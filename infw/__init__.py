@@ -1,0 +1,22 @@
+"""infw — TPU-native ingress node firewall framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the OpenShift
+Ingress Node Firewall operator (reference at /root/reference): declarative
+firewall specs, admission validation with failsafe-port protection, per-node
+rule fan-out/merge, an idempotent sync boundary, and a packet-classification
+dataplane whose per-packet hot path (eBPF/XDP in the reference) is
+re-expressed as batched decision-matrix kernels on TPU.
+
+Layer map (see SURVEY.md §7):
+  spec / validate            — CRD types + webhook logic (L6)
+  controllers                — fan-out, merge, config deployment (L5)
+  syncer                     — per-node sync boundary singleton (L4)
+  compiler                   — rule compiler: spec -> tensors (L3)
+  kernels / backend          — classification dataplane (L1)
+  obs                        — statistics, events, pcap replay (L2)
+  daemon                     — node daemon loop (L4)
+"""
+
+__version__ = "0.1.0"
+
+from . import constants  # noqa: F401

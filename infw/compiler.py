@@ -1,0 +1,494 @@
+"""Rule compiler: declarative firewall specs -> packed classifier tensors.
+
+This is the TPU-native analogue of the reference's map writer
+(/root/reference/pkg/ebpf/ingress_node_firewall_loader.go):
+
+- ``encode_rules``     mirrors makeIngressFwRulesMap's rule packing
+  (loader.go:429-515): rule at array index == order, ruleId == order,
+  single port encoded as dstPortEnd==0, protocol numbers per syscall consts.
+- ``build_key``        mirrors BuildEBPFKey (loader.go:530-547): the LPM key
+  is (prefixLen = masklen + 32, ifindex, unmasked 16-byte address data).
+- ``build_table_content`` mirrors IngressNodeFwRulesLoader's
+  ebpfKeyToRules construction (loader.go:139-173) including the skip of
+  invalid interfaces and bond-member expansion.
+- ``compile_tables``   replaces Map.Update with tensor building: a dense
+  bit-matrix LPM representation (for the MXU compare-all kernel) and a
+  multibit trie (for the gather/scan kernel at 100K+ entries), plus the
+  (T, R, 7) int32 rule decision matrix mirroring ruleType_st
+  (bpf/ingress_node_firewall.h:69-77).
+
+Rule row columns: [ruleId, protocol, dstPortStart, dstPortEnd, icmpType,
+icmpCode, action] — all int32.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import portutils
+from .constants import (
+    ALLOW,
+    DENY,
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    MAX_RULES_PER_TARGET,
+)
+from .interfaces import InterfaceRegistry
+from .netutil import CIDRParseError, key_prefix_len, parse_cidr
+from .spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    PROTOCOL_TYPE_ICMP,
+    PROTOCOL_TYPE_ICMP6,
+    PROTOCOL_TYPE_SCTP,
+    PROTOCOL_TYPE_TCP,
+    PROTOCOL_TYPE_UDP,
+    IngressNodeFirewallRules,
+)
+
+RULE_COLS = 7
+COL_RULE_ID = 0
+COL_PROTOCOL = 1
+COL_PORT_START = 2
+COL_PORT_END = 3
+COL_ICMP_TYPE = 4
+COL_ICMP_CODE = 5
+COL_ACTION = 6
+
+MAX_IFINDEX = 1 << 20
+
+
+class CompileError(ValueError):
+    pass
+
+
+class LpmKey(NamedTuple):
+    """BpfLpmIpKeySt equivalent (bpf/ingress_node_firewall.h:83-87).
+
+    ``ip_data`` carries the *unmasked* address bytes exactly like the
+    reference key (loader.go:537-541); masking happens at insert time.
+    """
+
+    prefix_len: int
+    ingress_ifindex: int
+    ip_data: bytes  # 16 bytes
+
+    @property
+    def mask_len(self) -> int:
+        return self.prefix_len - 32
+
+    def masked_identity(self) -> Tuple[int, int, bytes]:
+        """The bits the LPM trie actually keys on: (prefixLen, ifindex,
+        ip_data masked to mask_len bits).  Two keys with equal masked
+        identity address the same trie entry, so a later insert replaces
+        the earlier one (kernel lpm_trie semantics)."""
+        m = self.mask_len
+        data = bytearray(self.ip_data)
+        full, rem = divmod(m, 8)
+        for i in range(full + (1 if rem else 0), 16):
+            if i == full and rem:
+                continue
+            data[i] = 0
+        if rem:
+            data[full] &= (0xFF00 >> rem) & 0xFF
+        return (self.prefix_len, self.ingress_ifindex, bytes(data))
+
+
+def encode_rules(
+    ingress: IngressNodeFirewallRules, width: int = MAX_RULES_PER_TARGET
+) -> np.ndarray:
+    """CRD protocol rules -> (width, 7) int32 row matrix.
+
+    Mirrors loader.go:434-515: the row index is the rule's ``order`` and
+    ruleId == order; index 0 stays zeroed (reserved catch-all slot,
+    ingressnodefirewall_types.go:94).  Orders outside [1, width) are a
+    compile error (the reference would panic on the array store)."""
+    rules = np.zeros((width, RULE_COLS), dtype=np.int32)
+    for rule in ingress.rules:
+        idx = rule.order
+        if idx < 1 or idx >= width:
+            raise CompileError(
+                f"rule order {idx} out of range [1, {width})"
+            )
+        rules[idx, COL_RULE_ID] = idx
+        pc = rule.protocol_config
+        proto = pc.protocol
+        if proto in (PROTOCOL_TYPE_TCP, PROTOCOL_TYPE_UDP, PROTOCOL_TYPE_SCTP):
+            pr = {PROTOCOL_TYPE_TCP: pc.tcp, PROTOCOL_TYPE_UDP: pc.udp,
+                  PROTOCOL_TYPE_SCTP: pc.sctp}[proto]
+            if pr is None:
+                raise CompileError(f"missing port config for protocol {proto}")
+            try:
+                if portutils.is_range(pr):
+                    start, end = portutils.get_range(pr)
+                    rules[idx, COL_PORT_START] = start
+                    rules[idx, COL_PORT_END] = end
+                else:
+                    rules[idx, COL_PORT_START] = portutils.get_port(pr)
+                    rules[idx, COL_PORT_END] = 0
+            except portutils.PortParseError as e:
+                raise CompileError(f"invalid Port {pr.ports!r} for protocol {proto}: {e}")
+            rules[idx, COL_PROTOCOL] = {
+                PROTOCOL_TYPE_TCP: IPPROTO_TCP,
+                PROTOCOL_TYPE_UDP: IPPROTO_UDP,
+                PROTOCOL_TYPE_SCTP: IPPROTO_SCTP,
+            }[proto]
+        elif proto == PROTOCOL_TYPE_ICMP:
+            if pc.icmp is None:
+                raise CompileError("missing ICMP config")
+            rules[idx, COL_ICMP_TYPE] = pc.icmp.icmp_type
+            rules[idx, COL_ICMP_CODE] = pc.icmp.icmp_code
+            rules[idx, COL_PROTOCOL] = IPPROTO_ICMP
+        elif proto == PROTOCOL_TYPE_ICMP6:
+            if pc.icmpv6 is None:
+                raise CompileError("missing ICMPv6 config")
+            rules[idx, COL_ICMP_TYPE] = pc.icmpv6.icmp_type
+            rules[idx, COL_ICMP_CODE] = pc.icmpv6.icmp_code
+            rules[idx, COL_PROTOCOL] = IPPROTO_ICMPV6
+        # An unset/"" protocol leaves Protocol==0: the catch-all rule
+        # (kernel.c:254-257).
+
+        if rule.action == ACTION_ALLOW:
+            rules[idx, COL_ACTION] = ALLOW
+        elif rule.action == ACTION_DENY:
+            rules[idx, COL_ACTION] = DENY
+        else:
+            raise CompileError(f"Failed invalid action {rule.action!r}")
+    return rules
+
+
+def build_key(if_id: int, cidr: str) -> LpmKey:
+    """BuildEBPFKey (loader.go:530-547)."""
+    try:
+        parsed = parse_cidr(cidr)
+    except CIDRParseError as e:
+        raise CompileError(f"Failed to parse SourceCIDRs: {e}")
+    return LpmKey(
+        prefix_len=key_prefix_len(parsed.mask_len),
+        ingress_ifindex=if_id,
+        ip_data=parsed.ip_data,
+    )
+
+
+def make_ingress_fw_rules_map(
+    ingress: IngressNodeFirewallRules,
+    if_id: int,
+    width: int = MAX_RULES_PER_TARGET,
+) -> Tuple[List[LpmKey], np.ndarray]:
+    """makeIngressFwRulesMap (loader.go:429-527): one packed rule matrix
+    shared by one key per CIDR."""
+    rules = encode_rules(ingress, width)
+    keys = [build_key(if_id, cidr) for cidr in ingress.source_cidrs]
+    return keys, rules
+
+
+def build_table_content(
+    iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
+    registry: InterfaceRegistry,
+    width: int = MAX_RULES_PER_TARGET,
+    is_valid_interface=None,
+) -> Dict[LpmKey, np.ndarray]:
+    """The ebpfKeyToRules map (loader.go:139-173): desired LPM table
+    content keyed by the full (unmasked) key.  Invalid interfaces are
+    skipped with no error; unknown interfaces raise (mirroring
+    GetInterfaceIndices error propagation, loader.go:149-152)."""
+    if is_valid_interface is None:
+        is_valid_interface = registry.is_valid_interface_name_and_state
+    content: Dict[LpmKey, np.ndarray] = {}
+    for iface_name, ingress_rules in iface_ingress_rules.items():
+        if not is_valid_interface(iface_name):
+            continue
+        if_ids = registry.get_interface_indices(iface_name)
+        for ingress in ingress_rules:
+            for if_id in if_ids:
+                keys, rules = make_ingress_fw_rules_map(ingress, if_id, width)
+                for key in keys:
+                    content[key] = rules
+    return content
+
+
+def min_rule_width(
+    iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
+) -> int:
+    """Smallest rule-matrix width that still places every rule at index ==
+    order (used to shrink the (T, R, 7) tensor below the full 100)."""
+    max_order = 0
+    for ingress_rules in iface_ingress_rules.values():
+        for ingress in ingress_rules:
+            for rule in ingress.rules:
+                max_order = max(max_order, rule.order)
+    return max(2, max_order + 1)
+
+
+# --- compiled tensors -------------------------------------------------------
+
+@dataclass
+class CompiledTables:
+    """Device-ready classifier state compiled from one desired ruleset.
+
+    Dense LPM representation (for the compare-all MXU kernel):
+      key_words:  (T, 5) uint32 — [ifindex, ip word0..3] big-endian words of
+                  the masked 160-bit LPM key,
+      mask_words: (T, 5) uint32 — 160-bit mask (ifindex word always ~0),
+      mask_len:   (T,)  int32   — CIDR mask length (without ifindex bits).
+
+    Trie representation (for the gather kernel): a multibit trie with
+    ``stride`` bits per level over the 128 IP bits; per-interface roots.
+      trie_child:  (N * slots,) int32 — child node index, 0 = none,
+      trie_target: (N * slots,) int32 — best terminating target, -1 = none,
+      root_lut:    (max_ifindex+1,) int32 — ifindex -> root node, 0 = none.
+
+    Shared:
+      rules: (T, R, 7) int32 rule decision matrix.
+    """
+
+    rule_width: int
+    stride: int
+    num_entries: int
+    key_words: np.ndarray
+    mask_words: np.ndarray
+    mask_len: np.ndarray
+    rules: np.ndarray
+    trie_child: np.ndarray
+    trie_target: np.ndarray
+    root_lut: np.ndarray
+    content: Dict[LpmKey, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def num_targets(self) -> int:
+        return int(self.rules.shape[0])
+
+    @property
+    def num_trie_nodes(self) -> int:
+        return int(self.trie_child.shape[0] // (1 << self.stride))
+
+    @property
+    def levels(self) -> int:
+        return 128 // self.stride
+
+    @property
+    def v4_level_cap(self) -> int:
+        """Deepest trie level (0-based) whose targets an IPv4 packet may
+        accept: masklen <= 32 <=> level < 32 // stride."""
+        return 32 // self.stride
+
+    def save(self, path: str) -> None:
+        """Persist compiled state (the pinned-map equivalent; see
+        infw.syncer checkpointing)."""
+        import json
+
+        meta = {
+            "rule_width": self.rule_width,
+            "stride": self.stride,
+            "num_entries": self.num_entries,
+            "content_keys": [
+                [k.prefix_len, k.ingress_ifindex, k.ip_data.hex()]
+                for k in self.content
+            ],
+        }
+        content_rules = (
+            np.stack([self.content[k] for k in self.content])
+            if self.content
+            else np.zeros((0, self.rule_width, RULE_COLS), np.int32)
+        )
+        np.savez_compressed(
+            path,
+            meta=json.dumps(meta),
+            key_words=self.key_words,
+            mask_words=self.mask_words,
+            mask_len=self.mask_len,
+            rules=self.rules,
+            trie_child=self.trie_child,
+            trie_target=self.trie_target,
+            root_lut=self.root_lut,
+            content_rules=content_rules,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "CompiledTables":
+        import json
+
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["meta"]))
+            content_rules = z["content_rules"]
+            content = {}
+            for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
+                content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = content_rules[i]
+            return cls(
+                rule_width=meta["rule_width"],
+                stride=meta["stride"],
+                num_entries=meta["num_entries"],
+                key_words=z["key_words"],
+                mask_words=z["mask_words"],
+                mask_len=z["mask_len"],
+                rules=z["rules"],
+                trie_child=z["trie_child"],
+                trie_target=z["trie_target"],
+                root_lut=z["root_lut"],
+                content=content,
+            )
+
+
+def _words_from_bytes(data: bytes) -> List[int]:
+    return [int.from_bytes(data[i : i + 4], "big") for i in range(0, 16, 4)]
+
+
+def _mask_words_for(mask_len: int) -> List[int]:
+    words = []
+    remaining = mask_len
+    for _ in range(4):
+        bits = min(32, max(0, remaining))
+        words.append(((0xFFFFFFFF << (32 - bits)) & 0xFFFFFFFF) if bits else 0)
+        remaining -= bits
+    return words
+
+
+class _TrieBuilder:
+    """Leaf-pushed multibit trie with ``stride`` bits per level.
+
+    Node 0 is the null node (all child 0, all targets -1); interface roots
+    are allocated on demand.  Slot-level priority during expansion follows
+    longest-prefix order; equal-length (i.e. identical) prefixes are
+    last-writer-wins like kernel trie updates.
+    """
+
+    def __init__(self, stride: int):
+        if stride not in (4, 8):
+            raise CompileError(f"unsupported trie stride {stride}")
+        self.stride = stride
+        self.slots = 1 << stride
+        self.child: List[np.ndarray] = [np.zeros(self.slots, np.int32)]
+        self.target: List[np.ndarray] = [np.full(self.slots, -1, np.int32)]
+        self.slot_mask_len: List[np.ndarray] = [np.full(self.slots, -1, np.int32)]
+        self.roots: Dict[int, int] = {}
+
+    def _new_node(self) -> int:
+        self.child.append(np.zeros(self.slots, np.int32))
+        self.target.append(np.full(self.slots, -1, np.int32))
+        self.slot_mask_len.append(np.full(self.slots, -1, np.int32))
+        return len(self.child) - 1
+
+    def _root_for(self, ifindex: int) -> int:
+        node = self.roots.get(ifindex)
+        if node is None:
+            node = self._new_node()
+            self.roots[ifindex] = node
+        return node
+
+    def insert(self, ifindex: int, ip_data: bytes, mask_len: int, target: int) -> None:
+        node = self._root_for(ifindex)
+        bits = int.from_bytes(ip_data, "big")  # 128-bit big-endian value
+        depth = 0
+        remaining = mask_len
+        while remaining > self.stride:
+            shift = 128 - self.stride * (depth + 1)
+            slot = (bits >> shift) & (self.slots - 1)
+            nxt = int(self.child[node][slot])
+            if nxt == 0:
+                nxt = self._new_node()
+                self.child[node][slot] = nxt
+            node = nxt
+            depth += 1
+            remaining -= self.stride
+        # Expand the remaining (0..stride] bits into 2^(stride-remaining)
+        # slots of this node; longest prefix wins per slot, ties (identical
+        # prefixes) overwrite (map-update semantics).
+        shift = 128 - self.stride * (depth + 1)
+        base_slot = (bits >> shift) & (self.slots - 1)
+        span = 1 << (self.stride - remaining)
+        base_slot &= ~(span - 1)
+        for slot in range(base_slot, base_slot + span):
+            if mask_len >= self.slot_mask_len[node][slot]:
+                self.slot_mask_len[node][slot] = mask_len
+                self.target[node][slot] = target
+
+    def arrays(self, max_ifindex: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        child = np.concatenate(self.child) if self.child else np.zeros(0, np.int32)
+        target = np.concatenate(self.target) if self.target else np.zeros(0, np.int32)
+        root_lut = np.zeros(max_ifindex + 1, np.int32)
+        for ifindex, node in self.roots.items():
+            root_lut[ifindex] = node
+        return child, target, root_lut
+
+
+def compile_tables(
+    iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
+    registry: InterfaceRegistry,
+    rule_width: Optional[int] = None,
+    stride: int = 4,
+    is_valid_interface=None,
+) -> CompiledTables:
+    """Full compile: desired interface rules -> CompiledTables."""
+    if rule_width is None:
+        rule_width = min_rule_width(iface_ingress_rules)
+    rule_width = min(max(rule_width, 2), MAX_RULES_PER_TARGET)
+
+    content = build_table_content(
+        iface_ingress_rules, registry, rule_width, is_valid_interface
+    )
+    return compile_tables_from_content(content, rule_width=rule_width, stride=stride)
+
+
+def compile_tables_from_content(
+    content: Dict[LpmKey, np.ndarray],
+    rule_width: int = MAX_RULES_PER_TARGET,
+    stride: int = 4,
+) -> CompiledTables:
+    """Build tensors from explicit LPM-map content (also used by tests to
+    drive adversarial tables directly)."""
+    # Deduplicate by masked identity, later entries replacing earlier ones —
+    # exactly what successive Map.Update calls do on the kernel trie.
+    dedup: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray]] = {}
+    for key, rules in content.items():
+        if key.ingress_ifindex < 0 or key.ingress_ifindex > MAX_IFINDEX:
+            raise CompileError(f"ifindex {key.ingress_ifindex} out of supported range")
+        if not (32 <= key.prefix_len <= 160):
+            raise CompileError(f"prefixLen {key.prefix_len} out of range [32,160]")
+        dedup[key.masked_identity()] = (key, rules)
+
+    entries = list(dedup.values())
+    T = len(entries)
+    R = rule_width
+
+    key_words = np.zeros((max(T, 1), 5), np.uint32)
+    mask_words = np.zeros((max(T, 1), 5), np.uint32)
+    mask_len = np.zeros(max(T, 1), np.int32)
+    rules = np.zeros((max(T, 1), R, RULE_COLS), np.int32)
+
+    trie = _TrieBuilder(stride)
+    max_ifindex = max((k.ingress_ifindex for k, _ in entries), default=0)
+
+    for t, (key, rule_rows) in enumerate(entries):
+        m = key.mask_len
+        _, _, masked_ip = key.masked_identity()
+        words = _words_from_bytes(masked_ip)
+        key_words[t] = [key.ingress_ifindex] + words
+        mask_words[t] = [0xFFFFFFFF] + _mask_words_for(m)
+        mask_len[t] = m
+        rows = np.asarray(rule_rows, np.int32)
+        if rows.shape[0] < R:
+            padded = np.zeros((R, RULE_COLS), np.int32)
+            padded[: rows.shape[0]] = rows
+            rows = padded
+        rules[t] = rows[:R]
+        trie.insert(key.ingress_ifindex, masked_ip, m, t)
+
+    trie_child, trie_target, root_lut = trie.arrays(max_ifindex)
+    return CompiledTables(
+        rule_width=R,
+        stride=stride,
+        num_entries=T,
+        key_words=key_words[:max(T, 1)],
+        mask_words=mask_words,
+        mask_len=mask_len,
+        rules=rules,
+        trie_child=trie_child,
+        trie_target=trie_target,
+        root_lut=root_lut,
+        content=dict(content),
+    )

@@ -1,0 +1,119 @@
+"""Virtual network-interface registry.
+
+The reference resolves interface names via netlink
+(/root/reference/pkg/interfaces/interfaces.go): validity = up and not
+loopback (:24-35), name -> index (:53-60), and bond interfaces expand to
+their member indices (:85-116).  On a TPU host the dataplane is fed packet
+batches rather than NIC queues, so interfaces become a declarative registry
+that the daemon configures; the resolution semantics (including bond
+expansion and the "invalid interfaces are skipped, not errors" behavior)
+are preserved exactly.
+
+Like the reference's test seam (the package-level ``netInterfaces`` var,
+interfaces.go:11-13 / ebpfsyncer.go:26), the registry lookup is a module
+function that tests can monkeypatch.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class InterfaceError(RuntimeError):
+    pass
+
+
+@dataclass
+class Interface:
+    name: str
+    index: int
+    up: bool = True
+    loopback: bool = False
+    type: str = "device"          # "device" | "bond"
+    master: Optional[str] = None  # bond master name for member links
+    xdp_attached: bool = False    # mirrors netlink's Xdp.Attached flag
+
+
+class InterfaceRegistry:
+    """In-memory mirror of the host link table."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ifaces: Dict[str, Interface] = {}
+
+    def add(self, iface: Interface) -> None:
+        with self._lock:
+            self._ifaces[iface.name] = iface
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._ifaces.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ifaces.clear()
+
+    def get(self, name: str) -> Optional[Interface]:
+        with self._lock:
+            return self._ifaces.get(name)
+
+    def list(self) -> List[Interface]:
+        with self._lock:
+            return list(self._ifaces.values())
+
+    def is_valid_interface_name_and_state(self, name: str) -> bool:
+        """IsValidInterfaceNameAndState (interfaces.go:24-35)."""
+        iface = self.get(name)
+        return iface is not None and iface.up and not iface.loopback
+
+    def get_interface_index(self, name: str) -> int:
+        """GetInterfaceIndex (interfaces.go:53-60)."""
+        iface = self.get(name)
+        if iface is None:
+            raise InterfaceError(f"looking up network interface name {name!r}: not found")
+        return iface.index
+
+    def get_interface_indices(self, name: str) -> List[int]:
+        """GetInterfaceIndices (interfaces.go:85-116): non-bond interfaces
+        resolve to their own index; bonds resolve to all member indices."""
+        iface = self.get(name)
+        if iface is None:
+            raise InterfaceError(f"link {name!r} not found")
+        if iface.type != "bond":
+            return [self.get_interface_index(name)]
+        return [l.index for l in self.list() if l.master == name]
+
+    def get_interfaces_with_xdp_attached(self) -> List[str]:
+        """GetInterfacesWithXDPAttached (interfaces.go:38-50)."""
+        return [l.name for l in self.list() if l.xdp_attached]
+
+    def detach_xdp_from_all_interfaces(self) -> None:
+        """DetachXDPFromAllInterfaces (interfaces.go:63-81)."""
+        with self._lock:
+            for iface in self._ifaces.values():
+                iface.xdp_attached = False
+
+    def set_xdp(self, name: str, attached: bool) -> None:
+        iface = self.get(name)
+        if iface is None:
+            raise InterfaceError(f"link {name!r} not found")
+        iface.xdp_attached = attached
+
+
+# Process-global default registry, preloaded with a typical node NIC so the
+# out-of-the-box experience matches a single-NIC node.
+default_registry = InterfaceRegistry()
+default_registry.add(Interface(name="eth0", index=2))
+
+
+def is_valid_interface_name_and_state(name: str) -> bool:
+    return default_registry.is_valid_interface_name_and_state(name)
+
+
+def get_interface_index(name: str) -> int:
+    return default_registry.get_interface_index(name)
+
+
+def get_interface_indices(name: str) -> List[int]:
+    return default_registry.get_interface_indices(name)

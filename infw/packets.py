@@ -1,0 +1,137 @@
+"""Batched packet representation.
+
+The reference's per-packet inputs are the XDP context fields consumed by
+ingress_node_firewall_main and ip_extract_l4info
+(/root/reference/bpf/ingress_node_firewall_kernel.c:95-174,412-439):
+ethertype, source IP, L4 protocol, destination port or ICMP type/code,
+ingress ifindex and packet length.  The TPU dataplane consumes those same
+fields as a struct-of-arrays batch; header parsing from raw bytes happens
+host-side (infw.obs.pcap) or packets are generated synthetically.
+
+Field conventions:
+- ``kind``: KIND_* code for the ethertype switch outcome (constants.py);
+- ``l4_ok``: 0 if ip_extract_l4info would have failed (unsupported L4
+  protocol or truncated header) -> lookup returns SET_ACTION(UNDEF);
+- ``ip_words``: (B, 4) uint32 big-endian words of the 16-byte source-IP key
+  data (IPv4 packets occupy word 0, rest zero — kernel.c:206-212);
+- ``dst_port`` is host byte order (the kernel compares bpf_ntohs(dstPort));
+- ``pkt_len`` is the full frame length (bpf_xdp_get_buff_len).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .constants import KIND_IPV4, KIND_IPV6
+from .netutil import ip_str_to_words
+
+
+@dataclass
+class PacketBatch:
+    kind: np.ndarray       # (B,) int32
+    l4_ok: np.ndarray      # (B,) int32 (0/1)
+    ifindex: np.ndarray    # (B,) int32
+    ip_words: np.ndarray   # (B, 4) uint32
+    proto: np.ndarray      # (B,) int32
+    dst_port: np.ndarray   # (B,) int32
+    icmp_type: np.ndarray  # (B,) int32
+    icmp_code: np.ndarray  # (B,) int32
+    pkt_len: np.ndarray    # (B,) int32
+
+    def __len__(self) -> int:
+        return int(self.kind.shape[0])
+
+    @property
+    def size(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, stop: int) -> "PacketBatch":
+        return PacketBatch(
+            **{
+                f: getattr(self, f)[start:stop]
+                for f in (
+                    "kind l4_ok ifindex ip_words proto dst_port "
+                    "icmp_type icmp_code pkt_len".split()
+                )
+            }
+        )
+
+    def pad_to(self, n: int) -> "PacketBatch":
+        """Pad with KIND_OTHER packets (always XDP_PASS, no stats) so batch
+        shapes stay static under jit."""
+        b = len(self)
+        if b >= n:
+            return self
+        pad = n - b
+
+        def _pad(a):
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths, constant_values=3)  # KIND_OTHER / junk
+
+        return PacketBatch(
+            kind=_pad(self.kind),
+            l4_ok=np.pad(self.l4_ok, (0, pad)),
+            ifindex=np.pad(self.ifindex, (0, pad)),
+            ip_words=np.pad(self.ip_words, ((0, pad), (0, 0))),
+            proto=np.pad(self.proto, (0, pad)),
+            dst_port=np.pad(self.dst_port, (0, pad)),
+            icmp_type=np.pad(self.icmp_type, (0, pad)),
+            icmp_code=np.pad(self.icmp_code, (0, pad)),
+            pkt_len=np.pad(self.pkt_len, (0, pad)),
+        )
+
+
+def make_batch(
+    *,
+    src: Sequence[str],
+    proto: Sequence[int],
+    ifindex: Sequence[int],
+    dst_port: Optional[Sequence[int]] = None,
+    icmp_type: Optional[Sequence[int]] = None,
+    icmp_code: Optional[Sequence[int]] = None,
+    pkt_len: Optional[Sequence[int]] = None,
+    l4_ok: Optional[Sequence[int]] = None,
+    kind: Optional[Sequence[int]] = None,
+) -> PacketBatch:
+    """Convenience constructor from parallel per-packet field lists; ``src``
+    is a list of IP address strings and determines v4/v6 kind."""
+    b = len(src)
+    words = np.zeros((b, 4), np.uint32)
+    kinds = np.zeros(b, np.int32)
+    for i, addr in enumerate(src):
+        w, is_v4 = ip_str_to_words(addr)
+        words[i] = w
+        kinds[i] = KIND_IPV4 if is_v4 else KIND_IPV6
+    if kind is not None:
+        kinds = np.asarray(kind, np.int32)
+
+    def arr(x, default=0):
+        if x is None:
+            return np.full(b, default, np.int32)
+        return np.asarray(x, np.int32)
+
+    return PacketBatch(
+        kind=kinds,
+        l4_ok=arr(l4_ok, 1),
+        ifindex=arr(ifindex),
+        ip_words=words,
+        proto=arr(proto),
+        dst_port=arr(dst_port),
+        icmp_type=arr(icmp_type),
+        icmp_code=arr(icmp_code),
+        pkt_len=arr(pkt_len, 64),
+    )
+
+
+def concat(batches: List[PacketBatch]) -> PacketBatch:
+    return PacketBatch(
+        **{
+            f: np.concatenate([getattr(b, f) for b in batches])
+            for f in (
+                "kind l4_ok ifindex ip_words proto dst_port "
+                "icmp_type icmp_code pkt_len".split()
+            )
+        }
+    )

@@ -1,0 +1,182 @@
+"""T1: rule compiler semantics (reference loader.go:429-547 encoding)."""
+import numpy as np
+import pytest
+
+from infw import compiler
+from infw.constants import ALLOW, DENY, IPPROTO_ICMP, IPPROTO_ICMPV6, IPPROTO_TCP, IPPROTO_UDP
+from infw.interfaces import Interface, InterfaceRegistry
+from infw.spec import (
+    IngressNodeFirewallICMPRule,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallRules,
+    IngressNodeProtocolConfig,
+)
+
+
+def proto_rule(order, protocol, action="Allow", **kw):
+    pc = IngressNodeProtocolConfig(protocol=protocol)
+    if protocol in ("TCP", "UDP", "SCTP"):
+        pr = IngressNodeFirewallProtoRule(ports=kw.get("ports", 80))
+        setattr(pc, protocol.lower(), pr)
+    elif protocol == "ICMP":
+        pc.icmp = IngressNodeFirewallICMPRule(
+            icmp_type=kw.get("t", 8), icmp_code=kw.get("c", 0)
+        )
+    elif protocol == "ICMPv6":
+        pc.icmpv6 = IngressNodeFirewallICMPRule(
+            icmp_type=kw.get("t", 128), icmp_code=kw.get("c", 0)
+        )
+    return IngressNodeFirewallProtocolRule(order=order, protocol_config=pc, action=action)
+
+
+def test_rule_row_index_is_order_and_ruleid_is_order():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(5, "TCP", ports=8080, action="Deny")]
+    )
+    rows = compiler.encode_rules(ing)
+    assert rows[5, compiler.COL_RULE_ID] == 5
+    assert rows[5, compiler.COL_PROTOCOL] == IPPROTO_TCP
+    assert rows[5, compiler.COL_PORT_START] == 8080
+    assert rows[5, compiler.COL_PORT_END] == 0  # single port -> end==0
+    assert rows[5, compiler.COL_ACTION] == DENY
+    # all other slots empty (ruleId 0 == INVALID_RULE_ID)
+    assert rows[[0, 1, 4, 6], compiler.COL_RULE_ID].sum() == 0
+
+
+def test_range_encoding():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(1, "UDP", ports="100-200")]
+    )
+    rows = compiler.encode_rules(ing)
+    assert rows[1, compiler.COL_PROTOCOL] == IPPROTO_UDP
+    assert rows[1, compiler.COL_PORT_START] == 100
+    assert rows[1, compiler.COL_PORT_END] == 200
+    assert rows[1, compiler.COL_ACTION] == ALLOW
+
+
+def test_icmp_encoding():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"],
+        rules=[proto_rule(2, "ICMP", t=8, c=0), proto_rule(3, "ICMPv6", t=128, c=0)],
+    )
+    rows = compiler.encode_rules(ing)
+    assert rows[2, compiler.COL_PROTOCOL] == IPPROTO_ICMP
+    assert rows[2, compiler.COL_ICMP_TYPE] == 8
+    assert rows[3, compiler.COL_PROTOCOL] == IPPROTO_ICMPV6
+    assert rows[3, compiler.COL_ICMP_TYPE] == 128
+
+
+def test_unset_protocol_is_catch_all():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"],
+        rules=[
+            IngressNodeFirewallProtocolRule(
+                order=1, protocol_config=IngressNodeProtocolConfig(protocol=""), action="Deny"
+            )
+        ],
+    )
+    rows = compiler.encode_rules(ing)
+    assert rows[1, compiler.COL_PROTOCOL] == 0
+    assert rows[1, compiler.COL_ACTION] == DENY
+
+
+def test_order_out_of_range_is_error():
+    # order >= width would be an array-OOB panic in the reference loader.
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(100, "TCP", ports=80)]
+    )
+    with pytest.raises(compiler.CompileError):
+        compiler.encode_rules(ing, width=100)
+
+
+def test_invalid_action_is_error():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(1, "TCP", ports=80, action="Nope")]
+    )
+    with pytest.raises(compiler.CompileError):
+        compiler.encode_rules(ing)
+
+
+def test_build_key_v4():
+    key = compiler.build_key(7, "192.168.1.5/24")
+    assert key.prefix_len == 24 + 32
+    assert key.ingress_ifindex == 7
+    # Unmasked address bytes in the key data (loader.go:537-541).
+    assert key.ip_data[:4] == bytes([192, 168, 1, 5])
+    assert key.ip_data[4:] == bytes(12)
+
+
+def test_build_key_v6():
+    key = compiler.build_key(3, "2002:db8::1/32")
+    assert key.prefix_len == 32 + 32
+    assert key.ip_data[:4] == bytes([0x20, 0x02, 0x0D, 0xB8])
+
+
+def test_build_key_invalid_cidr():
+    with pytest.raises(compiler.CompileError):
+        compiler.build_key(1, "192.168.1.5")
+
+
+def test_masked_identity_collision_last_wins():
+    # Two keys with the same effective prefix collapse into one trie entry,
+    # the later insert winning (kernel LPM map update semantics).
+    ing_a = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.1/8"], rules=[proto_rule(1, "TCP", ports=80, action="Deny")]
+    )
+    ing_b = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.2/8"], rules=[proto_rule(1, "TCP", ports=80, action="Allow")]
+    )
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2))
+    tables = compiler.compile_tables({"eth0": [ing_a, ing_b]}, reg)
+    assert tables.num_entries == 1
+    assert tables.rules[0, 1, compiler.COL_ACTION] == ALLOW
+
+
+def test_bond_expansion():
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="bond0", index=10, type="bond"))
+    reg.add(Interface(name="eth1", index=11, master="bond0"))
+    reg.add(Interface(name="eth2", index=12, master="bond0"))
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(1, "TCP", ports=80)]
+    )
+    tables = compiler.compile_tables({"bond0": [ing]}, reg)
+    assert tables.num_entries == 2
+    assert sorted(int(w) for w in tables.key_words[:, 0]) == [11, 12]
+
+
+def test_invalid_interface_skipped():
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2, up=False))  # down -> invalid -> skip
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(1, "TCP", ports=80)]
+    )
+    tables = compiler.compile_tables({"eth0": [ing]}, reg)
+    assert tables.num_entries == 0
+
+
+def test_compiled_tables_roundtrip(tmp_path):
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2))
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24", "2002:db8::/32"],
+        rules=[proto_rule(1, "TCP", ports="80-90", action="Deny")],
+    )
+    tables = compiler.compile_tables({"eth0": [ing]}, reg)
+    path = str(tmp_path / "tables.npz")
+    tables.save(path)
+    loaded = compiler.CompiledTables.load(path)
+    assert loaded.num_entries == tables.num_entries
+    np.testing.assert_array_equal(loaded.rules, tables.rules)
+    np.testing.assert_array_equal(loaded.trie_child, tables.trie_child)
+    np.testing.assert_array_equal(loaded.root_lut, tables.root_lut)
+    assert set(loaded.content.keys()) == set(tables.content.keys())
+
+
+def test_min_rule_width():
+    ing = IngressNodeFirewallRules(
+        source_cidrs=["10.0.0.0/24"], rules=[proto_rule(17, "TCP", ports=80)]
+    )
+    assert compiler.min_rule_width({"eth0": [ing]}) == 18

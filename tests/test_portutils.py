@@ -1,0 +1,74 @@
+"""T1: port/range parsing semantics (reference pkg/utils/utils.go)."""
+import pytest
+
+from infw import portutils
+from infw.spec import IngressNodeFirewallProtoRule as Proto
+
+
+def test_int_port_is_not_range():
+    assert not portutils.is_range(Proto(ports=80))
+
+
+def test_string_single_port_is_not_range():
+    assert not portutils.is_range(Proto(ports="80"))
+
+
+def test_string_range_detected():
+    assert portutils.is_range(Proto(ports="80-100"))
+
+
+def test_get_port_int():
+    assert portutils.get_port(Proto(ports=80)) == 80
+
+
+def test_get_port_string():
+    assert portutils.get_port(Proto(ports="8080")) == 8080
+
+
+def test_get_port_rejects_range():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_port(Proto(ports="80-100"))
+
+
+def test_get_port_rejects_zero():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_port(Proto(ports=0))
+
+
+def test_get_port_rejects_over_uint16():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_port(Proto(ports=65536))
+
+
+def test_get_port_rejects_garbage():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_port(Proto(ports="http"))
+
+
+def test_get_range_ok():
+    assert portutils.get_range(Proto(ports="80-100")) == (80, 100)
+
+
+def test_get_range_rejects_non_range():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_range(Proto(ports=80))
+
+
+def test_get_range_rejects_start_gt_end():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_range(Proto(ports="100-80"))
+
+
+def test_get_range_rejects_equal():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_range(Proto(ports="80-80"))
+
+
+def test_get_range_rejects_start_zero():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_range(Proto(ports="0-80"))
+
+
+def test_get_range_rejects_bad_end():
+    with pytest.raises(portutils.PortParseError):
+        portutils.get_range(Proto(ports="80-lots"))

@@ -1,0 +1,192 @@
+"""T1: admission validation semantics (reference pkg/webhook/webhook.go and
+its envtest suite pkg/webhook/webhook_suite_test.go accept/reject matrix)."""
+import pytest
+
+from infw import validate
+from infw.spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    IngressNodeFirewall,
+    IngressNodeFirewallICMPRule,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallRules,
+    IngressNodeFirewallSpec,
+    IngressNodeProtocolConfig,
+    ObjectMeta,
+)
+
+
+def inf(name="fw", cidrs=("10.0.0.0/24",), rules=(), interfaces=("eth0",), selector=None):
+    return IngressNodeFirewall(
+        metadata=ObjectMeta(name=name),
+        spec=IngressNodeFirewallSpec(
+            node_selector=dict(selector or {}),
+            ingress=[
+                IngressNodeFirewallRules(source_cidrs=list(cidrs), rules=list(rules))
+            ],
+            interfaces=list(interfaces),
+        ),
+    )
+
+
+def tcp_rule(order, ports, action=ACTION_DENY):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol="TCP", tcp=IngressNodeFirewallProtoRule(ports=ports)
+        ),
+        action=action,
+    )
+
+
+def udp_rule(order, ports, action=ACTION_DENY):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol="UDP", udp=IngressNodeFirewallProtoRule(ports=ports)
+        ),
+        action=action,
+    )
+
+
+def icmp_rule(order, t=8, c=0, action=ACTION_DENY):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol="ICMP", icmp=IngressNodeFirewallICMPRule(icmp_type=t, icmp_code=c)
+        ),
+        action=action,
+    )
+
+
+def test_valid_tcp_rule_accepted():
+    assert validate.validate_ingress_node_firewall(inf(rules=[tcp_rule(1, 8080)])) == []
+
+
+def test_valid_range_rule_accepted():
+    assert validate.validate_ingress_node_firewall(inf(rules=[tcp_rule(1, "800-900")])) == []
+
+
+def test_valid_icmp_rule_accepted():
+    assert validate.validate_ingress_node_firewall(inf(rules=[icmp_rule(1)])) == []
+
+
+def test_invalid_cidr_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(cidrs=["10.0.0.0"]))
+    assert any("CIDR" in e for e in errs)
+
+
+def test_empty_cidrs_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(cidrs=[]))
+    assert any("at least one sourceCIDR" in e for e in errs)
+
+
+def test_ipv6_cidr_accepted():
+    assert validate.validate_ingress_node_firewall(inf(cidrs=["2002:db8::/32"])) == []
+
+
+def test_blank_interface_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(interfaces=[""]))
+    assert any("blank" in e for e in errs)
+
+
+def test_long_interface_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(interfaces=["x" * 17]))
+    assert any("too long" in e for e in errs)
+
+
+def test_numeric_leading_interface_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(interfaces=["3eth0"]))
+    assert any("can't start with a number" in e for e in errs)
+
+
+def test_duplicate_order_rejected():
+    errs = validate.validate_ingress_node_firewall(
+        inf(rules=[tcp_rule(1, 8080), tcp_rule(1, 9090)])
+    )
+    assert any("unique order" in e for e in errs)
+
+
+def test_too_many_rules_rejected():
+    rules = [tcp_rule(i, 1000 + i) for i in range(1, 102)]
+    errs = validate.validate_ingress_node_firewall(inf(rules=rules))
+    assert any("no more than 100 rules" in e for e in errs)
+
+
+def test_icmp_rule_with_ports_rejected():
+    bad = icmp_rule(1)
+    bad.protocol_config.tcp = IngressNodeFirewallProtoRule(ports=80)
+    errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("ports are erroneously defined" in e for e in errs)
+
+
+def test_tcp_rule_without_ports_rejected():
+    bad = IngressNodeFirewallProtocolRule(
+        order=1, protocol_config=IngressNodeProtocolConfig(protocol="TCP")
+    )
+    errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("no port defined" in e for e in errs)
+
+
+def test_tcp_rule_with_icmp_rejected():
+    bad = tcp_rule(1, 80)
+    bad.protocol_config.icmp = IngressNodeFirewallICMPRule()
+    errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("ICMP type/code defined" in e for e in errs)
+
+
+@pytest.mark.parametrize("port", [6443, 2380, 2379, 22, 10250, 10259, 10257])
+def test_deny_on_tcp_failsafe_port_rejected(port):
+    errs = validate.validate_ingress_node_firewall(inf(rules=[tcp_rule(1, port)]))
+    assert any("conflict with access to" in e for e in errs)
+
+
+def test_deny_on_udp_failsafe_port_rejected():
+    errs = validate.validate_ingress_node_firewall(inf(rules=[udp_rule(1, 68)]))
+    assert any("conflict with access to DHCP" in e for e in errs)
+
+
+def test_allow_on_failsafe_port_accepted():
+    assert (
+        validate.validate_ingress_node_firewall(
+            inf(rules=[tcp_rule(1, 22, action=ACTION_ALLOW)])
+        )
+        == []
+    )
+
+
+def test_deny_range_covering_failsafe_rejected_closed_interval():
+    # The webhook's range check is closed [start, end] (webhook.go:316-318):
+    # 6000-6443 conflicts even though the dataplane range match is half-open.
+    errs = validate.validate_ingress_node_firewall(inf(rules=[tcp_rule(1, "6000-6443")]))
+    assert any("port range is in conflict" in e for e in errs)
+
+
+def test_deny_range_not_covering_failsafe_accepted():
+    assert validate.validate_ingress_node_firewall(inf(rules=[tcp_rule(1, "6444-6500")])) == []
+
+
+def test_cross_inf_order_overlap_rejected():
+    existing = inf(name="other", rules=[tcp_rule(1, 8080)])
+    new = inf(name="new", rules=[tcp_rule(1, 9090)])
+    errs = validate.validate_ingress_node_firewall(new, existing=[existing])
+    assert any("conflicts with IngressNodeFirewall" in e for e in errs)
+
+
+def test_cross_inf_no_overlap_with_different_selector():
+    existing = inf(name="other", rules=[tcp_rule(1, 8080)], selector={"role": "worker"})
+    new = inf(name="new", rules=[tcp_rule(1, 9090)])
+    assert validate.validate_ingress_node_firewall(new, existing=[existing]) == []
+
+
+def test_cross_inf_no_overlap_with_different_cidr():
+    existing = inf(name="other", cidrs=["192.168.0.0/16"], rules=[tcp_rule(1, 8080)])
+    new = inf(name="new", rules=[tcp_rule(1, 9090)])
+    assert validate.validate_ingress_node_firewall(new, existing=[existing]) == []
+
+
+def test_same_object_update_not_conflicting_with_itself():
+    existing = inf(name="same", rules=[tcp_rule(1, 8080)])
+    new = inf(name="same", rules=[tcp_rule(1, 9090)])
+    assert validate.validate_ingress_node_firewall(new, existing=[existing]) == []
