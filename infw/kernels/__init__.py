@@ -1,0 +1,7 @@
+"""Classification kernels.
+
+- jaxpath: pure JAX/XLA implementations (dense compare-all LPM for
+  reference-capacity tables, multibit-trie walk for 100K+ entries).
+- pallas_dense: fused Pallas TPU kernel for the dense path (MXU bit-matmul
+  LPM + one-hot rule gather + scan + stats).
+"""

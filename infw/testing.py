@@ -1,0 +1,190 @@
+"""Test fixtures: adversarial ruleset and packet generators.
+
+Replaces the reference's veth+netcat traffic harness
+(/root/reference/pkg/ebpfsyncer/ebpfsyncer_test.go:1236-1318) with synthetic
+rule tables and packet tensors; the reachability tables of that suite become
+golden verdict vectors checked against the NumPy oracle.
+"""
+from __future__ import annotations
+
+import ipaddress
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .compiler import LpmKey, compile_tables_from_content, CompiledTables
+from .constants import (
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    MAX_RULES_PER_TARGET,
+)
+from .packets import PacketBatch
+
+_PROTOS = [IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP, IPPROTO_ICMP, IPPROTO_ICMPV6, 0]
+
+
+def random_rules(
+    rng: np.random.Generator, width: int, max_rules: Optional[int] = None
+) -> np.ndarray:
+    """Random packed rule rows (width, 7) with the loader's invariants:
+    index == order == ruleId, index 0 empty."""
+    rows = np.zeros((width, 7), np.int32)
+    n = rng.integers(0, max_rules if max_rules is not None else width - 1, endpoint=True)
+    orders = rng.choice(np.arange(1, width), size=min(n, width - 1), replace=False)
+    for order in orders:
+        proto = _PROTOS[rng.integers(0, len(_PROTOS))]
+        rows[order, 0] = order
+        rows[order, 1] = proto
+        if proto in (IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP):
+            if rng.random() < 0.5:
+                start = int(rng.integers(1, 65000))
+                rows[order, 2] = start
+                rows[order, 3] = int(rng.integers(start + 1, 65536))
+            else:
+                rows[order, 2] = int(rng.integers(1, 65536))
+                rows[order, 3] = 0
+        elif proto in (IPPROTO_ICMP, IPPROTO_ICMPV6):
+            rows[order, 4] = int(rng.integers(0, 256))
+            rows[order, 5] = int(rng.integers(0, 3))
+        rows[order, 6] = int(rng.integers(1, 3))  # DENY or ALLOW
+    return rows
+
+
+def random_tables(
+    rng: np.random.Generator,
+    n_entries: int,
+    ifindexes: Tuple[int, ...] = (2, 3),
+    width: int = 16,
+    stride: int = 4,
+    v6_fraction: float = 0.3,
+    overlap_fraction: float = 0.3,
+) -> CompiledTables:
+    """Random LPM content with deliberately overlapping prefixes (nested
+    CIDRs of different lengths over shared bases) to stress longest-match
+    tie-breaks."""
+    content: Dict[LpmKey, np.ndarray] = {}
+    bases: List[Tuple[bytes, bool]] = []
+    while len(content) < n_entries:
+        is_v6 = rng.random() < v6_fraction
+        if bases and rng.random() < overlap_fraction:
+            base, is_v6 = bases[rng.integers(0, len(bases))]
+            data = bytearray(base)
+            # perturb tail bytes to create nested/sibling prefixes
+            pos = rng.integers(1, 16)
+            data[pos] = rng.integers(0, 256)
+            data = bytes(data)
+        else:
+            if is_v6:
+                data = bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+            else:
+                data = bytes(rng.integers(0, 256, 4, dtype=np.uint8)) + bytes(12)
+            bases.append((data, is_v6))
+        if is_v6:
+            mask_len = int(rng.choice([0, 8, 13, 24, 32, 48, 64, 96, 128]))
+        else:
+            mask_len = int(rng.choice([0, 1, 8, 13, 16, 24, 30, 31, 32]))
+            data = data[:4] + bytes(12)
+        ifindex = int(ifindexes[rng.integers(0, len(ifindexes))])
+        key = LpmKey(prefix_len=mask_len + 32, ingress_ifindex=ifindex, ip_data=data)
+        content[key] = random_rules(rng, width)
+    return compile_tables_from_content(content, rule_width=width, stride=stride)
+
+
+def random_batch(
+    rng: np.random.Generator,
+    tables: CompiledTables,
+    n_packets: int,
+    ifindexes: Tuple[int, ...] = (2, 3, 9),
+    hit_fraction: float = 0.7,
+) -> PacketBatch:
+    """Random packets biased toward table hits and match boundaries."""
+    keys = list(tables.content.keys())
+    b = n_packets
+    kind = rng.choice([0, 1, 2, 3], size=b, p=[0.02, 0.55, 0.4, 0.03]).astype(np.int32)
+    l4_ok = (rng.random(b) > 0.05).astype(np.int32)
+    ifindex = np.array([ifindexes[i] for i in rng.integers(0, len(ifindexes), b)], np.int32)
+    ip = np.zeros((b, 16), np.uint8)
+    proto = np.zeros(b, np.int32)
+    dst_port = np.zeros(b, np.int32)
+    icmp_type = np.zeros(b, np.int32)
+    icmp_code = np.zeros(b, np.int32)
+
+    for i in range(b):
+        if keys and rng.random() < hit_fraction:
+            key = keys[rng.integers(0, len(keys))]
+            data = bytearray(key.ip_data)
+            if rng.random() < 0.5:
+                # flip bits beyond the mask: should still match
+                m = key.mask_len
+                if m < 128:
+                    bit = rng.integers(m, 128)
+                    data[bit // 8] ^= 0x80 >> (bit % 8)
+            else:
+                # sometimes flip a bit inside the mask: should not match
+                if key.mask_len > 0 and rng.random() < 0.3:
+                    bit = rng.integers(0, key.mask_len)
+                    data[bit // 8] ^= 0x80 >> (bit % 8)
+            ip[i] = np.frombuffer(bytes(data), np.uint8)
+            ifindex[i] = key.ingress_ifindex if rng.random() < 0.9 else ifindex[i]
+            is_v4_key = all(d == 0 for d in data[4:]) and key.mask_len <= 32
+            kind[i] = 1 if (is_v4_key and rng.random() < 0.8) else (2 if rng.random() < 0.8 else kind[i])
+            # bias protocol/port toward a rule in that entry
+            rows = tables.content[key]
+            nz = np.nonzero(rows[:, 0])[0]
+            if len(nz) and rng.random() < 0.8:
+                r = rows[nz[rng.integers(0, len(nz))]]
+                proto[i] = r[1] if r[1] != 0 else rng.integers(0, 255)
+                if r[1] in (IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP):
+                    if r[3] == 0:
+                        dst_port[i] = r[2] + rng.integers(-1, 2)
+                    else:
+                        dst_port[i] = int(
+                            rng.choice([r[2] - 1, r[2], r[3] - 1, r[3], r[3] + 1])
+                        )
+                    dst_port[i] = int(np.clip(dst_port[i], 0, 65535))
+                elif r[1] in (IPPROTO_ICMP, IPPROTO_ICMPV6):
+                    icmp_type[i] = r[4] + rng.integers(0, 2)
+                    icmp_code[i] = r[5]
+                continue
+        # fully random packet
+        ip[i] = rng.integers(0, 256, 16, dtype=np.uint8)
+        if kind[i] == 1:
+            ip[i, 4:] = 0
+        proto[i] = int(rng.choice([6, 17, 132, 1, 58, 47, 0]))
+        dst_port[i] = int(rng.integers(0, 65536))
+        icmp_type[i] = int(rng.integers(0, 256))
+        icmp_code[i] = int(rng.integers(0, 3))
+
+    words = np.zeros((b, 4), np.uint32)
+    for w in range(4):
+        words[:, w] = (
+            ip[:, 4 * w].astype(np.uint32) << 24
+            | ip[:, 4 * w + 1].astype(np.uint32) << 16
+            | ip[:, 4 * w + 2].astype(np.uint32) << 8
+            | ip[:, 4 * w + 3].astype(np.uint32)
+        )
+    # v4 packets must have zero high words (host parser guarantees this)
+    words[kind == 1, 1:] = 0
+    return PacketBatch(
+        kind=kind,
+        l4_ok=l4_ok,
+        ifindex=ifindex,
+        ip_words=words,
+        proto=proto,
+        dst_port=dst_port,
+        icmp_type=icmp_type,
+        icmp_code=icmp_code,
+        pkt_len=rng.integers(60, 1500, b).astype(np.int32),
+    )
+
+
+def stats_dict_from_array(stats4: np.ndarray) -> Dict[int, List[int]]:
+    """(MAX_TARGETS, 4) int64 -> {ruleId: [ap, ab, dp, db]} with zero rows
+    dropped, for comparison against the oracle's dict."""
+    out: Dict[int, List[int]] = {}
+    for rid in np.nonzero(stats4.any(axis=1))[0]:
+        out[int(rid)] = [int(x) for x in stats4[rid]]
+    return out
