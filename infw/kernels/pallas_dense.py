@@ -1,0 +1,283 @@
+"""Fused Pallas TPU classification kernel (dense path).
+
+TPU-first re-expression of the XDP hot path
+(/root/reference/bpf/ingress_node_firewall_kernel.c:189-457) for tables up
+to a few thousand targets (the reference caps at MAX_TARGETS=1024,
+bpf/ingress_node_firewall.h:13).  Instead of a pointer-chasing LPM trie +
+unrolled scan per packet, the whole classification becomes three MXU
+matmuls per packet block:
+
+1. **LPM as bit-matmul**: the 160-bit LPM key (ifindex:32 || srcIP:128) is
+   unpacked to a {0,1} int8 matrix; for each table entry two int8 matrices
+   M0 = mask & ~prefix and M1 = mask & prefix are prebuilt.  The number of
+   in-mask mismatching bits is  bits @ M0 + (1-bits) @ M1  (int8 x int8 ->
+   int32 on the MXU); an entry matches iff that count is 0.  Longest
+   prefix selection is a max over (mask_len+1) scores with first-index
+   tie-break; the packet-side prefix caps (v4 <= /32, kernel.c:207) become
+   a score mask.
+2. **Rule-row gather as one-hot matmul**: the matched target's packed rule
+   bytes are fetched by onehot(tidx) @ rules_bytes — the MXU plays the
+   role of the map lookup, keeping the whole rule table in VMEM.
+3. **Ordered first-match scan**: vectorized over the 128-padded rule axis
+   with min-index selection; identical semantics to kernel.c:222-258.
+
+The kernel emits per-packet (result, tidx); XDP verdict + statistics are
+fused around it by XLA (jaxpath.finalize).  tidx doubles as the
+debug-lookup record (the reference's dbg hash map, kernel.c:59-64).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..compiler import CompiledTables
+from ..constants import (
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    KIND_IPV4,
+    KIND_OTHER,
+)
+from .jaxpath import DeviceBatch, finalize
+
+BLOCK_B = 256     # packets per grid step
+RULE_PAD = 128    # padded rule axis (MAX_RULES_PER_TARGET=100 <= 128)
+NUM_FIELDS = 9    # rid, proto, ps_hi, ps_lo, pe_hi, pe_lo, itype, icode, act
+KEY_BITS = 160
+MAX_DENSE_TARGETS = 4096
+
+
+class PallasTables(NamedTuple):
+    """Dense-kernel table operands (device arrays).
+
+    Matmul operands are bfloat16: every value is a small non-negative
+    integer (bits in {0,1}, rule bytes in [0,255]) that bf16 represents
+    exactly, and f32 accumulation of <=160 products is exact — so the MXU's
+    native bf16 path computes exact integer arithmetic."""
+
+    m0t: jax.Array       # (KEY_BITS, Tp) bf16 — mask & ~prefix
+    m1t: jax.Array       # (KEY_BITS, Tp) bf16 — mask & prefix
+    mask_len: jax.Array  # (1, Tp) int32, -1 for padding columns
+    rules_bytes: jax.Array  # (Tp, NUM_FIELDS*RULE_PAD) bf16, field-major
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def build_pallas_tables(tables: CompiledTables) -> PallasTables:
+    """Host-side packing of CompiledTables into the bit-matrix layout."""
+    T = tables.num_entries
+    if T > MAX_DENSE_TARGETS:
+        raise ValueError(
+            f"dense kernel supports up to {MAX_DENSE_TARGETS} targets, got {T}"
+        )
+    Tp = _round_up(max(T, 1), 128)
+
+    key_words = tables.key_words.astype(np.uint32)[:T]
+    mask_words = tables.mask_words.astype(np.uint32)[:T]
+
+    # (T, 160) bit expansion, big-endian within each word.
+    def unpack_bits(words: np.ndarray) -> np.ndarray:
+        out = np.zeros((words.shape[0], KEY_BITS), np.int8)
+        for w in range(5):
+            for b in range(32):
+                out[:, w * 32 + b] = (words[:, w] >> np.uint32(31 - b)) & 1
+        return out
+
+    prefix_bits = unpack_bits(key_words) if T else np.zeros((0, KEY_BITS), np.int8)
+    mask_bits = unpack_bits(mask_words) if T else np.zeros((0, KEY_BITS), np.int8)
+    m0 = mask_bits & (1 - prefix_bits)
+    m1 = mask_bits & prefix_bits
+
+    m0t = np.zeros((KEY_BITS, Tp), np.float32)
+    m1t = np.zeros((KEY_BITS, Tp), np.float32)
+    m0t[:, :T] = m0.T
+    m1t[:, :T] = m1.T
+
+    mask_len = np.full((1, Tp), -1, np.int32)
+    mask_len[0, :T] = tables.mask_len[:T]
+
+    R = tables.rule_width
+    rb = np.zeros((Tp, NUM_FIELDS * RULE_PAD), np.float32)
+    rules = tables.rules[:T].astype(np.int64)
+    fields = [
+        rules[..., 0] & 0xFF,          # ruleId (order <= 99 fits one byte)
+        rules[..., 1] & 0xFF,          # protocol
+        (rules[..., 2] >> 8) & 0xFF,   # dstPortStart hi
+        rules[..., 2] & 0xFF,          # dstPortStart lo
+        (rules[..., 3] >> 8) & 0xFF,   # dstPortEnd hi
+        rules[..., 3] & 0xFF,          # dstPortEnd lo
+        rules[..., 4] & 0xFF,          # icmpType
+        rules[..., 5] & 0xFF,          # icmpCode
+        rules[..., 6] & 0xFF,          # action
+    ]
+    for f, vals in enumerate(fields):
+        rb[:T, f * RULE_PAD : f * RULE_PAD + R] = vals
+
+    return PallasTables(
+        m0t=jnp.asarray(m0t, jnp.bfloat16),
+        m1t=jnp.asarray(m1t, jnp.bfloat16),
+        mask_len=jnp.asarray(mask_len),
+        rules_bytes=jnp.asarray(rb, jnp.bfloat16),
+    )
+
+
+def _classify_kernel(fields_ref, words_ref, m0_ref, m1_ref, mlen_ref, rules_ref, out_ref):
+    Bb = fields_ref.shape[0]
+    Tp = m0_ref.shape[1]
+
+    kind = fields_ref[:, 0:1]
+    proto = fields_ref[:, 2:3]
+    dport = fields_ref[:, 3:4]
+    itype = fields_ref[:, 4:5]
+    icode = fields_ref[:, 5:6]
+
+    # --- 1. unpack the 160-bit LPM key ------------------------------------
+    iota32 = jax.lax.broadcasted_iota(jnp.int32, (Bb, 32), 1)
+    pieces = []
+    for w in range(5):
+        word = fields_ref[:, 1:2] if w == 0 else words_ref[:, w - 1 : w]
+        pieces.append(
+            (jax.lax.shift_right_logical(word, 31 - iota32) & 1).astype(jnp.bfloat16)
+        )
+    bits = jnp.concatenate(pieces, axis=1)  # (Bb, 160) in {0,1}
+
+    # --- 2. LPM: in-mask mismatch counts via two bf16 MXU matmuls ---------
+    dn = (((1,), (0,)), ((), ()))
+    mism = jax.lax.dot_general(
+        bits, m0_ref[:, :], dn, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        (1 - bits), m1_ref[:, :], dn, preferred_element_type=jnp.float32
+    )  # (Bb, Tp) exact small-integer counts in f32
+
+    mlen = mlen_ref[:, :]  # (1, Tp); -1 marks padding
+    cap = jnp.where(kind == KIND_IPV4, 32, 128)  # (Bb, 1)
+    ok = (mism == 0.0) & (mlen >= 0) & (mlen <= cap)
+    score = jnp.where(ok, mlen + 1, 0)  # (Bb, Tp)
+    best = jnp.max(score, axis=1, keepdims=True)
+    iota_t = jax.lax.broadcasted_iota(jnp.int32, (Bb, Tp), 1)
+    # First index achieving the (positive) max; tidx == Tp means no match.
+    # (score == best) & (score > 0) keeps all operands full-width — Mosaic
+    # rejects (B,1)-bool broadcasts through logical ops.
+    tidx = jnp.min(
+        jnp.where((score == best) & (score > 0), iota_t, Tp), axis=1, keepdims=True
+    )
+    matched = best > 0
+
+    # --- 3. rule-row fetch: one-hot @ rule bytes on the MXU ---------------
+    # tidx == Tp (no match) produces an all-zero row -> ruleId 0 -> UNDEF.
+    onehot = (iota_t == tidx).astype(jnp.bfloat16)  # (Bb, Tp)
+    rowb = jax.lax.dot_general(
+        onehot, rules_ref[:, :], dn, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)  # (Bb, 9*RULE_PAD) — one-hot sums are exact bytes
+
+    R = RULE_PAD
+    rid = rowb[:, 0 * R : 1 * R]
+    rproto = rowb[:, 1 * R : 2 * R]
+    ps = rowb[:, 2 * R : 3 * R] * 256 + rowb[:, 3 * R : 4 * R]
+    pe = rowb[:, 4 * R : 5 * R] * 256 + rowb[:, 5 * R : 6 * R]
+    it = rowb[:, 6 * R : 7 * R]
+    ic = rowb[:, 7 * R : 8 * R]
+    act = rowb[:, 8 * R : 9 * R]
+
+    # --- 4. ordered first-match scan (kernel.c:222-258) -------------------
+    valid = rid != 0
+    proto_eq = (rproto != 0) & (rproto == proto)
+    is_transport = (
+        (rproto == IPPROTO_TCP) | (rproto == IPPROTO_UDP) | (rproto == IPPROTO_SCTP)
+    )
+    # boolean algebra instead of a bool-valued select (Mosaic restriction)
+    pe_zero = pe == 0
+    port_hit = (pe_zero & (dport == ps)) | (
+        jnp.logical_not(pe_zero) & (dport >= ps) & (dport < pe)
+    )
+    fam = jnp.where(kind == KIND_IPV4, IPPROTO_ICMP, IPPROTO_ICMPV6)
+    icmp_hit = (rproto == fam) & (it == itype) & (ic == icode)
+    hit = valid & ((proto_eq & ((is_transport & port_hit) | icmp_hit)) | (rproto == 0))
+
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (Bb, R), 1)
+    first = jnp.min(jnp.where(hit, iota_r, R), axis=1, keepdims=True)
+    any_hit = first < R
+    oh2 = (iota_r == first).astype(jnp.int32)
+    rid_f = jnp.sum(rid * oh2, axis=1, keepdims=True)
+    act_f = jnp.sum(act * oh2, axis=1, keepdims=True)
+    result = jnp.where(any_hit, (rid_f << 8) | act_f, 0)
+
+    out_ref[:, 0:1] = result
+    out_ref[:, 1:2] = jnp.where(matched, tidx, -1)
+
+
+def _pallas_scan(
+    fields: jax.Array, words: jax.Array, pt: PallasTables, interpret: bool
+) -> jax.Array:
+    B = fields.shape[0]
+    Tp = pt.m0t.shape[1]
+    grid = (B // BLOCK_B,)
+    return pl.pallas_call(
+        _classify_kernel,
+        out_shape=jax.ShapeDtypeStruct((B, 2), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BLOCK_B, 8), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_B, 4), lambda i: (i, 0)),
+            pl.BlockSpec((KEY_BITS, Tp), lambda i: (0, 0)),
+            pl.BlockSpec((KEY_BITS, Tp), lambda i: (0, 0)),
+            pl.BlockSpec((1, Tp), lambda i: (0, 0)),
+            pl.BlockSpec((Tp, NUM_FIELDS * RULE_PAD), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_B, 2), lambda i: (i, 0)),
+        interpret=interpret,
+    )(fields, words, pt.m0t, pt.m1t, pt.mask_len, pt.rules_bytes)
+
+
+def classify_pallas(
+    pt: PallasTables, batch: DeviceBatch, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full forward pass via the Pallas kernel; returns (results, xdp,
+    stats) identical to jaxpath.classify."""
+    B = batch.kind.shape[0]
+    Bp = _round_up(max(B, 1), BLOCK_B)
+    pad = Bp - B
+
+    fields = jnp.stack(
+        [
+            batch.kind,
+            batch.ifindex,
+            batch.proto,
+            batch.dst_port,
+            batch.icmp_type,
+            batch.icmp_code,
+            batch.l4_ok,
+            batch.pkt_len,
+        ],
+        axis=1,
+    ).astype(jnp.int32)
+    words = batch.ip_words.astype(jnp.int32)  # bit patterns; shifts are logical
+    if pad:
+        # Padding packets are KIND_OTHER: always PASS, never recorded.
+        pad_fields = jnp.zeros((pad, 8), jnp.int32).at[:, 0].set(KIND_OTHER)
+        fields = jnp.concatenate([fields, pad_fields], axis=0)
+        words = jnp.concatenate([words, jnp.zeros((pad, 4), jnp.int32)], axis=0)
+
+    out = _pallas_scan(fields, words, pt, interpret)[:B]
+    raw_result = out[:, 0].astype(jnp.uint32)
+    return finalize(raw_result, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_pallas(interpret: bool):
+    return jax.jit(functools.partial(classify_pallas, interpret=interpret))
+
+
+def default_interpret() -> bool:
+    """Interpret mode everywhere except real TPU backends."""
+    return jax.default_backend() != "tpu"

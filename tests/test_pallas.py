@@ -1,0 +1,71 @@
+"""T3: Pallas dense kernel vs oracle (interpret mode on CPU; the same
+kernel compiles via Mosaic on real TPU — exercised by bench.py and
+__graft_entry__)."""
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.compiler import LpmKey, compile_tables_from_content
+from infw.kernels import jaxpath, pallas_dense
+
+
+def assert_pallas_matches(tables, batch):
+    ref = oracle.classify(tables, batch)
+    pt = pallas_dense.build_pallas_tables(tables)
+    db = jaxpath.device_batch(batch)
+    res, xdp, stats = pallas_dense.jitted_classify_pallas(True)(pt, db)
+    np.testing.assert_array_equal(np.asarray(res), ref.results)
+    np.testing.assert_array_equal(np.asarray(xdp), ref.xdp)
+    got = testing.stats_dict_from_array(jaxpath.merge_stats_host(np.asarray(stats)))
+    assert got == ref.stats
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_pallas_random_differential(seed):
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables(rng, n_entries=40, width=12, stride=4)
+    batch = testing.random_batch(rng, tables, n_packets=300)
+    assert_pallas_matches(tables, batch)
+
+
+def test_pallas_non_block_multiple_batch():
+    rng = np.random.default_rng(3)
+    tables = testing.random_tables(rng, n_entries=10, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=77)
+    assert_pallas_matches(tables, batch)
+
+
+def test_pallas_empty_table():
+    tables = compile_tables_from_content({}, rule_width=4)
+    rng = np.random.default_rng(7)
+    batch = testing.random_batch(rng, tables, n_packets=50)
+    assert_pallas_matches(tables, batch)
+
+
+def test_pallas_full_rule_width():
+    # All 100 rule slots populated (the reference's MAX_RULES_PER_TARGET).
+    rows = np.zeros((100, 7), np.int32)
+    for order in range(1, 100):
+        rows[order] = [order, 6, order * 100, 0, 0, 0, 1 + order % 2]
+    content = {LpmKey(32, 2, bytes(16)): rows}
+    tables = compile_tables_from_content(content, rule_width=100)
+    from infw.packets import make_batch
+
+    batch = make_batch(
+        src=["1.1.1.1"] * 4,
+        proto=[6] * 4,
+        dst_port=[100, 5000, 9900, 77],
+        ifindex=[2] * 4,
+    )
+    ref = oracle.classify(tables, batch)
+    assert [(int(r) >> 8) for r in ref.results] == [1, 50, 99, 0]
+    assert_pallas_matches(tables, batch)
+
+
+def test_pallas_rejects_oversized_table():
+    rng = np.random.default_rng(0)
+    tables = testing.random_tables(rng, n_entries=20, width=4)
+    tables.mask_len.resize(5000, refcheck=False)  # simulate huge T
+    object.__setattr__(tables, "num_entries", 5000)
+    with pytest.raises(ValueError):
+        pallas_dense.build_pallas_tables(tables)
