@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: packet classifications/sec/chip at 100K rule entries.
+
+Config 2/3 of BASELINE.json: 1000 sourceCIDR targets x 100 ordered rules
+(= 100K rule entries, the reference's full MAX_TARGETS x MAX_RULES_PER_TARGET
+capacity, bpf/ingress_node_firewall.h:13-14), mixed IPv4/IPv6 + TCP/UDP/ICMP,
+classified by the fused Pallas kernel on one chip.  Verdicts are
+spot-checked against the scalar oracle before timing.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is throughput / 10M (the BASELINE.json north-star target);
+diagnostics go to stderr.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from infw import oracle, testing  # noqa: E402
+from infw.kernels import jaxpath, pallas_dense  # noqa: E402
+
+TARGET = 10_000_000.0  # classifications/sec (BASELINE.json north star)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    rng = np.random.default_rng(2024)
+    tables = testing.random_tables(
+        rng, n_entries=1000, width=100, stride=4, ifindexes=(2, 3, 4)
+    )
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch(rng, tables, n_packets=n_packets)
+
+    pt = jax.tree.map(jax.device_put, pallas_dense.build_pallas_tables(tables))
+    db = jaxpath.device_batch(batch)
+    fn = pallas_dense.jitted_classify_pallas(not on_tpu)
+
+    t0 = time.perf_counter()
+    out = fn(pt, db)
+    out[0].block_until_ready()
+    log(f"compile+first run: {time.perf_counter()-t0:.2f}s")
+
+    # Correctness gate: subsample vs the scalar oracle.
+    sub = batch.slice(0, 2000)
+    ref = oracle.classify(tables, sub)
+    got = np.asarray(fn(pt, jaxpath.device_batch(sub))[0])
+    if not (got == ref.results).all():
+        log("FATAL: verdict mismatch vs oracle")
+        print(json.dumps({
+            "metric": "packet classifications/sec/chip @100K rules",
+            "value": 0.0, "unit": "packets/s", "vs_baseline": 0.0,
+        }))
+        return 1
+    log("verdict spot-check vs oracle: OK (2000 packets)")
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(pt, db)
+    out[0].block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    throughput = n_packets / dt
+    log(f"throughput: {throughput/1e6:.2f} M classifications/s "
+        f"({dt*1e3:.2f} ms / {n_packets} packets)")
+
+    # p50 verdict latency: round-trip of a small batch (dispatch -> verdicts
+    # on host), the analogue of the per-packet verdict path.
+    small = jaxpath.device_batch(batch.slice(0, 4096))
+    lats = []
+    for _ in range(30 if on_tpu else 5):
+        t0 = time.perf_counter()
+        r = fn(pt, small)
+        np.asarray(r[0])
+        lats.append(time.perf_counter() - t0)
+    p50 = sorted(lats)[len(lats) // 2]
+    log(f"p50 verdict latency (4096-packet batch round-trip): {p50*1e3:.3f} ms")
+
+    print(json.dumps({
+        "metric": "packet classifications/sec/chip @100K rules (1000 CIDRs x 100 rules, Pallas dense)",
+        "value": round(throughput, 1),
+        "unit": "packets/s",
+        "vs_baseline": round(throughput / TARGET, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

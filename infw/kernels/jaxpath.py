@@ -78,10 +78,15 @@ class DeviceBatch(NamedTuple):
 
 def device_tables(tables: CompiledTables, device=None) -> DeviceTables:
     put = lambda a: jax.device_put(jnp.asarray(a), device)
+    # Padding rows get the mask_len == -1 sentinel so the dense match can
+    # exclude them without a separate entry count (keeps every array
+    # shardable along the target axis).
+    mask_len = tables.mask_len.copy()
+    mask_len[tables.num_entries :] = -1
     return DeviceTables(
         key_words=put(tables.key_words.astype(np.uint32)),
         mask_words=put(tables.mask_words.astype(np.uint32)),
-        mask_len=put(tables.mask_len),
+        mask_len=put(mask_len),
         rules=put(tables.rules),
         trie_child=put(tables.trie_child),
         trie_target=put(tables.trie_target),
@@ -119,9 +124,7 @@ def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     diff = (pkt[:, None, :] ^ tables.key_words[None]) & tables.mask_words[None]
     match = jnp.all(diff == 0, axis=-1)  # (B,T)
     cap = jnp.where(batch.kind == KIND_IPV4, 32, 128)  # packet-side mask cap
-    T = tables.mask_len.shape[0]
-    in_range = jnp.arange(T, dtype=jnp.int32)[None, :] < tables.num_entries
-    ok = match & (tables.mask_len[None] <= cap[:, None]) & in_range
+    ok = match & (tables.mask_len[None] >= 0) & (tables.mask_len[None] <= cap[:, None])
     score = jnp.where(ok, tables.mask_len[None] + 1, 0)  # (B,T)
     tidx = jnp.argmax(score, axis=1).astype(jnp.int32)
     return jnp.where(jnp.max(score, axis=1) > 0, tidx, -1)

@@ -1,0 +1,1 @@
+"""Distributed classification over device meshes."""

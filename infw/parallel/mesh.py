@@ -1,0 +1,200 @@
+"""Multi-chip classification: shard_map over a ("data", "rules") mesh.
+
+The reference's parallelism is per-node DaemonSets plus per-CPU hot-path
+maps (SURVEY.md §2 parallelism table).  The TPU-native equivalents:
+
+- **data axis**: the packet batch is sharded across chips (the analogue of
+  per-CPU XDP processing); per-shard statistics are combined with psum over
+  ICI (the analogue of the userspace per-CPU stats aggregation,
+  /root/reference/pkg/metrics/statistics.go:126-157).
+- **rules axis**: the rule table itself is sharded across chips ("tensor
+  parallelism" over targets).  Each chip computes the longest-prefix match
+  over its local entries; the global winner is selected with a pmax over
+  the match score (mask_len+1 — globally unique among matching entries
+  because equal-length matching prefixes are deduplicated at compile time),
+  and only the winning chip contributes the scanned verdict via psum.
+
+Rule tensors are broadcast/resharded with jax.device_put under the mesh —
+the ICI/DCN replacement for the reference's per-node BPF map writes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compiler import CompiledTables
+from ..kernels import jaxpath
+from ..kernels.jaxpath import DeviceBatch, DeviceTables
+
+
+def make_mesh(n_devices: Optional[int] = None, rules_shards: int = 1) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n % rules_shards != 0:
+        raise ValueError(f"{n} devices not divisible into {rules_shards} rule shards")
+    arr = np.array(devices[:n]).reshape(n // rules_shards, rules_shards)
+    return Mesh(arr, ("data", "rules"))
+
+
+def _pad_tables_for_shards(tables: CompiledTables, shards: int) -> CompiledTables:
+    """Pad the target axis to a multiple of the rules-shard count; padding
+    rows carry the mask_len == -1 sentinel."""
+    T = tables.key_words.shape[0]
+    Tp = ((max(T, 1) + shards - 1) // shards) * shards
+    if Tp == T:
+        t = tables
+        pad = 0
+    else:
+        pad = Tp - T
+
+    def padrow(a, fill=0):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    mask_len = tables.mask_len.copy()
+    mask_len[tables.num_entries :] = -1
+    return CompiledTables(
+        rule_width=tables.rule_width,
+        stride=tables.stride,
+        num_entries=tables.num_entries,
+        key_words=padrow(tables.key_words),
+        mask_words=padrow(tables.mask_words),
+        mask_len=padrow(mask_len, -1),
+        rules=padrow(tables.rules),
+        trie_child=tables.trie_child,
+        trie_target=tables.trie_target,
+        root_lut=tables.root_lut,
+        content=tables.content,
+    )
+
+
+def shard_tables(tables: CompiledTables, mesh: Mesh) -> DeviceTables:
+    """Place compiled tables on the mesh: dense arrays sharded along the
+    target axis over "rules", trie arrays replicated."""
+    shards = mesh.shape["rules"]
+    padded = _pad_tables_for_shards(tables, shards)
+
+    def put(a, spec):
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+    mask_len = padded.mask_len
+    return DeviceTables(
+        key_words=put(padded.key_words.astype(np.uint32), P("rules", None)),
+        mask_words=put(padded.mask_words.astype(np.uint32), P("rules", None)),
+        mask_len=put(mask_len, P("rules")),
+        rules=put(padded.rules, P("rules", None, None)),
+        trie_child=put(padded.trie_child, P()),
+        trie_target=put(padded.trie_target, P()),
+        root_lut=put(padded.root_lut, P()),
+        num_entries=put(np.int32(padded.num_entries), P()),
+    )
+
+
+def shard_batch(batch, mesh: Mesh) -> DeviceBatch:
+    """Place a packet batch sharded along the data axis (pad the batch to a
+    multiple of the data-shard count first, packets.PacketBatch.pad_to)."""
+    def put(a, spec):
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+    return DeviceBatch(
+        kind=put(batch.kind, P("data")),
+        l4_ok=put(batch.l4_ok, P("data")),
+        ifindex=put(batch.ifindex, P("data")),
+        ip_words=put(batch.ip_words.astype(np.uint32), P("data", None)),
+        proto=put(batch.proto, P("data")),
+        dst_port=put(batch.dst_port, P("data")),
+        icmp_type=put(batch.icmp_type, P("data")),
+        icmp_code=put(batch.icmp_code, P("data")),
+        pkt_len=put(batch.pkt_len, P("data")),
+    )
+
+
+def _local_dense_partial(tables: DeviceTables, batch: DeviceBatch):
+    """Per-shard LPM over local entries: returns (local best score, raw
+    scan result restricted to the local winner)."""
+    pkt = jaxpath.packet_key_words(batch)
+    diff = (pkt[:, None, :] ^ tables.key_words[None]) & tables.mask_words[None]
+    match = jnp.all(diff == 0, axis=-1)
+    cap = jnp.where(batch.kind == 1, 32, 128)
+    ok = match & (tables.mask_len[None] >= 0) & (tables.mask_len[None] <= cap[:, None])
+    score = jnp.where(ok, tables.mask_len[None] + 1, 0)
+    best = jnp.max(score, axis=1)
+    tidx = jnp.argmax(score, axis=1)
+    rows = jnp.take(tables.rules, tidx, axis=0)
+    rows = jnp.where((best > 0)[:, None, None], rows, 0)
+    raw = jaxpath.rule_scan(rows, batch)
+    return best.astype(jnp.int32), raw
+
+
+def _sharded_step(tables: DeviceTables, batch: DeviceBatch):
+    """The full distributed step, to be wrapped in shard_map."""
+    best, raw = _local_dense_partial(tables, batch)
+    gbest = jax.lax.pmax(best, "rules")
+    winner = (best == gbest) & (best > 0)
+    raw = jnp.where(winner, raw, 0)
+    raw = jax.lax.psum(raw, "rules")  # only the winning shard contributes
+    results, xdp, stats = jaxpath.finalize(raw.astype(jnp.uint32), batch)
+    # Stats: identical across the rules group (post-selection), so count
+    # them once per data shard, then reduce across the whole mesh.
+    stats = jnp.where(jax.lax.axis_index("rules") == 0, stats, 0)
+    stats = jax.lax.psum(stats, ("data", "rules"))
+    return results, xdp, stats
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_classifier(mesh: Mesh):
+    """jit-compiled multi-chip classify: batch sharded over "data", dense
+    tables sharded over "rules"; returns (results, xdp, stats) with
+    results/xdp sharded over "data" and stats fully replicated."""
+    batch_specs = DeviceBatch(
+        kind=P("data"),
+        l4_ok=P("data"),
+        ifindex=P("data"),
+        ip_words=P("data", None),
+        proto=P("data"),
+        dst_port=P("data"),
+        icmp_type=P("data"),
+        icmp_code=P("data"),
+        pkt_len=P("data"),
+    )
+    table_specs = DeviceTables(
+        key_words=P("rules", None),
+        mask_words=P("rules", None),
+        mask_len=P("rules"),
+        rules=P("rules", None, None),
+        trie_child=P(),
+        trie_target=P(),
+        root_lut=P(),
+        num_entries=P(),
+    )
+    fn = jax.shard_map(
+        _sharded_step,
+        mesh=mesh,
+        in_specs=(table_specs, batch_specs),
+        out_specs=(P("data"), P("data"), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def classify_on_mesh(
+    mesh: Mesh, tables: CompiledTables, batch
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Convenience wrapper: shard, classify, fetch host results."""
+    data_shards = mesh.shape["data"]
+    b = len(batch)
+    bp = ((b + data_shards - 1) // data_shards) * data_shards
+    padded = batch.pad_to(bp)
+    dt = shard_tables(tables, mesh)
+    db = shard_batch(padded, mesh)
+    results, xdp, stats = make_sharded_classifier(mesh)(dt, db)
+    return (
+        np.asarray(results)[:b],
+        np.asarray(xdp)[:b],
+        np.asarray(stats),
+    )

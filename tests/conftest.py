@@ -6,12 +6,20 @@ dry-runs the multichip path via __graft_entry__.dryrun_multichip).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment presets JAX_PLATFORMS=axon (the
+# real TPU tunnel) and tests must run on the virtual CPU mesh.  jax is
+# already imported at interpreter start (sitecustomize), so the env var
+# alone is too late — update the config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

@@ -1,0 +1,24 @@
+"""Multi-chip sharding: classification on a ("data","rules") mesh must be
+bit-exact vs the oracle (runs on the virtual 8-device CPU mesh)."""
+import jax
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.parallel import mesh as meshmod
+
+
+@pytest.mark.parametrize("rules_shards", [1, 2, 4])
+def test_sharded_classify_matches_oracle(rules_shards):
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+    m = meshmod.make_mesh(8, rules_shards=rules_shards)
+    rng = np.random.default_rng(11)
+    tables = testing.random_tables(rng, n_entries=37, width=10, stride=4)
+    batch = testing.random_batch(rng, tables, n_packets=301)
+    ref = oracle.classify(tables, batch)
+    results, xdp, stats = meshmod.classify_on_mesh(m, tables, batch)
+    np.testing.assert_array_equal(results, ref.results)
+    np.testing.assert_array_equal(xdp, ref.xdp)
+    from infw.kernels import jaxpath
+    got = testing.stats_dict_from_array(jaxpath.merge_stats_host(stats))
+    assert got == ref.stats
