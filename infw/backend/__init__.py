@@ -1,0 +1,8 @@
+"""Classifier backends.
+
+- tpu: JAX/Pallas device classifier (dense MXU kernel or XLA trie path).
+- cpu_ref: native C++ reference classifier (ctypes), the differential
+  oracle and CPU fallback — the parity component for the reference's one
+  native-code piece (the XDP C program).
+"""
+from .base import Classifier, ClassifyOutput  # noqa: F401

@@ -1,0 +1,74 @@
+"""Classifier backend interface.
+
+The runtime contract every dataplane backend implements.  Mirrors the role
+of the loaded XDP program + its maps
+(/root/reference/pkg/ebpf/ingress_node_firewall_loader.go:43-50): rules are
+loaded idempotently, packets are classified, statistics accumulate until
+reset.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+import numpy as np
+
+from ..compiler import CompiledTables
+from ..constants import MAX_TARGETS
+from ..packets import PacketBatch
+
+
+@dataclass
+class ClassifyOutput:
+    """Per-batch outputs: packed u32 results, XDP verdicts, and the batch's
+    statistics increment (MAX_TARGETS, 4) int64 [allow_pkts, allow_bytes,
+    deny_pkts, deny_bytes]."""
+
+    results: np.ndarray
+    xdp: np.ndarray
+    stats_delta: np.ndarray
+
+
+class StatsAccumulator:
+    """Host-side equivalent of the per-CPU statistics map
+    (bpf/ingress_node_firewall_kernel.c:36-41): accumulates per-ruleId
+    counters until the dataplane is reset; read by the metrics poller."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats = np.zeros((MAX_TARGETS, 4), np.int64)
+
+    def add(self, delta: np.ndarray) -> None:
+        with self._lock:
+            self._stats += delta
+
+    def snapshot(self) -> np.ndarray:
+        with self._lock:
+            return self._stats.copy()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats[:] = 0
+
+
+class Classifier(Protocol):
+    """One-per-node dataplane program."""
+
+    def load_tables(self, tables: CompiledTables) -> None:
+        """Swap in a newly compiled ruleset (idempotent, atomic)."""
+        ...
+
+    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+        ...
+
+    @property
+    def stats(self) -> StatsAccumulator:
+        ...
+
+    @property
+    def tables(self) -> Optional[CompiledTables]:
+        ...
+
+    def close(self) -> None:
+        ...

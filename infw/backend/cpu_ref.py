@@ -1,0 +1,141 @@
+"""ctypes bindings + Classifier wrapper for the native C++ reference
+classifier (native/classifier.cpp).
+
+The shared library is built on demand with g++ (cached by source mtime) —
+the framework's analogue of the reference's bpf2go build step
+(/root/reference/pkg/ebpf/ingress_node_firewall_loader.go:53).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..compiler import CompiledTables
+from ..constants import MAX_TARGETS
+from ..packets import PacketBatch
+from .base import ClassifyOutput, StatsAccumulator
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "classifier.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "_build", "libinfwref.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_library() -> str:
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-Wall", "-shared", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return _LIB
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build_library())
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.infw_classify.restype = None
+            lib.infw_classify.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, u32p, i32p, u8p, i32p,
+                ctypes.c_int32, i32p, i32p, u32p, u8p, i32p, i32p, i32p,
+                i32p, i32p, u32p, i32p, i64p,
+            ]
+            lib.infw_abi_version.restype = ctypes.c_int32
+            assert lib.infw_abi_version() == 1
+            _lib = lib
+        return _lib
+
+
+def _words_to_bytes(words: np.ndarray) -> np.ndarray:
+    """(N, 4) uint32 big-endian words -> (N, 16) uint8."""
+    return words.astype(">u4").view(np.uint8).reshape(words.shape[0], 16)
+
+
+class CpuRefClassifier:
+    """Native CPU dataplane implementing the Classifier protocol."""
+
+    def __init__(self) -> None:
+        self._lib = load_library()
+        self._lock = threading.Lock()
+        self._stats = StatsAccumulator()
+        self._tables: Optional[CompiledTables] = None
+        self._packed = None
+        self._closed = False
+
+    def load_tables(self, tables: CompiledTables) -> None:
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        T = tables.num_entries
+        ent_ifindex = np.ascontiguousarray(tables.key_words[:T, 0], np.uint32)
+        ent_masklen = np.ascontiguousarray(tables.mask_len[:T], np.int32)
+        ent_ip = np.ascontiguousarray(
+            _words_to_bytes(tables.key_words[:T, 1:5].astype(np.uint32))
+        )
+        rules = np.ascontiguousarray(tables.rules[:T], np.int32)
+        with self._lock:
+            self._tables = tables
+            self._packed = (T, tables.rule_width, ent_ifindex, ent_masklen, ent_ip, rules)
+
+    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+        with self._lock:
+            if self._packed is None:
+                raise RuntimeError("no rule tables loaded")
+            T, width, ent_ifindex, ent_masklen, ent_ip, rules = self._packed
+
+        B = len(batch)
+        kind = np.ascontiguousarray(batch.kind, np.int32)
+        l4_ok = np.ascontiguousarray(batch.l4_ok, np.int32)
+        pkt_ifindex = np.ascontiguousarray(batch.ifindex, np.uint32)
+        pkt_ip = np.ascontiguousarray(_words_to_bytes(batch.ip_words.astype(np.uint32)))
+        proto = np.ascontiguousarray(batch.proto, np.int32)
+        dport = np.ascontiguousarray(batch.dst_port, np.int32)
+        itype = np.ascontiguousarray(batch.icmp_type, np.int32)
+        icode = np.ascontiguousarray(batch.icmp_code, np.int32)
+        pktlen = np.ascontiguousarray(batch.pkt_len, np.int32)
+
+        results = np.zeros(B, np.uint32)
+        xdp = np.zeros(B, np.int32)
+        stats = np.zeros((MAX_TARGETS, 4), np.int64)
+
+        c = ctypes
+        p = lambda a, t: a.ctypes.data_as(c.POINTER(t))
+        self._lib.infw_classify(
+            c.c_int32(T), c.c_int32(width),
+            p(ent_ifindex, c.c_uint32), p(ent_masklen, c.c_int32),
+            p(ent_ip, c.c_uint8), p(rules, c.c_int32),
+            c.c_int32(B), p(kind, c.c_int32), p(l4_ok, c.c_int32),
+            p(pkt_ifindex, c.c_uint32), p(pkt_ip, c.c_uint8),
+            p(proto, c.c_int32), p(dport, c.c_int32), p(itype, c.c_int32),
+            p(icode, c.c_int32), p(pktlen, c.c_int32),
+            p(results, c.c_uint32), p(xdp, c.c_int32), p(stats, c.c_int64),
+        )
+        self._stats.add(stats)
+        return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats)
+
+    @property
+    def stats(self) -> StatsAccumulator:
+        return self._stats
+
+    @property
+    def tables(self) -> Optional[CompiledTables]:
+        return self._tables
+
+    def close(self) -> None:
+        with self._lock:
+            self._packed = None
+            self._tables = None
+            self._closed = True

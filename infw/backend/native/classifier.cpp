@@ -1,0 +1,167 @@
+// Native CPU reference classifier.
+//
+// The parity component for the reference's single native-code piece — the
+// XDP C program (/root/reference/bpf/ingress_node_firewall_kernel.c) — used
+// as the --backend=cpu dataplane and as a second, independent differential
+// oracle for the TPU kernels.  Implements the identical verdict semantics:
+// LPM over (ifindex:32 || ip:128) with packet-side prefix caps, the ordered
+// first-match rule scan (half-open ranges, end==0 single port, family-gated
+// ICMP, protocol==0 catch-all), result packing action|ruleId<<8, and
+// per-ruleId statistics.
+//
+// Built as a shared library; driven through ctypes (see cpu_ref.py).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxTargets = 1024;
+constexpr int kUndef = 0;
+constexpr int kDeny = 1;   // XDP_DROP
+constexpr int kAllow = 2;  // XDP_PASS
+
+constexpr int kKindMalformed = 0;
+constexpr int kKindV4 = 1;
+constexpr int kKindV6 = 2;
+
+constexpr int kProtoIcmp = 1;
+constexpr int kProtoTcp = 6;
+constexpr int kProtoUdp = 17;
+constexpr int kProtoIcmp6 = 58;
+constexpr int kProtoSctp = 132;
+
+struct Entry {
+  uint32_t ifindex;
+  int32_t mask_len;          // CIDR bits (without the 32 ifindex bits)
+  uint8_t ip[16];            // masked prefix bytes, network order
+};
+
+inline bool prefix_matches(const Entry& e, const uint8_t* ip) {
+  int full = e.mask_len / 8;
+  if (full && std::memcmp(e.ip, ip, full) != 0) return false;
+  int rem = e.mask_len % 8;
+  if (rem) {
+    uint8_t mask = static_cast<uint8_t>(0xFF00 >> rem);
+    if ((e.ip[full] & mask) != (ip[full] & mask)) return false;
+  }
+  return true;
+}
+
+inline uint32_t scan_rules(const int32_t* rows, int width, int proto, int dport,
+                           int itype, int icode, bool is_v4) {
+  const int icmp_proto = is_v4 ? kProtoIcmp : kProtoIcmp6;
+  for (int i = 0; i < width; ++i) {
+    const int32_t* r = rows + i * 7;
+    const int rid = r[0];
+    if (rid == 0) continue;  // INVALID_RULE_ID slot
+    const int rproto = r[1];
+    if (rproto != 0 && rproto == proto) {
+      if (rproto == kProtoTcp || rproto == kProtoUdp || rproto == kProtoSctp) {
+        const int ps = r[2], pe = r[3];
+        if (pe == 0) {
+          if (ps == dport)
+            return (static_cast<uint32_t>(rid & 0xFFFFFF) << 8) |
+                   static_cast<uint32_t>(r[6] & 0xFF);
+        } else if (dport >= ps && dport < pe) {
+          return (static_cast<uint32_t>(rid & 0xFFFFFF) << 8) |
+                 static_cast<uint32_t>(r[6] & 0xFF);
+        }
+      }
+      if (rproto == icmp_proto && r[4] == itype && r[5] == icode) {
+        return (static_cast<uint32_t>(rid & 0xFFFFFF) << 8) |
+               static_cast<uint32_t>(r[6] & 0xFF);
+      }
+    }
+    if (rproto == 0) {  // catch-all
+      return (static_cast<uint32_t>(rid & 0xFFFFFF) << 8) |
+             static_cast<uint32_t>(r[6] & 0xFF);
+    }
+  }
+  return kUndef;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Classify a batch.  All pointers are caller-owned contiguous arrays.
+//   entries: ent_ifindex[T] u32, ent_masklen[T] i32, ent_ip[T*16] u8 (masked)
+//   rules:   [T * width * 7] i32
+//   packets: kind/l4_ok/proto/dport/itype/icode/pktlen [B] i32,
+//            pkt_ifindex[B] u32, pkt_ip[B*16] u8
+//   out:     results[B] u32, xdp[B] i32, stats[kMaxTargets*4] i64
+//            (stats is ACCUMULATED into, not zeroed — per-CPU map behavior)
+void infw_classify(int32_t T, int32_t width, const uint32_t* ent_ifindex,
+                   const int32_t* ent_masklen, const uint8_t* ent_ip,
+                   const int32_t* rules, int32_t B, const int32_t* kind,
+                   const int32_t* l4_ok, const uint32_t* pkt_ifindex,
+                   const uint8_t* pkt_ip, const int32_t* proto,
+                   const int32_t* dport, const int32_t* itype,
+                   const int32_t* icode, const int32_t* pktlen,
+                   uint32_t* results, int32_t* xdp, int64_t* stats) {
+  // Bucket entries per ifindex once per call to cut the LPM scan down.
+  std::vector<Entry> entries(static_cast<size_t>(T));
+  for (int32_t t = 0; t < T; ++t) {
+    entries[t].ifindex = ent_ifindex[t];
+    entries[t].mask_len = ent_masklen[t];
+    std::memcpy(entries[t].ip, ent_ip + t * 16, 16);
+  }
+
+  for (int32_t p = 0; p < B; ++p) {
+    const int k = kind[p];
+    if (k == kKindMalformed) {
+      results[p] = 0;
+      xdp[p] = kDeny;  // XDP_DROP on malformed eth header
+      continue;
+    }
+    if (k != kKindV4 && k != kKindV6) {
+      results[p] = 0;
+      xdp[p] = kAllow;  // unknown ethertype -> XDP_PASS
+      continue;
+    }
+    const bool is_v4 = (k == kKindV4);
+    uint32_t result = kUndef;
+    if (l4_ok[p]) {
+      const int cap = is_v4 ? 32 : 128;
+      const uint8_t* ip = pkt_ip + p * 16;
+      int best_len = -1;
+      int best_t = -1;
+      for (int32_t t = 0; t < T; ++t) {
+        const Entry& e = entries[t];
+        if (e.ifindex != pkt_ifindex[p]) continue;
+        if (e.mask_len > cap || e.mask_len <= best_len) continue;
+        if (!prefix_matches(e, ip)) continue;
+        best_len = e.mask_len;
+        best_t = t;
+      }
+      if (best_t >= 0) {
+        result = scan_rules(rules + static_cast<size_t>(best_t) * width * 7,
+                            width, proto[p], dport[p], itype[p], icode[p], is_v4);
+      }
+    }
+    results[p] = result;
+    const int action = static_cast<int>(result & 0xFF);
+    const uint32_t rule_id = (result >> 8) & 0xFFFFFF;
+    if (action == kDeny) {
+      xdp[p] = kDeny;
+      if (rule_id < kMaxTargets) {
+        stats[rule_id * 4 + 2] += 1;
+        stats[rule_id * 4 + 3] += pktlen[p];
+      }
+    } else if (action == kAllow) {
+      xdp[p] = kAllow;
+      if (rule_id < kMaxTargets) {
+        stats[rule_id * 4 + 0] += 1;
+        stats[rule_id * 4 + 1] += pktlen[p];
+      }
+    } else {
+      xdp[p] = kAllow;  // UNDEF -> default pass, no stats
+    }
+  }
+}
+
+int32_t infw_abi_version() { return 1; }
+
+}  // extern "C"

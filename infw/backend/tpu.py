@@ -1,0 +1,116 @@
+"""TPU classifier backend.
+
+The device-resident dataplane: compiled rule tensors live in HBM/VMEM, the
+classify step is the fused Pallas kernel (tables up to the dense limit) or
+the XLA trie path (100K+ CIDRs).  Design points:
+
+- **double-buffered table swap** (SURVEY.md §2: the TPU analogue of the
+  reference's mutex-serialized map rewrite,
+  /root/reference/pkg/ebpfsyncer/ebpfsyncer.go:56-63): the next rule
+  tensors are built and device_put while classification continues on the
+  current set; the swap is a single reference assignment under a lock, so
+  in-flight batches finish on the old tables and new batches see the new
+  ones — no torn reads, no pause.
+- **async pipelining**: classify() dispatches without blocking (JAX's
+  async dispatch queues the work); results are materialized lazily, so a
+  caller streaming batches overlaps host<->device transfer with compute.
+- statistics accumulate host-side in int64 from the device's per-batch
+  (1024, 6) int32 sums.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..compiler import CompiledTables
+from ..kernels import jaxpath, pallas_dense
+from ..packets import PacketBatch
+from .base import ClassifyOutput, StatsAccumulator
+
+
+class TpuClassifier:
+    """Single-chip device classifier."""
+
+    def __init__(
+        self,
+        device=None,
+        dense_limit: int = pallas_dense.MAX_DENSE_TARGETS,
+        force_path: Optional[str] = None,  # "dense" | "trie" | None (auto)
+        interpret: Optional[bool] = None,
+    ) -> None:
+        self._device = device if device is not None else jax.devices()[0]
+        self._dense_limit = dense_limit
+        self._force_path = force_path
+        self._interpret = (
+            interpret if interpret is not None else pallas_dense.default_interpret()
+        )
+        self._lock = threading.Lock()
+        self._stats = StatsAccumulator()
+        self._tables: Optional[CompiledTables] = None
+        self._active = None  # (path, device tables)
+        self._closed = False
+
+    # -- rule loading -------------------------------------------------------
+
+    def load_tables(self, tables: CompiledTables) -> None:
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        path = self._force_path or (
+            "dense" if tables.num_entries <= self._dense_limit else "trie"
+        )
+        # Build the next buffer off-lock (host packing + device_put can be
+        # slow); swap under the lock.
+        if path == "dense":
+            pt = pallas_dense.build_pallas_tables(tables)
+            dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
+        else:
+            dev = jaxpath.device_tables(tables, self._device)
+        with self._lock:
+            self._tables = tables
+            self._active = (path, dev)
+
+    # -- classify -----------------------------------------------------------
+
+    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+        with self._lock:
+            if self._active is None:
+                raise RuntimeError("no rule tables loaded")
+            path, dev = self._active
+            stride = self._tables.stride
+        db = jaxpath.device_batch(batch, self._device)
+        if path == "dense":
+            res, xdp, stats = pallas_dense.jitted_classify_pallas(self._interpret)(
+                dev, db
+            )
+        else:
+            res, xdp, stats = jaxpath.jitted_classify(True, stride)(dev, db)
+        stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
+        self._stats.add(stats_delta)
+        return ClassifyOutput(
+            results=np.asarray(res), xdp=np.asarray(xdp), stats_delta=stats_delta
+        )
+
+    # -- accessors / lifecycle ---------------------------------------------
+
+    @property
+    def stats(self) -> StatsAccumulator:
+        return self._stats
+
+    @property
+    def tables(self) -> Optional[CompiledTables]:
+        return self._tables
+
+    @property
+    def active_path(self) -> Optional[str]:
+        return self._active[0] if self._active else None
+
+    def close(self) -> None:
+        """Release device references (the analogue of detaching the XDP
+        program and closing the BPF objects, loader.go:306-333)."""
+        with self._lock:
+            self._active = None
+            self._tables = None
+            self._closed = True
