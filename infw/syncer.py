@@ -1,0 +1,414 @@
+"""The dataplane sync boundary.
+
+TPU-native equivalent of the reference's ebpfsyncer
+(/root/reference/pkg/ebpfsyncer/ebpfsyncer.go) — the architecture's key
+seam: the single point of contact between declarative desired state and the
+running classifier.  One method, ``sync_interface_ingress_rules(rules,
+is_delete)`` (ebpfsyncer.go:32-34), hides the backend (TPU Pallas / XLA
+trie / native C++ CPU reference).
+
+Lifecycle semantics preserved from the reference:
+
+- **singleton, mutex-serialized** (:38-67, :72-73): one syncer per daemon
+  process; concurrent syncs serialize.  ``reset_singleton_for_test()``
+  replaces the test suite's ``once = sync.Once{}`` restart simulation
+  (ebpfsyncer_test.go:1232-1234).
+- **lazy manager creation + restart re-adoption** (:100-104 →
+  loader.go:381-407): the classifier is created on first sync; if a
+  checkpoint ("pinned" compiled tables + attach manifest) exists it is
+  re-adopted, so a daemon restart resumes enforcing without recompiling.
+- **stats poller paused around sync** (:81-88) so metrics never read a
+  table mid-rewrite.
+- **is_delete ⇒ resetAll** (:90-97, :160-181): detach everything, close the
+  classifier, remove the checkpoint (unpin).
+- **detach-unmanaged → attach-new → load rules** order (:106-125); attach
+  retries on busy interfaces (XDP_EBUSY, :193-207).
+- **idempotent rule load**: desired vs stale key diff
+  (loader.go:177-194,551-631) — unchanged content causes no device reload.
+- ``get_classifier_map_content_for_test`` mirrors
+  ``GetBPFMapContentForTest`` (ebpfsyncer.go:128-133).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Set, Tuple
+
+import numpy as np
+
+from . import interfaces as interfaces_mod
+from .backend.base import Classifier
+from .compiler import (
+    CompiledTables,
+    LpmKey,
+    build_table_content,
+    compile_tables_from_content,
+    min_rule_width,
+)
+from .constants import MAX_RULES_PER_TARGET
+from .interfaces import InterfaceRegistry
+from .spec import IngressNodeFirewallRules
+
+log = logging.getLogger("infw.syncer")
+
+# XDP_EBUSY retry policy (ebpfsyncer.go:28-30,193-207).
+XDP_EBUSY_MAX_RETRIES = 10
+XDP_EBUSY_RETRY_INTERVAL_S = 0.1
+
+
+class SyncError(RuntimeError):
+    pass
+
+
+def _rules_equal(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> bool:
+    """Width-insensitive rule-matrix equality: the reference compares
+    fixed-width (100) packed structs (loader.go:580 DeepEqual); our compiled
+    widths shrink to the ruleset, so matrices are equal when they agree on
+    the common prefix and are zero beyond it."""
+    if a is None or b is None:
+        return False
+    if a.shape[0] < b.shape[0]:
+        a, b = b, a
+    w = b.shape[0]
+    return np.array_equal(a[:w], b) and not a[w:].any()
+
+
+class AttachBusyError(SyncError):
+    """The interface is held by another program (unix.EBUSY analogue)."""
+
+
+class StatsPoller(Protocol):
+    """The pause/resume surface of the metrics poller
+    (pkg/metrics/statistics.go:88-110)."""
+
+    def start_poll(self, classifier: Classifier) -> None: ...
+    def stop_poll(self) -> None: ...
+
+
+class Syncer(Protocol):
+    """EbpfSyncer interface (ebpfsyncer.go:32-34) — the mock boundary used
+    by the node-state controller tests."""
+
+    def sync_interface_ingress_rules(
+        self,
+        iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
+        is_delete: bool,
+    ) -> None: ...
+
+
+class DataplaneSyncer:
+    """Production syncer driving a Classifier backend.
+
+    ``classifier_factory`` plays the role of ``createNewManager``
+    (ebpfsyncer.go:100 → NewIngNodeFwController); ``attach_fn`` /
+    ``detach_fn`` are the XDP attach/detach seams (tests inject failures to
+    exercise the EBUSY retry path).
+    """
+
+    def __init__(
+        self,
+        classifier_factory: Callable[[], Classifier],
+        registry: Optional[InterfaceRegistry] = None,
+        stats_poller: Optional[StatsPoller] = None,
+        checkpoint_dir: Optional[str] = None,
+        rule_width: Optional[int] = None,
+        stride: int = 4,
+        attach_fn: Optional[Callable[[str], None]] = None,
+        detach_fn: Optional[Callable[[str], None]] = None,
+        is_valid_interface: Optional[Callable[[str], bool]] = None,
+        ebusy_retry_interval_s: float = XDP_EBUSY_RETRY_INTERVAL_S,
+    ) -> None:
+        self._factory = classifier_factory
+        self._registry = registry if registry is not None else interfaces_mod.default_registry
+        self._stats_poller = stats_poller
+        self._checkpoint_dir = checkpoint_dir
+        self._rule_width = rule_width
+        self._stride = stride
+        self._attach_fn = attach_fn
+        self._detach_fn = detach_fn
+        # Injectable like the package-level isValidInterfaceNameAndState var
+        # (ebpfsyncer.go:26, mocked at ebpfsyncer_test.go:1249-1251).
+        self._is_valid_interface = is_valid_interface
+        self._ebusy_interval = ebusy_retry_interval_s
+
+        self._lock = threading.Lock()
+        self._classifier: Optional[Classifier] = None
+        self._attached: Set[str] = set()
+        self._content: Dict[LpmKey, np.ndarray] = {}
+
+    # -- public surface ------------------------------------------------------
+
+    def sync_interface_ingress_rules(
+        self,
+        iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
+        is_delete: bool,
+    ) -> None:
+        """SyncInterfaceIngressRules (ebpfsyncer.go:70-126)."""
+        with self._lock:
+            log.info("syncing ingress firewall rules for %d interfaces (delete=%s)",
+                     len(iface_ingress_rules), is_delete)
+            if self._stats_poller is not None:
+                self._stats_poller.stop_poll()
+            try:
+                self._create_manager_if_not_exists()
+                if is_delete:
+                    self._reset_all()
+                    return
+                self._detach_unmanaged_interfaces(iface_ingress_rules)
+                self._attach_new_interfaces(iface_ingress_rules)
+                self._load_ingress_node_firewall_rules(iface_ingress_rules)
+                # The attach/detach set may change even when rule content
+                # does not; the manifest must always reflect it or a restart
+                # re-adopts stale attachments.
+                self._save_manifest()
+            finally:
+                if self._stats_poller is not None and self._classifier is not None:
+                    self._stats_poller.start_poll(self._classifier)
+
+    @property
+    def classifier(self) -> Optional[Classifier]:
+        return self._classifier
+
+    def attached_interfaces(self) -> Set[str]:
+        with self._lock:
+            return set(self._attached)
+
+    def get_classifier_map_content_for_test(self) -> Dict[LpmKey, np.ndarray]:
+        """GetBPFMapContentForTest (ebpfsyncer.go:128-133,
+        loader.go:286-303): the live table content of the running
+        classifier."""
+        with self._lock:
+            if self._classifier is None:
+                raise SyncError("Failed to get BPF map content: no manager")
+            return {k: v.copy() for k, v in self._content.items()}
+
+    def shutdown(self) -> None:
+        """SIGTERM handler path (ebpfsyncer.go:90-97): full reset, keeping
+        the checkpoint so a restart re-adopts (the kernel analogue: pinned
+        links keep enforcing after daemon death)."""
+        with self._lock:
+            if self._classifier is None:
+                return
+            if self._stats_poller is not None:
+                self._stats_poller.stop_poll()
+            for name in list(self._attached):
+                self._detach(name)
+            self._classifier.close()
+            self._classifier = None
+            self._attached.clear()
+            self._content = {}
+
+    # -- lifecycle internals -------------------------------------------------
+
+    def _create_manager_if_not_exists(self) -> None:
+        """createNewManagerIfNotExists (ebpfsyncer.go:100-104 → loader
+        NewIngNodeFwController), incl. pinned-state re-adoption
+        (loader.go:99-104,381-407)."""
+        if self._classifier is not None:
+            return
+        self._classifier = self._factory()
+        ck = self._load_checkpoint()
+        if ck is not None:
+            tables, attached = ck
+            self._classifier.load_tables(tables)
+            self._content = dict(tables.content)
+            for name in attached:
+                try:
+                    self._attach(name)
+                except (SyncError, interfaces_mod.InterfaceError):
+                    log.warning("re-adopt: interface %s no longer attachable", name)
+            log.info("re-adopted checkpoint: %d entries, %d interfaces",
+                     tables.num_entries, len(self._attached))
+
+    def _reset_all(self) -> None:
+        """resetAll (ebpfsyncer.go:160-181): detach + close + unpin."""
+        for name in list(self._attached):
+            self._detach(name)
+        self._attached.clear()
+        if self._classifier is not None:
+            self._classifier.close()
+        self._classifier = None
+        self._content = {}
+        self._remove_checkpoint()
+
+    def _detach_unmanaged_interfaces(
+        self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
+    ) -> None:
+        """detachUnmanagedInterfaces (ebpfsyncer.go:218-232): anything
+        currently attached but absent from the desired set is detached."""
+        for name in list(self._attached):
+            if name not in iface_ingress_rules:
+                log.info("detaching unmanaged interface %s", name)
+                self._detach(name)
+
+    def _attach_new_interfaces(
+        self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
+    ) -> None:
+        """attachNewInterfaces (ebpfsyncer.go:183-215): invalid interfaces
+        are skipped without error; busy interfaces retry."""
+        valid = self._is_valid_interface or self._registry.is_valid_interface_name_and_state
+        for name in iface_ingress_rules:
+            if name in self._attached:
+                continue
+            if not valid(name):
+                log.error("fail to attach ingress firewall prog to interface %s: invalid state", name)
+                continue
+            last: Optional[Exception] = None
+            for _ in range(XDP_EBUSY_MAX_RETRIES):
+                try:
+                    self._attach(name)
+                    last = None
+                    break
+                except AttachBusyError as e:
+                    last = e
+                    time.sleep(self._ebusy_interval)
+            if last is not None:
+                raise SyncError(f"failed to attach interface {name}: {last}")
+
+    def _load_ingress_node_firewall_rules(
+        self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
+    ) -> None:
+        """loadIngressNodeFirewallRules → IngressNodeFwRulesLoader
+        (loader.go:130-194): build desired content, diff against current,
+        reload the device tables only when the content changed, then pin."""
+        valid = self._is_valid_interface or self._registry.is_valid_interface_name_and_state
+        width = self._desired_width(iface_ingress_rules)
+        desired = build_table_content(
+            iface_ingress_rules, self._registry, width, is_valid_interface=valid
+        )
+        stale = self._get_stale_keys(desired)
+        current = {k.masked_identity(): v for k, v in self._content.items()}
+        changed = bool(stale) or any(
+            not _rules_equal(current.get(k.masked_identity()), v)
+            for k, v in desired.items()
+        )
+        if not changed and self._classifier.tables is not None:
+            log.info("rules unchanged; skipping device reload")
+            return
+        tables = compile_tables_from_content(
+            desired, rule_width=width, stride=self._stride
+        )
+        self._classifier.load_tables(tables)
+        self._content = dict(desired)
+        self._save_checkpoint(tables)
+
+    def _desired_width(self, iface_ingress_rules) -> int:
+        if self._rule_width is not None:
+            return self._rule_width
+        return min(min_rule_width(iface_ingress_rules), MAX_RULES_PER_TARGET)
+
+    def _get_stale_keys(self, desired: Dict[LpmKey, np.ndarray]) -> List[LpmKey]:
+        """getStaleKeys (loader.go:551-631): current keys that are absent
+        from — or whose rules differ from — the desired content."""
+        want = {k.masked_identity(): v for k, v in desired.items()}
+        return [
+            k
+            for k, v in self._content.items()
+            if not _rules_equal(want.get(k.masked_identity()), v)
+        ]
+
+    # -- attach/detach seams -------------------------------------------------
+
+    def _attach(self, name: str) -> None:
+        if self._attach_fn is not None:
+            self._attach_fn(name)
+        else:
+            self._registry.set_xdp(name, True)
+        self._attached.add(name)
+
+    def _detach(self, name: str) -> None:
+        try:
+            if self._detach_fn is not None:
+                self._detach_fn(name)
+            else:
+                self._registry.set_xdp(name, False)
+        except interfaces_mod.InterfaceError:
+            pass  # interface vanished; treat as detached (loader.go:268-283)
+        self._attached.discard(name)
+
+    # -- checkpoint ("pinning") ---------------------------------------------
+
+    def _ck_paths(self) -> Optional[Tuple[str, str]]:
+        if not self._checkpoint_dir:
+            return None
+        return (
+            os.path.join(self._checkpoint_dir, "tables.npz"),
+            os.path.join(self._checkpoint_dir, "manifest.json"),
+        )
+
+    def _save_checkpoint(self, tables: CompiledTables) -> None:
+        paths = self._ck_paths()
+        if paths is None:
+            return
+        tables_path, _ = paths
+        os.makedirs(self._checkpoint_dir, exist_ok=True)
+        # Atomic swap: never leave a torn checkpoint (the bpffs pin is
+        # similarly all-or-nothing).
+        tmp = tables_path + ".tmp.npz"
+        tables.save(tmp)
+        os.replace(tmp, tables_path)
+        self._save_manifest()
+
+    def _save_manifest(self) -> None:
+        paths = self._ck_paths()
+        if paths is None:
+            return
+        _, manifest_path = paths
+        os.makedirs(self._checkpoint_dir, exist_ok=True)
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"attached": sorted(self._attached)}, f)
+        os.replace(tmp, manifest_path)
+
+    def _load_checkpoint(self) -> Optional[Tuple[CompiledTables, List[str]]]:
+        paths = self._ck_paths()
+        if paths is None:
+            return None
+        tables_path, manifest_path = paths
+        if not (os.path.exists(tables_path) and os.path.exists(manifest_path)):
+            return None
+        try:
+            tables = CompiledTables.load(tables_path)
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            return tables, list(manifest.get("attached", []))
+        except Exception as e:  # torn/corrupt checkpoint: start fresh
+            log.warning("failed to load checkpoint: %s", e)
+            return None
+
+    def _remove_checkpoint(self) -> None:
+        paths = self._ck_paths()
+        if paths is None:
+            return
+        for p in paths:
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+
+# -- process singleton (GetEbpfSyncer, ebpfsyncer.go:38-67) ------------------
+
+_singleton_lock = threading.Lock()
+_singleton: Optional[DataplaneSyncer] = None
+
+
+def get_syncer(**kwargs) -> DataplaneSyncer:
+    """First call constructs the singleton with the given kwargs; later
+    calls return it unchanged (sync.Once semantics, ebpfsyncer.go:56-63)."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = DataplaneSyncer(**kwargs)
+        return _singleton
+
+
+def reset_singleton_for_test() -> None:
+    """once = sync.Once{} (ebpfsyncer_test.go:1232-1234): simulates daemon
+    process restart."""
+    global _singleton
+    with _singleton_lock:
+        _singleton = None

@@ -1,0 +1,473 @@
+"""Syncer lifecycle tests — the port of the reference's root-gated
+dataplane integration suite (/root/reference/pkg/ebpfsyncer/ebpfsyncer_test.go):
+veth pairs + netcat probes become synthetic packet batches; reachability
+tables become golden verdict vectors; bpffs pins become the compiled-table
+checkpoint; the `once = sync.Once{}` restart trick becomes
+reset_singleton_for_test().
+"""
+import numpy as np
+import pytest
+
+from infw import syncer as syncer_mod
+from infw.backend.cpu_ref import CpuRefClassifier
+from infw.constants import DENY, UNDEF, XDP_DROP, XDP_PASS
+from infw.interfaces import Interface, InterfaceRegistry
+from infw.packets import make_batch
+from infw.spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    IngressNodeFirewallICMPRule,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallRules,
+    IngressNodeProtocolConfig,
+    PROTOCOL_TYPE_ICMP,
+    PROTOCOL_TYPE_TCP,
+    PROTOCOL_TYPE_UDP,
+    PROTOCOL_TYPE_UNSET,
+)
+from infw.syncer import AttachBusyError, DataplaneSyncer, SyncError
+
+
+class CountingClassifier(CpuRefClassifier):
+    """CpuRefClassifier that counts device table loads (the re-sync
+    idempotency probe: unchanged rules must not reload,
+    ebpfsyncer_test.go:598-726)."""
+
+    def __init__(self):
+        super().__init__()
+        self.load_count = 0
+
+    def load_tables(self, tables):
+        self.load_count += 1
+        super().load_tables(tables)
+
+
+def tcp_rule(order, ports, action):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol=PROTOCOL_TYPE_TCP, tcp=IngressNodeFirewallProtoRule(ports=ports)
+        ),
+        action=action,
+    )
+
+
+def udp_rule(order, ports, action):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol=PROTOCOL_TYPE_UDP, udp=IngressNodeFirewallProtoRule(ports=ports)
+        ),
+        action=action,
+    )
+
+
+def icmp_rule(order, itype, icode, action):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol=PROTOCOL_TYPE_ICMP,
+            icmp=IngressNodeFirewallICMPRule(icmp_type=itype, icmp_code=icode),
+        ),
+        action=action,
+    )
+
+
+def catchall_rule(order, action):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(protocol=PROTOCOL_TYPE_UNSET),
+        action=action,
+    )
+
+
+def ingress(cidrs, rules):
+    return IngressNodeFirewallRules(source_cidrs=list(cidrs), rules=list(rules))
+
+
+@pytest.fixture
+def registry():
+    """The veth fixture (ebpfsyncer_test.go:1253-1317): dummy0..2."""
+    reg = InterfaceRegistry()
+    for i, name in enumerate(["dummy0", "dummy1", "dummy2"]):
+        reg.add(Interface(name=name, index=10 + i))
+    return reg
+
+
+@pytest.fixture
+def make_syncer(registry, tmp_path):
+    def _make(**kw):
+        kw.setdefault("classifier_factory", CountingClassifier)
+        kw.setdefault("registry", registry)
+        kw.setdefault("checkpoint_dir", str(tmp_path / "ck"))
+        kw.setdefault("ebusy_retry_interval_s", 0.001)
+        return DataplaneSyncer(**kw)
+
+    return _make
+
+
+IF0, IF1 = 10, 11  # dummy0, dummy1 indices
+
+
+# --- reachability verdict tables (TestSyncInterfaceIngressRulesWithHTTP,
+# ebpfsyncer_test.go:41-447) -------------------------------------------------
+
+def verdicts(s, src, proto, dport, ifidx, itype=None, icode=None):
+    batch = make_batch(
+        src=src,
+        proto=proto,
+        dst_port=dport,
+        ifindex=ifidx,
+        icmp_type=itype,
+        icmp_code=icode,
+    )
+    return list(s.classifier.classify(batch).xdp)
+
+
+def test_deny_tcp_port_from_cidr(make_syncer):
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]},
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["192.0.2.1", "192.0.2.1", "192.0.2.5", "198.51.100.1"],
+        proto=[6, 6, 6, 6],
+        dport=[80, 81, 80, 80],
+        ifidx=[IF0, IF0, IF0, IF0],
+    )
+    #            in-CIDR:80→DROP  in-CIDR:81→PASS  out-of-CIDR→PASS ×2
+    assert got == [XDP_DROP, XDP_PASS, XDP_PASS, XDP_PASS]
+
+
+def test_allow_then_catchall_deny(make_syncer):
+    """Ordered first-match: Allow tcp/80 at order 1, protocol-catch-all Deny
+    at order 2 (kernel.c:229-257 scan semantics)."""
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {
+            "dummy0": [
+                ingress(
+                    ["192.0.2.0/24"],
+                    [tcp_rule(1, 80, ACTION_ALLOW), catchall_rule(2, ACTION_DENY)],
+                )
+            ]
+        },
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["192.0.2.7"] * 4,
+        proto=[6, 6, 17, 1],
+        dport=[80, 443, 53, 0],
+        ifidx=[IF0] * 4,
+        itype=[0, 0, 0, 8],
+    )
+    assert got == [XDP_PASS, XDP_DROP, XDP_DROP, XDP_DROP]
+
+
+def test_port_range_half_open(make_syncer):
+    """Kernel range match is [start, end) (kernel.c:241)."""
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["10.0.0.0/8"], [tcp_rule(1, "800-900", ACTION_DENY)])]},
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["10.1.2.3"] * 4,
+        proto=[6] * 4,
+        dport=[799, 800, 899, 900],
+        ifidx=[IF0] * 4,
+    )
+    assert got == [XDP_PASS, XDP_DROP, XDP_DROP, XDP_PASS]
+
+
+def test_icmp_and_udp_rules(make_syncer):
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {
+            "dummy0": [
+                ingress(
+                    ["192.0.2.0/30"],
+                    [icmp_rule(1, 8, 0, ACTION_DENY), udp_rule(2, 53, ACTION_DENY)],
+                )
+            ]
+        },
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["192.0.2.1"] * 4,
+        proto=[1, 1, 17, 17],
+        dport=[0, 0, 53, 54],
+        ifidx=[IF0] * 4,
+        itype=[8, 9, 0, 0],
+        icode=[0, 0, 0, 0],
+    )
+    # echo-request dropped, type 9 passes; udp 53 dropped, 54 passes
+    assert got == [XDP_DROP, XDP_PASS, XDP_DROP, XDP_PASS]
+
+
+def test_ipv6_cidr(make_syncer):
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["2001:db8::/64"], [tcp_rule(1, 80, ACTION_DENY)])]},
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["2001:db8::5", "2001:db9::5"],
+        proto=[6, 6],
+        dport=[80, 80],
+        ifidx=[IF0, IF0],
+    )
+    assert got == [XDP_DROP, XDP_PASS]
+
+
+def test_per_interface_isolation(make_syncer):
+    """Rules keyed by ingress ifindex: traffic on dummy1 is unaffected by
+    dummy0's table (multi-interface TCs, ebpfsyncer_test.go:449-596)."""
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {
+            "dummy0": [ingress(["0.0.0.0/0"], [tcp_rule(1, 80, ACTION_DENY)])],
+            "dummy1": [ingress(["0.0.0.0/0"], [tcp_rule(1, 443, ACTION_DENY)])],
+        },
+        False,
+    )
+    got = verdicts(
+        s,
+        src=["198.51.100.9"] * 4,
+        proto=[6] * 4,
+        dport=[80, 443, 80, 443],
+        ifidx=[IF0, IF0, IF1, IF1],
+    )
+    assert got == [XDP_DROP, XDP_PASS, XDP_PASS, XDP_DROP]
+    assert s.attached_interfaces() == {"dummy0", "dummy1"}
+
+
+# --- attach/detach + idempotency ---------------------------------------------
+
+def test_attach_detach_lifecycle(make_syncer, registry):
+    s = make_syncer()
+    rules = {"dummy0": [ingress(["1.1.1.0/24"], [tcp_rule(1, 22, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules, False)
+    assert registry.get("dummy0").xdp_attached
+    assert not registry.get("dummy1").xdp_attached
+
+    # moving the ruleset to dummy1 detaches the now-unmanaged dummy0
+    rules2 = {"dummy1": [ingress(["1.1.1.0/24"], [tcp_rule(1, 22, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules2, False)
+    assert not registry.get("dummy0").xdp_attached
+    assert registry.get("dummy1").xdp_attached
+    assert s.attached_interfaces() == {"dummy1"}
+
+
+def test_invalid_interface_skipped(make_syncer, registry):
+    """Invalid (down/loopback/missing) interfaces are skipped without
+    failing the sync (ebpfsyncer.go:185-191, loader.go:141-148)."""
+    registry.add(Interface(name="downif", index=99, up=False))
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {
+            "downif": [ingress(["1.1.1.0/24"], [tcp_rule(1, 22, ACTION_DENY)])],
+            "dummy0": [ingress(["2.2.2.0/24"], [tcp_rule(1, 22, ACTION_DENY)])],
+        },
+        False,
+    )
+    assert s.attached_interfaces() == {"dummy0"}
+    content = s.get_classifier_map_content_for_test()
+    assert all(k.ingress_ifindex == IF0 for k in content)
+
+
+def test_resync_idempotent_no_reload(make_syncer):
+    """Unchanged desired state must not touch the device tables
+    (re-sync idempotency, ebpfsyncer_test.go:598-726)."""
+    s = make_syncer()
+    rules = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules, False)
+    assert s.classifier.load_count == 1
+    s.sync_interface_ingress_rules(rules, False)
+    s.sync_interface_ingress_rules(rules, False)
+    assert s.classifier.load_count == 1
+
+    # a rule change does reload
+    rules2 = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 81, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules2, False)
+    assert s.classifier.load_count == 2
+
+
+def test_map_content_whitebox(make_syncer):
+    """White-box table content assertions
+    (TestVerifyBPFKeysAfterInterfaceIngressRulesUpdate,
+    ebpfsyncer_test.go:727-989)."""
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {
+            "dummy0": [
+                ingress(["192.0.2.0/30", "10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])
+            ]
+        },
+        False,
+    )
+    content = s.get_classifier_map_content_for_test()
+    idents = {(k.prefix_len, k.ingress_ifindex) for k in content}
+    assert idents == {(30 + 32, IF0), (8 + 32, IF0)}
+    for rules in content.values():
+        assert rules[1, 0] == 1          # ruleId == order
+        assert rules[1, 1] == 6          # IPPROTO_TCP
+        assert rules[1, 2] == 80 and rules[1, 3] == 0  # single port: end==0
+        assert rules[1, 6] == DENY
+
+    # update: drop one CIDR — its key must be purged
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]},
+        False,
+    )
+    content = s.get_classifier_map_content_for_test()
+    assert {(k.prefix_len, k.ingress_ifindex) for k in content} == {(40, IF0)}
+
+
+def test_delete_resets_all(make_syncer, registry, tmp_path):
+    s = make_syncer()
+    rules = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules, False)
+    assert (tmp_path / "ck" / "tables.npz").exists()
+
+    s.sync_interface_ingress_rules(rules, True)
+    assert s.classifier is None
+    assert s.attached_interfaces() == set()
+    assert not registry.get("dummy0").xdp_attached
+    assert not (tmp_path / "ck" / "tables.npz").exists()
+    with pytest.raises(SyncError):
+        s.get_classifier_map_content_for_test()
+
+
+def test_ebusy_retry(make_syncer, registry):
+    """Attach retries on busy interfaces (ebpfsyncer.go:193-207)."""
+    fails = {"n": 3}
+
+    def flaky_attach(name):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise AttachBusyError(name)
+        registry.set_xdp(name, True)
+
+    s = make_syncer(attach_fn=flaky_attach)
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["1.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]}, False
+    )
+    assert s.attached_interfaces() == {"dummy0"}
+
+    fails["n"] = 10**9  # forever-busy: sync fails after max retries
+    s2 = make_syncer(attach_fn=flaky_attach)
+    with pytest.raises(SyncError):
+        s2.sync_interface_ingress_rules(
+            {"dummy1": [ingress(["1.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]}, False
+        )
+
+
+# --- restart recovery (checkpoint re-adoption) --------------------------------
+
+def test_restart_readoption(make_syncer, registry, tmp_path):
+    """Crash-restart recovery (TestInterfaceAttachments TC1,
+    ebpfsyncer_test.go:1045-1053): a new syncer over the same checkpoint
+    dir re-adopts tables + attachments without recompiling."""
+    rules = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s = make_syncer()
+    s.sync_interface_ingress_rules(rules, False)
+    before = verdicts(s, src=["192.0.2.1"], proto=[6], dport=[80], ifidx=[IF0])
+    s.shutdown()  # daemon dies; checkpoint ("pins") survives
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules(rules, False)
+    # one load for adoption, none for the no-op diff
+    assert s2.classifier.load_count == 1
+    assert s2.attached_interfaces() == {"dummy0"}
+    after = verdicts(s2, src=["192.0.2.1"], proto=[6], dport=[80], ifidx=[IF0])
+    assert before == after == [XDP_DROP]
+
+
+def test_restart_readoption_interface_gone(make_syncer, registry, tmp_path):
+    """A checkpointed interface that vanished before restart is skipped
+    with a warning, not a sync failure."""
+    rules = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s = make_syncer()
+    s.sync_interface_ingress_rules(rules, False)
+    s.shutdown()
+    registry.remove("dummy0")
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules({}, False)  # must not raise
+    assert s2.attached_interfaces() == set()
+
+
+def test_manifest_tracks_detach_without_rule_change(make_syncer, registry, tmp_path):
+    """Detaching an interface whose table content contributes nothing must
+    still update the checkpoint manifest, or a restart re-adopts it."""
+    rules_both = {
+        "dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])],
+        "dummy1": [],
+    }
+    s = make_syncer()
+    s.sync_interface_ingress_rules(rules_both, False)
+    assert s.attached_interfaces() == {"dummy0", "dummy1"}
+
+    rules_one = {"dummy0": rules_both["dummy0"]}
+    s.sync_interface_ingress_rules(rules_one, False)  # content unchanged
+    s.shutdown()
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules(rules_one, False)
+    assert s2.attached_interfaces() == {"dummy0"}
+    assert not registry.get("dummy1").xdp_attached
+
+
+def test_shutdown_stops_stats_poller(make_syncer):
+    events = []
+
+    class Poller:
+        def stop_poll(self):
+            events.append("stop")
+
+        def start_poll(self, classifier):
+            events.append("start")
+
+    s = make_syncer(stats_poller=Poller())
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["1.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]}, False
+    )
+    s.shutdown()
+    assert events == ["stop", "start", "stop"]
+
+
+def test_singleton_semantics(make_syncer):
+    syncer_mod.reset_singleton_for_test()
+    a = syncer_mod.get_syncer(classifier_factory=CountingClassifier)
+    b = syncer_mod.get_syncer()
+    assert a is b
+    syncer_mod.reset_singleton_for_test()
+    c = syncer_mod.get_syncer(classifier_factory=CountingClassifier)
+    assert c is not a
+
+
+# --- stats poller pause/resume ------------------------------------------------
+
+def test_stats_poller_paused_around_sync(make_syncer):
+    events = []
+
+    class Poller:
+        def stop_poll(self):
+            events.append("stop")
+
+        def start_poll(self, classifier):
+            events.append(("start", classifier is not None))
+
+    s = make_syncer(stats_poller=Poller())
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["1.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]}, False
+    )
+    assert events == ["stop", ("start", True)]
