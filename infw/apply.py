@@ -1,0 +1,26 @@
+"""Create-or-update apply helper.
+
+Equivalent of the reference's pkg/apply
+(/root/reference/pkg/apply/apply.go:36-58): create the object if absent,
+otherwise update it when semantically different, preserving the stored
+status subresource.
+"""
+from __future__ import annotations
+
+from .spec import semantic_equal
+from .store import InMemoryStore, NotFoundError
+
+
+def apply_object(store: InMemoryStore, obj) -> object:
+    """ApplyObject (apply.go:36)."""
+    try:
+        existing = store.get(obj.KIND, obj.metadata.name, obj.metadata.namespace)
+    except NotFoundError:
+        return store.create(obj)
+    same = (
+        semantic_equal(existing.spec, obj.spec)
+        and existing.metadata.labels == obj.metadata.labels
+        and [o.to_dict() for o in existing.metadata.owner_references]
+        == [o.to_dict() for o in obj.metadata.owner_references]
+    )
+    return existing if same else store.update(obj)

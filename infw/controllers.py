@@ -1,0 +1,317 @@
+"""Manager-side controllers: rule fan-out and daemon deployment.
+
+TPU-native equivalents of the reference's two manager reconcilers:
+
+- ``IngressNodeFirewallReconciler`` mirrors
+  /root/reference/controllers/ingressnodefirewall_controller.go: full-state
+  reconciliation of cluster-scoped IngressNodeFirewall objects × labeled
+  Nodes into per-node namespaced NodeState objects (:57-201,253-365), with
+  the ruleset merge and its duplicate-order detection (:371-425) and the
+  per-INF SyncStatus rollup (:352-361).
+- ``IngressNodeFirewallConfigReconciler`` mirrors
+  ingressnodefirewallconfig_controller.go: singleton-name enforcement
+  (:89-92), manifest render with image/namespace/debug (:130-146), apply,
+  and Available/Progressing/Degraded conditions with a 5s requeue while
+  the daemon deployment is still coming up (:94-119).
+
+Both run against the pluggable Store (in-memory for tests, exactly the
+role envtest plays for the reference suite).
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import render, status
+from .apply import apply_object
+from .spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+    IngressNodeFirewallNodeState,
+    IngressNodeFirewallNodeStateStatus,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallRules,
+    NODE_STATE_SYNC_ERROR,
+    NODE_STATE_SYNC_OK,
+    ObjectMeta,
+    OwnerReference,
+    SYNC_STATUS_ERROR,
+    SYNC_STATUS_OK,
+    semantic_equal,
+)
+from .store import DaemonSet, InMemoryStore, Node, NotFoundError
+
+log = logging.getLogger("infw.controllers")
+
+# Singleton config resource name (ingressnodefirewallconfig_controller.go:41).
+DEFAULT_CONFIG_NAME = "ingressnodefirewallconfig"
+
+
+class MergeError(ValueError):
+    pass
+
+
+def merge_firewall_protocol_rules(
+    a: List[IngressNodeFirewallProtocolRule],
+    b: List[IngressNodeFirewallProtocolRule],
+) -> List[IngressNodeFirewallProtocolRule]:
+    """mergeFirewallProtocolRules (ingressnodefirewall_controller.go:409-425):
+    duplicate orders — within a alone, or across a and b — are an error."""
+    orders = set()
+    for item in a:
+        if item.order in orders:
+            raise MergeError(f"duplicate order {item.order} detected for rules in A")
+        orders.add(item.order)
+    out = list(a)
+    for item in b:
+        if item.order in orders:
+            raise MergeError(f"duplicate order {item.order} detected for rules in B")
+        orders.add(item.order)
+        out.append(item)
+    return out
+
+
+def merge_rule_set(
+    a: List[IngressNodeFirewallRules], b: List[IngressNodeFirewallRules]
+) -> List[IngressNodeFirewallRules]:
+    """mergeRuleSet (ingressnodefirewall_controller.go:371-403): ruleset a
+    (already merged, one CIDR per entry) absorbs ruleset b (from an INF,
+    any number of CIDRs per entry); same-CIDR entries merge their rule
+    lists, new CIDRs append as singleton entries."""
+    out = list(a)
+    for rule_b in b:
+        for source_cidr in rule_b.source_cidrs:
+            for i, rule_a in enumerate(out):
+                if len(rule_a.source_cidrs) != 1:
+                    raise MergeError(
+                        "cannot merge into ruleset A with invalid SourceCIDRs: "
+                        f"'{rule_a.source_cidrs}'"
+                    )
+                if rule_a.source_cidrs[0] == source_cidr:
+                    out[i] = IngressNodeFirewallRules(
+                        source_cidrs=rule_a.source_cidrs,
+                        rules=merge_firewall_protocol_rules(rule_a.rules, rule_b.rules),
+                    )
+                    break
+            else:
+                out.append(
+                    IngressNodeFirewallRules(
+                        source_cidrs=[source_cidr], rules=list(rule_b.rules)
+                    )
+                )
+    return out
+
+
+@dataclass
+class ReconcileResult:
+    """ctrl.Result: requeue_after is seconds, None = done."""
+
+    requeue_after: Optional[float] = None
+
+
+class IngressNodeFirewallReconciler:
+    """The fan-out controller (the control plane's "train step")."""
+
+    def __init__(self, store: InMemoryStore, namespace: str = "ingress-node-firewall-system"):
+        self.store = store
+        self.namespace = namespace
+
+    def reconcile(self) -> ReconcileResult:
+        """Reconcile (ingressnodefirewall_controller.go:57-201): list
+        current NodeStates, build desired from all INFs × Nodes, then
+        delete stale / update changed (spec, then status separately) /
+        create missing."""
+        current = self.store.list(
+            IngressNodeFirewallNodeState.KIND, namespace=self.namespace
+        )
+        infs = self.store.list(IngressNodeFirewall.KIND)
+        desired = self.build_node_states(infs)
+
+        for node_state in current:
+            name = node_state.metadata.name
+            want = desired.pop(name, None)
+            if want is None:
+                try:
+                    self.store.delete(
+                        IngressNodeFirewallNodeState.KIND, name, self.namespace
+                    )
+                except NotFoundError:
+                    pass
+                continue
+            spec_changed = not semantic_equal(node_state.spec, want.spec)
+            owners_changed = [
+                o.to_dict() for o in node_state.metadata.owner_references
+            ] != [o.to_dict() for o in want.metadata.owner_references]
+            if spec_changed or owners_changed:
+                node_state.spec = want.spec
+                node_state.metadata.owner_references = want.metadata.owner_references
+                self.store.update(node_state)
+            if not semantic_equal(node_state.status, want.status):
+                node_state.status = want.status
+                self.store.update_status(node_state)
+
+        for name, want in desired.items():
+            created = self.store.create(want)
+            created.status = want.status
+            self.store.update_status(created)
+        return ReconcileResult()
+
+    def build_node_states(
+        self, infs: List[IngressNodeFirewall]
+    ) -> Dict[str, IngressNodeFirewallNodeState]:
+        """buildNodeStates (ingressnodefirewall_controller.go:253-365)."""
+        node_states: Dict[str, IngressNodeFirewallNodeState] = {}
+        for inf in infs:
+            nodes = self.store.list(Node.KIND, labels=inf.spec.node_selector)
+            for node in nodes:
+                name = node.metadata.name
+                state = node_states.get(name)
+                if state is None:
+                    state = IngressNodeFirewallNodeState(
+                        metadata=ObjectMeta(name=name, namespace=self.namespace)
+                    )
+
+                # owner-reference accumulation (:291-308)
+                owner = OwnerReference(
+                    api_version=inf.API_VERSION,
+                    kind=inf.KIND,
+                    name=inf.metadata.name,
+                    uid=inf.metadata.uid,
+                )
+                if not any(
+                    o.kind == owner.kind
+                    and o.api_version == owner.api_version
+                    and o.name == owner.name
+                    and o.uid == owner.uid
+                    for o in state.metadata.owner_references
+                ):
+                    state.metadata.owner_references.append(owner)
+
+                # a node already in SyncError is skipped for later INFs (:312-315)
+                if state.status.sync_status == NODE_STATE_SYNC_ERROR:
+                    node_states[name] = state
+                    continue
+                state.status.sync_status = NODE_STATE_SYNC_OK
+                state.status.sync_error_message = ""
+
+                if not inf.spec.interfaces:
+                    state.status = IngressNodeFirewallNodeStateStatus(
+                        sync_status=NODE_STATE_SYNC_ERROR,
+                        sync_error_message=(
+                            "Invalid interface name - cannot provide an empty list"
+                        ),
+                    )
+                    node_states[name] = state
+                    continue
+
+                for iface in inf.spec.interfaces:
+                    existing = state.spec.interface_ingress_rules.setdefault(iface, [])
+                    try:
+                        state.spec.interface_ingress_rules[iface] = merge_rule_set(
+                            existing, inf.spec.ingress
+                        )
+                    except MergeError as e:
+                        state.status = IngressNodeFirewallNodeStateStatus(
+                            sync_status=NODE_STATE_SYNC_ERROR,
+                            sync_error_message=(
+                                f'Illegal ruleset merge operation, err: "{e}"'
+                            ),
+                        )
+                        break
+                node_states[name] = state
+
+            # per-INF SyncStatus rollup (:352-361)
+            inf.status.sync_status = SYNC_STATUS_OK
+            for node in nodes:
+                st = node_states.get(node.metadata.name)
+                if st is not None and st.status.sync_status == NODE_STATE_SYNC_ERROR:
+                    inf.status.sync_status = SYNC_STATUS_ERROR
+                    break
+            try:
+                self.store.update_status(inf)
+            except NotFoundError:
+                log.error("failed to update INF status: %s not found", inf.metadata.name)
+        return node_states
+
+
+class IngressNodeFirewallConfigReconciler:
+    """The daemon deployer (ingressnodefirewallconfig_controller.go)."""
+
+    def __init__(
+        self,
+        store: InMemoryStore,
+        namespace: str = "ingress-node-firewall-system",
+        daemon_image: str = "infw-daemon:latest",
+        backend: str = "tpu",
+        poll_period_s: int = 30,
+        manifest_dir: str = render.MANIFEST_DIR,
+    ):
+        self.store = store
+        self.namespace = namespace
+        self.daemon_image = daemon_image
+        self.backend = backend
+        self.poll_period_s = poll_period_s
+        self.manifest_dir = manifest_dir
+
+    def reconcile(self, name: str) -> ReconcileResult:
+        """Reconcile (ingressnodefirewallconfig_controller.go:71-122)."""
+        try:
+            cfg = self.store.get(IngressNodeFirewallConfig.KIND, name, self.namespace)
+        except NotFoundError:
+            return ReconcileResult()  # deleted; owned objects are GC'd
+        if name != DEFAULT_CONFIG_NAME:
+            log.error("Invalid IngressNode firewall config resource name %r", name)
+            return ReconcileResult()  # success: avoid requeue (:89-92)
+
+        result = ReconcileResult()
+        try:
+            self.sync_config_resources(cfg)
+        except (render.RenderError, OSError) as e:
+            status.update(
+                self.store, cfg, status.CONDITION_DEGRADED,
+                "FailedToSyncIngressNodeFirewallConfigResources", str(e),
+            )
+            return result
+        try:
+            status.is_config_available(self.store, self.namespace)
+        except status.ConfigResourcesNotReadyError as e:
+            result.requeue_after = 5.0
+            status.update(
+                self.store, cfg, status.CONDITION_PROGRESSING, "", str(e)
+            )
+        except NotFoundError as e:
+            status.update(
+                self.store, cfg, status.CONDITION_PROGRESSING, "", str(e)
+            )
+        else:
+            status.update(self.store, cfg, status.CONDITION_AVAILABLE)
+        return result
+
+    def sync_config_resources(self, cfg: IngressNodeFirewallConfig) -> None:
+        """syncIngressNodeFwConfigResources (:130-160): render the daemon
+        manifest with the env contract, overlay the config's nodeSelector,
+        set the controller reference, apply."""
+        data = render.RenderData()
+        data.data["Image"] = self.daemon_image
+        data.data["NameSpace"] = self.namespace
+        data.data["Backend"] = self.backend
+        data.data["PollPeriod"] = self.poll_period_s
+        data.data["Debug"] = (
+            "1" if cfg.spec.debug else "0"
+        )  # ENABLE_LPM_LOOKUP_DBG (:139-144)
+
+        for obj in render.render_dir(self.manifest_dir, data):
+            if obj.KIND != DaemonSet.KIND:
+                continue
+            if cfg.spec.node_selector:
+                obj.spec["nodeSelector"] = dict(cfg.spec.node_selector)
+            obj.metadata.owner_references = [
+                OwnerReference(
+                    api_version=cfg.API_VERSION,
+                    kind=cfg.KIND,
+                    name=cfg.metadata.name,
+                    uid=cfg.metadata.uid,
+                )
+            ]
+            apply_object(self.store, obj)

@@ -1,0 +1,80 @@
+"""Manifest rendering.
+
+Equivalent of the reference's pkg/render
+(/root/reference/pkg/render/render.go, funcs.go): template files under a
+manifest directory are rendered with a data map into typed objects.  The
+reference uses Go text/template + sprig over YAML; here the manifests are
+JSON documents with ``${Var}`` placeholders (string.Template) — the
+``get_or``/``is_set`` helpers mirror funcs.go:9,24.
+
+The daemon descriptor template lives in ``infw/bindata/daemon.json`` (the
+analogue of bindata/manifests/daemon/daemonset.yaml).
+"""
+from __future__ import annotations
+
+import json
+import os
+import string
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .store import _KINDS
+
+MANIFEST_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bindata")
+
+
+class RenderError(ValueError):
+    pass
+
+
+@dataclass
+class RenderData:
+    """MakeRenderData (render.go:24-31)."""
+
+    data: Dict[str, object] = field(default_factory=dict)
+
+
+def get_or(data: RenderData, key: str, default: object) -> object:
+    """getOr template func (funcs.go:9-21)."""
+    v = data.data.get(key)
+    return default if v is None else v
+
+
+def is_set(data: RenderData, key: str) -> bool:
+    """isSet template func (funcs.go:24-31)."""
+    return data.data.get(key) is not None
+
+
+def render_template(text: str, data: RenderData) -> str:
+    """RenderTemplate (render.go:64-86): substitution with a hard error on
+    missing variables (mirroring template.Option("missingkey=error"))."""
+    try:
+        return string.Template(text).substitute(
+            {k: str(v) for k, v in data.data.items()}
+        )
+    except KeyError as e:
+        raise RenderError(f"missing template variable {e.args[0]!r}")
+    except ValueError as e:
+        raise RenderError(f"invalid template: {e}")
+
+
+def render_dir(manifest_dir: str, data: RenderData) -> List[object]:
+    """RenderDir (render.go:33-61): every ``*.json`` file in the directory,
+    rendered and decoded into typed store objects."""
+    objs: List[object] = []
+    for name in sorted(os.listdir(manifest_dir)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(manifest_dir, name)) as f:
+            text = f.read()
+        rendered = render_template(text, data)
+        try:
+            doc = json.loads(rendered)
+        except json.JSONDecodeError as e:
+            raise RenderError(f"failed to decode rendered manifest {name}: {e}")
+        kind = doc.get("kind", "")
+        cls = _KINDS.get(kind)
+        if cls is None:
+            raise RenderError(f"unknown kind {kind!r} in manifest {name}")
+        objs.append(cls.from_dict(doc))
+    return objs

@@ -1,0 +1,82 @@
+"""Config status conditions.
+
+Equivalent of the reference's pkg/status
+(/root/reference/pkg/status/status.go): the Available / Progressing /
+Degraded condition template (:30-40,75-97), the semantic-equality guarded
+status update (:43-55), and the daemon availability probe with its typed
+not-ready error (:19-28,101-111).
+"""
+from __future__ import annotations
+
+import time
+from typing import List
+
+from .spec import Condition, IngressNodeFirewallConfig
+from .store import DaemonSet, InMemoryStore
+
+CONDITION_AVAILABLE = "Available"
+CONDITION_PROGRESSING = "Progressing"
+CONDITION_DEGRADED = "Degraded"
+
+DAEMON_NAME = "ingress-node-firewall-daemon"
+
+
+class ConfigResourcesNotReadyError(RuntimeError):
+    """IngressNodeFirewallConfigResourcesNotReadyError (status.go:19-28)."""
+
+
+def _base_conditions(now: float) -> List[Condition]:
+    return [
+        Condition(type=CONDITION_AVAILABLE, status="False",
+                  reason=CONDITION_AVAILABLE, last_transition_time=now),
+        Condition(type=CONDITION_PROGRESSING, status="False",
+                  reason=CONDITION_PROGRESSING, last_transition_time=now),
+        Condition(type=CONDITION_DEGRADED, status="False",
+                  reason=CONDITION_DEGRADED, last_transition_time=now),
+    ]
+
+
+def get_conditions(condition: str, reason: str, message: str) -> List[Condition]:
+    """getConditions (status.go:59-72)."""
+    conds = _base_conditions(time.time())
+    idx = {CONDITION_AVAILABLE: 0, CONDITION_PROGRESSING: 1, CONDITION_DEGRADED: 2}[
+        condition
+    ]
+    conds[idx].status = "True"
+    if idx > 0:
+        conds[idx].reason = reason or conds[idx].reason
+        conds[idx].message = message
+    return conds
+
+
+def _semantically_equal(a: List[Condition], b: List[Condition]) -> bool:
+    def strip(conds):
+        return [
+            (c.type, c.status, c.reason, c.message) for c in conds
+        ]
+
+    return strip(a) == strip(b)
+
+
+def update(
+    store: InMemoryStore,
+    cfg: IngressNodeFirewallConfig,
+    condition: str,
+    reason: str = "",
+    message: str = "",
+) -> None:
+    """Update (status.go:43-55): skip the write when nothing changed
+    (modulo transition timestamps)."""
+    conditions = get_conditions(condition, reason, message)
+    if not _semantically_equal(conditions, cfg.status.conditions):
+        cfg.status.conditions = conditions
+        store.update_status(cfg)
+
+
+def is_config_available(store: InMemoryStore, namespace: str) -> None:
+    """IsIngressNodeFirewallConfigAvailable (status.go:101-111): raises
+    NotFoundError if the daemon deployment is absent,
+    ConfigResourcesNotReadyError while pods are still coming up."""
+    ds: DaemonSet = store.get(DaemonSet.KIND, DAEMON_NAME, namespace)
+    if ds.status.desired_number_scheduled != ds.status.number_ready:
+        raise ConfigResourcesNotReadyError("IngressNodeFirewall daemon not ready")

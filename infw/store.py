@@ -1,0 +1,299 @@
+"""Pluggable resource store — the control plane's "cluster API".
+
+The reference's manager and daemon communicate exclusively through the
+Kubernetes API (SURVEY.md §1): controllers List/Get/Create/Update/Delete
+typed objects and react to watch events.  This module provides that
+surface as an in-memory, thread-safe store with watch callbacks — the
+default backend for tests and single-host deployments (the role envtest
+plays for the reference's controller suite,
+/root/reference/controllers/suite_test.go:66-137).  A networked adapter
+can implement the same Store protocol later without touching the
+controllers.
+
+Semantics preserved from the k8s client:
+- objects are copied on write and on read (no aliasing mutations);
+- ``update_status`` writes only the status subresource
+  (r.Status().Update, ingressnodefirewall_controller.go:141-147);
+- deletes of finalized objects set ``deletion_timestamp`` and wait for
+  finalizer removal (the NodeState finalizer dance,
+  ingressnodefirewallnodestate_controller.go:77-99);
+- every write bumps ``resource_version`` and fans out a watch event.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+    IngressNodeFirewallNodeState,
+    ObjectMeta,
+    deep_copy,
+)
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class NotFoundError(StoreError):
+    pass
+
+
+class AlreadyExistsError(StoreError):
+    pass
+
+
+@dataclass
+class Node:
+    """Minimal cluster Node: metadata only (the fan-out controller matches
+    on labels, ingressnodefirewall_controller.go:269-275)."""
+
+    KIND = "Node"
+    API_VERSION = "v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.KIND, "metadata": self.metadata.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata", {}) or {}))
+
+
+@dataclass
+class DaemonSetStatus:
+    """The readiness fields the availability probe consumes
+    (pkg/status/status.go:101-111)."""
+
+    desired_number_scheduled: int = 0
+    number_ready: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "desiredNumberScheduled": self.desired_number_scheduled,
+            "numberReady": self.number_ready,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DaemonSetStatus":
+        return cls(
+            desired_number_scheduled=int(d.get("desiredNumberScheduled", 0)),
+            number_ready=int(d.get("numberReady", 0)),
+        )
+
+
+@dataclass
+class DaemonSet:
+    """The rendered per-node daemon deployment descriptor — what the
+    reference's DaemonSet manifest is to kubelet
+    (bindata/manifests/daemon/daemonset.yaml), reduced to the fields that
+    drive TPU daemon processes: selector, image, env contract."""
+
+    KIND = "DaemonSet"
+    API_VERSION = "apps/v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict = field(default_factory=dict)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec,
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DaemonSet":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata", {}) or {}),
+            spec=dict(d.get("spec", {}) or {}),
+            status=DaemonSetStatus.from_dict(d.get("status", {}) or {}),
+        )
+
+
+_KINDS = {
+    IngressNodeFirewall.KIND: IngressNodeFirewall,
+    IngressNodeFirewallConfig.KIND: IngressNodeFirewallConfig,
+    IngressNodeFirewallNodeState.KIND: IngressNodeFirewallNodeState,
+    Node.KIND: Node,
+    DaemonSet.KIND: DaemonSet,
+}
+
+# watch event types
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchCallback = Callable[[str, object], None]
+
+
+def _copy(obj):
+    return obj.__class__.from_dict(obj.to_dict())
+
+
+class InMemoryStore:
+    """Thread-safe object store with watches."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], object] = {}
+        self._watchers: Dict[str, List[WatchCallback]] = {}
+        self._rv = 0
+
+    # -- keys ----------------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
+        return (kind, namespace or "", name)
+
+    def _key_of(self, obj) -> Tuple[str, str, str]:
+        return self._key(obj.KIND, obj.metadata.namespace, obj.metadata.name)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, kind: str, name: str, namespace: str = ""):
+        with self._lock:
+            obj = self._objects.get(self._key(kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return _copy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> List[object]:
+        """List with optional namespace scoping and MatchingLabels
+        selection (client.MatchingLabels semantics: empty selector matches
+        everything)."""
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != (namespace or ""):
+                    continue
+                if labels:
+                    meta = obj.metadata
+                    if any(meta.labels.get(lk) != lv for lk, lv in labels.items()):
+                        continue
+                out.append(_copy(obj))
+            return out
+
+    # -- writes --------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        with self._lock:
+            key = self._key_of(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            stored = _copy(obj)
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            if not stored.metadata.uid:
+                stored.metadata.uid = f"uid-{self._rv}"
+            self._objects[key] = stored
+            self._notify(ADDED, stored)
+            return _copy(stored)
+
+    def update(self, obj) -> object:
+        """Full-object update (spec + metadata); the status subresource is
+        carried over from the stored object, mirroring the API server's
+        split."""
+        with self._lock:
+            key = self._key_of(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            stored = _copy(obj)
+            if hasattr(cur, "status"):
+                stored.status = deep_copy(cur.status) if hasattr(cur.status, "to_dict") else cur.status
+            stored.metadata.uid = cur.metadata.uid
+            stored.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            self._objects[key] = stored
+            self._notify(MODIFIED, stored)
+            return _copy(stored)
+
+    def update_status(self, obj) -> object:
+        with self._lock:
+            key = self._key_of(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            stored = _copy(cur)
+            stored.status = (
+                deep_copy(obj.status) if hasattr(obj.status, "to_dict") else obj.status
+            )
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            self._objects[key] = stored
+            self._notify(MODIFIED, stored)
+            return _copy(stored)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        """Finalizer-aware delete: objects with finalizers get a deletion
+        timestamp and remain until the finalizers are removed via
+        update_finalizers."""
+        with self._lock:
+            key = self._key(kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            if cur.metadata.finalizers:
+                if cur.metadata.deletion_timestamp is None:
+                    cur.metadata.deletion_timestamp = time.time()
+                    self._rv += 1
+                    cur.metadata.resource_version = self._rv
+                    self._notify(MODIFIED, cur)
+                return
+            del self._objects[key]
+            self._notify(DELETED, cur)
+
+    def update_finalizers(self, obj, finalizers: List[str]) -> object:
+        """Set the finalizer list; an object past its deletion timestamp
+        with no finalizers left is removed (API-server GC behavior the
+        NodeState controller's finalizer dance relies on)."""
+        with self._lock:
+            key = self._key_of(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFoundError(f"{key} not found")
+            cur.metadata.finalizers = list(finalizers)
+            self._rv += 1
+            cur.metadata.resource_version = self._rv
+            if cur.metadata.deletion_timestamp is not None and not cur.metadata.finalizers:
+                del self._objects[key]
+                self._notify(DELETED, cur)
+            else:
+                self._notify(MODIFIED, cur)
+            return _copy(cur)
+
+    # -- watches -------------------------------------------------------------
+
+    def watch(self, kind: str, callback: WatchCallback) -> Callable[[], None]:
+        """Subscribe to events for a kind; returns an unsubscribe thunk."""
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(callback)
+
+        def cancel() -> None:
+            with self._lock:
+                try:
+                    self._watchers.get(kind, []).remove(callback)
+                except ValueError:
+                    pass
+
+        return cancel
+
+    def _notify(self, event: str, obj) -> None:
+        for cb in list(self._watchers.get(obj.KIND, [])):
+            cb(event, _copy(obj))

@@ -215,7 +215,14 @@ class DataplaneSyncer:
             tables, attached = ck
             self._classifier.load_tables(tables)
             self._content = dict(tables.content)
+            valid = (
+                self._is_valid_interface
+                or self._registry.is_valid_interface_name_and_state
+            )
             for name in attached:
+                if not valid(name):
+                    log.warning("re-adopt: interface %s no longer valid", name)
+                    continue
                 try:
                     self._attach(name)
                 except (SyncError, interfaces_mod.InterfaceError):
@@ -257,14 +264,15 @@ class DataplaneSyncer:
                 log.error("fail to attach ingress firewall prog to interface %s: invalid state", name)
                 continue
             last: Optional[Exception] = None
-            for _ in range(XDP_EBUSY_MAX_RETRIES):
+            for attempt in range(XDP_EBUSY_MAX_RETRIES):
                 try:
                     self._attach(name)
                     last = None
                     break
                 except AttachBusyError as e:
                     last = e
-                    time.sleep(self._ebusy_interval)
+                    if attempt < XDP_EBUSY_MAX_RETRIES - 1:
+                        time.sleep(self._ebusy_interval)
             if last is not None:
                 raise SyncError(f"failed to attach interface {name}: {last}")
 
@@ -276,9 +284,16 @@ class DataplaneSyncer:
         reload the device tables only when the content changed, then pin."""
         valid = self._is_valid_interface or self._registry.is_valid_interface_name_and_state
         width = self._desired_width(iface_ingress_rules)
-        desired = build_table_content(
+        raw = build_table_content(
             iface_ingress_rules, self._registry, width, is_valid_interface=valid
         )
+        # Collapse keys that alias after masking (last writer wins), exactly
+        # like successive Map.Update calls on the kernel LPM trie — the diff
+        # below and the test-content API must see what the device enforces.
+        dedup = {}
+        for k, v in raw.items():
+            dedup[k.masked_identity()] = (k, v)
+        desired = {k: v for k, v in dedup.values()}
         stale = self._get_stale_keys(desired)
         current = {k.masked_identity(): v for k, v in self._content.items()}
         changed = bool(stale) or any(
@@ -350,7 +365,7 @@ class DataplaneSyncer:
         tmp = tables_path + ".tmp.npz"
         tables.save(tmp)
         os.replace(tmp, tables_path)
-        self._save_manifest()
+        # manifest is written by the sync-level _save_manifest() call
 
     def _save_manifest(self) -> None:
         paths = self._ck_paths()

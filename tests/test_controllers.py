@@ -1,0 +1,307 @@
+"""Controller tests — the port of the reference's envtest suites
+(/root/reference/controllers/ingressnodefirewall_controller_test.go and
+ingressnodefirewall_controller_rules_test.go): the in-memory Store plays
+the API server, reconcile() is driven directly (what envtest's watch loop
+does), and the merge matrix covers multi-INF overlap incl. duplicate-order
+SyncError expectations.
+"""
+import pytest
+
+from infw.controllers import (
+    DEFAULT_CONFIG_NAME,
+    IngressNodeFirewallConfigReconciler,
+    IngressNodeFirewallReconciler,
+    MergeError,
+    merge_firewall_protocol_rules,
+    merge_rule_set,
+)
+from infw.spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+    IngressNodeFirewallConfigSpec,
+    IngressNodeFirewallNodeState,
+    IngressNodeFirewallRules,
+    IngressNodeFirewallSpec,
+    NODE_STATE_SYNC_ERROR,
+    NODE_STATE_SYNC_OK,
+    ObjectMeta,
+    SYNC_STATUS_ERROR,
+    SYNC_STATUS_OK,
+)
+from infw.store import DaemonSet, DaemonSetStatus, InMemoryStore, Node, NotFoundError
+from test_syncer import catchall_rule, ingress, tcp_rule, udp_rule
+
+NS = "ingress-node-firewall-system"
+
+
+def node(name, labels):
+    return Node(metadata=ObjectMeta(name=name, labels=labels))
+
+
+def inf(name, selector, ingress_rules, interfaces=("eth0",)):
+    return IngressNodeFirewall(
+        metadata=ObjectMeta(name=name),
+        spec=IngressNodeFirewallSpec(
+            node_selector=dict(selector),
+            ingress=list(ingress_rules),
+            interfaces=list(interfaces),
+        ),
+    )
+
+
+@pytest.fixture
+def store():
+    return InMemoryStore()
+
+
+@pytest.fixture
+def reconciler(store):
+    return IngressNodeFirewallReconciler(store, namespace=NS)
+
+
+WORKER = {"node-role.kubernetes.io/worker": ""}
+
+
+# --- fan-out lifecycle (ingressnodefirewall_controller_test.go:115-289) -------
+
+def test_fanout_creates_nodestate_per_matching_node(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(node("worker-1", WORKER))
+    store.create(node("cp-0", {"node-role.kubernetes.io/control-plane": ""}))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+
+    reconciler.reconcile()
+    states = store.list(IngressNodeFirewallNodeState.KIND, namespace=NS)
+    assert sorted(s.metadata.name for s in states) == ["worker-0", "worker-1"]
+    for s in states:
+        assert s.status.sync_status == NODE_STATE_SYNC_OK
+        assert set(s.spec.interface_ingress_rules) == {"eth0"}
+        assert s.metadata.owner_references[0].name == "fw1"
+    assert store.get(IngressNodeFirewall.KIND, "fw1").status.sync_status == SYNC_STATUS_OK
+
+
+def test_fanout_node_label_move_and_delete(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    reconciler.reconcile()
+    assert len(store.list(IngressNodeFirewallNodeState.KIND, namespace=NS)) == 1
+
+    # label move: node no longer matches -> NodeState deleted
+    n = store.get(Node.KIND, "worker-0")
+    n.metadata.labels = {"other": ""}
+    store.update(n)
+    reconciler.reconcile()
+    assert store.list(IngressNodeFirewallNodeState.KIND, namespace=NS) == []
+
+    # label back -> recreated
+    n.metadata.labels = dict(WORKER)
+    store.update(n)
+    reconciler.reconcile()
+    assert len(store.list(IngressNodeFirewallNodeState.KIND, namespace=NS)) == 1
+
+    # INF deleted -> NodeState deleted
+    store.delete(IngressNodeFirewall.KIND, "fw1")
+    reconciler.reconcile()
+    assert store.list(IngressNodeFirewallNodeState.KIND, namespace=NS) == []
+
+
+def test_fanout_empty_interfaces_is_sync_error(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(
+        inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])],
+            interfaces=())
+    )
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    assert s.status.sync_status == NODE_STATE_SYNC_ERROR
+    assert "empty list" in s.status.sync_error_message
+    assert store.get(IngressNodeFirewall.KIND, "fw1").status.sync_status == SYNC_STATUS_ERROR
+
+
+def test_fanout_spec_update_propagates(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    fw = inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])])
+    store.create(fw)
+    reconciler.reconcile()
+
+    fw.spec.ingress = [ingress(["10.0.0.0/8"], [tcp_rule(1, 443, ACTION_DENY)])]
+    store.update(fw)
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    [entry] = s.spec.interface_ingress_rules["eth0"]
+    assert entry.rules[0].protocol_config.tcp.ports == 443
+
+
+# --- multi-INF merge matrix (controller_rules_test.go:60+) --------------------
+
+def test_merge_two_infs_distinct_cidrs(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    store.create(inf("fw2", WORKER, [ingress(["172.16.0.0/12"], [tcp_rule(1, 22, ACTION_DENY)])]))
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    entries = s.spec.interface_ingress_rules["eth0"]
+    assert sorted(e.source_cidrs[0] for e in entries) == ["10.0.0.0/8", "172.16.0.0/12"]
+    assert {o.name for o in s.metadata.owner_references} == {"fw1", "fw2"}
+
+
+def test_merge_same_cidr_disjoint_orders(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    store.create(inf("fw2", WORKER, [ingress(["10.0.0.0/8"], [udp_rule(2, 53, ACTION_ALLOW)])]))
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    [entry] = s.spec.interface_ingress_rules["eth0"]
+    assert sorted(r.order for r in entry.rules) == [1, 2]
+    assert s.status.sync_status == NODE_STATE_SYNC_OK
+
+
+def test_merge_same_cidr_duplicate_order_is_sync_error(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    store.create(inf("fw2", WORKER, [ingress(["10.0.0.0/8"], [udp_rule(1, 53, ACTION_ALLOW)])]))
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    assert s.status.sync_status == NODE_STATE_SYNC_ERROR
+    assert "duplicate order 1" in s.status.sync_error_message
+    # Rollup follows INF processing order (buildNodeStates:352-361): fw1
+    # completed its merge before fw2 introduced the conflict, so only fw2
+    # reports Error on this pass.
+    assert store.get(IngressNodeFirewall.KIND, "fw1").status.sync_status == SYNC_STATUS_OK
+    assert store.get(IngressNodeFirewall.KIND, "fw2").status.sync_status == SYNC_STATUS_ERROR
+
+
+def test_merge_error_node_does_not_poison_other_nodes(store, reconciler):
+    """Only the conflicted node goes SyncError; a node matched by just one
+    of the INFs still syncs fine."""
+    store.create(node("worker-0", WORKER))
+    store.create(node("special-0", {"special": ""}))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    store.create(inf("fw2", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 22, ACTION_DENY)])]))
+    store.create(inf("fw3", {"special": ""}, [ingress(["10.0.0.0/8"], [tcp_rule(1, 22, ACTION_DENY)])]))
+    reconciler.reconcile()
+    assert (
+        store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS).status.sync_status
+        == NODE_STATE_SYNC_ERROR
+    )
+    assert (
+        store.get(IngressNodeFirewallNodeState.KIND, "special-0", NS).status.sync_status
+        == NODE_STATE_SYNC_OK
+    )
+    assert store.get(IngressNodeFirewall.KIND, "fw3").status.sync_status == SYNC_STATUS_OK
+
+
+def test_merge_multi_cidr_inf_expands_to_singleton_entries(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(
+        inf("fw1", WORKER,
+            [ingress(["10.0.0.0/8", "192.168.0.0/16"], [tcp_rule(1, 80, ACTION_DENY)])])
+    )
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    entries = s.spec.interface_ingress_rules["eth0"]
+    assert all(len(e.source_cidrs) == 1 for e in entries)
+    assert sorted(e.source_cidrs[0] for e in entries) == ["10.0.0.0/8", "192.168.0.0/16"]
+
+
+def test_merge_multiple_interfaces(store, reconciler):
+    store.create(node("worker-0", WORKER))
+    store.create(
+        inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])],
+            interfaces=("eth0", "eth1"))
+    )
+    reconciler.reconcile()
+    s = store.get(IngressNodeFirewallNodeState.KIND, "worker-0", NS)
+    assert set(s.spec.interface_ingress_rules) == {"eth0", "eth1"}
+
+
+# --- merge unit behavior (mergeRuleSet/mergeFirewallProtocolRules) ------------
+
+def test_merge_rule_set_invalid_a():
+    bad_a = [ingress(["1.0.0.0/8", "2.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]
+    with pytest.raises(MergeError, match="invalid SourceCIDRs"):
+        merge_rule_set(bad_a, [ingress(["1.0.0.0/8"], [tcp_rule(2, 2, ACTION_DENY)])])
+
+
+def test_merge_protocol_rules_duplicate_in_a():
+    a = [tcp_rule(1, 1, ACTION_DENY), tcp_rule(1, 2, ACTION_DENY)]
+    with pytest.raises(MergeError, match="rules in A"):
+        merge_firewall_protocol_rules(a, [])
+
+
+def test_merge_protocol_rules_duplicate_within_b():
+    with pytest.raises(MergeError, match="rules in B"):
+        merge_firewall_protocol_rules(
+            [], [tcp_rule(3, 1, ACTION_DENY), tcp_rule(3, 2, ACTION_DENY)]
+        )
+
+
+# --- config controller (ingressnodefirewallconfig_controller.go) --------------
+
+def cfg_obj(name=DEFAULT_CONFIG_NAME, debug=None, selector=None):
+    return IngressNodeFirewallConfig(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=IngressNodeFirewallConfigSpec(
+            node_selector=dict(selector or {}), debug=debug
+        ),
+    )
+
+
+def conds(cfg):
+    return {c.type: c.status for c in cfg.status.conditions}
+
+
+def test_config_renders_daemonset_and_progresses(store):
+    r = IngressNodeFirewallConfigReconciler(store, namespace=NS, daemon_image="img:1")
+    store.create(cfg_obj(debug=True, selector={"tpu": "v5e"}))
+    res = r.reconcile(DEFAULT_CONFIG_NAME)
+
+    ds = store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS)
+    assert ds.spec["image"] == "img:1"
+    assert ds.spec["env"]["ENABLE_LPM_LOOKUP_DBG"] == "1"
+    assert ds.spec["env"]["NAMESPACE"] == NS
+    assert ds.spec["nodeSelector"] == {"tpu": "v5e"}
+    assert ds.metadata.owner_references[0].name == DEFAULT_CONFIG_NAME
+
+    # daemon not ready yet -> Progressing + 5s requeue
+    ds.status = DaemonSetStatus(desired_number_scheduled=2, number_ready=1)
+    store.update_status(ds)
+    res = r.reconcile(DEFAULT_CONFIG_NAME)
+    assert res.requeue_after == 5.0
+    cfg = store.get(IngressNodeFirewallConfig.KIND, DEFAULT_CONFIG_NAME, NS)
+    assert conds(cfg)["Progressing"] == "True"
+    assert conds(cfg)["Available"] == "False"
+
+    # daemon ready -> Available
+    ds.status = DaemonSetStatus(desired_number_scheduled=2, number_ready=2)
+    store.update_status(ds)
+    res = r.reconcile(DEFAULT_CONFIG_NAME)
+    assert res.requeue_after is None
+    cfg = store.get(IngressNodeFirewallConfig.KIND, DEFAULT_CONFIG_NAME, NS)
+    assert conds(cfg)["Available"] == "True"
+
+
+def test_config_singleton_name_enforced(store):
+    r = IngressNodeFirewallConfigReconciler(store, namespace=NS)
+    store.create(cfg_obj(name="wrong-name"))
+    res = r.reconcile("wrong-name")
+    assert res.requeue_after is None
+    with pytest.raises(NotFoundError):
+        store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS)
+
+
+def test_config_apply_idempotent(store):
+    r = IngressNodeFirewallConfigReconciler(store, namespace=NS)
+    store.create(cfg_obj())
+    r.reconcile(DEFAULT_CONFIG_NAME)
+    rv1 = store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS).metadata.resource_version
+    r.reconcile(DEFAULT_CONFIG_NAME)
+    rv2 = store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS).metadata.resource_version
+    assert rv1 == rv2  # unchanged render does not rewrite the object
+
+
+def test_config_missing_is_noop(store):
+    r = IngressNodeFirewallConfigReconciler(store, namespace=NS)
+    assert r.reconcile(DEFAULT_CONFIG_NAME).requeue_after is None

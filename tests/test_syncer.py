@@ -391,6 +391,46 @@ def test_restart_readoption(make_syncer, registry, tmp_path):
     assert before == after == [XDP_DROP]
 
 
+def test_resync_idempotent_with_aliasing_cidrs(make_syncer):
+    """CIDRs that collapse after masking (10.0.0.0/8 vs 10.1.0.0/8) must
+    still diff as unchanged across identical syncs, and the test-content
+    API must report the entry the device actually enforces (last writer
+    wins, kernel map-update semantics)."""
+    s = make_syncer()
+    rules = {
+        "dummy0": [
+            ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)]),
+            ingress(["10.1.0.0/8"], [tcp_rule(1, 443, ACTION_DENY)]),
+        ]
+    }
+    s.sync_interface_ingress_rules(rules, False)
+    s.sync_interface_ingress_rules(rules, False)
+    s.sync_interface_ingress_rules(rules, False)
+    assert s.classifier.load_count == 1
+
+    content = s.get_classifier_map_content_for_test()
+    assert len(content) == 1
+    [rows] = content.values()
+    assert rows[1, 2] == 443  # last writer won
+    got = verdicts(s, src=["10.2.3.4"] * 2, proto=[6, 6], dport=[80, 443], ifidx=[IF0] * 2)
+    assert got == [XDP_PASS, XDP_DROP]
+
+
+def test_restart_readoption_skips_down_interface(make_syncer, registry, tmp_path):
+    """An interface that went down while the daemon was dead must not be
+    re-attached on restart (matches the attach-path validity check)."""
+    rules = {"dummy0": [ingress(["192.0.2.0/30"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s = make_syncer()
+    s.sync_interface_ingress_rules(rules, False)
+    s.shutdown()
+    registry.get("dummy0").up = False
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules({}, False)
+    assert s2.attached_interfaces() == set()
+    assert not registry.get("dummy0").xdp_attached
+
+
 def test_restart_readoption_interface_gone(make_syncer, registry, tmp_path):
     """A checkpointed interface that vanished before restart is skipped
     with a warning, not a sync failure."""
