@@ -1,0 +1,440 @@
+"""The per-node daemon: watch desired state, program the dataplane,
+classify ingest traffic, serve metrics, stream deny events.
+
+Equivalent of the reference daemon binary
+(/root/reference/cmd/daemon/daemon.go): env contract NODE_NAME /
+NAMESPACE / POLL_PERIOD_SECONDS / ENABLE_LPM_LOOKUP_DBG (:69-84),
+loopback-bound metrics + health endpoints (:57-58, ports 39301/39300),
+namespace-scoped state watching (:91-95), wiring of the NodeState
+controller and the statistics poller (:96-130).
+
+TPU-native deltas:
+- ``--backend tpu|cpu`` selects the classifier (Pallas/XLA device path vs
+  the native C++ reference) behind the same syncer boundary.
+- Desired state arrives either through an in-process Store watch or a
+  **state directory** (``<state-dir>/nodestates/<node>.json``) so external
+  controllers/tools can drive a running daemon exactly like applying a CR;
+  file deletion = CR deletion.
+- Packet ingest is file-based replay: drop a frames file (see
+  ``write_frames_file``) into ``<state-dir>/ingest/``; verdict summaries
+  land in ``<state-dir>/out/``; deny events stream to the event log (the
+  role of the syslog sidecar, cmd/syslog/syslog.go).
+- ``ENABLE_LPM_LOOKUP_DBG`` fills a bounded in-memory key buffer served at
+  ``/debug/lookup-keys`` — the analogue of the 16384-entry debug hash map
+  (bpf/ingress_node_firewall_kernel.c:59-64,214-216) inspectable with
+  bpftool.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import struct
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .backend.base import Classifier
+from .interfaces import InterfaceRegistry, default_registry
+from .nodestate_controller import NodeStateReconciler
+from .obs.events import EventRing, EventsLogger, emit_deny_events
+from .obs.pcap import parse_frames
+from .obs.statistics import Statistics
+from .packets import PacketBatch
+from .spec import IngressNodeFirewallNodeState
+from .store import InMemoryStore
+from .syncer import DataplaneSyncer, SyncError
+
+log = logging.getLogger("infw.daemon")
+
+DEFAULT_METRICS_PORT = 39301   # cmd/daemon/daemon.go:57
+DEFAULT_HEALTH_PORT = 39300    # cmd/daemon/daemon.go:58
+DEBUG_MAP_ENTRIES = 16384      # kernel.c:63 debug map max_entries
+
+_FRAMES_MAGIC = b"INFW1\n"
+
+
+# --- frames-file replay format ----------------------------------------------
+
+def write_frames_file(path: str, frames: Sequence[bytes], ifindex) -> None:
+    """Length-prefixed raw-frame container for ingest replay: per record a
+    u32 ingress ifindex + u32 length + frame bytes."""
+    if np.isscalar(ifindex):
+        ifindex = [int(ifindex)] * len(frames)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_FRAMES_MAGIC)
+        f.write(struct.pack("<I", len(frames)))
+        for idx, frame in zip(ifindex, frames):
+            f.write(struct.pack("<II", int(idx), len(frame)))
+            f.write(frame)
+    os.replace(tmp, path)
+
+
+def read_frames_file(path: str) -> Tuple[List[bytes], List[int]]:
+    with open(path, "rb") as f:
+        magic = f.read(len(_FRAMES_MAGIC))
+        if magic != _FRAMES_MAGIC:
+            raise ValueError(f"{path}: not an infw frames file")
+        (count,) = struct.unpack("<I", f.read(4))
+        frames, ifindexes = [], []
+        for _ in range(count):
+            idx, length = struct.unpack("<II", f.read(8))
+            frames.append(f.read(length))
+            ifindexes.append(idx)
+    return frames, ifindexes
+
+
+# --- debug lookup buffer (ENABLE_LPM_LOOKUP_DBG) -----------------------------
+
+class DebugLookupBuffer:
+    """Bounded record of the LPM lookup keys the dataplane constructed —
+    the debug hash map (kernel.c:59-64) re-expressed host-side.  Keys are
+    (ifindex, ip_words) per classified packet; capacity-bounded with
+    overwrite of the oldest (the kernel map just stops inserting; a ring
+    is strictly more useful and still bounded)."""
+
+    def __init__(self, capacity: int = DEBUG_MAP_ENTRIES) -> None:
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+
+    def record_batch(self, batch: PacketBatch) -> None:
+        ifx = np.asarray(batch.ifindex)
+        words = np.asarray(batch.ip_words)
+        with self._lock:
+            for i in range(len(ifx)):
+                self._buf.append((int(ifx[i]), tuple(int(w) for w in words[i])))
+
+    def snapshot(self) -> List[Tuple[int, Tuple[int, int, int, int]]]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+# --- classifier factories ----------------------------------------------------
+
+def make_classifier_factory(backend: str):
+    if backend == "cpu":
+        from .backend.cpu_ref import CpuRefClassifier
+
+        return CpuRefClassifier
+    if backend == "tpu":
+        from .backend.tpu import TpuClassifier
+
+        return TpuClassifier
+    raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
+
+
+# --- daemon ------------------------------------------------------------------
+
+class Daemon:
+    def __init__(
+        self,
+        state_dir: str,
+        node_name: str,
+        namespace: str = "ingress-node-firewall-system",
+        backend: str = "cpu",
+        poll_period_s: float = 30.0,
+        debug_lookup: bool = False,
+        registry: Optional[InterfaceRegistry] = None,
+        store: Optional[InMemoryStore] = None,
+        metrics_port: int = DEFAULT_METRICS_PORT,
+        health_port: int = DEFAULT_HEALTH_PORT,
+        file_poll_interval_s: float = 0.2,
+        event_sink=None,
+    ) -> None:
+        self.state_dir = state_dir
+        self.node_name = node_name
+        self.namespace = namespace
+        self.backend = backend
+        self.debug_lookup = debug_lookup
+        self.file_poll_interval_s = file_poll_interval_s
+        self.registry = registry if registry is not None else default_registry
+
+        self.nodestates_dir = os.path.join(state_dir, "nodestates")
+        self.ingest_dir = os.path.join(state_dir, "ingest")
+        self.out_dir = os.path.join(state_dir, "out")
+        self.events_path = os.path.join(state_dir, "events.log")
+        for d in (self.nodestates_dir, self.ingest_dir, self.out_dir):
+            os.makedirs(d, exist_ok=True)
+
+        self.stats = Statistics(poll_period_s=poll_period_s)
+        self.stats.register()
+        self.syncer = DataplaneSyncer(
+            classifier_factory=make_classifier_factory(backend),
+            registry=self.registry,
+            stats_poller=self.stats,
+            checkpoint_dir=os.path.join(state_dir, "checkpoint"),
+        )
+        self.store = store if store is not None else InMemoryStore()
+        self.reconciler = NodeStateReconciler(
+            self.store, self.syncer, node_name=node_name, namespace=namespace
+        )
+        self.store.watch(IngressNodeFirewallNodeState.KIND, self._on_store_event)
+
+        self.ring = EventRing()
+        self._event_file = open(self.events_path, "a", buffering=1)
+        sink = event_sink if event_sink is not None else self._write_event_line
+        self.events_logger = EventsLogger(
+            self.ring,
+            sink,
+            iface_names={i.index: i.name for i in self.registry.list()},
+        )
+        self.debug_buffer = DebugLookupBuffer()
+
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._servers: List[ThreadingHTTPServer] = []
+        self._known_state_files: Dict[str, float] = {}
+        self.metrics_port = metrics_port
+        self.health_port = health_port
+
+    # -- event sink ----------------------------------------------------------
+
+    def _write_event_line(self, line: str) -> None:
+        self._event_file.write(line + "\n")
+
+    # -- store-driven reconcile ----------------------------------------------
+
+    def _on_store_event(self, event: str, obj) -> None:
+        try:
+            if event == "DELETED":
+                if (
+                    obj.metadata.name == self.node_name
+                    and obj.metadata.namespace == self.namespace
+                ):
+                    # finalizer path already synced the delete; nothing to do
+                    return
+            self.reconciler.reconcile(obj.metadata.name, obj.metadata.namespace)
+        except SyncError as e:
+            log.error("reconcile failed: %s", e)
+
+    # -- file-driven desired state -------------------------------------------
+
+    def scan_nodestates_once(self) -> None:
+        """State-dir protocol: <nodestates>/<node-name>.json holds the
+        NodeState CR dict; file deletion is CR deletion."""
+        seen = {}
+        for fn in os.listdir(self.nodestates_dir):
+            if not fn.endswith(".json"):
+                continue
+            path = os.path.join(self.nodestates_dir, fn)
+            try:
+                mtime = os.path.getmtime(path)
+            except FileNotFoundError:
+                continue
+            seen[fn] = mtime
+            if self._known_state_files.get(fn) == mtime:
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                log.error("bad nodestate file %s: %s", fn, e)
+                continue
+            ns_obj = IngressNodeFirewallNodeState.from_dict(doc)
+            if not ns_obj.metadata.name:
+                ns_obj.metadata.name = fn[: -len(".json")]
+            if not ns_obj.metadata.namespace:
+                ns_obj.metadata.namespace = self.namespace
+            if ns_obj.metadata.name != self.node_name:
+                continue
+            try:
+                self.syncer.sync_interface_ingress_rules(
+                    ns_obj.spec.interface_ingress_rules, False
+                )
+                self._known_state_files[fn] = mtime
+            except SyncError as e:
+                log.error("sync failed for %s: %s", fn, e)
+        for fn in list(self._known_state_files):
+            if fn not in seen:
+                del self._known_state_files[fn]
+                try:
+                    self.syncer.sync_interface_ingress_rules({}, True)
+                except SyncError as e:
+                    log.error("delete sync failed for %s: %s", fn, e)
+
+    # -- ingest --------------------------------------------------------------
+
+    def process_ingest_once(self) -> int:
+        """Classify every frames file in the ingest dir; write verdict
+        summaries to out/; emit deny events; consume the file."""
+        processed = 0
+        if self.syncer.classifier is None or self.syncer.classifier.tables is None:
+            return 0
+        for fn in sorted(os.listdir(self.ingest_dir)):
+            path = os.path.join(self.ingest_dir, fn)
+            if fn.endswith(".tmp") or not os.path.isfile(path):
+                continue
+            try:
+                frames, ifindexes = read_frames_file(path)
+            except (OSError, ValueError, struct.error) as e:
+                log.error("bad ingest file %s: %s", fn, e)
+                os.remove(path)
+                continue
+            batch = parse_frames(frames, ifindexes)
+            out = self.syncer.classifier.classify(batch)
+            if self.debug_lookup:
+                self.debug_buffer.record_batch(batch)
+            emit_deny_events(
+                self.ring, out.results, batch.ifindex, batch.pkt_len, frames
+            )
+            xdp = np.asarray(out.xdp)
+            summary = {
+                "file": fn,
+                "packets": len(frames),
+                "pass": int((xdp == 2).sum()),
+                "drop": int((xdp == 1).sum()),
+                "results": [int(r) for r in np.asarray(out.results)],
+            }
+            with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
+                json.dump(summary, f)
+            os.remove(path)
+            processed += 1
+        return processed
+
+    # -- HTTP endpoints ------------------------------------------------------
+
+    def _make_handler(daemon_self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype="text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, daemon_self.stats.render_prometheus_text())
+                elif self.path in ("/healthz", "/readyz"):
+                    self._send(200, "ok")
+                elif self.path == "/debug/lookup-keys":
+                    keys = daemon_self.debug_buffer.snapshot()
+                    self._send(
+                        200,
+                        json.dumps(
+                            [{"ifindex": k[0], "ip_words": list(k[1])} for k in keys]
+                        ),
+                        ctype="application/json",
+                    )
+                else:
+                    self._send(404, "not found")
+
+        return Handler
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        handler = self._make_handler()
+        for port in {self.metrics_port, self.health_port}:
+            srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+            self._servers.append(srv)
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.events_logger.start()
+        t = threading.Thread(target=self._file_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info(
+            "daemon started node=%s backend=%s metrics=127.0.0.1:%d",
+            self.node_name, self.backend, self.metrics_port,
+        )
+
+    def _file_loop(self) -> None:
+        while not self._stop.wait(self.file_poll_interval_s):
+            try:
+                self.scan_nodestates_once()
+                self.process_ingest_once()
+            except Exception as e:  # keep the loop alive
+                log.error("daemon loop error: %s", e)
+
+    def stop(self) -> None:
+        """SIGTERM path: stop polling/serving, detach the dataplane but
+        keep the checkpoint (ebpfsyncer.go:90-97 — rules keep enforcing
+        across daemon restarts via the pinned state)."""
+        self._stop.set()
+        for srv in self._servers:
+            srv.shutdown()
+        self.events_logger.stop()
+        self.stats.stop_poll()
+        self.syncer.shutdown()
+        self._event_file.close()
+
+    @property
+    def actual_metrics_port(self) -> int:
+        return self._servers[0].server_address[1] if self._servers else self.metrics_port
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry with the reference env contract
+    (cmd/daemon/daemon.go:69-84): flags override env, env overrides
+    defaults."""
+    p = argparse.ArgumentParser(prog="infw-daemon")
+    p.add_argument("--state-dir", required=True)
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument(
+        "--namespace",
+        default=os.environ.get("NAMESPACE", "ingress-node-firewall-system"),
+    )
+    p.add_argument("--backend", default=os.environ.get("INFW_BACKEND", "cpu"),
+                   choices=["tpu", "cpu"])
+    p.add_argument(
+        "--poll-period-seconds",
+        type=float,
+        default=float(os.environ.get("POLL_PERIOD_SECONDS", "30")),
+    )
+    p.add_argument("--metrics-port", type=int, default=DEFAULT_METRICS_PORT)
+    p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
+    args = p.parse_args(argv)
+
+    if not args.node_name:
+        p.error("environment variable NODE_NAME or --node-name is required")
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    debug = os.environ.get("ENABLE_LPM_LOOKUP_DBG", "0") not in ("0", "", "false")
+    daemon = Daemon(
+        state_dir=args.state_dir,
+        node_name=args.node_name,
+        namespace=args.namespace,
+        backend=args.backend,
+        poll_period_s=args.poll_period_seconds,
+        debug_lookup=debug,
+        metrics_port=args.metrics_port,
+        health_port=args.health_port,
+    )
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    daemon.start()
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,2 @@
+"""Observability: statistics polling/exposition, deny-event pipeline, and
+raw-frame parsing (the host-side analogue of the XDP header parse)."""

@@ -1,0 +1,229 @@
+"""Deny-event pipeline.
+
+The reference path (SURVEY.md §3.5): kernel emits a perf event per denied
+packet — header + first ≤256B of the frame
+(/root/reference/bpf/ingress_node_firewall_kernel.c:361-399) — a daemon
+goroutine decodes it with gopacket and writes structured lines to syslog,
+which a sidecar prints to stdout
+(/root/reference/pkg/ebpf/ingress_node_firewall_events.go:25-171,
+cmd/syslog/syslog.go:16-69).
+
+TPU-native shape: the classifier's deny verdicts for a batch are turned
+into EventRecords (deny-only — allow generates no event, kernel.c:446,450)
+pushed into a bounded ring that tolerates overflow with a lost-sample
+counter (the perf ring's LostSamples accounting, events.go:79-82); a
+consumer thread decodes and writes the same line format to any sink.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..constants import (
+    DENY,
+    ETH_P_IP,
+    ETH_P_IPV6,
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    MAX_EVENT_DATA,
+    XDP_DROP,
+    XDP_PASS,
+    get_action,
+    get_rule_id,
+)
+from .pcap import ETH_HLEN, IPV4_HLEN, IPV6_HLEN, _L4_HLEN
+
+
+@dataclass
+class EventHdr:
+    """event_hdr_st (bpf/ingress_node_firewall.h:58-64)."""
+
+    if_id: int
+    rule_id: int
+    action: int
+    pkt_length: int
+
+    def pack(self) -> bytes:
+        """Little-endian wire layout mirrored from the Go-side decode
+        (events.go:90-93): u16 ifId, u16 ruleId, u8 action, pad, u16 len."""
+        return struct.pack("<HHBxH", self.if_id, self.rule_id, self.action,
+                          self.pkt_length)
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "EventHdr":
+        if_id, rule_id, action, pkt_length = struct.unpack_from("<HHBxH", raw)
+        return cls(if_id=if_id, rule_id=rule_id, action=action, pkt_length=pkt_length)
+
+
+@dataclass
+class EventRecord:
+    hdr: EventHdr
+    packet: bytes  # first <= MAX_EVENT_DATA bytes of the raw frame
+
+
+def convert_xdp_action_to_string(action: int) -> str:
+    """convertXdpActionToString (events.go:173-181)."""
+    if action == XDP_DROP:
+        return "Drop"
+    if action == XDP_PASS:
+        return "Allow"
+    return "invalid action"
+
+
+class EventRing:
+    """Bounded ring with lost-sample accounting (MAX_CPUS-slot perf ring,
+    kernel.c:24-29; LostSamples handling events.go:79-82)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque()
+        self._capacity = capacity
+        self.lost_samples = 0
+
+    def push(self, rec: EventRecord) -> None:
+        with self._lock:
+            if len(self._ring) >= self._capacity:
+                self.lost_samples += 1
+                return
+            self._ring.append(rec)
+
+    def pop_all(self) -> List[EventRecord]:
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def emit_deny_events(
+    ring: EventRing,
+    results: np.ndarray,
+    ifindex: np.ndarray,
+    pkt_len: np.ndarray,
+    frames: Optional[Sequence[bytes]] = None,
+) -> int:
+    """generate_event_and_update_statistics for a whole batch
+    (kernel.c:361-399): one event per DENY verdict, capturing the first
+    ≤MAX_EVENT_DATA raw bytes when frames are available.  Returns the
+    number of events emitted."""
+    deny_idx = np.nonzero((np.asarray(results) & 0xFF) == DENY)[0]
+    for i in deny_idx:
+        raw = bytes(frames[i][:MAX_EVENT_DATA]) if frames is not None else b""
+        hdr = EventHdr(
+            if_id=int(ifindex[i]),
+            rule_id=get_rule_id(int(results[i])),
+            action=get_action(int(results[i])),
+            pkt_length=min(int(pkt_len[i]), 0xFFFF),
+        )
+        ring.push(EventRecord(hdr=hdr, packet=raw))
+    return len(deny_idx)
+
+
+def decode_event_lines(
+    rec: EventRecord, iface_name: str = "?"
+) -> List[str]:
+    """The gopacket-equivalent decode (events.go:104-166): the exact line
+    formats the reference writes to syslog, which the e2e suite regexes
+    out of the sidecar logs (test/e2e/events/events.go:140-205)."""
+    hdr = rec.hdr
+    lines = [
+        f"ruleId {hdr.rule_id} action {convert_xdp_action_to_string(hdr.action)} "
+        f"len {hdr.pkt_length} if {iface_name}"
+    ]
+    pkt = rec.packet
+    if len(pkt) < ETH_HLEN:
+        return lines
+    ethertype = struct.unpack_from("!H", pkt, 12)[0]
+    l4_off = None
+    proto = None
+    if ethertype == ETH_P_IP and len(pkt) >= ETH_HLEN + IPV4_HLEN:
+        src = ".".join(str(b) for b in pkt[ETH_HLEN + 12 : ETH_HLEN + 16])
+        dst = ".".join(str(b) for b in pkt[ETH_HLEN + 16 : ETH_HLEN + 20])
+        lines.append(f"\tipv4 src addr {src} dst addr {dst}")
+        proto = pkt[ETH_HLEN + 9]
+        l4_off = ETH_HLEN + IPV4_HLEN
+    elif ethertype == ETH_P_IPV6 and len(pkt) >= ETH_HLEN + IPV6_HLEN:
+        import ipaddress
+
+        src = str(ipaddress.IPv6Address(pkt[ETH_HLEN + 8 : ETH_HLEN + 24]))
+        dst = str(ipaddress.IPv6Address(pkt[ETH_HLEN + 24 : ETH_HLEN + 40]))
+        lines.append(f"\tipv6 src addr {src} dst addr {dst}")
+        proto = pkt[ETH_HLEN + 6]
+        l4_off = ETH_HLEN + IPV6_HLEN
+    if l4_off is None or proto is None:
+        return lines
+    hlen = _L4_HLEN.get(proto)
+    if hlen is None or len(pkt) < l4_off + hlen:
+        return lines
+    if proto in (IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP):
+        sport, dport = struct.unpack_from("!HH", pkt, l4_off)
+        name = {IPPROTO_TCP: "tcp", IPPROTO_UDP: "udp", IPPROTO_SCTP: "sctp"}[proto]
+        lines.append(f"\t{name} srcPort {sport} dstPort {dport}")
+    elif proto == IPPROTO_ICMP:
+        lines.append(f"\ticmpv4 type {pkt[l4_off]} code {pkt[l4_off + 1]}")
+    elif proto == IPPROTO_ICMPV6:
+        lines.append(f"\ticmpv6 type {pkt[l4_off]} code {pkt[l4_off + 1]}")
+    return lines
+
+
+class EventsLogger:
+    """The daemon-side reader goroutine + syslog sidecar collapsed into a
+    thread draining the ring into a line sink (stdout/logfile/collector).
+
+    ``iface_names`` maps ifindex -> name (net.InterfaceByIndex,
+    events.go:100-104); unknown indices log "?" rather than dropping the
+    event (we keep the event; the reference skips it — kept intentionally
+    so synthetic replays without a registry still record drops)."""
+
+    def __init__(
+        self,
+        ring: EventRing,
+        sink: Callable[[str], None],
+        iface_names: Optional[dict] = None,
+        poll_interval_s: float = 0.05,
+    ) -> None:
+        self._ring = ring
+        self._sink = sink
+        self._iface_names = iface_names or {}
+        self._interval = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+        self.drain_once()
+
+    def drain_once(self) -> int:
+        n = 0
+        for rec in self._ring.pop_all():
+            name = self._iface_names.get(rec.hdr.if_id, "?")
+            for line in decode_event_lines(rec, name):
+                self._sink(line)
+            n += 1
+        return n
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.drain_once()

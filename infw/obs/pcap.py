@@ -1,0 +1,174 @@
+"""Raw ethernet frame parse/build.
+
+Host-side replica of the XDP header parse
+(/root/reference/bpf/ingress_node_firewall_kernel.c): the ethertype switch
+of ingress_node_firewall_main (:423-439) and ip_extract_l4info (:95-174),
+producing the struct-of-arrays PacketBatch the TPU dataplane consumes.
+
+Faithfulness notes (bit-exact quirks preserved on purpose):
+- The kernel advances past a *fixed-size* iphdr (no IHL handling), so IPv4
+  options would shift the L4 parse; we replicate the fixed 20-byte step.
+- Unknown L4 protocol or a truncated L4 header makes ip_extract_l4info
+  return -1 ⇒ lookup returns UNDEF ⇒ PASS (l4_ok=0 here); a truncated
+  *IP* header is the same condition (:103-105,112-114).
+- A frame shorter than the ethernet header is KIND_MALFORMED ⇒ XDP_DROP
+  (:423-426).
+- dst_port is converted to host order (the kernel compares
+  bpf_ntohs(dstPort), :236-243).
+
+``build_frame`` is the synthesis inverse, used by tests, pcap replay and
+the deny-event capture (the perf ring captures the first ≤256B of the raw
+packet, :392-399).
+"""
+from __future__ import annotations
+
+import ipaddress
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..constants import (
+    ETH_P_IP,
+    ETH_P_IPV6,
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    KIND_IPV4,
+    KIND_IPV6,
+    KIND_MALFORMED,
+    KIND_OTHER,
+)
+from ..packets import PacketBatch
+
+ETH_HLEN = 14
+IPV4_HLEN = 20   # sizeof(struct iphdr) — fixed, no IHL (kernel.c:103)
+IPV6_HLEN = 40   # sizeof(struct ipv6hdr)
+_L4_HLEN = {
+    IPPROTO_TCP: 20,   # sizeof(struct tcphdr)
+    IPPROTO_UDP: 8,    # sizeof(struct udphdr)
+    IPPROTO_SCTP: 12,  # sizeof(struct sctphdr)
+    IPPROTO_ICMP: 8,   # sizeof(struct icmphdr)
+    IPPROTO_ICMPV6: 8, # sizeof(struct icmp6hdr)
+}
+
+
+def parse_frame(frame: bytes):
+    """One frame -> (kind, l4_ok, ip_words[4], proto, dst_port, icmp_type,
+    icmp_code, pkt_len)."""
+    pkt_len = len(frame)
+    if pkt_len < ETH_HLEN:
+        return (KIND_MALFORMED, 0, (0, 0, 0, 0), 0, 0, 0, 0, pkt_len)
+    ethertype = struct.unpack_from("!H", frame, 12)[0]
+    if ethertype == ETH_P_IP:
+        kind, ip_hlen = KIND_IPV4, IPV4_HLEN
+    elif ethertype == ETH_P_IPV6:
+        kind, ip_hlen = KIND_IPV6, IPV6_HLEN
+    else:
+        return (KIND_OTHER, 0, (0, 0, 0, 0), 0, 0, 0, 0, pkt_len)
+
+    l4_off = ETH_HLEN + ip_hlen
+    if pkt_len < l4_off:
+        # truncated IP header: ip_extract_l4info returns -1 (:103-105)
+        return (kind, 0, (0, 0, 0, 0), 0, 0, 0, 0, pkt_len)
+
+    if kind == KIND_IPV4:
+        proto = frame[ETH_HLEN + 9]
+        src = frame[ETH_HLEN + 12 : ETH_HLEN + 16]
+        words = (struct.unpack("!I", src)[0], 0, 0, 0)
+    else:
+        proto = frame[ETH_HLEN + 6]
+        src = frame[ETH_HLEN + 8 : ETH_HLEN + 24]
+        words = struct.unpack("!4I", src)
+
+    hlen = _L4_HLEN.get(proto)
+    if hlen is None or pkt_len < l4_off + hlen:
+        return (kind, 0, words, proto, 0, 0, 0, pkt_len)
+
+    dst_port = icmp_type = icmp_code = 0
+    if proto in (IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP):
+        dst_port = struct.unpack_from("!H", frame, l4_off + 2)[0]
+    else:
+        icmp_type = frame[l4_off]
+        icmp_code = frame[l4_off + 1]
+    return (kind, 1, words, proto, dst_port, icmp_type, icmp_code, pkt_len)
+
+
+def parse_frames(frames: Sequence[bytes], ifindex) -> PacketBatch:
+    """Frames + per-frame (or scalar) ingress ifindex -> PacketBatch."""
+    b = len(frames)
+    if np.isscalar(ifindex):
+        ifindex = [int(ifindex)] * b
+    kind = np.zeros(b, np.int32)
+    l4_ok = np.zeros(b, np.int32)
+    words = np.zeros((b, 4), np.uint32)
+    proto = np.zeros(b, np.int32)
+    dst_port = np.zeros(b, np.int32)
+    icmp_type = np.zeros(b, np.int32)
+    icmp_code = np.zeros(b, np.int32)
+    pkt_len = np.zeros(b, np.int32)
+    for i, frame in enumerate(frames):
+        k, ok, w, p, dp, it, ic, pl = parse_frame(frame)
+        kind[i], l4_ok[i], proto[i], dst_port[i] = k, ok, p, dp
+        icmp_type[i], icmp_code[i], pkt_len[i] = it, ic, pl
+        words[i] = w
+    return PacketBatch(
+        kind=kind,
+        l4_ok=l4_ok,
+        ifindex=np.asarray(ifindex, np.int32),
+        ip_words=words,
+        proto=proto,
+        dst_port=dst_port,
+        icmp_type=icmp_type,
+        icmp_code=icmp_code,
+        pkt_len=pkt_len,
+    )
+
+
+def build_frame(
+    src_ip: str,
+    dst_ip: str,
+    proto: int,
+    src_port: int = 0,
+    dst_port: int = 0,
+    icmp_type: int = 0,
+    icmp_code: int = 0,
+    payload: bytes = b"",
+    ethertype: Optional[int] = None,
+    src_mac: bytes = b"\x02\x00\x00\x00\x00\x01",
+    dst_mac: bytes = b"\x02\x00\x00\x00\x00\x02",
+) -> bytes:
+    """Synthesize a well-formed ethernet frame for replay/tests."""
+    src = ipaddress.ip_address(src_ip)
+    dst = ipaddress.ip_address(dst_ip)
+    is_v4 = src.version == 4
+    if ethertype is None:
+        ethertype = ETH_P_IP if is_v4 else ETH_P_IPV6
+
+    if proto in (IPPROTO_TCP,):
+        l4 = struct.pack("!HHIIBBHHH", src_port, dst_port, 0, 0, 5 << 4, 0, 0, 0, 0)
+    elif proto == IPPROTO_UDP:
+        l4 = struct.pack("!HHHH", src_port, dst_port, 8 + len(payload), 0)
+    elif proto == IPPROTO_SCTP:
+        l4 = struct.pack("!HHII", src_port, dst_port, 0, 0)
+    elif proto in (IPPROTO_ICMP, IPPROTO_ICMPV6):
+        l4 = struct.pack("!BBHI", icmp_type, icmp_code, 0, 0)
+    else:
+        l4 = b""
+    l4 += payload
+
+    if is_v4:
+        total = IPV4_HLEN + len(l4)
+        ip = struct.pack(
+            "!BBHHHBBH4s4s",
+            (4 << 4) | 5, 0, total, 0, 0, 64, proto, 0, src.packed, dst.packed,
+        )
+    else:
+        ip = struct.pack(
+            "!IHBB16s16s",
+            (6 << 28), len(l4), proto, 64, src.packed, dst.packed,
+        )
+    eth = dst_mac + src_mac + struct.pack("!H", ethertype)
+    return eth + ip + l4

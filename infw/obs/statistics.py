@@ -1,0 +1,166 @@
+"""Node-level statistics: poller + Prometheus exposition.
+
+Equivalent of the reference's pkg/metrics
+(/root/reference/pkg/metrics/statistics.go): a poller thread reads the
+classifier's accumulated per-rule counters every poll period, sums rules
+1..MAX_INGRESS_RULES-1 with overflow-checked additions (:112-167,170-181),
+and publishes the four node gauges:
+
+    ingressnodefirewall_node_packet_allow_total
+    ingressnodefirewall_node_packet_allow_bytes
+    ingressnodefirewall_node_packet_deny_total
+    ingressnodefirewall_node_packet_deny_bytes
+
+(:18-48).  ``render_prometheus_text`` is the /metrics exposition the
+daemon serves (the e2e suite parses this exact text format,
+test/e2e/functional/tests/e2e.go:1143-1356).
+
+The classifier's StatsAccumulator plays the per-CPU map: per-batch stat
+deltas land there from the device (already summed across shards with
+psum on the TPU path), and this poller aggregates across rules — the same
+split as kernel per-CPU counters vs userspace aggregation.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..backend.base import Classifier
+from ..failsaferules import MAX_INGRESS_RULES
+
+log = logging.getLogger("infw.obs.statistics")
+
+METRIC_INF_NAMESPACE = "ingressnodefirewall"
+METRIC_INF_SUBSYSTEM_NODE = "node"
+
+_U64_MAX = (1 << 64) - 1
+
+_METRICS = [
+    ("packet_allow_total",
+     "The number of packets which results in an allow IP packet result"),
+    ("packet_allow_bytes",
+     "The number of bytes for packets which results in an allow IP packet result"),
+    ("packet_deny_total",
+     "The number of packets which results in a deny IP packet result"),
+    ("packet_deny_bytes",
+     "The number of bytes for packets which results in an deny IP packet result"),
+]
+
+
+def get_prometheus_statistic_names() -> List[str]:
+    """GetPrometheusStatisticNames (statistics.go:52-60)."""
+    return [
+        f"{METRIC_INF_NAMESPACE}_{METRIC_INF_SUBSYSTEM_NODE}_{name}"
+        for name, _ in _METRICS
+    ]
+
+
+def add_uint64(a: int, b: int):
+    """addUInt64 (statistics.go:170-181): returns (value, ok)."""
+    c = (a + b) & _U64_MAX
+    if a == 0 or b == 0:
+        return c, True
+    if c > a and c > b:
+        return c, True
+    return c, False
+
+
+class Statistics:
+    """NewStatistics + Register + Start/StopPoll (statistics.go:61-110).
+
+    Implements the syncer's StatsPoller protocol, so the sync boundary can
+    pause polling around table rewrites (ebpfsyncer.go:81-88)."""
+
+    def __init__(self, poll_period_s: float = 30.0) -> None:
+        self.poll_period_s = float(poll_period_s)
+        self._lock = threading.Lock()
+        self._values: Dict[str, int] = {name: 0 for name, _ in _METRICS}
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._registered = False
+
+    # -- registration (regOnce, statistics.go:79-86) -------------------------
+
+    def register(self) -> None:
+        with self._lock:
+            self._registered = True
+
+    # -- polling -------------------------------------------------------------
+
+    def start_poll(self, classifier: Classifier) -> None:
+        with self._lock:
+            if self._thread is not None:
+                log.info("Metrics are already being polled")
+                return
+            stop = threading.Event()
+            thread = threading.Thread(
+                target=self._poll_loop, args=(classifier, stop), daemon=True
+            )
+            self._stop, self._thread = stop, thread
+            thread.start()
+
+    def stop_poll(self) -> None:
+        with self._lock:
+            thread, stop = self._thread, self._stop
+            self._thread = self._stop = None
+        if thread is not None:
+            stop.set()
+            thread.join()
+
+    @property
+    def is_polling(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    def _poll_loop(self, classifier: Classifier, stop: threading.Event) -> None:
+        log.info("Starting node metrics updater")
+        while not stop.wait(self.poll_period_s):
+            self.update_metrics(classifier)
+        log.info("Stopped node metric updates")
+
+    def update_metrics(self, classifier: Classifier) -> None:
+        """updateMetrics (statistics.go:112-167): sum rules
+        1..MAX_INGRESS_RULES-1 with overflow checks; gauges are *set* to
+        the running totals (counters monotonically grow in the map — here
+        in the StatsAccumulator — until dataplane reset)."""
+        snap = classifier.stats.snapshot()  # (MAX_TARGETS, 4) int64
+
+        def checked_add(cur: int, inc: int, label: str) -> int:
+            result, ok = add_uint64(inc, cur)
+            if not ok:
+                log.warning("Overflow occurred during addition of %s statistic", label)
+                return cur
+            return result
+
+        allow_count = allow_bytes = deny_count = deny_bytes = 0
+        for rule in range(1, min(MAX_INGRESS_RULES, snap.shape[0])):
+            ap, ab, dp, db = (int(x) for x in snap[rule])
+            allow_count = checked_add(allow_count, ap, "allow packet")
+            allow_bytes = checked_add(allow_bytes, ab, "allow byte")
+            deny_count = checked_add(deny_count, dp, "deny packet")
+            deny_bytes = checked_add(deny_bytes, db, "deny byte")
+        with self._lock:
+            self._values["packet_allow_total"] = allow_count
+            self._values["packet_allow_bytes"] = allow_bytes
+            self._values["packet_deny_total"] = deny_count
+            self._values["packet_deny_bytes"] = deny_bytes
+
+    # -- exposition ----------------------------------------------------------
+
+    def values(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._values)
+
+    def render_prometheus_text(self) -> str:
+        """Prometheus text format served on the daemon's /metrics endpoint
+        (the reference's 127.0.0.1:39301, cmd/daemon/daemon.go:57-58)."""
+        vals = self.values()
+        out = []
+        for name, help_text in _METRICS:
+            full = f"{METRIC_INF_NAMESPACE}_{METRIC_INF_SUBSYSTEM_NODE}_{name}"
+            out.append(f"# HELP {full} {help_text}")
+            out.append(f"# TYPE {full} gauge")
+            out.append(f"{full} {vals[name]}")
+        return "\n".join(out) + "\n"

@@ -196,13 +196,18 @@ class InMemoryStore:
             if key in self._objects:
                 raise AlreadyExistsError(f"{key} already exists")
             stored = _copy(obj)
+            # The API server ignores status on create (status is a
+            # subresource); writers must follow with update_status.
+            if hasattr(stored, "status"):
+                stored.status = stored.status.__class__()
             self._rv += 1
             stored.metadata.resource_version = self._rv
             if not stored.metadata.uid:
                 stored.metadata.uid = f"uid-{self._rv}"
             self._objects[key] = stored
-            self._notify(ADDED, stored)
-            return _copy(stored)
+            out = _copy(stored)
+        self._notify(ADDED, stored)
+        return out
 
     def update(self, obj) -> object:
         """Full-object update (spec + metadata); the status subresource is
@@ -221,8 +226,9 @@ class InMemoryStore:
             self._rv += 1
             stored.metadata.resource_version = self._rv
             self._objects[key] = stored
-            self._notify(MODIFIED, stored)
-            return _copy(stored)
+            out = _copy(stored)
+        self._notify(MODIFIED, stored)
+        return out
 
     def update_status(self, obj) -> object:
         with self._lock:
@@ -237,8 +243,9 @@ class InMemoryStore:
             self._rv += 1
             stored.metadata.resource_version = self._rv
             self._objects[key] = stored
-            self._notify(MODIFIED, stored)
-            return _copy(stored)
+            out = _copy(stored)
+        self._notify(MODIFIED, stored)
+        return out
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         """Finalizer-aware delete: objects with finalizers get a deletion
@@ -252,12 +259,17 @@ class InMemoryStore:
             if cur.metadata.finalizers:
                 if cur.metadata.deletion_timestamp is None:
                     cur.metadata.deletion_timestamp = time.time()
-                    self._rv += 1
-                    cur.metadata.resource_version = self._rv
-                    self._notify(MODIFIED, cur)
-                return
-            del self._objects[key]
-            self._notify(DELETED, cur)
+                self._rv += 1
+                cur.metadata.resource_version = self._rv
+                event, obj = MODIFIED, cur
+            else:
+                del self._objects[key]
+                event, obj = DELETED, cur
+        # Re-notify even when deletion was already in progress: watchers
+        # whose finalizer teardown failed transiently get a retry signal on
+        # the next delete attempt (the role controller-runtime's requeue
+        # plays for the reference).
+        self._notify(event, obj)
 
     def update_finalizers(self, obj, finalizers: List[str]) -> object:
         """Set the finalizer list; an object past its deletion timestamp
@@ -273,10 +285,12 @@ class InMemoryStore:
             cur.metadata.resource_version = self._rv
             if cur.metadata.deletion_timestamp is not None and not cur.metadata.finalizers:
                 del self._objects[key]
-                self._notify(DELETED, cur)
+                event = DELETED
             else:
-                self._notify(MODIFIED, cur)
-            return _copy(cur)
+                event = MODIFIED
+            out = _copy(cur)
+        self._notify(event, cur)
+        return out
 
     # -- watches -------------------------------------------------------------
 
@@ -295,5 +309,10 @@ class InMemoryStore:
         return cancel
 
     def _notify(self, event: str, obj) -> None:
-        for cb in list(self._watchers.get(obj.KIND, [])):
+        """Fan out an event.  Callers invoke this OUTSIDE the store lock so
+        slow watchers (a full dataplane sync can sleep through attach
+        retries) never block other threads' store access."""
+        with self._lock:
+            callbacks = list(self._watchers.get(obj.KIND, []))
+        for cb in callbacks:
             cb(event, _copy(obj))

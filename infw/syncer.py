@@ -141,6 +141,11 @@ class DataplaneSyncer:
 
     # -- public surface ------------------------------------------------------
 
+    def _valid_fn(self) -> Callable[[str], bool]:
+        """Resolve the validity seam (the injectable
+        isValidInterfaceNameAndState package var, ebpfsyncer.go:26)."""
+        return self._is_valid_interface or self._registry.is_valid_interface_name_and_state
+
     def sync_interface_ingress_rules(
         self,
         iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
@@ -215,10 +220,7 @@ class DataplaneSyncer:
             tables, attached = ck
             self._classifier.load_tables(tables)
             self._content = dict(tables.content)
-            valid = (
-                self._is_valid_interface
-                or self._registry.is_valid_interface_name_and_state
-            )
+            valid = self._valid_fn()
             for name in attached:
                 if not valid(name):
                     log.warning("re-adopt: interface %s no longer valid", name)
@@ -256,7 +258,7 @@ class DataplaneSyncer:
     ) -> None:
         """attachNewInterfaces (ebpfsyncer.go:183-215): invalid interfaces
         are skipped without error; busy interfaces retry."""
-        valid = self._is_valid_interface or self._registry.is_valid_interface_name_and_state
+        valid = self._valid_fn()
         for name in iface_ingress_rules:
             if name in self._attached:
                 continue
@@ -282,7 +284,7 @@ class DataplaneSyncer:
         """loadIngressNodeFirewallRules → IngressNodeFwRulesLoader
         (loader.go:130-194): build desired content, diff against current,
         reload the device tables only when the content changed, then pin."""
-        valid = self._is_valid_interface or self._registry.is_valid_interface_name_and_state
+        valid = self._valid_fn()
         width = self._desired_width(iface_ingress_rules)
         raw = build_table_content(
             iface_ingress_rules, self._registry, width, is_valid_interface=valid

@@ -31,7 +31,7 @@ from infw.spec import (
     SYNC_STATUS_OK,
 )
 from infw.store import DaemonSet, DaemonSetStatus, InMemoryStore, Node, NotFoundError
-from test_syncer import catchall_rule, ingress, tcp_rule, udp_rule
+from test_syncer import ingress, tcp_rule, udp_rule
 
 NS = "ingress-node-firewall-system"
 

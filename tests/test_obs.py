@@ -1,0 +1,221 @@
+"""Observability tests: frame parse round-trips vs the batch oracle,
+deny-event pipeline line formats, and the statistics poller/exposition
+(reference: pkg/metrics/statistics.go behaviors + the e2e suites'
+metrics/events assertions, e2e.go:1143-1356,1560-1620)."""
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from infw import oracle
+from infw.backend.cpu_ref import CpuRefClassifier
+from infw.constants import (
+    DENY,
+    IPPROTO_ICMP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    KIND_IPV4,
+    KIND_IPV6,
+    KIND_MALFORMED,
+    KIND_OTHER,
+    XDP_DROP,
+)
+from infw.obs import pcap
+from infw.obs.events import (
+    EventHdr,
+    EventRing,
+    EventsLogger,
+    decode_event_lines,
+    emit_deny_events,
+)
+from infw.obs.statistics import (
+    Statistics,
+    add_uint64,
+    get_prometheus_statistic_names,
+)
+from infw.spec import ACTION_DENY
+from infw.syncer import DataplaneSyncer
+from infw.interfaces import Interface, InterfaceRegistry
+from test_syncer import ingress, tcp_rule
+
+# --- pcap parse/build ---------------------------------------------------------
+
+def test_frame_roundtrip_v4_tcp():
+    f = pcap.build_frame("192.0.2.1", "10.0.0.1", IPPROTO_TCP, 1234, 80)
+    kind, ok, words, proto, dport, it, ic, plen = pcap.parse_frame(f)
+    assert (kind, ok, proto, dport) == (KIND_IPV4, 1, IPPROTO_TCP, 80)
+    assert words[0] == int.from_bytes(bytes([192, 0, 2, 1]), "big")
+    assert words[1:] == (0, 0, 0)
+    assert plen == len(f)
+
+
+def test_frame_roundtrip_v6_icmp6():
+    f = pcap.build_frame("2001:db8::1", "2001:db8::2", 58, icmp_type=128, icmp_code=0)
+    kind, ok, words, proto, dport, it, ic, plen = pcap.parse_frame(f)
+    assert (kind, ok, proto, it, ic) == (KIND_IPV6, 1, 58, 128, 0)
+
+
+def test_frame_edge_cases():
+    # short ethernet -> malformed (kernel.c:423-426 -> XDP_DROP)
+    assert pcap.parse_frame(b"\x00" * 10)[0] == KIND_MALFORMED
+    # unknown ethertype -> KIND_OTHER -> PASS
+    arp = b"\x02" * 12 + b"\x08\x06" + b"\x00" * 28
+    assert pcap.parse_frame(arp)[0] == KIND_OTHER
+    # truncated L4 -> l4_ok = 0 (ip_extract_l4info -1 -> UNDEF -> PASS)
+    f = pcap.build_frame("192.0.2.1", "10.0.0.1", IPPROTO_TCP, 1, 2)[:-10]
+    kind, ok, *_ = pcap.parse_frame(f)
+    assert (kind, ok) == (KIND_IPV4, 0)
+    # unknown L4 proto (GRE 47) -> l4_ok = 0
+    f = pcap.build_frame("192.0.2.1", "10.0.0.1", 47)
+    kind, ok, *_ = pcap.parse_frame(f)
+    assert (kind, ok) == (KIND_IPV4, 0)
+
+
+def test_parse_frames_batch_verdicts_match_oracle():
+    """Raw frames -> batch -> classify: the full observability-path parse
+    agrees with the dataplane."""
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2))
+    s = DataplaneSyncer(classifier_factory=CpuRefClassifier, registry=reg)
+    s.sync_interface_ingress_rules(
+        {"eth0": [ingress(["192.0.2.0/24"], [tcp_rule(1, 80, ACTION_DENY)])]}, False
+    )
+    frames = [
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 80),
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 81),
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_UDP, 999, 80),
+        pcap.build_frame("203.0.113.9", "10.0.0.1", IPPROTO_TCP, 999, 80),
+        b"\x00" * 8,  # malformed -> DROP
+    ]
+    batch = pcap.parse_frames(frames, ifindex=2)
+    out = s.classifier.classify(batch)
+    assert list(out.xdp) == [1, 2, 2, 2, 1]
+    o = oracle.classify(s.classifier.tables, batch)
+    assert list(o.xdp) == list(out.xdp)
+
+
+# --- event pipeline -----------------------------------------------------------
+
+def test_event_hdr_wire_roundtrip():
+    hdr = EventHdr(if_id=3, rule_id=7, action=XDP_DROP, pkt_length=99)
+    assert EventHdr.unpack(hdr.pack()) == hdr
+
+
+def test_emit_and_decode_deny_events():
+    frames = [
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 80),
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 81),
+        pcap.build_frame("2001:db8::7", "2001:db8::1", IPPROTO_ICMP + 57, icmp_type=128),
+    ]
+    batch = pcap.parse_frames(frames, ifindex=2)
+    # results: rule 5 deny, allow, rule 6 deny
+    results = np.array([(5 << 8) | DENY, 2, (6 << 8) | DENY], np.uint32)
+    ring = EventRing()
+    n = emit_deny_events(ring, results, batch.ifindex, batch.pkt_len, frames)
+    assert n == 2 and len(ring) == 2
+
+    recs = ring.pop_all()
+    lines = decode_event_lines(recs[0], "eth0")
+    assert lines[0] == f"ruleId 5 action Drop len {len(frames[0])} if eth0"
+    assert lines[1] == "\tipv4 src addr 192.0.2.9 dst addr 10.0.0.1"
+    assert lines[2] == "\ttcp srcPort 999 dstPort 80"
+
+    lines6 = decode_event_lines(recs[1], "eth0")
+    assert lines6[1] == "\tipv6 src addr 2001:db8::7 dst addr 2001:db8::1"
+    assert lines6[2] == "\ticmpv6 type 128 code 0"
+
+
+def test_event_ring_overflow_lost_samples():
+    from infw.obs.events import EventRecord
+
+    ring = EventRing(capacity=2)
+    for _ in range(5):
+        ring.push(EventRecord(hdr=EventHdr(1, 1, 1, 1), packet=b""))
+    assert len(ring) == 2
+    assert ring.lost_samples == 3
+
+
+def test_events_logger_drains_to_sink():
+    ring = EventRing()
+    frames = [pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 80)]
+    batch = pcap.parse_frames(frames, ifindex=2)
+    emit_deny_events(
+        ring, np.array([(1 << 8) | DENY], np.uint32), batch.ifindex, batch.pkt_len, frames
+    )
+    out = []
+    logger = EventsLogger(ring, out.append, iface_names={2: "eth0"}, poll_interval_s=0.01)
+    logger.start()
+    deadline = time.time() + 2
+    while not out and time.time() < deadline:
+        time.sleep(0.01)
+    logger.stop()
+    assert any(re.match(r"ruleId 1 action Drop len \d+ if eth0", l) for l in out)
+
+
+# --- statistics ---------------------------------------------------------------
+
+def test_add_uint64_overflow():
+    assert add_uint64(1, 2) == (3, True)
+    assert add_uint64(0, 5) == (5, True)
+    v, ok = add_uint64((1 << 64) - 1, 2)
+    assert not ok
+
+
+def test_statistic_names():
+    assert get_prometheus_statistic_names() == [
+        "ingressnodefirewall_node_packet_allow_total",
+        "ingressnodefirewall_node_packet_allow_bytes",
+        "ingressnodefirewall_node_packet_deny_total",
+        "ingressnodefirewall_node_packet_deny_bytes",
+    ]
+
+
+def test_statistics_update_and_exposition():
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2))
+    s = DataplaneSyncer(classifier_factory=CpuRefClassifier, registry=reg)
+    s.sync_interface_ingress_rules(
+        {"eth0": [ingress(["192.0.2.0/24"], [tcp_rule(1, 80, ACTION_DENY)])]}, False
+    )
+    frames = [
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 80),  # deny
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 80),  # deny
+        pcap.build_frame("192.0.2.9", "10.0.0.1", IPPROTO_TCP, 999, 81),  # no match
+    ]
+    batch = pcap.parse_frames(frames, ifindex=2)
+    s.classifier.classify(batch)
+
+    stats = Statistics(poll_period_s=3600)
+    stats.update_metrics(s.classifier)
+    vals = stats.values()
+    assert vals["packet_deny_total"] == 2
+    assert vals["packet_deny_bytes"] == 2 * len(frames[0])
+    assert vals["packet_allow_total"] == 0  # no-match PASS is rule 0: not counted
+
+    text = stats.render_prometheus_text()
+    assert "# TYPE ingressnodefirewall_node_packet_deny_total gauge" in text
+    assert re.search(r"^ingressnodefirewall_node_packet_deny_total 2$", text, re.M)
+
+
+def test_statistics_poller_start_stop():
+    class FakeClassifier:
+        def __init__(self):
+            from infw.backend.base import StatsAccumulator
+
+            self._stats = StatsAccumulator()
+
+        @property
+        def stats(self):
+            return self._stats
+
+    stats = Statistics(poll_period_s=0.01)
+    c = FakeClassifier()
+    stats.start_poll(c)
+    assert stats.is_polling
+    stats.start_poll(c)  # no-op double start (statistics.go:89-92)
+    time.sleep(0.05)
+    stats.stop_poll()
+    assert not stats.is_polling
+    stats.stop_poll()  # no-op double stop
