@@ -50,7 +50,7 @@ class TpuClassifier:
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         self._tables: Optional[CompiledTables] = None
-        self._active = None  # (path, device tables)
+        self._active = None  # (path, device tables, block_b or None)
         self._closed = False
 
     # -- rule loading -------------------------------------------------------
@@ -66,11 +66,13 @@ class TpuClassifier:
         if path == "dense":
             pt = pallas_dense.build_pallas_tables(tables)
             dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
+            block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
         else:
             dev = jaxpath.device_tables(tables, self._device)
+            block_b = None
         with self._lock:
             self._tables = tables
-            self._active = (path, dev)
+            self._active = (path, dev, block_b)
 
     # -- classify -----------------------------------------------------------
 
@@ -78,13 +80,13 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev = self._active
+            path, dev, block_b = self._active
             stride = self._tables.stride
         db = jaxpath.device_batch(batch, self._device)
         if path == "dense":
-            res, xdp, stats = pallas_dense.jitted_classify_pallas(self._interpret)(
-                dev, db
-            )
+            res, xdp, stats = pallas_dense.jitted_classify_pallas(
+                self._interpret, block_b
+            )(dev, db)
         else:
             res, xdp, stats = jaxpath.jitted_classify(True, stride)(dev, db)
         stats_delta = jaxpath.merge_stats_host(np.asarray(stats))

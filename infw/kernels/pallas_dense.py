@@ -48,33 +48,60 @@ from ..constants import (
 )
 from .jaxpath import DeviceBatch, finalize
 
-BLOCK_B = 256     # packets per grid step
+BLOCK_B = 256     # default packets per grid step (see classify_pallas block_b)
 RULE_PAD = 128    # padded rule axis (MAX_RULES_PER_TARGET=100 <= 128)
-NUM_FIELDS = 9    # rid, proto, ps_hi, ps_lo, pe_hi, pe_lo, itype, icode, act
+# Field-major rule-byte layout: rid_act packs (ruleId<<1)|(action-1) in one
+# byte (ruleId <= 100 -> 7 bits; action in {1,2} -> 1 bit), giving exactly
+# 8*128 = 1024 gather columns — MXU-tile aligned, and 11% less work than a
+# separate action column.
+NUM_FIELDS = 8    # rid_act, proto, ps_hi, ps_lo, pe_hi, pe_lo, itype, icode
 KEY_BITS = 160
 MAX_DENSE_TARGETS = 4096
+# Measured on v5e (100K rule entries = 1000 CIDRs x 100 rules): int8 MXU
+# path 17.1ms/2^20 packets vs bf16 22.6ms; block 512 beats 256 (better MXU
+# utilization), 2048 exceeds the 16MB scoped-VMEM limit.
+DEFAULT_DTYPE = "int8"
+
+
+def choose_block_b(num_targets_padded: int) -> int:
+    """Largest packet block that keeps the kernel inside scoped VMEM for
+    the given (padded) target count."""
+    return 512 if num_targets_padded <= 1024 else BLOCK_B
 
 
 class PallasTables(NamedTuple):
     """Dense-kernel table operands (device arrays).
 
-    Matmul operands are bfloat16: every value is a small non-negative
-    integer (bits in {0,1}, rule bytes in [0,255]) that bf16 represents
-    exactly, and f32 accumulation of <=160 products is exact — so the MXU's
-    native bf16 path computes exact integer arithmetic."""
+    Two exact-integer MXU paths, selected by the operand dtype:
+    - int8 (default): s8 x s8 -> s32, double-rate on v5e; rule bytes are
+      stored biased by -128 so [0,255] fits s8 (bias re-added in-kernel).
+    - bf16: bf16 x bf16 -> f32; every value is a small integer in [-1,255]
+      that bf16 represents exactly, and f32 accumulation of <=160 products
+      is exact.
 
-    m0t: jax.Array       # (KEY_BITS, Tp) bf16 — mask & ~prefix
-    m1t: jax.Array       # (KEY_BITS, Tp) bf16 — mask & prefix
+    The LPM mismatch count folds into ONE matmul:
+        mism = bits @ (M0 - M1) + rowsum(M1)
+    where M0 = mask & ~prefix, M1 = mask & prefix: bits@M0 counts
+    should-be-zero key bits that are one, (1-bits)@M1 counts should-be-one
+    bits that are zero, and expanding (1-bits)@M1 gives the folded form."""
+
+    mdt: jax.Array       # (KEY_BITS, Tp) int8|bf16 — M0 - M1, in {-1,0,1}
+    m1sum: jax.Array     # (1, Tp) int32|f32 — per-entry rowsum(M1)
     mask_len: jax.Array  # (1, Tp) int32, -1 for padding columns
-    rules_bytes: jax.Array  # (Tp, NUM_FIELDS*RULE_PAD) bf16, field-major
+    rules_bytes: jax.Array  # (Tp, NUM_FIELDS*RULE_PAD) int8 (biased -128) | bf16
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def build_pallas_tables(tables: CompiledTables) -> PallasTables:
-    """Host-side packing of CompiledTables into the bit-matrix layout."""
+def build_pallas_tables(tables: CompiledTables, dtype: str = DEFAULT_DTYPE) -> PallasTables:
+    """Host-side packing of CompiledTables into the bit-matrix layout.
+
+    dtype "bf16": operands bf16, accumulate f32 (values <= 255, exact).
+    dtype "int8": operands int8, accumulate int32 on the MXU's double-rate
+    s8 path; rule bytes are stored biased by -128 so [0,255] fits s8, and
+    the kernel adds the bias back after the one-hot gather (exact)."""
     T = tables.num_entries
     if T > MAX_DENSE_TARGETS:
         raise ValueError(
@@ -98,10 +125,10 @@ def build_pallas_tables(tables: CompiledTables) -> PallasTables:
     m0 = mask_bits & (1 - prefix_bits)
     m1 = mask_bits & prefix_bits
 
-    m0t = np.zeros((KEY_BITS, Tp), np.float32)
-    m1t = np.zeros((KEY_BITS, Tp), np.float32)
-    m0t[:, :T] = m0.T
-    m1t[:, :T] = m1.T
+    mdt = np.zeros((KEY_BITS, Tp), np.float32)
+    mdt[:, :T] = (m0.astype(np.int32) - m1.astype(np.int32)).T
+    m1sum = np.zeros((1, Tp), np.float32)
+    m1sum[0, :T] = m1.sum(axis=1)
 
     mask_len = np.full((1, Tp), -1, np.int32)
     mask_len[0, :T] = tables.mask_len[:T]
@@ -109,8 +136,10 @@ def build_pallas_tables(tables: CompiledTables) -> PallasTables:
     R = tables.rule_width
     rb = np.zeros((Tp, NUM_FIELDS * RULE_PAD), np.float32)
     rules = tables.rules[:T].astype(np.int64)
+    rid = rules[..., 0] & 0x7F
+    act = np.clip(rules[..., 6], 1, 2) - 1  # {DENY=1,ALLOW=2} -> {0,1}
     fields = [
-        rules[..., 0] & 0xFF,          # ruleId (order <= 99 fits one byte)
+        np.where(rules[..., 0] != 0, (rid << 1) | act, 0),  # rid_act
         rules[..., 1] & 0xFF,          # protocol
         (rules[..., 2] >> 8) & 0xFF,   # dstPortStart hi
         rules[..., 2] & 0xFF,          # dstPortStart lo
@@ -118,22 +147,28 @@ def build_pallas_tables(tables: CompiledTables) -> PallasTables:
         rules[..., 3] & 0xFF,          # dstPortEnd lo
         rules[..., 4] & 0xFF,          # icmpType
         rules[..., 5] & 0xFF,          # icmpCode
-        rules[..., 6] & 0xFF,          # action
     ]
     for f, vals in enumerate(fields):
         rb[:T, f * RULE_PAD : f * RULE_PAD + R] = vals
 
+    if dtype == "int8":
+        return PallasTables(
+            mdt=jnp.asarray(mdt, jnp.int8),
+            m1sum=jnp.asarray(m1sum, jnp.int32),
+            mask_len=jnp.asarray(mask_len),
+            rules_bytes=jnp.asarray(rb - 128.0, jnp.int8),
+        )
     return PallasTables(
-        m0t=jnp.asarray(m0t, jnp.bfloat16),
-        m1t=jnp.asarray(m1t, jnp.bfloat16),
+        mdt=jnp.asarray(mdt, jnp.bfloat16),
+        m1sum=jnp.asarray(m1sum, jnp.float32),
         mask_len=jnp.asarray(mask_len),
         rules_bytes=jnp.asarray(rb, jnp.bfloat16),
     )
 
 
-def _classify_kernel(fields_ref, words_ref, m0_ref, m1_ref, mlen_ref, rules_ref, out_ref):
+def _classify_kernel(fields_ref, words_ref, md_ref, m1s_ref, mlen_ref, rules_ref, out_ref):
     Bb = fields_ref.shape[0]
-    Tp = m0_ref.shape[1]
+    Tp = md_ref.shape[1]
 
     kind = fields_ref[:, 0:1]
     proto = fields_ref[:, 2:3]
@@ -141,27 +176,30 @@ def _classify_kernel(fields_ref, words_ref, m0_ref, m1_ref, mlen_ref, rules_ref,
     itype = fields_ref[:, 4:5]
     icode = fields_ref[:, 5:6]
 
+    mm_dtype = md_ref.dtype  # bf16 or int8 — selects the MXU path
+    acc_dtype = jnp.int32 if mm_dtype == jnp.int8 else jnp.float32
+
     # --- 1. unpack the 160-bit LPM key ------------------------------------
     iota32 = jax.lax.broadcasted_iota(jnp.int32, (Bb, 32), 1)
     pieces = []
     for w in range(5):
         word = fields_ref[:, 1:2] if w == 0 else words_ref[:, w - 1 : w]
         pieces.append(
-            (jax.lax.shift_right_logical(word, 31 - iota32) & 1).astype(jnp.bfloat16)
+            (jax.lax.shift_right_logical(word, 31 - iota32) & 1).astype(mm_dtype)
         )
     bits = jnp.concatenate(pieces, axis=1)  # (Bb, 160) in {0,1}
 
-    # --- 2. LPM: in-mask mismatch counts via two bf16 MXU matmuls ---------
+    # --- 2. LPM: in-mask mismatch counts via ONE MXU matmul ---------------
+    # bits@M0 + (1-bits)@M1 == bits@(M0-M1) + rowsum(M1); all terms are
+    # small integers, exact on both the bf16->f32 and s8->s32 paths.
     dn = (((1,), (0,)), ((), ()))
     mism = jax.lax.dot_general(
-        bits, m0_ref[:, :], dn, preferred_element_type=jnp.float32
-    ) + jax.lax.dot_general(
-        (1 - bits), m1_ref[:, :], dn, preferred_element_type=jnp.float32
-    )  # (Bb, Tp) exact small-integer counts in f32
+        bits, md_ref[:, :], dn, preferred_element_type=acc_dtype
+    ) + m1s_ref[:, :]  # (Bb, Tp) exact small-integer counts
 
     mlen = mlen_ref[:, :]  # (1, Tp); -1 marks padding
     cap = jnp.where(kind == KIND_IPV4, 32, 128)  # (Bb, 1)
-    ok = (mism == 0.0) & (mlen >= 0) & (mlen <= cap)
+    ok = (mism == jnp.zeros((), acc_dtype)) & (mlen >= 0) & (mlen <= cap)
     score = jnp.where(ok, mlen + 1, 0)  # (Bb, Tp)
     best = jnp.max(score, axis=1, keepdims=True)
     iota_t = jax.lax.broadcasted_iota(jnp.int32, (Bb, Tp), 1)
@@ -175,19 +213,24 @@ def _classify_kernel(fields_ref, words_ref, m0_ref, m1_ref, mlen_ref, rules_ref,
 
     # --- 3. rule-row fetch: one-hot @ rule bytes on the MXU ---------------
     # tidx == Tp (no match) produces an all-zero row -> ruleId 0 -> UNDEF.
-    onehot = (iota_t == tidx).astype(jnp.bfloat16)  # (Bb, Tp)
+    onehot = (iota_t == tidx).astype(mm_dtype)  # (Bb, Tp)
     rowb = jax.lax.dot_general(
-        onehot, rules_ref[:, :], dn, preferred_element_type=jnp.float32
-    ).astype(jnp.int32)  # (Bb, 9*RULE_PAD) — one-hot sums are exact bytes
+        onehot, rules_ref[:, :], dn, preferred_element_type=acc_dtype
+    ).astype(jnp.int32)  # (Bb, 8*RULE_PAD) — one-hot sums are exact bytes
+    if mm_dtype == jnp.int8:
+        # int8 rule bytes are stored biased by -128; add the bias back for
+        # matched packets (no-match rows must stay all-zero -> UNDEF).
+        rowb = rowb + jnp.where(matched, 128, 0)
 
     R = RULE_PAD
-    rid = rowb[:, 0 * R : 1 * R]
+    rid_act = rowb[:, 0 * R : 1 * R]
+    rid = jax.lax.shift_right_logical(rid_act, 1)
+    act = (rid_act & 1) + 1  # {0,1} -> {DENY=1, ALLOW=2}; unused when rid==0
     rproto = rowb[:, 1 * R : 2 * R]
     ps = rowb[:, 2 * R : 3 * R] * 256 + rowb[:, 3 * R : 4 * R]
     pe = rowb[:, 4 * R : 5 * R] * 256 + rowb[:, 5 * R : 6 * R]
     it = rowb[:, 6 * R : 7 * R]
     ic = rowb[:, 7 * R : 8 * R]
-    act = rowb[:, 8 * R : 9 * R]
 
     # --- 4. ordered first-match scan (kernel.c:222-258) -------------------
     valid = rid != 0
@@ -217,35 +260,37 @@ def _classify_kernel(fields_ref, words_ref, m0_ref, m1_ref, mlen_ref, rules_ref,
 
 
 def _pallas_scan(
-    fields: jax.Array, words: jax.Array, pt: PallasTables, interpret: bool
+    fields: jax.Array, words: jax.Array, pt: PallasTables, interpret: bool,
+    block_b: int,
 ) -> jax.Array:
     B = fields.shape[0]
-    Tp = pt.m0t.shape[1]
-    grid = (B // BLOCK_B,)
+    Tp = pt.mdt.shape[1]
+    grid = (B // block_b,)
     return pl.pallas_call(
         _classify_kernel,
         out_shape=jax.ShapeDtypeStruct((B, 2), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((BLOCK_B, 8), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_B, 4), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 8), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 4), lambda i: (i, 0)),
             pl.BlockSpec((KEY_BITS, Tp), lambda i: (0, 0)),
-            pl.BlockSpec((KEY_BITS, Tp), lambda i: (0, 0)),
+            pl.BlockSpec((1, Tp), lambda i: (0, 0)),
             pl.BlockSpec((1, Tp), lambda i: (0, 0)),
             pl.BlockSpec((Tp, NUM_FIELDS * RULE_PAD), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((BLOCK_B, 2), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_b, 2), lambda i: (i, 0)),
         interpret=interpret,
-    )(fields, words, pt.m0t, pt.m1t, pt.mask_len, pt.rules_bytes)
+    )(fields, words, pt.mdt, pt.m1sum, pt.mask_len, pt.rules_bytes)
 
 
 def classify_pallas(
-    pt: PallasTables, batch: DeviceBatch, interpret: bool = False
+    pt: PallasTables, batch: DeviceBatch, interpret: bool = False,
+    block_b: int = BLOCK_B,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full forward pass via the Pallas kernel; returns (results, xdp,
     stats) identical to jaxpath.classify."""
     B = batch.kind.shape[0]
-    Bp = _round_up(max(B, 1), BLOCK_B)
+    Bp = _round_up(max(B, 1), block_b)
     pad = Bp - B
 
     fields = jnp.stack(
@@ -268,14 +313,22 @@ def classify_pallas(
         fields = jnp.concatenate([fields, pad_fields], axis=0)
         words = jnp.concatenate([words, jnp.zeros((pad, 4), jnp.int32)], axis=0)
 
-    out = _pallas_scan(fields, words, pt, interpret)[:B]
+    out = _pallas_scan(fields, words, pt, interpret, block_b)[:B]
     raw_result = out[:, 0].astype(jnp.uint32)
     return finalize(raw_result, batch)
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify_pallas(interpret: bool):
-    return jax.jit(functools.partial(classify_pallas, interpret=interpret))
+def _jitted_classify_pallas(interpret: bool, block_b: int):
+    return jax.jit(
+        functools.partial(classify_pallas, interpret=interpret, block_b=block_b)
+    )
+
+
+def jitted_classify_pallas(interpret: bool, block_b: int = BLOCK_B):
+    """Cached jit wrapper; the cache key is normalized so callers that omit
+    block_b share the entry with callers passing BLOCK_B explicitly."""
+    return _jitted_classify_pallas(interpret, block_b)
 
 
 def default_interpret() -> bool:

@@ -9,9 +9,9 @@ from infw.compiler import LpmKey, compile_tables_from_content
 from infw.kernels import jaxpath, pallas_dense
 
 
-def assert_pallas_matches(tables, batch):
+def assert_pallas_matches(tables, batch, dtype=pallas_dense.DEFAULT_DTYPE):
     ref = oracle.classify(tables, batch)
-    pt = pallas_dense.build_pallas_tables(tables)
+    pt = pallas_dense.build_pallas_tables(tables, dtype=dtype)
     db = jaxpath.device_batch(batch)
     res, xdp, stats = pallas_dense.jitted_classify_pallas(True)(pt, db)
     np.testing.assert_array_equal(np.asarray(res), ref.results)
@@ -20,12 +20,13 @@ def assert_pallas_matches(tables, batch):
     assert got == ref.stats
 
 
+@pytest.mark.parametrize("dtype", ["int8", "bf16"])
 @pytest.mark.parametrize("seed", [0, 5])
-def test_pallas_random_differential(seed):
+def test_pallas_random_differential(seed, dtype):
     rng = np.random.default_rng(seed)
     tables = testing.random_tables(rng, n_entries=40, width=12, stride=4)
     batch = testing.random_batch(rng, tables, n_packets=300)
-    assert_pallas_matches(tables, batch)
+    assert_pallas_matches(tables, batch, dtype=dtype)
 
 
 def test_pallas_non_block_multiple_batch():
