@@ -1,0 +1,305 @@
+"""The cluster manager: controller runtime wiring both reconcilers, the
+admission webhook, and the NodeState export path.
+
+Equivalent of the reference's manager binary (/root/reference/main.go):
+env contract DAEMONSET_IMAGE / DAEMONSET_NAMESPACE (:87-99), webhook
+registration behind a toggle (:142-147), platform probe (:149-154),
+controller setup with watches (:132-140, 155-164), healthz endpoint and
+blocking run loop (:101-126, 177).
+
+The watch->workqueue->reconcile shape mirrors controller-runtime: events
+coalesce in a debounced queue, reconciles run on a worker thread, and a
+config reconcile returning requeue_after is rescheduled (the 5s
+requeue-while-progressing, ingressnodefirewallconfig_controller.go:94-107).
+
+NodeState export: when an ``export_dir`` is configured, every NodeState
+write/delete is mirrored to ``<export_dir>/nodestates/<node>.json`` — the
+file protocol the daemon watches — so manager and daemons compose across
+process boundaries the way the reference's manager and DaemonSet compose
+through the k8s API.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import queue
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from . import platform as platform_mod
+from . import validate
+from .controllers import (
+    DEFAULT_CONFIG_NAME,
+    IngressNodeFirewallConfigReconciler,
+    IngressNodeFirewallReconciler,
+)
+from .spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+    IngressNodeFirewallNodeState,
+)
+from .store import DELETED, InMemoryStore, Node
+
+log = logging.getLogger("infw.manager")
+
+DEFAULT_METRICS_PORT = 39201  # main.go:63
+DEFAULT_HEALTH_PORT = 8081    # main.go:65
+
+
+def inf_admission(obj: IngressNodeFirewall, store: InMemoryStore) -> List[str]:
+    """The validating webhook hooked into the store's admission seam
+    (webhook.go ValidateCreate/Update: validate against all *other*
+    existing IngressNodeFirewalls)."""
+    existing = [
+        o
+        for o in store.list(IngressNodeFirewall.KIND)
+        if o.metadata.name != obj.metadata.name
+    ]
+    return validate.validate_ingress_node_firewall(obj, existing)
+
+
+class Manager:
+    def __init__(
+        self,
+        store: Optional[InMemoryStore] = None,
+        namespace: str = "ingress-node-firewall-system",
+        daemon_image: str = "infw-daemon:latest",
+        enable_webhook: bool = True,
+        export_dir: Optional[str] = None,
+        metrics_port: int = DEFAULT_METRICS_PORT,
+        health_port: int = DEFAULT_HEALTH_PORT,
+    ) -> None:
+        self.store = store if store is not None else InMemoryStore()
+        self.namespace = namespace
+        self.platform = platform_mod.get_platform_info()
+        backend = "tpu" if self.platform.is_tpu else "cpu"
+        self.fanout = IngressNodeFirewallReconciler(self.store, namespace=namespace)
+        self.config = IngressNodeFirewallConfigReconciler(
+            self.store, namespace=namespace, daemon_image=daemon_image, backend=backend
+        )
+        self.metrics_port = metrics_port
+        self.health_port = health_port
+        self.reconcile_counts = {"fanout": 0, "config": 0}
+
+        if enable_webhook:
+            self.store.set_admission(IngressNodeFirewall.KIND, inf_admission)
+
+        self.export_dir: Optional[str] = None
+        if export_dir:
+            self.export_dir = os.path.join(export_dir, "nodestates")
+            os.makedirs(self.export_dir, exist_ok=True)
+
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._servers: List[ThreadingHTTPServer] = []
+        self._requeue_timers: dict = {}  # config name -> outstanding Timer
+        self._watch_cancels: List = []
+
+        # Watches (SetupWithManager): the fan-out controller reconciles on
+        # INF + Node + owned NodeState events
+        # (ingressnodefirewall_controller.go:239-249); the config controller
+        # on Config events.
+        for kind in (IngressNodeFirewall.KIND, Node.KIND):
+            self._watch_cancels.append(
+                self.store.watch(kind, lambda e, o: self.enqueue_fanout())
+            )
+        self._watch_cancels.append(
+            self.store.watch(IngressNodeFirewallNodeState.KIND, self._on_nodestate_event)
+        )
+        self._watch_cancels.append(
+            self.store.watch(
+                IngressNodeFirewallConfig.KIND,
+                lambda e, o: self.enqueue_config(o.metadata.name),
+            )
+        )
+
+    # -- work queue ----------------------------------------------------------
+
+    def enqueue_fanout(self) -> None:
+        self._queue.put(("fanout", None))
+
+    def enqueue_config(self, name: str) -> None:
+        self._queue.put(("config", name))
+
+    def _on_nodestate_event(self, event: str, obj) -> None:
+        if self.export_dir is not None:
+            path = os.path.join(self.export_dir, f"{obj.metadata.name}.json")
+            if event == DELETED:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+            else:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(obj.to_dict(), f)
+                os.replace(tmp, path)
+        # Owned-object watch: NodeState drift — including out-of-band
+        # deletion — triggers the owner's reconcile (Owns(&NodeState),
+        # :247); self-initiated deletes converge in one no-write pass since
+        # the store suppresses no-op writes.
+        self.enqueue_fanout()
+
+    def process_one(self, block: bool = True, timeout: Optional[float] = None) -> bool:
+        """Run one queued reconcile; returns False when the queue is empty
+        (non-blocking mode) or the stop flag is set."""
+        try:
+            item = self._queue.get(block=block, timeout=timeout)
+        except queue.Empty:
+            return False
+        kind, arg = item
+        # Debounce: collapse consecutive duplicate requests.
+        try:
+            while True:
+                nxt = self._queue.get_nowait()
+                if nxt != item:
+                    self._queue.put(nxt)
+                    break
+        except queue.Empty:
+            pass
+        try:
+            if kind == "fanout":
+                self.fanout.reconcile()
+                self.reconcile_counts["fanout"] += 1
+            elif kind == "config":
+                result = self.config.reconcile(arg)
+                self.reconcile_counts["config"] += 1
+                if result.requeue_after is not None and not self._stop.is_set():
+                    # One outstanding requeue per config: cancel-and-replace
+                    # so a progressing deployment never accumulates timers.
+                    old = self._requeue_timers.pop(arg, None)
+                    if old is not None:
+                        old.cancel()
+                    t = threading.Timer(
+                        result.requeue_after, lambda: self.enqueue_config(arg)
+                    )
+                    t.daemon = True
+                    t.start()
+                    self._requeue_timers[arg] = t
+        except Exception as e:  # reconcile errors are logged, never fatal
+            log.error("%s reconcile failed: %s", kind, e)
+        return True
+
+    def drain(self) -> None:
+        """Process queued work until empty (test helper — the equivalent of
+        envtest's Eventually())."""
+        while self.process_one(block=False):
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            self.process_one(block=True, timeout=0.2)
+
+    def _make_handler(mgr):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    self._send(200, "ok")
+                elif self.path == "/metrics":
+                    lines = [
+                        "# TYPE ingressnodefirewall_manager_reconcile_total counter"
+                    ]
+                    for k, v in mgr.reconcile_counts.items():
+                        lines.append(
+                            f'ingressnodefirewall_manager_reconcile_total{{controller="{k}"}} {v}'
+                        )
+                    self._send(200, "\n".join(lines) + "\n")
+                else:
+                    self._send(404, "not found")
+
+        return Handler
+
+    def start(self) -> None:
+        handler = self._make_handler()
+        for port in {self.metrics_port, self.health_port}:
+            srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
+            self._servers.append(srv)
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()
+        self._threads.append(t)
+        # Initial full reconciles (the List-driven state resync on start).
+        self.enqueue_fanout()
+        self.enqueue_config(DEFAULT_CONFIG_NAME)
+        log.info(
+            "manager started namespace=%s platform=%s devices=%d",
+            self.namespace, self.platform.backend, self.platform.num_devices,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        for cancel in self._watch_cancels:
+            cancel()
+        for t in self._requeue_timers.values():
+            t.cancel()
+        self._requeue_timers.clear()
+        for srv in self._servers:
+            srv.shutdown()
+            srv.server_close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry enforcing the env contract (main.go:87-99)."""
+    p = argparse.ArgumentParser(prog="infw-manager")
+    p.add_argument("--export-dir", default=None,
+                   help="mirror NodeStates to <dir>/nodestates for file-driven daemons")
+    p.add_argument("--namespace", default=os.environ.get(
+        "DAEMONSET_NAMESPACE", ""))
+    p.add_argument("--daemon-image", default=os.environ.get("DAEMONSET_IMAGE", ""))
+    p.add_argument("--enable-webhook", action="store_true", default=True)
+    p.add_argument("--disable-webhook", dest="enable_webhook", action="store_false")
+    p.add_argument("--metrics-port", type=int, default=DEFAULT_METRICS_PORT)
+    p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
+    args = p.parse_args(argv)
+
+    # Mirrors the hard env guards at main.go:87-99.
+    if not args.daemon_image:
+        p.error("DAEMONSET_IMAGE environment variable or --daemon-image must be set")
+    if not args.namespace:
+        p.error("DAEMONSET_NAMESPACE environment variable or --namespace must be set")
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    mgr = Manager(
+        namespace=args.namespace,
+        daemon_image=args.daemon_image,
+        enable_webhook=args.enable_webhook,
+        export_dir=args.export_dir,
+        metrics_port=args.metrics_port,
+        health_port=args.health_port,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    mgr.start()
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

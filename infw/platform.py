@@ -1,0 +1,54 @@
+"""Platform detection.
+
+Equivalent of the reference's pkg/platform
+(/root/reference/pkg/platform/platform.go): a probe of the running
+environment whose result feeds the deployment render (the reference
+probes the discovery API for the `route.openshift.io` group to decide
+OpenShift vs vanilla k8s, :94-101, consumed at
+ingressnodefirewallconfig_controller.go:138).  Here the meaningful
+environment facts are the accelerator platform: which JAX backend is
+live, the device kind, and how many chips are attached — consumed to pick
+the daemon backend and mesh shape.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class PlatformInfo:
+    """PlatformInfo (pkg/platform/types.go)."""
+
+    backend: str           # "tpu" | "cpu" | "gpu" | ...
+    device_kind: str       # e.g. "TPU v5 lite"
+    num_devices: int
+    device_platforms: List[str]
+
+    @property
+    def is_tpu(self) -> bool:
+        """The IsOpenShift() analogue: the capability bit deployment
+        rendering branches on (types.go:32)."""
+        return self.backend == "tpu"
+
+
+def get_platform_info() -> PlatformInfo:
+    """GetPlatformInfo (platform.go:34-104).  Probes lazily and degrades
+    to a CPU-only report if JAX cannot initialize a backend."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        backend = jax.default_backend()
+        kind = devices[0].device_kind if devices else ""
+        platforms = sorted({d.platform for d in devices})
+        return PlatformInfo(
+            backend=backend,
+            device_kind=kind,
+            num_devices=len(devices),
+            device_platforms=platforms,
+        )
+    except Exception:
+        return PlatformInfo(
+            backend="cpu", device_kind="", num_devices=0, device_platforms=[]
+        )

@@ -47,6 +47,15 @@ class AlreadyExistsError(StoreError):
     pass
 
 
+class AdmissionError(StoreError):
+    """Rejection by an admission validator (the webhook's deny response,
+    pkg/webhook/webhook.go:50-66)."""
+
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = list(errors)
+
+
 @dataclass
 class Node:
     """Minimal cluster Node: metadata only (the fan-out controller matches
@@ -145,7 +154,27 @@ class InMemoryStore:
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str, str], object] = {}
         self._watchers: Dict[str, List[WatchCallback]] = {}
+        self._admission: Dict[str, Callable] = {}
         self._rv = 0
+
+    # -- admission (the validating-webhook seam) -----------------------------
+
+    def set_admission(self, kind: str, validator: Callable) -> None:
+        """Register an admission validator for a kind: called on create and
+        update (not status/finalizer writes, matching the reference
+        webhook's Create/Update hooks) with (obj, store); a non-empty error
+        list rejects the write with AdmissionError."""
+        with self._lock:
+            self._admission[kind] = validator
+
+    def _admit(self, obj) -> None:
+        with self._lock:
+            validator = self._admission.get(obj.KIND)
+        if validator is None:
+            return
+        errors = validator(obj, self)
+        if errors:
+            raise AdmissionError(list(errors))
 
     # -- keys ----------------------------------------------------------------
 
@@ -192,6 +221,11 @@ class InMemoryStore:
 
     def create(self, obj) -> object:
         with self._lock:
+            # Admission inside the lock: cross-object invariants (e.g. the
+            # cross-INF order-overlap check) must validate against the same
+            # state the write commits into; the RLock makes the validator's
+            # own store reads re-entrant.
+            self._admit(obj)
             key = self._key_of(obj)
             if key in self._objects:
                 raise AlreadyExistsError(f"{key} already exists")
@@ -214,6 +248,7 @@ class InMemoryStore:
         carried over from the stored object, mirroring the API server's
         split."""
         with self._lock:
+            self._admit(obj)
             key = self._key_of(obj)
             cur = self._objects.get(key)
             if cur is None:
@@ -223,6 +258,12 @@ class InMemoryStore:
                 stored.status = deep_copy(cur.status) if hasattr(cur.status, "to_dict") else cur.status
             stored.metadata.uid = cur.metadata.uid
             stored.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+            # No-op updates don't bump the version or fire watches (API-server
+            # semantics — this is what lets level-based reconciles that write
+            # back unchanged state converge instead of livelocking).
+            stored.metadata.resource_version = cur.metadata.resource_version
+            if stored.to_dict() == cur.to_dict():
+                return _copy(cur)
             self._rv += 1
             stored.metadata.resource_version = self._rv
             self._objects[key] = stored
@@ -236,10 +277,18 @@ class InMemoryStore:
             cur = self._objects.get(key)
             if cur is None:
                 raise NotFoundError(f"{key} not found")
-            stored = _copy(cur)
-            stored.status = (
+            new_status = (
                 deep_copy(obj.status) if hasattr(obj.status, "to_dict") else obj.status
             )
+            same = (
+                new_status.to_dict() == cur.status.to_dict()
+                if hasattr(new_status, "to_dict")
+                else new_status == cur.status
+            )
+            if same:  # no-op status write (see update())
+                return _copy(cur)
+            stored = _copy(cur)
+            stored.status = new_status
             self._rv += 1
             stored.metadata.resource_version = self._rv
             self._objects[key] = stored

@@ -1,0 +1,212 @@
+"""Manager runtime tests: admission webhook seam, watch-driven
+reconciles, config requeue, NodeState file export, and the full
+manager->file->daemon composition (the port of the reference's e2e
+operator-deployment flow onto the file protocol)."""
+import json
+import os
+import time
+
+import pytest
+
+from infw.manager import Manager, inf_admission, main as manager_main
+from infw.platform import get_platform_info
+from infw.spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+    IngressNodeFirewallConfigSpec,
+    IngressNodeFirewallNodeState,
+    IngressNodeFirewallSpec,
+    ObjectMeta,
+)
+from infw.store import (
+    AdmissionError,
+    DaemonSet,
+    DaemonSetStatus,
+    InMemoryStore,
+    Node,
+    NotFoundError,
+)
+from infw.controllers import DEFAULT_CONFIG_NAME
+from test_syncer import ingress, tcp_rule, udp_rule
+
+NS = "ingress-node-firewall-system"
+WORKER = {"role": "worker"}
+
+
+def inf(name, selector, ingress_rules, interfaces=("eth0",)):
+    return IngressNodeFirewall(
+        metadata=ObjectMeta(name=name),
+        spec=IngressNodeFirewallSpec(
+            node_selector=dict(selector),
+            ingress=list(ingress_rules),
+            interfaces=list(interfaces),
+        ),
+    )
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    m = Manager(namespace=NS, export_dir=str(tmp_path / "export"))
+    yield m
+    m.stop()
+
+
+# --- admission webhook seam ---------------------------------------------------
+
+def test_admission_rejects_invalid_interface(mgr):
+    bad = inf("fw", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])],
+              interfaces=("3eth",))
+    with pytest.raises(AdmissionError, match="can't start with a number"):
+        mgr.store.create(bad)
+
+
+def test_admission_rejects_failsafe_conflict(mgr):
+    # TCP 6443 (kube API) is failsafe: a Deny rule covering it is rejected
+    # (webhook.go:199-243).
+    bad = inf("fw", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, "6000-7000", ACTION_DENY)])])
+    with pytest.raises(AdmissionError, match="conflict with access"):
+        mgr.store.create(bad)
+    # Allow over the same range is fine (webhook.go:219-223).
+    ok = inf("fw", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, "6000-7000", ACTION_ALLOW)])])
+    mgr.store.create(ok)
+
+
+def test_admission_rejects_cross_inf_order_overlap(mgr):
+    mgr.store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    with pytest.raises(AdmissionError, match="conflicts with IngressNodeFirewall"):
+        mgr.store.create(
+            inf("fw2", WORKER, [ingress(["10.0.0.0/8"], [udp_rule(1, 53, ACTION_DENY)])])
+        )
+    # distinct orders are admitted
+    mgr.store.create(
+        inf("fw2", WORKER, [ingress(["10.0.0.0/8"], [udp_rule(2, 53, ACTION_DENY)])])
+    )
+
+
+def test_admission_self_update_allowed(mgr):
+    fw = inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])])
+    mgr.store.create(fw)
+    fw.spec.ingress[0].rules[0].protocol_config.tcp.ports = 81
+    mgr.store.update(fw)  # must not conflict with itself
+
+
+# --- watch-driven reconciles + export ----------------------------------------
+
+def test_watch_driven_fanout_and_export(mgr, tmp_path):
+    mgr.store.create(Node(metadata=ObjectMeta(name="w0", labels=WORKER)))
+    mgr.store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    mgr.drain()
+    ns_obj = mgr.store.get(IngressNodeFirewallNodeState.KIND, "w0", NS)
+    assert "eth0" in ns_obj.spec.interface_ingress_rules
+
+    export = tmp_path / "export" / "nodestates" / "w0.json"
+    assert export.exists()
+    doc = json.loads(export.read_text())
+    assert doc["metadata"]["name"] == "w0"
+
+    # INF deletion -> NodeState deleted -> export file removed
+    mgr.store.delete(IngressNodeFirewall.KIND, "fw1")
+    mgr.drain()
+    assert not export.exists()
+
+
+def test_out_of_band_nodestate_deletion_repaired(mgr):
+    """Owns(&NodeState) semantics: deleting a NodeState out-of-band while
+    its INF still selects the node must recreate it on the next drain."""
+    mgr.store.create(Node(metadata=ObjectMeta(name="w0", labels=WORKER)))
+    mgr.store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    mgr.drain()
+    mgr.store.delete(IngressNodeFirewallNodeState.KIND, "w0", NS)
+    mgr.drain()
+    assert mgr.store.get(IngressNodeFirewallNodeState.KIND, "w0", NS)
+
+
+def test_stopped_manager_cancels_watches(tmp_path):
+    store = InMemoryStore()
+    m = Manager(store=store, namespace=NS, export_dir=str(tmp_path / "e"))
+    m.stop()
+    store.create(Node(metadata=ObjectMeta(name="w0", labels=WORKER)))
+    store.create(inf("fw1", WORKER, [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]))
+    assert m._queue.qsize() == 0  # no events land on the dead queue
+
+
+def test_config_reconcile_conditions(mgr):
+    mgr.store.create(
+        IngressNodeFirewallConfig(
+            metadata=ObjectMeta(name=DEFAULT_CONFIG_NAME, namespace=NS),
+            spec=IngressNodeFirewallConfigSpec(),
+        )
+    )
+    mgr.drain()
+    ds = mgr.store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS)
+    ds.status = DaemonSetStatus(desired_number_scheduled=1, number_ready=1)
+    mgr.store.update_status(ds)
+    mgr.enqueue_config(DEFAULT_CONFIG_NAME)
+    mgr.drain()
+    cfg = mgr.store.get(IngressNodeFirewallConfig.KIND, DEFAULT_CONFIG_NAME, NS)
+    assert {c.type: c.status for c in cfg.status.conditions}["Available"] == "True"
+
+
+# --- full manager -> file -> daemon composition -------------------------------
+
+def test_manager_daemon_file_composition(tmp_path):
+    from infw.daemon import Daemon
+    from infw.interfaces import Interface, InterfaceRegistry
+    from infw.obs.pcap import build_frame
+    from infw.daemon import write_frames_file
+
+    shared = str(tmp_path / "shared")
+    mgr = Manager(namespace=NS, export_dir=shared)
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2))
+    daemon = Daemon(
+        state_dir=shared, node_name="w0", namespace=NS, backend="cpu",
+        registry=reg, metrics_port=0, health_port=0, file_poll_interval_s=0.02,
+        poll_period_s=0.05,
+    )
+    daemon.start()
+    try:
+        mgr.store.create(Node(metadata=ObjectMeta(name="w0", labels=WORKER)))
+        mgr.store.create(
+            inf("fw1", WORKER, [ingress(["0.0.0.0/0"], [tcp_rule(1, 8080, ACTION_DENY)])])
+        )
+        mgr.drain()
+
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            daemon.syncer.classifier is None or daemon.syncer.classifier.tables is None
+        ):
+            time.sleep(0.02)
+        assert daemon.syncer.classifier is not None
+
+        frames = [build_frame("1.2.3.4", "10.0.0.1", 6, 1, 8080),
+                  build_frame("1.2.3.4", "10.0.0.1", 6, 1, 8081)]
+        write_frames_file(os.path.join(daemon.ingest_dir, "x.frames"), frames, 2)
+        vp = os.path.join(daemon.out_dir, "x.frames.verdicts.json")
+        while time.time() < deadline and not os.path.exists(vp):
+            time.sleep(0.02)
+        with open(vp) as f:
+            summary = json.load(f)
+        assert summary["drop"] == 1 and summary["pass"] == 1
+    finally:
+        daemon.stop()
+        mgr.stop()
+
+
+# --- CLI env contract ---------------------------------------------------------
+
+def test_main_requires_env(monkeypatch, capsys):
+    monkeypatch.delenv("DAEMONSET_IMAGE", raising=False)
+    monkeypatch.delenv("DAEMONSET_NAMESPACE", raising=False)
+    with pytest.raises(SystemExit):
+        manager_main([])
+    assert "DAEMONSET_IMAGE" in capsys.readouterr().err
+
+
+def test_platform_info():
+    info = get_platform_info()
+    assert info.backend  # cpu in tests
+    assert info.num_devices >= 1
+    assert isinstance(info.is_tpu, bool)
