@@ -1,0 +1,35 @@
+# Build/test entry points (the reference's Makefile equivalent, reduced to
+# what is meaningful for the TPU framework: /root/reference/Makefile's
+# test / test-race / ebpf-generate / bench roles).
+
+PY ?= python
+
+.PHONY: test test-fast bench native entry-check dryrun-multichip clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x
+
+# One JSON line on stdout; diagnostics on stderr (driver contract).
+bench:
+	$(PY) bench.py
+
+# Build the native C++ reference classifier explicitly (normally built
+# on demand by infw.backend.cpu_ref — the bpf2go-generate analogue).
+native:
+	$(MAKE) -C infw/backend/native
+
+# Single-chip compile check of the flagship forward step.
+entry-check:
+	$(PY) -c "import __graft_entry__ as g, jax; fn, args = g.entry(); \
+	jax.block_until_ready(jax.jit(fn)(*args)); print('entry OK')"
+
+# Full distributed step on a virtual 8-device CPU mesh.
+dryrun-multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -rf infw/backend/native/_build **/__pycache__ .pytest_cache
