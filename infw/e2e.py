@@ -1,0 +1,302 @@
+"""Declarative e2e reachability harness.
+
+Port of the reference functional suite's table engine
+(/root/reference/test/e2e/functional/tests/e2e.go:59-176,856+): test cases
+describe virtual client/server pods, generate IngressNodeFirewall CRs from
+the pods' IPs (sourceCIDRs = pod IP masked to a prefix, orders generated
+unique per CIDR), drive the FULL stack (admission -> fan-out -> NodeState
+-> syncer -> classifier), then assert a ``Reachable`` table.  Where the
+reference probes with real netcat/ping pods, this harness synthesizes the
+equivalent raw frames (obs.pcap.build_frame) and asserts the classifier
+verdict — PASS == reachable, DROP == unreachable (SURVEY.md §4 carry-over).
+"""
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .backend.cpu_ref import CpuRefClassifier
+from .constants import (
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    XDP_DROP,
+    XDP_PASS,
+)
+from .interfaces import Interface, InterfaceRegistry
+from .manager import Manager
+from .obs.pcap import build_frame, parse_frames
+from .spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallICMPRule,
+    IngressNodeFirewallNodeState,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallRules,
+    IngressNodeFirewallSpec,
+    IngressNodeProtocolConfig,
+    ObjectMeta,
+    PROTOCOL_TYPE_ICMP,
+    PROTOCOL_TYPE_ICMP6,
+    PROTOCOL_TYPE_SCTP,
+    PROTOCOL_TYPE_TCP,
+    PROTOCOL_TYPE_UDP,
+)
+from .store import Node
+from .syncer import DataplaneSyncer
+
+_PROTO_NUM = {
+    PROTOCOL_TYPE_TCP: IPPROTO_TCP,
+    PROTOCOL_TYPE_UDP: IPPROTO_UDP,
+    PROTOCOL_TYPE_SCTP: IPPROTO_SCTP,
+    PROTOCOL_TYPE_ICMP: IPPROTO_ICMP,
+    PROTOCOL_TYPE_ICMP6: IPPROTO_ICMPV6,
+}
+
+RuleTemplate = Callable[[str, int], IngressNodeFirewallProtocolRule]
+
+
+@dataclass
+class Pod:
+    """A virtual client/server endpoint (the reference's netcat pods)."""
+
+    name: str
+    ipv4: str = ""
+    ipv6: str = ""
+
+    def ip(self, family: int) -> str:
+        return self.ipv4 if family == 4 else self.ipv6
+
+
+@dataclass
+class SourceCIDRsEntry:
+    """sourceCIDRsEntry (e2e.go:76-84): a pod whose IP, masked to the
+    given prefixes, becomes the generated sourceCIDR(s)."""
+
+    pod_name: str
+    v4_prefix: int = 24
+    v6_prefix: int = 64
+
+
+@dataclass
+class TestRule:
+    """testRule (e2e.go:63-73): CIDR sources + protocol rule templates;
+    the harness generates unique orders per CIDR."""
+
+    __test__ = False  # keep pytest from collecting this dataclass
+
+    source_cidrs_entries: List[SourceCIDRsEntry]
+    proto_rules: List[RuleTemplate]
+
+
+@dataclass
+class Reachable:
+    """reachable (e2e.go:86-97)."""
+
+    source: str
+    destination: str
+    port: int = 0
+    connectivity: bool = True
+    protocol: str = PROTOCOL_TYPE_TCP
+    icmp_type: int = 8
+    icmp_code: int = 0
+
+
+def cidr_of(ip: str, v4_prefix: int, v6_prefix: int) -> str:
+    addr = ipaddress.ip_address(ip)
+    prefix = v4_prefix if addr.version == 4 else v6_prefix
+    net = ipaddress.ip_network(f"{ip}/{prefix}", strict=False)
+    return str(net)
+
+
+class Harness:
+    """Builds the stack once per scenario: manager store + fan-out +
+    in-process syncer fed by the generated NodeState."""
+
+    def __init__(
+        self,
+        pods: Sequence[Pod],
+        node_name: str = "e2e-node",
+        iface: str = "eth0",
+        ifindex: int = 2,
+        node_labels: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.pods = {p.name: p for p in pods}
+        self.node_name = node_name
+        self.iface = iface
+        self.ifindex = ifindex
+        self.node_labels = node_labels or {"do-node-ingress-firewall": "true"}
+        self.manager = Manager(namespace="e2e-ns")
+        self.manager.store.create(
+            Node(metadata=ObjectMeta(name=node_name, labels=dict(self.node_labels)))
+        )
+        self.registry = InterfaceRegistry()
+        self.registry.add(Interface(name=iface, index=ifindex))
+        self.syncer = DataplaneSyncer(
+            classifier_factory=CpuRefClassifier, registry=self.registry
+        )
+
+    def apply_rules(
+        self,
+        test_rules: List[TestRule],
+        interfaces: Optional[List[str]] = None,
+        families: Sequence[int] = (4, 6),
+        inf_name: str = "e2e-inf",
+        protocols: Optional[Dict[RuleTemplate, List[str]]] = None,
+    ) -> None:
+        """Generate the INF from the rule templates (order generated
+        unique per sourceCIDR, e2e.go:71-72) and run it through
+        admission + fan-out + sync."""
+        ingress: List[IngressNodeFirewallRules] = []
+        for tr in test_rules:
+            cidrs: List[str] = []
+            for entry in tr.source_cidrs_entries:
+                pod = self.pods[entry.pod_name]
+                for family in families:
+                    ip = pod.ip(family)
+                    if ip:
+                        cidrs.append(cidr_of(ip, entry.v4_prefix, entry.v6_prefix))
+            rules: List[IngressNodeFirewallProtocolRule] = []
+            order = 1
+            for template in tr.proto_rules:
+                # A template carries its natural protocol list (set by the
+                # factory); the protocols dict overrides per test case.
+                default = getattr(template, "default_protocols", [PROTOCOL_TYPE_TCP])
+                protos = (protocols or {}).get(template, default)
+                for proto in protos:
+                    rules.append(template(proto, order))
+                    order += 1
+            ingress.append(
+                IngressNodeFirewallRules(source_cidrs=cidrs, rules=rules)
+            )
+        inf = IngressNodeFirewall(
+            metadata=ObjectMeta(name=inf_name),
+            spec=IngressNodeFirewallSpec(
+                node_selector=dict(self.node_labels),
+                ingress=ingress,
+                interfaces=list(interfaces or [self.iface]),
+            ),
+        )
+        self.manager.store.create(inf)  # admission webhook runs here
+        self.resync()
+
+    def resync(self) -> None:
+        """Drain the manager queue and program the dataplane from the
+        resulting NodeState (also used after out-of-band spec updates)."""
+        self.manager.drain()
+        ns_obj = self.manager.store.get(
+            IngressNodeFirewallNodeState.KIND, self.node_name, "e2e-ns"
+        )
+        assert ns_obj.status.sync_status != "Error", ns_obj.status.sync_error_message
+        self.syncer.sync_interface_ingress_rules(
+            ns_obj.spec.interface_ingress_rules, False
+        )
+
+    def probe(self, r: Reachable, family: int = 4) -> bool:
+        """One connectivity probe: synthesize the frame the reference's
+        netcat/ping client would emit, classify, and report PASS."""
+        src = self.pods[r.source].ip(family)
+        dst = self.pods[r.destination].ip(family)
+        if not src or not dst:
+            raise ValueError(f"pod without family-{family} address")
+        proto = _PROTO_NUM[r.protocol]
+        if family == 6 and r.protocol == PROTOCOL_TYPE_ICMP:
+            proto = IPPROTO_ICMPV6
+        frame = build_frame(
+            src, dst, proto,
+            src_port=40001, dst_port=r.port,
+            icmp_type=r.icmp_type, icmp_code=r.icmp_code,
+        )
+        batch = parse_frames([frame], ifindex=self.ifindex)
+        out = self.syncer.classifier.classify(batch)
+        return int(out.xdp[0]) == XDP_PASS
+
+    def check_reachability(
+        self, table: List[Reachable], families: Sequence[int] = (4,)
+    ) -> List[str]:
+        """Assert the whole table; returns a list of human-readable
+        failures (empty == all expectations met)."""
+        failures = []
+        for r in table:
+            for family in families:
+                got = self.probe(r, family)
+                if got != r.connectivity:
+                    failures.append(
+                        f"{r.source}->{r.destination} proto={r.protocol} "
+                        f"port={r.port} family={family}: "
+                        f"expected connectivity={r.connectivity}, got {got}"
+                    )
+        return failures
+
+    def close(self) -> None:
+        self.manager.stop()
+        self.syncer.shutdown()
+
+
+# --- rule templates (the funcs the reference table passes, e2e.go:177+) ------
+# Each factory tags its template with default_protocols so forgetting the
+# protocols dict still instantiates a valid rule shape.
+
+def deny_port(port) -> RuleTemplate:
+    def template(proto: str, order: int) -> IngressNodeFirewallProtocolRule:
+        return _transport_rule(proto, order, port, "Deny")
+
+    template.default_protocols = [PROTOCOL_TYPE_TCP]
+    return template
+
+
+def allow_port(port) -> RuleTemplate:
+    def template(proto: str, order: int) -> IngressNodeFirewallProtocolRule:
+        return _transport_rule(proto, order, port, "Allow")
+
+    template.default_protocols = [PROTOCOL_TYPE_TCP]
+    return template
+
+
+def deny_icmp(icmp_type: int = 8, icmp_code: int = 0) -> RuleTemplate:
+    def template(proto: str, order: int) -> IngressNodeFirewallProtocolRule:
+        return _icmp_rule(proto, order, icmp_type, icmp_code, "Deny")
+
+    template.default_protocols = [
+        PROTOCOL_TYPE_ICMP if icmp_type < 128 else PROTOCOL_TYPE_ICMP6
+    ]
+    return template
+
+
+def deny_all() -> RuleTemplate:
+    def template(proto: str, order: int) -> IngressNodeFirewallProtocolRule:
+        return IngressNodeFirewallProtocolRule(
+            order=order,
+            protocol_config=IngressNodeProtocolConfig(protocol=""),
+            action="Deny",
+        )
+
+    template.default_protocols = [PROTOCOL_TYPE_TCP]  # instantiated once
+    return template
+
+
+def _transport_rule(proto, order, port, action):
+    pr = IngressNodeFirewallProtoRule(ports=port)
+    kw = {
+        PROTOCOL_TYPE_TCP: "tcp",
+        PROTOCOL_TYPE_UDP: "udp",
+        PROTOCOL_TYPE_SCTP: "sctp",
+    }[proto]
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(protocol=proto, **{kw: pr}),
+        action=action,
+    )
+
+
+def _icmp_rule(proto, order, icmp_type, icmp_code, action):
+    icmp = IngressNodeFirewallICMPRule(icmp_type=icmp_type, icmp_code=icmp_code)
+    kw = "icmp" if proto == PROTOCOL_TYPE_ICMP else "icmpv6"
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(protocol=proto, **{kw: icmp}),
+        action=action,
+    )

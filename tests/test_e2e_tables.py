@@ -1,0 +1,185 @@
+"""T4: declarative reachability tables through the FULL stack
+(admission webhook -> fan-out controller -> NodeState -> syncer ->
+classifier), the port of the reference functional suite's table-driven
+cases (/root/reference/test/e2e/functional/tests/e2e.go:177-980): netcat/
+ping probes become synthesized frames; connectivity == PASS verdict."""
+import pytest
+
+from infw.e2e import (
+    Harness,
+    Pod,
+    Reachable,
+    SourceCIDRsEntry,
+    TestRule,
+    allow_port,
+    deny_all,
+    deny_icmp,
+    deny_port,
+)
+from infw.spec import (
+    PROTOCOL_TYPE_ICMP,
+    PROTOCOL_TYPE_SCTP,
+    PROTOCOL_TYPE_TCP,
+    PROTOCOL_TYPE_UDP,
+)
+
+SERVER_ONE_PORT = 80
+SERVER_TWO_PORT = 8080
+ALLOWED_PORT = 40000
+SERVER_ONE_PORT_RANGE = "79-81"
+
+PODS = [
+    Pod("client-one", ipv4="172.16.1.8", ipv6="2001:db8:10::8"),
+    # client-three lives in client-one's /24 and /64: CIDR-matched, not
+    # pod-identity-matched.
+    Pod("client-three", ipv4="172.16.1.77", ipv6="2001:db8:10::77"),
+    Pod("client-two", ipv4="172.16.2.9", ipv6="2001:db8:20::9"),
+    Pod("server-one", ipv4="172.16.9.1", ipv6="2001:db8:90::1"),
+    Pod("server-two", ipv4="172.16.9.2", ipv6="2001:db8:90::2"),
+]
+
+TRANSPORT = [PROTOCOL_TYPE_TCP, PROTOCOL_TYPE_UDP, PROTOCOL_TYPE_SCTP]
+
+
+@pytest.fixture
+def harness():
+    h = Harness(PODS)
+    yield h
+    h.close()
+
+
+@pytest.mark.parametrize("proto", TRANSPORT)
+def test_deny_server_port_from_client_one_cidr(harness, proto):
+    """'deny a single port' case: client-one's /24 is blocked on the
+    server port; other ports and other clients unaffected."""
+    tpl = deny_port(SERVER_ONE_PORT)
+    harness.apply_rules(
+        [TestRule([SourceCIDRsEntry("client-one")], [tpl])],
+        protocols={tpl: [proto]},
+    )
+    failures = harness.check_reachability(
+        [
+            Reachable("client-one", "server-one", SERVER_ONE_PORT, False, proto),
+            Reachable("client-one", "server-one", ALLOWED_PORT, True, proto),
+            # same /24 (and /64) as client-one: also blocked — the rule
+            # matches the CIDR, not the pod identity
+            Reachable("client-three", "server-one", SERVER_ONE_PORT, False, proto),
+            # client-two is in a different /24: unaffected
+            Reachable("client-two", "server-one", SERVER_ONE_PORT, True, proto),
+        ],
+        families=(4, 6),
+    )
+    assert failures == []
+
+
+def test_deny_port_range(harness):
+    """'deny a port range' case with the half-open dataplane semantics:
+    range 79-81 covers 79 and 80, NOT 81 (kernel.c:241)."""
+    tpl = deny_port(SERVER_ONE_PORT_RANGE)
+    harness.apply_rules(
+        [TestRule([SourceCIDRsEntry("client-one")], [tpl])],
+        protocols={tpl: [PROTOCOL_TYPE_TCP]},
+    )
+    failures = harness.check_reachability(
+        [
+            Reachable("client-one", "server-one", 79, False),
+            Reachable("client-one", "server-one", 80, False),
+            Reachable("client-one", "server-one", 81, True),
+            Reachable("client-one", "server-one", 78, True),
+        ]
+    )
+    assert failures == []
+
+
+def test_allow_overrides_later_deny_all(harness):
+    """'allow one port, deny everything else' case: ordered first-match —
+    the Allow at a lower order shadows the catch-all Deny."""
+    allow = allow_port(ALLOWED_PORT)
+    deny = deny_all()
+    harness.apply_rules(
+        [TestRule([SourceCIDRsEntry("client-one")], [allow, deny])],
+        protocols={allow: [PROTOCOL_TYPE_TCP], deny: [PROTOCOL_TYPE_TCP]},
+    )
+    failures = harness.check_reachability(
+        [
+            Reachable("client-one", "server-one", ALLOWED_PORT, True),
+            Reachable("client-one", "server-one", SERVER_ONE_PORT, False),
+            Reachable("client-one", "server-two", SERVER_TWO_PORT, False),
+            Reachable("client-two", "server-one", SERVER_ONE_PORT, True),
+        ]
+    )
+    assert failures == []
+
+
+def test_deny_icmp_echo(harness):
+    """ICMP case: echo-request (type 8 code 0) blocked for the CIDR;
+    other ICMP types pass; v6 uses ICMPv6 type 128."""
+    v4 = deny_icmp(8, 0)
+    v6 = deny_icmp(128, 0)
+    harness.apply_rules(
+        [TestRule([SourceCIDRsEntry("client-one")], [v4, v6])],
+        protocols={v4: [PROTOCOL_TYPE_ICMP], v6: ["ICMPv6"]},
+    )
+    failures = harness.check_reachability(
+        [
+            Reachable("client-one", "server-one", 0, False, PROTOCOL_TYPE_ICMP,
+                      icmp_type=8),
+            Reachable("client-one", "server-one", 0, True, PROTOCOL_TYPE_ICMP,
+                      icmp_type=0),  # echo-reply unaffected
+            Reachable("client-two", "server-one", 0, True, PROTOCOL_TYPE_ICMP,
+                      icmp_type=8),
+        ]
+    )
+    assert failures == []
+    # v6: type 128 denied via the ICMPv6 rule
+    assert harness.probe(
+        Reachable("client-one", "server-one", 0, False, PROTOCOL_TYPE_ICMP,
+                  icmp_type=128), family=6
+    ) is False
+
+
+def test_multi_cidr_multi_rule_generation(harness):
+    """Two sourceCIDR entries + two protocol templates: orders are
+    generated unique per CIDR (the harness's order counter), both client
+    CIDRs end up covered."""
+    deny1 = deny_port(SERVER_ONE_PORT)
+    deny2 = deny_port(SERVER_TWO_PORT)
+    harness.apply_rules(
+        [
+            TestRule(
+                [SourceCIDRsEntry("client-one"), SourceCIDRsEntry("client-two")],
+                [deny1, deny2],
+            )
+        ],
+        protocols={deny1: [PROTOCOL_TYPE_TCP, PROTOCOL_TYPE_UDP],
+                   deny2: [PROTOCOL_TYPE_TCP]},
+    )
+    failures = harness.check_reachability(
+        [
+            Reachable("client-one", "server-one", SERVER_ONE_PORT, False),
+            Reachable("client-one", "server-one", SERVER_ONE_PORT, False, PROTOCOL_TYPE_UDP),
+            Reachable("client-two", "server-two", SERVER_TWO_PORT, False),
+            Reachable("client-one", "server-two", ALLOWED_PORT, True),
+        ]
+    )
+    assert failures == []
+
+
+def test_rules_update_reconfigures_dataplane(harness):
+    """Disruption-style case (e2e.go:982-1140): after the INF changes,
+    the dataplane reflects the new policy (policy persistence across
+    reconfiguration)."""
+    tpl = deny_port(SERVER_ONE_PORT)
+    harness.apply_rules(
+        [TestRule([SourceCIDRsEntry("client-one")], [tpl])],
+        protocols={tpl: [PROTOCOL_TYPE_TCP]},
+    )
+    assert not harness.probe(Reachable("client-one", "server-one", SERVER_ONE_PORT, False))
+
+    from infw.spec import IngressNodeFirewall
+    inf = harness.manager.store.get(IngressNodeFirewall.KIND, "e2e-inf")
+    inf.spec.ingress[0].rules[0].protocol_config.tcp.ports = SERVER_TWO_PORT
+    harness.manager.store.update(inf)
+    harness.resync()
+    assert harness.probe(Reachable("client-one", "server-one", SERVER_ONE_PORT, True))
+    assert not harness.probe(Reachable("client-one", "server-one", SERVER_TWO_PORT, False))
