@@ -56,7 +56,7 @@ def main():
 
     rng = np.random.default_rng(2024)
     tables = testing.random_tables(
-        rng, n_entries=1000, width=100, stride=4, ifindexes=(2, 3, 4)
+        rng, n_entries=1000, width=100, ifindexes=(2, 3, 4)
     )
     n_packets = 2**20 if on_tpu else 2**14
     batch = testing.random_batch(rng, tables, n_packets=n_packets)

@@ -81,14 +81,13 @@ class TpuClassifier:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
             path, dev, block_b = self._active
-            stride = self._tables.stride
         db = jaxpath.device_batch(batch, self._device)
         if path == "dense":
             res, xdp, stats = pallas_dense.jitted_classify_pallas(
                 self._interpret, block_b
             )(dev, db)
         else:
-            res, xdp, stats = jaxpath.jitted_classify(True, stride)(dev, db)
+            res, xdp, stats = jaxpath.jitted_classify(True)(dev, db)
         stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
         self._stats.add(stats_delta)
         return ClassifyOutput(

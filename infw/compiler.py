@@ -227,6 +227,26 @@ def min_rule_width(
 
 # --- compiled tensors -------------------------------------------------------
 
+# Variable-stride trie scheme: a 16-bit direct-indexed root level followed
+# by 8-bit levels (DIR-16-8-style, cf. the DIR-24-8 family of expanded
+# multibit tries).  Level bit boundaries are 16, 24, 32, ... so the IPv4
+# packet-side cap (32 bits) always falls on a level boundary, and level
+# count is bounded by the longest prefix actually present in the table —
+# a table with nothing longer than /64 compiles to 7 levels, not 15.
+VAR_TRIE_ROOT_STRIDE = 16
+VAR_TRIE_STRIDE = 8
+
+
+def trie_level_strides(n_levels: int) -> List[int]:
+    return [VAR_TRIE_ROOT_STRIDE] + [VAR_TRIE_STRIDE] * (n_levels - 1)
+
+
+def trie_levels_for_mask(max_mask_len: int) -> int:
+    if max_mask_len <= VAR_TRIE_ROOT_STRIDE:
+        return 1
+    return 1 + -(-(max_mask_len - VAR_TRIE_ROOT_STRIDE) // VAR_TRIE_STRIDE)
+
+
 @dataclass
 class CompiledTables:
     """Device-ready classifier state compiled from one desired ruleset.
@@ -237,25 +257,27 @@ class CompiledTables:
       mask_words: (T, 5) uint32 — 160-bit mask (ifindex word always ~0),
       mask_len:   (T,)  int32   — CIDR mask length (without ifindex bits).
 
-    Trie representation (for the gather kernel): a multibit trie with
-    ``stride`` bits per level over the 128 IP bits; per-interface roots.
-      trie_child:  (N * slots,) int32 — child node index, 0 = none,
-      trie_target: (N * slots,) int32 — best terminating target, -1 = none,
-      root_lut:    (max_ifindex+1,) int32 — ifindex -> root node, 0 = none.
+    Trie representation (for the gather path at 100K+ entries): a
+    variable-stride leaf-pushed trie (see VAR_TRIE_* above) with packed
+    per-slot rows so each level costs ONE row gather:
+      trie_levels: list of (n_nodes_l * slots_l, 2) int32 — per slot
+                   [child node index in level l+1 (0 = none),
+                    target + 1 (0 = none)]; node 0 of every level is the
+                   all-null node.
+      root_lut:    (max_ifindex+1,) int32 — ifindex -> level-0 node,
+                   0 = none.
 
     Shared:
       rules: (T, R, 7) int32 rule decision matrix.
     """
 
     rule_width: int
-    stride: int
     num_entries: int
     key_words: np.ndarray
     mask_words: np.ndarray
     mask_len: np.ndarray
     rules: np.ndarray
-    trie_child: np.ndarray
-    trie_target: np.ndarray
+    trie_levels: List[np.ndarray]
     root_lut: np.ndarray
     content: Dict[LpmKey, np.ndarray] = field(default_factory=dict)
 
@@ -264,18 +286,15 @@ class CompiledTables:
         return int(self.rules.shape[0])
 
     @property
-    def num_trie_nodes(self) -> int:
-        return int(self.trie_child.shape[0] // (1 << self.stride))
-
-    @property
     def levels(self) -> int:
-        return 128 // self.stride
+        return len(self.trie_levels)
 
     @property
-    def v4_level_cap(self) -> int:
-        """Deepest trie level (0-based) whose targets an IPv4 packet may
-        accept: masklen <= 32 <=> level < 32 // stride."""
-        return 32 // self.stride
+    def num_trie_nodes(self) -> int:
+        strides = trie_level_strides(self.levels)
+        return sum(
+            int(tbl.shape[0]) >> s for tbl, s in zip(self.trie_levels, strides)
+        )
 
     def save(self, path: str) -> None:
         """Persist compiled state (the pinned-map equivalent; see
@@ -284,8 +303,8 @@ class CompiledTables:
 
         meta = {
             "rule_width": self.rule_width,
-            "stride": self.stride,
             "num_entries": self.num_entries,
+            "n_trie_levels": len(self.trie_levels),
             "content_keys": [
                 [k.prefix_len, k.ingress_ifindex, k.ip_data.hex()]
                 for k in self.content
@@ -296,6 +315,9 @@ class CompiledTables:
             if self.content
             else np.zeros((0, self.rule_width, RULE_COLS), np.int32)
         )
+        level_arrays = {
+            f"trie_level_{i}": tbl for i, tbl in enumerate(self.trie_levels)
+        }
         np.savez_compressed(
             path,
             meta=json.dumps(meta),
@@ -303,10 +325,9 @@ class CompiledTables:
             mask_words=self.mask_words,
             mask_len=self.mask_len,
             rules=self.rules,
-            trie_child=self.trie_child,
-            trie_target=self.trie_target,
             root_lut=self.root_lut,
             content_rules=content_rules,
+            **level_arrays,
         )
 
     @classmethod
@@ -315,20 +336,25 @@ class CompiledTables:
 
         with np.load(path, allow_pickle=False) as z:
             meta = json.loads(str(z["meta"]))
+            if "n_trie_levels" not in meta:
+                raise CompileError(
+                    f"{path}: incompatible compiled-table format (pre-var-trie "
+                    "archive); recompile from the declarative spec"
+                )
             content_rules = z["content_rules"]
             content = {}
             for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
                 content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = content_rules[i]
             return cls(
                 rule_width=meta["rule_width"],
-                stride=meta["stride"],
                 num_entries=meta["num_entries"],
                 key_words=z["key_words"],
                 mask_words=z["mask_words"],
                 mask_len=z["mask_len"],
                 rules=z["rules"],
-                trie_child=z["trie_child"],
-                trie_target=z["trie_target"],
+                trie_levels=[
+                    z[f"trie_level_{i}"] for i in range(meta["n_trie_levels"])
+                ],
                 root_lut=z["root_lut"],
                 content=content,
             )
@@ -348,79 +374,92 @@ def _mask_words_for(mask_len: int) -> List[int]:
     return words
 
 
-class _TrieBuilder:
-    """Leaf-pushed multibit trie with ``stride`` bits per level.
+class _VarTrieBuilder:
+    """Leaf-pushed variable-stride trie (16-bit root level + 8-bit levels).
 
-    Node 0 is the null node (all child 0, all targets -1); interface roots
-    are allocated on demand.  Slot-level priority during expansion follows
-    longest-prefix order; equal-length (i.e. identical) prefixes are
-    last-writer-wins like kernel trie updates.
+    Node 0 of every level is the null node (all child 0, all targets -1);
+    per-interface level-0 roots are allocated on demand.  Slot-level
+    priority during leaf-push follows longest-prefix order; equal-length
+    (i.e. identical) prefixes are last-writer-wins like kernel trie
+    updates.  Level l slots pack [child-in-level-l+1, target] so the
+    device walk costs one row gather per level.
     """
 
-    def __init__(self, stride: int):
-        if stride not in (4, 8):
-            raise CompileError(f"unsupported trie stride {stride}")
-        self.stride = stride
-        self.slots = 1 << stride
-        self.child: List[np.ndarray] = [np.zeros(self.slots, np.int32)]
-        self.target: List[np.ndarray] = [np.full(self.slots, -1, np.int32)]
-        self.slot_mask_len: List[np.ndarray] = [np.full(self.slots, -1, np.int32)]
+    def __init__(self, n_levels: int):
+        self.n_levels = max(1, n_levels)
+        self.strides = trie_level_strides(self.n_levels)
+        self.bit_ends = np.cumsum(self.strides).tolist()
+        # per level: lists of per-node arrays (node 0 = null)
+        self.child: List[List[np.ndarray]] = []
+        self.target: List[List[np.ndarray]] = []
+        self.slot_mask: List[List[np.ndarray]] = []
+        for s in self.strides:
+            slots = 1 << s
+            self.child.append([np.zeros(slots, np.int32)])
+            self.target.append([np.full(slots, -1, np.int32)])
+            self.slot_mask.append([np.full(slots, -1, np.int32)])
         self.roots: Dict[int, int] = {}
 
-    def _new_node(self) -> int:
-        self.child.append(np.zeros(self.slots, np.int32))
-        self.target.append(np.full(self.slots, -1, np.int32))
-        self.slot_mask_len.append(np.full(self.slots, -1, np.int32))
-        return len(self.child) - 1
+    def _new_node(self, level: int) -> int:
+        slots = 1 << self.strides[level]
+        self.child[level].append(np.zeros(slots, np.int32))
+        self.target[level].append(np.full(slots, -1, np.int32))
+        self.slot_mask[level].append(np.full(slots, -1, np.int32))
+        return len(self.child[level]) - 1
 
     def _root_for(self, ifindex: int) -> int:
         node = self.roots.get(ifindex)
         if node is None:
-            node = self._new_node()
+            node = self._new_node(0)
             self.roots[ifindex] = node
         return node
 
     def insert(self, ifindex: int, ip_data: bytes, mask_len: int, target: int) -> None:
-        node = self._root_for(ifindex)
         bits = int.from_bytes(ip_data, "big")  # 128-bit big-endian value
-        depth = 0
-        remaining = mask_len
-        while remaining > self.stride:
-            shift = 128 - self.stride * (depth + 1)
-            slot = (bits >> shift) & (self.slots - 1)
-            nxt = int(self.child[node][slot])
+        node = self._root_for(ifindex)
+        level = 0
+        while mask_len > self.bit_ends[level]:
+            shift = 128 - self.bit_ends[level]
+            slot = (bits >> shift) & ((1 << self.strides[level]) - 1)
+            nxt = int(self.child[level][node][slot])
             if nxt == 0:
-                nxt = self._new_node()
-                self.child[node][slot] = nxt
+                nxt = self._new_node(level + 1)
+                self.child[level][node][slot] = nxt
             node = nxt
-            depth += 1
-            remaining -= self.stride
-        # Expand the remaining (0..stride] bits into 2^(stride-remaining)
-        # slots of this node; longest prefix wins per slot, ties (identical
-        # prefixes) overwrite (map-update semantics).
-        shift = 128 - self.stride * (depth + 1)
-        base_slot = (bits >> shift) & (self.slots - 1)
-        span = 1 << (self.stride - remaining)
+            level += 1
+        # Leaf-push the prefix into all covered slots of this level;
+        # longest prefix wins per slot, ties overwrite (map-update
+        # semantics).
+        stride = self.strides[level]
+        shift = 128 - self.bit_ends[level]
+        base_slot = (bits >> shift) & ((1 << stride) - 1)
+        span = 1 << (self.bit_ends[level] - mask_len)
         base_slot &= ~(span - 1)
-        for slot in range(base_slot, base_slot + span):
-            if mask_len >= self.slot_mask_len[node][slot]:
-                self.slot_mask_len[node][slot] = mask_len
-                self.target[node][slot] = target
+        sl = slice(base_slot, base_slot + span)
+        cur_mask = self.slot_mask[level][node][sl]
+        upd = mask_len >= cur_mask
+        self.slot_mask[level][node][sl] = np.where(upd, mask_len, cur_mask)
+        tgt = self.target[level][node][sl]
+        self.target[level][node][sl] = np.where(upd, target, tgt)
 
-    def arrays(self, max_ifindex: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        child = np.concatenate(self.child) if self.child else np.zeros(0, np.int32)
-        target = np.concatenate(self.target) if self.target else np.zeros(0, np.int32)
+    def arrays(self, max_ifindex: int) -> Tuple[List[np.ndarray], np.ndarray]:
+        levels = []
+        for l in range(self.n_levels):
+            child = np.concatenate(self.child[l])
+            target = np.concatenate(self.target[l])
+            levels.append(
+                np.stack([child, target + 1], axis=1).astype(np.int32)
+            )
         root_lut = np.zeros(max_ifindex + 1, np.int32)
         for ifindex, node in self.roots.items():
             root_lut[ifindex] = node
-        return child, target, root_lut
+        return levels, root_lut
 
 
 def compile_tables(
     iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]],
     registry: InterfaceRegistry,
     rule_width: Optional[int] = None,
-    stride: int = 4,
     is_valid_interface=None,
 ) -> CompiledTables:
     """Full compile: desired interface rules -> CompiledTables."""
@@ -431,13 +470,12 @@ def compile_tables(
     content = build_table_content(
         iface_ingress_rules, registry, rule_width, is_valid_interface
     )
-    return compile_tables_from_content(content, rule_width=rule_width, stride=stride)
+    return compile_tables_from_content(content, rule_width=rule_width)
 
 
 def compile_tables_from_content(
     content: Dict[LpmKey, np.ndarray],
     rule_width: int = MAX_RULES_PER_TARGET,
-    stride: int = 4,
 ) -> CompiledTables:
     """Build tensors from explicit LPM-map content (also used by tests to
     drive adversarial tables directly)."""
@@ -460,7 +498,8 @@ def compile_tables_from_content(
     mask_len = np.zeros(max(T, 1), np.int32)
     rules = np.zeros((max(T, 1), R, RULE_COLS), np.int32)
 
-    trie = _TrieBuilder(stride)
+    max_mask = max((k.mask_len for k, _ in entries), default=0)
+    trie = _VarTrieBuilder(trie_levels_for_mask(max_mask))
     max_ifindex = max((k.ingress_ifindex for k, _ in entries), default=0)
 
     for t, (key, rule_rows) in enumerate(entries):
@@ -478,17 +517,15 @@ def compile_tables_from_content(
         rules[t] = rows[:R]
         trie.insert(key.ingress_ifindex, masked_ip, m, t)
 
-    trie_child, trie_target, root_lut = trie.arrays(max_ifindex)
+    trie_levels, root_lut = trie.arrays(max_ifindex)
     return CompiledTables(
         rule_width=R,
-        stride=stride,
         num_entries=T,
         key_words=key_words[:max(T, 1)],
         mask_words=mask_words,
         mask_len=mask_len,
         rules=rules,
-        trie_child=trie_child,
-        trie_target=trie_target,
+        trie_levels=trie_levels,
         root_lut=root_lut,
         content=dict(content),
     )

@@ -203,12 +203,17 @@ class Harness:
         if not src or not dst:
             raise ValueError(f"pod without family-{family} address")
         proto = _PROTO_NUM[r.protocol]
+        icmp_type = r.icmp_type
         if family == 6 and r.protocol == PROTOCOL_TYPE_ICMP:
+            # A generic "ICMP" probe means the family's native ICMP: switch
+            # the protocol number AND translate the well-known echo types
+            # (request 8->128, reply 0->129) — what ping does per family.
             proto = IPPROTO_ICMPV6
+            icmp_type = {8: 128, 0: 129}.get(icmp_type, icmp_type)
         frame = build_frame(
             src, dst, proto,
             src_port=40001, dst_port=r.port,
-            icmp_type=r.icmp_type, icmp_code=r.icmp_code,
+            icmp_type=icmp_type, icmp_code=r.icmp_code,
         )
         batch = parse_frames([frame], ifindex=self.ifindex)
         out = self.syncer.classifier.classify(batch)

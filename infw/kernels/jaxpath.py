@@ -52,14 +52,18 @@ STATS_COLS = 6
 
 
 class DeviceTables(NamedTuple):
-    """Compiled tables resident on device."""
+    """Compiled tables resident on device.
+
+    ``trie_levels`` is a tuple of per-level (n_l*slots_l, 2) int32 arrays
+    (variable-stride trie, compiler.VAR_TRIE_*); the tuple length is part
+    of the pytree structure, so jit specializes per level count — the
+    static level bound the walk unrolls over."""
 
     key_words: jax.Array    # (T, 5) uint32
     mask_words: jax.Array   # (T, 5) uint32
     mask_len: jax.Array     # (T,) int32
     rules: jax.Array        # (T, R, 7) int32
-    trie_child: jax.Array   # (N*slots,) int32
-    trie_target: jax.Array  # (N*slots,) int32
+    trie_levels: Tuple[jax.Array, ...]
     root_lut: jax.Array     # (max_if+1,) int32
     num_entries: jax.Array  # () int32
 
@@ -88,8 +92,7 @@ def device_tables(tables: CompiledTables, device=None) -> DeviceTables:
         mask_words=put(tables.mask_words.astype(np.uint32)),
         mask_len=put(mask_len),
         rules=put(tables.rules),
-        trie_child=put(tables.trie_child),
-        trie_target=put(tables.trie_target),
+        trie_levels=tuple(put(tbl) for tbl in tables.trie_levels),
         root_lut=put(tables.root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
@@ -130,45 +133,41 @@ def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     return jnp.where(jnp.max(score, axis=1) > 0, tidx, -1)
 
 
-def lpm_trie(tables: DeviceTables, batch: DeviceBatch, stride: int) -> jax.Array:
-    """Multibit-trie walk: per-level gathers, all packets walk all levels
-    (no data-dependent control flow); returns target index or -1."""
-    slots = 1 << stride
-    levels = 128 // stride
-    v4_cap = 32 // stride
+def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
+    """Variable-stride trie walk: ONE packed (child, target) row gather
+    per level, statically unrolled over the table's level count (bounded
+    by its longest prefix); no data-dependent control flow.  Returns the
+    target index or -1.
 
-    # Precompute per-level slot values (levels, B) from the big-endian words.
-    nib_list = []
-    for d in range(levels):
-        w = (d * stride) // 32
-        shift = 32 - stride - (d * stride) % 32
-        nib_list.append(
-            ((batch.ip_words[:, w] >> np.uint32(shift)) & np.uint32(slots - 1)).astype(
-                jnp.int32
-            )
-        )
-    nibbles = jnp.stack(nib_list)  # (levels, B)
+    Slot targets at a level cover prefixes with mask_len in
+    (prev_boundary, boundary]; the IPv4 packet-side cap (entries longer
+    than /32 cannot match a v4 packet, kernel.c:207) is the boundary test
+    ``bit_end <= cap_bits`` — boundaries are 16, 24, 32, 40, ... so 32
+    always lands exactly on one."""
+    from ..compiler import trie_level_strides
 
+    strides = trie_level_strides(len(tables.trie_levels))
     lut_size = tables.root_lut.shape[0]
     if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
-    root = jnp.where(
+    node = jnp.where(
         if_ok, jnp.take(tables.root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
     )
-    level_cap = jnp.where(batch.kind == KIND_IPV4, v4_cap, levels)
+    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    best = jnp.full_like(node, -1)
 
-    def body(d, carry):
-        cur, best = carry
-        nib = jax.lax.dynamic_index_in_dim(nibbles, d, axis=0, keepdims=False)
-        e = cur * slots + nib
-        t = jnp.take(tables.trie_target, e)
-        ok = (t >= 0) & (d < level_cap)
-        best = jnp.where(ok, t, best)
-        cur = jnp.take(tables.trie_child, e)
-        return cur, best
-
-    _, best = jax.lax.fori_loop(
-        0, levels, body, (root, jnp.full_like(root, -1))
-    )
+    bit_end = 0
+    for stride, tbl in zip(strides, tables.trie_levels):
+        bit_start, bit_end = bit_end, bit_end + stride
+        w = bit_start // 32
+        shift = 32 - stride - (bit_start % 32)
+        nib = (
+            (batch.ip_words[:, w] >> np.uint32(shift)) & np.uint32((1 << stride) - 1)
+        ).astype(jnp.int32)
+        e = node * (1 << stride) + nib  # node 0 is the all-null node
+        rows = jnp.take(tbl, e, axis=0)  # (B, 2): [child, target+1]
+        ok = (rows[:, 1] > 0) & (bit_end <= cap_bits)
+        best = jnp.where(ok, rows[:, 1] - 1, best)
+        node = rows[:, 0]
     return best
 
 
@@ -249,11 +248,11 @@ def finalize(result: jax.Array, batch: DeviceBatch) -> Tuple[jax.Array, jax.Arra
 
 
 def classify(
-    tables: DeviceTables, batch: DeviceBatch, *, use_trie: bool, stride: int
+    tables: DeviceTables, batch: DeviceBatch, *, use_trie: bool
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full forward pass: LPM -> gather rules -> scan -> finalize."""
     if use_trie:
-        tidx = lpm_trie(tables, batch, stride)
+        tidx = lpm_trie(tables, batch)
     else:
         tidx = lpm_dense(tables, batch)
     rows = jnp.take(tables.rules, jnp.clip(tidx, 0), axis=0)
@@ -266,11 +265,13 @@ import functools
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify(use_trie: bool, stride: int):
-    """Compiled classify entry point; cache keyed on the static config.
-    Always use this (never eager) — op-by-op dispatch is orders of
-    magnitude slower than the fused XLA program."""
-    return jax.jit(functools.partial(classify, use_trie=use_trie, stride=stride))
+def jitted_classify(use_trie: bool):
+    """Compiled classify entry point; cache keyed on the static config
+    (the trie level count is part of the DeviceTables pytree structure,
+    so jit re-specializes per table depth automatically).  Always use
+    this (never eager) — op-by-op dispatch is orders of magnitude slower
+    than the fused XLA program."""
+    return jax.jit(functools.partial(classify, use_trie=use_trie))
 
 
 def merge_stats_host(stats: np.ndarray) -> np.ndarray:

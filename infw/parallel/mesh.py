@@ -60,14 +60,12 @@ def _pad_tables_for_shards(tables: CompiledTables, shards: int) -> CompiledTable
     mask_len[tables.num_entries :] = -1
     return CompiledTables(
         rule_width=tables.rule_width,
-        stride=tables.stride,
         num_entries=tables.num_entries,
         key_words=padrow(tables.key_words),
         mask_words=padrow(tables.mask_words),
         mask_len=padrow(mask_len, -1),
         rules=padrow(tables.rules),
-        trie_child=tables.trie_child,
-        trie_target=tables.trie_target,
+        trie_levels=tables.trie_levels,
         root_lut=tables.root_lut,
         content=tables.content,
     )
@@ -88,8 +86,7 @@ def shard_tables(tables: CompiledTables, mesh: Mesh) -> DeviceTables:
         mask_words=put(padded.mask_words.astype(np.uint32), P("rules", None)),
         mask_len=put(mask_len, P("rules")),
         rules=put(padded.rules, P("rules", None, None)),
-        trie_child=put(padded.trie_child, P()),
-        trie_target=put(padded.trie_target, P()),
+        trie_levels=tuple(put(tbl, P()) for tbl in padded.trie_levels),
         root_lut=put(padded.root_lut, P()),
         num_entries=put(np.int32(padded.num_entries), P()),
     )
@@ -147,10 +144,12 @@ def _sharded_step(tables: DeviceTables, batch: DeviceBatch):
 
 
 @functools.lru_cache(maxsize=None)
-def make_sharded_classifier(mesh: Mesh):
+def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 1):
     """jit-compiled multi-chip classify: batch sharded over "data", dense
     tables sharded over "rules"; returns (results, xdp, stats) with
-    results/xdp sharded over "data" and stats fully replicated."""
+    results/xdp sharded over "data" and stats fully replicated.
+    ``n_trie_levels`` must match the table's trie depth (the replicated
+    trie arrays are part of the pytree structure)."""
     batch_specs = DeviceBatch(
         kind=P("data"),
         l4_ok=P("data"),
@@ -167,8 +166,7 @@ def make_sharded_classifier(mesh: Mesh):
         mask_words=P("rules", None),
         mask_len=P("rules"),
         rules=P("rules", None, None),
-        trie_child=P(),
-        trie_target=P(),
+        trie_levels=tuple(P() for _ in range(n_trie_levels)),
         root_lut=P(),
         num_entries=P(),
     )
@@ -192,7 +190,7 @@ def classify_on_mesh(
     padded = batch.pad_to(bp)
     dt = shard_tables(tables, mesh)
     db = shard_batch(padded, mesh)
-    results, xdp, stats = make_sharded_classifier(mesh)(dt, db)
+    results, xdp, stats = make_sharded_classifier(mesh, len(dt.trie_levels))(dt, db)
     return (
         np.asarray(results)[:b],
         np.asarray(xdp)[:b],

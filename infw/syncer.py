@@ -115,7 +115,6 @@ class DataplaneSyncer:
         stats_poller: Optional[StatsPoller] = None,
         checkpoint_dir: Optional[str] = None,
         rule_width: Optional[int] = None,
-        stride: int = 4,
         attach_fn: Optional[Callable[[str], None]] = None,
         detach_fn: Optional[Callable[[str], None]] = None,
         is_valid_interface: Optional[Callable[[str], bool]] = None,
@@ -126,7 +125,6 @@ class DataplaneSyncer:
         self._stats_poller = stats_poller
         self._checkpoint_dir = checkpoint_dir
         self._rule_width = rule_width
-        self._stride = stride
         self._attach_fn = attach_fn
         self._detach_fn = detach_fn
         # Injectable like the package-level isValidInterfaceNameAndState var
@@ -306,7 +304,7 @@ class DataplaneSyncer:
             log.info("rules unchanged; skipping device reload")
             return
         tables = compile_tables_from_content(
-            desired, rule_width=width, stride=self._stride
+            desired, rule_width=width
         )
         self._classifier.load_tables(tables)
         self._content = dict(desired)

@@ -58,7 +58,6 @@ def random_tables(
     n_entries: int,
     ifindexes: Tuple[int, ...] = (2, 3),
     width: int = 16,
-    stride: int = 4,
     v6_fraction: float = 0.3,
     overlap_fraction: float = 0.3,
 ) -> CompiledTables:
@@ -90,7 +89,7 @@ def random_tables(
         ifindex = int(ifindexes[rng.integers(0, len(ifindexes))])
         key = LpmKey(prefix_len=mask_len + 32, ingress_ifindex=ifindex, ip_data=data)
         content[key] = random_rules(rng, width)
-    return compile_tables_from_content(content, rule_width=width, stride=stride)
+    return compile_tables_from_content(content, rule_width=width)
 
 
 def random_batch(

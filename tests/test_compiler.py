@@ -170,7 +170,9 @@ def test_compiled_tables_roundtrip(tmp_path):
     loaded = compiler.CompiledTables.load(path)
     assert loaded.num_entries == tables.num_entries
     np.testing.assert_array_equal(loaded.rules, tables.rules)
-    np.testing.assert_array_equal(loaded.trie_child, tables.trie_child)
+    assert len(loaded.trie_levels) == len(tables.trie_levels)
+    for a, b in zip(loaded.trie_levels, tables.trie_levels):
+        np.testing.assert_array_equal(a, b)
     np.testing.assert_array_equal(loaded.root_lut, tables.root_lut)
     assert set(loaded.content.keys()) == set(tables.content.keys())
 

@@ -17,8 +17,8 @@ def run_all_paths(tables, batch):
     dt = jaxpath.device_tables(tables)
     db = jaxpath.device_batch(batch)
     out = {}
-    out["dense"] = jaxpath.jitted_classify(False, tables.stride)(dt, db)
-    out["trie"] = jaxpath.jitted_classify(True, tables.stride)(dt, db)
+    out["dense"] = jaxpath.jitted_classify(False)(dt, db)
+    out["trie"] = jaxpath.jitted_classify(True)(dt, db)
     return out
 
 
@@ -38,10 +38,9 @@ def assert_matches_oracle(tables, batch):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
-@pytest.mark.parametrize("stride", [4, 8])
-def test_random_differential(seed, stride):
+def test_random_differential(seed):
     rng = np.random.default_rng(seed)
-    tables = testing.random_tables(rng, n_entries=40, width=12, stride=stride)
+    tables = testing.random_tables(rng, n_entries=40, width=12)
     batch = testing.random_batch(rng, tables, n_packets=300)
     assert_matches_oracle(tables, batch)
 
@@ -49,7 +48,7 @@ def test_random_differential(seed, stride):
 def test_large_overlapping_differential():
     rng = np.random.default_rng(42)
     tables = testing.random_tables(
-        rng, n_entries=200, width=8, stride=4, overlap_fraction=0.6
+        rng, n_entries=200, width=8, overlap_fraction=0.6
     )
     batch = testing.random_batch(rng, tables, n_packets=500)
     assert_matches_oracle(tables, batch)

@@ -13,7 +13,7 @@ def test_sharded_classify_matches_oracle(rules_shards):
     assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
     m = meshmod.make_mesh(8, rules_shards=rules_shards)
     rng = np.random.default_rng(11)
-    tables = testing.random_tables(rng, n_entries=37, width=10, stride=4)
+    tables = testing.random_tables(rng, n_entries=37, width=10)
     batch = testing.random_batch(rng, tables, n_packets=301)
     ref = oracle.classify(tables, batch)
     results, xdp, stats = meshmod.classify_on_mesh(m, tables, batch)

@@ -24,7 +24,7 @@ def assert_pallas_matches(tables, batch, dtype=pallas_dense.DEFAULT_DTYPE):
 @pytest.mark.parametrize("seed", [0, 5])
 def test_pallas_random_differential(seed, dtype):
     rng = np.random.default_rng(seed)
-    tables = testing.random_tables(rng, n_entries=40, width=12, stride=4)
+    tables = testing.random_tables(rng, n_entries=40, width=12)
     batch = testing.random_batch(rng, tables, n_packets=300)
     assert_pallas_matches(tables, batch, dtype=dtype)
 
