@@ -476,9 +476,12 @@ def compile_tables(
 def compile_tables_from_content(
     content: Dict[LpmKey, np.ndarray],
     rule_width: int = MAX_RULES_PER_TARGET,
+    min_trie_levels: int = 1,
 ) -> CompiledTables:
     """Build tensors from explicit LPM-map content (also used by tests to
-    drive adversarial tables directly)."""
+    drive adversarial tables directly).  ``min_trie_levels`` forces at
+    least that many trie levels — used by the mesh sharder so every
+    rules-shard compiles to the same static depth."""
     # Deduplicate by masked identity, later entries replacing earlier ones —
     # exactly what successive Map.Update calls do on the kernel trie.
     dedup: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray]] = {}
@@ -499,7 +502,7 @@ def compile_tables_from_content(
     rules = np.zeros((max(T, 1), R, RULE_COLS), np.int32)
 
     max_mask = max((k.mask_len for k, _ in entries), default=0)
-    trie = _VarTrieBuilder(trie_levels_for_mask(max_mask))
+    trie = _VarTrieBuilder(max(trie_levels_for_mask(max_mask), min_trie_levels))
     max_ifindex = max((k.ingress_ifindex for k, _ in entries), default=0)
 
     for t, (key, rule_rows) in enumerate(entries):

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..compiler import CompiledTables
+from ..compiler import CompiledTables, trie_level_strides
 from ..constants import (
     ALLOW,
     DENY,
@@ -133,10 +133,12 @@ def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     return jnp.where(jnp.max(score, axis=1) > 0, tidx, -1)
 
 
-def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
+def trie_walk(
+    trie_levels, root_lut: jax.Array, batch: DeviceBatch
+) -> jax.Array:
     """Variable-stride trie walk: ONE packed (child, target) row gather
-    per level, statically unrolled over the table's level count (bounded
-    by its longest prefix); no data-dependent control flow.  Returns the
+    per level, statically unrolled over the level count (bounded by the
+    table's longest prefix); no data-dependent control flow.  Returns the
     target index or -1.
 
     Slot targets at a level cover prefixes with mask_len in
@@ -144,19 +146,17 @@ def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     than /32 cannot match a v4 packet, kernel.c:207) is the boundary test
     ``bit_end <= cap_bits`` — boundaries are 16, 24, 32, 40, ... so 32
     always lands exactly on one."""
-    from ..compiler import trie_level_strides
-
-    strides = trie_level_strides(len(tables.trie_levels))
-    lut_size = tables.root_lut.shape[0]
+    strides = trie_level_strides(len(trie_levels))
+    lut_size = root_lut.shape[0]
     if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
     node = jnp.where(
-        if_ok, jnp.take(tables.root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
+        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
     )
     cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
     best = jnp.full_like(node, -1)
 
     bit_end = 0
-    for stride, tbl in zip(strides, tables.trie_levels):
+    for stride, tbl in zip(strides, trie_levels):
         bit_start, bit_end = bit_end, bit_end + stride
         w = bit_start // 32
         shift = 32 - stride - (bit_start % 32)
@@ -169,6 +169,10 @@ def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
         best = jnp.where(ok, rows[:, 1] - 1, best)
         node = rows[:, 0]
     return best
+
+
+def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
+    return trie_walk(tables.trie_levels, tables.root_lut, batch)
 
 
 def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:

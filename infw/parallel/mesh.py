@@ -20,7 +20,7 @@ the ICI/DCN replacement for the reference's per-node BPF map writes.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,7 +86,9 @@ def shard_tables(tables: CompiledTables, mesh: Mesh) -> DeviceTables:
         mask_words=put(padded.mask_words.astype(np.uint32), P("rules", None)),
         mask_len=put(mask_len, P("rules")),
         rules=put(padded.rules, P("rules", None, None)),
-        trie_levels=tuple(put(tbl, P()) for tbl in padded.trie_levels),
+        # The dense sharded step never walks the trie; don't ship or
+        # replicate the (potentially large) level arrays.
+        trie_levels=(),
         root_lut=put(padded.root_lut, P()),
         num_entries=put(np.int32(padded.num_entries), P()),
     )
@@ -128,9 +130,11 @@ def _local_dense_partial(tables: DeviceTables, batch: DeviceBatch):
     return best.astype(jnp.int32), raw
 
 
-def _sharded_step(tables: DeviceTables, batch: DeviceBatch):
-    """The full distributed step, to be wrapped in shard_map."""
-    best, raw = _local_dense_partial(tables, batch)
+def _combine_and_finalize(best, raw, batch: DeviceBatch):
+    """Cross-shard winner selection + finalize, shared by the dense and
+    trie sharded steps: the longest-prefix winner is unique (masked-
+    identity dedup at compile time), so pmax over scores + psum of the
+    winner's raw result reconstructs the single-chip verdict."""
     gbest = jax.lax.pmax(best, "rules")
     winner = (best == gbest) & (best > 0)
     raw = jnp.where(winner, raw, 0)
@@ -143,8 +147,14 @@ def _sharded_step(tables: DeviceTables, batch: DeviceBatch):
     return results, xdp, stats
 
 
+def _sharded_step(tables: DeviceTables, batch: DeviceBatch):
+    """The full distributed step, to be wrapped in shard_map."""
+    best, raw = _local_dense_partial(tables, batch)
+    return _combine_and_finalize(best, raw, batch)
+
+
 @functools.lru_cache(maxsize=None)
-def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 1):
+def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 0):
     """jit-compiled multi-chip classify: batch sharded over "data", dense
     tables sharded over "rules"; returns (results, xdp, stats) with
     results/xdp sharded over "data" and stats fully replicated.
@@ -178,6 +188,169 @@ def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 1):
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+# --- trie sharding over "rules": 1M-rule scale -------------------------------
+#
+# Above single-chip trie capacity, the LPM entries themselves are
+# partitioned across the "rules" axis: each chip compiles a trie over its
+# own entry subset, walks it locally, and the global longest-prefix winner
+# is selected with pmax over (mask_len + 1) scores.  Winner uniqueness
+# holds because two distinct entries of equal mask length that both match
+# one packet would have identical masked prefixes — which the compile-time
+# masked-identity dedup forbids.
+
+
+class ShardedTrieTables(NamedTuple):
+    """Per-shard trie state stacked on a leading "rules" axis."""
+
+    trie_levels: Tuple[jax.Array, ...]  # each (R, rows_l, 2) int32
+    root_lut: jax.Array                 # (R, L) int32
+    mask_len: jax.Array                 # (R, T) int32, -1 padding
+    rules: jax.Array                    # (R, T, W, 7) int32
+
+
+def build_trie_shards(tables: CompiledTables, shards: int) -> ShardedTrieTables:
+    """Partition the table's content round-robin into ``shards`` subsets,
+    compile each to the same static trie depth, and pad/stack the
+    per-shard arrays (host-side; call shard_tables_trie to place them)."""
+    from ..compiler import (
+        compile_tables_from_content,
+        trie_levels_for_mask,
+    )
+
+    # Partition the DEDUPED entry set: keys aliasing by masked identity
+    # must collapse before the split, or two shards could hold equal-length
+    # matching prefixes and the psum winner combine would double-count.
+    dedup = {}
+    for k, v in tables.content.items():
+        dedup[k.masked_identity()] = (k, v)
+    items = list(dedup.values())
+    n_levels = max(
+        trie_levels_for_mask(max((k.mask_len for k, _ in items), default=0)), 1
+    )
+    subs = [
+        compile_tables_from_content(
+            {k: v for k, v in items[i::shards]},
+            rule_width=tables.rule_width,
+            min_trie_levels=n_levels,
+        )
+        for i in range(shards)
+    ]
+
+    def pad_to(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
+        widths = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    levels = []
+    for l in range(n_levels):
+        rows = max(s.trie_levels[l].shape[0] for s in subs)
+        levels.append(
+            np.stack([pad_to(s.trie_levels[l], rows) for s in subs])
+        )
+    lut_len = max(s.root_lut.shape[0] for s in subs)
+    root_lut = np.stack([pad_to(s.root_lut, lut_len) for s in subs])
+    T = max(s.mask_len.shape[0] for s in subs)
+    mask_len = np.stack(
+        [
+            pad_to(np.where(np.arange(s.mask_len.shape[0]) < s.num_entries,
+                            s.mask_len, -1), T, fill=-1)
+            for s in subs
+        ]
+    )
+    rules = np.stack([pad_to(s.rules, T) for s in subs])
+    return ShardedTrieTables(
+        trie_levels=tuple(np.asarray(a, np.int32) for a in levels),
+        root_lut=root_lut.astype(np.int32),
+        mask_len=mask_len.astype(np.int32),
+        rules=rules.astype(np.int32),
+    )
+
+
+def shard_tables_trie(tables: CompiledTables, mesh: Mesh) -> ShardedTrieTables:
+    """Place the per-shard tries on the mesh, leading axis over "rules"."""
+    shards = mesh.shape["rules"]
+    host = build_trie_shards(tables, shards)
+
+    def put(a, spec):
+        return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+    return ShardedTrieTables(
+        trie_levels=tuple(put(t, P("rules", None, None)) for t in host.trie_levels),
+        root_lut=put(host.root_lut, P("rules", None)),
+        mask_len=put(host.mask_len, P("rules", None)),
+        rules=put(host.rules, P("rules", None, None, None)),
+    )
+
+
+def _sharded_trie_step(tables: ShardedTrieTables, batch: DeviceBatch):
+    """Distributed trie step inside shard_map: local walk + one mask_len
+    gather for the score, then the same pmax/psum winner selection as the
+    dense path."""
+    local_levels = tuple(t[0] for t in tables.trie_levels)  # drop shard dim
+    tidx = jaxpath.trie_walk(local_levels, tables.root_lut[0], batch)
+    matched = tidx >= 0
+    safe = jnp.clip(tidx, 0)
+    best = jnp.where(
+        matched, jnp.take(tables.mask_len[0], safe) + 1, 0
+    ).astype(jnp.int32)
+    rows = jnp.take(tables.rules[0], safe, axis=0)
+    rows = jnp.where(matched[:, None, None], rows, 0)
+    raw = jaxpath.rule_scan(rows, batch)
+    return _combine_and_finalize(best, raw, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_trie_classifier(mesh: Mesh, n_trie_levels: int):
+    """jit-compiled multi-chip trie classify: batch over "data", LPM
+    entries partitioned over "rules" as per-shard tries."""
+    batch_specs = DeviceBatch(
+        kind=P("data"), l4_ok=P("data"), ifindex=P("data"),
+        ip_words=P("data", None), proto=P("data"), dst_port=P("data"),
+        icmp_type=P("data"), icmp_code=P("data"), pkt_len=P("data"),
+    )
+    table_specs = ShardedTrieTables(
+        trie_levels=tuple(P("rules", None, None) for _ in range(n_trie_levels)),
+        root_lut=P("rules", None),
+        mask_len=P("rules", None),
+        rules=P("rules", None, None, None),
+    )
+    fn = jax.shard_map(
+        _sharded_trie_step,
+        mesh=mesh,
+        in_specs=(table_specs, batch_specs),
+        out_specs=(P("data"), P("data"), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def classify_on_mesh_trie(
+    mesh: Mesh,
+    tables: CompiledTables,
+    batch,
+    placed: Optional[ShardedTrieTables] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Convenience wrapper for the trie-sharded path.
+
+    Building/placing the per-shard tries is the expensive part at scale —
+    callers classifying a stream of batches against one ruleset should
+    call shard_tables_trie ONCE and pass the handle via ``placed``; only
+    the batch is shipped per call."""
+    data_shards = mesh.shape["data"]
+    b = len(batch)
+    bp = ((b + data_shards - 1) // data_shards) * data_shards
+    padded = batch.pad_to(bp)
+    dt = placed if placed is not None else shard_tables_trie(tables, mesh)
+    db = shard_batch(padded, mesh)
+    results, xdp, stats = make_sharded_trie_classifier(
+        mesh, len(dt.trie_levels)
+    )(dt, db)
+    return (
+        np.asarray(results)[:b],
+        np.asarray(xdp)[:b],
+        np.asarray(stats),
+    )
 
 
 def classify_on_mesh(

@@ -1,0 +1,51 @@
+"""BASELINE config 5: large adversarial overlap set, differential.
+
+The JAX variable-stride trie path is checked verdict-for-verdict against
+the native C++ reference classifier on a deliberately nested/overlapping
+CIDR table far above the dense limit.  The full-size (150K-entry) run is
+gated behind INFW_BIG_TESTS=1 (several GB of host RAM and ~1 min); a
+scaled-down version always runs in CI.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from infw import testing
+from infw.backend.cpu_ref import CpuRefClassifier
+from infw.kernels import jaxpath
+
+
+def _differential(n_entries: int, n_packets: int, seed: int = 23):
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables(
+        rng, n_entries=n_entries, width=8, overlap_fraction=0.6
+    )
+    batch = testing.random_batch(rng, tables, n_packets=n_packets)
+
+    ref = CpuRefClassifier()
+    ref.load_tables(tables)
+    want = ref.classify(batch)
+
+    dt = jaxpath.device_tables(tables)
+    db = jaxpath.device_batch(batch)
+    res, xdp, stats = jaxpath.jitted_classify(True)(dt, db)
+    np.testing.assert_array_equal(np.asarray(res), want.results)
+    np.testing.assert_array_equal(np.asarray(xdp), want.xdp)
+    got_stats = jaxpath.merge_stats_host(np.asarray(stats))
+    np.testing.assert_array_equal(got_stats, want.stats_delta)
+    return tables
+
+
+def test_adversarial_overlap_10k():
+    """Always-on scaled version: 10K nested CIDRs, trie vs native C++."""
+    tables = _differential(n_entries=10_000, n_packets=4096)
+    assert tables.levels >= 7  # deep prefixes present
+
+
+@pytest.mark.skipif(
+    os.environ.get("INFW_BIG_TESTS") != "1",
+    reason="set INFW_BIG_TESTS=1 for the 150K-entry adversarial run",
+)
+def test_adversarial_overlap_150k():
+    _differential(n_entries=150_000, n_packets=8192)

@@ -22,3 +22,20 @@ def test_sharded_classify_matches_oracle(rules_shards):
     from infw.kernels import jaxpath
     got = testing.stats_dict_from_array(jaxpath.merge_stats_host(stats))
     assert got == ref.stats
+
+
+def test_mesh_trie_sharded_matches_oracle():
+    """Trie-sharded rules axis (the 1M-rule-scale path): entries
+    partitioned across shards, winner by pmax over mask_len scores."""
+    from infw.kernels import jaxpath
+
+    rng = np.random.default_rng(31)
+    tables = testing.random_tables(rng, n_entries=120, width=8, overlap_fraction=0.5)
+    batch = testing.random_batch(rng, tables, n_packets=512)
+    m = meshmod.make_mesh(8, rules_shards=4)
+    results, xdp, stats = meshmod.classify_on_mesh_trie(m, tables, batch)
+    ref = oracle.classify(tables, batch)
+    np.testing.assert_array_equal(results, ref.results)
+    np.testing.assert_array_equal(xdp, ref.xdp)
+    got = testing.stats_dict_from_array(jaxpath.merge_stats_host(stats))
+    assert got == ref.stats
