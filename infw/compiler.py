@@ -48,6 +48,7 @@ from .spec import (
     PROTOCOL_TYPE_SCTP,
     PROTOCOL_TYPE_TCP,
     PROTOCOL_TYPE_UDP,
+    PROTOCOL_TYPE_UNSET,
     IngressNodeFirewallRules,
 )
 
@@ -150,6 +151,11 @@ def encode_rules(
             rules[idx, COL_ICMP_TYPE] = pc.icmpv6.icmp_type
             rules[idx, COL_ICMP_CODE] = pc.icmpv6.icmp_code
             rules[idx, COL_PROTOCOL] = IPPROTO_ICMPV6
+        elif proto != PROTOCOL_TYPE_UNSET:
+            # Only the literal "" discriminator means the protocol-0
+            # catch-all; a misspelled value (e.g. "Tcp") must not silently
+            # invert the user's intent into a catch-all rule.
+            raise CompileError(f"unknown protocol {proto!r}")
         # An unset/"" protocol leaves Protocol==0: the catch-all rule
         # (kernel.c:254-257).
 

@@ -41,12 +41,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .backend.base import Classifier
-from .interfaces import InterfaceRegistry, default_registry
+from .compiler import CompileError
+from .interfaces import InterfaceError, InterfaceRegistry, default_registry
 from .nodestate_controller import NodeStateReconciler
 from .obs.events import EventRing, EventsLogger, emit_deny_events
 from .obs.pcap import parse_frames
 from .obs.statistics import Statistics
 from .packets import PacketBatch
+from .schema import validate_nodestate_schema
 from .spec import IngressNodeFirewallNodeState
 from .store import InMemoryStore
 from .syncer import DataplaneSyncer, SyncError
@@ -106,10 +108,15 @@ class DebugLookupBuffer:
 
     def record_batch(self, batch: PacketBatch) -> None:
         ifx = np.asarray(batch.ifindex)
+        if len(ifx) == 0:
+            return
         words = np.asarray(batch.ip_words)
+        # Build all row tuples in C (one column_stack + tolist) rather than
+        # 5 int() conversions per packet in a Python loop.
+        rows = np.column_stack([ifx.reshape(-1, 1), words.reshape(len(ifx), -1)])
+        items = [(r[0], tuple(r[1:])) for r in rows.tolist()]
         with self._lock:
-            for i in range(len(ifx)):
-                self._buf.append((int(ifx[i]), tuple(int(w) for w in words[i])))
+            self._buf.extend(items)
 
     def snapshot(self) -> List[Tuple[int, Tuple[int, int, int, int]]]:
         with self._lock:
@@ -195,6 +202,11 @@ class Daemon:
         self._threads: List[threading.Thread] = []
         self._servers: List[ThreadingHTTPServer] = []
         self._known_state_files: Dict[str, float] = {}
+        # Files rejected deterministically (schema/compile): remembered by
+        # mtime so they are logged once, not every tick — but kept separate
+        # from _known_state_files so deleting a rejected file never counts
+        # as a CR deletion (which would reset the dataplane).
+        self._rejected_state_files: Dict[str, float] = {}
         self.metrics_port = metrics_port
         self.health_port = health_port
 
@@ -215,7 +227,7 @@ class Daemon:
                     # finalizer path already synced the delete; nothing to do
                     return
             self.reconciler.reconcile(obj.metadata.name, obj.metadata.namespace)
-        except SyncError as e:
+        except (SyncError, CompileError, InterfaceError) as e:
             log.error("reconcile failed: %s", e)
 
     # -- file-driven desired state -------------------------------------------
@@ -235,32 +247,60 @@ class Daemon:
             seen[fn] = mtime
             if self._known_state_files.get(fn) == mtime:
                 continue
+            if self._rejected_state_files.get(fn) == mtime:
+                continue
             try:
                 with open(path) as f:
                     doc = json.load(f)
-            except (OSError, json.JSONDecodeError) as e:
+                ns_obj = IngressNodeFirewallNodeState.from_dict(doc)
+            except OSError as e:
+                # I/O errors can be transient; retry next tick.
                 log.error("bad nodestate file %s: %s", fn, e)
                 continue
-            ns_obj = IngressNodeFirewallNodeState.from_dict(doc)
+            except (json.JSONDecodeError, TypeError, AttributeError, ValueError, KeyError) as e:
+                # Deterministically unparseable bytes: reject once by mtime
+                # like the schema tier, not every tick.
+                log.error("bad nodestate file %s: %s", fn, e)
+                self._rejected_state_files[fn] = mtime
+                continue
             if not ns_obj.metadata.name:
                 ns_obj.metadata.name = fn[: -len(".json")]
             if not ns_obj.metadata.namespace:
                 ns_obj.metadata.namespace = self.namespace
             if ns_obj.metadata.name != self.node_name:
                 continue
+            schema_errs = validate_nodestate_schema(ns_obj)
+            if schema_errs:
+                # The file protocol has no API server in front of it; apply
+                # the schema tier here so a misspelled protocol or order=0
+                # is rejected with CRD-style messages, not a compile error.
+                log.error("schema-invalid nodestate %s: %s", fn, "; ".join(schema_errs))
+                self._rejected_state_files[fn] = mtime
+                continue
             try:
                 self.syncer.sync_interface_ingress_rules(
                     ns_obj.spec.interface_ingress_rules, False
                 )
                 self._known_state_files[fn] = mtime
-            except SyncError as e:
+            except CompileError as e:
+                # Deterministic input error: re-reading the same bytes can
+                # never succeed, so record the mtime and reject once.
                 log.error("sync failed for %s: %s", fn, e)
+                self._rejected_state_files[fn] = mtime
+            except (SyncError, InterfaceError) as e:
+                # Possibly transient (interface not up yet, attach EBUSY):
+                # leave unrecorded so the next tick retries, but never
+                # abort the rest of the scan.
+                log.error("sync failed for %s: %s", fn, e)
+        for fn in list(self._rejected_state_files):
+            if fn not in seen:
+                del self._rejected_state_files[fn]
         for fn in list(self._known_state_files):
             if fn not in seen:
                 del self._known_state_files[fn]
                 try:
                     self.syncer.sync_interface_ingress_rules({}, True)
-                except SyncError as e:
+                except (SyncError, CompileError, InterfaceError) as e:
                     log.error("delete sync failed for %s: %s", fn, e)
 
     # -- ingest --------------------------------------------------------------
@@ -357,11 +397,16 @@ class Daemon:
 
     def _file_loop(self) -> None:
         while not self._stop.wait(self.file_poll_interval_s):
+            # Scan and ingest are isolated from each other: a persistently
+            # bad nodestate file must not starve packet classification.
             try:
                 self.scan_nodestates_once()
-                self.process_ingest_once()
             except Exception as e:  # keep the loop alive
-                log.error("daemon loop error: %s", e)
+                log.error("nodestate scan error: %s", e)
+            try:
+                self.process_ingest_once()
+            except Exception as e:
+                log.error("ingest error: %s", e)
 
     def stop(self) -> None:
         """SIGTERM path: stop polling/serving, detach the dataplane but

@@ -134,6 +134,14 @@ def build_pallas_tables(tables: CompiledTables, dtype: str = DEFAULT_DTYPE) -> P
     mask_len[0, :T] = tables.mask_len[:T]
 
     R = tables.rule_width
+    # ruleId and action share one byte as (ruleId<<1)|action, so ruleIds
+    # must fit in 7 bits; encode_rules guarantees order < 100, but a caller
+    # passing a wider custom table must fail loudly, not misclassify.
+    if tables.rule_width > 128:
+        raise ValueError(
+            f"rule_width {tables.rule_width} > 128: ruleId would not fit "
+            "in the packed (ruleId<<1)|action byte"
+        )
     rb = np.zeros((Tp, NUM_FIELDS * RULE_PAD), np.float32)
     rules = tables.rules[:T].astype(np.int64)
     rid = rules[..., 0] & 0x7F

@@ -21,6 +21,7 @@ Semantics preserved from the k8s client:
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -33,6 +34,8 @@ from .spec import (
     ObjectMeta,
     deep_copy,
 )
+
+log = logging.getLogger("infw.store")
 
 
 class StoreError(RuntimeError):
@@ -364,4 +367,10 @@ class InMemoryStore:
         with self._lock:
             callbacks = list(self._watchers.get(obj.KIND, []))
         for cb in callbacks:
-            cb(event, _copy(obj))
+            # A raising watcher must not propagate into the writer's
+            # create/update call or skip the remaining watchers (mirrors
+            # controller-runtime's per-handler workqueue isolation).
+            try:
+                cb(event, _copy(obj))
+            except Exception:
+                log.exception("watch callback failed for %s %s", event, obj.KIND)

@@ -160,9 +160,14 @@ class DataplaneSyncer:
                 if is_delete:
                     self._reset_all()
                     return
+                # Build the desired table content BEFORE touching the attach
+                # set: compilation is pure, so a CompileError (bad port
+                # string, out-of-range order...) leaves the dataplane exactly
+                # as it was — no interfaces detached, last-good rules intact.
+                desired, width = self._build_desired_content(iface_ingress_rules)
                 self._detach_unmanaged_interfaces(iface_ingress_rules)
                 self._attach_new_interfaces(iface_ingress_rules)
-                self._load_ingress_node_firewall_rules(iface_ingress_rules)
+                self._load_ingress_node_firewall_rules(desired, width)
                 # The attach/detach set may change even when rule content
                 # does not; the manifest must always reflect it or a restart
                 # re-adopts stale attachments.
@@ -276,12 +281,11 @@ class DataplaneSyncer:
             if last is not None:
                 raise SyncError(f"failed to attach interface {name}: {last}")
 
-    def _load_ingress_node_firewall_rules(
+    def _build_desired_content(
         self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
-    ) -> None:
-        """loadIngressNodeFirewallRules → IngressNodeFwRulesLoader
-        (loader.go:130-194): build desired content, diff against current,
-        reload the device tables only when the content changed, then pin."""
+    ) -> Tuple[Dict[LpmKey, np.ndarray], int]:
+        """Pure compile step: CRD rules → packed map content.  Raises
+        CompileError/InterfaceError without mutating any syncer state."""
         valid = self._valid_fn()
         width = self._desired_width(iface_ingress_rules)
         raw = build_table_content(
@@ -293,7 +297,14 @@ class DataplaneSyncer:
         dedup = {}
         for k, v in raw.items():
             dedup[k.masked_identity()] = (k, v)
-        desired = {k: v for k, v in dedup.values()}
+        return {k: v for k, v in dedup.values()}, width
+
+    def _load_ingress_node_firewall_rules(
+        self, desired: Dict[LpmKey, np.ndarray], width: int
+    ) -> None:
+        """loadIngressNodeFirewallRules → IngressNodeFwRulesLoader
+        (loader.go:130-194): diff desired against current, reload the
+        device tables only when the content changed, then pin."""
         stale = self._get_stale_keys(desired)
         current = {k.masked_identity(): v for k, v in self._content.items()}
         changed = bool(stale) or any(

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Iterable, List, Optional, Tuple
 
-from . import failsaferules, portutils
+from . import failsaferules, portutils, schema
 from .netutil import validate_source_cidr
 from .spec import (
     ACTION_ALLOW,
@@ -41,7 +41,12 @@ def validate_ingress_node_firewall(
     inf: IngressNodeFirewall,
     existing: Iterable[IngressNodeFirewall] = (),
 ) -> List[str]:
-    """validateIngressNodeFirewall (webhook.go:74-86)."""
+    """validateIngressNodeFirewall (webhook.go:74-86), preceded by the
+    schema (OpenAPI/CEL) tier — the API server rejects on that tier
+    before the webhook ever runs, so it short-circuits here too."""
+    schema_errs = schema.validate_ingress_node_firewall_schema(inf)
+    if schema_errs:
+        return schema_errs
     errs = validate_inf_rules(inf, existing)
     if errs:
         return errs

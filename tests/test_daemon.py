@@ -265,3 +265,55 @@ def test_daemon_restart_readopts(tmp_path):
         assert d2.syncer.attached_interfaces() == {"dummy0"}
     finally:
         d2.stop()
+
+
+def test_schema_invalid_nodestate_file_rejected_and_isolated(daemon):
+    """The state-dir protocol has no API server: the daemon applies the
+    schema tier itself, and a persistently bad file must not abort the
+    scan or block a *different* file for the same node (ADVICE r1)."""
+    bad = node_state().to_dict()
+    bad["spec"]["interfaceIngressRules"]["dummy0"][0]["rules"][0][
+        "protocolConfig"]["protocol"] = "Tcp"
+    # Different filename, same metadata.name -> still targets this node.
+    path = os.path.join(daemon.nodestates_dir, "aaa-bad.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(bad, f)
+    os.replace(path + ".tmp", path)
+    time.sleep(0.2)
+    # never synced: schema tier rejected it before compile
+    assert daemon.syncer.classifier is None or daemon.syncer.classifier.tables is None
+
+    # the bad file stays on disk; a good file later in sort order must
+    # still be scanned and synced
+    good = node_state().to_dict()
+    p2 = os.path.join(daemon.nodestates_dir, f"{NODE}.json")
+    with open(p2 + ".tmp", "w") as f:
+        json.dump(good, f)
+    os.replace(p2 + ".tmp", p2)
+    assert _wait(lambda: daemon.syncer.classifier is not None
+                 and daemon.syncer.classifier.tables is not None)
+    assert os.path.exists(path)
+
+
+def test_deleting_rejected_file_does_not_reset_dataplane(daemon):
+    """A rejected (schema-invalid) file is not desired state; removing it
+    must not be treated as CR deletion."""
+    good = node_state().to_dict()
+    p = os.path.join(daemon.nodestates_dir, f"{NODE}.json")
+    with open(p + ".tmp", "w") as f:
+        json.dump(good, f)
+    os.replace(p + ".tmp", p)
+    assert _wait(lambda: daemon.syncer.classifier is not None
+                 and daemon.syncer.classifier.tables is not None)
+
+    bad = node_state().to_dict()
+    bad["spec"]["interfaceIngressRules"]["dummy0"][0]["rules"][0]["order"] = 0
+    pbad = os.path.join(daemon.nodestates_dir, "zzz-bad.json")
+    with open(pbad + ".tmp", "w") as f:
+        json.dump(bad, f)
+    os.replace(pbad + ".tmp", pbad)
+    time.sleep(0.2)
+    os.remove(pbad)
+    time.sleep(0.2)
+    assert daemon.syncer.classifier is not None
+    assert daemon.syncer.classifier.tables is not None

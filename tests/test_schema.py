@@ -1,0 +1,228 @@
+"""Schema-tier (OpenAPI/CEL) validation tests.
+
+These invariants come from the kubebuilder markers on the reference API
+types (/root/reference/api/v1alpha1/ingressnodefirewall_types.go:26-38,
+51-61, 93-97, 128-130) — the tier the API server enforces before the
+webhook runs.
+"""
+import pytest
+
+from infw import schema, validate
+from infw.compiler import CompileError, encode_rules
+from infw.spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallICMPRule,
+    IngressNodeFirewallNodeState,
+    IngressNodeFirewallNodeStateSpec,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallRules,
+    IngressNodeFirewallSpec,
+    IngressNodeProtocolConfig,
+    ObjectMeta,
+)
+
+
+def mk_inf(rules, cidrs=("10.0.0.0/8",), name="inf-schema"):
+    return IngressNodeFirewall(
+        metadata=ObjectMeta(name=name),
+        spec=IngressNodeFirewallSpec(
+            interfaces=["eth0"],
+            ingress=[
+                IngressNodeFirewallRules(
+                    source_cidrs=list(cidrs), rules=list(rules)
+                )
+            ],
+        ),
+    )
+
+
+def tcp_rule(order=1, ports=80, action="Deny", protocol="TCP"):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol=protocol, tcp=IngressNodeFirewallProtoRule(ports=ports)
+        ),
+        action=action,
+    )
+
+
+def icmp_rule(order=1, icmp_type=8, icmp_code=0, action="Deny", v6=False):
+    icmp = IngressNodeFirewallICMPRule(icmp_type=icmp_type, icmp_code=icmp_code)
+    pc = (
+        IngressNodeProtocolConfig(protocol="ICMPv6", icmpv6=icmp)
+        if v6
+        else IngressNodeProtocolConfig(protocol="ICMP", icmp=icmp)
+    )
+    return IngressNodeFirewallProtocolRule(order=order, protocol_config=pc, action=action)
+
+
+class TestProtocolEnum:
+    def test_misspelled_protocol_rejected(self):
+        # VERDICT round-1 confirmed bug: "Tcp" used to pass with zero errors
+        # and silently compile to a protocol-0 catch-all.
+        inf = mk_inf([tcp_rule(protocol="Tcp")])
+        errs = validate.validate_ingress_node_firewall(inf)
+        assert any('Unsupported value: "Tcp"' in e for e in errs)
+
+    @pytest.mark.parametrize("proto", ["tcp", "TCP6", "icmp", "Udp", "ICMPV6"])
+    def test_bad_protocol_values(self, proto):
+        inf = mk_inf([tcp_rule(protocol=proto)])
+        errs = validate.validate_ingress_node_firewall(inf)
+        assert any(f'Unsupported value: "{proto}"' in e for e in errs)
+
+    def test_empty_protocol_is_legal_catch_all(self):
+        rule = IngressNodeFirewallProtocolRule(
+            order=1, protocol_config=IngressNodeProtocolConfig(protocol=""),
+            action="Deny",
+        )
+        assert validate.validate_ingress_node_firewall(mk_inf([rule])) == []
+
+    def test_all_enum_values_accepted(self):
+        rules = [
+            tcp_rule(order=1),
+            IngressNodeFirewallProtocolRule(
+                order=2,
+                protocol_config=IngressNodeProtocolConfig(
+                    protocol="UDP", udp=IngressNodeFirewallProtoRule(ports=5000)
+                ),
+                action="Deny",
+            ),
+            IngressNodeFirewallProtocolRule(
+                order=3,
+                protocol_config=IngressNodeProtocolConfig(
+                    protocol="SCTP", sctp=IngressNodeFirewallProtoRule(ports=5001)
+                ),
+                action="Deny",
+            ),
+            icmp_rule(order=4),
+            icmp_rule(order=5, v6=True),
+        ]
+        assert validate.validate_ingress_node_firewall(mk_inf(rules)) == []
+
+
+class TestOrderMinimum:
+    def test_order_zero_rejected_at_admission(self):
+        errs = validate.validate_ingress_node_firewall(mk_inf([tcp_rule(order=0)]))
+        assert any("order in body should be greater than or equal to 1" in e for e in errs)
+
+    def test_negative_order_rejected(self):
+        errs = validate.validate_ingress_node_firewall(mk_inf([tcp_rule(order=-3)]))
+        assert any("greater than or equal to 1" in e for e in errs)
+
+    def test_order_one_ok(self):
+        assert validate.validate_ingress_node_firewall(mk_inf([tcp_rule(order=1)])) == []
+
+
+class TestIcmpBounds:
+    @pytest.mark.parametrize("field,val", [("type", 256), ("type", -1), ("code", 256), ("code", 999)])
+    def test_out_of_bounds_rejected(self, field, val):
+        kw = {"icmp_type": val} if field == "type" else {"icmp_code": val}
+        errs = validate.validate_ingress_node_firewall(mk_inf([icmp_rule(**kw)]))
+        assert any("in body should be" in e and "icmp" in e for e in errs)
+
+    def test_icmpv6_bounds_checked_too(self):
+        errs = validate.validate_ingress_node_firewall(
+            mk_inf([icmp_rule(icmp_type=256, v6=True)])
+        )
+        assert any("icmpv6.icmpType" in e for e in errs)
+
+    @pytest.mark.parametrize("val", [0, 255])
+    def test_boundary_values_accepted(self, val):
+        assert (
+            validate.validate_ingress_node_firewall(
+                mk_inf([icmp_rule(icmp_type=val, icmp_code=val)])
+            )
+            == []
+        )
+
+
+class TestActionEnum:
+    @pytest.mark.parametrize("action", ["allow", "DENY", "Drop", ""])
+    def test_bad_action_rejected(self, action):
+        errs = validate.validate_ingress_node_firewall(mk_inf([tcp_rule(action=action)]))
+        assert any(f'Unsupported value: "{action}"' in e for e in errs)
+
+    @pytest.mark.parametrize("action", ["Allow", "Deny"])
+    def test_enum_actions_accepted(self, action):
+        assert validate.validate_ingress_node_firewall(mk_inf([tcp_rule(action=action)])) == []
+
+
+class TestUnionCelRules:
+    """The five XValidation rules (types.go:52-56)."""
+
+    def test_tcp_required_when_protocol_tcp(self):
+        rule = IngressNodeFirewallProtocolRule(
+            order=1, protocol_config=IngressNodeProtocolConfig(protocol="TCP"),
+            action="Deny",
+        )
+        errs = validate.validate_ingress_node_firewall(mk_inf([rule]))
+        assert any("tcp is required when protocol is TCP, and forbidden otherwise" in e for e in errs)
+
+    def test_tcp_forbidden_when_protocol_icmp(self):
+        rule = IngressNodeFirewallProtocolRule(
+            order=1,
+            protocol_config=IngressNodeProtocolConfig(
+                protocol="ICMP",
+                icmp=IngressNodeFirewallICMPRule(icmp_type=8),
+                tcp=IngressNodeFirewallProtoRule(ports=80),
+            ),
+            action="Deny",
+        )
+        errs = validate.validate_ingress_node_firewall(mk_inf([rule]))
+        assert any("tcp is required when protocol is TCP, and forbidden otherwise" in e for e in errs)
+
+    @pytest.mark.parametrize(
+        "proto,member_msg",
+        [
+            ("UDP", "udp is required when protocol is UDP"),
+            ("SCTP", "sctp is required when protocol is SCTP"),
+            ("ICMP", "icmp is required when protocol is ICMP,"),
+            ("ICMPv6", "icmpv6 is required when protocol is ICMPv6"),
+        ],
+    )
+    def test_member_required_per_discriminator(self, proto, member_msg):
+        rule = IngressNodeFirewallProtocolRule(
+            order=1, protocol_config=IngressNodeProtocolConfig(protocol=proto),
+            action="Deny",
+        )
+        errs = validate.validate_ingress_node_firewall(mk_inf([rule]))
+        assert any(member_msg in e for e in errs)
+
+    def test_members_forbidden_when_protocol_unset(self):
+        rule = IngressNodeFirewallProtocolRule(
+            order=1,
+            protocol_config=IngressNodeProtocolConfig(
+                protocol="", udp=IngressNodeFirewallProtoRule(ports=53)
+            ),
+            action="Deny",
+        )
+        errs = validate.validate_ingress_node_firewall(mk_inf([rule]))
+        assert any("udp is required when protocol is UDP" in e for e in errs)
+
+
+class TestCompilerGuards:
+    def test_unknown_protocol_is_compile_error_not_catch_all(self):
+        ingress = mk_inf([tcp_rule(protocol="Tcp")]).spec.ingress[0]
+        with pytest.raises(CompileError, match="unknown protocol 'Tcp'"):
+            encode_rules(ingress)
+
+
+class TestNodeStateSchema:
+    def test_nodestate_rules_share_schema_tier(self):
+        ns = IngressNodeFirewallNodeState(
+            metadata=ObjectMeta(name="node-a"),
+            spec=IngressNodeFirewallNodeStateSpec(
+                interface_ingress_rules={
+                    "eth0": [
+                        IngressNodeFirewallRules(
+                            source_cidrs=["10.0.0.0/8"],
+                            rules=[tcp_rule(order=0, protocol="Tcp")],
+                        )
+                    ]
+                }
+            ),
+        )
+        errs = schema.validate_nodestate_schema(ns)
+        assert any("order in body should be greater than or equal to 1" in e for e in errs)
+        assert any('Unsupported value: "Tcp"' in e for e in errs)

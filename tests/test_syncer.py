@@ -511,3 +511,28 @@ def test_stats_poller_paused_around_sync(make_syncer):
         {"dummy0": [ingress(["1.0.0.0/8"], [tcp_rule(1, 1, ACTION_DENY)])]}, False
     )
     assert events == ["stop", ("start", True)]
+
+
+def test_compile_error_leaves_dataplane_untouched():
+    """A schema-valid but compile-invalid update (bad port string) must not
+    detach interfaces or drop the last-good rules: compilation happens
+    before any attach-set mutation."""
+    from infw.compiler import CompileError
+
+    reg = InterfaceRegistry()
+    for i, name in enumerate(["dummy0", "dummy1", "dummy2"]):
+        reg.add(Interface(name=name, index=10 + i))
+    s = DataplaneSyncer(classifier_factory=CpuRefClassifier, registry=reg)
+    good = {"dummy0": [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(good, False)
+    assert s.attached_interfaces() == {"dummy0"}
+    before = s.get_classifier_map_content_for_test()
+
+    bad_rule = tcp_rule(1, "80-abc", ACTION_DENY)
+    bad = {"dummy1": [ingress(["10.0.0.0/8"], [bad_rule])]}
+    with pytest.raises((SyncError, CompileError)):
+        s.sync_interface_ingress_rules(bad, False)
+    # dummy0 still attached, dummy1 never attached, content unchanged
+    assert s.attached_interfaces() == {"dummy0"}
+    after = s.get_classifier_map_content_for_test()
+    assert set(before) == set(after)

@@ -78,7 +78,11 @@ def test_invalid_cidr_rejected():
 
 
 def test_empty_cidrs_rejected():
+    # Schema tier (MinItems:=1) fires first, like the API server would.
     errs = validate.validate_ingress_node_firewall(inf(cidrs=[]))
+    assert any("should have at least 1 items" in e for e in errs)
+    # The webhook-tier check still exists beneath it.
+    errs = validate.validate_inf_rules(inf(cidrs=[]), [])
     assert any("at least one sourceCIDR" in e for e in errs)
 
 
@@ -117,7 +121,11 @@ def test_too_many_rules_rejected():
 def test_icmp_rule_with_ports_rejected():
     bad = icmp_rule(1)
     bad.protocol_config.tcp = IngressNodeFirewallProtoRule(ports=80)
+    # Schema tier: the tcp union member is forbidden for protocol ICMP.
     errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("tcp is required when protocol is TCP, and forbidden otherwise" in e for e in errs)
+    # Webhook tier beneath it still rejects on its own.
+    errs = validate.validate_inf_rules(inf(rules=[bad]), [])
     assert any("ports are erroneously defined" in e for e in errs)
 
 
@@ -126,6 +134,8 @@ def test_tcp_rule_without_ports_rejected():
         order=1, protocol_config=IngressNodeProtocolConfig(protocol="TCP")
     )
     errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("tcp is required when protocol is TCP" in e for e in errs)
+    errs = validate.validate_inf_rules(inf(rules=[bad]), [])
     assert any("no port defined" in e for e in errs)
 
 
@@ -133,6 +143,8 @@ def test_tcp_rule_with_icmp_rejected():
     bad = tcp_rule(1, 80)
     bad.protocol_config.icmp = IngressNodeFirewallICMPRule()
     errs = validate.validate_ingress_node_firewall(inf(rules=[bad]))
+    assert any("icmp is required when protocol is ICMP, and forbidden otherwise" in e for e in errs)
+    errs = validate.validate_inf_rules(inf(rules=[bad]), [])
     assert any("ICMP type/code defined" in e for e in errs)
 
 
