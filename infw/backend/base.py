@@ -30,6 +30,27 @@ class ClassifyOutput:
     stats_delta: np.ndarray
 
 
+class PendingClassify:
+    """Handle to an in-flight classification: the device work was dispatched
+    but the results are not yet materialized on the host.
+
+    The TPU analogue of the XDP program running inline on the NIC queue: a
+    caller streaming batches keeps several in flight so H2D transfer, kernel
+    and D2H readback of consecutive batches overlap.  `result()` blocks
+    until this batch's outputs are host-resident and applies the stats
+    increment exactly once."""
+
+    def __init__(self, materialize) -> None:
+        self._materialize = materialize
+        self._out: Optional[ClassifyOutput] = None
+
+    def result(self) -> ClassifyOutput:
+        if self._out is None:
+            self._out = self._materialize()
+            self._materialize = None  # drop device refs
+        return self._out
+
+
 class StatsAccumulator:
     """Host-side equivalent of the per-CPU statistics map
     (bpf/ingress_node_firewall_kernel.c:36-41): accumulates per-ruleId
@@ -60,6 +81,11 @@ class Classifier(Protocol):
         ...
 
     def classify(self, batch: PacketBatch) -> ClassifyOutput:
+        ...
+
+    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+        """Dispatch without blocking; materialize via .result().  Sync
+        backends may run eagerly and return an already-resolved handle."""
         ...
 
     @property

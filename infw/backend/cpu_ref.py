@@ -18,7 +18,7 @@ import numpy as np
 from ..compiler import CompiledTables
 from ..constants import MAX_TARGETS
 from ..packets import PacketBatch
-from .base import ClassifyOutput, StatsAccumulator
+from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "classifier.cpp")
@@ -125,6 +125,12 @@ class CpuRefClassifier:
         )
         self._stats.add(stats)
         return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats)
+
+    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+        """Eager: the native call is synchronous, so the handle resolves
+        immediately (protocol parity with TpuClassifier)."""
+        out = self.classify(batch)
+        return PendingClassify(lambda: out)
 
     @property
     def stats(self) -> StatsAccumulator:

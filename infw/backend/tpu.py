@@ -11,11 +11,14 @@ the XLA trie path (100K+ CIDRs).  Design points:
   current set; the swap is a single reference assignment under a lock, so
   in-flight batches finish on the old tables and new batches see the new
   ones — no torn reads, no pause.
-- **async pipelining**: classify() dispatches without blocking (JAX's
-  async dispatch queues the work); results are materialized lazily, so a
-  caller streaming batches overlaps host<->device transfer with compute.
+- **async pipelining**: classify_async() dispatches the H2D transfer and
+  kernel and returns a PendingClassify holding *unmaterialized* device
+  arrays; nothing blocks until .result() is called, so a caller keeping
+  several batches in flight overlaps H2D / kernel / D2H of consecutive
+  batches (the daemon's streaming ingest does exactly this).  classify()
+  is the synchronous convenience: dispatch + immediate materialize.
 - statistics accumulate host-side in int64 from the device's per-batch
-  (1024, 6) int32 sums.
+  (1024, 6) int32 sums, applied exactly once when a batch materializes.
 """
 from __future__ import annotations
 
@@ -28,7 +31,7 @@ import numpy as np
 from ..compiler import CompiledTables
 from ..kernels import jaxpath, pallas_dense
 from ..packets import PacketBatch
-from .base import ClassifyOutput, StatsAccumulator
+from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 
 
 class TpuClassifier:
@@ -76,23 +79,37 @@ class TpuClassifier:
 
     # -- classify -----------------------------------------------------------
 
-    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+        """Dispatch H2D + kernel now; return a handle whose .result()
+        materializes D2H and applies the stats increment exactly once.
+        JAX's async dispatch means this returns as soon as the work is
+        enqueued — in-flight batches finish on whatever table buffer they
+        were dispatched against (the double-buffer swap contract)."""
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
             path, dev, block_b = self._active
-        db = jaxpath.device_batch(batch, self._device)
+        # Packed wire format: 28B/packet H2D, 2B/packet D2H — the
+        # host<->device link is the streaming bottleneck, not the kernel.
+        wire = jax.device_put(batch.pack_wire(), self._device)
+        kind = np.asarray(batch.kind)
         if path == "dense":
-            res, xdp, stats = pallas_dense.jitted_classify_pallas(
+            res16, stats = pallas_dense.jitted_classify_pallas_wire(
                 self._interpret, block_b
-            )(dev, db)
+            )(dev, wire)
         else:
-            res, xdp, stats = jaxpath.jitted_classify(True)(dev, db)
-        stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
-        self._stats.add(stats_delta)
-        return ClassifyOutput(
-            results=np.asarray(res), xdp=np.asarray(xdp), stats_delta=stats_delta
-        )
+            res16, stats = jaxpath.jitted_classify_wire(True)(dev, wire)
+
+        def materialize() -> ClassifyOutput:
+            stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
+            self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(np.asarray(res16), kind)
+            return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats_delta)
+
+        return PendingClassify(materialize)
+
+    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+        return self.classify_async(batch).result()
 
     # -- accessors / lifecycle ---------------------------------------------
 

@@ -58,6 +58,8 @@ log = logging.getLogger("infw.daemon")
 DEFAULT_METRICS_PORT = 39301   # cmd/daemon/daemon.go:57
 DEFAULT_HEALTH_PORT = 39300    # cmd/daemon/daemon.go:58
 DEBUG_MAP_ENTRIES = 16384      # kernel.c:63 debug map max_entries
+DEFAULT_INGEST_CHUNK = 1 << 16     # packets per in-flight sub-batch
+DEFAULT_PIPELINE_DEPTH = 4         # async classify handles kept in flight
 
 _FRAMES_MAGIC = b"INFW1\n"
 
@@ -158,6 +160,8 @@ class Daemon:
         health_port: int = DEFAULT_HEALTH_PORT,
         file_poll_interval_s: float = 0.2,
         event_sink=None,
+        ingest_chunk: int = DEFAULT_INGEST_CHUNK,
+        pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -165,6 +169,8 @@ class Daemon:
         self.backend = backend
         self.debug_lookup = debug_lookup
         self.file_poll_interval_s = file_poll_interval_s
+        self.ingest_chunk = max(1, int(ingest_chunk))
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.registry = registry if registry is not None else default_registry
 
         self.nodestates_dir = os.path.join(state_dir, "nodestates")
@@ -307,10 +313,59 @@ class Daemon:
 
     def process_ingest_once(self) -> int:
         """Classify every frames file in the ingest dir; write verdict
-        summaries to out/; emit deny events; consume the file."""
-        processed = 0
-        if self.syncer.classifier is None or self.syncer.classifier.tables is None:
+        summaries to out/; emit deny events; consume the file.
+
+        Streaming pipeline: each file's batch is split into chunks of
+        ``ingest_chunk`` packets, dispatched with ``classify_async`` and
+        kept ``pipeline_depth`` deep in flight, so H2D transfer, device
+        kernel and D2H readback of consecutive chunks overlap instead of
+        serializing one full round trip per file (the inline per-packet
+        role of bpf/ingress_node_firewall_kernel.c:412-457)."""
+        clf = self.syncer.classifier
+        if clf is None or clf.tables is None:
             return 0
+        inflight: deque = deque()
+        processed = 0
+
+        def finalize(fctx) -> None:
+            """Write verdicts, emit events, consume the file — runs as soon
+            as the file's last chunk drains, so a failure here leaves at
+            most the in-flight window (not the whole backlog) exposed to
+            re-classification, and memory stays bounded per file."""
+            nonlocal processed
+            fctx["parts"].sort(key=lambda p: p[0])
+            parts = fctx["parts"]
+            results = (
+                np.concatenate([np.asarray(out.results) for _, out in parts])
+                if parts else np.zeros(0, np.uint32)
+            )
+            xdp = (
+                np.concatenate([np.asarray(out.xdp) for _, out in parts])
+                if parts else np.zeros(0, np.int32)
+            )
+            batch, frames, fn = fctx["batch"], fctx["frames"], fctx["fn"]
+            if self.debug_lookup:
+                self.debug_buffer.record_batch(batch)
+            emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, frames)
+            summary = {
+                "file": fn,
+                "packets": len(frames),
+                "pass": int((xdp == 2).sum()),
+                "drop": int((xdp == 1).sum()),
+                "results": [int(r) for r in results],
+            }
+            with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
+                json.dump(summary, f)
+            os.remove(fctx["path"])
+            processed += 1
+
+        def drain_one() -> None:
+            fctx, start, pending = inflight.popleft()
+            fctx["parts"].append((start, pending.result()))
+            fctx["remaining"] -= 1
+            if fctx["remaining"] == 0:
+                finalize(fctx)
+
         for fn in sorted(os.listdir(self.ingest_dir)):
             path = os.path.join(self.ingest_dir, fn)
             if fn.endswith(".tmp") or not os.path.isfile(path):
@@ -322,24 +377,22 @@ class Daemon:
                 os.remove(path)
                 continue
             batch = parse_frames(frames, ifindexes)
-            out = self.syncer.classifier.classify(batch)
-            if self.debug_lookup:
-                self.debug_buffer.record_batch(batch)
-            emit_deny_events(
-                self.ring, out.results, batch.ifindex, batch.pkt_len, frames
-            )
-            xdp = np.asarray(out.xdp)
-            summary = {
-                "file": fn,
-                "packets": len(frames),
-                "pass": int((xdp == 2).sum()),
-                "drop": int((xdp == 1).sum()),
-                "results": [int(r) for r in np.asarray(out.results)],
+            n = len(batch)
+            starts = list(range(0, n, self.ingest_chunk))
+            fctx = {
+                "fn": fn, "path": path, "frames": frames, "batch": batch,
+                "parts": [], "remaining": len(starts),
             }
-            with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
-                json.dump(summary, f)
-            os.remove(path)
-            processed += 1
+            if n == 0:
+                finalize(fctx)  # no device dispatch for an empty file
+                continue
+            for s in starts:
+                sub = batch.slice(s, min(s + self.ingest_chunk, n))
+                while len(inflight) >= self.pipeline_depth:
+                    drain_one()
+                inflight.append((fctx, s, clf.classify_async(sub)))
+        while inflight:
+            drain_one()
         return processed
 
     # -- HTTP endpoints ------------------------------------------------------
@@ -445,6 +498,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument("--metrics-port", type=int, default=DEFAULT_METRICS_PORT)
     p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
+    p.add_argument("--ingest-chunk", type=int, default=DEFAULT_INGEST_CHUNK)
+    p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
     args = p.parse_args(argv)
 
     if not args.node_name:
@@ -464,6 +519,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         debug_lookup=debug,
         metrics_port=args.metrics_port,
         health_port=args.health_port,
+        ingest_chunk=args.ingest_chunk,
+        pipeline_depth=args.pipeline_depth,
     )
     stop = threading.Event()
 

@@ -20,6 +20,7 @@ Two LPM strategies, selected by table size:
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Tuple
 
 import jax
@@ -111,6 +112,56 @@ def device_batch(batch: PacketBatch, device=None) -> DeviceBatch:
         icmp_code=put(batch.icmp_code),
         pkt_len=put(batch.pkt_len),
     )
+
+
+def unpack_wire(wire: jax.Array) -> DeviceBatch:
+    """Device-side inverse of PacketBatch.pack_wire: (B, 7) uint32 →
+    DeviceBatch.  Pure elementwise bit ops, fused by XLA into whatever
+    consumes the fields — the packed descriptor never round-trips HBM."""
+    w0 = wire[:, 0]
+    w1 = wire[:, 1]
+    return DeviceBatch(
+        kind=(w0 & 3).astype(jnp.int32),
+        l4_ok=((w0 >> 2) & 1).astype(jnp.int32),
+        ifindex=wire[:, 2].astype(jnp.int32),
+        ip_words=wire[:, 3:7],
+        proto=((w0 >> 3) & 0xFF).astype(jnp.int32),
+        dst_port=(w1 & 0xFFFF).astype(jnp.int32),
+        icmp_type=((w0 >> 11) & 0xFF).astype(jnp.int32),
+        icmp_code=((w0 >> 19) & 0xFF).astype(jnp.int32),
+        pkt_len=((w1 >> 16) & 0xFFFF).astype(jnp.int32),
+    )
+
+
+def classify_wire(
+    tables: DeviceTables, wire: jax.Array, *, use_trie: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Wire-format forward pass: packed descriptors in, (results_u16,
+    stats) out.  The D2H payload is 2B/packet — ruleId ≤ 255 always holds
+    (MAX_RULES_PER_TARGET=100), and the XDP verdict is host-derivable from
+    (results, kind), so neither the u32 results nor the xdp array crosses
+    the link."""
+    res, _xdp, stats = classify(tables, unpack_wire(wire), use_trie=use_trie)
+    return res.astype(jnp.uint16), stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_wire(use_trie: bool):
+    return jax.jit(functools.partial(classify_wire, use_trie=use_trie))
+
+
+def host_finalize_wire(res16: np.ndarray, kind: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side completion of the wire path: widen results to u32 and
+    rebuild the XDP verdict exactly as finalize() does on device
+    (kernel.c:423-455 — malformed DROP, deny DROP, else PASS)."""
+    results = res16.astype(np.uint32)
+    action = results & 0xFF
+    xdp = np.where(
+        kind == KIND_MALFORMED,
+        XDP_DROP,
+        np.where(action == DENY, XDP_DROP, XDP_PASS),
+    ).astype(np.int32)
+    return results, xdp
 
 
 def packet_key_words(batch: DeviceBatch) -> jax.Array:
@@ -263,9 +314,6 @@ def classify(
     rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
     result = rule_scan(rows, batch)
     return finalize(result, batch)
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=None)

@@ -333,6 +333,28 @@ def _jitted_classify_pallas(interpret: bool, block_b: int):
     )
 
 
+def classify_pallas_wire(
+    pt: PallasTables, wire: jax.Array, interpret: bool = False,
+    block_b: int = BLOCK_B,
+) -> Tuple[jax.Array, jax.Array]:
+    """Wire-format Pallas pass (see jaxpath.classify_wire): packed (B, 7)
+    uint32 descriptors in, (results_u16, stats) out; the unpack fuses into
+    the field-stacking that feeds the kernel."""
+    from . import jaxpath
+
+    res, _xdp, stats = classify_pallas(
+        pt, jaxpath.unpack_wire(wire), interpret=interpret, block_b=block_b
+    )
+    return res.astype(jnp.uint16), stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_pallas_wire(interpret: bool, block_b: int = BLOCK_B):
+    return jax.jit(
+        functools.partial(classify_pallas_wire, interpret=interpret, block_b=block_b)
+    )
+
+
 def jitted_classify_pallas(interpret: bool, block_b: int = BLOCK_B):
     """Cached jit wrapper; the cache key is normalized so callers that omit
     block_b share the entry with callers passing BLOCK_B explicitly."""

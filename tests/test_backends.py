@@ -95,3 +95,66 @@ def test_cpp_large_random_differential():
     clf = CpuRefClassifier()
     clf.load_tables(tables)
     check_against_oracle(clf, tables, batch)
+
+
+def test_classify_async_matches_sync_and_stats_once():
+    """classify_async with several handles in flight returns identical
+    results to the sync path, and each batch's stats apply exactly once,
+    on materialization."""
+    rng = np.random.default_rng(31)
+    tables = testing.random_tables(rng, n_entries=40, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=256)
+
+    sync_clf = TpuClassifier()
+    sync_clf.load_tables(tables)
+    want = sync_clf.classify(batch)
+    sync_clf.close()
+
+    clf = TpuClassifier()
+    clf.load_tables(tables)
+    pendings = [clf.classify_async(batch) for _ in range(3)]
+    assert (clf.stats.snapshot() == 0).all()  # nothing applied yet
+    outs = [p.result() for p in pendings]
+    for out in outs:
+        assert np.array_equal(np.asarray(out.results), np.asarray(want.results))
+        assert np.array_equal(np.asarray(out.xdp), np.asarray(want.xdp))
+    assert np.array_equal(clf.stats.snapshot(), 3 * want.stats_delta)
+    # repeated result() must not re-apply stats
+    assert pendings[0].result() is outs[0]
+    assert np.array_equal(clf.stats.snapshot(), 3 * want.stats_delta)
+    clf.close()
+
+
+def test_cpu_ref_classify_async_parity():
+    rng = np.random.default_rng(32)
+    tables = testing.random_tables(rng, n_entries=40, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=256)
+    clf = CpuRefClassifier()
+    clf.load_tables(tables)
+    want = clf.classify(batch)
+    got = clf.classify_async(batch).result()
+    assert np.array_equal(got.results, want.results)
+    clf.close()
+
+
+def test_wire_pack_unpack_roundtrip():
+    """pack_wire ∘ unpack_wire is the identity on every classification
+    field (pkt_len clamped to u16 — larger than any ethernet frame)."""
+    import jax.numpy as jnp
+    from infw.kernels.jaxpath import unpack_wire
+
+    rng = np.random.default_rng(41)
+    tables = testing.random_tables(rng, n_entries=10, width=4)
+    batch = testing.random_batch(rng, tables, n_packets=128)
+    db = unpack_wire(jnp.asarray(batch.pack_wire()))
+    np.testing.assert_array_equal(np.asarray(db.kind), batch.kind)
+    np.testing.assert_array_equal(np.asarray(db.l4_ok), batch.l4_ok)
+    np.testing.assert_array_equal(np.asarray(db.ifindex), batch.ifindex)
+    np.testing.assert_array_equal(np.asarray(db.ip_words), batch.ip_words)
+    np.testing.assert_array_equal(np.asarray(db.proto), batch.proto)
+    np.testing.assert_array_equal(np.asarray(db.dst_port), batch.dst_port)
+    np.testing.assert_array_equal(np.asarray(db.icmp_type), batch.icmp_type)
+    np.testing.assert_array_equal(np.asarray(db.icmp_code), batch.icmp_code)
+    np.testing.assert_array_equal(
+        np.asarray(db.pkt_len), np.clip(batch.pkt_len, 0, 0xFFFF)
+    )

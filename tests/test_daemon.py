@@ -317,3 +317,42 @@ def test_deleting_rejected_file_does_not_reset_dataplane(daemon):
     time.sleep(0.2)
     assert daemon.syncer.classifier is not None
     assert daemon.syncer.classifier.tables is not None
+
+
+def test_pipelined_ingest_multi_chunk(tmp_path):
+    """A file larger than ingest_chunk is split into in-flight sub-batches;
+    verdict order and stats must match the single-shot path."""
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    d = Daemon(
+        state_dir=str(tmp_path / "state"),
+        node_name=NODE, namespace=NS, backend="cpu",
+        poll_period_s=0.05, registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=0.02, ingest_chunk=7, pipeline_depth=3,
+    )
+    d.start()
+    try:
+        ns_doc = node_state().to_dict()
+        p = os.path.join(d.nodestates_dir, f"{NODE}.json")
+        with open(p + ".tmp", "w") as f:
+            json.dump(ns_doc, f)
+        os.replace(p + ".tmp", p)
+        assert _wait(lambda: d.syncer.classifier is not None
+                     and d.syncer.classifier.tables is not None)
+        # 20 packets -> 3 chunks at chunk=7; alternate deny/pass
+        frames = [
+            build_frame("10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999,
+                        80 if i % 2 == 0 else 81)
+            for i in range(20)
+        ]
+        write_frames_file(os.path.join(d.ingest_dir, "big.frames"), frames, 10)
+        vp = os.path.join(d.out_dir, "big.frames.verdicts.json")
+        assert _wait(lambda: os.path.exists(vp))
+        with open(vp) as f:
+            summary = json.load(f)
+        assert summary["packets"] == 20
+        assert summary["drop"] == 10 and summary["pass"] == 10
+        # verdict order preserved across chunk boundaries
+        assert summary["results"][:4] == [257, 0, 257, 0]
+    finally:
+        d.stop()
