@@ -43,9 +43,9 @@ from . import interfaces as interfaces_mod
 from .backend.base import Classifier
 from .compiler import (
     CompiledTables,
+    IncrementalTables,
     LpmKey,
     build_table_content,
-    compile_tables_from_content,
     min_rule_width,
 )
 from .constants import MAX_RULES_PER_TARGET
@@ -136,6 +136,10 @@ class DataplaneSyncer:
         self._classifier: Optional[Classifier] = None
         self._attached: Set[str] = set()
         self._content: Dict[LpmKey, np.ndarray] = {}
+        # Incremental compile state: kept across syncs so a small rule edit
+        # patches per-key (addOrUpdateRules/purgeKeys granularity,
+        # loader.go:200-218,633) instead of recompiling the whole table.
+        self._updater: Optional[IncrementalTables] = None
 
     # -- public surface ------------------------------------------------------
 
@@ -208,6 +212,7 @@ class DataplaneSyncer:
             self._classifier = None
             self._attached.clear()
             self._content = {}
+            self._updater = None
 
     # -- lifecycle internals -------------------------------------------------
 
@@ -244,6 +249,7 @@ class DataplaneSyncer:
             self._classifier.close()
         self._classifier = None
         self._content = {}
+        self._updater = None
         self._remove_checkpoint()
 
     def _detach_unmanaged_interfaces(
@@ -314,9 +320,38 @@ class DataplaneSyncer:
         if not changed and self._classifier.tables is not None:
             log.info("rules unchanged; skipping device reload")
             return
-        tables = compile_tables_from_content(
-            desired, rule_width=width
-        )
+        if (
+            self._updater is not None
+            and self._updater.rule_width == width
+            and self._updater.fits(desired)
+        ):
+            # Per-key patch: purge stale identities, upsert changed/new
+            # ones (addOrUpdateRules/purgeKeys granularity) — a one-CIDR
+            # edit touches one dense row + one trie node.  Diff against the
+            # UPDATER's content, not self._content: a failed load/checkpoint
+            # leaves _content stale while the updater already mutated, and
+            # the next sync must reconcile from what the updater holds.
+            base = self._updater.content
+            base_by_ident = {k.masked_identity(): v for k, v in base.items()}
+            desired_idents = {k.masked_identity() for k in desired}
+            deletes = [
+                k for k in base
+                if k.masked_identity() not in desired_idents
+            ]
+            upserts = {
+                k: v for k, v in desired.items()
+                if not _rules_equal(base_by_ident.get(k.masked_identity()), v)
+            }
+            self._updater.apply(upserts, deletes)
+            log.info("incremental table update: %d upserts, %d deletes",
+                     len(upserts), len(deletes))
+            if self._updater.maybe_compact():
+                log.info("compacted table: tombstones reclaimed")
+        else:
+            self._updater = IncrementalTables.from_content(
+                desired, rule_width=width
+            )
+        tables = self._updater.snapshot()
         self._classifier.load_tables(tables)
         self._content = dict(desired)
         self._save_checkpoint(tables)

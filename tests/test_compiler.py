@@ -182,3 +182,169 @@ def test_min_rule_width():
         source_cidrs=["10.0.0.0/24"], rules=[proto_rule(17, "TCP", ports=80)]
     )
     assert compiler.min_rule_width({"eth0": [ing]}) == 18
+
+
+# --- incremental table updates (loader.go:200-218,633 granularity) -----------
+
+def _random_content(rng, n, ifindexes=(2, 3)):
+    from infw.compiler import LpmKey, RULE_COLS
+    content = {}
+    while len(content) < n:
+        mask = int(rng.integers(8, 33))
+        ip = bytes([10, rng.integers(0, 256), rng.integers(0, 256),
+                    rng.integers(0, 256)]) + bytes(12)
+        # mask the address
+        ipi = int.from_bytes(ip[:4], "big") & (0xFFFFFFFF << (32 - mask))
+        ip = ipi.to_bytes(4, "big") + bytes(12)
+        key = LpmKey(32 + mask, int(rng.choice(ifindexes)), ip)
+        rows = np.zeros((3, RULE_COLS), np.int32)
+        rows[1] = [1, 6, int(rng.integers(1, 65000)), 0, 0, 0, int(rng.integers(1, 3))]
+        content[key] = rows
+    return content
+
+
+def _assert_tables_equivalent(a, b, rng, n_packets=400):
+    """Same verdicts from both compiled tables on random traffic, through
+    the COMPILED arrays (not the content dict): the native classifier
+    exercises the dense key/mask/rules tensors, the XLA trie path the
+    leaf-pushed trie levels."""
+    from infw import testing
+    from infw.backend.cpu_ref import CpuRefClassifier
+    from infw.kernels import jaxpath
+
+    batch = testing.random_batch(rng, a if a.num_entries else b, n_packets=n_packets)
+    ca, cb = CpuRefClassifier(), CpuRefClassifier()
+    ca.load_tables(a)
+    cb.load_tables(b)
+    np.testing.assert_array_equal(
+        ca.classify(batch).results, cb.classify(batch).results
+    )
+    dbatch = jaxpath.device_batch(batch)
+    ra = np.asarray(jaxpath.jitted_classify(True)(jaxpath.device_tables(a), dbatch)[0])
+    rb = np.asarray(jaxpath.jitted_classify(True)(jaxpath.device_tables(b), dbatch)[0])
+    np.testing.assert_array_equal(ra, rb)
+
+
+def test_incremental_add_matches_full_rebuild():
+    from infw.compiler import IncrementalTables, compile_tables_from_content
+    rng = np.random.default_rng(61)
+    base = _random_content(rng, 60)
+    extra = _random_content(rng, 10, ifindexes=(2,))
+    it = IncrementalTables.from_content(base, rule_width=4)
+    it.apply(extra)
+    merged = dict(base); merged.update(extra)
+    full = compile_tables_from_content(merged, rule_width=4)
+    _assert_tables_equivalent(it.snapshot(), full, rng)
+
+
+def test_incremental_delete_restores_shorter_prefix():
+    """Deleting a /24 must re-expose the covering /16 in the same trie
+    node (node-local re-push)."""
+    from infw.compiler import IncrementalTables, LpmKey, RULE_COLS
+    from infw import oracle
+    from infw.packets import make_batch
+
+    def rows(action):
+        r = np.zeros((2, RULE_COLS), np.int32)
+        r[1] = [1, 6, 80, 0, 0, 0, action]
+        return r
+
+    k16 = LpmKey(32 + 16, 2, bytes([10, 1, 0, 0]) + bytes(12))
+    k24 = LpmKey(32 + 24, 2, bytes([10, 1, 7, 0]) + bytes(12))
+    it = IncrementalTables.from_content({k16: rows(2), k24: rows(1)}, rule_width=2)
+    b = make_batch(src=["10.1.7.9"], proto=[6], dst_port=[80], ifindex=[2])
+    assert oracle.classify(it.snapshot(), b).results[0] == (1 << 8) | 1  # /24 deny
+    it.apply({}, deletes=[k24])
+    assert oracle.classify(it.snapshot(), b).results[0] == (1 << 8) | 2  # /16 allow
+    # the tombstoned dense row is padding
+    t = it.snapshot()
+    assert (t.mask_len == -1).sum() == 1
+
+
+def test_incremental_update_in_place():
+    from infw.compiler import IncrementalTables, LpmKey, RULE_COLS
+    from infw import oracle
+    from infw.packets import make_batch
+
+    k = LpmKey(32 + 24, 2, bytes([10, 2, 3, 0]) + bytes(12))
+    r1 = np.zeros((2, RULE_COLS), np.int32); r1[1] = [1, 6, 80, 0, 0, 0, 1]
+    r2 = np.zeros((2, RULE_COLS), np.int32); r2[1] = [1, 6, 80, 0, 0, 0, 2]
+    it = IncrementalTables.from_content({k: r1}, rule_width=2)
+    b = make_batch(src=["10.2.3.4"], proto=[6], dst_port=[80], ifindex=[2])
+    assert oracle.classify(it.snapshot(), b).results[0] & 0xFF == 1
+    it.apply({k: r2})
+    assert oracle.classify(it.snapshot(), b).results[0] & 0xFF == 2
+    assert it.snapshot().num_entries == 1  # no growth
+
+
+def test_incremental_slot_reuse_after_delete():
+    from infw.compiler import IncrementalTables, compile_tables_from_content
+    rng = np.random.default_rng(62)
+    content = _random_content(rng, 30)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    keys = list(content)
+    it.apply({}, deletes=keys[:5])
+    extra = _random_content(rng, 5, ifindexes=(3,))
+    it.apply(extra)
+    assert it.snapshot().num_entries == 30  # tombstones reused, no growth
+    merged = {k: v for k, v in content.items() if k not in keys[:5]}
+    merged.update(extra)
+    full = compile_tables_from_content(merged, rule_width=4)
+    _assert_tables_equivalent(it.snapshot(), full, rng)
+
+
+def test_incremental_random_churn_matches_full():
+    """Many rounds of random add/update/delete stay equivalent to a fresh
+    full compile of the same logical content."""
+    from infw.compiler import IncrementalTables, compile_tables_from_content
+    rng = np.random.default_rng(63)
+    content = _random_content(rng, 50)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    for round_ in range(8):
+        keys = list(content)
+        dels = [keys[int(i)] for i in rng.choice(len(keys), size=5, replace=False)]
+        for k in dels:
+            del content[k]
+        adds = _random_content(rng, 6)
+        content.update(adds)
+        it.apply(adds, deletes=dels)
+    full = compile_tables_from_content(content, rule_width=4)
+    _assert_tables_equivalent(it.snapshot(), full, rng, n_packets=800)
+
+
+def test_compaction_reclaims_tombstones():
+    from infw.compiler import IncrementalTables
+    rng = np.random.default_rng(64)
+    content = _random_content(rng, 200)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    keys = list(content)
+    it.apply({}, deletes=keys[:150])
+    survivors = {k: v for k, v in content.items() if k not in keys[:150]}
+    assert it.snapshot().num_entries == 200  # tombstones still present
+    assert it.maybe_compact()
+    assert it.snapshot().num_entries == len(survivors)
+    from infw.compiler import compile_tables_from_content
+    full = compile_tables_from_content(survivors, rule_width=4)
+    _assert_tables_equivalent(it.snapshot(), full, rng)
+    # further incremental updates still work after compaction
+    extra = _random_content(rng, 5)
+    it.apply(extra)
+    survivors.update(extra)
+    full = compile_tables_from_content(survivors, rule_width=4)
+    _assert_tables_equivalent(it.snapshot(), full, rng)
+
+
+def test_apply_atomic_on_invalid_key():
+    """A bad key in an upsert batch must leave the updater unchanged."""
+    from infw.compiler import CompileError, IncrementalTables, LpmKey, RULE_COLS
+    rng = np.random.default_rng(65)
+    content = _random_content(rng, 20)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    before = it.snapshot()
+    bad = LpmKey(200, 2, bytes(16))  # prefix_len out of range
+    good = _random_content(rng, 1)
+    with pytest.raises(CompileError):
+        it.apply({**good, bad: np.zeros((2, RULE_COLS), np.int32)})
+    after = it.snapshot()
+    assert set(after.content) == set(before.content)
+    np.testing.assert_array_equal(after.mask_len, before.mask_len)
