@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from ..compiler import CompiledTables
+from ..constants import KIND_IPV6
 from ..kernels import jaxpath, pallas_dense
 from ..packets import PacketBatch
 from .base import ClassifyOutput, PendingClassify, StatsAccumulator
@@ -91,14 +92,18 @@ class TpuClassifier:
             path, dev, block_b = self._active
         # Packed wire format: 28B/packet H2D, 2B/packet D2H — the
         # host<->device link is the streaming bottleneck, not the kernel.
-        wire = jax.device_put(batch.pack_wire(), self._device)
         kind = np.asarray(batch.kind)
+        wire = jax.device_put(batch.pack_wire(), self._device)
         if path == "dense":
             res16, stats = pallas_dense.jitted_classify_pallas_wire(
                 self._interpret, block_b
             )(dev, wire)
         else:
-            res16, stats = jaxpath.jitted_classify_wire(True)(dev, wire)
+            # Depth specialization: a batch with no IPv6 packets walks only
+            # the ≤/32 trie levels (3 gathers instead of up to 15) — the
+            # daemon steers family-homogeneous chunks here.
+            v4_only = not bool((kind == KIND_IPV6).any())
+            res16, stats = jaxpath.jitted_classify_wire(True, v4_only)(dev, wire)
 
         def materialize() -> ClassifyOutput:
             stats_delta = jaxpath.merge_stats_host(np.asarray(stats))

@@ -42,6 +42,7 @@ import numpy as np
 
 from .backend.base import Classifier
 from .compiler import CompileError
+from .constants import KIND_IPV6
 from .interfaces import InterfaceError, InterfaceRegistry, default_registry
 from .nodestate_controller import NodeStateReconciler
 from .obs.events import EventRing, EventsLogger, emit_deny_events
@@ -333,17 +334,13 @@ class Daemon:
             most the in-flight window (not the whole backlog) exposed to
             re-classification, and memory stays bounded per file."""
             nonlocal processed
-            fctx["parts"].sort(key=lambda p: p[0])
-            parts = fctx["parts"]
-            results = (
-                np.concatenate([np.asarray(out.results) for _, out in parts])
-                if parts else np.zeros(0, np.uint32)
-            )
-            xdp = (
-                np.concatenate([np.asarray(out.xdp) for _, out in parts])
-                if parts else np.zeros(0, np.int32)
-            )
             batch, frames, fn = fctx["batch"], fctx["frames"], fctx["fn"]
+            n = len(batch)
+            results = np.zeros(n, np.uint32)
+            xdp = np.full(n, 2, np.int32)
+            for idx, out in fctx["parts"]:
+                results[idx] = np.asarray(out.results)
+                xdp[idx] = np.asarray(out.xdp)
             if self.debug_lookup:
                 self.debug_buffer.record_batch(batch)
             emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, frames)
@@ -360,8 +357,8 @@ class Daemon:
             processed += 1
 
         def drain_one() -> None:
-            fctx, start, pending = inflight.popleft()
-            fctx["parts"].append((start, pending.result()))
+            fctx, idx, pending = inflight.popleft()
+            fctx["parts"].append((idx, pending.result()))
             fctx["remaining"] -= 1
             if fctx["remaining"] == 0:
                 finalize(fctx)
@@ -378,19 +375,32 @@ class Daemon:
                 continue
             batch = parse_frames(frames, ifindexes)
             n = len(batch)
-            starts = list(range(0, n, self.ingest_chunk))
+            # Regroup by family so each chunk is depth-homogeneous: v4-only
+            # chunks take the truncated trie walk (3 gathers, not 15).
+            order = np.arange(n)
+            kinds = np.asarray(batch.kind)
+            groups = [
+                g
+                for g in (order[kinds != KIND_IPV6], order[kinds == KIND_IPV6])
+                if len(g)
+            ]
+            chunks = [
+                g[s : s + self.ingest_chunk]
+                for g in groups
+                for s in range(0, len(g), self.ingest_chunk)
+            ]
             fctx = {
                 "fn": fn, "path": path, "frames": frames, "batch": batch,
-                "parts": [], "remaining": len(starts),
+                "parts": [], "remaining": len(chunks),
             }
             if n == 0:
                 finalize(fctx)  # no device dispatch for an empty file
                 continue
-            for s in starts:
-                sub = batch.slice(s, min(s + self.ingest_chunk, n))
+            for idx in chunks:
+                sub = batch.take(idx)
                 while len(inflight) >= self.pipeline_depth:
                     drain_one()
-                inflight.append((fctx, s, clf.classify_async(sub)))
+                inflight.append((fctx, idx, clf.classify_async(sub)))
         while inflight:
             drain_one()
         return processed

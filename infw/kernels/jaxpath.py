@@ -133,21 +133,47 @@ def unpack_wire(wire: jax.Array) -> DeviceBatch:
     )
 
 
+def v4_trie_depth(n_levels: int) -> int:
+    """Number of leading trie levels whose bit boundary is within the IPv4
+    packet-side cap (32 bits): entries longer than /32 can never match a
+    v4 packet (kernel.c:207), so a v4-only batch walks only these levels.
+    With 16-8-8-... strides that is min(3, n_levels)."""
+    strides = trie_level_strides(n_levels)
+    depth, bit_end = 0, 0
+    for s in strides:
+        bit_end += s
+        if bit_end > 32:
+            break
+        depth += 1
+    return max(1, depth)
+
+
 def classify_wire(
-    tables: DeviceTables, wire: jax.Array, *, use_trie: bool
+    tables: DeviceTables, wire: jax.Array, *, use_trie: bool, v4_only: bool = False
 ) -> Tuple[jax.Array, jax.Array]:
     """Wire-format forward pass: packed descriptors in, (results_u16,
     stats) out.  The D2H payload is 2B/packet — ruleId ≤ 255 always holds
     (MAX_RULES_PER_TARGET=100), and the XDP verdict is host-derivable from
     (results, kind), so neither the u32 results nor the xdp array crosses
-    the link."""
+    the link.
+
+    ``v4_only`` is the depth-specialization fast path: when the caller
+    guarantees the batch holds no IPv6 packets, the trie walk is truncated
+    to the levels reachable under the 32-bit cap — a /128-deep table walks
+    3 gathers instead of 15.  The truncated level tuple changes the pytree
+    structure, so jit compiles a separate (cheaper) executable."""
+    if v4_only and use_trie:
+        depth = v4_trie_depth(len(tables.trie_levels))
+        tables = tables._replace(trie_levels=tables.trie_levels[:depth])
     res, _xdp, stats = classify(tables, unpack_wire(wire), use_trie=use_trie)
     return res.astype(jnp.uint16), stats
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify_wire(use_trie: bool):
-    return jax.jit(functools.partial(classify_wire, use_trie=use_trie))
+def jitted_classify_wire(use_trie: bool, v4_only: bool = False):
+    return jax.jit(
+        functools.partial(classify_wire, use_trie=use_trie, v4_only=v4_only)
+    )
 
 
 def host_finalize_wire(res16: np.ndarray, kind: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

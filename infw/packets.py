@@ -58,6 +58,19 @@ class PacketBatch:
             }
         )
 
+    def take(self, idx: np.ndarray) -> "PacketBatch":
+        """Arbitrary-index subset (used to regroup packets by family so
+        each device chunk is depth-homogeneous)."""
+        return PacketBatch(
+            **{
+                f: getattr(self, f)[idx]
+                for f in (
+                    "kind l4_ok ifindex ip_words proto dst_port "
+                    "icmp_type icmp_code pkt_len".split()
+                )
+            }
+        )
+
     def pack_wire(self) -> np.ndarray:
         """Pack into the (B, 7) uint32 device wire format — 28B/packet
         instead of 9 separate int32 arrays (48B/packet).  The host→device

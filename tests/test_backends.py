@@ -158,3 +158,52 @@ def test_wire_pack_unpack_roundtrip():
     np.testing.assert_array_equal(
         np.asarray(db.pkt_len), np.clip(batch.pkt_len, 0, 0xFFFF)
     )
+
+
+def test_v4_depth_specialization_bit_exact():
+    """A v4-only batch classified through the truncated trie walk must
+    match the full-depth walk even when the table holds /128 entries."""
+    import jax.numpy as jnp
+    from infw.kernels import jaxpath
+    from infw.compiler import LpmKey, RULE_COLS, compile_tables_from_content
+
+    rng = np.random.default_rng(51)
+    content = {}
+    # v4 prefixes at /8../32 plus v6 entries to /128 (forcing 15 levels)
+    while len(content) < 300:
+        if rng.random() < 0.5:
+            mask = int(rng.integers(8, 33))
+            ip = bytes([10, rng.integers(0, 256), rng.integers(0, 256),
+                        rng.integers(0, 256)]) + bytes(12)
+        else:
+            mask = int(rng.integers(33, 129))
+            ip = bytes([0x20, 0x01]) + bytes(rng.integers(0, 256, 14).tolist())
+        ipi = int.from_bytes(ip, "big") & ((1 << 128) - (1 << (128 - mask)))
+        key = LpmKey(32 + mask, 2, ipi.to_bytes(16, "big"))
+        rows = np.zeros((2, RULE_COLS), np.int32)
+        rows[1] = [1, 6, int(rng.integers(1, 65000)), 0, 0, 0, int(rng.integers(1, 3))]
+        content[key] = rows
+    tables = compile_tables_from_content(content, rule_width=2)
+    assert len(tables.trie_levels) == 15  # /128 table depth
+
+    from infw import testing
+    batch = testing.random_batch(rng, tables, n_packets=500)
+    # make it v4-only: rewrite v6 packets as v4
+    kinds = np.asarray(batch.kind).copy()
+    kinds[kinds == 2] = 1
+    batch.kind = kinds
+    wire = jnp.asarray(batch.pack_wire())
+    dev = jaxpath.device_tables(tables)
+    full, _ = jaxpath.jitted_classify_wire(True, False)(dev, wire)
+    fast, _ = jaxpath.jitted_classify_wire(True, True)(dev, wire)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(fast))
+
+    # TpuClassifier auto-selects the fast path for v4-only batches and
+    # stays bit-exact vs the oracle
+    from infw import oracle
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    out = clf.classify(batch)
+    ref = oracle.classify(tables, batch)
+    np.testing.assert_array_equal(out.results, ref.results)
+    clf.close()
