@@ -80,12 +80,17 @@ class Classifier(Protocol):
         """Swap in a newly compiled ruleset (idempotent, atomic)."""
         ...
 
-    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+    def classify(self, batch: PacketBatch, apply_stats: bool = True) -> ClassifyOutput:
         ...
 
-    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+    def classify_async(
+        self, batch: PacketBatch, apply_stats: bool = True
+    ) -> PendingClassify:
         """Dispatch without blocking; materialize via .result().  Sync
-        backends may run eagerly and return an already-resolved handle."""
+        backends may run eagerly and return an already-resolved handle.
+        With ``apply_stats=False`` the accumulator is left untouched and
+        the caller applies ``stats_delta`` itself (exactly-once semantics
+        across retries)."""
         ...
 
     @property

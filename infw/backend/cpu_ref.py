@@ -90,7 +90,7 @@ class CpuRefClassifier:
             self._tables = tables
             self._packed = (T, tables.rule_width, ent_ifindex, ent_masklen, ent_ip, rules)
 
-    def classify(self, batch: PacketBatch) -> ClassifyOutput:
+    def classify(self, batch: PacketBatch, apply_stats: bool = True) -> ClassifyOutput:
         with self._lock:
             if self._packed is None:
                 raise RuntimeError("no rule tables loaded")
@@ -123,13 +123,16 @@ class CpuRefClassifier:
             p(icode, c.c_int32), p(pktlen, c.c_int32),
             p(results, c.c_uint32), p(xdp, c.c_int32), p(stats, c.c_int64),
         )
-        self._stats.add(stats)
+        if apply_stats:
+            self._stats.add(stats)
         return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats)
 
-    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+    def classify_async(
+        self, batch: PacketBatch, apply_stats: bool = True
+    ) -> PendingClassify:
         """Eager: the native call is synchronous, so the handle resolves
         immediately (protocol parity with TpuClassifier)."""
-        out = self.classify(batch)
+        out = self.classify(batch, apply_stats=apply_stats)
         return PendingClassify(lambda: out)
 
     @property

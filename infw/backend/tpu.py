@@ -72,6 +72,7 @@ class TpuClassifier:
             dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
             block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
         else:
+            jaxpath.check_wire_ruleids(tables)
             dev = jaxpath.device_tables(tables, self._device)
             block_b = None
         with self._lock:
@@ -80,12 +81,20 @@ class TpuClassifier:
 
     # -- classify -----------------------------------------------------------
 
-    def classify_async(self, batch: PacketBatch) -> PendingClassify:
+    def classify_async(
+        self, batch: PacketBatch, apply_stats: bool = True
+    ) -> PendingClassify:
         """Dispatch H2D + kernel now; return a handle whose .result()
         materializes D2H and applies the stats increment exactly once.
         JAX's async dispatch means this returns as soon as the work is
         enqueued — in-flight batches finish on whatever table buffer they
-        were dispatched against (the double-buffer swap contract)."""
+        were dispatched against (the double-buffer swap contract).
+
+        ``apply_stats=False`` defers the accumulator increment to the
+        caller (who applies ``stats_delta`` itself) — used by the daemon's
+        ingest so statistics land exactly once only after the source file
+        is consumed, never on a batch that will be re-classified after a
+        mid-pipeline failure."""
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
@@ -107,14 +116,15 @@ class TpuClassifier:
 
         def materialize() -> ClassifyOutput:
             stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
-            self._stats.add(stats_delta)
+            if apply_stats:
+                self._stats.add(stats_delta)
             results, xdp = jaxpath.host_finalize_wire(np.asarray(res16), kind)
             return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats_delta)
 
         return PendingClassify(materialize)
 
-    def classify(self, batch: PacketBatch) -> ClassifyOutput:
-        return self.classify_async(batch).result()
+    def classify(self, batch: PacketBatch, apply_stats: bool = True) -> ClassifyOutput:
+        return self.classify_async(batch, apply_stats=apply_stats).result()
 
     # -- accessors / lifecycle ---------------------------------------------
 

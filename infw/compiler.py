@@ -781,8 +781,11 @@ class IncrementalTables:
                 idx, self._seq_arr[idx],
             )
 
-        new_keys: List[LpmKey] = []
-        new_rows: List[np.ndarray] = []
+        # New-key upserts deduplicated by masked identity (last writer wins,
+        # mirroring from_content and successive Map.Update on the kernel
+        # trie) so two aliasing LpmKeys in one call cannot create two live
+        # dense rows for one LPM entry.
+        new_by_ident: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray, np.ndarray]] = {}
         for key, rows in upserts.items():
             ident = key.masked_identity()
             t = self._ident_to_t.get(ident)
@@ -798,10 +801,11 @@ class IncrementalTables:
                     self._ident_to_key[ident] = key
                 self.content[key] = rows
             else:
-                new_keys.append(key)
-                new_rows.append(padded)
-        if not new_keys:
+                new_by_ident[ident] = (key, rows, padded)
+        if not new_by_ident:
             return
+        new_keys = [k for k, _, _ in new_by_ident.values()]
+        new_rows = [p for _, _, p in new_by_ident.values()]
         K = len(new_keys)
         slots = [self._free.pop() if self._free else None for _ in range(K)]
         n_append = sum(1 for s in slots if s is None)
@@ -826,11 +830,10 @@ class IncrementalTables:
         self._term_level[t_ids] = lv
         self._term_node[t_ids] = nd
         self._max_ifindex = max(self._max_ifindex, int(ifindex.max()))
-        for i, key in enumerate(new_keys):
-            ident = key.masked_identity()
+        for i, (ident, (key, rows, _)) in enumerate(new_by_ident.items()):
             self._ident_to_t[ident] = int(t_ids[i])
             self._ident_to_key[ident] = key
-            self.content[key] = upserts[key]
+            self.content[key] = rows
 
     def maybe_compact(self) -> bool:
         """Rebuild from live content when tombstones dominate, so a table

@@ -329,10 +329,13 @@ class Daemon:
         processed = 0
 
         def finalize(fctx) -> None:
-            """Write verdicts, emit events, consume the file — runs as soon
-            as the file's last chunk drains, so a failure here leaves at
-            most the in-flight window (not the whole backlog) exposed to
-            re-classification, and memory stays bounded per file."""
+            """Write verdicts, consume the file, then apply stats and emit
+            events — runs as soon as the file's last chunk drains, so
+            memory stays bounded per file.  Chunks are dispatched with
+            apply_stats=False and the deltas land here, strictly AFTER the
+            source file is removed: a failure anywhere earlier leaves the
+            file for a clean retry with zero double-counted statistics and
+            no duplicate deny events."""
             nonlocal processed
             batch, frames, fn = fctx["batch"], fctx["frames"], fctx["fn"]
             n = len(batch)
@@ -343,7 +346,6 @@ class Daemon:
                 xdp[idx] = np.asarray(out.xdp)
             if self.debug_lookup:
                 self.debug_buffer.record_batch(batch)
-            emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, frames)
             summary = {
                 "file": fn,
                 "packets": len(frames),
@@ -354,14 +356,32 @@ class Daemon:
             with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
                 json.dump(summary, f)
             os.remove(fctx["path"])
+            for _idx, out in fctx["parts"]:
+                clf.stats.add(out.stats_delta)
+            emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, frames)
             processed += 1
 
         def drain_one() -> None:
+            """Materialize the oldest in-flight chunk.  A failure (device
+            error, finalize I/O) poisons only its own file: remaining
+            handles for that file are drained and discarded, the source
+            file stays on disk for the next tick, and other files'
+            pipelines continue untouched."""
             fctx, idx, pending = inflight.popleft()
-            fctx["parts"].append((idx, pending.result()))
+            try:
+                out = pending.result()
+                if not fctx["failed"]:
+                    fctx["parts"].append((idx, out))
+            except Exception as e:
+                if not fctx["failed"]:
+                    fctx["failed"] = True
+                    log.error("ingest classify failed for %s: %s", fctx["fn"], e)
             fctx["remaining"] -= 1
-            if fctx["remaining"] == 0:
-                finalize(fctx)
+            if fctx["remaining"] == 0 and not fctx["failed"]:
+                try:
+                    finalize(fctx)
+                except Exception as e:
+                    log.error("ingest finalize failed for %s: %s", fctx["fn"], e)
 
         for fn in sorted(os.listdir(self.ingest_dir)):
             path = os.path.join(self.ingest_dir, fn)
@@ -391,16 +411,34 @@ class Daemon:
             ]
             fctx = {
                 "fn": fn, "path": path, "frames": frames, "batch": batch,
-                "parts": [], "remaining": len(chunks),
+                "parts": [], "remaining": len(chunks), "failed": False,
             }
             if n == 0:
-                finalize(fctx)  # no device dispatch for an empty file
+                try:
+                    finalize(fctx)  # no device dispatch for an empty file
+                except Exception as e:
+                    log.error("ingest finalize failed for %s: %s", fn, e)
                 continue
             for idx in chunks:
+                if fctx["failed"]:
+                    # dispatching more chunks of a poisoned file is wasted
+                    # device work — their results would be discarded
+                    fctx["remaining"] -= 1
+                    continue
                 sub = batch.take(idx)
                 while len(inflight) >= self.pipeline_depth:
                     drain_one()
-                inflight.append((fctx, idx, clf.classify_async(sub)))
+                try:
+                    # Eager backends (CPU ref) raise HERE, not in .result();
+                    # the failure must still poison only this file, never
+                    # abort the tick and starve later-sorted files.
+                    pending = clf.classify_async(sub, apply_stats=False)
+                except Exception as e:
+                    fctx["failed"] = True
+                    fctx["remaining"] -= 1
+                    log.error("ingest classify failed for %s: %s", fn, e)
+                    continue
+                inflight.append((fctx, idx, pending))
         while inflight:
             drain_one()
         return processed

@@ -129,7 +129,7 @@ def unpack_wire(wire: jax.Array) -> DeviceBatch:
         dst_port=(w1 & 0xFFFF).astype(jnp.int32),
         icmp_type=((w0 >> 11) & 0xFF).astype(jnp.int32),
         icmp_code=((w0 >> 19) & 0xFF).astype(jnp.int32),
-        pkt_len=((w1 >> 16) & 0xFFFF).astype(jnp.int32),
+        pkt_len=(((w1 >> 16) & 0xFFFF) | ((w0 >> 27) << 16)).astype(jnp.int32),
     )
 
 
@@ -169,6 +169,22 @@ def classify_wire(
     return res.astype(jnp.uint16), stats
 
 
+def check_wire_ruleids(tables: CompiledTables) -> None:
+    """The wire result is (ruleId<<8 | action) cast to uint16, so ruleIds
+    must fit in 8 bits.  Syncer-compiled tables always satisfy this
+    (ruleId == order < MAX_RULES_PER_TARGET), but
+    compile_tables_from_content accepts adversarial direct content where
+    rid goes up to 2^24 — fail loudly at load time instead of silently
+    corrupting reported ruleIds (the analogue of the pallas rule_width
+    guard in build_pallas_tables)."""
+    max_rid = int(tables.rules[..., 0].max()) if tables.rules.size else 0
+    if max_rid > 0xFF:
+        raise ValueError(
+            f"max ruleId {max_rid} > 255 does not fit the uint16 wire "
+            "result; use the u32 (non-wire) classify path"
+        )
+
+
 @functools.lru_cache(maxsize=None)
 def jitted_classify_wire(use_trie: bool, v4_only: bool = False):
     return jax.jit(
@@ -198,14 +214,24 @@ def packet_key_words(batch: DeviceBatch) -> jax.Array:
     )
 
 
-def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
-    """Compare-all LPM: returns per-packet target index or -1."""
+def lpm_dense_scores(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
+    """(B, T) compare-all LPM match scores: mask_len + 1 for matching
+    non-padding entries within the packet-side cap (32 for v4, 128 for
+    v6 — kernel.c:206-219), else 0.  The ONE dense-match implementation:
+    both the single-chip path (lpm_dense) and the mesh rules-sharded
+    partial (parallel.mesh._local_dense_partial) consume these scores, so
+    a semantics change lands everywhere at once."""
     pkt = packet_key_words(batch)  # (B,5)
     diff = (pkt[:, None, :] ^ tables.key_words[None]) & tables.mask_words[None]
     match = jnp.all(diff == 0, axis=-1)  # (B,T)
     cap = jnp.where(batch.kind == KIND_IPV4, 32, 128)  # packet-side mask cap
     ok = match & (tables.mask_len[None] >= 0) & (tables.mask_len[None] <= cap[:, None])
-    score = jnp.where(ok, tables.mask_len[None] + 1, 0)  # (B,T)
+    return jnp.where(ok, tables.mask_len[None] + 1, 0)  # (B,T)
+
+
+def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
+    """Compare-all LPM: returns per-packet target index or -1."""
+    score = lpm_dense_scores(tables, batch)
     tidx = jnp.argmax(score, axis=1).astype(jnp.int32)
     return jnp.where(jnp.max(score, axis=1) > 0, tidx, -1)
 

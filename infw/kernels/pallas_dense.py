@@ -144,6 +144,12 @@ def build_pallas_tables(tables: CompiledTables, dtype: str = DEFAULT_DTYPE) -> P
         )
     rb = np.zeros((Tp, NUM_FIELDS * RULE_PAD), np.float32)
     rules = tables.rules[:T].astype(np.int64)
+    max_rid = int(rules[..., 0].max()) if T else 0
+    if max_rid > 0x7F:
+        raise ValueError(
+            f"max ruleId {max_rid} > 127 does not fit the packed "
+            "(ruleId<<1)|action byte; use the jax u32 classify path"
+        )
     rid = rules[..., 0] & 0x7F
     act = np.clip(rules[..., 6], 1, 2) - 1  # {DENY=1,ALLOW=2} -> {0,1}
     fields = [

@@ -52,14 +52,19 @@ class EventHdr:
     pkt_length: int
 
     def pack(self) -> bytes:
-        """Little-endian wire layout mirrored from the Go-side decode
-        (events.go:90-93): u16 ifId, u16 ruleId, u8 action, pad, u16 len."""
-        return struct.pack("<HHBxH", self.if_id, self.rule_id, self.action,
+        """Little-endian wire layout derived from the Go-side decode
+        (events.go:90-93) with one deliberate widening: ifId is u32, not
+        u16 — Linux ifindexes routinely exceed 65535 on hosts with many
+        netns veths and the compiler admits up to MAX_IFINDEX = 1<<20, so
+        the reference's u16 would truncate (or, packed strictly, crash on)
+        real deny events.  Layout: u32 ifId, u16 ruleId, u8 action, pad,
+        u16 len."""
+        return struct.pack("<IHBxH", self.if_id, self.rule_id, self.action,
                           self.pkt_length)
 
     @classmethod
     def unpack(cls, raw: bytes) -> "EventHdr":
-        if_id, rule_id, action, pkt_length = struct.unpack_from("<HHBxH", raw)
+        if_id, rule_id, action, pkt_length = struct.unpack_from("<IHBxH", raw)
         return cls(if_id=if_id, rule_id=rule_id, action=action, pkt_length=pkt_length)
 
 

@@ -78,15 +78,19 @@ class PacketBatch:
         bottleneck, so the descriptor is packed like a NIC ring entry:
 
           w0: kind(2) | l4_ok(1)<<2 | proto(8)<<3 | icmpType(8)<<11
-              | icmpCode(8)<<19
-          w1: dstPort(16) | pktLen(16)<<16   (pktLen clamped to 65535;
-              ethernet jumbo frames are < 10K, so no real traffic clips)
+              | icmpCode(8)<<19 | pktLenHi(5)<<27
+          w1: dstPort(16) | pktLenLo(16)<<16
           w2: ifindex (full u32)
           w3..w6: ip_words
+
+        pktLen carries 21 bits (clamp at 2 MiB - 1): jumbo frames are
+        < 10K and even BIG-TCP GRO/TSO aggregates cap at 512 KiB, so no
+        real capture frame clips and byte statistics stay exact.
 
         Device-side inverse: kernels.jaxpath.unpack_wire (fused into the
         classify jit, so unpacking costs no extra HBM round trip)."""
         b = len(self)
+        plen = np.clip(self.pkt_len, 0, 0x1FFFFF).astype(np.uint32)
         out = np.empty((b, 7), np.uint32)
         out[:, 0] = (
             (self.kind.astype(np.uint32) & 3)
@@ -94,9 +98,10 @@ class PacketBatch:
             | ((self.proto.astype(np.uint32) & 0xFF) << 3)
             | ((self.icmp_type.astype(np.uint32) & 0xFF) << 11)
             | ((self.icmp_code.astype(np.uint32) & 0xFF) << 19)
+            | ((plen >> 16) << 27)
         )
         out[:, 1] = (self.dst_port.astype(np.uint32) & 0xFFFF) | (
-            np.clip(self.pkt_len, 0, 0xFFFF).astype(np.uint32) << 16
+            (plen & 0xFFFF) << 16
         )
         out[:, 2] = self.ifindex.astype(np.uint32)
         out[:, 3:7] = self.ip_words.astype(np.uint32)

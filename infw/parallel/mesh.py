@@ -115,13 +115,10 @@ def shard_batch(batch, mesh: Mesh) -> DeviceBatch:
 
 def _local_dense_partial(tables: DeviceTables, batch: DeviceBatch):
     """Per-shard LPM over local entries: returns (local best score, raw
-    scan result restricted to the local winner)."""
-    pkt = jaxpath.packet_key_words(batch)
-    diff = (pkt[:, None, :] ^ tables.key_words[None]) & tables.mask_words[None]
-    match = jnp.all(diff == 0, axis=-1)
-    cap = jnp.where(batch.kind == 1, 32, 128)
-    ok = match & (tables.mask_len[None] >= 0) & (tables.mask_len[None] <= cap[:, None])
-    score = jnp.where(ok, tables.mask_len[None] + 1, 0)
+    scan result restricted to the local winner).  Match semantics come
+    from the shared jaxpath.lpm_dense_scores — one implementation for
+    single-chip and mesh."""
+    score = jaxpath.lpm_dense_scores(tables, batch)
     best = jnp.max(score, axis=1)
     tidx = jnp.argmax(score, axis=1)
     rows = jnp.take(tables.rules, tidx, axis=0)

@@ -139,13 +139,18 @@ def test_cpu_ref_classify_async_parity():
 
 def test_wire_pack_unpack_roundtrip():
     """pack_wire ∘ unpack_wire is the identity on every classification
-    field (pkt_len clamped to u16 — larger than any ethernet frame)."""
+    field (pkt_len carries 21 bits — clamped at 2MiB-1, beyond any
+    GRO/TSO aggregate)."""
     import jax.numpy as jnp
     from infw.kernels.jaxpath import unpack_wire
 
     rng = np.random.default_rng(41)
     tables = testing.random_tables(rng, n_entries=10, width=4)
     batch = testing.random_batch(rng, tables, n_packets=128)
+    # include >u16 lengths (BIG-TCP scale) and one that clips at 21 bits
+    pl = batch.pkt_len.copy()
+    pl[:4] = [70000, 0x1FFFFF, 3_000_000, 524288]
+    batch.pkt_len = pl
     db = unpack_wire(jnp.asarray(batch.pack_wire()))
     np.testing.assert_array_equal(np.asarray(db.kind), batch.kind)
     np.testing.assert_array_equal(np.asarray(db.l4_ok), batch.l4_ok)
@@ -156,8 +161,58 @@ def test_wire_pack_unpack_roundtrip():
     np.testing.assert_array_equal(np.asarray(db.icmp_type), batch.icmp_type)
     np.testing.assert_array_equal(np.asarray(db.icmp_code), batch.icmp_code)
     np.testing.assert_array_equal(
-        np.asarray(db.pkt_len), np.clip(batch.pkt_len, 0, 0xFFFF)
+        np.asarray(db.pkt_len), np.clip(batch.pkt_len, 0, 0x1FFFFF)
     )
+
+
+def test_wire_path_byte_stats_above_u16():
+    """Byte statistics through the TPU wire path stay exact for frames
+    larger than 64 KiB (the old u16 pkt_len silently undercounted them)."""
+    from infw.packets import make_batch
+
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, 6, 80, 0, 0, 0, 1]  # TCP 80 deny
+    content = {LpmKey(32, 2, bytes(16)): rows}
+    tables = compile_tables_from_content(content, rule_width=4)
+    b = make_batch(src=["1.1.1.1"] * 2, proto=[6] * 2, dst_port=[80] * 2,
+                   ifindex=[2] * 2, pkt_len=[70000, 524288])
+    ref = oracle.classify(tables, b)
+    clf = TpuClassifier()
+    clf.load_tables(tables)
+    out = clf.classify(b)
+    assert testing.stats_dict_from_array(out.stats_delta) == ref.stats
+    assert int(out.stats_delta[1, 3]) == 70000 + 524288  # deny bytes exact
+    clf.close()
+
+
+def test_wire_ruleid_guard_trips_loudly():
+    """Adversarial direct content with ruleId > 255 must be rejected at
+    load time on the wire paths, never silently truncated in the uint16
+    result (jaxpath guard; pallas analogue at ruleId > 127)."""
+    from infw.kernels import jaxpath, pallas_dense
+
+    rows = np.zeros((2, 7), np.int32)
+    rows[1] = [300, 6, 80, 0, 0, 0, 1]
+    tables = compile_tables_from_content(
+        {LpmKey(32, 2, bytes(16)): rows}, rule_width=2
+    )
+    with pytest.raises(ValueError, match="ruleId"):
+        jaxpath.check_wire_ruleids(tables)
+    with pytest.raises(ValueError, match="ruleId"):
+        pallas_dense.build_pallas_tables(tables)
+    clf = TpuClassifier(force_path="trie")
+    with pytest.raises(ValueError, match="ruleId"):
+        clf.load_tables(tables)
+    # the u32 (non-wire) jax path still classifies such tables correctly
+    from infw import testing as _t
+    batch = _t.random_batch(np.random.default_rng(7), tables, n_packets=64)
+    ref = oracle.classify(tables, batch)
+    got = np.asarray(
+        jaxpath.jitted_classify(False)(
+            jaxpath.device_tables(tables), jaxpath.device_batch(batch)
+        )[0]
+    )
+    np.testing.assert_array_equal(got, ref.results)
 
 
 def test_v4_depth_specialization_bit_exact():

@@ -334,6 +334,43 @@ def test_compaction_reclaims_tombstones():
     _assert_tables_equivalent(it.snapshot(), full, rng)
 
 
+def test_apply_aliasing_new_key_upserts_dedupe_last_wins():
+    """Two distinct LpmKeys sharing a masked identity in ONE apply() call
+    must collapse into a single live dense row with the last writer's
+    rules (kernel LPM map update semantics, matching from_content), with
+    dense and trie paths agreeing and no undeletable orphan row."""
+    from infw.compiler import (
+        IncrementalTables, LpmKey, RULE_COLS, trie_levels_for_mask,
+    )
+    from infw import oracle
+    from infw.kernels import jaxpath
+    from infw.packets import make_batch
+
+    def rows(action):
+        r = np.zeros((2, RULE_COLS), np.int32)
+        r[1] = [1, 6, 80, 0, 0, 0, action]
+        return r
+
+    it = IncrementalTables.from_content(
+        {}, rule_width=2, min_trie_levels=trie_levels_for_mask(32 + 8)
+    )
+    ka = LpmKey(32 + 8, 2, bytes([10, 0, 0, 1]) + bytes(12))  # 10.0.0.1/8
+    kb = LpmKey(32 + 8, 2, bytes([10, 0, 0, 2]) + bytes(12))  # 10.0.0.2/8
+    it.apply({ka: rows(1), kb: rows(2)})
+    t = it.snapshot()
+    assert t.num_entries == 1  # one live row, not two aliases
+    b = make_batch(src=["10.9.9.9"], proto=[6], dst_port=[80], ifindex=[2])
+    assert oracle.classify(t, b).results[0] & 0xFF == 2  # last writer (Allow)
+    db = jaxpath.device_batch(b)
+    dt = jaxpath.device_tables(t)
+    for use_trie in (False, True):
+        got = int(np.asarray(jaxpath.jitted_classify(use_trie)(dt, db)[0])[0])
+        assert got & 0xFF == 2
+    # deleting via the LOSING alias still removes the entry (no orphan)
+    it.apply({}, deletes=[ka])
+    assert oracle.classify(it.snapshot(), b).results[0] == 0
+
+
 def test_apply_atomic_on_invalid_key():
     """A bad key in an upsert batch must leave the updater unchanged."""
     from infw.compiler import CompileError, IncrementalTables, LpmKey, RULE_COLS

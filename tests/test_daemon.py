@@ -319,6 +319,103 @@ def test_deleting_rejected_file_does_not_reset_dataplane(daemon):
     assert daemon.syncer.classifier.tables is not None
 
 
+def test_deny_event_with_large_ifindex(tmp_path):
+    """A deny on an interface with ifindex > 65535 must flow through
+    process_ingest_once and the event pipeline without the old u16
+    EventHdr pack crash (struct.error)."""
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="big0", index=70000))
+    d = Daemon(
+        state_dir=str(tmp_path / "state"),
+        node_name=NODE, namespace=NS, backend="cpu",
+        poll_period_s=0.05, registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=0.02,
+    )
+    d.start()
+    try:
+        ns = node_state(
+            rules={"big0": [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]}
+        )
+        p = os.path.join(d.nodestates_dir, f"{NODE}.json")
+        with open(p + ".tmp", "w") as f:
+            json.dump(ns.to_dict(), f)
+        os.replace(p + ".tmp", p)
+        assert _wait(lambda: d.syncer.classifier is not None
+                     and d.syncer.classifier.tables is not None)
+        frames = [build_frame("10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999, 80)]
+        write_frames_file(os.path.join(d.ingest_dir, "t.frames"), frames, 70000)
+        vp = os.path.join(d.out_dir, "t.frames.verdicts.json")
+        assert _wait(lambda: os.path.exists(vp))
+        with open(vp) as f:
+            assert json.load(f)["drop"] == 1
+        assert _wait(lambda: os.path.exists(d.events_path)
+                     and "ruleId 1 action Drop" in open(d.events_path).read())
+        assert "if big0" in open(d.events_path).read()
+    finally:
+        d.stop()
+
+
+@pytest.mark.parametrize("mode", ["deferred", "sync"])
+def test_ingest_failure_isolated_and_stats_exactly_once(tmp_path, mode):
+    """A mid-pipeline classify failure poisons only its own file — the
+    file stays on disk for retry, other files complete — and statistics
+    land exactly once across the retry (no double counting).  Covered for
+    both failure surfaces: a deferred .result() raise (async TPU backend)
+    and a synchronous classify_async raise (eager CPU backend)."""
+    from infw.backend.base import PendingClassify
+
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    d = Daemon(
+        state_dir=str(tmp_path / "state"),
+        node_name=NODE, namespace=NS, backend="cpu",
+        poll_period_s=0.05, registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=60.0,  # drive ticks manually
+    )
+    try:
+        with open(os.path.join(d.nodestates_dir, f"{NODE}.json"), "w") as f:
+            json.dump(node_state().to_dict(), f)
+        d.scan_nodestates_once()
+        clf = d.syncer.classifier
+        assert clf is not None and clf.tables is not None
+
+        deny = lambda: build_frame("10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999, 80)
+        write_frames_file(os.path.join(d.ingest_dir, "aaa.frames"), [deny()] * 3, 10)
+        write_frames_file(os.path.join(d.ingest_dir, "bbb.frames"), [deny()] * 2, 10)
+
+        orig = clf.classify_async
+        boom = {"left": 1}
+
+        def flaky(batch, apply_stats=True):
+            if boom["left"]:
+                boom["left"] -= 1
+                if mode == "sync":
+                    raise RuntimeError("device fell over at dispatch")
+
+                def explode():
+                    raise RuntimeError("device fell over")
+
+                return PendingClassify(explode)
+            return orig(batch, apply_stats=apply_stats)
+
+        clf.classify_async = flaky
+        assert d.process_ingest_once() == 1  # only bbb completed
+        assert os.path.exists(os.path.join(d.ingest_dir, "aaa.frames"))
+        assert not os.path.exists(
+            os.path.join(d.out_dir, "aaa.frames.verdicts.json")
+        )
+        assert os.path.exists(os.path.join(d.out_dir, "bbb.frames.verdicts.json"))
+        snap = clf.stats.snapshot()
+        assert snap[1, 2] == 2  # bbb's 2 denies, nothing from failed aaa
+
+        assert d.process_ingest_once() == 1  # retry tick consumes aaa
+        assert not os.path.exists(os.path.join(d.ingest_dir, "aaa.frames"))
+        snap = clf.stats.snapshot()
+        assert snap[1, 2] == 5  # 3 + 2, each deny counted exactly once
+    finally:
+        d.stop()
+
+
 def test_pipelined_ingest_multi_chunk(tmp_path):
     """A file larger than ingest_chunk is split into in-flight sub-batches;
     verdict order and stats must match the single-shot path."""

@@ -101,6 +101,10 @@ def test_parse_frames_batch_verdicts_match_oracle():
 def test_event_hdr_wire_roundtrip():
     hdr = EventHdr(if_id=3, rule_id=7, action=XDP_DROP, pkt_length=99)
     assert EventHdr.unpack(hdr.pack()) == hdr
+    # ifId is u32: Linux ifindexes beyond 65535 (many-netns hosts; the
+    # compiler admits up to MAX_IFINDEX = 1<<20) must survive the header
+    big = EventHdr(if_id=1 << 20, rule_id=7, action=XDP_DROP, pkt_length=99)
+    assert EventHdr.unpack(big.pack()) == big
 
 
 def test_emit_and_decode_deny_events():
