@@ -113,6 +113,14 @@ class TpuClassifier:
             # daemon steers family-homogeneous chunks here.
             v4_only = not bool((kind == KIND_IPV6).any())
             res16, stats = jaxpath.jitted_classify_wire(True, v4_only)(dev, wire)
+        # Start the D2H copy now so it overlaps the dispatch of subsequent
+        # batches; .result() then finds the bytes already (or sooner) on
+        # host.  Not all platforms expose it — best effort.
+        for arr in (res16, stats):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
 
         def materialize() -> ClassifyOutput:
             stats_delta = jaxpath.merge_stats_host(np.asarray(stats))

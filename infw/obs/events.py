@@ -100,6 +100,14 @@ class EventRing:
                 return
             self._ring.append(rec)
 
+    def is_full(self) -> bool:
+        with self._lock:
+            return len(self._ring) >= self._capacity
+
+    def add_lost(self, n: int) -> None:
+        with self._lock:
+            self.lost_samples += n
+
     def pop_all(self) -> List[EventRecord]:
         with self._lock:
             out = list(self._ring)
@@ -123,7 +131,14 @@ def emit_deny_events(
     ≤MAX_EVENT_DATA raw bytes when frames are available.  Returns the
     number of events emitted."""
     deny_idx = np.nonzero((np.asarray(results) & 0xFF) == DENY)[0]
-    for i in deny_idx:
+    for pos, i in enumerate(deny_idx):
+        if ring.is_full():
+            # replay-scale fast path: a full ring loses the whole rest of
+            # the batch in O(1) instead of constructing millions of
+            # records just to drop them (the perf ring does the same —
+            # overwritten slots surface only as LostSamples)
+            ring.add_lost(len(deny_idx) - pos)
+            break
         raw = bytes(frames[i][:MAX_EVENT_DATA]) if frames is not None else b""
         hdr = EventHdr(
             if_id=int(ifindex[i]),

@@ -180,6 +180,203 @@ def random_batch(
     )
 
 
+def random_tables_fast(
+    rng: np.random.Generator,
+    n_entries: int,
+    ifindexes: Tuple[int, ...] = (2, 3),
+    width: int = 16,
+    v6_fraction: float = 0.3,
+    group_size: int = 8,
+) -> CompiledTables:
+    """Vectorized large-table generator: like random_tables but NumPy-
+    vectorized end to end so 100K-1M-entry tables build in seconds (the
+    scale tier of BASELINE config 3/5).  Entries cluster into groups
+    sharing a base address with realistic prefix-length mixes (v4 peaked
+    at /24, v6 at /48), so nested/sibling prefixes stress longest-match
+    tie-breaks exactly like the per-entry generator."""
+    content: Dict[LpmKey, np.ndarray] = {}
+    seen = set()
+    while len(content) < n_entries:
+        n = int((n_entries - len(content)) * 1.4) + 64
+        is_v6 = rng.random(n) < v6_fraction
+        n_groups = max(1, n // group_size)
+        bases = rng.integers(0, 256, (n_groups, 16), dtype=np.uint8)
+        gid = rng.integers(0, n_groups, n)
+        ip = bases[gid].copy()
+        # sibling prefixes: perturb one tail byte on half the entries
+        perturb = rng.random(n) < 0.5
+        pos = rng.integers(1, 16, n)
+        val = rng.integers(0, 256, n, dtype=np.uint8)
+        rows_i = np.arange(n)[perturb]
+        ip[rows_i, pos[perturb]] = val[perturb]
+
+        v4_lens = np.array([0, 8, 12, 16, 20, 24, 24, 24, 28, 32])
+        v6_lens = np.array([0, 32, 40, 48, 48, 48, 56, 64, 96, 128])
+        mask_len = np.where(
+            is_v6,
+            v6_lens[rng.integers(0, len(v6_lens), n)],
+            v4_lens[rng.integers(0, len(v4_lens), n)],
+        ).astype(np.int64)
+        ip[~is_v6, 4:] = 0
+        ifindex = np.asarray(ifindexes)[rng.integers(0, len(ifindexes), n)]
+
+        rules = random_rules_bulk(rng, n, width)
+
+        ip_bytes = [bytes(row) for row in ip]
+        for i in range(n):
+            # exact masked-identity dedupe so the final entry count is
+            # exactly n_entries (from_content would collapse aliases)
+            m = int(mask_len[i])
+            nb, rem = m // 8, m % 8
+            data = ip_bytes[i][:nb]
+            if rem:
+                data += bytes([ip_bytes[i][nb] & ((0xFF << (8 - rem)) & 0xFF)])
+            ident = (int(ifindex[i]), m, data)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            key = LpmKey(
+                prefix_len=int(mask_len[i]) + 32,
+                ingress_ifindex=int(ifindex[i]),
+                ip_data=ip_bytes[i],
+            )
+            content[key] = rules[i]
+            if len(content) >= n_entries:
+                break
+    return compile_tables_from_content(content, rule_width=width)
+
+
+def random_rules_bulk(
+    rng: np.random.Generator, n: int, width: int
+) -> np.ndarray:
+    """(n, width, 7) packed rule rows, vectorized version of random_rules:
+    index == order == ruleId, index 0 empty, mixed protocols, half port
+    ranges / half single ports, DENY or ALLOW actions."""
+    rows = np.zeros((n, width, 7), np.int32)
+    if width < 2:
+        return rows
+    # per-entry fill probability in [0.3, 1.0] so table density varies
+    fill_p = rng.uniform(0.3, 1.0, (n, 1))
+    populated = rng.random((n, width)) < fill_p
+    populated[:, 0] = False  # order 0 reserved (catch-all slot semantics)
+    order = np.broadcast_to(np.arange(width, dtype=np.int32), (n, width))
+    proto = np.asarray(_PROTOS)[rng.integers(0, len(_PROTOS), (n, width))]
+    is_transport = (
+        (proto == IPPROTO_TCP) | (proto == IPPROTO_UDP) | (proto == IPPROTO_SCTP)
+    )
+    is_icmp = (proto == IPPROTO_ICMP) | (proto == IPPROTO_ICMPV6)
+    start = rng.integers(1, 65000, (n, width))
+    use_range = rng.random((n, width)) < 0.5
+    span = rng.integers(1, 500, (n, width))
+    end = np.where(use_range, np.minimum(start + span, 65535), 0)
+    rows[..., 0] = np.where(populated, order, 0)
+    rows[..., 1] = np.where(populated, proto, 0)
+    rows[..., 2] = np.where(populated & is_transport, start, 0)
+    rows[..., 3] = np.where(populated & is_transport, end, 0)
+    rows[..., 4] = np.where(populated & is_icmp, rng.integers(0, 256, (n, width)), 0)
+    rows[..., 5] = np.where(populated & is_icmp, rng.integers(0, 3, (n, width)), 0)
+    rows[..., 6] = np.where(populated, rng.integers(1, 3, (n, width)), 0)
+    return rows
+
+
+def random_batch_fast(
+    rng: np.random.Generator,
+    tables: CompiledTables,
+    n_packets: int,
+    extra_ifindexes: Tuple[int, ...] = (9,),
+    hit_fraction: float = 0.7,
+) -> PacketBatch:
+    """Vectorized version of random_batch: packets biased toward table
+    hits (address sampled from a random entry, bits flipped beyond — or
+    occasionally inside — the mask) and toward rule-match boundaries
+    (protocol/port copied from a random populated rule of that entry).
+    Generates 10M-packet batches in seconds for the replay tier."""
+    b = n_packets
+    T = int(tables.num_entries)
+    kind = rng.choice([0, 1, 2, 3], size=b, p=[0.02, 0.55, 0.4, 0.03]).astype(np.int32)
+    l4_ok = (rng.random(b) > 0.05).astype(np.int32)
+    all_if = np.unique(
+        np.concatenate([tables.key_words[:T, 0].astype(np.int64),
+                        np.asarray(extra_ifindexes, np.int64)])
+    )
+    ifindex = all_if[rng.integers(0, len(all_if), b)].astype(np.int32)
+    # random baseline
+    ip = rng.integers(0, 256, (b, 16), dtype=np.uint8)
+    proto = np.asarray([6, 17, 132, 1, 58, 47, 0])[rng.integers(0, 7, b)].astype(np.int32)
+    dst_port = rng.integers(0, 65536, b).astype(np.int32)
+    icmp_type = rng.integers(0, 256, b).astype(np.int32)
+    icmp_code = rng.integers(0, 3, b).astype(np.int32)
+
+    hit = rng.random(b) < (hit_fraction if T else 0.0)
+    if T:
+        e = rng.integers(0, T, b)
+        # entry address bytes from the dense key words (big-endian words)
+        ent_ip = (
+            tables.key_words[:T, 1:5].astype(">u4").copy().view(np.uint8).reshape(T, 16)
+        )
+        ent_mask = tables.mask_len[:T].astype(np.int64)
+        ent_if = tables.key_words[:T, 0].astype(np.int32)
+        m = ent_mask[e]
+        hip = ent_ip[e].copy()
+        # flip a bit beyond the mask (still matches) or, 30% of the time
+        # when flippable, inside the mask (usually breaks the match)
+        beyond_ok = m < 128
+        bit_beyond = (m + (rng.integers(0, 1 << 16, b) % np.maximum(128 - m, 1)))
+        inside = (rng.random(b) < 0.3) & (m > 0)
+        bit_inside = rng.integers(0, 1 << 16, b) % np.maximum(m, 1)
+        bit = np.where(inside, bit_inside, np.where(beyond_ok, bit_beyond, 0))
+        do_flip = beyond_ok | inside
+        byte_i, mask_v = (bit // 8).astype(np.int64), (0x80 >> (bit % 8)).astype(np.uint8)
+        sel = np.where(hit & do_flip)[0]
+        hip[sel, byte_i[sel]] ^= mask_v[sel]
+        ip[hit] = hip[hit]
+        ifindex = np.where(hit & (rng.random(b) < 0.9), ent_if[e], ifindex)
+        is_v4_key = (ent_mask[e] <= 32) & ~np.any(hip[:, 4:] != 0, axis=1)
+        kind = np.where(
+            hit & is_v4_key & (rng.random(b) < 0.8), 1,
+            np.where(hit & ~is_v4_key & (rng.random(b) < 0.8), 2, kind),
+        ).astype(np.int32)
+        # bias protocol/port toward a random populated rule of the entry
+        R = tables.rules.shape[1]
+        ridx = rng.integers(0, R, b)
+        rule = tables.rules[np.clip(e, 0, T - 1), ridx]  # (b, 7)
+        has_rule = rule[:, 0] != 0
+        use_rule = hit & has_rule & (rng.random(b) < 0.8)
+        rproto = rule[:, 1]
+        proto = np.where(use_rule & (rproto != 0), rproto, proto)
+        is_tr = (rproto == IPPROTO_TCP) | (rproto == IPPROTO_UDP) | (rproto == IPPROTO_SCTP)
+        jitter = rng.integers(-1, 2, b)
+        port_single = np.clip(rule[:, 2] + jitter, 0, 65535)
+        edge = np.stack([
+            rule[:, 2] - 1, rule[:, 2], rule[:, 3] - 1, rule[:, 3], rule[:, 3] + 1
+        ], 1)[np.arange(b), rng.integers(0, 5, b)]
+        port_range = np.clip(edge, 0, 65535)
+        dst_port = np.where(
+            use_rule & is_tr,
+            np.where(rule[:, 3] == 0, port_single, port_range),
+            dst_port,
+        ).astype(np.int32)
+        is_ic = (rproto == IPPROTO_ICMP) | (rproto == IPPROTO_ICMPV6)
+        icmp_type = np.where(
+            use_rule & is_ic, rule[:, 4] + rng.integers(0, 2, b), icmp_type
+        ).astype(np.int32)
+        icmp_code = np.where(use_rule & is_ic, rule[:, 5], icmp_code).astype(np.int32)
+
+    ip[kind == 1, 4:] = 0
+    words = np.ascontiguousarray(ip).view(">u4").astype(np.uint32).reshape(b, 4)
+    return PacketBatch(
+        kind=kind,
+        l4_ok=l4_ok,
+        ifindex=ifindex,
+        ip_words=words,
+        proto=proto,
+        dst_port=dst_port.astype(np.int32),
+        icmp_type=icmp_type,
+        icmp_code=icmp_code,
+        pkt_len=rng.integers(60, 1500, b).astype(np.int32),
+    )
+
+
 def stats_dict_from_array(stats4: np.ndarray) -> Dict[int, List[int]]:
     """(MAX_TARGETS, 4) int64 -> {ruleId: [ap, ab, dp, db]} with zero rows
     dropped, for comparison against the oracle's dict."""
