@@ -197,6 +197,7 @@ class Daemon:
         health_port: int = DEFAULT_HEALTH_PORT,
         file_poll_interval_s: float = 0.2,
         event_sink=None,
+        events_socket: Optional[str] = None,
         ingest_chunk: int = DEFAULT_INGEST_CHUNK,
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     ) -> None:
@@ -233,7 +234,23 @@ class Daemon:
 
         self.ring = EventRing()
         self._event_file = open(self.events_path, "a", buffering=1)
-        sink = event_sink if event_sink is not None else self._write_event_line
+        # Sidecar composition (daemonset.yaml:54-67): events always land in
+        # events.log (the in-process record) and, when --events-socket is
+        # given, are ALSO shipped as unixgram datagrams to the follower
+        # process (cmd/syslog/syslog.go:16) — fire-and-forget, a dead
+        # sidecar never blocks the dataplane.
+        self._events_socket_sink = None
+        if events_socket:
+            from .obs.sidecar import UnixDatagramSink
+
+            self._events_socket_sink = UnixDatagramSink(events_socket)
+        base_sink = event_sink if event_sink is not None else self._write_event_line
+        if self._events_socket_sink is not None:
+            def sink(line, _base=base_sink, _sock=self._events_socket_sink):
+                _base(line)
+                _sock(line)
+        else:
+            sink = base_sink
         self.events_logger = EventsLogger(
             self.ring,
             sink,
@@ -566,6 +583,8 @@ class Daemon:
         self.stats.stop_poll()
         self.syncer.shutdown()
         self._event_file.close()
+        if self._events_socket_sink is not None:
+            self._events_socket_sink.close()
 
     @property
     def actual_metrics_port(self) -> int:
@@ -594,6 +613,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
     p.add_argument("--ingest-chunk", type=int, default=DEFAULT_INGEST_CHUNK)
     p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
+    p.add_argument(
+        "--events-socket",
+        default=os.environ.get("INFW_EVENTS_SOCKET", ""),
+        help="unixgram socket to ship deny-event lines to (the events "
+        "sidecar composition, daemonset.yaml:54-67); run "
+        "`python -m infw.obs.sidecar --socket PATH` as the follower",
+    )
     args = p.parse_args(argv)
 
     if not args.node_name:
@@ -615,6 +641,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         health_port=args.health_port,
         ingest_chunk=args.ingest_chunk,
         pipeline_depth=args.pipeline_depth,
+        events_socket=args.events_socket or None,
     )
     stop = threading.Event()
 

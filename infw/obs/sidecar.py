@@ -1,0 +1,155 @@
+"""Events sidecar: a separate follower process that makes deny events
+log-collectable.
+
+The reference composes the daemon with a syslog-server sidecar container:
+the daemon's event goroutine writes structured lines to a unixgram socket
+(/var/run/syslog) and the sidecar prints every message to container
+stdout, so `kubectl logs ds/ingress-node-firewall-daemon -c events` shows
+per-drop records (/root/reference/cmd/syslog/syslog.go:16-69, wired at
+bindata/manifests/daemon/daemonset.yaml:54-67).
+
+Same composition here, two transports:
+
+- **socket mode** (the faithful analogue): the daemon is started with a
+  ``UnixDatagramSink`` as its event sink; this process binds the unixgram
+  socket and prints each received event line to stdout.
+- **tail mode**: follow the daemon's ``events.log`` file (rotation-aware,
+  tail -F style) for deployments where a shared socket is inconvenient.
+
+Run:  python -m infw.obs.sidecar --socket /var/run/infw-events.sock
+      python -m infw.obs.sidecar --tail  <state-dir>/events.log
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+from typing import Optional, TextIO
+
+
+class UnixDatagramSink:
+    """Daemon-side event sink: one datagram per event line, fire and
+    forget — a dead/absent sidecar must never block or crash the
+    dataplane (the kernel's bpf_perf_event_output likewise drops when the
+    ring is full).  Dropped lines are counted."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self.dropped = 0
+
+    def __call__(self, line: str) -> None:
+        try:
+            self._sock.sendto(line.encode(errors="replace"), self._path)
+        except OSError:
+            self.dropped += 1
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def serve_socket(path: str, out: TextIO = sys.stdout,
+                 should_stop=None) -> None:
+    """Bind the unixgram socket and print each event line to stdout —
+    cmd/syslog/syslog.go:33,61-65 without the RFC3164 framing (the line
+    content IS the payload here)."""
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    sock.bind(path)
+    sock.settimeout(0.2)
+    try:
+        while should_stop is None or not should_stop():
+            try:
+                data = sock.recv(65536)
+            except socket.timeout:
+                continue
+            out.write(data.decode(errors="replace") + "\n")
+            out.flush()
+    finally:
+        sock.close()
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+
+def tail_file(path: str, out: TextIO = sys.stdout, poll_s: float = 0.1,
+              should_stop=None, from_start: bool = True) -> None:
+    """tail -F the daemon's events.log: survives the file not existing
+    yet and truncation/rotation (reopens when the inode shrinks or
+    changes)."""
+    f: Optional[TextIO] = None
+    ino = None
+    pos = 0
+    fragment = ""
+    while should_stop is None or not should_stop():
+        if f is None:
+            try:
+                f = open(path, "r")
+                ino = os.fstat(f.fileno()).st_ino
+                if not from_start:
+                    f.seek(0, os.SEEK_END)
+                pos = f.tell()
+                fragment = ""
+            except FileNotFoundError:
+                time.sleep(poll_s)
+                continue
+        line = f.readline()
+        if line:
+            pos = f.tell()
+            # A partial line (writer mid-append, no newline yet) must not
+            # be emitted as a broken record — hold the fragment and glue
+            # the continuation on when it lands.
+            fragment += line
+            if fragment.endswith("\n"):
+                out.write(fragment)
+                out.flush()
+                fragment = ""
+            continue
+        try:
+            st = os.stat(path)
+            if st.st_ino != ino or st.st_size < pos:
+                f.close()
+                f = None  # rotated/truncated: reopen from the top
+                from_start = True
+                continue
+        except FileNotFoundError:
+            f.close()
+            f = None
+            # a recreated file is a fresh log: emit it all, even in
+            # --from-end mode (mirrors the rename-rotation branch)
+            from_start = True
+            continue
+        time.sleep(poll_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="infw-events",
+        description="ingress-node-firewall events sidecar "
+        "(cmd/syslog/syslog.go equivalent)",
+    )
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--socket", help="unixgram socket path to serve")
+    g.add_argument("--tail", help="events.log file to follow")
+    ap.add_argument("--from-end", action="store_true",
+                    help="tail mode: start at EOF instead of the top")
+    args = ap.parse_args(argv)
+    try:
+        if args.socket:
+            serve_socket(args.socket)
+        else:
+            tail_file(args.tail, from_start=not args.from_end)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,8 @@
 """T3: backend classifiers (TPU/JAX paths + native C++ reference) vs the
 NumPy oracle, plus lifecycle semantics (table swap, stats accumulation,
 close)."""
+import time
+
 import numpy as np
 import pytest
 
@@ -262,3 +264,103 @@ def test_v4_depth_specialization_bit_exact():
     ref = oracle.classify(tables, batch)
     np.testing.assert_array_equal(out.results, ref.results)
     clf.close()
+
+
+@pytest.mark.parametrize("path", ["trie", "dense"])
+def test_double_buffer_swap_under_concurrency(tmp_path, path):
+    """The double-buffer contract (infw/backend/tpu.py docstring; the TPU
+    analogue of the mutex-serialized map rewrite,
+    /root/reference/pkg/ebpfsyncer/ebpfsyncer.go:56-63,72-73): reader
+    threads stream classify_async while a writer thread continuously swaps
+    table generations and checkpoints them.  Every returned batch must
+    match exactly one generation's oracle verdicts (never a torn mix), and
+    the stats accumulator must equal the sum of the per-batch deltas
+    (each batch applied exactly once)."""
+    import threading
+
+    from infw.packets import make_batch
+
+    # G generations over the same key: order g rule, TCP port 80, action
+    # alternating Deny/Allow -> verdict (g<<8)|action identifies the
+    # generation a batch ran against.
+    G = 4
+    gens = []
+    for g in range(1, G + 1):
+        rows = np.zeros((8, 7), np.int32)
+        rows[g] = [g, 6, 80, 0, 0, 0, 1 + (g % 2)]
+        content = {LpmKey(40, 2, bytes([10]) + bytes(15)): rows}
+        gens.append(compile_tables_from_content(content, rule_width=8))
+
+    n = 64
+    batch = make_batch(
+        src=["10.0.0.9"] * n, proto=[6] * n, dst_port=[80] * n,
+        ifindex=[2] * n, pkt_len=[100] * n,
+    )
+    expected = {}
+    for g, t in enumerate(gens):
+        ref = oracle.classify(t, batch)
+        expected[tuple(ref.results.tolist())] = g
+
+    clf = TpuClassifier(force_path=path)
+    clf.load_tables(gens[0])
+
+    stop = threading.Event()
+    errors = []
+    seen_gens = set()
+    deltas_lock = threading.Lock()
+    delta_total = [None]
+
+    swaps = [0]
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            t = gens[i % G]
+            try:
+                clf.load_tables(t)
+                t.save(str(tmp_path / f"ckpt-{i % G}.npz"))
+            except Exception as e:  # pragma: no cover
+                errors.append(f"writer: {e!r}")
+                return
+            i += 1
+            swaps[0] = i
+
+    def reader():
+        while not stop.is_set():
+            try:
+                out = clf.classify_async(batch).result()
+            except Exception as e:  # pragma: no cover
+                errors.append(f"reader: {e!r}")
+                return
+            key = tuple(out.results.tolist())
+            if key not in expected:
+                errors.append(f"torn verdicts: {sorted(set(key))}")
+                return
+            seen_gens.add(expected[key])
+            with deltas_lock:
+                if delta_total[0] is None:
+                    delta_total[0] = out.stats_delta.astype(np.int64)
+                else:
+                    delta_total[0] = delta_total[0] + out.stats_delta
+
+    w = threading.Thread(target=writer)
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    w.start()
+    [r.start() for r in readers]
+    # run until the race is real: several completed swaps AND several
+    # classified batches (interpret-mode readers are GIL-heavy, so a fixed
+    # sleep can starve one side)
+    deadline = time.time() + 60
+    while time.time() < deadline and not errors and (
+        swaps[0] < 8 or len(seen_gens) < 2
+    ):
+        time.sleep(0.05)
+    stop.set()
+    w.join(timeout=30)
+    [r.join(timeout=30) for r in readers]
+    clf.close()
+
+    assert not errors, errors[:5]
+    assert len(seen_gens) >= 2, f"swap never observed: {seen_gens}"
+    # exactly-once stats: accumulator == sum of returned deltas
+    np.testing.assert_array_equal(clf.stats.snapshot(), delta_total[0])

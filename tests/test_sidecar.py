@@ -1,0 +1,247 @@
+"""Events sidecar composition: daemon + separate follower process.
+
+The reference suite asserts per-drop records by regexing the events
+sidecar's container logs (/root/reference/test/e2e/events/events.go:
+140-205); here the sidecar is a real child process
+(`python -m infw.obs.sidecar`) whose stdout is captured and regexed the
+same way, over both transports (unixgram socket — the faithful analogue
+of cmd/syslog/syslog.go — and events.log tail)."""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from infw.daemon import Daemon, write_frames_file_v2
+from infw.interfaces import Interface, InterfaceRegistry
+from infw.obs.pcap import FramesBuf, build_frame
+from infw.obs.sidecar import UnixDatagramSink, serve_socket, tail_file
+
+NODE = "node-a"
+
+DROP_LINE = re.compile(
+    r"ruleId (\d+) action Drop len (\d+) if (\S+)"
+)
+V4_LINE = re.compile(r"ipv4 src addr ([\d.]+) dst addr ([\d.]+)")
+TCP_LINE = re.compile(r"tcp srcPort (\d+) dstPort (\d+)")
+
+
+def _nodestate_doc():
+    return {
+        "apiVersion": "ingressnodefirewall.openshift.io/v1alpha1",
+        "kind": "IngressNodeFirewallNodeState",
+        "metadata": {"name": NODE, "namespace": "ingress-node-firewall-system"},
+        "spec": {"interfaceIngressRules": {"eth0": [
+            {"sourceCIDRs": ["10.0.0.0/8"],
+             "rules": [{"order": 1, "protocolConfig": {"protocol": "TCP",
+                        "tcp": {"ports": "80"}}, "action": "Deny"}]}
+        ]}},
+    }
+
+
+def _start_daemon(tmp_path, **kw):
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="eth0", index=2, up=True))
+    d = Daemon(
+        state_dir=str(tmp_path), node_name=NODE, backend="cpu", registry=reg,
+        metrics_port=0, health_port=0, poll_period_s=5,
+        file_poll_interval_s=0.05, **kw,
+    )
+    d.start()
+    return d
+
+
+def _apply_and_replay(d, tmp_path, n_drops=3):
+    p = os.path.join(d.nodestates_dir, f"{NODE}.json")
+    with open(p + ".tmp", "w") as f:
+        json.dump(_nodestate_doc(), f)
+    os.replace(p + ".tmp", p)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if d.syncer.classifier is not None and d.syncer.classifier.tables is not None:
+            break
+        time.sleep(0.02)
+    assert d.syncer.classifier.tables is not None
+    frames = [
+        build_frame(f"10.0.0.{i+1}", "9.9.9.9", 6, 4000 + i, 80)
+        for i in range(n_drops)
+    ] + [build_frame("10.0.0.9", "9.9.9.9", 6, 4999, 81)]  # pass
+    fb = FramesBuf.from_frames(frames, 2)
+    write_frames_file_v2(os.path.join(d.ingest_dir, "t.frames"), fb)
+    vp = os.path.join(d.out_dir, "t.frames.verdicts.json")
+    while time.time() < deadline and not os.path.exists(vp):
+        time.sleep(0.02)
+    assert os.path.exists(vp)
+
+
+def _wait_for(path, pattern, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            text = open(path).read()
+            if pattern.search(text):
+                return text
+        time.sleep(0.05)
+    raise AssertionError(
+        f"pattern {pattern.pattern!r} never appeared in {path}: "
+        f"{open(path).read() if os.path.exists(path) else '<missing>'!r}"
+    )
+
+
+def _assert_drop_records(text, n_drops):
+    """The reference's per-drop assertions (events.go:140-205): one record
+    per denied packet with rule/iface/addresses/ports decoded."""
+    drops = DROP_LINE.findall(text)
+    assert len(drops) == n_drops, text
+    assert all(r == ("1", "54", "eth0") for r in drops)
+    assert len(V4_LINE.findall(text)) == n_drops
+    tcp = TCP_LINE.findall(text)
+    assert [p[1] for p in tcp] == ["80"] * n_drops
+    assert sorted(p[0] for p in tcp) == [str(4000 + i) for i in range(n_drops)]
+    # allow verdicts generate no event (kernel.c:450)
+    assert "dstPort 81" not in text
+
+
+@pytest.mark.parametrize("transport", ["socket", "tail"])
+def test_sidecar_process_composition(tmp_path, transport):
+    sock_path = os.path.join(str(tmp_path), "events.sock")
+    out_path = os.path.join(str(tmp_path), "sidecar.out")
+    events_log = os.path.join(str(tmp_path), "events.log")
+
+    argv = ["--socket", sock_path] if transport == "socket" else \
+        ["--tail", events_log]
+    with open(out_path, "wb") as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "infw.obs.sidecar", *argv],
+            stdout=out, stderr=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    d = None
+    try:
+        if transport == "socket":
+            # wait for the follower to bind before the daemon sends
+            deadline = time.time() + 10
+            while time.time() < deadline and not os.path.exists(sock_path):
+                time.sleep(0.02)
+            assert os.path.exists(sock_path)
+            d = _start_daemon(tmp_path, events_socket=sock_path)
+        else:
+            d = _start_daemon(tmp_path)
+        _apply_and_replay(d, tmp_path)
+        text = _wait_for(out_path, TCP_LINE)
+        time.sleep(0.3)  # let the remaining lines flush
+        _assert_drop_records(open(out_path).read(), n_drops=3)
+        if transport == "socket":
+            # events.log still has the full record (in-process sink kept)
+            assert DROP_LINE.search(open(events_log).read())
+    finally:
+        if d is not None:
+            d.stop()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_unix_sink_tolerates_dead_sidecar(tmp_path):
+    """A missing/dead follower never blocks or crashes the daemon —
+    datagrams are dropped and counted (the perf-ring overflow posture)."""
+    sink = UnixDatagramSink(os.path.join(str(tmp_path), "nobody.sock"))
+    for _ in range(5):
+        sink("ruleId 1 action Drop len 54 if eth0")
+    assert sink.dropped == 5
+    sink.close()
+
+
+def test_tail_file_survives_rotation(tmp_path):
+    path = os.path.join(str(tmp_path), "ev.log")
+    out_path = os.path.join(str(tmp_path), "out.txt")
+    import threading
+
+    stop = threading.Event()
+    out = open(out_path, "w")
+    t = threading.Thread(
+        target=tail_file,
+        args=(path, out, 0.02, stop.is_set),
+    )
+    t.start()
+    try:
+        with open(path, "w", buffering=1) as f:
+            f.write("line-1\n")
+        time.sleep(0.3)
+        os.replace(path + "", path + ".old")  # rotate
+        with open(path, "w", buffering=1) as f:
+            f.write("line-2\n")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if "line-2" in open(out_path).read():
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        out.close()
+    text = open(out_path).read()
+    assert "line-1" in text and "line-2" in text
+
+
+def _tail_in_thread(path, out_path, from_start=True):
+    import threading
+
+    stop = threading.Event()
+    out = open(out_path, "w")
+    t = threading.Thread(
+        target=tail_file, args=(path, out, 0.02, stop.is_set, from_start)
+    )
+    t.start()
+    return stop, t, out
+
+
+def test_tail_file_holds_partial_lines(tmp_path):
+    """A record written in two OS-level appends must come out as ONE
+    line, never a split record a regexing consumer would miss."""
+    path = os.path.join(str(tmp_path), "ev.log")
+    out_path = os.path.join(str(tmp_path), "out.txt")
+    stop, t, out = _tail_in_thread(path, out_path)
+    try:
+        with open(path, "a") as f:
+            f.write("ruleId 1 action Drop ")
+            f.flush()
+            time.sleep(0.3)  # tailer sees the fragment now
+            f.write("len 54 if eth0\n")
+        deadline = time.time() + 10
+        while time.time() < deadline and "eth0" not in open(out_path).read():
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        out.close()
+    assert open(out_path).read() == "ruleId 1 action Drop len 54 if eth0\n"
+
+
+def test_tail_file_from_end_remove_recreate(tmp_path):
+    """--from-end must still emit everything in a file recreated after
+    remove-style rotation (a fresh log is new content, not history)."""
+    path = os.path.join(str(tmp_path), "ev.log")
+    out_path = os.path.join(str(tmp_path), "out.txt")
+    with open(path, "w") as f:
+        f.write("old-history\n")
+    stop, t, out = _tail_in_thread(path, out_path, from_start=False)
+    try:
+        time.sleep(0.3)  # tailer is at EOF of the old file
+        os.remove(path)
+        time.sleep(0.3)
+        with open(path, "w") as f:
+            f.write("after-rotate-1\n")
+        deadline = time.time() + 10
+        while time.time() < deadline and "after-rotate-1" not in open(out_path).read():
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        out.close()
+    text = open(out_path).read()
+    assert "after-rotate-1" in text
+    assert "old-history" not in text  # --from-end: history stays skipped
