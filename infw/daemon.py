@@ -581,6 +581,7 @@ class Daemon:
             srv.shutdown()
         self.events_logger.stop()
         self.stats.stop_poll()
+        self.stats.unregister()
         self.syncer.shutdown()
         self._event_file.close()
         if self._events_socket_sink is not None:
@@ -629,6 +630,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.backend == "tpu":
+        # Join the multi-host process group when configured
+        # (INFW_COORDINATOR / INFW_NUM_PROCESSES / INFW_PROCESS_ID) — the
+        # DaemonSet-scale-out analogue; single-process is a no-op.
+        from .parallel.multihost import init_distributed
+
+        init_distributed()
     debug = os.environ.get("ENABLE_LPM_LOOKUP_DBG", "0") not in ("0", "", "false")
     daemon = Daemon(
         state_dir=args.state_dir,

@@ -67,6 +67,41 @@ def add_uint64(a: int, b: int):
     return c, False
 
 
+#: Process-level registry of Statistics instances — the analogue of
+#: registering the gauges into controller-runtime's shared Prometheus
+#: registry (statistics.go:79-86): everything registered is rendered by
+#: one exposition call, however many daemons/pollers live in-process.
+_registry_lock = threading.Lock()
+_registry: List["Statistics"] = []
+
+
+def _render_exposition(vals: Dict[str, int]) -> str:
+    """Prometheus text format for the four node gauges — the ONE place
+    the exposition format lives (shared by per-instance and registry
+    renders)."""
+    out = []
+    for name, help_text in _METRICS:
+        full = f"{METRIC_INF_NAMESPACE}_{METRIC_INF_SUBSYSTEM_NODE}_{name}"
+        out.append(f"# HELP {full} {help_text}")
+        out.append(f"# TYPE {full} gauge")
+        out.append(f"{full} {vals[name]}")
+    return "\n".join(out) + "\n"
+
+
+def render_registry_text() -> str:
+    """Combined exposition over every registered Statistics instance
+    (values summed per metric) — what a shared /metrics endpoint serves
+    when multiple pollers register, matching the reference's single
+    metrics.Registry fed by any number of collectors."""
+    with _registry_lock:
+        instances = list(_registry)
+    totals: Dict[str, int] = {name: 0 for name, _ in _METRICS}
+    for inst in instances:
+        for name, v in inst.values().items():
+            totals[name] += v
+    return _render_exposition(totals)
+
+
 class Statistics:
     """NewStatistics + Register + Start/StopPoll (statistics.go:61-110).
 
@@ -84,8 +119,23 @@ class Statistics:
     # -- registration (regOnce, statistics.go:79-86) -------------------------
 
     def register(self) -> None:
-        with self._lock:
+        """Idempotent (regOnce): adds this instance to the process-level
+        registry consumed by render_registry_text.  Flag and list are
+        mutated under the ONE registry lock so they can never diverge
+        (a register/unregister race could otherwise double-append)."""
+        with _registry_lock:
+            if self._registered:
+                return
             self._registered = True
+            _registry.append(self)
+
+    def unregister(self) -> None:
+        with _registry_lock:
+            if not self._registered:
+                return
+            self._registered = False
+            if self in _registry:
+                _registry.remove(self)
 
     # -- polling -------------------------------------------------------------
 
@@ -156,11 +206,4 @@ class Statistics:
     def render_prometheus_text(self) -> str:
         """Prometheus text format served on the daemon's /metrics endpoint
         (the reference's 127.0.0.1:39301, cmd/daemon/daemon.go:57-58)."""
-        vals = self.values()
-        out = []
-        for name, help_text in _METRICS:
-            full = f"{METRIC_INF_NAMESPACE}_{METRIC_INF_SUBSYSTEM_NODE}_{name}"
-            out.append(f"# HELP {full} {help_text}")
-            out.append(f"# TYPE {full} gauge")
-            out.append(f"{full} {vals[name]}")
-        return "\n".join(out) + "\n"
+        return _render_exposition(self.values())

@@ -39,3 +39,44 @@ def test_mesh_trie_sharded_matches_oracle():
     np.testing.assert_array_equal(xdp, ref.xdp)
     got = testing.stats_dict_from_array(jaxpath.merge_stats_host(stats))
     assert got == ref.stats
+
+
+def test_mesh_trie_sharded_10k_adversarial():
+    """Scale tier (VERDICT r2 #7): a 10K-entry nested/overlapping table
+    sharded over the rules axis, where per-shard trie depth padding and
+    the pmax winner combine are actually stressed (shards compile
+    different node counts but identical static depth), bit-exact vs the
+    native C++ reference classifier."""
+    from infw.backend.cpu_ref import CpuRefClassifier
+    from infw.kernels import jaxpath
+
+    rng = np.random.default_rng(41)
+    tables = testing.random_tables_fast(
+        rng, n_entries=10_000, width=8, group_size=6
+    )
+    assert tables.levels >= 7  # deep v6 prefixes present
+    batch = testing.random_batch_fast(rng, tables, n_packets=4096)
+
+    ref = CpuRefClassifier()
+    ref.load_tables(tables)
+    want = ref.classify(batch)
+
+    m = meshmod.make_mesh(8, rules_shards=4)
+    placed = meshmod.shard_tables_trie(tables, m)
+    # per-shard tries genuinely differ in size but share static depth
+    assert placed.trie_levels[0].shape[0] == 4
+    results, xdp, stats = meshmod.classify_on_mesh_trie(
+        m, tables, batch, placed=placed
+    )
+    np.testing.assert_array_equal(results, want.results)
+    np.testing.assert_array_equal(xdp, want.xdp)
+    got = jaxpath.merge_stats_host(stats)
+    np.testing.assert_array_equal(got, want.stats_delta)
+
+    # second batch against the placed handle (stream-of-batches usage)
+    batch2 = testing.random_batch_fast(rng, tables, n_packets=1024)
+    want2 = ref.classify(batch2)
+    results2, _, _ = meshmod.classify_on_mesh_trie(
+        m, tables, batch2, placed=placed
+    )
+    np.testing.assert_array_equal(results2, want2.results)

@@ -223,3 +223,51 @@ def test_statistics_poller_start_stop():
     stats.stop_poll()
     assert not stats.is_polling
     stats.stop_poll()  # no-op double stop
+
+
+def test_statistics_registry_exposition():
+    """register() feeds a real process-level registry: one exposition
+    call renders the sum over every registered poller (the shared
+    Prometheus-registry role, statistics.go:79-86), and unregister
+    removes an instance."""
+    from infw.obs import statistics as st
+
+    class _FakeClf:
+        def __init__(self, deny):
+            import numpy as np
+
+            snap = np.zeros((16, 4), np.int64)
+            snap[1] = [0, 0, deny, deny * 100]
+            self._snap = snap
+
+        @property
+        def stats(self):
+            outer = self
+
+            class _S:
+                def snapshot(self):
+                    return outer._snap
+            return _S()
+
+    # isolate from any Statistics other tests left registered
+    with st._registry_lock:
+        saved = list(st._registry)
+        st._registry.clear()
+    a, b = st.Statistics(), st.Statistics()
+    a.register(); a.register()  # regOnce: idempotent
+    b.register()
+    try:
+        a.update_metrics(_FakeClf(2))
+        b.update_metrics(_FakeClf(3))
+        text = st.render_registry_text()
+        assert "ingressnodefirewall_node_packet_deny_total 5" in text
+        assert "ingressnodefirewall_node_packet_deny_bytes 500" in text
+        b.unregister()
+        text = st.render_registry_text()
+        assert "ingressnodefirewall_node_packet_deny_total 2" in text
+    finally:
+        a.unregister()
+        b.unregister()
+        assert "deny_total 0" in st.render_registry_text()
+        with st._registry_lock:
+            st._registry.extend(saved)
