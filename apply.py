@@ -1,0 +1,24 @@
+import json, os, sys, time, urllib.request
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from infw.daemon import write_frames_file_v2
+from infw.obs.pcap import build_frame, FramesBuf
+sd = "/tmp/infw-verify4/state"
+ns = {"apiVersion": "ingressnodefirewall.openshift.io/v1alpha1",
+      "kind": "IngressNodeFirewallNodeState",
+      "metadata": {"name": os.uname().nodename, "namespace": "ingress-node-firewall-system"},
+      "spec": {"interfaceIngressRules": {"eth0": [
+          {"sourceCIDRs": ["10.1.0.0/16"],
+           "rules": [{"order": 1, "protocolConfig": {"protocol": "TCP",
+                      "tcp": {"ports": "80"}}, "action": "Deny"}]}]}}}
+p = os.path.join(sd, "nodestates", os.uname().nodename + ".json")
+with open(p + ".tmp", "w") as f: json.dump(ns, f)
+os.replace(p + ".tmp", p)
+time.sleep(3)
+fb = FramesBuf.from_frames([build_frame("10.1.2.3", "9.9.9.9", 6, 1234, 80)], 2)
+write_frames_file_v2(os.path.join(sd, "ingest", "v.frames"), fb)
+deadline = time.time() + 20
+vp = os.path.join(sd, "out", "v.frames.verdicts.json")
+while time.time() < deadline and not os.path.exists(vp): time.sleep(0.1)
+print("verdicts:", open(vp).read())
+print("healthz:", urllib.request.urlopen("http://127.0.0.1:39300/healthz").read())

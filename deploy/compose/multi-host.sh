@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Multi-host composition: the DaemonSet-scale-out analogue. One daemon
+# process per host joined into a single JAX job over DCN
+# (infw/parallel/multihost.py). Run this script on EVERY host with the
+# same coordinator address and a unique INFW_PROCESS_ID.
+#
+#   host0: INFW_PROCESS_ID=0 deploy/compose/multi-host.sh host0:8476 4
+#   host1: INFW_PROCESS_ID=1 deploy/compose/multi-host.sh host0:8476 4
+#   ...
+#
+# The per-packet pmax/psum rules-axis combine stays on each host's ICI;
+# only the data axis and the final stats reduction cross DCN.
+set -euo pipefail
+
+COORD="${1:?usage: multi-host.sh COORDINATOR_HOST:PORT NUM_PROCESSES [STATE_DIR]}"
+NPROC="${2:?usage: multi-host.sh COORDINATOR_HOST:PORT NUM_PROCESSES [STATE_DIR]}"
+STATE_DIR="${3:-/var/lib/infw}"
+REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
+
+cd "$REPO_DIR"
+mkdir -p "$STATE_DIR"
+
+INFW_COORDINATOR="$COORD" \
+INFW_NUM_PROCESSES="$NPROC" \
+INFW_PROCESS_ID="${INFW_PROCESS_ID:?set INFW_PROCESS_ID to this hosts rank}" \
+NODE_NAME="${NODE_NAME:-$(hostname)}" \
+exec python -m infw.daemon --state-dir "$STATE_DIR" --backend tpu

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Single-node composition: the daemonset pod re-expressed as processes —
+# daemon (dataplane) + events sidecar (cmd/syslog analogue) + manager
+# (fan-out controller), wired exactly like
+# /root/reference/bindata/manifests/daemon/daemonset.yaml:25-113 wires its
+# three containers (shared state volume -> state dir, syslog unix socket
+# -> unixgram events socket, metrics 39301 / health 39300).
+#
+# Usage: deploy/compose/single-node.sh [STATE_DIR] [BACKEND]
+set -euo pipefail
+
+STATE_DIR="${1:-/var/lib/infw}"
+BACKEND="${2:-${INFW_BACKEND:-tpu}}"
+NODE_NAME="${NODE_NAME:-$(hostname)}"
+EVENTS_SOCK="${INFW_EVENTS_SOCKET:-$STATE_DIR/events.sock}"
+REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
+
+mkdir -p "$STATE_DIR"
+cd "$REPO_DIR"
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; wait || true; }
+trap cleanup EXIT INT TERM
+
+# events sidecar first so the daemon's datagrams have a listener
+python -m infw.obs.sidecar --socket "$EVENTS_SOCK" &
+pids+=($!)
+
+# manager: fan-out controller + admission + NodeState export
+DAEMONSET_IMAGE="${DAEMONSET_IMAGE:-infw:latest}" \
+DAEMONSET_NAMESPACE="${DAEMONSET_NAMESPACE:-ingress-node-firewall-system}" \
+python -m infw.manager --export-dir "$STATE_DIR" &
+pids+=($!)
+
+# daemon in the foreground (no exec: the EXIT trap must outlive it so a
+# daemon crash also tears down the sidecar and manager)
+NODE_NAME="$NODE_NAME" python -m infw.daemon \
+  --state-dir "$STATE_DIR" \
+  --backend "$BACKEND" \
+  --events-socket "$EVENTS_SOCK"

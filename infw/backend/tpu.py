@@ -54,7 +54,7 @@ class TpuClassifier:
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         self._tables: Optional[CompiledTables] = None
-        self._active = None  # (path, device tables, block_b or None)
+        self._active = None  # (path, device tables, block_b or None, wide_rids)
         self._closed = False
 
     # -- rule loading -------------------------------------------------------
@@ -67,17 +67,32 @@ class TpuClassifier:
         )
         # Build the next buffer off-lock (host packing + device_put can be
         # slow); swap under the lock.
+        wide_rids = False
         if path == "dense":
-            pt = pallas_dense.build_pallas_tables(tables)
+            try:
+                pt = pallas_dense.build_pallas_tables(tables)
+            except ValueError as e:
+                if "ruleId" not in str(e):
+                    raise
+                # Adversarial direct content whose ruleIds exceed the
+                # Pallas packing: serve it from the trie path instead of
+                # refusing the table at load time.
+                path = "trie"
+        if path == "dense":
             dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
             block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
         else:
-            jaxpath.check_wire_ruleids(tables)
+            try:
+                jaxpath.check_wire_ruleids(tables)
+            except ValueError:
+                # ruleIds > 255: the 2B wire result can't carry them —
+                # fall back to the u32 (non-wire) classify path.
+                wide_rids = True
             dev = jaxpath.device_tables(tables, self._device)
             block_b = None
         with self._lock:
             self._tables = tables
-            self._active = (path, dev, block_b)
+            self._active = (path, dev, block_b, wide_rids)
 
     # -- classify -----------------------------------------------------------
 
@@ -98,11 +113,18 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev, block_b = self._active
-        # Packed wire format: 28B/packet H2D, 2B/packet D2H — the
-        # host<->device link is the streaming bottleneck, not the kernel.
+            path, dev, block_b, wide_rids = self._active
+        if wide_rids:
+            return self._classify_async_wide(dev, batch, apply_stats)
+        # Packed wire format: 28B/packet H2D (16B for v4-compactable
+        # chunks), 2B/packet D2H — the host<->device link is the streaming
+        # bottleneck, not the kernel.  The daemon regroups ingest by
+        # family, so the majority family of real traffic ships compact.
         kind = np.asarray(batch.kind)
-        wire = jax.device_put(batch.pack_wire(), self._device)
+        v4_only = not bool((kind == KIND_IPV6).any())
+        compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
+        wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
+        wire = jax.device_put(wire_np, self._device)
         if path == "dense":
             res16, stats = pallas_dense.jitted_classify_pallas_wire(
                 self._interpret, block_b
@@ -111,7 +133,6 @@ class TpuClassifier:
             # Depth specialization: a batch with no IPv6 packets walks only
             # the ≤/32 trie levels (3 gathers instead of up to 15) — the
             # daemon steers family-homogeneous chunks here.
-            v4_only = not bool((kind == KIND_IPV6).any())
             res16, stats = jaxpath.jitted_classify_wire(True, v4_only)(dev, wire)
         # Start the D2H copy now so it overlaps the dispatch of subsequent
         # batches; .result() then finds the bytes already (or sooner) on
@@ -128,6 +149,31 @@ class TpuClassifier:
                 self._stats.add(stats_delta)
             results, xdp = jaxpath.host_finalize_wire(np.asarray(res16), kind)
             return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats_delta)
+
+        return PendingClassify(materialize)
+
+    def _classify_async_wide(
+        self, dev, batch: PacketBatch, apply_stats: bool
+    ) -> PendingClassify:
+        """u32 results path for tables whose ruleIds exceed the wire
+        format's 8 bits: full DeviceBatch H2D and 4B/packet results D2H —
+        slower on the link, lossless on ruleIds."""
+        db = jaxpath.device_batch(batch, self._device)
+        res, xdp, stats = jaxpath.jitted_classify(True)(dev, db)
+        for arr in (res, xdp, stats):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
+
+        def materialize() -> ClassifyOutput:
+            stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=np.asarray(res), xdp=np.asarray(xdp),
+                stats_delta=stats_delta,
+            )
 
         return PendingClassify(materialize)
 

@@ -115,16 +115,25 @@ def device_batch(batch: PacketBatch, device=None) -> DeviceBatch:
 
 
 def unpack_wire(wire: jax.Array) -> DeviceBatch:
-    """Device-side inverse of PacketBatch.pack_wire: (B, 7) uint32 →
-    DeviceBatch.  Pure elementwise bit ops, fused by XLA into whatever
-    consumes the fields — the packed descriptor never round-trips HBM."""
+    """Device-side inverse of PacketBatch.pack_wire / pack_wire_v4,
+    discriminated by the (static) wire width: (B, 7) carries the full
+    128-bit source address, (B, 4) the family-compact v4 layout (IP word 0
+    only, high words reconstructed as zeros — the v4 key invariant).
+    Pure elementwise bit ops, fused by XLA into whatever consumes the
+    fields — the packed descriptor never round-trips HBM."""
     w0 = wire[:, 0]
     w1 = wire[:, 1]
+    if wire.shape[1] == 4:
+        ip_words = jnp.concatenate(
+            [wire[:, 3:4], jnp.zeros((wire.shape[0], 3), wire.dtype)], axis=1
+        )
+    else:
+        ip_words = wire[:, 3:7]
     return DeviceBatch(
         kind=(w0 & 3).astype(jnp.int32),
         l4_ok=((w0 >> 2) & 1).astype(jnp.int32),
         ifindex=wire[:, 2].astype(jnp.int32),
-        ip_words=wire[:, 3:7],
+        ip_words=ip_words,
         proto=((w0 >> 3) & 0xFF).astype(jnp.int32),
         dst_port=(w1 & 0xFFFF).astype(jnp.int32),
         icmp_type=((w0 >> 11) & 0xFF).astype(jnp.int32),

@@ -89,9 +89,14 @@ class PacketBatch:
 
         Device-side inverse: kernels.jaxpath.unpack_wire (fused into the
         classify jit, so unpacking costs no extra HBM round trip)."""
-        b = len(self)
+        out = np.empty((len(self), 7), np.uint32)
+        self._pack_wire_header(out)
+        out[:, 3:7] = self.ip_words.astype(np.uint32)
+        return out
+
+    def _pack_wire_header(self, out: np.ndarray) -> None:
+        """w0..w2 of the wire layout (shared by the 7- and 4-word formats)."""
         plen = np.clip(self.pkt_len, 0, 0x1FFFFF).astype(np.uint32)
-        out = np.empty((b, 7), np.uint32)
         out[:, 0] = (
             (self.kind.astype(np.uint32) & 3)
             | ((self.l4_ok.astype(np.uint32) & 1) << 2)
@@ -104,7 +109,24 @@ class PacketBatch:
             (plen & 0xFFFF) << 16
         )
         out[:, 2] = self.ifindex.astype(np.uint32)
-        out[:, 3:7] = self.ip_words.astype(np.uint32)
+
+    def is_v4_compactable(self) -> bool:
+        """True when the batch can take the 4-word wire format: no IPv6
+        packets and no nonzero high IP words (the host parser guarantees
+        zeros for v4/malformed/other frames; synthetic batches may not)."""
+        return not bool(
+            (np.asarray(self.kind) == KIND_IPV6).any()
+        ) and not bool(np.asarray(self.ip_words)[:, 1:].any())
+
+    def pack_wire_v4(self) -> np.ndarray:
+        """The family-compact (B, 4) uint32 wire format — 16B/packet for
+        v4-only chunks (the daemon's ingest regroups by family, so the
+        majority family of real traffic takes this path): w0..w2 as
+        pack_wire, w3 = IP word 0.  Caller contract: is_v4_compactable().
+        Device-side inverse: unpack_wire (width-discriminated)."""
+        out = np.empty((len(self), 4), np.uint32)
+        self._pack_wire_header(out)
+        out[:, 3] = self.ip_words[:, 0].astype(np.uint32)
         return out
 
     def pad_to(self, n: int) -> "PacketBatch":

@@ -202,9 +202,6 @@ def test_wire_ruleid_guard_trips_loudly():
         jaxpath.check_wire_ruleids(tables)
     with pytest.raises(ValueError, match="ruleId"):
         pallas_dense.build_pallas_tables(tables)
-    clf = TpuClassifier(force_path="trie")
-    with pytest.raises(ValueError, match="ruleId"):
-        clf.load_tables(tables)
     # the u32 (non-wire) jax path still classifies such tables correctly
     from infw import testing as _t
     batch = _t.random_batch(np.random.default_rng(7), tables, n_packets=64)
@@ -364,3 +361,58 @@ def test_double_buffer_swap_under_concurrency(tmp_path, path):
     assert len(seen_gens) >= 2, f"swap never observed: {seen_gens}"
     # exactly-once stats: accumulator == sum of returned deltas
     np.testing.assert_array_equal(clf.stats.snapshot(), delta_total[0])
+
+
+@pytest.mark.parametrize("path", ["dense", "trie"])
+def test_v4_compact_wire_parity(path):
+    """A v4-compactable batch auto-ships the 16B/packet (B,4) wire format
+    on both device paths; verdicts/stats stay bit-exact vs the oracle."""
+    rng = np.random.default_rng(29)
+    tables = testing.random_tables(rng, n_entries=40, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=300)
+    # make it v4-compactable: no IPv6 packets, high IP words zeroed
+    batch.kind = np.where(batch.kind == 2, 1, batch.kind).astype(np.int32)
+    batch.ip_words[:, 1:] = 0
+    assert batch.is_v4_compactable()
+    assert batch.pack_wire_v4().shape == (300, 4)
+    clf = TpuClassifier(force_path=path)
+    clf.load_tables(tables)
+    check_against_oracle(clf, tables, batch)
+    clf.close()
+
+
+def test_is_v4_compactable_rejects_v6_and_high_words():
+    rng = np.random.default_rng(30)
+    tables = testing.random_tables(rng, n_entries=10, width=4)
+    batch = testing.random_batch(rng, tables, n_packets=50)
+    batch.kind[0] = 2  # one IPv6 packet
+    assert not batch.is_v4_compactable()
+    batch.kind[:] = 1
+    batch.ip_words[:, 1:] = 0
+    batch.ip_words[3, 2] = 7  # stray high word
+    assert not batch.is_v4_compactable()
+
+
+def test_wide_ruleid_tables_fall_back_to_u32_path():
+    """Direct adversarial content with ruleIds > 255 loads on the TPU
+    backend (u32 fallback) instead of refusing, and reports the full
+    ruleId losslessly."""
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [70000, 6, 80, 0, 0, 0, 1]  # rid 70000 > u8/u16, TCP 80 deny
+    content = {LpmKey(32, 2, bytes(16)): rows}
+    tables = compile_tables_from_content(content, rule_width=4)
+    from infw.packets import make_batch
+
+    b = make_batch(src=["9.9.9.9"], proto=[6], dst_port=[80], ifindex=[2],
+                   pkt_len=[100])
+    ref = oracle.classify(tables, b)
+    # both the forced-trie AND the default (auto -> dense -> fallback)
+    # configurations must serve the table
+    for kw in ({"force_path": "trie"}, {}):
+        clf = TpuClassifier(**kw)
+        clf.load_tables(tables)
+        out = clf.classify(b)
+        assert out.results[0] == ((70000 & 0xFFFFFF) << 8) | 1
+        assert out.xdp[0] == 1  # XDP_DROP
+        np.testing.assert_array_equal(out.results, ref.results)
+        clf.close()

@@ -1,0 +1,105 @@
+"""deploy/ tree validation: every shipped sample CR must pass the schema
+and admission tiers (the reference's samples are applied against a live
+API server in its e2e flow; here the validation library IS that gate),
+the bundle descriptor must stay consistent with infw.spec, and the
+compose launchers must be syntactically sound."""
+import json
+import os
+import subprocess
+
+import pytest
+
+from infw.spec import (
+    IngressNodeFirewall,
+    IngressNodeFirewallConfig,
+)
+from infw.validate import validate_ingress_node_firewall
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+SAMPLES = os.path.join(DEPLOY, "samples")
+
+
+def _load_docs(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc if isinstance(doc, list) else [doc]
+
+
+def _all_sample_docs():
+    docs = []
+    for fn in sorted(os.listdir(SAMPLES)):
+        for doc in _load_docs(os.path.join(SAMPLES, fn)):
+            docs.append((fn, doc))
+    return docs
+
+
+def test_samples_cover_reference_set():
+    names = set(os.listdir(SAMPLES))
+    assert {
+        "ingress-node-firewall-config.json",
+        "ingressnodefirewall-demo.json",
+        "ingressnodefirewall-demo-2.json",
+        "ingressnodefirewall-demo-3.json",
+        "ingressnodefirewall-denyall.json",
+    } <= names
+
+
+@pytest.mark.parametrize(
+    "fn,doc", _all_sample_docs(), ids=lambda x: x if isinstance(x, str) else ""
+)
+def test_sample_parses_and_validates(fn, doc):
+    if doc["kind"] == "IngressNodeFirewallConfig":
+        obj = IngressNodeFirewallConfig.from_dict(doc)
+        assert obj.metadata.name == "ingressnodefirewallconfig"
+        return
+    assert doc["kind"] == "IngressNodeFirewall"
+    inf = IngressNodeFirewall.from_dict(doc)
+    errs = validate_ingress_node_firewall(inf)
+    assert errs == [], f"{fn}: {errs}"
+
+
+def test_demo3_pair_trips_cross_inf_order_check():
+    """Reference-faithful quirk: the demo-3 pair shares nodeSelector,
+    CIDR 172.20.0.0/24 AND order 20 (only the interface differs), and the
+    reference webhook's cross-INF check ignores interfaces
+    (webhook.go:330-365) — so applying -b after -a must produce exactly
+    the order-conflict error, reference message format included."""
+    a, b = [
+        IngressNodeFirewall.from_dict(d)
+        for d in _load_docs(
+            os.path.join(SAMPLES, "ingressnodefirewall-demo-3.json")
+        )
+    ]
+    assert validate_ingress_node_firewall(a) == []
+    errs = validate_ingress_node_firewall(b, existing=[a])
+    assert len(errs) == 1
+    assert "order is not unique for sourceCIDR '172.20.0.0/24'" in errs[0]
+    assert "ingressnodefirewall-demo-3-a" in errs[0]
+
+
+def test_bundle_manifest_consistent():
+    with open(os.path.join(DEPLOY, "bundle", "manifest.json")) as f:
+        m = json.load(f)
+    kinds = {api["kind"] for api in m["providedAPIs"]}
+    assert kinds == {
+        "IngressNodeFirewall",
+        "IngressNodeFirewallConfig",
+        "IngressNodeFirewallNodeState",
+    }
+    for api in m["providedAPIs"]:
+        for ex in api.get("exampleFiles", []):
+            p = os.path.normpath(os.path.join(DEPLOY, "bundle", ex))
+            assert os.path.exists(p), f"dangling exampleFile {ex}"
+    # declared daemon entry must name the real module and ports
+    daemon = m["components"]["daemon"]
+    assert "infw.daemon" in daemon["run"]
+    assert daemon["ports"] == {"metrics": 39301, "health": 39300}
+    assert "NODE_NAME" in daemon["env"]["required"]
+
+
+@pytest.mark.parametrize("script", ["single-node.sh", "multi-host.sh"])
+def test_compose_scripts_parse(script):
+    p = os.path.join(DEPLOY, "compose", script)
+    assert os.access(p, os.X_OK), f"{script} must be executable"
+    subprocess.run(["bash", "-n", p], check=True)
