@@ -92,12 +92,23 @@ def chained_throughput(classify_step, dt, db, n_packets, on_tpu, label):
     t0 = time.perf_counter()
     int(loop(k1, dt, db))
     log(f"{label}: warmup k={k1} {time.perf_counter()-t0:.1f}s")
-    t0 = time.perf_counter(); int(loop(k1, dt, db)); t1 = time.perf_counter()
-    t2 = time.perf_counter(); int(loop(k2, dt, db)); t3 = time.perf_counter()
-    dt_s = ((t3 - t2) - (t1 - t0)) / (k2 - k1)
+    # A tunnel hiccup on either sample corrupts the slope; take the
+    # per-k minimum over a few attempts before declaring non-monotonic.
+    best1 = best2 = float("inf")
+    dt_s = -1.0
+    for attempt in range(3):
+        t0 = time.perf_counter(); int(loop(k1, dt, db)); t1 = time.perf_counter()
+        t2 = time.perf_counter(); int(loop(k2, dt, db)); t3 = time.perf_counter()
+        best1 = min(best1, t1 - t0)
+        best2 = min(best2, t3 - t2)
+        dt_s = (best2 - best1) / (k2 - k1)
+        if dt_s > 0:
+            break
+        log(f"{label}: non-monotonic sample (attempt {attempt + 1}/3) "
+            f"k={k1}:{best1:.3f}s k={k2}:{best2:.3f}s")
     if dt_s <= 0:
         raise RuntimeError(
-            f"{label}: non-monotonic timing k={k1}:{t1-t0:.3f}s k={k2}:{t3-t2:.3f}s"
+            f"{label}: non-monotonic timing k={k1}:{best1:.3f}s k={k2}:{best2:.3f}s"
         )
     thr = n_packets / dt_s
     log(f"{label}: {thr/1e6:.2f} M classifications/s "
