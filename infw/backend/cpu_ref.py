@@ -32,7 +32,8 @@ def _build_library() -> str:
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
     if (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
         subprocess.run(
-            ["g++", "-O2", "-fPIC", "-std=c++17", "-Wall", "-shared", "-o", _LIB, _SRC],
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-Wall", "-pthread",
+             "-shared", "-o", _LIB, _SRC],
             check=True,
             capture_output=True,
         )
@@ -54,8 +55,14 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_int32, i32p, i32p, u32p, u8p, i32p, i32p, i32p,
                 i32p, i32p, u32p, i32p, i64p,
             ]
+            lib.infw_parse_frames.restype = None
+            lib.infw_parse_frames.argtypes = [
+                ctypes.c_int64, u8p, i64p, u32p,
+                i32p, i32p, u32p, i32p, i32p, i32p, i32p, i32p,
+                ctypes.c_int32,
+            ]
             lib.infw_abi_version.restype = ctypes.c_int32
-            assert lib.infw_abi_version() == 1
+            assert lib.infw_abi_version() == 2
             _lib = lib
         return _lib
 

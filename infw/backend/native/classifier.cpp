@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -162,6 +163,112 @@ void infw_classify(int32_t T, int32_t width, const uint32_t* ent_ifindex,
   }
 }
 
-int32_t infw_abi_version() { return 1; }
+// Frame parser: the host-side replica of the XDP header parse
+// (ingress_node_firewall_kernel.c:95-174,423-439) at ingest-replay scale.
+// Bit-exact with the Python parse paths in infw/obs/pcap.py (fixed 20-byte
+// iphdr — no IHL; unknown/truncated L4 => l4_ok=0; <14-byte frame =>
+// KIND_MALFORMED), one linear pass per frame, parallelized over frame
+// ranges — ~10x the vectorized-NumPy gather formulation at 1M frames.
+void infw_parse_frames(
+    int64_t n,
+    const uint8_t* buf,
+    const int64_t* offsets,
+    const uint32_t* lengths,
+    int32_t* kind,
+    int32_t* l4_ok,
+    uint32_t* words,   // (n, 4)
+    int32_t* proto,
+    int32_t* dst_port,
+    int32_t* icmp_type,
+    int32_t* icmp_code,
+    int32_t* pkt_len,
+    int32_t n_threads) {
+  constexpr int kEthHlen = 14;
+  constexpr int kV4Hlen = 20;  // fixed sizeof(struct iphdr), kernel.c:103
+  constexpr int kV6Hlen = 40;
+  constexpr int kKindOther = 3;
+  int l4_hlen[256];
+  for (int i = 0; i < 256; ++i) l4_hlen[i] = -1;
+  l4_hlen[kProtoTcp] = 20;
+  l4_hlen[kProtoUdp] = 8;
+  l4_hlen[kProtoSctp] = 12;
+  l4_hlen[kProtoIcmp] = 8;
+  l4_hlen[kProtoIcmp6] = 8;
+
+  auto be16 = [](const uint8_t* p) -> uint32_t {
+    return (static_cast<uint32_t>(p[0]) << 8) | p[1];
+  };
+  auto be32 = [](const uint8_t* p) -> uint32_t {
+    return (static_cast<uint32_t>(p[0]) << 24) | (static_cast<uint32_t>(p[1]) << 16) |
+           (static_cast<uint32_t>(p[2]) << 8) | p[3];
+  };
+
+  auto run = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* f = buf + offsets[i];
+      const int32_t len = static_cast<int32_t>(lengths[i]);
+      pkt_len[i] = len;
+      l4_ok[i] = 0;
+      proto[i] = 0;
+      dst_port[i] = 0;
+      icmp_type[i] = 0;
+      icmp_code[i] = 0;
+      words[i * 4 + 0] = words[i * 4 + 1] = words[i * 4 + 2] = words[i * 4 + 3] = 0;
+      if (len < kEthHlen) {
+        kind[i] = kKindMalformed;
+        continue;
+      }
+      const uint32_t ethertype = be16(f + 12);
+      int k, ip_hlen;
+      if (ethertype == 0x0800) {
+        k = kKindV4; ip_hlen = kV4Hlen;
+      } else if (ethertype == 0x86DD) {
+        k = kKindV6; ip_hlen = kV6Hlen;
+      } else {
+        kind[i] = kKindOther;
+        continue;
+      }
+      kind[i] = k;
+      if (len < kEthHlen + ip_hlen) continue;  // truncated IP: l4_ok=0
+      int pr;
+      if (k == kKindV4) {
+        pr = f[kEthHlen + 9];
+        words[i * 4 + 0] = be32(f + kEthHlen + 12);
+      } else {
+        pr = f[kEthHlen + 6];
+        for (int w = 0; w < 4; ++w)
+          words[i * 4 + w] = be32(f + kEthHlen + 8 + 4 * w);
+      }
+      proto[i] = pr;
+      const int hl = l4_hlen[pr];
+      if (hl < 0 || len < kEthHlen + ip_hlen + hl) continue;
+      l4_ok[i] = 1;
+      const uint8_t* l4 = f + kEthHlen + ip_hlen;
+      if (pr == kProtoTcp || pr == kProtoUdp || pr == kProtoSctp) {
+        dst_port[i] = static_cast<int32_t>(be16(l4 + 2));
+      } else {
+        icmp_type[i] = l4[0];
+        icmp_code[i] = l4[1];
+      }
+    }
+  };
+
+  int nt = n_threads;
+  if (nt <= 1 || n < (1 << 16)) {
+    run(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    const int64_t step = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = t * step;
+      const int64_t hi = lo + step < n ? lo + step : n;
+      if (lo >= hi) break;
+      threads.emplace_back(run, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+}
+
+int32_t infw_abi_version() { return 2; }
 
 }  // extern "C"

@@ -125,29 +125,34 @@ class TpuClassifier:
         compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
         wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
         wire = jax.device_put(wire_np, self._device)
+        # Fused single-buffer output: results + stats come back in ONE
+        # D2H materialization (jaxpath.fuse_wire_outputs) — each readback
+        # RPC pays the link's sync floor, so two arrays per chunk would
+        # double the per-chunk latency cost.
         if path == "dense":
-            res16, stats = pallas_dense.jitted_classify_pallas_wire(
+            fused = pallas_dense.jitted_classify_pallas_wire_fused(
                 self._interpret, block_b
             )(dev, wire)
         else:
             # Depth specialization: a batch with no IPv6 packets walks only
             # the ≤/32 trie levels (3 gathers instead of up to 15) — the
             # daemon steers family-homogeneous chunks here.
-            res16, stats = jaxpath.jitted_classify_wire(True, v4_only)(dev, wire)
+            fused = jaxpath.jitted_classify_wire_fused(True, v4_only)(dev, wire)
         # Start the D2H copy now so it overlaps the dispatch of subsequent
         # batches; .result() then finds the bytes already (or sooner) on
         # host.  Not all platforms expose it — best effort.
-        for arr in (res16, stats):
-            try:
-                arr.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                break
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        n = len(batch)
 
         def materialize() -> ClassifyOutput:
-            stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
+            res16, stats = jaxpath.split_wire_outputs(np.asarray(fused), n)
+            stats_delta = jaxpath.merge_stats_host(stats)
             if apply_stats:
                 self._stats.add(stats_delta)
-            results, xdp = jaxpath.host_finalize_wire(np.asarray(res16), kind)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
             return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats_delta)
 
         return PendingClassify(materialize)

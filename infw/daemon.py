@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import mmap
 import os
 import signal
 import struct
@@ -113,18 +114,29 @@ def read_frames_file(path: str) -> Tuple[List[bytes], List[int]]:
 
 
 def read_frames_any(path: str) -> FramesBuf:
-    """Read either frames-file version into a FramesBuf."""
+    """Read either frames-file version into a FramesBuf.
+
+    The v2 frame buffer is memory-mapped, not read: the parser (native,
+    one linear pass) faults pages straight from the page cache with no
+    intermediate copy of the (potentially multi-GB) payload, and the map
+    lives only as long as the FramesBuf referencing it."""
     with open(path, "rb") as f:
         magic = f.read(len(_FRAMES_MAGIC2))
         if magic == _FRAMES_MAGIC2:
             (count,) = struct.unpack("<I", f.read(4))
             ifindex = np.frombuffer(f.read(4 * count), "<u4")
             lengths = np.frombuffer(f.read(4 * count), "<u4")
-            buf = np.frombuffer(f.read(), np.uint8)
-            if len(lengths) != count or len(buf) != int(
+            payload_off = f.tell()
+            total = os.fstat(f.fileno()).st_size - payload_off
+            if len(lengths) != count or total != int(
                 lengths.astype(np.int64).sum()
             ):
                 raise ValueError(f"{path}: truncated v2 frames file")
+            if total:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                buf = np.frombuffer(mm, np.uint8, count=total, offset=payload_off)
+            else:
+                buf = np.zeros(0, np.uint8)
             return FramesBuf.from_lengths(buf, lengths, ifindex)
     if magic != _FRAMES_MAGIC:
         raise ValueError(f"{path}: not an infw frames file")

@@ -201,6 +201,49 @@ def jitted_classify_wire(use_trie: bool, v4_only: bool = False):
     )
 
 
+def fuse_wire_outputs(res16: jax.Array, stats: jax.Array) -> jax.Array:
+    """Pack (results_u16, stats_i32) into ONE int32 device buffer.
+
+    Each D2H materialization is a separate RPC that pays the link's sync
+    floor — ~90 ms per array on a tunneled deployment (measured) —
+    so reading results and stats separately doubles the per-chunk latency
+    for 24KB of stats.  Layout: ceil(B/2) words of u16-pair-packed
+    results, then stats flattened; bitcast (not convert) so the high
+    result's top bit survives the int32 view."""
+    b = res16.shape[0]
+    r = res16
+    if b % 2:
+        r = jnp.concatenate([r, jnp.zeros(1, jnp.uint16)])
+    # (nw, 2) u16 -> (nw,) u32 bitcast: a pure reinterpretation, no
+    # lane-crossing shuffles (the strided r[0::2] | r[1::2] << 16 form
+    # measures ~40% slower on the chip).
+    packed = jax.lax.bitcast_convert_type(r.reshape(-1, 2), jnp.uint32)
+    return jnp.concatenate(
+        [jax.lax.bitcast_convert_type(packed, jnp.int32), stats.reshape(-1)]
+    )
+
+
+def split_wire_outputs(arr: np.ndarray, b: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host inverse of fuse_wire_outputs -> (results_u16[b], stats_i32)."""
+    u = arr.view(np.uint32)
+    nw = (b + 1) // 2
+    res16 = np.empty(nw * 2, np.uint16)
+    res16[0::2] = u[:nw] & 0xFFFF
+    res16[1::2] = u[:nw] >> 16
+    stats = arr[nw:].reshape(MAX_TARGETS, 6)
+    return res16[:b], stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_wire_fused(use_trie: bool, v4_only: bool = False):
+    def f(tables: DeviceTables, wire: jax.Array) -> jax.Array:
+        return fuse_wire_outputs(
+            *classify_wire(tables, wire, use_trie=use_trie, v4_only=v4_only)
+        )
+
+    return jax.jit(f)
+
+
 def host_finalize_wire(res16: np.ndarray, kind: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side completion of the wire path: widen results to u32 and
     rebuild the XDP verdict exactly as finalize() does on device

@@ -361,6 +361,21 @@ def jitted_classify_pallas_wire(interpret: bool, block_b: int = BLOCK_B):
     )
 
 
+@functools.lru_cache(maxsize=None)
+def jitted_classify_pallas_wire_fused(interpret: bool, block_b: int = BLOCK_B):
+    """Single-buffer output (see jaxpath.fuse_wire_outputs): one D2H RPC
+    per chunk instead of two — the tunnel's sync floor makes the second
+    readback cost ~90 ms for 24KB of stats."""
+    from . import jaxpath
+
+    def f(pt: PallasTables, wire: jax.Array) -> jax.Array:
+        return jaxpath.fuse_wire_outputs(
+            *classify_pallas_wire(pt, wire, interpret=interpret, block_b=block_b)
+        )
+
+    return jax.jit(f)
+
+
 def jitted_classify_pallas(interpret: bool, block_b: int = BLOCK_B):
     """Cached jit wrapper; the cache key is normalized so callers that omit
     block_b share the entry with callers passing BLOCK_B explicitly."""

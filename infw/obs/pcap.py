@@ -23,7 +23,9 @@ packet, :392-399).
 from __future__ import annotations
 
 import ipaddress
+import os
 import struct
+import subprocess
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -171,17 +173,14 @@ class FramesBuf:
         return self.buf[off : off + int(self.lengths[i])].tobytes()
 
 
-def _be16(buf: np.ndarray, pos: np.ndarray, ok: np.ndarray) -> np.ndarray:
-    """Vector big-endian u16 gather at byte position ``pos`` (clipped;
-    callers mask with ``ok``)."""
-    p = np.where(ok, pos, 0)
-    return (buf[p].astype(np.int32) << 8) | buf[p + 1].astype(np.int32)
+def _be16_at(buf: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    """Big-endian u16 gather at byte positions ``pos`` (all in-bounds)."""
+    return (buf[pos].astype(np.int32) << 8) | buf[pos + 1]
 
 
-def _be32w(buf: np.ndarray, pos: np.ndarray, ok: np.ndarray, n_words: int) -> np.ndarray:
-    """(B, n_words) big-endian u32 gather starting at ``pos``."""
-    p = np.where(ok, pos, 0)
-    idx = p[:, None] + np.arange(4 * n_words)
+def _be32w_at(buf: np.ndarray, pos: np.ndarray, n_words: int) -> np.ndarray:
+    """(len(pos), n_words) big-endian u32 gather starting at ``pos``."""
+    idx = pos[:, None] + np.arange(4 * n_words)
     by = buf[idx].astype(np.uint32).reshape(len(pos), n_words, 4)
     return (by[..., 0] << 24) | (by[..., 1] << 16) | (by[..., 2] << 8) | by[..., 3]
 
@@ -192,18 +191,91 @@ for _p, _h in _L4_HLEN.items():
 
 
 def parse_frames_buf(fb: FramesBuf) -> PacketBatch:
-    """Vectorized parse_frames over a FramesBuf: bit-exact with the scalar
-    parse_frame (same kernel.c quirks), NumPy end to end — 10M frames
-    parse in well under a second instead of minutes of per-frame Python."""
+    """Parse a FramesBuf into a PacketBatch: bit-exact with the scalar
+    parse_frame (same kernel.c quirks).
+
+    Dispatches to the native C++ parser (classifier.cpp
+    infw_parse_frames — one linear pass per frame, multi-threaded) when
+    the library is available; falls back to the vectorized NumPy path
+    (subset-index gathers) when the toolchain is absent or
+    INFW_NO_NATIVE_PARSE is set.  Both are differentially tested against
+    parse_frame."""
+    global _native_unavailable
+    if (
+        len(fb)
+        and not _native_unavailable
+        and not os.environ.get("INFW_NO_NATIVE_PARSE")
+    ):
+        try:
+            return _parse_frames_buf_native(fb)
+        except (OSError, ImportError, AttributeError, AssertionError,
+                subprocess.SubprocessError):
+            # Toolchain missing or build failed: remember, so steady-state
+            # ingest doesn't re-spawn a doomed g++ attempt per chunk.
+            _native_unavailable = True
+    return _parse_frames_buf_np(fb)
+
+
+_native_unavailable = False
+
+
+def _parse_frames_buf_native(fb: FramesBuf) -> PacketBatch:
+    from ..backend.cpu_ref import load_library
+
+    lib = load_library()
+    b = len(fb)
+    buf = np.ascontiguousarray(fb.buf)
+    offsets = np.ascontiguousarray(fb.offsets, np.int64)
+    lengths = np.ascontiguousarray(fb.lengths, np.uint32)
+    kind = np.empty(b, np.int32)
+    l4_ok = np.empty(b, np.int32)
+    words = np.empty((b, 4), np.uint32)
+    proto = np.empty(b, np.int32)
+    dst_port = np.empty(b, np.int32)
+    icmp_type = np.empty(b, np.int32)
+    icmp_code = np.empty(b, np.int32)
+    pkt_len = np.empty(b, np.int32)
+    import ctypes
+
+    p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+    lib.infw_parse_frames(
+        b,
+        p(buf, ctypes.c_uint8),
+        p(offsets, ctypes.c_int64),
+        p(lengths, ctypes.c_uint32),
+        p(kind, ctypes.c_int32),
+        p(l4_ok, ctypes.c_int32),
+        p(words, ctypes.c_uint32),
+        p(proto, ctypes.c_int32),
+        p(dst_port, ctypes.c_int32),
+        p(icmp_type, ctypes.c_int32),
+        p(icmp_code, ctypes.c_int32),
+        p(pkt_len, ctypes.c_int32),
+        min(8, os.cpu_count() or 1),
+    )
+    return PacketBatch(
+        kind=kind,
+        l4_ok=l4_ok,
+        ifindex=fb.ifindex.astype(np.int32),
+        ip_words=words,
+        proto=proto,
+        dst_port=dst_port,
+        icmp_type=icmp_type,
+        icmp_code=icmp_code,
+        pkt_len=pkt_len,
+    )
+
+
+def _parse_frames_buf_np(fb: FramesBuf) -> PacketBatch:
+    """Vectorized NumPy parse: gathers run over subset index arrays
+    (np.nonzero of each family mask), never masked full-batch positions —
+    every byte read is for a row that needs it, and subset membership
+    already proves the read in-bounds (ip_ok/l4_ok encode the length
+    checks), so no clipping is required."""
     b = len(fb)
     if b == 0:
         return parse_frames([], [])
     buf = fb.buf
-    # Masked gathers read up to 16 bytes at clipped position 0 (the IPv6
-    # word extraction in _be32w) even when every row is masked out — the
-    # buffer must be at least that long.
-    if len(buf) < 16:
-        buf = np.concatenate([buf, np.zeros(16 - len(buf), np.uint8)])
     off = fb.offsets
     pkt_len = fb.lengths.astype(np.int32)
 
@@ -212,7 +284,9 @@ def parse_frames_buf(fb: FramesBuf) -> PacketBatch:
     kind[malformed] = KIND_MALFORMED
 
     has_eth = ~malformed
-    ethertype = _be16(buf, off + 12, has_eth)
+    ie = np.nonzero(has_eth)[0]
+    ethertype = np.zeros(b, np.int32)
+    ethertype[ie] = _be16_at(buf, off[ie] + 12)
     is_v4 = has_eth & (ethertype == ETH_P_IP)
     is_v6 = has_eth & (ethertype == ETH_P_IPV6)
     kind[is_v4] = KIND_IPV4
@@ -223,28 +297,28 @@ def parse_frames_buf(fb: FramesBuf) -> PacketBatch:
     ip_ok = (is_v4 | is_v6) & (pkt_len >= ETH_HLEN + ip_hlen)
 
     proto = np.zeros(b, np.int32)
-    pv4 = ip_ok & is_v4
-    pv6 = ip_ok & is_v6
-    proto[pv4] = buf[np.where(pv4, off + ETH_HLEN + 9, 0)].astype(np.int32)[pv4]
-    proto[pv6] = buf[np.where(pv6, off + ETH_HLEN + 6, 0)].astype(np.int32)[pv6]
+    i4 = np.nonzero(ip_ok & is_v4)[0]
+    i6 = np.nonzero(ip_ok & is_v6)[0]
+    proto[i4] = buf[off[i4] + ETH_HLEN + 9]
+    proto[i6] = buf[off[i6] + ETH_HLEN + 6]
 
     words = np.zeros((b, 4), np.uint32)
-    words[pv4, 0] = _be32w(buf, off + ETH_HLEN + 12, pv4, 1)[pv4, 0]
-    words[pv6] = _be32w(buf, off + ETH_HLEN + 8, pv6, 4)[pv6]
+    words[i4, 0] = _be32w_at(buf, off[i4] + ETH_HLEN + 12, 1)[:, 0]
+    words[i6] = _be32w_at(buf, off[i6] + ETH_HLEN + 8, 4)
 
     hlen = _L4_HLEN_LUT[proto]
     l4_ok = ip_ok & (hlen >= 0) & (pkt_len >= ETH_HLEN + ip_hlen + hlen)
     is_transport = (
         (proto == IPPROTO_TCP) | (proto == IPPROTO_UDP) | (proto == IPPROTO_SCTP)
     )
-    tr = l4_ok & is_transport
-    ic = l4_ok & ~is_transport
+    itr = np.nonzero(l4_ok & is_transport)[0]
+    iic = np.nonzero(l4_ok & ~is_transport)[0]
     dst_port = np.zeros(b, np.int32)
-    dst_port[tr] = _be16(buf, l4_off + 2, tr)[tr]
+    dst_port[itr] = _be16_at(buf, l4_off[itr] + 2)
     icmp_type = np.zeros(b, np.int32)
     icmp_code = np.zeros(b, np.int32)
-    icmp_type[ic] = buf[np.where(ic, l4_off, 0)].astype(np.int32)[ic]
-    icmp_code[ic] = buf[np.where(ic, l4_off + 1, 0)].astype(np.int32)[ic]
+    icmp_type[iic] = buf[l4_off[iic]]
+    icmp_code[iic] = buf[l4_off[iic] + 1]
 
     return PacketBatch(
         kind=kind,

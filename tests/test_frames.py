@@ -71,6 +71,74 @@ def test_parse_frames_buf_matches_scalar():
         )
 
 
+_FIELDS = ("kind", "l4_ok", "ifindex", "ip_words", "proto",
+           "dst_port", "icmp_type", "icmp_code", "pkt_len")
+
+
+def _native_available() -> bool:
+    """Probe once: only a missing/broken toolchain skips the native
+    differential tests — a regression in the parser itself must FAIL."""
+    try:
+        from infw.backend.cpu_ref import load_library
+
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+_HAS_NATIVE = _native_available()
+needs_native = pytest.mark.skipif(
+    not _HAS_NATIVE, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_parse_native_and_numpy_agree():
+    """Both parse_frames_buf implementations against the scalar on the
+    adversarial mix — the native path must not diverge from NumPy on any
+    truncation/ethertype/protocol edge."""
+    from infw.obs.pcap import _parse_frames_buf_native, _parse_frames_buf_np
+
+    rng = np.random.default_rng(12)
+    frames = _random_frames(rng, n=2000)
+    ifx = rng.integers(1, 1 << 20, len(frames))
+    fb = FramesBuf.from_frames(frames, ifx)
+    want = parse_frames(frames, list(ifx))
+    got_np = _parse_frames_buf_np(fb)
+    for field in _FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got_np, field), getattr(want, field), err_msg=f"np:{field}"
+        )
+    got_nat = _parse_frames_buf_native(fb)
+    for field in _FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got_nat, field), getattr(want, field), err_msg=f"native:{field}"
+        )
+
+
+@needs_native
+def test_parse_native_threaded_matches_numpy():
+    """Above the 64K single-thread threshold the native parser shards
+    across threads; shard boundaries must not corrupt any row."""
+    from infw.obs.pcap import _parse_frames_buf_native, _parse_frames_buf_np
+
+    rng = np.random.default_rng(13)
+    tables = testing.random_tables_fast(rng, n_entries=200, width=8)
+    batch = testing.random_batch_fast(rng, tables, n_packets=100_000)
+    fb = build_frames_bulk(
+        batch.kind, batch.ip_words, batch.proto, batch.dst_port,
+        batch.icmp_type, batch.icmp_code, l4_ok=batch.l4_ok,
+    )
+    fb.ifindex = np.asarray(batch.ifindex, np.uint32)
+    want = _parse_frames_buf_np(fb)
+    got = _parse_frames_buf_native(fb)
+    for field in _FIELDS:
+        np.testing.assert_array_equal(
+            getattr(got, field), getattr(want, field), err_msg=field
+        )
+
+
 def test_parse_frames_buf_empty():
     got = parse_frames_buf(FramesBuf.from_frames([], []))
     assert len(got) == 0
