@@ -40,11 +40,22 @@ def _build_library() -> str:
     return _LIB
 
 
+_ABI_VERSION = 3
+
+
 def load_library() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is None:
             lib = ctypes.CDLL(_build_library())
+            lib.infw_abi_version.restype = ctypes.c_int32
+            if lib.infw_abi_version() != _ABI_VERSION:
+                # Stale prebuilt .so whose mtime defeated the rebuild gate
+                # (artifact cache, cp -p): force one rebuild from source
+                # instead of binding symbols that may not exist.
+                os.remove(_LIB)
+                lib = ctypes.CDLL(_build_library())
+                lib.infw_abi_version.restype = ctypes.c_int32
             i32p = ctypes.POINTER(ctypes.c_int32)
             u32p = ctypes.POINTER(ctypes.c_uint32)
             u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -61,8 +72,13 @@ def load_library() -> ctypes.CDLL:
                 i32p, i32p, u32p, i32p, i32p, i32p, i32p, i32p,
                 ctypes.c_int32,
             ]
-            lib.infw_abi_version.restype = ctypes.c_int32
-            assert lib.infw_abi_version() == 2
+            lib.infw_pack_wire_subset.restype = ctypes.c_int32
+            lib.infw_pack_wire_subset.argtypes = [
+                ctypes.c_int64, i64p,
+                i32p, i32p, i32p, u32p, i32p, i32p, i32p, i32p, i32p,
+                u32p, ctypes.c_int32,
+            ]
+            assert lib.infw_abi_version() == _ABI_VERSION
             _lib = lib
         return _lib
 

@@ -269,6 +269,78 @@ void infw_parse_frames(
   }
 }
 
-int32_t infw_abi_version() { return 2; }
+// Fused subset gather + wire pack (PacketBatch.take + pack_wire[_v4] in
+// one pass): the daemon regroups ingest by family before dispatch, and
+// copying 9 SoA arrays per chunk just to re-pack them into the 7- or
+// 4-word device descriptor doubles the host cost of the hot loop.
+// Returns flags: bit0 = packed compact (4 words/row, rows contiguous at
+// the front of out), bit1 = subset is v4_only (no KIND_IPV6 rows).
+int32_t infw_pack_wire_subset(
+    int64_t n,
+    const int64_t* idx,
+    const int32_t* kind,
+    const int32_t* l4_ok,
+    const int32_t* ifindex,
+    const uint32_t* words,  // (B, 4)
+    const int32_t* proto,
+    const int32_t* dst_port,
+    const int32_t* icmp_type,
+    const int32_t* icmp_code,
+    const int32_t* pkt_len,
+    uint32_t* out,          // room for n * 7 words
+    int32_t n_threads) {
+  bool any_v6 = false, any_hi = false;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = idx[i];
+    any_v6 |= kind[r] == kKindV6;
+    any_hi |= (words[r * 4 + 1] | words[r * 4 + 2] | words[r * 4 + 3]) != 0;
+  }
+  const bool compact = !any_v6 && !any_hi;
+  const int w = compact ? 4 : 7;
+
+  auto run = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const int64_t r = idx[i];
+      uint32_t* o = out + i * w;
+      int32_t pl = pkt_len[r];  // clip as signed: negative -> 0, not huge
+      if (pl < 0) pl = 0;
+      const uint32_t plen = pl > 0x1FFFFF ? 0x1FFFFF : static_cast<uint32_t>(pl);
+      o[0] = (static_cast<uint32_t>(kind[r]) & 3) |
+             ((static_cast<uint32_t>(l4_ok[r]) & 1) << 2) |
+             ((static_cast<uint32_t>(proto[r]) & 0xFF) << 3) |
+             ((static_cast<uint32_t>(icmp_type[r]) & 0xFF) << 11) |
+             ((static_cast<uint32_t>(icmp_code[r]) & 0xFF) << 19) |
+             ((plen >> 16) << 27);
+      o[1] = (static_cast<uint32_t>(dst_port[r]) & 0xFFFF) | ((plen & 0xFFFF) << 16);
+      o[2] = static_cast<uint32_t>(ifindex[r]);
+      if (compact) {
+        o[3] = words[r * 4 + 0];
+      } else {
+        o[3] = words[r * 4 + 0];
+        o[4] = words[r * 4 + 1];
+        o[5] = words[r * 4 + 2];
+        o[6] = words[r * 4 + 3];
+      }
+    }
+  };
+
+  int nt = n_threads;
+  if (nt <= 1 || n < (1 << 16)) {
+    run(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    const int64_t step = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = t * step;
+      const int64_t hi = lo + step < n ? lo + step : n;
+      if (lo >= hi) break;
+      threads.emplace_back(run, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return (compact ? 1 : 0) | (any_v6 ? 0 : 2);
+}
+
+int32_t infw_abi_version() { return 3; }
 
 }  // extern "C"

@@ -124,6 +124,40 @@ class TpuClassifier:
         v4_only = not bool((kind == KIND_IPV6).any())
         compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
         wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
+        return self._dispatch_wire(
+            path, dev, block_b, wire_np, v4_only, kind, apply_stats
+        )
+
+    def supports_packed(self) -> bool:
+        """True when classify_async_packed can take this table generation
+        (the wide-ruleId fallback needs the full u32 batch path)."""
+        with self._lock:
+            return self._active is not None and not self._active[3]
+
+    def classify_async_packed(
+        self, wire_np: np.ndarray, v4_only: bool, apply_stats: bool = True
+    ) -> PendingClassify:
+        """classify_async for a pre-packed (B, 4|7) uint32 wire array
+        (PacketBatch.pack_wire_subset): the daemon's hot loop skips the
+        9-array subset copy entirely.  Caller contract: supports_packed()
+        is True for the current table generation; kind is recovered from
+        wire w0 for the host-side XDP rebuild."""
+        with self._lock:
+            if self._active is None:
+                raise RuntimeError("no rule tables loaded")
+            path, dev, block_b, wide_rids = self._active
+        if wide_rids:
+            raise RuntimeError(
+                "wide-ruleId tables need the full-batch path (supports_packed)"
+            )
+        kind = (wire_np[:, 0] & 3).astype(np.int32)
+        return self._dispatch_wire(
+            path, dev, block_b, wire_np, v4_only, kind, apply_stats
+        )
+
+    def _dispatch_wire(
+        self, path, dev, block_b, wire_np, v4_only, kind, apply_stats
+    ) -> PendingClassify:
         wire = jax.device_put(wire_np, self._device)
         # Fused single-buffer output: results + stats come back in ONE
         # D2H materialization (jaxpath.fuse_wire_outputs) — each readback
@@ -145,7 +179,7 @@ class TpuClassifier:
             fused.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
-        n = len(batch)
+        n = wire_np.shape[0]
 
         def materialize() -> ClassifyOutput:
             res16, stats = jaxpath.split_wire_outputs(np.asarray(fused), n)

@@ -494,20 +494,35 @@ class Daemon:
                 except Exception as e:
                     log.error("ingest finalize failed for %s: %s", fn, e)
                 continue
+            # Packed fast path: parse -> wire descriptors in one native
+            # pass per chunk (no 9-array subset copy); backends without
+            # the packed entry point (CPU ref, wide-ruleId tables) take
+            # the composed take()+classify_async path.
+            packed_ok = (
+                getattr(clf, "supports_packed", None) is not None
+                and clf.supports_packed()
+            )
             for idx in chunks:
                 if fctx["failed"]:
                     # dispatching more chunks of a poisoned file is wasted
                     # device work — their results would be discarded
                     fctx["remaining"] -= 1
                     continue
-                sub = batch.take(idx)
                 while len(inflight) >= self.pipeline_depth:
                     drain_one()
                 try:
                     # Eager backends (CPU ref) raise HERE, not in .result();
                     # the failure must still poison only this file, never
                     # abort the tick and starve later-sorted files.
-                    pending = clf.classify_async(sub, apply_stats=False)
+                    if packed_ok:
+                        wire, v4_only = batch.pack_wire_subset(idx)
+                        pending = clf.classify_async_packed(
+                            wire, v4_only, apply_stats=False
+                        )
+                    else:
+                        pending = clf.classify_async(
+                            batch.take(idx), apply_stats=False
+                        )
                 except Exception as e:
                     fctx["failed"] = True
                     fctx["remaining"] -= 1

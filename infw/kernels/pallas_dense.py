@@ -58,15 +58,16 @@ NUM_FIELDS = 8    # rid_act, proto, ps_hi, ps_lo, pe_hi, pe_lo, itype, icode
 KEY_BITS = 160
 MAX_DENSE_TARGETS = 4096
 # Measured on v5e (100K rule entries = 1000 CIDRs x 100 rules): int8 MXU
-# path 17.1ms/2^20 packets vs bf16 22.6ms; block 512 beats 256 (better MXU
-# utilization), 2048 exceeds the 16MB scoped-VMEM limit.
+# path beats bf16 (17.1 vs 22.6 ms/2^20 at block 256); block sweep gives
+# 256: 67.0, 512: 74.7, 1024: 78.6 M pkts/s; 2048 exceeds the 16MB
+# scoped-VMEM limit (the (Bb, Tp) i32 mismatch + rule-row blocks double).
 DEFAULT_DTYPE = "int8"
 
 
 def choose_block_b(num_targets_padded: int) -> int:
     """Largest packet block that keeps the kernel inside scoped VMEM for
     the given (padded) target count."""
-    return 512 if num_targets_padded <= 1024 else BLOCK_B
+    return 1024 if num_targets_padded <= 1024 else BLOCK_B
 
 
 class PallasTables(NamedTuple):

@@ -43,6 +43,52 @@ def test_tpu_backend_trie_path():
     clf.close()
 
 
+@pytest.mark.parametrize("path", ["dense", "trie"])
+def test_classify_async_packed_matches_unpacked(path):
+    """The daemon's packed fast path (pack_wire_subset ->
+    classify_async_packed) must be verdict/xdp/stats-identical to the
+    composed take()+classify_async on every subset family shape,
+    for both device paths."""
+    rng = np.random.default_rng(27)
+    tables = testing.random_tables(rng, n_entries=60, width=10)
+    batch = testing.random_batch(rng, tables, n_packets=600)
+    clf = TpuClassifier(force_path=path)
+    clf.load_tables(tables)
+    assert clf.supports_packed()
+    kinds = np.asarray(batch.kind)
+    subsets = [
+        np.nonzero(kinds != 2)[0],           # daemon's non-v6 group
+        np.nonzero(kinds == 2)[0],           # v6 group
+        np.random.default_rng(1).permutation(len(batch)),
+    ]
+    for idx in subsets:
+        if not len(idx):
+            continue
+        idx = np.ascontiguousarray(idx, np.int64)
+        want = clf.classify_async(batch.take(idx), apply_stats=False).result()
+        wire, v4_only = batch.pack_wire_subset(idx)
+        got = clf.classify_async_packed(wire, v4_only, apply_stats=False).result()
+        np.testing.assert_array_equal(got.results, want.results)
+        np.testing.assert_array_equal(got.xdp, want.xdp)
+        np.testing.assert_array_equal(got.stats_delta, want.stats_delta)
+    clf.close()
+
+
+def test_classify_async_packed_rejected_on_wide_rids():
+    """Tables whose ruleIds exceed the wire format must refuse the packed
+    entry point (supports_packed gates the daemon)."""
+    rows = np.zeros((2, 7), np.int32)
+    rows[0] = [3000, 6, 80, 0, 0, 0, 1]  # ruleId 3000 > 255 -> wide path
+    content = {LpmKey(32, 2, bytes(16)): rows}
+    tables = compile_tables_from_content(content, rule_width=2)
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    assert not clf.supports_packed()
+    with pytest.raises(RuntimeError):
+        clf.classify_async_packed(np.zeros((1, 7), np.uint32), True)
+    clf.close()
+
+
 @pytest.mark.parametrize("make", [CpuRefClassifier, TpuClassifier], ids=["cpp", "tpu"])
 def test_stats_accumulate_across_batches(make):
     rows = np.zeros((4, 7), np.int32)

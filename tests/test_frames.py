@@ -139,6 +139,55 @@ def test_parse_native_threaded_matches_numpy():
         )
 
 
+def _subset_cases(batch):
+    kinds = np.asarray(batch.kind)
+    rng = np.random.default_rng(3)
+    return {
+        "non-v6": np.nonzero(kinds != 2)[0],
+        "v6": np.nonzero(kinds == 2)[0],
+        "mixed-shuffled": rng.permutation(len(batch)),
+    }
+
+
+@needs_native
+def test_pack_wire_subset_native_matches_fallback():
+    """The fused native take+pack must emit byte-identical wire arrays
+    and the same (compact, v4_only) decisions as the composed NumPy path
+    on every subset shape the daemon dispatches."""
+    import infw.packets as packets
+
+    rng = np.random.default_rng(14)
+    tables = testing.random_tables_fast(rng, n_entries=300, width=8)
+    batch = testing.random_batch_fast(rng, tables, n_packets=120_000)
+    for name, idx in _subset_cases(batch).items():
+        if not len(idx):
+            continue
+        got_wire, got_v4 = batch._pack_wire_subset_native(
+            np.ascontiguousarray(idx, np.int64)
+        )
+        sub = batch.take(idx)
+        compact = sub.is_v4_compactable()
+        want_wire = sub.pack_wire_v4() if compact else sub.pack_wire()
+        want_v4 = not bool((np.asarray(sub.kind) == 2).any())
+        assert got_wire.shape == want_wire.shape, name
+        np.testing.assert_array_equal(got_wire, want_wire, err_msg=name)
+        assert got_v4 == want_v4, name
+
+
+def test_pack_wire_subset_fallback_when_native_off(monkeypatch):
+    import infw.packets as packets
+
+    monkeypatch.setattr(packets, "_native_pack_unavailable", True)
+    rng = np.random.default_rng(15)
+    tables = testing.random_tables_fast(rng, n_entries=50, width=4)
+    batch = testing.random_batch_fast(rng, tables, n_packets=500)
+    idx = np.arange(len(batch))
+    wire, v4_only = batch.pack_wire_subset(idx)
+    sub = batch.take(idx)
+    want = sub.pack_wire_v4() if sub.is_v4_compactable() else sub.pack_wire()
+    np.testing.assert_array_equal(wire, want)
+
+
 def test_parse_frames_buf_empty():
     got = parse_frames_buf(FramesBuf.from_frames([], []))
     assert len(got) == 0
