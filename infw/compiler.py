@@ -555,11 +555,22 @@ class VarTrie:
                 seq,
             )
 
-    def arrays(self, max_ifindex: int) -> Tuple[List[np.ndarray], np.ndarray]:
+    def arrays(
+        self, max_ifindex: int, consume: bool = False
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Device-layout level tables.  ``consume=True`` shrinks the
+        growth buffers in place and hands them out directly — zero copy
+        of the (multi-GB at 1M entries) node arrays — and leaves the trie
+        unusable for further inserts; only for builders about to be
+        dropped (the one-shot compile_tables_from_content path)."""
         levels = []
         for l in range(self.n_levels):
             n = self.n_nodes[l] * self._slots(l)
-            levels.append(self._ct[l][:n].copy())
+            if consume:
+                self._ct[l].resize((n, 2), refcheck=False)
+                levels.append(self._ct[l])
+            else:
+                levels.append(self._ct[l][:n].copy())
         root_lut = np.zeros(max_ifindex + 1, np.int32)
         for ifindex, node in self.roots.items():
             root_lut[ifindex] = node
@@ -608,6 +619,7 @@ class IncrementalTables:
         self.trie = VarTrie(n_levels)
         self._cap = 0
         self._size = 0
+        self._consumed = False
         self._key_words = np.zeros((0, 5), np.uint32)
         self._mask_words = np.zeros((0, 5), np.uint32)
         self._mask_len = np.zeros(0, np.int32)
@@ -634,36 +646,40 @@ class IncrementalTables:
     ) -> "IncrementalTables":
         # Deduplicate by masked identity, later entries replacing earlier
         # ones — what successive Map.Update calls do on the kernel trie.
+        # The identity is computed once per key and threaded through every
+        # later loop (3 masked-identity passes over 1M keys were ~15% of
+        # the whole compile).
         dedup: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray]] = {}
         for key, rules in content.items():
             _validate_key(key)
             dedup[key.masked_identity()] = (key, rules)
-        entries = list(dedup.values())
+        entries = list(dedup.items())
         T = len(entries)
         R = rule_width
 
-        max_mask = max((k.mask_len for k, _ in entries), default=0)
+        max_mask = max((k.mask_len for _, (k, _r) in entries), default=0)
         self = cls(R, max(trie_levels_for_mask(max_mask), min_trie_levels))
 
         ifindex = np.fromiter(
-            (k.ingress_ifindex for k, _ in entries), np.int64, count=T
+            (k.ingress_ifindex for _, (k, _r) in entries), np.int64, count=T
         )
-        mask_len = np.fromiter((k.mask_len for k, _ in entries), np.int64, count=T)
+        mask_len = np.fromiter(
+            (k.mask_len for _, (k, _r) in entries), np.int64, count=T
+        )
         ip = (
             np.frombuffer(
-                b"".join(k.masked_identity()[2] for k, _ in entries), np.uint8
+                b"".join(ident[2] for ident, _ in entries), np.uint8
             ).reshape(T, 16)
             if T
             else np.zeros((0, 16), np.uint8)
         )
         rules_t = np.zeros((T, R, RULE_COLS), np.int32)
-        for t, (_, rows) in enumerate(entries):
+        for t, (_, (_k, rows)) in enumerate(entries):
             rows = np.asarray(rows, np.int32)
             rules_t[t, : min(rows.shape[0], R)] = rows[:R]
 
         self._bulk_init(ifindex, ip, mask_len, rules_t)
-        for t, (key, _) in enumerate(entries):
-            ident = key.masked_identity()
+        for t, (ident, (key, _r)) in enumerate(entries):
             self._ident_to_t[ident] = t
             self._ident_to_key[ident] = key
         self.content = dict(content)
@@ -741,6 +757,11 @@ class IncrementalTables:
         """purgeKeys + addOrUpdateRules granularity: deletes tombstone and
         node-local re-push; same-identity upserts patch the rule rows in
         place; new keys fill tombstoned slots or append."""
+        if self._consumed:
+            raise CompileError(
+                "tables were snapshot(consume=True)d; the snapshot owns "
+                "the buffers — build a fresh IncrementalTables"
+            )
         # Validate everything before the first mutation so a bad key leaves
         # this long-lived instance untouched (the throwaway full-compile
         # path got that atomicity for free).
@@ -855,21 +876,41 @@ class IncrementalTables:
 
     # -- packing -------------------------------------------------------------
 
-    def snapshot(self) -> CompiledTables:
+    def snapshot(self, consume: bool = False) -> CompiledTables:
+        """Immutable CompiledTables from the current state.
+
+        ``consume=True`` skips every defensive copy by shrinking the
+        growth buffers in place and handing them to the snapshot — for
+        builders that are dropped right after (the one-shot
+        compile_tables_from_content path, where the copies were ~half of
+        a 1M-entry compile).  The builder must not be mutated again."""
+        if self._consumed:
+            raise CompileError(
+                "tables were snapshot(consume=True)d; buffers are gone"
+            )
         T = self._size
         n = max(T, 1)
         self._ensure_cap(n)  # empty tables keep one zeroed padding row
-        trie_levels, root_lut = self.trie.arrays(self._max_ifindex)
+        if consume:
+            self._consumed = True
+        trie_levels, root_lut = self.trie.arrays(self._max_ifindex, consume=consume)
+
+        def take(a: np.ndarray) -> np.ndarray:
+            if not consume:
+                return a[:n].copy()
+            a.resize((n,) + a.shape[1:], refcheck=False)
+            return a
+
         return CompiledTables(
             rule_width=self.rule_width,
             num_entries=T,
-            key_words=self._key_words[:n].copy(),
-            mask_words=self._mask_words[:n].copy(),
-            mask_len=self._mask_len[:n].copy(),
-            rules=self._rules[:n].copy(),
+            key_words=take(self._key_words),
+            mask_words=take(self._mask_words),
+            mask_len=take(self._mask_len),
+            rules=take(self._rules),
             trie_levels=trie_levels,
             root_lut=root_lut,
-            content=dict(self.content),
+            content=self.content if consume else dict(self.content),
         )
 
 
@@ -891,4 +932,4 @@ def compile_tables_from_content(
     rules-shard compiles to the same static depth."""
     return IncrementalTables.from_content(
         content, rule_width=rule_width, min_trie_levels=min_trie_levels
-    ).snapshot()
+    ).snapshot(consume=True)

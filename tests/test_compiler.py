@@ -385,3 +385,20 @@ def test_apply_atomic_on_invalid_key():
     after = it.snapshot()
     assert set(after.content) == set(before.content)
     np.testing.assert_array_equal(after.mask_len, before.mask_len)
+
+
+def test_consumed_snapshot_guards_mutation():
+    """snapshot(consume=True) hands the buffers to the snapshot; any
+    further use of the builder must fail loudly, never silently corrupt
+    the supposedly immutable CompiledTables."""
+    from infw.compiler import CompileError, IncrementalTables
+
+    rng = np.random.default_rng(66)
+    content = _random_content(rng, 20)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    snap = it.snapshot(consume=True)
+    assert snap.num_entries == len(snap.content)
+    with pytest.raises(CompileError):
+        it.apply(_random_content(rng, 1))
+    with pytest.raises(CompileError):
+        it.snapshot()
