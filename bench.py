@@ -303,6 +303,49 @@ def bench_adversarial_1m(rng, on_tpu):
     )
 
 
+# --- incremental rule-update latency --------------------------------------
+
+
+def bench_incremental_update(rng, on_tpu):
+    """1-key rule edit -> device latency at 100K entries: the Map.Update
+    analogue (loader.go:200-218).  The patch path diffs host tables and
+    ships only changed rows; a full reload re-uploads the whole table."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import IncrementalTables
+
+    n_entries = 100_000 if on_tpu else 2_000
+    tables = testing.random_tables_fast(rng, n_entries=n_entries, width=8,
+                                        ifindexes=(2, 3, 4))
+    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    clf = TpuClassifier(force_path="trie")
+    t0 = time.perf_counter()
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()  # device baseline established
+    t_full = time.perf_counter() - t0
+    log(f"update: full load @{n_entries}: {t_full:.2f}s")
+    keys = list(it.content)
+    lats = []
+    for i in range(5):
+        key = keys[1000 + i]
+        rows = it.content[key].copy()
+        rows[0, 6] = 1 if rows[0, 6] == 2 else 2
+        t0 = time.perf_counter()
+        it.apply({key: rows})
+        clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+        lats.append(time.perf_counter() - t0)
+        mode, n_rows = clf._last_load
+        log(f"update {i}: {lats[-1]*1e3:.0f} ms mode={mode} rows={n_rows}")
+        assert mode == "patch", "patch path must engage for 1-key edits"
+    med = sorted(lats)[len(lats) // 2]
+    emit(
+        f"1-key rule update to device @{n_entries // 1000}K entries "
+        f"(incremental diff-scatter patch; full reload {t_full:.1f}s)",
+        med * 1e3, "ms", vs_baseline=t_full / med,
+    )
+    clf.close()
+
+
 # --- wire-path p50 latency -------------------------------------------------
 
 
@@ -414,6 +457,10 @@ def main():
         bench_adversarial_1m(rng, on_tpu)
     except Exception as e:
         log(f"adv1m FAILED: {e}")
+    try:
+        bench_incremental_update(rng, on_tpu)
+    except Exception as e:
+        log(f"incremental update FAILED: {e}")
 
     try:
         tables, batch, thr = bench_dense_headline(rng, on_tpu)

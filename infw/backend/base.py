@@ -76,8 +76,13 @@ class StatsAccumulator:
 class Classifier(Protocol):
     """One-per-node dataplane program."""
 
-    def load_tables(self, tables: CompiledTables) -> None:
-        """Swap in a newly compiled ruleset (idempotent, atomic)."""
+    def load_tables(self, tables: CompiledTables, dirty_hint=None) -> None:
+        """Swap in a newly compiled ruleset (idempotent, atomic).
+
+        ``dirty_hint`` (IncrementalTables.peek_dirty() or None) is an
+        optional superset of the rows changed since the previous load —
+        device backends use it to patch in place instead of re-uploading;
+        others ignore it."""
         ...
 
     def classify(self, batch: PacketBatch, apply_stats: bool = True) -> ClassifyOutput:

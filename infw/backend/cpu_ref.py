@@ -99,7 +99,9 @@ class CpuRefClassifier:
         self._packed = None
         self._closed = False
 
-    def load_tables(self, tables: CompiledTables) -> None:
+    def load_tables(self, tables: CompiledTables, dirty_hint=None) -> None:
+        # dirty_hint is a device-patch acceleration; the CPU backend's
+        # full repack is already cheap, so it is accepted and ignored.
         if self._closed:
             raise RuntimeError("classifier is closed")
         T = tables.num_entries

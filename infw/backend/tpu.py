@@ -55,11 +55,18 @@ class TpuClassifier:
         self._stats = StatsAccumulator()
         self._tables: Optional[CompiledTables] = None
         self._active = None  # (path, device tables, block_b or None, wide_rids)
+        self._last_load = None  # ("patch"|"full", rows) — introspection/tests
         self._closed = False
 
     # -- rule loading -------------------------------------------------------
 
-    def load_tables(self, tables: CompiledTables) -> None:
+    def load_tables(self, tables: CompiledTables, dirty_hint=None) -> None:
+        """Swap in a newly compiled ruleset.
+
+        ``dirty_hint`` (IncrementalTables.peek_dirty()) accelerates the
+        incremental device patch: with it, the patch scatters exactly the
+        hinted rows with NO full-table host diff — a 1-key edit costs a
+        couple of small transfers regardless of table size."""
         if self._closed:
             raise RuntimeError("classifier is closed")
         path = self._force_path or (
@@ -81,6 +88,7 @@ class TpuClassifier:
         if path == "dense":
             dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
             block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
+            self._last_load = ("full", tables.num_entries)
         else:
             try:
                 jaxpath.check_wire_ruleids(tables)
@@ -88,7 +96,36 @@ class TpuClassifier:
                 # ruleIds > 255: the 2B wire result can't carry them —
                 # fall back to the u32 (non-wire) classify path.
                 wide_rids = True
-            dev = jaxpath.device_tables(tables, self._device)
+            dev = None
+            with self._lock:
+                prev_tables, prev_active = self._tables, self._active
+            if (
+                prev_tables is not None
+                and prev_active is not None
+                and prev_active[0] == "trie"
+            ):
+                # Incremental device patch (the Map.Update analogue):
+                # ship only the rows that changed since the resident
+                # generation; falls back to a full upload on structural
+                # change (trie growth, compaction, path flip).
+                patched = jaxpath.patch_device_tables(
+                    prev_active[1], prev_tables, tables, self._device,
+                    hint=dirty_hint,
+                )
+                if patched is None and dirty_hint is not None:
+                    # hint didn't apply (bucket growth / oversized delta):
+                    # try the diff-based patch before a full re-upload
+                    patched = jaxpath.patch_device_tables(
+                        prev_active[1], prev_tables, tables, self._device
+                    )
+                if patched is not None:
+                    dev, n_rows = patched
+                    self._last_load = ("patch", n_rows)
+            if dev is None:
+                # pad=True buckets device row counts so later small edits
+                # keep array shapes and can take the patch path
+                dev = jaxpath.device_tables(tables, self._device, pad=True)
+                self._last_load = ("full", tables.num_entries)
             block_b = None
         with self._lock:
             self._tables = tables

@@ -415,6 +415,30 @@ class VarTrie:
             self._prio.append(np.zeros(2 * slots, np.int64))
             self.n_nodes.append(1)
         self.roots: Dict[int, int] = {}
+        # Dirty-row tracking (None = off): per-level lists of slot-row
+        # index arrays written since the last drain — a SUPERSET of the
+        # rows whose values changed, which is exactly what the device
+        # patch path needs (it scatters current values for hinted rows).
+        self._dirty_rows: Optional[List[List[np.ndarray]]] = None
+
+    def start_dirty_tracking(self) -> None:
+        self._dirty_rows = [[] for _ in range(self.n_levels)]
+
+    def _record_rows(self, level: int, rows: np.ndarray) -> None:
+        if self._dirty_rows is not None:
+            self._dirty_rows[level].append(np.asarray(rows, np.int64))
+
+    def drain_dirty(self) -> Optional[List[np.ndarray]]:
+        """Per-level unique written rows since tracking (re)started, or
+        None when tracking is off.  Does NOT clear — callers clear via
+        start_dirty_tracking() once the consumer (device patch) has
+        definitely applied them."""
+        if self._dirty_rows is None:
+            return None
+        return [
+            np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
+            for parts in self._dirty_rows
+        ]
 
     def _slots(self, level: int) -> int:
         return 1 << self.strides[level]
@@ -494,6 +518,7 @@ class VarTrie:
                 self._ct[l - 1][uniq_codes, 0] = first + np.arange(
                     len(uniq_codes), dtype=np.int32
                 )
+                self._record_rows(l - 1, uniq_codes)
                 existing = self._ct[l - 1][code, 0]
             parent[reach] = existing
             term_node = np.where(t_level == l, parent, term_node)
@@ -528,6 +553,7 @@ class VarTrie:
         np.maximum.at(self._prio[level], flat, prio[rep])
         won = self._prio[level][flat] == prio[rep]
         self._ct[level][flat[won], 1] = (target.astype(np.int32) + 1)[rep[won]]
+        self._record_rows(level, flat[won])
 
     def repush_node(
         self,
@@ -545,6 +571,7 @@ class VarTrie:
         sl = slice(node * slots, (node + 1) * slots)
         self._ct[level][sl, 1] = 0
         self._prio[level][sl] = 0
+        self._record_rows(level, np.arange(sl.start, sl.stop, dtype=np.int64))
         if len(target):
             self._leaf_push(
                 level,
@@ -620,6 +647,8 @@ class IncrementalTables:
         self._cap = 0
         self._size = 0
         self._consumed = False
+        self._dirty_t: Optional[List[np.ndarray]] = None  # None = off
+        self._dirty_invalid = False
         self._key_words = np.zeros((0, 5), np.uint32)
         self._mask_words = np.zeros((0, 5), np.uint32)
         self._mask_len = np.zeros(0, np.int32)
@@ -683,7 +712,49 @@ class IncrementalTables:
             self._ident_to_t[ident] = t
             self._ident_to_key[ident] = key
         self.content = dict(content)
+        # Long-lived instances track dirty rows from here so the device
+        # patch path can skip the full-table diff.  The hint stays
+        # INVALID until the first clear_dirty(): hints are deltas against
+        # a device generation, and no device has consumed this (re)build
+        # yet — an empty hint against an older resident table would
+        # silently patch nothing.
+        self.start_dirty_tracking()
+        self._dirty_invalid = True
         return self
+
+    # -- dirty hints (device patch acceleration) -----------------------------
+
+    def start_dirty_tracking(self) -> None:
+        self._dirty_t = []
+        self._dirty_invalid = False
+        self.trie.start_dirty_tracking()
+
+    def _record_t(self, t) -> None:
+        if self._dirty_t is not None:
+            self._dirty_t.append(np.atleast_1d(np.asarray(t, np.int64)))
+
+    def peek_dirty(self) -> Optional[Dict]:
+        """Accumulated dirty rows since the last clear_dirty(), as
+        {"dense": rows, "levels": [rows per level]} — a SUPERSET of
+        changed rows, for jaxpath.patch_device_tables.  None when
+        unavailable (tracking off, or invalidated by a compaction whose
+        row layout no longer matches the device's).  Callers clear only
+        after the device consumer has definitely applied them, so a
+        failed load keeps accumulating."""
+        if self._dirty_t is None or self._dirty_invalid:
+            return None
+        levels = self.trie.drain_dirty()
+        if levels is None:
+            return None
+        dense = (
+            np.unique(np.concatenate(self._dirty_t))
+            if self._dirty_t
+            else np.zeros(0, np.int64)
+        )
+        return {"dense": dense, "levels": levels}
+
+    def clear_dirty(self) -> None:
+        self.start_dirty_tracking()
 
     def _ensure_cap(self, n: int) -> None:
         if n <= self._cap:
@@ -788,6 +859,7 @@ class IncrementalTables:
             self._mask_words[t] = 0
             self._rules[t] = 0
             self._free.append(t)
+            self._record_t(t)
             dirty_nodes.add((int(self._term_level[t]), int(self._term_node[t])))
         for level, node in dirty_nodes:
             m = (
@@ -816,6 +888,7 @@ class IncrementalTables:
             if t is not None:
                 # in-place rule patch; LPM structure unchanged
                 self._rules[t] = padded
+                self._record_t(t)
                 old_key = self._ident_to_key[ident]
                 if old_key != key:
                     self.content.pop(old_key, None)
@@ -850,6 +923,7 @@ class IncrementalTables:
         lv, nd = self.trie.batch_insert(ifindex, ip, mask_len, t_ids, seq)
         self._term_level[t_ids] = lv
         self._term_node[t_ids] = nd
+        self._record_t(t_ids)
         self._max_ifindex = max(self._max_ifindex, int(ifindex.max()))
         for i, (ident, (key, rows, _)) in enumerate(new_by_ident.items()):
             self._ident_to_t[ident] = int(t_ids[i])
@@ -872,6 +946,10 @@ class IncrementalTables:
             min_trie_levels=self.trie.n_levels,
         )
         self.__dict__.update(fresh.__dict__)
+        # The device still holds the pre-compaction layout: row-level
+        # hints are meaningless across the rebuild.  clear_dirty() (after
+        # the consumer's full reload) re-validates.
+        self._dirty_invalid = True
         return True
 
     # -- packing -------------------------------------------------------------

@@ -81,21 +81,268 @@ class DeviceBatch(NamedTuple):
     pkt_len: jax.Array    # (B,) int32
 
 
-def device_tables(tables: CompiledTables, device=None) -> DeviceTables:
-    put = lambda a: jax.device_put(jnp.asarray(a), device)
-    # Padding rows get the mask_len == -1 sentinel so the dense match can
-    # exclude them without a separate entry count (keeps every array
-    # shardable along the target axis).
+def _row_bucket(n: int) -> int:
+    """Bucketed device row count: small tables round to the next power of
+    two, large ones to 4096-row chunks — so a few appended entries keep
+    every device array shape (and thus the jit cache AND the incremental
+    patch path) stable."""
+    if n <= 0:
+        return 8
+    if n <= 4096:
+        return max(8, 1 << (n - 1).bit_length())
+    return -(-n // 4096) * 4096
+
+
+def _pad_rows(a: np.ndarray, n_rows: int, fill=0) -> np.ndarray:
+    if a.shape[0] >= n_rows:
+        return a
+    if fill == 0:
+        # np.zeros is calloc — lazily mapped zero pages, no write pass
+        # (np.full memsets the whole multi-GB buffer; measured 20+s across
+        # a large table's padded layouts)
+        out = np.zeros((n_rows,) + a.shape[1:], a.dtype)
+    else:
+        out = np.empty((n_rows,) + a.shape[1:], a.dtype)
+        out[a.shape[0] :] = fill
+    out[: a.shape[0]] = a
+    return out
+
+
+def _host_device_layout(tables: CompiledTables, pad: bool):
+    """Host-side arrays in the exact layout device_tables uploads:
+    mask_len sentinel applied, rows bucket-padded when ``pad``.  Shared by
+    device_tables and patch_device_tables so a patched device state is
+    bit-identical to a fresh upload."""
     mask_len = tables.mask_len.copy()
     mask_len[tables.num_entries :] = -1
+    # copy=False: the compiler already stores these as uint32; a blind
+    # astype would copy the full arrays on every patch diff
+    key_words = tables.key_words.astype(np.uint32, copy=False)
+    mask_words = tables.mask_words.astype(np.uint32, copy=False)
+    rules = tables.rules
+    trie_levels = list(tables.trie_levels)
+    root_lut = tables.root_lut
+    if pad:
+        n = _row_bucket(mask_len.shape[0])
+        key_words = _pad_rows(key_words, n)
+        mask_words = _pad_rows(mask_words, n)
+        mask_len = _pad_rows(mask_len, n, fill=-1)  # padding rows are inert
+        rules = _pad_rows(rules, n)
+        # level padding rows are unreachable (node ids only reach
+        # allocated nodes) and zero = [no child, no target] anyway
+        trie_levels = [_pad_rows(l, _row_bucket(l.shape[0])) for l in trie_levels]
+        root_lut = _pad_rows(root_lut, _row_bucket(root_lut.shape[0]))
+    return key_words, mask_words, mask_len, rules, trie_levels, root_lut
+
+
+def device_tables(
+    tables: CompiledTables, device=None, pad: bool = False
+) -> DeviceTables:
+    """Upload to device.  ``pad=True`` buckets row counts (see
+    _row_bucket) — used by the long-lived classifier so incremental table
+    edits keep array shapes, enabling patch_device_tables and avoiding
+    per-size jit recompiles.  Padding rows carry the mask_len == -1
+    sentinel so the dense match excludes them without a separate entry
+    count (and every array stays shardable along the target axis)."""
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+    key_words, mask_words, mask_len, rules, trie_levels, root_lut = (
+        _host_device_layout(tables, pad)
+    )
     return DeviceTables(
-        key_words=put(tables.key_words.astype(np.uint32)),
-        mask_words=put(tables.mask_words.astype(np.uint32)),
+        key_words=put(key_words),
+        mask_words=put(mask_words),
         mask_len=put(mask_len),
-        rules=put(tables.rules),
-        trie_levels=tuple(put(tbl) for tbl in tables.trie_levels),
-        root_lut=put(tables.root_lut),
+        rules=put(rules),
+        trie_levels=tuple(put(tbl) for tbl in trie_levels),
+        root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_rows_jit():
+    # NOT donated on purpose: in-flight classify dispatches hold the old
+    # table handles, and the double-buffer contract says they finish on
+    # the old generation.  XLA materializes copy-then-scatter on device —
+    # a full-table HBM copy is milliseconds; what the patch saves is the
+    # host->device transfer of the unchanged gigabytes.
+    return jax.jit(lambda a, idx, rows: a.at[idx].set(rows))
+
+
+def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0):
+    """Scatter-patch one bucket-padded device array from the host diff of
+    its UNPADDED old/new sources (no padded copies are materialized —
+    np.full of multi-GB pad layouts was 20+s per patch).  Appended rows
+    scatter their new values; rows the table shrank away from reset to
+    the pad fill, keeping the device state bit-identical to a fresh
+    ``pad=True`` upload.  Returns (patched_or_original_array,
+    rows_changed) or None when the dtype/trailing dims/row bucket changed
+    (caller re-uploads)."""
+    if old_np.dtype != new_np.dtype or old_np.shape[1:] != new_np.shape[1:]:
+        return None
+    nb = dev_arr.shape[0]
+    if (
+        tuple(dev_arr.shape[1:]) != new_np.shape[1:]
+        or _row_bucket(new_np.shape[0]) != nb
+        or _row_bucket(old_np.shape[0]) != nb
+    ):
+        return None
+    no, nn = old_np.shape[0], new_np.shape[0]
+    common = min(no, nn)
+    changed = np.nonzero(
+        (
+            old_np[:common].reshape(common, -1)
+            != new_np[:common].reshape(common, -1)
+        ).any(axis=1)
+    )[0]
+    parts_idx = [changed]
+    parts_rows = [new_np[changed]]
+    if nn > no:
+        parts_idx.append(np.arange(no, nn))
+        parts_rows.append(new_np[no:])
+    elif no > nn:
+        parts_idx.append(np.arange(nn, no))
+        parts_rows.append(
+            np.full((no - nn,) + new_np.shape[1:], fill, new_np.dtype)
+        )
+    idx = np.concatenate(parts_idx)
+    rows = np.concatenate(parts_rows)
+    k = len(idx)
+    if k == 0:
+        return dev_arr, 0
+    if k > nb // 4:
+        # Large delta: a bucketed scatter would ship close to the full
+        # array AND pay the device-side copy — the full upload wins.
+        return None
+    # Bucket the scatter size to the next power of two (pad by repeating
+    # the last row — duplicate indices with identical values are a
+    # deterministic no-op) so the jit cache stays bounded.
+    cap = min(1 << max(3, (k - 1).bit_length()), nb)
+    pidx = np.empty(cap, np.int64)
+    pidx[:k] = idx
+    pidx[k:] = idx[-1]
+    prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
+    prows[:k] = rows
+    prows[k:] = rows[-1]
+    return _scatter(dev_arr, pidx, prows, device), k
+
+
+def _scatter(dev_arr, pidx: np.ndarray, prows: np.ndarray, device):
+    return _scatter_rows_jit()(
+        dev_arr, jax.device_put(pidx, device), jax.device_put(prows, device)
+    )
+
+
+def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
+    """Hint-mode patch: scatter ``new_np[rows]`` without any host diff.
+    ``rows`` must be a SUPERSET of the rows whose values changed (the
+    compiler's dirty tracking guarantees this); unchanged hinted rows
+    rewrite their identical value.  Returns (array, k) or None when the
+    bucket/dtype no longer matches or the hint is too large to win."""
+    nb = dev_arr.shape[0]
+    if (
+        tuple(dev_arr.shape[1:]) != new_np.shape[1:]
+        or _row_bucket(new_np.shape[0]) != nb
+    ):
+        return None
+    rows = rows[rows < new_np.shape[0]]
+    k = len(rows)
+    if k == 0:
+        return dev_arr, 0
+    if k > nb // 4:
+        return None
+    cap = min(1 << max(3, (k - 1).bit_length()), nb)
+    pidx = np.empty(cap, np.int64)
+    pidx[:k] = rows
+    pidx[k:] = rows[-1]
+    return _scatter(dev_arr, pidx, new_np[pidx], device), k
+
+
+def patch_device_tables(
+    dev: DeviceTables,
+    old: CompiledTables,
+    new: CompiledTables,
+    device=None,
+    hint=None,
+) -> Tuple[DeviceTables, int] | None:
+    """Incremental device update — the TPU-native Map.Update
+    (/root/reference/pkg/ebpf/ingress_node_firewall_loader.go:200-218,
+    where a one-CIDR edit touches one kernel map key): diff the old/new
+    host tables row-wise (in the padded device layout, so the patched
+    state is bit-identical to a fresh ``device_tables(new, pad=True)``
+    upload) and ship ONLY the changed rows, scattering them into a
+    device-side copy of the resident arrays.  A one-key edit at 1M
+    entries uploads kilobytes instead of the ~3.4GB full table.
+
+    ``dev`` must have been built with ``pad=True``.  Returns
+    (new DeviceTables, total_rows_changed), or None when the structure
+    changed beyond the row buckets (level count, bucket growth,
+    compaction shrink past a bucket) and the caller re-uploads in full."""
+    if len(dev.trie_levels) != len(new.trie_levels) or len(
+        old.trie_levels
+    ) != len(new.trie_levels):
+        return None
+    o = _host_device_layout(old, pad=False)
+    nw = _host_device_layout(new, pad=False)
+    # only trie levels / root_lut go through put: pad fill is 0 for both
+    put = lambda a: jax.device_put(
+        jnp.asarray(_pad_rows(a, _row_bucket(a.shape[0]))), device
+    )
+    total = 0
+
+    hint_levels = hint["levels"] if hint is not None else None
+    if hint_levels is not None and len(hint_levels) != len(dev.trie_levels):
+        # check before any device work so a stale hint wastes no scatters
+        return None
+    dense = []
+    for dl, ol, nl, fill in zip(
+        (dev.key_words, dev.mask_words, dev.mask_len, dev.rules),
+        o[:4],
+        nw[:4],
+        (0, 0, -1, 0),
+    ):
+        if hint is not None:
+            p = _patch_array_rows(dl, nl, hint["dense"], device)
+        else:
+            p = _patch_array(dl, ol, nl, device, fill=fill)
+        if p is None:
+            return None
+        dense.append(p[0])
+        total += p[1]
+    levels = []
+    for i, (dl, ol, nl) in enumerate(zip(dev.trie_levels, o[4], nw[4])):
+        if hint_levels is not None:
+            p = _patch_array_rows(dl, nl, hint_levels[i], device)
+        else:
+            p = _patch_array(dl, ol, nl, device)
+        if p is None:
+            # this level's bucket changed (or the delta is too large):
+            # re-upload just this level
+            levels.append(put(nl))
+            total += len(nl)
+        else:
+            levels.append(p[0])
+            total += p[1]
+    p = _patch_array(dev.root_lut, o[5], nw[5], device)
+    if p is None:
+        root_lut = put(nw[5])
+        total += len(nw[5])
+    else:
+        root_lut, k = p
+        total += k
+    return (
+        DeviceTables(
+            key_words=dense[0],
+            mask_words=dense[1],
+            mask_len=dense[2],
+            rules=dense[3],
+            trie_levels=tuple(levels),
+            root_lut=root_lut,
+            num_entries=jax.device_put(
+                jnp.asarray(np.int32(new.num_entries)), device
+            ),
+        ),
+        total,
     )
 
 

@@ -352,7 +352,15 @@ class DataplaneSyncer:
                 desired, rule_width=width
             )
         tables = self._updater.snapshot()
-        self._classifier.load_tables(tables)
+        # Dirty rows accumulated since the last SUCCESSFUL load: the
+        # device backend patches exactly those rows instead of diffing or
+        # re-uploading the table.  Cleared only after load_tables returns
+        # (a failed load keeps accumulating, so the next attempt's hint
+        # still covers this generation's changes).
+        self._classifier.load_tables(
+            tables, dirty_hint=self._updater.peek_dirty()
+        )
+        self._updater.clear_dirty()
         self._content = dict(desired)
         self._save_checkpoint(tables)
 

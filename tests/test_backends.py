@@ -462,3 +462,121 @@ def test_wide_ruleid_tables_fall_back_to_u32_path():
         assert out.xdp[0] == 1  # XDP_DROP
         np.testing.assert_array_equal(out.results, ref.results)
         clf.close()
+
+
+def test_device_patch_matches_full_upload_under_churn():
+    """patch_device_tables must produce device arrays bit-identical to a
+    fresh full upload after every incremental mutation round (the
+    Map.Update-granularity device path)."""
+    import jax
+
+    from infw.compiler import IncrementalTables
+    from infw.kernels import jaxpath
+    from test_compiler import _random_content
+
+    rng = np.random.default_rng(70)
+    content = _random_content(rng, 60)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    prev = it.snapshot()
+    dev = jaxpath.device_tables(prev, pad=True)
+    for round_ in range(6):
+        keys = list(content)
+        dels = [keys[int(i)] for i in rng.choice(len(keys), size=4, replace=False)]
+        for k in dels:
+            del content[k]
+        adds = _random_content(rng, 5)
+        content.update(adds)
+        it.apply(adds, deletes=dels)
+        new = it.snapshot()
+        patched = jaxpath.patch_device_tables(dev, prev, new)
+        fresh = jaxpath.device_tables(new, pad=True)
+        if patched is None:
+            dev = fresh  # structural change: full upload, keep iterating
+        else:
+            dev, n_rows = patched
+            assert n_rows > 0
+        for a, b in zip(jax.tree.leaves(dev), jax.tree.leaves(fresh)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        prev = new
+    # classify on the final patched tables is bit-exact vs oracle
+    batch = testing.random_batch(rng, prev, n_packets=400)
+    ref = oracle.classify(prev, batch)
+    from infw.kernels.jaxpath import device_batch, jitted_classify
+    got = np.asarray(jitted_classify(True)(dev, device_batch(batch))[0])
+    np.testing.assert_array_equal(got, ref.results)
+
+
+def test_classifier_incremental_load_uses_patch():
+    """A small rule edit on a loaded trie-path classifier must take the
+    incremental device patch, and verdicts must match the oracle."""
+    from infw.compiler import IncrementalTables
+    from test_compiler import _random_content
+
+    rng = np.random.default_rng(71)
+    content = _random_content(rng, 40)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(it.snapshot())
+    assert clf._last_load[0] == "full"
+    it.clear_dirty()  # device baseline established
+    adds = _random_content(rng, 2)
+    content.update(adds)
+    it.apply(adds)
+    snap = it.snapshot()
+    clf.load_tables(snap, dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    mode, n_rows = clf._last_load
+    # patched rows include leaf-push slot ranges, but must stay far below
+    # a full upload (all padded array rows)
+    full_rows = sum(
+        a.shape[0]
+        for a in (snap.key_words, snap.mask_words, snap.mask_len, snap.rules)
+    ) + sum(l.shape[0] for l in snap.trie_levels)
+    assert mode == "patch" and 0 < n_rows < full_rows // 2
+    batch = testing.random_batch(rng, snap, n_packets=300)
+    check_against_oracle(clf, snap, batch)
+    clf.close()
+
+
+def test_device_patch_with_hints_matches_full_upload_under_churn():
+    """The hint-accelerated patch (no host diff) must stay bit-identical
+    to a fresh padded upload across random churn, including the
+    baseline-invalidation rules (fresh builds and compactions must NOT
+    yield a usable hint until the device consumes a snapshot)."""
+    import jax
+
+    from infw.compiler import IncrementalTables
+    from infw.kernels import jaxpath
+    from test_compiler import _random_content
+
+    rng = np.random.default_rng(72)
+    content = _random_content(rng, 60)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    assert it.peek_dirty() is None  # no device baseline yet
+    prev = it.snapshot()
+    dev = jaxpath.device_tables(prev, pad=True)
+    it.clear_dirty()
+    used_hint = 0
+    for round_ in range(6):
+        keys = list(content)
+        dels = [keys[int(i)] for i in rng.choice(len(keys), size=4, replace=False)]
+        for k in dels:
+            del content[k]
+        adds = _random_content(rng, 5)
+        content.update(adds)
+        it.apply(adds, deletes=dels)
+        new = it.snapshot()
+        hint = it.peek_dirty()
+        patched = jaxpath.patch_device_tables(dev, prev, new, hint=hint)
+        fresh = jaxpath.device_tables(new, pad=True)
+        if patched is None:
+            dev = fresh
+        else:
+            dev = patched[0]
+            if hint is not None:
+                used_hint += 1
+        it.clear_dirty()
+        for a, b in zip(jax.tree.leaves(dev), jax.tree.leaves(fresh)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        prev = new
+    assert used_hint > 0  # the hint path must actually engage

@@ -38,9 +38,9 @@ class CountingClassifier(CpuRefClassifier):
         super().__init__()
         self.load_count = 0
 
-    def load_tables(self, tables):
+    def load_tables(self, tables, dirty_hint=None):
         self.load_count += 1
-        super().load_tables(tables)
+        super().load_tables(tables, dirty_hint=dirty_hint)
 
 
 def tcp_rule(order, ports, action):
