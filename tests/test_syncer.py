@@ -536,3 +536,41 @@ def test_compile_error_leaves_dataplane_untouched():
     assert s.attached_interfaces() == {"dummy0"}
     after = s.get_classifier_map_content_for_test()
     assert set(before) == set(after)
+
+
+def test_tpu_syncer_incremental_sync_takes_patch_path(make_syncer):
+    """A one-CIDR edit through the full sync boundary with the TPU
+    backend must engage the incremental device patch (dirty hints flow
+    syncer -> classifier), and verdicts must track the edit."""
+    from infw.backend.tpu import TpuClassifier
+
+    s = make_syncer(
+        classifier_factory=lambda: TpuClassifier(force_path="trie")
+    )
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["10.1.0.0/16", "10.2.0.0/16"],
+                            [tcp_rule(1, "80", ACTION_ALLOW)])]},
+        False,
+    )
+    assert s.classifier._last_load[0] == "full"
+    assert verdicts(s, ["10.1.9.9"], [6], [80], [IF0]) == [XDP_PASS]
+    # flip the action on one rule set: same keys, patched rows.  The edit
+    # flips TOWARD Deny so a lost patch (no-match default = PASS) cannot
+    # masquerade as success.
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["10.1.0.0/16", "10.2.0.0/16"],
+                            [tcp_rule(1, "80", ACTION_DENY)])]},
+        False,
+    )
+    mode, n_rows = s.classifier._last_load
+    assert mode == "patch" and n_rows > 0
+    assert verdicts(s, ["10.1.9.9"], [6], [80], [IF0]) == [XDP_DROP]
+    # add a CIDR: appends flow through the same hint path (again Deny, so
+    # a dropped append would fail loudly as PASS)
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["10.1.0.0/16", "10.2.0.0/16", "10.3.0.0/16"],
+                            [tcp_rule(1, "80", ACTION_DENY)])]},
+        False,
+    )
+    assert s.classifier._last_load[0] == "patch"
+    assert verdicts(s, ["10.3.9.9"], [6], [80], [IF0]) == [XDP_DROP]
