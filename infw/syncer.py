@@ -46,6 +46,7 @@ from .compiler import (
     IncrementalTables,
     LpmKey,
     build_table_content,
+    compile_tables_from_content,
     min_rule_width,
 )
 from .constants import MAX_RULES_PER_TARGET
@@ -140,6 +141,9 @@ class DataplaneSyncer:
         # patches per-key (addOrUpdateRules/purgeKeys granularity,
         # loader.go:200-218,633) instead of recompiling the whole table.
         self._updater: Optional[IncrementalTables] = None
+        # Incremental deltas applied to the updater but not yet persisted
+        # to any checkpoint (journal or base); survives failed loads.
+        self._pending_deltas: List[Tuple[Dict[LpmKey, np.ndarray], List[LpmKey]]] = []
 
     # -- public surface ------------------------------------------------------
 
@@ -345,12 +349,21 @@ class DataplaneSyncer:
             self._updater.apply(upserts, deletes)
             log.info("incremental table update: %d upserts, %d deletes",
                      len(upserts), len(deletes))
+            # Deltas accumulate until a checkpoint (journal or base)
+            # actually persists them: a failed device load leaves the
+            # delta pending, so the NEXT successful sync still journals
+            # it instead of silently dropping it from the checkpoint.
+            if upserts or deletes:
+                self._pending_deltas.append((upserts, deletes))
+            incremental = True
             if self._updater.maybe_compact():
                 log.info("compacted table: tombstones reclaimed")
+                incremental = False  # checkpoint needs the full state
         else:
             self._updater = IncrementalTables.from_content(
                 desired, rule_width=width
             )
+            incremental = False
         tables = self._updater.snapshot()
         # Dirty rows accumulated since the last SUCCESSFUL load: the
         # device backend patches exactly those rows instead of diffing or
@@ -362,6 +375,13 @@ class DataplaneSyncer:
         )
         self._updater.clear_dirty()
         self._content = dict(desired)
+        # Checkpointing follows the same O(delta) discipline as the device
+        # path: an incremental sync appends small journal records (one per
+        # pending delta); the full (compression-bound, ~14s at 300K
+        # entries) base rewrite only happens on rebuilds or when the
+        # journal grows past its cap.
+        if incremental and self._journal_pending():
+            return
         self._save_checkpoint(tables)
 
     def _desired_width(self, iface_ingress_rules) -> int:
@@ -414,12 +434,130 @@ class DataplaneSyncer:
             return
         tables_path, _ = paths
         os.makedirs(self._checkpoint_dir, exist_ok=True)
+        # Clear the journal BEFORE swapping the base: a crash in between
+        # leaves old-base + empty-journal (consistent, merely stale —
+        # the controller's next sync converges it), never new-base +
+        # stale-journal, whose replay would resurrect deleted rules.
+        self._clear_journal()
         # Atomic swap: never leave a torn checkpoint (the bpffs pin is
         # similarly all-or-nothing).
         tmp = tables_path + ".tmp.npz"
         tables.save(tmp)
         os.replace(tmp, tables_path)
+        self._pending_deltas = []
         # manifest is written by the sync-level _save_manifest() call
+
+    # -- delta-journal checkpointing ----------------------------------------
+    #
+    # A 1-key sync must not pay a full-table compression pass: the delta
+    # is appended as journal/<seq>.json next to the base npz, and restart
+    # replays base.content + journal (same last-writer-wins masked-identity
+    # semantics as successive Map.Update calls) through one compile.  The
+    # journal is capped (JOURNAL_MAX records) — overflow rewrites the base.
+
+    JOURNAL_MAX = 64
+
+    def _journal_dir(self) -> Optional[str]:
+        if not self._checkpoint_dir:
+            return None
+        return os.path.join(self._checkpoint_dir, "journal")
+
+    def _journal_files(self) -> List[str]:
+        d = self._journal_dir()
+        if d is None or not os.path.isdir(d):
+            return []
+        # tmp files are '<seq>.json.tmp' — excluded by the suffix check
+        return sorted(f for f in os.listdir(d) if f.endswith(".json"))
+
+    def _journal_pending(self) -> bool:
+        """Append every pending delta as a journal record; returns False
+        when the caller must do a full base save instead (no checkpoint
+        dir, no base yet, or the journal would exceed its cap)."""
+        d = self._journal_dir()
+        paths = self._ck_paths()
+        if d is None or paths is None or not os.path.exists(paths[0]):
+            return False
+        if not self._pending_deltas:
+            return True  # nothing new to persist; checkpoint already current
+        existing = self._journal_files()
+        if len(existing) + len(self._pending_deltas) > self.JOURNAL_MAX:
+            log.info("checkpoint journal full (%d records); compacting to base",
+                     len(existing))
+            return False
+        os.makedirs(d, exist_ok=True)
+        seq = int(existing[-1].split(".")[0]) + 1 if existing else 0
+        for upserts, deletes in self._pending_deltas:
+            rec = {
+                "upserts": [
+                    [k.prefix_len, k.ingress_ifindex, k.ip_data.hex(),
+                     np.asarray(v, np.int32).tolist()]
+                    for k, v in upserts.items()
+                ],
+                "deletes": [
+                    [k.prefix_len, k.ingress_ifindex, k.ip_data.hex()]
+                    for k in deletes
+                ],
+            }
+            path = os.path.join(d, f"{seq:08d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)
+            seq += 1
+        self._pending_deltas = []
+        return True
+
+    def _clear_journal(self) -> None:
+        d = self._journal_dir()
+        if d is None or not os.path.isdir(d):
+            return
+        for f in os.listdir(d):  # records AND orphaned tmp files
+            try:
+                os.remove(os.path.join(d, f))
+            except FileNotFoundError:
+                pass
+
+    def _replay_journal(self, tables: CompiledTables) -> CompiledTables:
+        """Apply journal records to the base checkpoint's content and
+        recompile once.  A corrupt record stops replay at that point
+        (prefix semantics — everything before it is still applied)."""
+        files = self._journal_files()
+        if not files:
+            return tables
+        content = dict(tables.content)
+        by_ident = {k.masked_identity(): k for k in content}
+        d = self._journal_dir()
+        applied = 0
+        for fn in files:
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    rec = json.load(f)
+                ups = [
+                    (LpmKey(p, i, bytes.fromhex(h)), np.asarray(rows, np.int32))
+                    for p, i, h, rows in rec["upserts"]
+                ]
+                dels = [LpmKey(p, i, bytes.fromhex(h))
+                        for p, i, h in rec["deletes"]]
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                log.warning("corrupt journal record %s: %s; replay stops here",
+                            fn, e)
+                break
+            for k in dels:
+                old = by_ident.pop(k.masked_identity(), None)
+                if old is not None:
+                    content.pop(old, None)
+            for k, rows in ups:
+                ident = k.masked_identity()
+                old = by_ident.get(ident)
+                if old is not None and old != k:
+                    content.pop(old, None)
+                by_ident[ident] = k
+                content[k] = rows
+            applied += 1
+        if applied == 0:
+            return tables  # nothing usable: skip the pointless recompile
+        log.info("checkpoint journal: replayed %d/%d records", applied, len(files))
+        return compile_tables_from_content(content, rule_width=tables.rule_width)
 
     def _save_manifest(self) -> None:
         paths = self._ck_paths()
@@ -441,6 +579,7 @@ class DataplaneSyncer:
             return None
         try:
             tables = CompiledTables.load(tables_path)
+            tables = self._replay_journal(tables)
             with open(manifest_path) as f:
                 manifest = json.load(f)
             return tables, list(manifest.get("attached", []))
@@ -452,6 +591,7 @@ class DataplaneSyncer:
         paths = self._ck_paths()
         if paths is None:
             return
+        self._clear_journal()
         for p in paths:
             try:
                 os.remove(p)

@@ -574,3 +574,130 @@ def test_tpu_syncer_incremental_sync_takes_patch_path(make_syncer):
     )
     assert s.classifier._last_load[0] == "patch"
     assert verdicts(s, ["10.3.9.9"], [6], [80], [IF0]) == [XDP_DROP]
+
+
+def test_incremental_sync_journals_instead_of_full_checkpoint(
+    make_syncer, tmp_path
+):
+    """A 1-key edit appends a journal record (O(delta)) instead of
+    rewriting the full base npz; restart replays base + journal and the
+    recovered table enforces the latest rules."""
+    import os
+
+    ck = tmp_path / "ck"
+    s = make_syncer()
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(["192.0.2.0/30", "198.51.100.0/24"],
+                            [tcp_rule(1, 80, ACTION_ALLOW)])]},
+        False,
+    )
+    base_mtime = os.path.getmtime(ck / "tables.npz")
+    assert not (ck / "journal").exists() or not os.listdir(ck / "journal")
+    # three incremental edits: base untouched, journal grows
+    for i, action in enumerate([ACTION_DENY, ACTION_ALLOW, ACTION_DENY]):
+        s.sync_interface_ingress_rules(
+            {"dummy0": [ingress(["192.0.2.0/30", "198.51.100.0/24"],
+                                [tcp_rule(1, 80, action)])]},
+            False,
+        )
+    assert os.path.getmtime(ck / "tables.npz") == base_mtime
+    assert len(os.listdir(ck / "journal")) == 3
+    s.shutdown()
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules(  # adoption; rules unchanged => no reload
+        {"dummy0": [ingress(["192.0.2.0/30", "198.51.100.0/24"],
+                            [tcp_rule(1, 80, ACTION_DENY)])]},
+        False,
+    )
+    assert s2.classifier.load_count == 1  # re-adopt only, diff is clean
+    got = verdicts(s2, src=["192.0.2.1"], proto=[6], dport=[80], ifidx=[IF0])
+    assert got == [XDP_DROP]  # the journaled final state, not the base
+
+
+def test_journal_overflow_compacts_to_base(make_syncer, tmp_path):
+    import os
+
+    ck = tmp_path / "ck"
+    s = make_syncer()
+    s.JOURNAL_MAX = 4
+    rules = lambda p: {"dummy0": [ingress(["10.0.0.0/8"],
+                                          [tcp_rule(1, str(p), ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules(80), False)
+    for p in range(81, 81 + 4):
+        s.sync_interface_ingress_rules(rules(p), False)
+    assert len(os.listdir(ck / "journal")) == 4
+    base_mtime = os.path.getmtime(ck / "tables.npz")
+    s.sync_interface_ingress_rules(rules(99), False)  # overflow: compact
+    assert os.path.getmtime(ck / "tables.npz") > base_mtime
+    assert os.listdir(ck / "journal") == []
+    # and the compacted base alone recovers the latest state
+    s.shutdown()
+    s2 = make_syncer()
+    got = None
+    s2.sync_interface_ingress_rules(rules(99), False)
+    assert s2.classifier.load_count == 1
+    got = verdicts(s2, src=["10.1.1.1"], proto=[6], dport=[99], ifidx=[IF0])
+    assert got == [XDP_DROP]
+
+
+def test_corrupt_journal_record_stops_replay_at_prefix(make_syncer, tmp_path):
+    """A torn journal record must not poison recovery: records before it
+    still apply, the corrupt one and everything after are ignored."""
+    import os
+
+    ck = tmp_path / "ck"
+    s = make_syncer()
+    rules = lambda p: {"dummy0": [ingress(["10.0.0.0/8"],
+                                          [tcp_rule(1, str(p), ACTION_DENY)])]}
+    s.sync_interface_ingress_rules(rules(80), False)
+    s.sync_interface_ingress_rules(rules(81), False)
+    s.sync_interface_ingress_rules(rules(82), False)
+    files = sorted(os.listdir(ck / "journal"))
+    assert len(files) == 2
+    (ck / "journal" / files[1]).write_text("{torn")
+    s.shutdown()
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules(rules(81), False)  # matches replayed prefix
+    assert s2.classifier.load_count == 1
+    got = verdicts(s2, src=["10.1.1.1"] * 2, proto=[6] * 2, dport=[81, 82],
+                   ifidx=[IF0] * 2)
+    assert got == [XDP_DROP, XDP_PASS]
+
+
+def test_pending_delta_survives_failed_load_into_journal(make_syncer, tmp_path):
+    """Sync A applies a delta to the updater but the device load fails;
+    sync B succeeds with an empty diff-vs-updater.  The checkpoint must
+    still learn sync A's delta (journaled by B), or a restart would
+    enforce stale rules."""
+    import os
+
+    ck = tmp_path / "ck"
+    s = make_syncer()
+    rules = lambda a: {"dummy0": [ingress(["10.0.0.0/8"],
+                                          [tcp_rule(1, "80", a)])]}
+    s.sync_interface_ingress_rules(rules(ACTION_ALLOW), False)
+
+    real_load = s.classifier.load_tables
+    calls = {"n": 0}
+
+    def flaky_load(tables, dirty_hint=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient device error")
+        real_load(tables, dirty_hint=dirty_hint)
+
+    s.classifier.load_tables = flaky_load
+    with pytest.raises(Exception):
+        s.sync_interface_ingress_rules(rules(ACTION_DENY), False)
+    assert not (ck / "journal").exists() or not os.listdir(ck / "journal")
+    # retry succeeds; the earlier delta must land in the journal
+    s.sync_interface_ingress_rules(rules(ACTION_DENY), False)
+    assert len(os.listdir(ck / "journal")) == 1
+    s.shutdown()
+
+    s2 = make_syncer()
+    s2.sync_interface_ingress_rules(rules(ACTION_DENY), False)
+    assert s2.classifier.load_count == 1  # adopt only: checkpoint was current
+    got = verdicts(s2, src=["10.1.1.1"], proto=[6], dport=[80], ifidx=[IF0])
+    assert got == [XDP_DROP]
