@@ -438,6 +438,14 @@ def bench_dense_headline(rng, on_tpu):
 def main():
     on_tpu = jax.default_backend() == "tpu"
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    if on_tpu:
+        # Persistent XLA compile cache: repeated bench runs (and the
+        # daemon tiers inside this one) skip the 30-60s first-compiles.
+        # Timing methodology is unaffected — compiles are excluded from
+        # every measured slope.
+        from infw.platform import enable_jax_compile_cache
+
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
     rng = np.random.default_rng(2024)
 
     # Each tier is independent: a failure (tunnel flake, non-monotonic

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import platform as platform_mod
 from .backend.base import Classifier
 from .compiler import CompileError
 from .constants import KIND_IPV6
@@ -192,24 +193,6 @@ def make_classifier_factory(backend: str):
     raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
 
 
-def _enable_jax_compile_cache(cache_dir: str) -> None:
-    """Persistent XLA compilation cache under the state dir: a restarted
-    daemon re-adopts its checkpoint AND skips the 30-60s first-compile of
-    the classify executables (they rebuild from the on-disk cache in
-    ~100s of ms).  Best effort — an old jax without the option, or an
-    unwritable dir, must never stop the dataplane."""
-    try:
-        import jax
-
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # cache every executable, however fast its compile was
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:  # pragma: no cover - depends on jax build
-        log.warning("jax compilation cache unavailable: %s", e)
-
-
 # --- daemon ------------------------------------------------------------------
 
 class Daemon:
@@ -249,7 +232,9 @@ class Daemon:
             os.makedirs(d, exist_ok=True)
 
         if backend == "tpu":
-            _enable_jax_compile_cache(os.path.join(state_dir, "jax-cache"))
+            platform_mod.enable_jax_compile_cache(
+                os.path.join(state_dir, "jax-cache")
+            )
 
         self.stats = Statistics(poll_period_s=poll_period_s)
         self.stats.register()

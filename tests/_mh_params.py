@@ -1,0 +1,7 @@
+"""Shared dataset recipe for the 2-process multi-host test: the worker
+and the verifying parent must build the identical tables/batch."""
+SEED = 77
+N_ENTRIES = 80
+WIDTH = 8
+OVERLAP = 0.4
+N_PACKETS = 512

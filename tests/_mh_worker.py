@@ -11,6 +11,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
@@ -33,15 +34,18 @@ from infw import testing
 from infw.parallel import multihost
 from infw.parallel.mesh import shard_tables_trie
 
+import _mh_params as mp
+
 ok = multihost.init_distributed(f"localhost:{port}", 2, rank)
 assert ok, "process group did not initialize"
 assert len(jax.devices()) == 8 and jax.local_device_count() == 4, (
     jax.devices(), jax.local_device_count(),
 )
 
-rng = np.random.default_rng(77)
-tables = testing.random_tables(rng, n_entries=80, width=8, overlap_fraction=0.4)
-batch = testing.random_batch(rng, tables, n_packets=512)  # same on both ranks
+rng = np.random.default_rng(mp.SEED)
+tables = testing.random_tables(rng, n_entries=mp.N_ENTRIES, width=mp.WIDTH,
+                               overlap_fraction=mp.OVERLAP)
+batch = testing.random_batch(rng, tables, n_packets=mp.N_PACKETS)  # same on both ranks
 
 mesh = multihost.make_global_mesh()  # data=2 (one shard per host) x rules=4
 assert mesh.shape == {"data": 2, "rules": 4}

@@ -108,10 +108,11 @@ def test_two_process_group_classify_matches_oracle(tmp_path):
     logs = [tmp_path / "rank0.log", tmp_path / "rank1.log"]
     try:
         for r in (0, 1):
-            procs.append(subprocess.Popen(
-                [_sys.executable, worker, str(r), str(port), str(tmp_path)],
-                stdout=open(logs[r], "wb"), stderr=subprocess.STDOUT,
-            ))
+            with open(logs[r], "wb") as lf:  # child dups the fd; parent closes
+                procs.append(subprocess.Popen(
+                    [_sys.executable, worker, str(r), str(port), str(tmp_path)],
+                    stdout=lf, stderr=subprocess.STDOUT,
+                ))
         # poll both: if either worker dies early, fail immediately with
         # ITS log instead of burning the full timeout on the survivor
         deadline = _time.time() + 180
@@ -126,20 +127,29 @@ def test_two_process_group_classify_matches_oracle(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for r, p in enumerate(procs):
-        assert p.poll() == 0, (
-            f"rank {r} rc={p.poll()}:\n{logs[r].read_text()[-3000:]}"
-        )
+                p.wait(timeout=30)  # reap; poll() after bare kill() is racy
+    rcs = [p.poll() for p in procs]
+    # report the rank that actually FAILED, not a survivor we killed
+    culprits = [r for r, rc in enumerate(rcs) if rc not in (0, None, -9)] or [
+        r for r, rc in enumerate(rcs) if rc != 0
+    ]
+    assert all(rc == 0 for rc in rcs), "".join(
+        f"\nrank {r} rc={rcs[r]}:\n{logs[r].read_text()[-3000:]}"
+        for r in culprits
+    )
 
     r0 = np.load(tmp_path / "rank0.npz")
     r1 = np.load(tmp_path / "rank1.npz")
-    rng = np.random.default_rng(77)
-    tables = testing.random_tables(rng, n_entries=80, width=8,
-                                   overlap_fraction=0.4)
-    batch = testing.random_batch(rng, tables, n_packets=512)
+    import _mh_params as mp
+
+    rng = np.random.default_rng(mp.SEED)
+    tables = testing.random_tables(rng, n_entries=mp.N_ENTRIES, width=mp.WIDTH,
+                                   overlap_fraction=mp.OVERLAP)
+    batch = testing.random_batch(rng, tables, n_packets=mp.N_PACKETS)
     ref = oracle.classify(tables, batch)
-    assert (int(r0["lo"]), int(r0["hi"])) == (0, 256)
-    assert (int(r1["lo"]), int(r1["hi"])) == (256, 512)
+    half = mp.N_PACKETS // 2
+    assert (int(r0["lo"]), int(r0["hi"])) == (0, half)
+    assert (int(r1["lo"]), int(r1["hi"])) == (half, mp.N_PACKETS)
     res = np.concatenate([r0["res"], r1["res"]])
     xdp = np.concatenate([r0["xdp"], r1["xdp"]])
     np.testing.assert_array_equal(res, ref.results)
