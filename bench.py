@@ -232,6 +232,7 @@ def bench_replay_10m(rng, tables, on_tpu):
         # would use smaller chunks for latency.
         d.ingest_chunk = 1 << 20
         d.pipeline_depth = 4
+        d.max_tick_packets = 16 << 20
         d.debug_lookup = False
         d.ring = EventRing(capacity=4096)
 

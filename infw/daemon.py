@@ -44,13 +44,14 @@ import numpy as np
 from . import platform as platform_mod
 from .backend.base import Classifier
 from .compiler import CompileError
-from .constants import KIND_IPV6
+from .constants import KIND_IPV6, KIND_OTHER, MAX_TARGETS
 from .interfaces import InterfaceError, InterfaceRegistry, default_registry
 from .nodestate_controller import NodeStateReconciler
 from .obs.events import EventRing, EventsLogger, emit_deny_events
 from .obs.pcap import FramesBuf, parse_frames_buf
 from .obs.statistics import Statistics
-from .packets import PacketBatch
+from . import packets as packets_mod
+from .packets import PacketBatch, expand_wire_v4
 from .schema import validate_nodestate_schema
 from .spec import IngressNodeFirewallNodeState
 from .store import InMemoryStore
@@ -63,6 +64,7 @@ DEFAULT_HEALTH_PORT = 39300    # cmd/daemon/daemon.go:58
 DEBUG_MAP_ENTRIES = 16384      # kernel.c:63 debug map max_entries
 DEFAULT_INGEST_CHUNK = 1 << 16     # packets per in-flight sub-batch
 DEFAULT_PIPELINE_DEPTH = 4         # async classify handles kept in flight
+DEFAULT_MAX_TICK_PACKETS = 4 << 20   # parse-ahead bound for one ingest tick
 
 _FRAMES_MAGIC = b"INFW1\n"
 _FRAMES_MAGIC2 = b"INFW2\n"
@@ -181,6 +183,28 @@ class DebugLookupBuffer:
 
 # --- classifier factories ----------------------------------------------------
 
+def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
+    """Per-file statistics from host-resident verdicts — (MAX_TARGETS, 4)
+    int64 [allow_pkts, allow_bytes, deny_pkts, deny_bytes], mirroring the
+    device accumulation semantics (kernel.c:361-399: allow/deny only,
+    ruleId < MAX_TARGETS).  Computed host-side so a device job that spans
+    files never entangles one file's counters with another's exactly-once
+    lifecycle."""
+    action = results & 0xFF
+    rid = (results >> 8).astype(np.int64)
+    pl = np.asarray(pkt_len, np.int64)
+    out = np.zeros((MAX_TARGETS, 4), np.int64)
+    for col, act in ((0, 2), (2, 1)):  # ALLOW=2, DENY=1
+        m = (action == act) & (rid < MAX_TARGETS)
+        if m.any():
+            r = rid[m]
+            out[:, col] = np.bincount(r, minlength=MAX_TARGETS)[:MAX_TARGETS]
+            out[:, col + 1] = np.bincount(
+                r, weights=pl[m], minlength=MAX_TARGETS
+            )[:MAX_TARGETS].astype(np.int64)
+    return out
+
+
 def make_classifier_factory(backend: str):
     if backend == "cpu":
         from .backend.cpu_ref import CpuRefClassifier
@@ -213,6 +237,7 @@ class Daemon:
         events_socket: Optional[str] = None,
         ingest_chunk: int = DEFAULT_INGEST_CHUNK,
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+        max_tick_packets: int = DEFAULT_MAX_TICK_PACKETS,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -222,6 +247,7 @@ class Daemon:
         self.file_poll_interval_s = file_poll_interval_s
         self.ingest_chunk = max(1, int(ingest_chunk))
         self.pipeline_depth = max(1, int(pipeline_depth))
+        self.max_tick_packets = max(1, int(max_tick_packets))
         self.registry = registry if registry is not None else default_registry
 
         self.nodestates_dir = os.path.join(state_dir, "nodestates")
@@ -382,39 +408,44 @@ class Daemon:
                     log.error("delete sync failed for %s: %s", fn, e)
 
     # -- ingest --------------------------------------------------------------
+    #
+    # (helpers below are module-level: _expand_wire, _concat_batches,
+    # stats_from_results)
 
     def process_ingest_once(self) -> int:
         """Classify every frames file in the ingest dir; write verdict
         summaries to out/; emit deny events; consume the file.
 
-        Streaming pipeline: each file's batch is split into chunks of
-        ``ingest_chunk`` packets, dispatched with ``classify_async`` and
-        kept ``pipeline_depth`` deep in flight, so H2D transfer, device
-        kernel and D2H readback of consecutive chunks overlap instead of
-        serializing one full round trip per file (the inline per-packet
-        role of bpf/ingress_node_firewall_kernel.c:412-457)."""
+        Cross-file batching: all pending files (bounded by
+        ``max_tick_packets``) are parsed up front, their packets regrouped
+        into family-homogeneous device jobs of ``ingest_chunk`` rows that
+        SPAN file boundaries — on a high-latency link each dispatch/
+        readback round trip is the dominant cost, so ten small files share
+        a handful of round trips instead of paying two each.  Jobs are
+        kept ``pipeline_depth`` deep in flight so H2D, kernel and D2H of
+        consecutive jobs overlap (the inline per-packet role of
+        bpf/ingress_node_firewall_kernel.c:412-457).
+
+        Failure isolation: a failed MERGED job is re-dispatched as
+        per-file jobs, so a fault attributable to one file's packets
+        poisons only that file (left on disk for retry) while its
+        job-mates complete; statistics are computed host-side per file
+        from the verdicts and applied only after the file is consumed —
+        exactly once across any retry."""
         clf = self.syncer.classifier
         if clf is None or clf.tables is None:
             return 0
-        inflight: deque = deque()
         processed = 0
 
         def finalize(fctx) -> None:
-            """Write verdicts, consume the file, then apply stats and emit
-            events — runs as soon as the file's last chunk drains, so
-            memory stays bounded per file.  Chunks are dispatched with
-            apply_stats=False and the deltas land here, strictly AFTER the
-            source file is removed: a failure anywhere earlier leaves the
-            file for a clean retry with zero double-counted statistics and
-            no duplicate deny events."""
+            """Write verdicts, consume the file, then apply stats and
+            emit events — strictly AFTER the source file is removed: a
+            failure anywhere earlier leaves the file for a clean retry
+            with zero double-counted statistics and no duplicate deny
+            events."""
             nonlocal processed
             batch, fb, fn = fctx["batch"], fctx["frames"], fctx["fn"]
-            n = len(batch)
-            results = np.zeros(n, np.uint32)
-            xdp = np.full(n, 2, np.int32)
-            for idx, out in fctx["parts"]:
-                results[idx] = np.asarray(out.results)
-                xdp[idx] = np.asarray(out.xdp)
+            results, xdp = fctx["results"], fctx["xdp"]
             if self.debug_lookup:
                 self.debug_buffer.record_batch(batch)
             # Per-packet verdicts go to a binary sidecar (little-endian u32
@@ -426,7 +457,7 @@ class Daemon:
             )
             summary = {
                 "file": fn,
-                "packets": n,
+                "packets": len(batch),
                 "pass": int((xdp == 2).sum()),
                 "drop": int((xdp == 1).sum()),
                 "results_file": fn + ".verdicts.bin",
@@ -434,26 +465,11 @@ class Daemon:
             with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
                 json.dump(summary, f)
             os.remove(fctx["path"])
-            for _idx, out in fctx["parts"]:
-                clf.stats.add(out.stats_delta)
+            clf.stats.add(stats_from_results(results, np.asarray(batch.pkt_len)))
             emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, fb)
             processed += 1
 
-        def drain_one() -> None:
-            """Materialize the oldest in-flight chunk.  A failure (device
-            error, finalize I/O) poisons only its own file: remaining
-            handles for that file are drained and discarded, the source
-            file stays on disk for the next tick, and other files'
-            pipelines continue untouched."""
-            fctx, idx, pending = inflight.popleft()
-            try:
-                out = pending.result()
-                if not fctx["failed"]:
-                    fctx["parts"].append((idx, out))
-            except Exception as e:
-                if not fctx["failed"]:
-                    fctx["failed"] = True
-                    log.error("ingest classify failed for %s: %s", fctx["fn"], e)
+        def seg_done(fctx) -> None:
             fctx["remaining"] -= 1
             if fctx["remaining"] == 0 and not fctx["failed"]:
                 try:
@@ -461,10 +477,15 @@ class Daemon:
                 except Exception as e:
                     log.error("ingest finalize failed for %s: %s", fctx["fn"], e)
 
+        # ---- phase 1: read + parse pending files (bounded per tick) ----
+        files = []
+        total = 0
         for fn in sorted(os.listdir(self.ingest_dir)):
             path = os.path.join(self.ingest_dir, fn)
             if fn.endswith(".tmp") or not os.path.isfile(path):
                 continue
+            if files and total >= self.max_tick_packets:
+                break  # the rest belongs to the next tick
             try:
                 fb = read_frames_any(path)
                 batch = parse_frames_buf(fb)
@@ -476,23 +497,11 @@ class Daemon:
                 os.remove(path)
                 continue
             n = len(batch)
-            # Regroup by family so each chunk is depth-homogeneous: v4-only
-            # chunks take the truncated trie walk (3 gathers, not 15).
-            order = np.arange(n)
-            kinds = np.asarray(batch.kind)
-            groups = [
-                g
-                for g in (order[kinds != KIND_IPV6], order[kinds == KIND_IPV6])
-                if len(g)
-            ]
-            chunks = [
-                g[s : s + self.ingest_chunk]
-                for g in groups
-                for s in range(0, len(g), self.ingest_chunk)
-            ]
             fctx = {
                 "fn": fn, "path": path, "frames": fb, "batch": batch,
-                "parts": [], "remaining": len(chunks), "failed": False,
+                "results": np.zeros(n, np.uint32),
+                "xdp": np.full(n, 2, np.int32),
+                "remaining": 0, "failed": False,
             }
             if n == 0:
                 try:
@@ -500,43 +509,123 @@ class Daemon:
                 except Exception as e:
                     log.error("ingest finalize failed for %s: %s", fn, e)
                 continue
-            # Packed fast path: parse -> wire descriptors in one native
-            # pass per chunk (no 9-array subset copy); backends without
-            # the packed entry point (CPU ref, wide-ruleId tables) take
-            # the composed take()+classify_async path.
-            packed_ok = (
-                getattr(clf, "supports_packed", None) is not None
-                and clf.supports_packed()
-            )
-            for idx in chunks:
-                if fctx["failed"]:
-                    # dispatching more chunks of a poisoned file is wasted
-                    # device work — their results would be discarded
-                    fctx["remaining"] -= 1
-                    continue
-                while len(inflight) >= self.pipeline_depth:
-                    drain_one()
+            files.append(fctx)
+            total += n
+        if not files:
+            return processed
+
+        # ---- phase 2: family-homogeneous jobs spanning files ----
+        # v4-only jobs take the truncated trie walk (3 gathers, not 15).
+        jobs: deque = deque()
+        for want_v6 in (False, True):
+            cur = []
+            cur_n = 0
+            for fctx in files:
+                kinds = np.asarray(fctx["batch"].kind)
+                g = np.nonzero((kinds == KIND_IPV6) == want_v6)[0]
+                pos = 0
+                while pos < len(g):
+                    take = g[pos : pos + (self.ingest_chunk - cur_n)]
+                    cur.append((fctx, take))
+                    fctx["remaining"] += 1
+                    cur_n += len(take)
+                    pos += len(take)
+                    if cur_n >= self.ingest_chunk:
+                        jobs.append({"segments": cur, "retry": False})
+                        cur, cur_n = [], 0
+            if cur:
+                jobs.append({"segments": cur, "retry": False})
+
+        packed_ok = (
+            getattr(clf, "supports_packed", None) is not None
+            and clf.supports_packed()
+        )
+
+        def _bucket(n: int) -> int:
+            """Pad jobs to power-of-two row counts (capped at the chunk
+            size) so tail jobs reuse compiled executables instead of
+            jit-compiling a fresh shape mid-tick.  Padding rows are
+            KIND_OTHER (always PASS, no stats — and per-file statistics
+            come from the host-side verdicts anyway, so inert padding is
+            free)."""
+            if n >= self.ingest_chunk:
+                return n
+            return min(1 << max(6, (n - 1).bit_length()), self.ingest_chunk)
+
+        def dispatch(job):
+            """Returns a PendingClassify, or raises (eager backends raise
+            HERE, async ones at .result())."""
+            segs = [(f, idx) for f, idx in job["segments"] if not f["failed"]]
+            job["segments"] = segs
+            if not segs:
+                return None
+            n = sum(len(idx) for _f, idx in segs)
+            if packed_ok:
+                parts = [
+                    f["batch"].pack_wire_subset(np.ascontiguousarray(idx, np.int64))
+                    for f, idx in segs
+                ]
+                width = max(w.shape[1] for w, _v4 in parts)
+                wire = np.concatenate(
+                    [w if w.shape[1] == width else expand_wire_v4(w)
+                     for w, _v4 in parts]
+                )
+                pad = _bucket(n) - n
+                if pad:
+                    padrows = np.zeros((pad, width), np.uint32)
+                    padrows[:, 0] = KIND_OTHER
+                    wire = np.concatenate([wire, padrows])
+                v4_only = all(v4 for _w, v4 in parts)
+                return clf.classify_async_packed(wire, v4_only, apply_stats=False)
+            merged = packets_mod.concat(
+                [f["batch"].take(idx) for f, idx in segs]
+            ).pad_to(_bucket(n))
+            return clf.classify_async(merged, apply_stats=False)
+
+        def job_failed(job, err) -> None:
+            """A merged job's fault cannot be attributed to one file:
+            re-dispatch each segment as its own single-file job.  A retry
+            job's fault CAN be attributed — poison that file."""
+            if not job["retry"]:
+                log.warning("ingest job failed (%s); retrying per file", err)
+                for f, idx in job["segments"]:
+                    jobs.append({"segments": [(f, idx)], "retry": True})
+                return
+            for f, _idx in job["segments"]:
+                if not f["failed"]:
+                    f["failed"] = True
+                    log.error("ingest classify failed for %s: %s", f["fn"], err)
+                seg_done(f)
+
+        def drain_one() -> None:
+            job, pending = inflight.popleft()
+            try:
+                out = pending.result()
+            except Exception as e:
+                job_failed(job, e)
+                return
+            off = 0
+            for f, idx in job["segments"]:
+                k = len(idx)
+                if not f["failed"]:
+                    f["results"][idx] = np.asarray(out.results)[off : off + k]
+                    f["xdp"][idx] = np.asarray(out.xdp)[off : off + k]
+                off += k
+                seg_done(f)
+
+        inflight: deque = deque()
+        while jobs or inflight:
+            while jobs and len(inflight) < self.pipeline_depth:
+                job = jobs.popleft()
                 try:
-                    # Eager backends (CPU ref) raise HERE, not in .result();
-                    # the failure must still poison only this file, never
-                    # abort the tick and starve later-sorted files.
-                    if packed_ok:
-                        wire, v4_only = batch.pack_wire_subset(idx)
-                        pending = clf.classify_async_packed(
-                            wire, v4_only, apply_stats=False
-                        )
-                    else:
-                        pending = clf.classify_async(
-                            batch.take(idx), apply_stats=False
-                        )
+                    pending = dispatch(job)
                 except Exception as e:
-                    fctx["failed"] = True
-                    fctx["remaining"] -= 1
-                    log.error("ingest classify failed for %s: %s", fn, e)
+                    job_failed(job, e)
                     continue
-                inflight.append((fctx, idx, pending))
-        while inflight:
-            drain_one()
+                if pending is not None:
+                    inflight.append((job, pending))
+            if inflight:
+                drain_one()
         return processed
 
     # -- HTTP endpoints ------------------------------------------------------
@@ -647,6 +736,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
     p.add_argument("--ingest-chunk", type=int, default=DEFAULT_INGEST_CHUNK)
     p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
+    p.add_argument("--max-tick-packets", type=int,
+                   default=DEFAULT_MAX_TICK_PACKETS)
     p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
@@ -681,6 +772,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics_port=args.metrics_port,
         health_port=args.health_port,
         ingest_chunk=args.ingest_chunk,
+        max_tick_packets=args.max_tick_packets,
         pipeline_depth=args.pipeline_depth,
         events_socket=args.events_socket or None,
     )

@@ -262,3 +262,13 @@ def concat(batches: List[PacketBatch]) -> PacketBatch:
             )
         }
     )
+
+
+def expand_wire_v4(w: np.ndarray) -> np.ndarray:
+    """(n, 4) compact wire rows -> (n, 7): zero high IP words (the compact
+    format's eligibility guarantee).  Lives next to pack_wire/pack_wire_v4
+    so the 4-word/7-word correspondence has one owner; used when a merged
+    ingest job mixes compact and full segments and must ship one width."""
+    out = np.zeros((w.shape[0], 7), np.uint32)
+    out[:, :4] = w
+    return out

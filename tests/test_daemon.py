@@ -357,11 +357,13 @@ def test_deny_event_with_large_ifindex(tmp_path):
 
 @pytest.mark.parametrize("mode", ["deferred", "sync"])
 def test_ingest_failure_isolated_and_stats_exactly_once(tmp_path, mode):
-    """A mid-pipeline classify failure poisons only its own file — the
-    file stays on disk for retry, other files complete — and statistics
-    land exactly once across the retry (no double counting).  Covered for
-    both failure surfaces: a deferred .result() raise (async TPU backend)
-    and a synchronous classify_async raise (eager CPU backend)."""
+    """Failure semantics of the cross-file-batched ingest: a TRANSIENT
+    fault on a merged job self-heals within the tick (per-file retry
+    dispatch), while a PERSISTENT fault attributable to one file poisons
+    only that file — it stays on disk for the next tick, job-mates
+    complete — and statistics land exactly once across every retry.
+    Covered for both failure surfaces: a deferred .result() raise (async
+    TPU backend) and a synchronous classify_async raise (eager CPU)."""
     from infw.backend.base import PendingClassify
 
     reg = InterfaceRegistry()
@@ -384,11 +386,10 @@ def test_ingest_failure_isolated_and_stats_exactly_once(tmp_path, mode):
         write_frames_file(os.path.join(d.ingest_dir, "bbb.frames"), [deny()] * 2, 10)
 
         orig = clf.classify_async
-        boom = {"left": 1}
+        fail_when = {"pred": lambda batch: True}
 
         def flaky(batch, apply_stats=True):
-            if boom["left"]:
-                boom["left"] -= 1
+            if fail_when["pred"](batch):
                 if mode == "sync":
                     raise RuntimeError("device fell over at dispatch")
 
@@ -399,19 +400,48 @@ def test_ingest_failure_isolated_and_stats_exactly_once(tmp_path, mode):
             return orig(batch, apply_stats=apply_stats)
 
         clf.classify_async = flaky
-        assert d.process_ingest_once() == 1  # only bbb completed
-        assert os.path.exists(os.path.join(d.ingest_dir, "aaa.frames"))
-        assert not os.path.exists(
-            os.path.join(d.out_dir, "aaa.frames.verdicts.json")
-        )
-        assert os.path.exists(os.path.join(d.out_dir, "bbb.frames.verdicts.json"))
-        snap = clf.stats.snapshot()
-        assert snap[1, 2] == 2  # bbb's 2 denies, nothing from failed aaa
 
-        assert d.process_ingest_once() == 1  # retry tick consumes aaa
+        # --- transient fault: fail exactly one dispatch (the merged job);
+        # the per-file retries complete everything within the tick ---
+        boom = {"left": 1}
+
+        def once(batch):
+            if boom["left"]:
+                boom["left"] -= 1
+                return True
+            return False
+
+        fail_when["pred"] = once
+        assert d.process_ingest_once() == 2
         assert not os.path.exists(os.path.join(d.ingest_dir, "aaa.frames"))
         snap = clf.stats.snapshot()
-        assert snap[1, 2] == 5  # 3 + 2, each deny counted exactly once
+        assert snap[1, 2] == 5  # 3 + 2 denies, exactly once despite the retry
+
+        # --- persistent per-file fault: every batch containing aaa2's
+        # (content-marked) packets fails — the merged job AND aaa2's
+        # per-file retry — so only aaa2 is poisoned; bbb2 completes and
+        # is counted once ---
+        mark = lambda: build_frame("10.1.2.9", "203.0.113.1", IPPROTO_TCP, 999, 80)
+        MARK_W0 = (10 << 24) | (1 << 16) | (2 << 8) | 9
+        write_frames_file(os.path.join(d.ingest_dir, "aaa2.frames"), [mark()] * 3, 10)
+        write_frames_file(os.path.join(d.ingest_dir, "bbb2.frames"), [deny()] * 2, 10)
+        fail_when["pred"] = lambda batch: bool(
+            (np.asarray(batch.ip_words)[:, 0] == MARK_W0).any()
+        )
+        assert d.process_ingest_once() == 1  # only bbb2
+        assert os.path.exists(os.path.join(d.ingest_dir, "aaa2.frames"))
+        assert not os.path.exists(
+            os.path.join(d.out_dir, "aaa2.frames.verdicts.json")
+        )
+        assert os.path.exists(os.path.join(d.out_dir, "bbb2.frames.verdicts.json"))
+        snap = clf.stats.snapshot()
+        assert snap[1, 2] == 7  # +bbb2 only
+
+        fail_when["pred"] = lambda batch: False
+        assert d.process_ingest_once() == 1  # retry tick consumes aaa2
+        assert not os.path.exists(os.path.join(d.ingest_dir, "aaa2.frames"))
+        snap = clf.stats.snapshot()
+        assert snap[1, 2] == 10  # every deny counted exactly once
     finally:
         d.stop()
 
@@ -456,5 +486,54 @@ def test_pipelined_ingest_multi_chunk(tmp_path):
         )
         assert len(rb) == 20
         assert rb[:4].tolist() == [257, 0, 257, 0]
+    finally:
+        d.stop()
+
+
+def test_cross_file_batched_ingest_tpu_backend(tmp_path):
+    """Multiple frames files in one tick share merged device jobs (packed
+    wire path); per-file verdict sidecars, stats and events must be
+    identical to processing them alone."""
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    d = Daemon(
+        state_dir=str(tmp_path / "state"),
+        node_name=NODE, namespace=NS, backend="tpu",
+        poll_period_s=0.05, registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=60.0, ingest_chunk=64,
+    )
+    try:
+        with open(os.path.join(d.nodestates_dir, f"{NODE}.json"), "w") as f:
+            json.dump(node_state().to_dict(), f)
+        d.scan_nodestates_once()
+        clf = d.syncer.classifier
+        assert clf.supports_packed()
+
+        mk = lambda dport: build_frame(
+            "10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999, dport
+        )
+        v6 = build_frame("2001:db8::1", "2001:db8::2", IPPROTO_TCP, 999, 80)
+        # three files, mixed families, sizes straddling the chunk size
+        write_frames_file(os.path.join(d.ingest_dir, "f0.frames"),
+                          [mk(80)] * 40 + [v6] * 10, 10)
+        write_frames_file(os.path.join(d.ingest_dir, "f1.frames"),
+                          [mk(81)] * 50 + [mk(80)] * 30, 10)
+        write_frames_file(os.path.join(d.ingest_dir, "f2.frames"),
+                          [v6] * 5, 10)
+        assert d.process_ingest_once() == 3
+        got = {}
+        for fn in ("f0", "f1", "f2"):
+            with open(os.path.join(d.out_dir, fn + ".frames.verdicts.json")) as f:
+                got[fn] = json.load(f)
+        # rule: deny tcp/80 from 10.1.0.0/16 (v4 only), everything else passes
+        assert (got["f0"]["drop"], got["f0"]["pass"]) == (40, 10)
+        assert (got["f1"]["drop"], got["f1"]["pass"]) == (30, 50)
+        assert (got["f2"]["drop"], got["f2"]["pass"]) == (0, 5)
+        rb = np.fromfile(
+            os.path.join(d.out_dir, got["f1"]["results_file"]), dtype="<u4"
+        )
+        assert (rb[:50] == 0).all() and (rb[50:] == 257).all()
+        snap = clf.stats.snapshot()
+        assert snap[1, 2] == 70  # 40 + 30 denies across merged jobs
     finally:
         d.stop()
