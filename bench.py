@@ -231,7 +231,7 @@ def bench_replay_10m(rng, tables, on_tpu):
         # small the payload) dominates below this; the real-PCIe deployment
         # would use smaller chunks for latency.
         d.ingest_chunk = 1 << 20
-        d.pipeline_depth = 4
+        d.pipeline_depth = 16
         d.max_tick_packets = 16 << 20
         d.debug_lookup = False
         d.ring = EventRing(capacity=4096)
