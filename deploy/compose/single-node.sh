@@ -26,10 +26,12 @@ trap cleanup EXIT INT TERM
 python -m infw.obs.sidecar --socket "$EVENTS_SOCK" &
 pids+=($!)
 
-# manager: fan-out controller + admission + NodeState export
+# manager: fan-out controller + admission + NodeState export; CRs are
+# applied by dropping IngressNodeFirewall JSONs into $STATE_DIR/apply
+# (admission verdicts land beside them as <name>.status.json)
 DAEMONSET_IMAGE="${DAEMONSET_IMAGE:-infw:latest}" \
 DAEMONSET_NAMESPACE="${DAEMONSET_NAMESPACE:-ingress-node-firewall-system}" \
-python -m infw.manager --export-dir "$STATE_DIR" &
+python -m infw.manager --export-dir "$STATE_DIR" --apply-dir "$STATE_DIR/apply" &
 pids+=($!)
 
 # daemon in the foreground (no exec: the EXIT trap must outlive it so a

@@ -21,6 +21,7 @@ through the k8s API.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import logging
 import os
@@ -42,7 +43,15 @@ from .spec import (
     IngressNodeFirewallConfig,
     IngressNodeFirewallNodeState,
 )
-from .store import DELETED, InMemoryStore, Node
+from .apply import apply_object
+from .store import (
+    DELETED,
+    AdmissionError,
+    InMemoryStore,
+    Node,
+    NotFoundError,
+    StoreError,
+)
 
 log = logging.getLogger("infw.manager")
 
@@ -70,6 +79,8 @@ class Manager:
         daemon_image: str = "infw-daemon:latest",
         enable_webhook: bool = True,
         export_dir: Optional[str] = None,
+        apply_dir: Optional[str] = None,
+        apply_poll_interval_s: float = 0.5,
         metrics_port: int = DEFAULT_METRICS_PORT,
         health_port: int = DEFAULT_HEALTH_PORT,
     ) -> None:
@@ -92,6 +103,17 @@ class Manager:
         if export_dir:
             self.export_dir = os.path.join(export_dir, "nodestates")
             os.makedirs(self.export_dir, exist_ok=True)
+
+        # kubectl-apply analogue: a watched directory of IngressNodeFirewall
+        # CR JSONs (see scan_apply_dir_once) — the file seam that lets an
+        # operator drive a RUNNING manager process the way `kubectl apply`
+        # drives the reference's API server.
+        self.apply_dir: Optional[str] = None
+        if apply_dir:
+            self.apply_dir = apply_dir
+            os.makedirs(self.apply_dir, exist_ok=True)
+        self.apply_poll_interval_s = apply_poll_interval_s
+        self._applied: dict = {}  # filename -> (cr name, namespace, stat sig)
 
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
@@ -191,6 +213,106 @@ class Manager:
         while self.process_one(block=False):
             pass
 
+    # -- apply dir (kubectl-apply seam) --------------------------------------
+
+    def scan_apply_dir_once(self) -> None:
+        """Reconcile the apply directory against the store: each
+        ``<name>.json`` is an IngressNodeFirewall CR applied through the
+        admission seam (create-or-update); file deletion deletes the CR.
+        The admission verdict lands in ``<name>.status.json`` — the file
+        protocol's version of the webhook response the reference returns
+        on the API call (webhook.go ValidateCreate/Update)."""
+        if not self.apply_dir:
+            return
+        seen = set()
+        for fn in sorted(os.listdir(self.apply_dir)):
+            if (
+                not fn.endswith(".json")
+                or fn.endswith(".status.json")
+                or fn.endswith(".tmp")
+            ):
+                continue
+            path = os.path.join(self.apply_dir, fn)
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except FileNotFoundError:
+                continue
+            seen.add(fn)
+            # Content hash, not (mtime, size): same-length rewrites within
+            # one mtime tick must not be silently skipped.
+            sig = hashlib.sha1(raw).hexdigest()
+            prev = self._applied.get(fn)
+            if prev is not None and prev[2] == sig:
+                continue
+            errors: List[str] = []
+            inf = None
+            try:
+                inf = IngressNodeFirewall.from_dict(json.loads(raw))
+            except Exception as e:
+                errors = [f"unparseable IngressNodeFirewall document: {e}"]
+            if inf is not None:
+                if prev is not None and prev[0] not in (None, inf.metadata.name):
+                    # The file renamed its CR.  The file is the source of
+                    # truth in this seam, so the no-longer-declared old
+                    # object goes first — it must not linger (orphan) nor
+                    # order-conflict with its own successor in admission.
+                    self._delete_cr(prev[0], prev[1], fn + " (renamed)")
+                try:
+                    apply_object(self.store, inf)
+                except AdmissionError as e:
+                    errors = list(e.errors)
+                except StoreError as e:
+                    errors = [str(e)]
+            self._write_apply_status(fn, errors)
+            if errors:
+                log.warning("apply %s rejected: %s", fn, "; ".join(errors))
+                # Remember the rejected signature so an unchanged file is
+                # not re-applied (and re-logged) every poll — but KEEP the
+                # previously applied CR mapping: the live object must still
+                # be deletable when the file goes away.
+                old = prev if prev is not None else (None, None, None)
+                self._applied[fn] = (old[0], old[1], sig)
+            else:
+                log.info("applied %s -> IngressNodeFirewall/%s",
+                         fn, inf.metadata.name)
+                self._applied[fn] = (
+                    inf.metadata.name, inf.metadata.namespace, sig
+                )
+        for fn in [f for f in self._applied if f not in seen]:
+            name, namespace, _sig = self._applied.pop(fn)
+            try:
+                os.remove(os.path.join(self.apply_dir, fn[:-5] + ".status.json"))
+            except OSError:
+                pass
+            if name is None:
+                continue  # a rejected file never reached the store
+            self._delete_cr(name, namespace, fn + " removed")
+
+    def _delete_cr(self, name: str, namespace: Optional[str], why: str) -> None:
+        try:
+            self.store.delete(IngressNodeFirewall.KIND, name, namespace or "")
+            log.info("deleted IngressNodeFirewall/%s (%s)", name, why)
+        except NotFoundError:
+            pass
+
+    def _write_apply_status(self, fn: str, errors: List[str]) -> None:
+        status_path = os.path.join(
+            self.apply_dir, fn[:-5] + ".status.json"
+        )
+        tmp = status_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"applied": not errors, "errors": errors}, f)
+        os.replace(tmp, status_path)
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scan_apply_dir_once()
+            except Exception as e:  # never let a scan error kill the loop
+                log.error("apply-dir scan failed: %s", e)
+            self._stop.wait(self.apply_poll_interval_s)
+
     # -- lifecycle -----------------------------------------------------------
 
     def _worker(self) -> None:
@@ -238,6 +360,10 @@ class Manager:
         t = threading.Thread(target=self._worker, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.apply_dir:
+            t = threading.Thread(target=self._apply_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
         # Initial full reconciles (the List-driven state resync on start).
         self.enqueue_fanout()
         self.enqueue_config(DEFAULT_CONFIG_NAME)
@@ -263,6 +389,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="infw-manager")
     p.add_argument("--export-dir", default=None,
                    help="mirror NodeStates to <dir>/nodestates for file-driven daemons")
+    p.add_argument("--apply-dir", default=None,
+                   help="watch <dir> for IngressNodeFirewall CR JSONs "
+                        "(kubectl-apply seam; <name>.status.json carries "
+                        "the admission verdict)")
     p.add_argument("--namespace", default=os.environ.get(
         "DAEMONSET_NAMESPACE", ""))
     p.add_argument("--daemon-image", default=os.environ.get("DAEMONSET_IMAGE", ""))
@@ -286,6 +416,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         daemon_image=args.daemon_image,
         enable_webhook=args.enable_webhook,
         export_dir=args.export_dir,
+        apply_dir=args.apply_dir,
         metrics_port=args.metrics_port,
         health_port=args.health_port,
     )
