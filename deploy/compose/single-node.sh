@@ -31,7 +31,8 @@ pids+=($!)
 # (admission verdicts land beside them as <name>.status.json)
 DAEMONSET_IMAGE="${DAEMONSET_IMAGE:-infw:latest}" \
 DAEMONSET_NAMESPACE="${DAEMONSET_NAMESPACE:-ingress-node-firewall-system}" \
-python -m infw.manager --export-dir "$STATE_DIR" --apply-dir "$STATE_DIR/apply" &
+python -m infw.manager --export-dir "$STATE_DIR" --apply-dir "$STATE_DIR/apply" \
+  --register-node "$NODE_NAME" &
 pids+=($!)
 
 # daemon in the foreground (no exec: the EXIT trap must outlive it so a

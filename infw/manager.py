@@ -42,11 +42,13 @@ from .spec import (
     IngressNodeFirewall,
     IngressNodeFirewallConfig,
     IngressNodeFirewallNodeState,
+    ObjectMeta,
 )
 from .apply import apply_object
 from .store import (
     DELETED,
     AdmissionError,
+    AlreadyExistsError,
     InMemoryStore,
     Node,
     NotFoundError,
@@ -81,6 +83,7 @@ class Manager:
         export_dir: Optional[str] = None,
         apply_dir: Optional[str] = None,
         apply_poll_interval_s: float = 0.5,
+        register_nodes: Optional[List[str]] = None,
         metrics_port: int = DEFAULT_METRICS_PORT,
         health_port: int = DEFAULT_HEALTH_PORT,
     ) -> None:
@@ -114,6 +117,16 @@ class Manager:
             os.makedirs(self.apply_dir, exist_ok=True)
         self.apply_poll_interval_s = apply_poll_interval_s
         self._applied: dict = {}  # filename -> (cr name, namespace, stat sig)
+
+        # Self-registered Node inventory for API-server-less deployments
+        # (the compose stack): the reference's fan-out matches CRs against
+        # cluster Nodes; a single-node composition registers its own host
+        # the way a kubelet joins the cluster.
+        for node_name in register_nodes or []:
+            try:
+                self.store.create(Node(metadata=ObjectMeta(name=node_name)))
+            except AlreadyExistsError:
+                pass
 
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
@@ -393,6 +406,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="watch <dir> for IngressNodeFirewall CR JSONs "
                         "(kubectl-apply seam; <name>.status.json carries "
                         "the admission verdict)")
+    p.add_argument("--register-node", action="append", default=None,
+                   metavar="NAME",
+                   help="register a Node in the manager's inventory "
+                        "(repeatable; API-server-less compose runs where "
+                        "no kubelet joins nodes)")
     p.add_argument("--namespace", default=os.environ.get(
         "DAEMONSET_NAMESPACE", ""))
     p.add_argument("--daemon-image", default=os.environ.get("DAEMONSET_IMAGE", ""))
@@ -417,6 +435,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         enable_webhook=args.enable_webhook,
         export_dir=args.export_dir,
         apply_dir=args.apply_dir,
+        register_nodes=args.register_node,
         metrics_port=args.metrics_port,
         health_port=args.health_port,
     )

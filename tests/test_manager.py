@@ -322,6 +322,7 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
         spawn("manager", [_sys.executable, "-m", "infw.manager",
                           "--export-dir", str(state),
                           "--apply-dir", str(state / "apply"),
+                          "--register-node", "composed-node",
                           "--metrics-port", "0", "--health-port", "0"])
         spawn("daemon", [_sys.executable, "-m", "infw.daemon",
                          "--state-dir", str(state), "--backend", "cpu",
@@ -332,13 +333,8 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
         while time.time() < deadline and not (state / "apply").is_dir():
             time.sleep(0.1)
 
-        # the manager has no Node objects in a from-files run; NodeState
-        # fan-out needs one — drive it via the manager's own store? No:
-        # the compose manager builds NodeStates from watched Nodes, and a
-        # fresh process has none, so the flow uses the daemon's direct
-        # nodestates seam in deploy docs.  HERE we assert the apply->
-        # admission->status part through the manager process, then the
-        # dataplane part through the daemon's nodestates protocol.
+        # a CR that trips the failsafe webhook: rejected with the verdict
+        # in its status file (the API-call error of webhook.go, as a file)
         bad = inf("fw-bad", WORKER,
                   [ingress(["10.0.0.0/8"], [tcp_rule(1, 22, ACTION_DENY)])]).to_dict()
         _write_cr(state / "apply" / "fw-bad.json", bad)
@@ -350,20 +346,18 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
         assert st["applied"] is False
         assert any("conflict" in e for e in st["errors"]), st  # failsafe SSH
 
-        ns_doc = {
-            "apiVersion": "ingressnodefirewall.openshift.io/v1alpha1",
-            "kind": "IngressNodeFirewallNodeState",
-            "metadata": {"name": "composed-node", "namespace": NS},
-            "spec": {"interfaceIngressRules": {"eth0": [
-                {"sourceCIDRs": ["10.1.0.0/16"],
-                 "rules": [{"order": 1,
-                            "protocolConfig": {"protocol": "TCP",
-                                               "tcp": {"ports": "80"}},
-                            "action": "Deny"}]}]}},
-        }
+        # the REAL path: a valid CR (empty selector = all nodes) travels
+        # admission -> fan-out against the self-registered Node ->
+        # NodeState export -> daemon sync.  No manual NodeState anywhere.
+        good = inf("fw-good", {},
+                   [ingress(["10.1.0.0/16"], [tcp_rule(1, 80, ACTION_DENY)])],
+                   interfaces=("eth0",)).to_dict()
+        _write_cr(state / "apply" / "fw-good.json", good)
         nsp = state / "nodestates" / "composed-node.json"
-        nsp.parent.mkdir(parents=True, exist_ok=True)
-        _write_cr(nsp, ns_doc)
+        deadline = time.time() + 30  # fresh budget: startup consumed the first
+        while time.time() < deadline and not nsp.exists():
+            time.sleep(0.1)
+        assert nsp.exists(), logs["manager"].read_text()[-2000:]
 
         from infw.daemon import write_frames_file_v2
         from infw.obs.pcap import FramesBuf, build_frame
