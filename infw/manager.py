@@ -116,7 +116,8 @@ class Manager:
             self.apply_dir = apply_dir
             os.makedirs(self.apply_dir, exist_ok=True)
         self.apply_poll_interval_s = apply_poll_interval_s
-        self._applied: dict = {}  # filename -> (cr name, namespace, stat sig)
+        # filename -> ((kind, name, namespace) | None, content hash)
+        self._applied: dict = {}
 
         # Self-registered Node inventory for API-server-less deployments
         # (the compose stack): the reference's fan-out matches CRs against
@@ -228,13 +229,22 @@ class Manager:
 
     # -- apply dir (kubectl-apply seam) --------------------------------------
 
+    # CR kinds the apply seam accepts (NodeStates are manager-owned
+    # output, never operator input — exactly the reference's RBAC shape)
+    APPLY_KINDS = {
+        IngressNodeFirewall.KIND: IngressNodeFirewall,
+        IngressNodeFirewallConfig.KIND: IngressNodeFirewallConfig,
+    }
+
     def scan_apply_dir_once(self) -> None:
         """Reconcile the apply directory against the store: each
-        ``<name>.json`` is an IngressNodeFirewall CR applied through the
-        admission seam (create-or-update); file deletion deletes the CR.
-        The admission verdict lands in ``<name>.status.json`` — the file
-        protocol's version of the webhook response the reference returns
-        on the API call (webhook.go ValidateCreate/Update)."""
+        ``<name>.json`` is a CR (IngressNodeFirewall or
+        IngressNodeFirewallConfig, discriminated by ``kind``) applied
+        through the admission seam (create-or-update); file deletion
+        deletes the CR.  The admission verdict lands in
+        ``<name>.status.json`` — the file protocol's version of the
+        webhook response the reference returns on the API call
+        (webhook.go ValidateCreate/Update)."""
         if not self.apply_dir:
             return
         seen = set()
@@ -255,24 +265,48 @@ class Manager:
             # Content hash, not (mtime, size): same-length rewrites within
             # one mtime tick must not be silently skipped.
             sig = hashlib.sha1(raw).hexdigest()
-            prev = self._applied.get(fn)
-            if prev is not None and prev[2] == sig:
+            prev = self._applied.get(fn)  # (ident-or-None, sig)
+            if prev is not None and prev[1] == sig:
                 continue
             errors: List[str] = []
-            inf = None
+            obj = None
             try:
-                inf = IngressNodeFirewall.from_dict(json.loads(raw))
-            except Exception as e:
-                errors = [f"unparseable IngressNodeFirewall document: {e}"]
-            if inf is not None:
-                if prev is not None and prev[0] not in (None, inf.metadata.name):
-                    # The file renamed its CR.  The file is the source of
-                    # truth in this seam, so the no-longer-declared old
-                    # object goes first — it must not linger (orphan) nor
-                    # order-conflict with its own successor in admission.
-                    self._delete_cr(prev[0], prev[1], fn + " (renamed)")
+                doc = json.loads(raw)
+            except ValueError as e:
+                doc = None
+                errors = [f"unparseable CR document: {e}"]
+            if doc is not None:
+                cls = self.APPLY_KINDS.get(
+                    doc.get("kind") if isinstance(doc, dict) else None
+                )
+                if cls is None:
+                    kind = doc.get("kind") if isinstance(doc, dict) else doc
+                    errors = [
+                        f"unsupported kind {kind!r} "
+                        f"(expected one of {sorted(self.APPLY_KINDS)})"
+                    ]
+                else:
+                    try:
+                        obj = cls.from_dict(doc)
+                    except Exception as e:
+                        errors = [f"invalid {cls.KIND} document: {e}"]
+                    if obj is not None and (
+                        cls is IngressNodeFirewallConfig
+                        and not obj.metadata.namespace
+                    ):
+                        # the config reconciler looks in the manager namespace
+                        obj.metadata.namespace = self.namespace
+            if obj is not None:
+                ident = (obj.KIND, obj.metadata.name, obj.metadata.namespace)
+                if prev is not None and prev[0] not in (None, ident):
+                    # The file renamed (or re-kinded) its CR.  The file is
+                    # the source of truth in this seam, so the
+                    # no-longer-declared old object goes first — it must
+                    # not linger (orphan) nor order-conflict with its own
+                    # successor in admission.
+                    self._delete_cr(prev[0], fn + " (renamed)")
                 try:
-                    apply_object(self.store, inf)
+                    apply_object(self.store, obj)
                 except AdmissionError as e:
                     errors = list(e.errors)
                 except StoreError as e:
@@ -284,28 +318,26 @@ class Manager:
                 # not re-applied (and re-logged) every poll — but KEEP the
                 # previously applied CR mapping: the live object must still
                 # be deletable when the file goes away.
-                old = prev if prev is not None else (None, None, None)
-                self._applied[fn] = (old[0], old[1], sig)
+                old = prev if prev is not None else (None, None)
+                self._applied[fn] = (old[0], sig)
             else:
-                log.info("applied %s -> IngressNodeFirewall/%s",
-                         fn, inf.metadata.name)
-                self._applied[fn] = (
-                    inf.metadata.name, inf.metadata.namespace, sig
-                )
+                log.info("applied %s -> %s/%s", fn, obj.KIND, obj.metadata.name)
+                self._applied[fn] = (ident, sig)
         for fn in [f for f in self._applied if f not in seen]:
-            name, namespace, _sig = self._applied.pop(fn)
+            ident, _sig = self._applied.pop(fn)
             try:
                 os.remove(os.path.join(self.apply_dir, fn[:-5] + ".status.json"))
             except OSError:
                 pass
-            if name is None:
+            if ident is None:
                 continue  # a rejected file never reached the store
-            self._delete_cr(name, namespace, fn + " removed")
+            self._delete_cr(ident, fn + " removed")
 
-    def _delete_cr(self, name: str, namespace: Optional[str], why: str) -> None:
+    def _delete_cr(self, ident, why: str) -> None:
+        kind, name, namespace = ident
         try:
-            self.store.delete(IngressNodeFirewall.KIND, name, namespace or "")
-            log.info("deleted IngressNodeFirewall/%s (%s)", name, why)
+            self.store.delete(kind, name, namespace or "")
+            log.info("deleted %s/%s (%s)", kind, name, why)
         except NotFoundError:
             pass
 
@@ -403,8 +435,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--export-dir", default=None,
                    help="mirror NodeStates to <dir>/nodestates for file-driven daemons")
     p.add_argument("--apply-dir", default=None,
-                   help="watch <dir> for IngressNodeFirewall CR JSONs "
-                        "(kubectl-apply seam; <name>.status.json carries "
+                   help="watch <dir> for CR JSONs (IngressNodeFirewall or "
+                        "IngressNodeFirewallConfig, by kind) — the "
+                        "kubectl-apply seam; <name>.status.json carries "
                         "the admission verdict)")
     p.add_argument("--register-node", action="append", default=None,
                    metavar="NAME",

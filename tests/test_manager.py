@@ -395,3 +395,35 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(timeout=15)
+
+
+def test_apply_dir_config_kind_and_unsupported(tmp_path):
+    """The apply seam routes by kind: an IngressNodeFirewallConfig drives
+    the config reconciler (daemonset render); unsupported kinds are
+    rejected with the reason in the status file."""
+    m = Manager(namespace=NS, apply_dir=str(tmp_path / "apply"))
+    try:
+        _write_cr(tmp_path / "apply" / "config.json", {
+            "apiVersion": "ingressnodefirewall.openshift.io/v1alpha1",
+            "kind": "IngressNodeFirewallConfig",
+            "metadata": {"name": DEFAULT_CONFIG_NAME},
+            "spec": {"nodeSelector": {}, "debug": True},
+        })
+        m.scan_apply_dir_once()
+        m.drain()
+        cfg = m.store.get(
+            IngressNodeFirewallConfig.KIND, DEFAULT_CONFIG_NAME, NS
+        )
+        assert cfg.spec.debug is True  # namespace defaulted to the manager's
+        ds = m.store.get(DaemonSet.KIND, "ingress-node-firewall-daemon", NS)
+        assert ds is not None  # config reconcile rendered the daemonset
+
+        _write_cr(tmp_path / "apply" / "node.json",
+                  {"kind": "Node", "metadata": {"name": "n0"}})
+        m.scan_apply_dir_once()
+        with open(tmp_path / "apply" / "node.status.json") as f:
+            st = json.load(f)
+        assert st["applied"] is False
+        assert any("unsupported kind" in e for e in st["errors"])
+    finally:
+        m.stop()
