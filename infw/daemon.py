@@ -244,6 +244,7 @@ class Daemon:
         ingest_chunk: int = DEFAULT_INGEST_CHUNK,
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
         max_tick_packets: int = DEFAULT_MAX_TICK_PACKETS,
+        event_ring_size: int = 4096,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -282,7 +283,11 @@ class Daemon:
         )
         self.store.watch(IngressNodeFirewallNodeState.KIND, self._on_store_event)
 
-        self.ring = EventRing()
+        # perf-ring analogue (kernel.c perf event array): once full,
+        # incoming records are dropped and counted as LostSamples (the
+        # oldest events survive a deny storm), so capacity trades event
+        # completeness for memory
+        self.ring = EventRing(capacity=max(64, int(event_ring_size)))
         self._event_file = open(self.events_path, "a", buffering=1)
         # Sidecar composition (daemonset.yaml:54-67): events always land in
         # events.log (the in-process record) and, when --events-socket is
@@ -744,6 +749,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
     p.add_argument("--max-tick-packets", type=int,
                    default=DEFAULT_MAX_TICK_PACKETS)
+    p.add_argument("--event-ring-size", type=int, default=4096,
+                   help="deny-event ring capacity, minimum 64 (overflow "
+                        "drops new records and counts them as lost "
+                        "samples, like the kernel perf ring)")
     p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
@@ -779,6 +788,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         health_port=args.health_port,
         ingest_chunk=args.ingest_chunk,
         max_tick_packets=args.max_tick_packets,
+        event_ring_size=args.event_ring_size,
         pipeline_depth=args.pipeline_depth,
         events_socket=args.events_socket or None,
     )

@@ -98,6 +98,7 @@ class Manager:
         self.metrics_port = metrics_port
         self.health_port = health_port
         self.reconcile_counts = {"fanout": 0, "config": 0}
+        self.apply_counts = {"applied": 0, "rejected": 0, "deleted": 0}
 
         if enable_webhook:
             self.store.set_admission(IngressNodeFirewall.KIND, inf_admission)
@@ -313,6 +314,7 @@ class Manager:
                     errors = [str(e)]
             self._write_apply_status(fn, errors)
             if errors:
+                self.apply_counts["rejected"] += 1
                 log.warning("apply %s rejected: %s", fn, "; ".join(errors))
                 # Remember the rejected signature so an unchanged file is
                 # not re-applied (and re-logged) every poll — but KEEP the
@@ -321,6 +323,7 @@ class Manager:
                 old = prev if prev is not None else (None, None)
                 self._applied[fn] = (old[0], sig)
             else:
+                self.apply_counts["applied"] += 1
                 log.info("applied %s -> %s/%s", fn, obj.KIND, obj.metadata.name)
                 self._applied[fn] = (ident, sig)
         for fn in [f for f in self._applied if f not in seen]:
@@ -337,6 +340,7 @@ class Manager:
         kind, name, namespace = ident
         try:
             self.store.delete(kind, name, namespace or "")
+            self.apply_counts["deleted"] += 1
             log.info("deleted %s/%s (%s)", kind, name, why)
         except NotFoundError:
             pass
@@ -387,6 +391,13 @@ class Manager:
                     for k, v in mgr.reconcile_counts.items():
                         lines.append(
                             f'ingressnodefirewall_manager_reconcile_total{{controller="{k}"}} {v}'
+                        )
+                    lines.append(
+                        "# TYPE ingressnodefirewall_manager_apply_total counter"
+                    )
+                    for k, v in mgr.apply_counts.items():
+                        lines.append(
+                            f'ingressnodefirewall_manager_apply_total{{outcome="{k}"}} {v}'
                         )
                     self._send(200, "\n".join(lines) + "\n")
                 else:
