@@ -49,3 +49,53 @@ def test_adversarial_overlap_10k():
 )
 def test_adversarial_overlap_150k():
     _differential(n_entries=150_000, n_packets=8192)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("INFW_BIG_TESTS"), reason="INFW_BIG_TESTS=1 to enable"
+)
+def test_seed_sweep_differential():
+    """Multi-seed robustness sweep: every backend path (oracle, native
+    C++, XLA dense, XLA trie, Pallas interpret, packed wire) must agree
+    verdict-for-verdict across many random table/batch draws — the
+    fixed-seed differential tests cannot catch seed-dependent edge cases
+    (mask-length boundaries, slot ties, family mixes) that this does."""
+    from infw import oracle
+    from infw.backend.tpu import TpuClassifier
+
+    for seed in range(40, 56):
+        rng = np.random.default_rng(seed)
+        tables = testing.random_tables(
+            rng,
+            n_entries=int(rng.integers(5, 400)),
+            width=int(rng.integers(2, 16)),
+            overlap_fraction=float(rng.random() * 0.8),
+        )
+        batch = testing.random_batch(rng, tables, n_packets=512)
+        want = oracle.classify(tables, batch)
+
+        ref = CpuRefClassifier()
+        ref.load_tables(tables)
+        got = ref.classify(batch)
+        np.testing.assert_array_equal(got.results, want.results, err_msg=f"cpp seed {seed}")
+
+        for path in ("dense", "trie"):
+            clf = TpuClassifier(force_path=path)
+            clf.load_tables(tables)
+            out = clf.classify(batch, apply_stats=False)
+            np.testing.assert_array_equal(
+                out.results, want.results, err_msg=f"{path} seed {seed}"
+            )
+            np.testing.assert_array_equal(
+                out.xdp, want.xdp, err_msg=f"{path} seed {seed}"
+            )
+            if clf.supports_packed():
+                idx = np.arange(len(batch), dtype=np.int64)
+                wire, v4_only = batch.pack_wire_subset(idx)
+                pk = clf.classify_async_packed(
+                    wire, v4_only, apply_stats=False
+                ).result()
+                np.testing.assert_array_equal(
+                    pk.results, want.results, err_msg=f"{path}-packed seed {seed}"
+                )
+            clf.close()
