@@ -49,7 +49,7 @@ from .interfaces import InterfaceError, InterfaceRegistry, default_registry
 from .nodestate_controller import NodeStateReconciler
 from .obs.events import EventRing, EventsLogger, emit_deny_events
 from .obs.pcap import FramesBuf, parse_frames_buf
-from .obs.statistics import Statistics
+from .obs.statistics import Registry as MetricsRegistry, Statistics
 from . import packets as packets_mod
 from .packets import PacketBatch, expand_wire_v4
 from .schema import validate_nodestate_schema
@@ -133,6 +133,15 @@ def read_frames_any(path: str) -> FramesBuf:
         magic = f.read(len(_FRAMES_MAGIC2))
         if magic == _FRAMES_MAGIC2:
             (count,) = struct.unpack("<I", f.read(4))
+            # Bound the declared count against the file size BEFORE
+            # allocating: a corrupt header with count near 2^32 would
+            # otherwise attempt multi-GB reads ahead of the truncation
+            # check below.
+            st_size = os.fstat(f.fileno()).st_size
+            if 8 * count + f.tell() > st_size:
+                raise ValueError(
+                    f"{path}: v2 header count {count} exceeds file size"
+                )
             ifindex = np.frombuffer(f.read(4 * count), "<u4")
             lengths = np.frombuffer(f.read(4 * count), "<u4")
             payload_off = f.tell()
@@ -269,8 +278,13 @@ class Daemon:
                 os.path.join(state_dir, "jax-cache")
             )
 
+        # Per-daemon metrics registry (controller-runtime gives each
+        # manager its own, statistics.go:79-86): /metrics serves whatever
+        # collectors are registered here — the daemon's own Statistics
+        # plus any additional pollers a composition adds.
+        self.metrics_registry = MetricsRegistry()
         self.stats = Statistics(poll_period_s=poll_period_s)
-        self.stats.register()
+        self.stats.register(self.metrics_registry)
         self.syncer = DataplaneSyncer(
             classifier_factory=make_classifier_factory(backend),
             registry=self.registry,
@@ -473,8 +487,12 @@ class Daemon:
                 "drop": int((xdp == 1).sum()),
                 "results_file": fn + ".verdicts.bin",
             }
-            with open(os.path.join(self.out_dir, fn + ".verdicts.json"), "w") as f:
+            # tmp + rename like every other file in the protocol: readers
+            # poll for the path and must never see a half-written doc
+            jpath = os.path.join(self.out_dir, fn + ".verdicts.json")
+            with open(jpath + ".tmp", "w") as f:
                 json.dump(summary, f)
+            os.replace(jpath + ".tmp", jpath)
             os.remove(fctx["path"])
             clf.stats.add(stats_from_results(results, np.asarray(batch.pkt_len)))
             emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, fb)
@@ -505,7 +523,14 @@ class Daemon:
                 # does — leaving it would wedge the tick at this file
                 # every poll and starve later-sorted files.
                 log.error("bad ingest file %s: %s", fn, e)
-                os.remove(path)
+                try:
+                    os.remove(path)
+                except OSError as re:
+                    # An unremovable file (EACCES/EROFS, racing unlink)
+                    # must not abort the tick — that would starve every
+                    # later-sorted file behind it.
+                    log.error("could not remove bad ingest file %s: %s",
+                              fn, re)
                 continue
             n = len(batch)
             fctx = {
@@ -566,6 +591,7 @@ class Daemon:
         def dispatch(job):
             """Returns a PendingClassify, or raises (eager backends raise
             HERE, async ones at .result())."""
+            nonlocal packed_ok
             segs = [(f, idx) for f, idx in job["segments"] if not f["failed"]]
             job["segments"] = segs
             if not segs:
@@ -587,7 +613,25 @@ class Daemon:
                     padrows[:, 0] = KIND_OTHER
                     wire = np.concatenate([wire, padrows])
                 v4_only = all(v4 for _w, v4 in parts)
-                return clf.classify_async_packed(wire, v4_only, apply_stats=False)
+                try:
+                    return clf.classify_async_packed(
+                        wire, v4_only, apply_stats=False
+                    )
+                except RuntimeError:
+                    # A concurrent load_tables can flip the table to
+                    # wide-ruleId mid-tick; re-check and fall through to
+                    # the unpacked path instead of poisoning every
+                    # in-flight file (the retry jobs would raise again,
+                    # still packed).  A CLOSED classifier also fails
+                    # supports_packed — that is not a format flip and the
+                    # unpacked path would raise identically, so re-raise.
+                    if clf.supports_packed() or clf.active_path is None:
+                        raise
+                    packed_ok = False  # sticky for the rest of the tick
+                    log.warning(
+                        "table flipped to wide-ruleId mid-tick; "
+                        "falling back to unpacked classify"
+                    )
             merged = packets_mod.concat(
                 [f["batch"].take(idx) for f, idx in segs]
             ).pad_to(_bucket(n))
@@ -656,7 +700,7 @@ class Daemon:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    self._send(200, daemon_self.stats.render_prometheus_text())
+                    self._send(200, daemon_self.metrics_registry.render_text())
                 elif self.path in ("/healthz", "/readyz"):
                     self._send(200, "ok")
                 elif self.path == "/debug/lookup-keys":

@@ -299,19 +299,56 @@ class Manager:
                         obj.metadata.namespace = self.namespace
             if obj is not None:
                 ident = (obj.KIND, obj.metadata.name, obj.metadata.namespace)
-                if prev is not None and prev[0] not in (None, ident):
-                    # The file renamed (or re-kinded) its CR.  The file is
-                    # the source of truth in this seam, so the
-                    # no-longer-declared old object goes first — it must
-                    # not linger (orphan) nor order-conflict with its own
-                    # successor in admission.
-                    self._delete_cr(prev[0], fn + " (renamed)")
-                try:
-                    apply_object(self.store, obj)
-                except AdmissionError as e:
-                    errors = list(e.errors)
-                except StoreError as e:
-                    errors = [str(e)]
+                old_ident = (
+                    prev[0]
+                    if prev is not None and prev[0] not in (None, ident)
+                    else None
+                )
+                errors = self._try_apply(obj)
+                if old_ident is not None:
+                    # The file renamed (or re-kinded) its CR.  The
+                    # replacement is validated FIRST (above) so a bad edit
+                    # never fails open: the webhook analogue rejects
+                    # atomically, leaving the old object enforcing.  Only
+                    # when the rejection is the successor conflicting with
+                    # its own predecessor (cross-INF order overlap names
+                    # the conflicting INF, validate.py:266-270) is the
+                    # predecessor removed for ONE retry — any other
+                    # rejection must not touch the enforcing object (a
+                    # delete/recreate cycle would briefly fail open for
+                    # watchers and can lose the CR if the restore races).
+                    conflict_tag = (
+                        f"conflicts with IngressNodeFirewall "
+                        f"{old_ident[1]!r}"
+                    )
+                    # EVERY error must be a self-conflict: any other error
+                    # survives the predecessor's removal, so the retry
+                    # could not succeed and the churn would be pure risk.
+                    self_conflict = (
+                        old_ident[0] == obj.KIND
+                        and bool(errors)
+                        and all(conflict_tag in e for e in errors)
+                    )
+                    if not errors:
+                        self._delete_cr(old_ident, fn + " (renamed)")
+                    elif self_conflict:
+                        old_obj = self._get_cr(old_ident)
+                        self._delete_cr(old_ident, fn + " (renamed)")
+                        errors = self._try_apply(obj)
+                        if errors and old_obj is not None:
+                            try:
+                                self.store.create(old_obj)
+                                log.warning(
+                                    "apply %s: replacement rejected; "
+                                    "restored %s/%s", fn, old_ident[0],
+                                    old_ident[1],
+                                )
+                            except StoreError as e:
+                                log.error(
+                                    "apply %s: could not restore %s/%s "
+                                    "after rejected replacement: %s",
+                                    fn, old_ident[0], old_ident[1], e,
+                                )
             self._write_apply_status(fn, errors)
             if errors:
                 self.apply_counts["rejected"] += 1
@@ -335,6 +372,24 @@ class Manager:
             if ident is None:
                 continue  # a rejected file never reached the store
             self._delete_cr(ident, fn + " removed")
+
+    def _try_apply(self, obj) -> List[str]:
+        """Apply through the admission seam; returns the rejection errors
+        ([] on success)."""
+        try:
+            apply_object(self.store, obj)
+        except AdmissionError as e:
+            return list(e.errors)
+        except StoreError as e:
+            return [str(e)]
+        return []
+
+    def _get_cr(self, ident):
+        kind, name, namespace = ident
+        try:
+            return self.store.get(kind, name, namespace or "")
+        except NotFoundError:
+            return None
 
     def _delete_cr(self, ident, why: str) -> None:
         kind, name, namespace = ident

@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from ..backend.base import Classifier
@@ -67,14 +68,6 @@ def add_uint64(a: int, b: int):
     return c, False
 
 
-#: Process-level registry of Statistics instances — the analogue of
-#: registering the gauges into controller-runtime's shared Prometheus
-#: registry (statistics.go:79-86): everything registered is rendered by
-#: one exposition call, however many daemons/pollers live in-process.
-_registry_lock = threading.Lock()
-_registry: List["Statistics"] = []
-
-
 def _render_exposition(vals: Dict[str, int]) -> str:
     """Prometheus text format for the four node gauges — the ONE place
     the exposition format lives (shared by per-instance and registry
@@ -88,18 +81,59 @@ def _render_exposition(vals: Dict[str, int]) -> str:
     return "\n".join(out) + "\n"
 
 
-def render_registry_text() -> str:
-    """Combined exposition over every registered Statistics instance
-    (values summed per metric) — what a shared /metrics endpoint serves
-    when multiple pollers register, matching the reference's single
-    metrics.Registry fed by any number of collectors."""
-    with _registry_lock:
-        instances = list(_registry)
-    totals: Dict[str, int] = {name: 0 for name, _ in _METRICS}
-    for inst in instances:
-        for name, v in inst.values().items():
-            totals[name] += v
-    return _render_exposition(totals)
+class Registry:
+    """The metrics.Registry analogue (statistics.go:79-86): Statistics
+    collectors register into it and one exposition call renders them all
+    (values summed per metric).  Collectors are held by WEAK reference —
+    an instance that is registered and then dropped (crash-looped daemon
+    constructions, test fixtures) disappears from the exposition with the
+    instance instead of inflating sums forever; ``unregister`` remains the
+    explicit path."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._refs: List["weakref.ref[Statistics]"] = []
+
+    def register(self, inst: "Statistics") -> None:
+        """Idempotent (regOnce, statistics.go:79-86)."""
+        with self._lock:
+            self._prune_locked()
+            if any(r() is inst for r in self._refs):
+                return
+            self._refs.append(weakref.ref(inst))
+
+    def unregister(self, inst: "Statistics") -> None:
+        with self._lock:
+            self._refs = [
+                r for r in self._refs if r() is not None and r() is not inst
+            ]
+
+    def _prune_locked(self) -> None:
+        self._refs = [r for r in self._refs if r() is not None]
+
+    def collectors(self) -> List["Statistics"]:
+        with self._lock:
+            self._prune_locked()
+            return [inst for r in self._refs if (inst := r()) is not None]
+
+    def render_text(self) -> str:
+        """Combined exposition over every live registered collector —
+        what a shared /metrics endpoint serves, matching the reference's
+        single metrics.Registry fed by any number of collectors."""
+        totals: Dict[str, int] = {name: 0 for name, _ in _METRICS}
+        for inst in self.collectors():
+            for name, v in inst.values().items():
+                totals[name] += v
+        return _render_exposition(totals)
+
+
+#: Process-level default registry — the analogue of controller-runtime's
+#: global metrics.Registry every manager shares unless handed its own.
+DEFAULT_REGISTRY = Registry()
+
+
+def render_registry_text(registry: Optional[Registry] = None) -> str:
+    return (registry if registry is not None else DEFAULT_REGISTRY).render_text()
 
 
 class Statistics:
@@ -114,28 +148,35 @@ class Statistics:
         self._values: Dict[str, int] = {name: 0 for name, _ in _METRICS}
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
-        self._registered = False
+        # Registration state has its own lock, held across BOTH the
+        # attribute swap and the Registry membership mutation so the two
+        # can never diverge (a register/unregister race could otherwise
+        # leave a live member with self._registry already None).  It must
+        # not be self._lock: render_text holds the registry lock while
+        # calling values() (which takes self._lock) — sharing that lock
+        # here would be an ABBA deadlock.
+        self._reg_lock = threading.Lock()
+        self._registry: Optional[Registry] = None
 
     # -- registration (regOnce, statistics.go:79-86) -------------------------
 
-    def register(self) -> None:
-        """Idempotent (regOnce): adds this instance to the process-level
-        registry consumed by render_registry_text.  Flag and list are
-        mutated under the ONE registry lock so they can never diverge
-        (a register/unregister race could otherwise double-append)."""
-        with _registry_lock:
-            if self._registered:
-                return
-            self._registered = True
-            _registry.append(self)
+    def register(self, registry: Optional[Registry] = None) -> None:
+        """Register this collector into ``registry`` (default: the
+        process-level DEFAULT_REGISTRY).  Idempotent per registry
+        (regOnce); re-registering into a different registry moves the
+        collector."""
+        target = registry if registry is not None else DEFAULT_REGISTRY
+        with self._reg_lock:
+            prev, self._registry = self._registry, target
+            if prev is not None and prev is not target:
+                prev.unregister(self)
+            target.register(self)
 
     def unregister(self) -> None:
-        with _registry_lock:
-            if not self._registered:
-                return
-            self._registered = False
-            if self in _registry:
-                _registry.remove(self)
+        with self._reg_lock:
+            prev, self._registry = self._registry, None
+            if prev is not None:
+                prev.unregister(self)
 
     # -- polling -------------------------------------------------------------
 

@@ -4,6 +4,7 @@ inverse for every field the classifier consumes, and the v2 columnar
 frames file must round-trip.  These are the replay-scale equivalents of
 the reference's gopacket decode checks."""
 import os
+import struct
 
 import numpy as np
 import pytest
@@ -254,6 +255,21 @@ def test_read_frames_any_rejects_garbage(tmp_path):
     with open(p, "wb") as f:
         f.write(b"not a frames file at all")
     with pytest.raises(ValueError):
+        read_frames_any(p)
+
+
+def test_read_frames_any_bounds_v2_count(tmp_path):
+    """A corrupt v2 header whose u32 count is near 2^32 must be rejected
+    BEFORE any allocation is attempted (round-3 advisor finding): the
+    count is bounded against the file size, not trusted."""
+    from infw.daemon import _FRAMES_MAGIC2
+
+    p = os.path.join(tmp_path, "huge.frames")
+    with open(p, "wb") as f:
+        f.write(_FRAMES_MAGIC2)
+        f.write(struct.pack("<I", 0xFFFFFF00))
+        f.write(b"\x00" * 64)  # far too small for the declared count
+    with pytest.raises(ValueError, match="exceeds file size"):
         read_frames_any(p)
 
 

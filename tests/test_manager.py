@@ -270,6 +270,64 @@ def test_apply_dir_create_update_delete(tmp_path):
         m.stop()
 
 
+def test_apply_dir_rename_to_rejected_keeps_old_cr(tmp_path):
+    """A file edit that renames its CR to something admission rejects must
+    NOT fail open: the previously-enforcing object stays (the reference
+    webhook rejects atomically, leaving the old object intact) — round-3
+    advisor medium finding."""
+    m = Manager(namespace=NS, apply_dir=str(tmp_path / "apply"))
+    try:
+        doc = inf("fw1", WORKER,
+                  [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]).to_dict()
+        _write_cr(tmp_path / "apply" / "fw.json", doc)
+        m.scan_apply_dir_once()
+        assert m.store.get(IngressNodeFirewall.KIND, "fw1") is not None
+
+        # rename AND break it (deny on failsafe port 22 is rejected)
+        doc["metadata"]["name"] = "fw2"
+        doc["spec"]["ingress"][0]["rules"][0]["protocolConfig"]["tcp"]["ports"] = "22"
+        _write_cr(tmp_path / "apply" / "fw.json", doc)
+        m.scan_apply_dir_once()
+        # old object still enforcing, successor rejected
+        assert m.store.get(IngressNodeFirewall.KIND, "fw1") is not None
+        with pytest.raises(NotFoundError):
+            m.store.get(IngressNodeFirewall.KIND, "fw2")
+        with open(tmp_path / "apply" / "fw.status.json") as f:
+            assert json.load(f)["applied"] is False
+
+        # removing the file still deletes the live (old) CR — the mapping
+        # survived the rejected rename
+        os.remove(tmp_path / "apply" / "fw.json")
+        m.scan_apply_dir_once()
+        with pytest.raises(NotFoundError):
+            m.store.get(IngressNodeFirewall.KIND, "fw1")
+    finally:
+        m.stop()
+
+
+def test_apply_dir_rename_conflicting_with_self_succeeds(tmp_path):
+    """A rename whose successor order-conflicts only with its own
+    predecessor (identical spec, new name) must land: the scan retries
+    with the predecessor removed, and restores it only if the successor
+    still fails on its own."""
+    m = Manager(namespace=NS, apply_dir=str(tmp_path / "apply"))
+    try:
+        doc = inf("fwa", WORKER,
+                  [ingress(["10.0.0.0/8"], [tcp_rule(1, 80, ACTION_DENY)])]).to_dict()
+        _write_cr(tmp_path / "apply" / "fw.json", doc)
+        m.scan_apply_dir_once()
+        doc["metadata"]["name"] = "fwb"  # same spec: overlaps fwa's orders
+        _write_cr(tmp_path / "apply" / "fw.json", doc)
+        m.scan_apply_dir_once()
+        assert m.store.get(IngressNodeFirewall.KIND, "fwb") is not None
+        with pytest.raises(NotFoundError):
+            m.store.get(IngressNodeFirewall.KIND, "fwa")
+        with open(tmp_path / "apply" / "fw.status.json") as f:
+            assert json.load(f)["applied"] is True
+    finally:
+        m.stop()
+
+
 def test_apply_dir_rejection_writes_status(tmp_path):
     m = Manager(namespace=NS, apply_dir=str(tmp_path / "apply"))
     try:

@@ -249,25 +249,52 @@ def test_statistics_registry_exposition():
                     return outer._snap
             return _S()
 
-    # isolate from any Statistics other tests left registered
-    with st._registry_lock:
-        saved = list(st._registry)
-        st._registry.clear()
+    reg = st.Registry()  # isolated from the process-level default
     a, b = st.Statistics(), st.Statistics()
-    a.register(); a.register()  # regOnce: idempotent
-    b.register()
-    try:
-        a.update_metrics(_FakeClf(2))
-        b.update_metrics(_FakeClf(3))
-        text = st.render_registry_text()
-        assert "ingressnodefirewall_node_packet_deny_total 5" in text
-        assert "ingressnodefirewall_node_packet_deny_bytes 500" in text
-        b.unregister()
-        text = st.render_registry_text()
-        assert "ingressnodefirewall_node_packet_deny_total 2" in text
-    finally:
-        a.unregister()
-        b.unregister()
-        assert "deny_total 0" in st.render_registry_text()
-        with st._registry_lock:
-            st._registry.extend(saved)
+    a.register(reg); a.register(reg)  # regOnce: idempotent per registry
+    b.register(reg)
+    a.update_metrics(_FakeClf(2))
+    b.update_metrics(_FakeClf(3))
+    text = st.render_registry_text(reg)
+    assert "ingressnodefirewall_node_packet_deny_total 5" in text
+    assert "ingressnodefirewall_node_packet_deny_bytes 500" in text
+    b.unregister()
+    text = reg.render_text()
+    assert "ingressnodefirewall_node_packet_deny_total 2" in text
+    a.unregister()
+    b.unregister()  # no-op double unregister
+    assert "deny_total 0" in reg.render_text()
+
+
+def test_statistics_registry_weakrefs():
+    """A collector registered and then dropped without unregister (a
+    crash-looped daemon construction) must fall out of the exposition
+    with the instance instead of inflating sums forever (round-3 advisor
+    finding)."""
+    import gc
+
+    from infw.obs import statistics as st
+
+    reg = st.Registry()
+    a = st.Statistics()
+    a.register(reg)
+    with a._lock:
+        a._values["packet_deny_total"] = 7
+    assert "deny_total 7" in reg.render_text()
+    del a
+    gc.collect()
+    assert reg.collectors() == []
+    assert "deny_total 0" in reg.render_text()
+
+
+def test_statistics_register_moves_between_registries():
+    from infw.obs import statistics as st
+
+    r1, r2 = st.Registry(), st.Registry()
+    a = st.Statistics()
+    a.register(r1)
+    a.register(r2)  # move: must leave r1
+    assert r1.collectors() == []
+    assert r2.collectors() == [a]
+    a.unregister()
+    assert r2.collectors() == []
