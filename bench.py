@@ -12,22 +12,33 @@ same series as previous rounds):
      (bpf/ingress_node_firewall_kernel.c:218-219, map :43-57).
   2. config 5a: 10M-packet frames-file replay through the daemon's
      pipelined ingest (read + vectorized parse + classify + verdict
-     sidecar + stats/events), sustained packets/s.
+     sidecar + stats/events), sustained packets/s, min of 3 passes.
   3. config 5b: 1M-entry adversarial overlap table classified on chip.
-  4. wire-path p50 verdict latency (pack -> H2D -> classify -> 2B/packet
-     readback), small-batch sweep.
-  5. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
+  4. config 4: 8 interfaces x per-iface rulesets, mixed-ifindex batch.
+  5. 1-key incremental device update latency.
+  6. wire-path p50 verdict latency (pack -> H2D -> classify -> 2B/packet
+     readback), batch sweep 32..4096 incl. pinned-device-input mode.
+  7. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
      dense kernel.
+
+After all tiers, every recorded metric line is RE-EMITTED in one final
+block (headline last) so drivers that keep only the output tail still
+record the full set.
 
 Timing methodology (the device is reached through a tunnel whose dispatch
 layer memoizes repeated identical executions and whose block_until_ready
 is unreliable): K classify iterations are CHAINED on-device inside one
-jitted fori_loop — iteration i+1's ports depend on iteration i's verdicts,
-so no caching or reordering is possible — and only a scalar checksum is
-read back.  Throughput is the two-point slope (K=k2 minus K=k1)/(k2-k1),
-which cancels the fixed RPC/dispatch overhead exactly.  The replay tier
-instead times wall-clock over the daemon's real ingest loop with fresh
-file contents per iteration.
+jitted fori_loop — iteration i+1's ports AND ip words depend on iteration
+i's verdicts, so no caching, reordering, or loop-invariant hoisting is
+possible — and only a scalar checksum is read back.  Chaining the ip
+words matters: with only the port chained the LPM stage is loop-invariant
+and XLA hoists it out of the loop entirely (rounds 2-3 published
+rule-scan-only trie numbers that were 30x+ optimistic because of this).
+Throughput is the two-point slope (K=k2 minus K=k1)/(k2-k1) with k2 grown
+until the signal clears the tunnel's per-call jitter, which cancels the
+fixed RPC/dispatch overhead exactly.  The replay tier instead times
+wall-clock over the daemon's real ingest loop with fresh file contents
+per pass (min of 3).
 """
 import json
 import os
@@ -52,14 +63,31 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def emit(metric, value, unit, vs_baseline=None):
-    print(json.dumps({
+#: every metric line emitted during the run, re-printed as one final
+#: block so a driver that keeps only the output tail still records the
+#: FULL metric set (round-3 lost the 100K-CIDR line to tail truncation)
+_RECORDED = []
+
+
+def emit(metric, value, unit, vs_baseline=None, record=True):
+    line = json.dumps({
         "metric": metric,
         "value": round(value, 3 if value < 1e3 else 1),
         "unit": unit,
         "vs_baseline": round(vs_baseline if vs_baseline is not None
                              else value / TARGET, 3),
-    }), flush=True)
+    })
+    if record:
+        _RECORDED.append(line)
+    print(line, flush=True)
+
+
+def re_emit_recorded():
+    """Re-print every recorded metric line in one contiguous final block
+    (the headline is emitted after this, keeping it the last line)."""
+    log(f"re-emitting {len(_RECORDED)} recorded metric lines")
+    for line in _RECORDED:
+        print(line, flush=True)
 
 
 def fail(reason):
@@ -70,17 +98,36 @@ def fail(reason):
 
 def chained_throughput(classify_step, dt, db, n_packets, on_tpu, label):
     """Two-point slope of an on-device chained fori_loop (see module
-    docstring).  classify_step(dt, batch) -> u32 results."""
+    docstring).  classify_step(dt, batch) -> u32 results.
+
+    The chain feeds the results back into BOTH dst_port and the ip words
+    (low nibble, word 0 for v4 / word 3 for v6, preserving the v4
+    zero-word invariant).  The ip feedback is what makes the number
+    honest: with only the port chained, the LPM stage (trie walk / dense
+    compare) is loop-invariant and XLA HOISTS IT OUT of the fori_loop —
+    rounds 2-3 reported rule-scan-only throughput for the XLA trie tiers
+    (30x+ optimistic; the Pallas headline was unaffected, a pallas_call
+    is opaque to loop-invariant code motion)."""
+    from infw.constants import KIND_IPV4
+
+    word_sel = (
+        jnp.arange(4, dtype=jnp.int32)[None, :]
+        == jnp.where(db.kind == KIND_IPV4, 0, 3)[:, None]
+    )
 
     @jax.jit
     def loop(k, dt, db):
         def step(i, carry):
-            dport, acc = carry
-            res = classify_step(dt, db._replace(dst_port=dport))
+            dport, ip, acc = carry
+            res = classify_step(dt, db._replace(dst_port=dport, ip_words=ip))
             dport = (dport + (res & 1).astype(jnp.int32)) % 65536
-            return dport, acc + jnp.sum(res.astype(jnp.uint32))
+            pert = (res & 0xF) ^ (i.astype(jnp.uint32) & 0xF)
+            ip = jnp.where(word_sel, ip ^ pert[:, None], ip)
+            return dport, ip, acc + jnp.sum(res.astype(jnp.uint32))
 
-        return jax.lax.fori_loop(0, k, step, (db.dst_port, jnp.uint32(0)))[1]
+        return jax.lax.fori_loop(
+            0, k, step, (db.dst_port, db.ip_words, jnp.uint32(0))
+        )[2]
 
     t0 = time.perf_counter()
     int(loop(1, dt, db))
@@ -92,27 +139,44 @@ def chained_throughput(classify_step, dt, db, n_packets, on_tpu, label):
     t0 = time.perf_counter()
     int(loop(k1, dt, db))
     log(f"{label}: warmup k={k1} {time.perf_counter()-t0:.1f}s")
-    # A tunnel hiccup on either sample corrupts the slope; take the
-    # per-k minimum over a few attempts before declaring non-monotonic.
-    best1 = best2 = float("inf")
+
+    def best_of(k, attempts=3):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            int(loop(k, dt, db))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # SIGNAL RESOLUTION: the per-call RPC jitter through the tunnel is
+    # tens of ms, so the k2-k1 time difference must be grown until it
+    # dominates — a fixed k2=23 under-resolves fast kernels (a 0.3 ms/iter
+    # walk gives a 6 ms signal against 40 ms noise; round-3's family-split
+    # numbers wandered 3-8x between runs because of exactly this).  Grow
+    # k2 until the measured difference clears _MIN_SIGNAL_S, then take
+    # min-of-3 per point.
+    _MIN_SIGNAL_S = 0.5 if on_tpu else 0.05
+    best1 = best_of(k1)
     dt_s = -1.0
-    for attempt in range(3):
-        t0 = time.perf_counter(); int(loop(k1, dt, db)); t1 = time.perf_counter()
-        t2 = time.perf_counter(); int(loop(k2, dt, db)); t3 = time.perf_counter()
-        best1 = min(best1, t1 - t0)
-        best2 = min(best2, t3 - t2)
-        dt_s = (best2 - best1) / (k2 - k1)
-        if dt_s > 0:
+    while True:
+        best2 = best_of(k2)
+        signal = best2 - best1
+        if signal >= _MIN_SIGNAL_S or k2 >= 6000:
             break
-        log(f"{label}: non-monotonic sample (attempt {attempt + 1}/3) "
-            f"k={k1}:{best1:.3f}s k={k2}:{best2:.3f}s")
+        grow = 4 if signal <= 0 else min(
+            4, max(2, int(_MIN_SIGNAL_S / max(signal, 1e-3) + 1))
+        )
+        k2 *= grow
+        log(f"{label}: growing k2 -> {k2} (signal {signal*1e3:.0f} ms "
+            f"below {_MIN_SIGNAL_S*1e3:.0f} ms floor)")
+    dt_s = (best2 - best1) / (k2 - k1)
     if dt_s <= 0:
         raise RuntimeError(
             f"{label}: non-monotonic timing k={k1}:{best1:.3f}s k={k2}:{best2:.3f}s"
         )
     thr = n_packets / dt_s
     log(f"{label}: {thr/1e6:.2f} M classifications/s "
-        f"({dt_s*1e3:.2f} ms / {n_packets} packets, slope k={k1}->k={k2})")
+        f"({dt_s*1e3:.3f} ms / {n_packets} packets, slope k={k1}->k={k2})")
     return thr
 
 
@@ -153,52 +217,96 @@ def family_split_throughput(dt, batch, on_tpu, label):
 
 
 def spot_check(fn_results, tables, batch, n=2000, label=""):
+    """Differential verdict check vs the oracle.  Above _SCALAR_LIMIT
+    packets the LPM-by-hash oracle is the ground truth (O(mask lens) per
+    packet vs the scalar oracle's O(entries)); the hash oracle itself is
+    cross-validated against the scalar one on the first 2000 packets, so
+    the scalar transliteration stays the root of trust."""
+    _SCALAR_LIMIT = 4000
+    n = min(n, len(batch))
     sub = batch.slice(0, n)
-    ref = oracle.classify(tables, sub)
+    t0 = time.perf_counter()
+    if n <= _SCALAR_LIMIT and tables.num_entries <= 20_000:
+        ref = oracle.classify(tables, sub).results
+    else:
+        h = oracle.HashLpmOracle(tables)
+        ref = h.classify(sub).results
+        # scalar cross-check budget ~2e7 entry-visits (~10s of Python);
+        # the hash results for the prefix are already in ref
+        n_cross = min(2000, max(50, int(2e7 / max(1, tables.num_entries))))
+        scalar = oracle.classify(tables, batch.slice(0, n_cross)).results
+        if not (ref[:n_cross] == scalar).all():
+            raise RuntimeError(f"{label}: hash oracle disagrees with scalar oracle")
     got = fn_results(sub)
-    if not (got == ref.results).all():
+    if not (got == ref).all():
         raise RuntimeError(f"{label}: verdict mismatch vs oracle")
-    log(f"{label}: verdict spot-check vs oracle OK ({n} packets)")
+    log(f"{label}: verdict spot-check vs oracle OK "
+        f"({n} packets, {time.perf_counter()-t0:.1f}s)")
+
+
+# --- shared XLA-trie tier body (configs 3, 4, 5b) --------------------------
+
+
+def trie_tier(rng, on_tpu, *, label, metric_of, table_kw, spot_n,
+              batch_check=None):
+    """One trie-path tier: build table -> upload -> compile wire path ->
+    spot-check vs oracle -> family-split chained throughput -> emit.
+    Shared by the 100K-CIDR, 1M-adversarial and 8-iface tiers so a
+    methodology fix lands in all of them at once."""
+    t0 = time.perf_counter()
+    tables = testing.random_tables_fast(rng, **table_kw)
+    log(f"{label}: table build {time.perf_counter()-t0:.1f}s "
+        f"entries={tables.num_entries} levels={tables.levels} "
+        f"trie nodes={sum(l.shape[0] for l in tables.trie_levels)//256}")
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    if batch_check is not None:
+        batch_check(batch)
+    t0 = time.perf_counter()
+    dt = jaxpath.device_tables(tables)
+    wire_fn = jaxpath.jitted_classify_wire(True)
+    np.asarray(wire_fn(dt, jnp.asarray(batch.slice(0, 2000).pack_wire()))[0])
+    log(f"{label}: upload+compile+first {time.perf_counter()-t0:.1f}s")
+
+    def results_of(sub):
+        res16 = np.asarray(wire_fn(dt, jnp.asarray(sub.pack_wire()))[0])
+        return jaxpath.host_finalize_wire(res16, sub.kind)[0]
+
+    spot_check(results_of, tables, batch,
+               n=spot_n if on_tpu else 2_000, label=label)
+
+    thr = family_split_throughput(dt, batch, on_tpu, label)
+    emit(metric_of(tables), thr, "packets/s")
+    return tables
 
 
 # --- config 3: 100K-CIDR trie --------------------------------------------
 
 
 def bench_trie_100k(rng, on_tpu):
-    t0 = time.perf_counter()
-    n_entries = 100_000 if on_tpu else 2_000
-    tables = testing.random_tables_fast(rng, n_entries=n_entries, width=8,
-                                        ifindexes=(2, 3, 4))
-    log(f"trie100k: table build {time.perf_counter()-t0:.1f}s "
-        f"entries={tables.num_entries} levels={tables.levels}")
-    n_packets = 2**20 if on_tpu else 2**14
-    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
-    dt = jaxpath.device_tables(tables)
-
-    wire_fn = jaxpath.jitted_classify_wire(True)
-    t0 = time.perf_counter()
-    np.asarray(wire_fn(dt, jnp.asarray(batch.slice(0, 2000).pack_wire()))[0])
-    log(f"trie100k: compile+first {time.perf_counter()-t0:.1f}s")
-
-    def results_of(sub):
-        res16 = np.asarray(wire_fn(dt, jnp.asarray(sub.pack_wire()))[0])
-        return jaxpath.host_finalize_wire(res16, sub.kind)[0]
-
-    spot_check(results_of, tables, batch, label="trie100k")
-
-    thr = family_split_throughput(dt, batch, on_tpu, "trie100k")
-    emit(
-        f"packet classifications/sec/chip @{tables.num_entries // 1000}K CIDRs "
-        "(variable-stride LPM trie, XLA, family-split chunks)",
-        thr, "packets/s",
+    return trie_tier(
+        rng, on_tpu, label="trie100k", spot_n=100_000,
+        table_kw=dict(n_entries=100_000 if on_tpu else 2_000, width=8,
+                      ifindexes=(2, 3, 4)),
+        metric_of=lambda t: (
+            f"packet classifications/sec/chip @{t.num_entries // 1000}K CIDRs "
+            "(variable-stride LPM trie, XLA, family-split chunks)"
+        ),
     )
-    return tables
 
 
 # --- config 5a: 10M-packet replay through daemon ingest -------------------
 
 
-def bench_replay_10m(rng, tables, on_tpu):
+def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
+    """Config 5a.  Round-3's record showed a 4.7x gap between a local run
+    (1.17 M pkts/s) and the driver's (0.25 M) — the tier is H2D-bandwidth
+    bound through the tunnel, so a single timed pass is hostage to link
+    variance.  Now: min-of-N passes, per-pass DISTINCT file contents
+    (ifindex rolls — the tunnel memoizes identical executions, so reused
+    bytes would fake the later passes), and a logged phase breakdown
+    (host read+parse+pack vs device round trips) plus the effective H2D
+    bandwidth so the record shows WHERE a slow pass went."""
     from infw.backend.tpu import TpuClassifier
     from infw.daemon import write_frames_file_v2
     from infw.obs.events import EventRing
@@ -212,7 +320,8 @@ def bench_replay_10m(rng, tables, on_tpu):
     fb = build_frames_bulk(batch.kind, batch.ip_words, batch.proto,
                            batch.dst_port, batch.icmp_type, batch.icmp_code,
                            l4_ok=batch.l4_ok)
-    fb.ifindex = np.asarray(batch.ifindex, np.uint32)
+    base_ifx = np.asarray(batch.ifindex, np.uint32)
+    fb.ifindex = base_ifx
     log(f"replay: synthesized {n_file} frames in {time.perf_counter()-t0:.1f}s "
         f"({len(fb.buf)/1e6:.0f} MB)")
 
@@ -221,7 +330,7 @@ def bench_replay_10m(rng, tables, on_tpu):
 
     state_dir = tempfile.mkdtemp(prefix="infw-bench-")
     try:
-        from infw.daemon import Daemon
+        from infw.daemon import Daemon, parse_frames_buf, read_frames_any
 
         d = Daemon.__new__(Daemon)  # ingest-only harness: no watch threads
         d.ingest_dir = os.path.join(state_dir, "ingest")
@@ -241,28 +350,79 @@ def bench_replay_10m(rng, tables, on_tpu):
         d.syncer = _Syncer()
 
         n_files = n_total // n_file
+
+        # the table's live ifindex domain, derived (not assumed): rotation
+        # permutes WITHIN it and leaves miss traffic (out-of-domain
+        # ifindexes from the batch generator) untouched, so every pass
+        # replays the same hit/miss workload mix as the nominal batch
+        live = np.asarray(tables.mask_len[: tables.num_entries]) >= 0
+        dom = np.unique(
+            np.asarray(tables.key_words[: tables.num_entries, 0])[live]
+        ).astype(np.uint32)
+        pos = np.searchsorted(dom, base_ifx)
+        pos_ok = (pos < len(dom)) & (dom[np.minimum(pos, len(dom) - 1)] == base_ifx)
+
+        def write_pass_files(p):
+            """Distinct content per (pass, file): roll + rotate the
+            ifindex column (feeds wire word 2 -> every device execution
+            is unique)."""
+            t0 = time.perf_counter()
+            for i in range(n_files):
+                k = p * n_files + i
+                rot = dom[(pos + k) % len(dom)]
+                ifx = np.where(pos_ok, rot, base_ifx).astype(np.uint32)
+                fb.ifindex = np.roll(ifx, 977 * k)
+                write_frames_file_v2(
+                    os.path.join(d.ingest_dir, f"f{i:03d}.frames"), fb
+                )
+            return time.perf_counter() - t0
+
         # warmup: compile both family-specialized wire paths
+        fb.ifindex = base_ifx
         write_frames_file_v2(os.path.join(d.ingest_dir, "warm.frames"), fb)
         t0 = time.perf_counter()
         d.process_ingest_once()
         log(f"replay: warmup (compile) {time.perf_counter()-t0:.1f}s")
 
+        # host-phase cost (read+parse+pack), measured once on one file:
+        # the pipelined tick overlaps this with device work, so it is the
+        # floor the daemon could hit if the link were free.
+        path0 = os.path.join(d.ingest_dir, "probe.frames")
+        write_frames_file_v2(path0, fb)
         t0 = time.perf_counter()
-        for i in range(n_files):
-            write_frames_file_v2(
-                os.path.join(d.ingest_dir, f"f{i:03d}.frames"), fb
-            )
-        t_write = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        done = d.process_ingest_once()
-        dt_s = time.perf_counter() - t0
-        assert done == n_files, f"processed {done}/{n_files}"
-        thr = n_total / dt_s
-        log(f"replay: {n_files} files x {n_file} packets in {dt_s:.1f}s "
-            f"(+{t_write:.1f}s file write) -> {thr/1e6:.2f} M packets/s; "
-            f"ring lost_samples={d.ring.lost_samples}")
+        pfb = read_frames_any(path0)
+        pbatch = parse_frames_buf(pfb)
+        _ = pbatch.pack_wire_subset(
+            np.arange(len(pbatch), dtype=np.int64)
+        )
+        t_host_file = time.perf_counter() - t0
+        os.remove(path0)
+        log(f"replay: host phase (read+parse+pack) {t_host_file:.2f}s/file "
+            f"-> {n_file/t_host_file/1e6:.2f} M pkts/s host-only floor")
+
+        best_dt, pass_times = float("inf"), []
+        for p in range(n_passes):
+            t_write = write_pass_files(p)
+            t0 = time.perf_counter()
+            done = d.process_ingest_once()
+            dt_s = time.perf_counter() - t0
+            assert done == n_files, f"processed {done}/{n_files}"
+            pass_times.append(dt_s)
+            best_dt = min(best_dt, dt_s)
+            # v4-compact wire is 16B/packet H2D; fused readback ~2B+stats
+            h2d_mb = n_total * 16 / 1e6
+            log(f"replay pass {p}: {n_files} x {n_file} packets in {dt_s:.1f}s "
+                f"(+{t_write:.1f}s file write) -> {n_total/dt_s/1e6:.2f} M "
+                f"pkts/s; ~{h2d_mb/dt_s:.0f} MB/s effective H2D; "
+                f"device-attributable ~{max(dt_s - n_files*t_host_file, 0):.1f}s "
+                f"if unpipelined host cost {n_files*t_host_file:.1f}s; "
+                f"ring lost_samples={d.ring.lost_samples}")
+        thr = n_total / best_dt
+        log(f"replay: min-of-{n_passes} {thr/1e6:.2f} M packets/s "
+            f"(passes: {', '.join(f'{t:.1f}s' for t in pass_times)})")
         emit(
-            f"daemon ingest replay sustained @{n_total/1e6:.0f}M packets "
+            f"daemon ingest replay sustained @{n_total/1e6:.0f}M packets, "
+            f"min of {n_passes} "
             f"({tables.num_entries // 1000}K-CIDR trie, incl. file read + "
             "parse + verdict sidecar + stats)",
             thr, "packets/s",
@@ -275,32 +435,40 @@ def bench_replay_10m(rng, tables, on_tpu):
 
 
 def bench_adversarial_1m(rng, on_tpu):
-    n_entries = 1_000_000 if on_tpu else 10_000
-    t0 = time.perf_counter()
-    tables = testing.random_tables_fast(rng, n_entries=n_entries, width=4,
-                                        group_size=16)
-    log(f"adv1m: table build {time.perf_counter()-t0:.1f}s "
-        f"entries={tables.num_entries} levels={tables.levels} "
-        f"trie nodes={sum(l.shape[0] for l in tables.trie_levels)//256}")
-    n_packets = 2**20 if on_tpu else 2**14
-    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
-    t0 = time.perf_counter()
-    dt = jaxpath.device_tables(tables)
-    log(f"adv1m: device upload {time.perf_counter()-t0:.1f}s")
+    trie_tier(
+        rng, on_tpu, label="adv1m", spot_n=100_000,
+        table_kw=dict(n_entries=1_000_000 if on_tpu else 10_000, width=4,
+                      group_size=16),
+        metric_of=lambda t: (
+            f"packet classifications/sec/chip @{t.num_entries/1e6:.0f}M-entry "
+            "adversarial overlap table (LPM trie, XLA, family-split chunks)"
+        ),
+    )
 
-    wire_fn = jaxpath.jitted_classify_wire(True)
 
-    def results_of(sub):
-        res16 = np.asarray(wire_fn(dt, jnp.asarray(sub.pack_wire()))[0])
-        return jaxpath.host_finalize_wire(res16, sub.kind)[0]
+# --- config 4: 8 interfaces x per-iface rule tables ------------------------
 
-    spot_check(results_of, tables, batch, n=1000, label="adv1m")
 
-    thr = family_split_throughput(dt, batch, on_tpu, "adv1m")
-    emit(
-        f"packet classifications/sec/chip @{tables.num_entries/1e6:.0f}M-entry "
-        "adversarial overlap table (LPM trie, XLA, family-split chunks)",
-        thr, "packets/s",
+def bench_8iface(rng, on_tpu):
+    """BASELINE config 4: one chip serving 8 interfaces, each with its own
+    ruleset (the reference's per-iface LPM key space — ifindex is the top
+    32 bits of the key, interfaces.go:85-116 expands bonds into member
+    indices the same way).  The batch mixes all 8 ifindexes; the root LUT
+    steers each packet into its interface's trie subtree."""
+    def check(batch):
+        ifx = np.asarray(batch.ifindex)
+        n_if = len(np.unique(ifx[(ifx >= 2) & (ifx < 10)]))
+        assert n_if == 8, f"batch covers {n_if}/8 interfaces"
+
+    trie_tier(
+        rng, on_tpu, label="8iface", spot_n=50_000, batch_check=check,
+        table_kw=dict(n_entries=100_000 if on_tpu else 2_000, width=8,
+                      ifindexes=tuple(range(2, 10))),
+        metric_of=lambda t: (
+            f"packet classifications/sec/chip, 8 ifaces x per-iface "
+            f"rulesets @{t.num_entries // 1000}K entries "
+            "(mixed-ifindex batch, LPM trie)"
+        ),
     )
 
 
@@ -370,7 +538,8 @@ def bench_wire_latency(tables, batch, on_tpu):
     dt = jaxpath.device_tables(tables)
     fn = jaxpath.jitted_classify_wire(False)
     best = None
-    for bs in (256, 1024, 4096):
+    pinned_small = []
+    for bs in (32, 64, 128, 256, 1024, 4096):
         sub = batch.slice(0, bs)
         wires = []
         for i in range(12):
@@ -385,8 +554,34 @@ def bench_wire_latency(tables, batch, on_tpu):
             np.asarray(res16)
             lats.append(time.perf_counter() - t0)
         p50 = sorted(lats)[len(lats) // 2]
+        # Pinned-input latency mode: the wire buffers are device-resident
+        # BEFORE the clock starts (a latency-sensitive on-node deployment
+        # keeps a pinned ring of input buffers), so the measured path is
+        # classify + readback only.  The pinned set is perturbed
+        # DIFFERENTLY from the unpinned wires above — re-executing those
+        # byte-identical inputs would hit the tunnel's memoization and
+        # time cached replays.
+        pwires = []
+        for i in range(12):
+            s = sub.slice(0, bs)
+            s.dst_port = ((s.dst_port.astype(np.int64) + 7000 + i) % 65536).astype(np.int32)
+            pwires.append(s.pack_wire())
+        dev_wires = [jax.device_put(w) for w in pwires]
+        for dw in dev_wires:
+            dw.block_until_ready()
+        plats = []
+        for dw in dev_wires[2:]:
+            t0 = time.perf_counter()
+            res16, _stats = fn(dt, dw)
+            np.asarray(res16)
+            plats.append(time.perf_counter() - t0)
+        pin50 = sorted(plats)[len(plats) // 2]
         log(f"wire p50 @batch={bs}: {p50*1e3:.3f} ms "
-            f"({p50/bs*1e9:.0f} ns/packet amortized)")
+            f"({p50/bs*1e9:.0f} ns/packet amortized); "
+            f"pinned-input {pin50*1e3:.3f} ms "
+            f"(above floor {max(pin50-floor,0.0)*1e3:.3f} ms)")
+        if bs <= 128:
+            pinned_small.append((bs, pin50))
         if best is None or p50 < best[1]:
             best = (bs, p50)
     emit(
@@ -398,6 +593,12 @@ def bench_wire_latency(tables, batch, on_tpu):
         "p50 verdict latency above link floor (dataplane-attributable)",
         max(best[1] - floor, 0.0) * 1e3, "ms", vs_baseline=0.0,
     )
+    for bs, pin50 in pinned_small:
+        emit(
+            f"p50 verdict latency above link floor @batch={bs} "
+            "(pinned device input)",
+            max(pin50 - floor, 0.0) * 1e3, "ms", vs_baseline=0.0,
+        )
 
 
 # --- config 2 headline -----------------------------------------------------
@@ -467,6 +668,10 @@ def main():
     except Exception as e:
         log(f"adv1m FAILED: {e}")
     try:
+        bench_8iface(rng, on_tpu)
+    except Exception as e:
+        log(f"8iface FAILED: {e}")
+    try:
         bench_incremental_update(rng, on_tpu)
     except Exception as e:
         log(f"incremental update FAILED: {e}")
@@ -480,10 +685,15 @@ def main():
     except Exception as e:
         log(f"wire latency FAILED: {e}")
 
+    # Truncation-proof record: every tier's metric line again in one
+    # contiguous block, then the headline LAST (drivers that parse the
+    # final line keep recording the same series as previous rounds; a
+    # tail-capture driver now gets the full set either way).
+    re_emit_recorded()
     emit(
         "packet classifications/sec/chip @100K rules "
         "(1000 CIDRs x 100 rules, Pallas int8 dense)",
-        thr, "packets/s",
+        thr, "packets/s", record=False,
     )
     return 0
 

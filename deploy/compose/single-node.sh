@@ -6,6 +6,11 @@
 # three containers (shared state volume -> state dir, syslog unix socket
 # -> unixgram events socket, metrics 39301 / health 39300).
 #
+# The composition itself — component run lines, launch order, env
+# contract — is declared in deploy/bundle/manifest.json (the OLM bundle
+# role); this script only resolves the deployment knobs and delegates to
+# the bundle-driven launcher.
+#
 # Usage: deploy/compose/single-node.sh [STATE_DIR] [BACKEND]
 set -euo pipefail
 
@@ -15,29 +20,8 @@ NODE_NAME="${NODE_NAME:-$(hostname)}"
 EVENTS_SOCK="${INFW_EVENTS_SOCKET:-$STATE_DIR/events.sock}"
 REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
 
-mkdir -p "$STATE_DIR"
-cd "$REPO_DIR"
-
-pids=()
-cleanup() { kill "${pids[@]}" 2>/dev/null || true; wait || true; }
-trap cleanup EXIT INT TERM
-
-# events sidecar first so the daemon's datagrams have a listener
-python -m infw.obs.sidecar --socket "$EVENTS_SOCK" &
-pids+=($!)
-
-# manager: fan-out controller + admission + NodeState export; CRs are
-# applied by dropping IngressNodeFirewall JSONs into $STATE_DIR/apply
-# (admission verdicts land beside them as <name>.status.json)
-DAEMONSET_IMAGE="${DAEMONSET_IMAGE:-infw:latest}" \
-DAEMONSET_NAMESPACE="${DAEMONSET_NAMESPACE:-ingress-node-firewall-system}" \
-python -m infw.manager --export-dir "$STATE_DIR" --apply-dir "$STATE_DIR/apply" \
-  --register-node "$NODE_NAME" &
-pids+=($!)
-
-# daemon in the foreground (no exec: the EXIT trap must outlive it so a
-# daemon crash also tears down the sidecar and manager)
-NODE_NAME="$NODE_NAME" python -m infw.daemon \
+exec python "$REPO_DIR/deploy/launch.py" \
   --state-dir "$STATE_DIR" \
   --backend "$BACKEND" \
+  --node-name "$NODE_NAME" \
   --events-socket "$EVENTS_SOCK"

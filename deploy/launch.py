@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Bundle-driven composition launcher.
+
+The OLM bundle analogue made operational: where the reference's bundle/
+ClusterServiceVersion tells OLM how to install and run the operator
+(/root/reference/bundle/manifests/ingress-node-firewall.clusterserviceversion.yaml
+declares the deployments, env contract and RBAC), this launcher READS
+``deploy/bundle/manifest.json`` and brings up the declared composition —
+events sidecar, manager (fan-out + apply dir), daemon (dataplane) — as
+supervised processes wired through a shared state dir and events socket,
+exactly like the reference daemonset wires its three containers
+(bindata/manifests/daemon/daemonset.yaml:25-113).
+
+Usage:
+    python deploy/launch.py --state-dir /var/lib/infw [--backend tpu|cpu]
+        [--node-name NAME] [--dry-run]
+
+The component commands, their order, and the env contract all come from
+the bundle; nothing here hand-rolls a run line.  Required env vars that
+have well-known deployment defaults (DAEMONSET_IMAGE etc.) are defaulted
+the way the kustomize overlays default them; any remaining missing
+required var is a launch error naming the component and variable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+
+BUNDLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bundle", "manifest.json")
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: deployment defaults for required env (the kustomize overlay role);
+#: anything already in the environment wins
+ENV_DEFAULTS = {
+    "DAEMONSET_IMAGE": "infw:latest",
+    "DAEMONSET_NAMESPACE": "ingress-node-firewall-system",
+}
+
+
+def load_bundle(path: str = BUNDLE_PATH) -> dict:
+    with open(path) as f:
+        bundle = json.load(f)
+    if bundle.get("schema") != "infw.bundle/v1":
+        raise SystemExit(f"{path}: unsupported bundle schema "
+                         f"{bundle.get('schema')!r}")
+    return bundle
+
+
+def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
+    """[(name, argv, env)] in bundle launch order.  ``subs`` fills the
+    run templates' <placeholders>; ``extra_args`` appends per-component
+    argv (e.g. ephemeral ports for tests)."""
+    components = bundle["components"]
+    order = bundle.get("launchOrder", sorted(components))
+    unknown = [n for n in order if n not in components]
+    if unknown:
+        raise SystemExit(f"bundle launchOrder names unknown components: {unknown}")
+    plan = []
+    for name in order:
+        comp = components[name]
+        run = comp["run"]
+        for key, val in subs.items():
+            run = run.replace(f"<{key}>", str(val))
+        if "<" in run:
+            raise SystemExit(
+                f"component {name}: unfilled placeholder in run line: {run}"
+            )
+        argv = shlex.split(run)
+        argv[0] = sys.executable  # the bundle says "python"; use ours
+        env = dict(os.environ)
+        # Override, don't setdefault: --node-name must name the WHOLE
+        # composition — a stray exported NODE_NAME would otherwise split
+        # it (manager registers --node-name while the daemon reads env
+        # and polls for a NodeState that never appears).
+        if subs.get("node-name"):
+            env["NODE_NAME"] = str(subs["node-name"])
+        for var, default in ENV_DEFAULTS.items():
+            env.setdefault(var, default)
+        missing = [
+            var for var in comp.get("env", {}).get("required", [])
+            if not env.get(var)
+        ]
+        if missing:
+            raise SystemExit(
+                f"component {name}: missing required env {missing} "
+                "(bundle env contract)"
+            )
+        argv += (extra_args or {}).get(name, [])
+        plan.append((name, argv, env))
+    return plan
+
+
+def launch(plan, state_dir: str, on_spawn=None) -> int:
+    """Spawn the plan in order; supervise until the LAST component (the
+    daemon — the dataplane is the composition's reason to exist) exits or
+    a signal arrives, then tear everything down in reverse order."""
+    os.makedirs(state_dir, exist_ok=True)
+    procs = []
+
+    def teardown(*_a):
+        for name, p in reversed(procs):
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 15
+        for name, p in reversed(procs):
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    signal.signal(signal.SIGTERM, lambda *_a: sys.exit(143))
+    try:
+        for name, argv, env in plan:
+            log_path = os.path.join(state_dir, f"{name}.log")
+            with open(log_path, "ab") as lf:
+                p = subprocess.Popen(
+                    argv, env=env, cwd=REPO_DIR,
+                    stdout=lf, stderr=subprocess.STDOUT,
+                )
+            procs.append((name, p))
+            print(f"launch: {name} pid={p.pid} log={log_path}", flush=True)
+            if on_spawn:
+                on_spawn(name, p)
+        # supervise: if ANY component dies, bring the composition down
+        # (the pod restart-policy role; an external supervisor restarts us)
+        while True:
+            for name, p in procs:
+                rc = p.poll()
+                if rc is not None:
+                    print(f"launch: {name} exited rc={rc}; tearing down",
+                          flush=True)
+                    return rc
+            time.sleep(0.3)
+    except (KeyboardInterrupt, SystemExit) as e:
+        return int(getattr(e, "code", 130) or 0)
+    finally:
+        teardown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--state-dir", default="/var/lib/infw")
+    ap.add_argument("--backend", default=os.environ.get("INFW_BACKEND", "tpu"),
+                    choices=("tpu", "cpu"))
+    ap.add_argument("--node-name",
+                    default=os.environ.get("NODE_NAME") or os.uname().nodename)
+    ap.add_argument("--events-socket", default=None,
+                    help="default: <state-dir>/events.sock")
+    ap.add_argument("--bundle", default=BUNDLE_PATH)
+    ap.add_argument("--ephemeral-ports", action="store_true",
+                    help="bind daemon metrics/health to ephemeral ports "
+                         "(tests / multiple compositions per host)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the launch plan and exit")
+    args = ap.parse_args(argv)
+
+    bundle = load_bundle(args.bundle)
+    state_dir = os.path.abspath(args.state_dir)
+    subs = {
+        "state-dir": state_dir,
+        "backend": args.backend,
+        "node-name": args.node_name,
+        "events-socket": args.events_socket
+        or os.path.join(state_dir, "events.sock"),
+    }
+    extra = (
+        {
+            "daemon": ["--metrics-port", "0", "--health-port", "0"],
+            "manager": ["--metrics-port", "0", "--health-port", "0"],
+        }
+        if args.ephemeral_ports else {}
+    )
+    plan = build_plan(bundle, subs, extra)
+    print(f"launch: bundle {bundle['name']} v{bundle['version']} "
+          f"({len(plan)} components)", flush=True)
+    if args.dry_run:
+        for name, argv_, env in plan:
+            print(f"  {name}: {' '.join(shlex.quote(a) for a in argv_)}")
+        return 0
+    return launch(plan, state_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

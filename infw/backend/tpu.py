@@ -126,6 +126,12 @@ class TpuClassifier:
                 # keep array shapes and can take the patch path
                 dev = jaxpath.device_tables(tables, self._device, pad=True)
                 self._last_load = ("full", tables.num_entries)
+                # Pre-compile the patch scatters against the fresh layout:
+                # the first post-load rule edit then ships in milliseconds
+                # instead of paying the scatter-jit compile (the pinned-map
+                # re-adoption contract is rules keep enforcing AND stay
+                # editable immediately, loader.go:381-407).
+                jaxpath.warm_patch_scatters(dev, self._device)
             block_b = None
         with self._lock:
             self._tables = tables

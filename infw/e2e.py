@@ -123,7 +123,14 @@ class Harness:
         iface: str = "eth0",
         ifindex: int = 2,
         node_labels: Optional[Dict[str, str]] = None,
+        classifier_factory: Optional[Callable] = None,
     ) -> None:
+        """``classifier_factory`` selects the dataplane under test —
+        CpuRefClassifier by default (CI), backend.tpu.TpuClassifier to
+        drive the same reachability tables against the device path (the
+        reference runs its one table engine against the real XDP
+        dataplane, e2e.go:856+; ours must run against the real TPU one
+        too, not only the C++ oracle)."""
         self.pods = {p.name: p for p in pods}
         self.node_name = node_name
         self.iface = iface
@@ -136,7 +143,8 @@ class Harness:
         self.registry = InterfaceRegistry()
         self.registry.add(Interface(name=iface, index=ifindex))
         self.syncer = DataplaneSyncer(
-            classifier_factory=CpuRefClassifier, registry=self.registry
+            classifier_factory=classifier_factory or CpuRefClassifier,
+            registry=self.registry,
         )
 
     def apply_rules(

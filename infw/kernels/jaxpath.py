@@ -135,6 +135,51 @@ def _host_device_layout(tables: CompiledTables, pad: bool):
     return key_words, mask_words, mask_len, rules, trie_levels, root_lut
 
 
+@functools.lru_cache(maxsize=None)
+def _sparse_expand_jit(n_rows: int):
+    """zeros(n_rows, 2) int32 scattered from (idx, vals) — the device
+    side of the sparse trie-level transfer.  One jit per level row count;
+    retraces per nnz shape are cheap and the persistent compile cache
+    carries them across processes."""
+    def f(idx, vals):
+        return jnp.zeros((n_rows, 2), jnp.int32).at[idx].set(vals)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _upcast_rules_jit():
+    return jax.jit(lambda r16: r16.astype(jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _mask_words_dev_jit():
+    """Reconstruct (T, 5) uint32 mask_words from mask_len on device —
+    mask words are pure prefix masks (compiler.py:789-792: ifindex word
+    fully masked on live rows, IP words from _mask_words_vec; dead and
+    padding rows are all-zero with the mask_len == -1 sentinel), so
+    shipping the 4-byte mask_len column reconstructs the 20-byte mask row
+    exactly."""
+    def f(mask_len):
+        valid = mask_len >= 0
+        w = jnp.arange(4, dtype=jnp.int32)[None, :]
+        bits = jnp.clip(mask_len[:, None] - 32 * w, 0, 32).astype(jnp.uint32)
+        full = jnp.uint32(0xFFFFFFFF)
+        ip = jnp.where(
+            bits > 0, (full << (jnp.uint32(32) - bits)) & full, 0
+        ).astype(jnp.uint32)
+        if0 = jnp.where(valid, full, 0).astype(jnp.uint32)[:, None]
+        return jnp.concatenate([if0, jnp.where(valid[:, None], ip, 0)], axis=1)
+
+    return jax.jit(f)
+
+
+#: ship a trie level sparse when its nonzero-row fraction is below this
+#: (sparse costs 12B/row shipped vs 8B/row dense, so the byte win starts
+#: at 2/3 — 0.5 keeps slack for the extra dispatch)
+_SPARSE_DENSITY_LIMIT = 0.5
+
+
 def device_tables(
     tables: CompiledTables, device=None, pad: bool = False
 ) -> DeviceTables:
@@ -143,17 +188,53 @@ def device_tables(
     edits keep array shapes, enabling patch_device_tables and avoiding
     per-size jit recompiles.  Padding rows carry the mask_len == -1
     sentinel so the dense match excludes them without a separate entry
-    count (and every array stays shardable along the target axis)."""
-    put = lambda a: jax.device_put(jnp.asarray(a), device)
+    count (and every array stays shardable along the target axis).
+
+    The TRANSFER layout is compacted — the restart-to-enforcement path
+    (the analogue of pinned-map re-adoption, loader.go:381-407) is
+    link-bandwidth bound at the 1M-entry tier (3.5GB of trie levels took
+    ~13 min through a ~5MB/s tunnel), so:
+      - trie levels ship sparse (index + nonzero rows; levels measure
+        ~1% dense at scale) and expand via on-device scatter;
+      - mask_words never ship (reconstructed on device from mask_len);
+      - rules ship as uint16 when their values fit (ports are the widest
+        field) and upcast on device.
+    The resident DeviceTables is bit-identical to a direct upload — the
+    patch path diffs against it with no knowledge of how it traveled."""
     key_words, mask_words, mask_len, rules, trie_levels, root_lut = (
         _host_device_layout(tables, pad)
     )
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+
+    # -- rules: narrow to u16 when every field fits ---------------------
+    if rules.size and 0 <= int(rules.min()) and int(rules.max()) < 65536:
+        rules_dev = _upcast_rules_jit()(put(rules.astype(np.uint16)))
+    else:
+        rules_dev = put(rules)  # empty, or wide values (adversarial content)
+
+    # -- trie levels: sparse scatter below the density limit ------------
+    levels_dev = []
+    for tbl in trie_levels:
+        n = tbl.shape[0]
+        if n == 0:
+            levels_dev.append(put(tbl))
+            continue
+        nnz = np.nonzero(np.ascontiguousarray(tbl).view(np.int64).reshape(-1))[0]
+        if len(nnz) <= n * _SPARSE_DENSITY_LIMIT:
+            levels_dev.append(
+                _sparse_expand_jit(n)(
+                    put(nnz.astype(np.int32)), put(tbl[nnz])
+                )
+            )
+        else:
+            levels_dev.append(put(tbl))
+
     return DeviceTables(
         key_words=put(key_words),
-        mask_words=put(mask_words),
+        mask_words=_mask_words_dev_jit()(put(mask_len)),
         mask_len=put(mask_len),
-        rules=put(rules),
-        trie_levels=tuple(put(tbl) for tbl in trie_levels),
+        rules=rules_dev,
+        trie_levels=tuple(levels_dev),
         root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
@@ -214,10 +295,10 @@ def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0
         # Large delta: a bucketed scatter would ship close to the full
         # array AND pay the device-side copy — the full upload wins.
         return None
-    # Bucket the scatter size to the next power of two (pad by repeating
-    # the last row — duplicate indices with identical values are a
-    # deterministic no-op) so the jit cache stays bounded.
-    cap = min(1 << max(3, (k - 1).bit_length()), nb)
+    # Pad the scatter to a capped size by repeating the last row —
+    # duplicate indices with identical values are a deterministic no-op —
+    # so the jit cache stays bounded and warmable (see _scatter_cap).
+    cap = _scatter_cap(k, nb)
     pidx = np.empty(cap, np.int64)
     pidx[:k] = idx
     pidx[k:] = idx[-1]
@@ -227,10 +308,53 @@ def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0
     return _scatter(dev_arr, pidx, prows, device), k
 
 
+#: every patch of <= this many rows shares ONE scatter executable per
+#: array shape — precompiled by warm_patch_scatters at load time; the
+#: padding transfer cost (256 rows of the widest row layout) is a few KB
+_PATCH_CAP = 256
+
+
+def _scatter_cap(k: int, nb: int) -> int:
+    """Padded scatter size for a k-row patch into an nb-row array: the
+    fixed _PATCH_CAP for every small patch (one warmable executable),
+    pow2 buckets only for rare large deltas."""
+    if nb <= _PATCH_CAP:
+        return nb
+    if k <= _PATCH_CAP:
+        return _PATCH_CAP
+    return min(1 << (k - 1).bit_length(), nb)
+
+
 def _scatter(dev_arr, pidx: np.ndarray, prows: np.ndarray, device):
     return _scatter_rows_jit()(
         dev_arr, jax.device_put(pidx, device), jax.device_put(prows, device)
     )
+
+
+def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
+    """Pre-compile the patch path's scatter executables so the FIRST
+    incremental update after a (re)load does not pay the scatter-jit
+    compile (~10s measured at the 1M tier).  The executable cache is
+    keyed on abstract shapes/dtypes, and every <= _PATCH_CAP-row patch
+    uses the SAME capped scatter shape (_scatter_cap), so one warm per
+    array shape covers all small edits.  Each warm scatters zeros into a
+    zeros SCRATCH array of the resident array's shape — no readback of
+    resident values, no touching the live tables; the scratch and its
+    scatter result are dropped as soon as the executable exists."""
+    seen = set()
+    for arr in (
+        dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
+        *dev.trie_levels, dev.root_lut,
+    ):
+        key = (arr.shape, str(arr.dtype))
+        if arr.shape[0] == 0 or key in seen:
+            continue
+        seen.add(key)
+        cap = _scatter_cap(1, arr.shape[0])
+        scratch = jax.device_put(jnp.zeros(arr.shape, arr.dtype), device)
+        pidx = np.zeros(cap, np.int64)
+        prows = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+        _scatter(scratch, pidx, prows, device)
 
 
 def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
@@ -251,7 +375,7 @@ def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
         return dev_arr, 0
     if k > nb // 4:
         return None
-    cap = min(1 << max(3, (k - 1).bit_length()), nb)
+    cap = _scatter_cap(k, nb)
     pidx = np.empty(cap, np.int64)
     pidx[:k] = rows
     pidx[k:] = rows[-1]

@@ -106,11 +106,11 @@ def _scan_rules(
     return UNDEF  # SET_ACTION(UNDEF) == 0
 
 
-def classify(tables: CompiledTables, batch: PacketBatch) -> ClassifyResult:
-    """Reference classification of a whole batch, including the ethertype
-    dispatch, stats accumulation and final XDP verdict of
-    ingress_node_firewall_main (kernel.c:412-457)."""
-    entries: List[Tuple[int, int, int, int]] = []
+def _dedup_entries(tables: CompiledTables):
+    """Masked-identity dedup of the table content (the map layer collapses
+    aliased keys; loader.go writes one map value per key).  Returns
+    (entries, rules_by_target) where entries are
+    (ifindex, mask_len, masked_ip_int, target)."""
     dedup: Dict[Tuple[int, int, bytes], int] = {}
     ordered: List[Tuple[Tuple[int, int, int, int], np.ndarray]] = []
     for key, rows in tables.content.items():
@@ -127,7 +127,24 @@ def classify(tables: CompiledTables, batch: PacketBatch) -> ClassifyResult:
             ordered.append(((*e, len(ordered)), rows))
     entries = [e for e, _ in ordered]
     rules_by_target = [rows for _, rows in ordered]
+    return entries, rules_by_target
 
+
+def classify(tables: CompiledTables, batch: PacketBatch) -> ClassifyResult:
+    """Reference classification of a whole batch, including the ethertype
+    dispatch, stats accumulation and final XDP verdict of
+    ingress_node_firewall_main (kernel.c:412-457)."""
+    entries, rules_by_target = _dedup_entries(tables)
+
+    def lookup(ifindex: int, ip_int: int, cap: int) -> int:
+        return _lpm_lookup(entries, ifindex, ip_int, cap)
+
+    return _classify_with_lookup(lookup, rules_by_target, batch)
+
+
+def _classify_with_lookup(
+    lookup, rules_by_target: List[np.ndarray], batch: PacketBatch
+) -> ClassifyResult:
     b = len(batch)
     results = np.zeros(b, np.uint32)
     xdp = np.zeros(b, np.int32)
@@ -149,7 +166,7 @@ def classify(tables: CompiledTables, batch: PacketBatch) -> ClassifyResult:
             for w in range(4):
                 ip_int = (ip_int << 32) | int(batch.ip_words[i, w])
             cap = V4_KEY_PREFIX_LEN if is_v4 else V6_KEY_PREFIX_LEN
-            target = _lpm_lookup(entries, int(batch.ifindex[i]), ip_int, cap)
+            target = lookup(int(batch.ifindex[i]), ip_int, cap)
             if target < 0:
                 result = UNDEF
             else:
@@ -173,6 +190,46 @@ def classify(tables: CompiledTables, batch: PacketBatch) -> ClassifyResult:
         else:
             xdp[i] = XDP_PASS  # UNDEF -> default pass, no stats (kernel.c:453-455)
     return ClassifyResult(results=results, xdp=xdp, stats=stats)
+
+
+class HashLpmOracle:
+    """LPM-by-hash oracle for large-table spot checks.
+
+    The scalar ``classify`` walks every entry per packet (O(entries) — the
+    direct transliteration of the BPF trie's longest-match semantics), so
+    differential checks at the 100K-1M-entry tiers could only afford a
+    few thousand packets.  This variant buckets the deduped entries by
+    mask length into hash maps keyed by (ifindex, masked-ip); lookup
+    probes mask lengths longest-first — O(distinct mask lens) per packet.
+    It shares the entry preprocessing, rule scan and per-packet dispatch
+    with the scalar oracle, but its lookup structure is independent of
+    both the scalar linear scan AND the tensor trie/dense encodings, so
+    it remains a meaningful differential ground truth (cross-validated
+    against the scalar oracle in tests and in bench spot checks)."""
+
+    def __init__(self, tables: CompiledTables) -> None:
+        entries, self._rules_by_target = _dedup_entries(tables)
+        buckets: Dict[int, Dict[Tuple[int, int], int]] = {}
+        for ifindex, mask_len, masked_ip, target in entries:
+            b = buckets.setdefault(mask_len, {})
+            b[(ifindex, masked_ip >> (128 - mask_len) if mask_len else 0)] = target
+        # longest-first probe order (strictly-greater tie-break of
+        # _lpm_lookup: equal lengths cannot coexist after dedup)
+        self._probe = sorted(buckets.items(), key=lambda kv: -kv[0])
+
+    def _lookup(self, ifindex: int, ip_int: int, cap: int) -> int:
+        for mask_len, bucket in self._probe:
+            if mask_len + 32 > cap:
+                continue  # entry longer than the packet-side key cap
+            t = bucket.get(
+                (ifindex, ip_int >> (128 - mask_len) if mask_len else 0)
+            )
+            if t is not None:
+                return t
+        return -1
+
+    def classify(self, batch: PacketBatch) -> ClassifyResult:
+        return _classify_with_lookup(self._lookup, self._rules_by_target, batch)
 
 
 def _bump(stats: Dict[int, List[int]], rule_id: int, deny: bool, length: int) -> None:

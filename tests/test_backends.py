@@ -506,6 +506,58 @@ def test_device_patch_matches_full_upload_under_churn():
     np.testing.assert_array_equal(got, ref.results)
 
 
+def test_compact_transfer_upload_bit_identical():
+    """device_tables ships a compacted transfer layout (sparse trie
+    levels, u16-narrowed rules, mask_words reconstructed on device from
+    mask_len) — the resident arrays must be bit-identical to a direct
+    device_put of the host layout, with tombstoned rows, both pad modes,
+    and the wide-ruleId (no-narrowing) fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    from infw.compiler import IncrementalTables, compile_tables_from_content
+    from infw.kernels import jaxpath
+    from test_compiler import _random_content
+
+    rng = np.random.default_rng(81)
+    content = _random_content(rng, 80)
+    # every mask_words reconstruction regime: /0 (zero IP mask), exactly
+    # one word (/32), multi-word v6 (/56, /96), full /128 — prefix_len is
+    # mask_len + 32 ifindex bits
+    rows = np.zeros((4, 7), np.int32)
+    rows[0] = [1, 6, 80, 0, 0, 0, 1]
+    for mask_len in (0, 32, 56, 96, 128):
+        ip = bytes([mask_len + 1] * 16)
+        content[LpmKey(mask_len + 32, 7, ip)] = rows
+    it = IncrementalTables.from_content(content, rule_width=4)
+    keys = list(content)
+    it.apply({}, deletes=[keys[3], keys[11], keys[40]])  # tombstones
+    variants = [it.snapshot()]
+    # wide ruleIds: disables the u16 narrowing
+    wide = _random_content(rng, 10)
+    k0 = next(iter(wide))
+    wide[k0] = wide[k0].copy()
+    wide[k0][0] = [70000, 6, 80, 0, 0, 0, 1]
+    variants.append(compile_tables_from_content(wide, rule_width=4))
+    variants.append(compile_tables_from_content({}, rule_width=4))  # empty
+    for tables in variants:
+        for pad in (False, True):
+            dev = jaxpath.device_tables(tables, pad=pad)
+            host = jaxpath._host_device_layout(tables, pad)
+            direct = jaxpath.DeviceTables(
+                key_words=jnp.asarray(host[0]),
+                mask_words=jnp.asarray(host[1]),
+                mask_len=jnp.asarray(host[2]),
+                rules=jnp.asarray(host[3]),
+                trie_levels=tuple(jnp.asarray(l) for l in host[4]),
+                root_lut=jnp.asarray(host[5]),
+                num_entries=jnp.asarray(np.int32(tables.num_entries)),
+            )
+            for a, b in zip(jax.tree.leaves(dev), jax.tree.leaves(direct)):
+                assert a.dtype == b.dtype
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_classifier_incremental_load_uses_patch():
     """A small rule edit on a loaded trie-path classifier must take the
     incremental device patch, and verdicts must match the oracle."""

@@ -61,6 +61,48 @@ def test_empty_table():
     assert_matches_oracle(tables, batch)
 
 
+@pytest.mark.parametrize("seed", [0, 5])
+def test_hash_oracle_matches_scalar_oracle(seed):
+    """The LPM-by-hash oracle (the big-tier spot-check ground truth) must
+    agree bit-for-bit with the scalar transliteration — results, xdp AND
+    stats — over adversarial nested/overlapping tables."""
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables_fast(
+        rng, n_entries=3000, width=8, group_size=6, ifindexes=(2, 3, 9)
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=4000)
+    ref = oracle.classify(tables, batch)
+    got = oracle.HashLpmOracle(tables).classify(batch)
+    np.testing.assert_array_equal(got.results, ref.results)
+    np.testing.assert_array_equal(got.xdp, ref.xdp)
+    assert got.stats == ref.stats
+
+
+def test_hash_oracle_empty_and_zero_mask():
+    """mask_len 0 entries (match-everything-on-ifindex) take the shift-128
+    path in both build and probe; empty tables must classify to UNDEF."""
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, 0, 0, 0, 0, 0, 1]  # catch-all deny
+    content = {LpmKey(32, 2, bytes(16)): rows}  # /0 on ifindex 2
+    tables = compile_tables_from_content(content, rule_width=4)
+    from infw.packets import make_batch
+
+    batch = make_batch(
+        src=["10.0.0.1", "2001:db8::1", "10.0.0.1"],
+        proto=[6, 6, 6], dst_port=[80, 80, 80], ifindex=[2, 2, 3],
+    )
+    ref = oracle.classify(tables, batch)
+    got = oracle.HashLpmOracle(tables).classify(batch)
+    np.testing.assert_array_equal(got.results, ref.results)
+    np.testing.assert_array_equal(got.xdp, ref.xdp)
+    # the /0 catch-all denies both families on ifindex 2, misses ifindex 3
+    assert got.xdp.tolist() == [1, 1, 2]
+
+    empty = compile_tables_from_content({}, rule_width=4)
+    got = oracle.HashLpmOracle(empty).classify(batch)
+    assert got.xdp.tolist() == [2, 2, 2]
+
+
 def test_nested_prefixes_longest_wins():
     # /8 allow, /16 deny, /24 allow, /32 deny nested — longest must win.
     rows_allow = np.zeros((4, 7), np.int32)

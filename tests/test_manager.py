@@ -351,12 +351,14 @@ def test_apply_dir_rejection_writes_status(tmp_path):
 
 
 def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
-    """The reference's whole e2e flow as REAL processes (the single-node
-    compose composition): sidecar + manager(--apply-dir) + daemon.  An
-    IngressNodeFirewall CR dropped in the apply dir must travel admission
-    -> fan-out -> NodeState export -> daemon sync -> classify, and the
-    deny event must come out of the SIDECAR's stdout in the reference's
-    line format (cmd/syslog + test/e2e/events regex flow)."""
+    """The reference's whole e2e flow as REAL processes, brought up FROM
+    THE BUNDLE: deploy/launch.py reads deploy/bundle/manifest.json and
+    spawns sidecar + manager(--apply-dir) + daemon (the OLM-install role,
+    /root/reference/bundle/).  An IngressNodeFirewall CR dropped in the
+    apply dir must travel admission -> fan-out -> NodeState export ->
+    daemon sync -> classify, and the deny event must come out of the
+    SIDECAR's log in the reference's line format (cmd/syslog +
+    test/e2e/events regex flow)."""
     import re
     import subprocess
     import sys as _sys
@@ -364,29 +366,20 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
     state = tmp_path / "state"
     sock = str(tmp_path / "events.sock")
     env = dict(os.environ, NODE_NAME="composed-node",
-               DAEMONSET_IMAGE="infw:latest", DAEMONSET_NAMESPACE=NS)
-    procs = {}
-    logs = {n: tmp_path / f"{n}.log" for n in ("sidecar", "manager", "daemon")}
-
-    def spawn(name, argv):
-        with open(logs[name], "wb") as lf:
-            procs[name] = subprocess.Popen(
-                argv, stdout=lf, stderr=subprocess.STDOUT, env=env
-            )
-
+               DAEMONSET_IMAGE="infw:latest", DAEMONSET_NAMESPACE=NS,
+               JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = {n: state / f"{n}.log"
+            for n in ("events-sidecar", "manager", "daemon")}
+    launcher = subprocess.Popen(
+        [_sys.executable, os.path.join(repo, "deploy", "launch.py"),
+         "--state-dir", str(state), "--backend", "cpu",
+         "--node-name", "composed-node", "--events-socket", sock,
+         "--ephemeral-ports"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+    )
+    procs = {"launcher": launcher}
     try:
-        spawn("sidecar", [_sys.executable, "-m", "infw.obs.sidecar",
-                          "--socket", sock])
-        spawn("manager", [_sys.executable, "-m", "infw.manager",
-                          "--export-dir", str(state),
-                          "--apply-dir", str(state / "apply"),
-                          "--register-node", "composed-node",
-                          "--metrics-port", "0", "--health-port", "0"])
-        spawn("daemon", [_sys.executable, "-m", "infw.daemon",
-                         "--state-dir", str(state), "--backend", "cpu",
-                         "--node-name", "composed-node",
-                         "--metrics-port", "0", "--health-port", "0",
-                         "--events-socket", sock])
         deadline = time.time() + 30
         while time.time() < deadline and not (state / "apply").is_dir():
             time.sleep(0.1)
@@ -435,21 +428,24 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
         with open(vp) as f:
             assert json.load(f)["drop"] == 1
 
-        # the deny event must surface on the SIDECAR's stdout
+        # the deny event must surface on the SIDECAR's log
         pat = re.compile(r"ruleId 1 action Drop len \d+ if ")
+        sidecar_log = logs["events-sidecar"]
         while time.time() < deadline:
-            if pat.search(logs["sidecar"].read_text(errors="replace")):
+            if sidecar_log.exists() and pat.search(
+                sidecar_log.read_text(errors="replace")
+            ):
                 break
             time.sleep(0.2)
-        assert pat.search(logs["sidecar"].read_text(errors="replace")), (
-            logs["sidecar"].read_text(errors="replace")[-2000:]
+        assert pat.search(sidecar_log.read_text(errors="replace")), (
+            sidecar_log.read_text(errors="replace")[-2000:]
         )
     finally:
         for p in procs.values():
             p.terminate()
         for p in procs.values():
             try:
-                p.wait(timeout=15)
+                p.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(timeout=15)
