@@ -65,14 +65,19 @@ def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
     plan = []
     for name in order:
         comp = components[name]
-        run = comp["run"]
-        for key, val in subs.items():
-            run = run.replace(f"<{key}>", str(val))
-        if "<" in run:
-            raise SystemExit(
-                f"component {name}: unfilled placeholder in run line: {run}"
-            )
-        argv = shlex.split(run)
+        # Split FIRST, substitute per token: a state dir or node name
+        # containing spaces/quotes must stay one argv element (the shell
+        # script this replaces quoted \"$STATE_DIR\" at every use).
+        argv = []
+        for tok in shlex.split(comp["run"]):
+            for key, val in subs.items():
+                tok = tok.replace(f"<{key}>", str(val))
+            if "<" in tok and ">" in tok:
+                raise SystemExit(
+                    f"component {name}: unfilled placeholder in run "
+                    f"token: {tok}"
+                )
+            argv.append(tok)
         argv[0] = sys.executable  # the bundle says "python"; use ours
         env = dict(os.environ)
         # Override, don't setdefault: --node-name must name the WHOLE
@@ -97,10 +102,11 @@ def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
     return plan
 
 
-def launch(plan, state_dir: str, on_spawn=None) -> int:
-    """Spawn the plan in order; supervise until the LAST component (the
-    daemon — the dataplane is the composition's reason to exist) exits or
-    a signal arrives, then tear everything down in reverse order."""
+def launch(plan, state_dir: str) -> int:
+    """Spawn the plan in order; supervise until ANY component exits (the
+    pod restart-policy model: the composition lives and dies as a unit,
+    and an external supervisor restarts the whole thing) or a signal
+    arrives, then tear everything down in reverse order."""
     os.makedirs(state_dir, exist_ok=True)
     procs = []
 
@@ -127,8 +133,6 @@ def launch(plan, state_dir: str, on_spawn=None) -> int:
                 )
             procs.append((name, p))
             print(f"launch: {name} pid={p.pid} log={log_path}", flush=True)
-            if on_spawn:
-                on_spawn(name, p)
         # supervise: if ANY component dies, bring the composition down
         # (the pod restart-policy role; an external supervisor restarts us)
         while True:

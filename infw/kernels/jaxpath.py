@@ -55,16 +55,23 @@ STATS_COLS = 6
 class DeviceTables(NamedTuple):
     """Compiled tables resident on device.
 
-    ``trie_levels`` is a tuple of per-level (n_l*slots_l, 2) int32 arrays
-    (variable-stride trie, compiler.VAR_TRIE_*); the tuple length is part
-    of the pytree structure, so jit specializes per level count — the
-    static level bound the walk unrolls over."""
+    ``trie_levels`` holds the device LPM walk structure (the poptrie
+    transform of the compiler's variable-stride slot trie, see
+    build_poptrie): element 0 is the DIR-16 root level as a direct-
+    indexed (n_0*65536, 2) int32 slot array (tiny, direct index beats
+    any compression); elements 1.. are (n_l, 18) uint32 poptrie node
+    rows [child_base, target_base, child_bitmap x8, target_bitmap x8].
+    ``trie_targets`` is the per-level target-compact arrays concatenated
+    (leading 0 sentinel).  The tuple length is part of the pytree
+    structure, so jit specializes per level count — the static level
+    bound the walk unrolls over."""
 
     key_words: jax.Array    # (T, 5) uint32
     mask_words: jax.Array   # (T, 5) uint32
     mask_len: jax.Array     # (T,) int32
     rules: jax.Array        # (T, R, 7) int32
     trie_levels: Tuple[jax.Array, ...]
+    trie_targets: jax.Array  # (1 + total present targets,) int32
     root_lut: jax.Array     # (max_if+1,) int32
     num_entries: jax.Array  # () int32
 
@@ -108,11 +115,111 @@ def _pad_rows(a: np.ndarray, n_rows: int, fill=0) -> np.ndarray:
     return out
 
 
-def _host_device_layout(tables: CompiledTables, pad: bool):
+def build_poptrie(tables: CompiledTables):
+    """Host transform: the compiler's slot-indexed variable-stride trie
+    (per level (n_l*slots, 2) int32 — ~1% occupied at scale, 3.4GB at 1M
+    entries) -> a poptrie-style compressed representation (Asai &
+    Ohara's poptrie, adapted: bitmap + popcount-rank node rows with
+    IMPLICIT child numbering) that the device walk gathers from:
+
+    - level 0 (DIR-16 root) stays a direct-indexed slot array — it is
+      small (n_0*65536 rows only for live ifindexes) and direct indexing
+      beats any compression; its child column is remapped to renumbered
+      level-1 ids PLUS ONE (0 = no child, since renumbered ids are
+      0-based).
+    - level l>=1 nodes renumber to the order their parent slots appear
+      (row-major (parent, slot) scan), so a node's children occupy the
+      contiguous range [child_base, child_base + popcount(bitmap)) at
+      the next level and the walk needs NO child-pointer gather: the
+      child id is child_base + rank(nib).
+    - per-level target values compact the same way; the walk tracks only
+      a winning global index into ``targets`` (leading 0 sentinel, so
+      index 0 reads 0 == no target) and gathers ONCE after the walk.
+
+    Returns (levels, targets): levels[0] (n_0*65536, 2) int32,
+    levels[1:] (n_l, 18) uint32 rows
+    [child_base, target_base, child_bm x8, target_bm x8]; targets int32.
+
+    Memoized on the CompiledTables instance — the transform scans the
+    full slot arrays (seconds at the 1M tier) and both the upload and
+    the patch diff consume it."""
+    cached = getattr(tables, "_poptrie_cache", None)
+    if cached is not None:
+        return cached
+    slot_levels = tables.trie_levels
+    strides = trie_level_strides(len(slot_levels))
+    out_levels = []
+    targets_parts = [np.zeros(1, np.int32)]  # index-0 sentinel
+    t_off = 1  # global target index of the current level's first target
+    perm = None  # new-id -> old-id for the CURRENT level (None: identity)
+    for l, (tbl, stride) in enumerate(zip(slot_levels, strides)):
+        slots = 1 << stride
+        R = tbl.reshape(tbl.shape[0] // slots, slots, 2)
+        if perm is not None:
+            # renumbered order; unreachable (orphaned) nodes drop out
+            R = R[perm] if len(perm) else R[:0]
+        n_nodes = R.shape[0]
+        child = R[:, :, 0]
+        tgt = R[:, :, 1]
+        present = child != 0
+        # next level's renumbering: present children in (node, slot) order
+        perm = child[present]
+        if l == 0:
+            # remap child ids to renumbered-level-1 ids + 1 (0 = none)
+            if len(slot_levels) > 1:
+                n_next = slot_levels[1].shape[0] // (1 << strides[1])
+                inv = np.zeros(max(n_next, 1), np.int32)
+                inv[perm] = np.arange(1, len(perm) + 1, dtype=np.int32)
+                remapped = np.where(present, inv[child], 0)
+            else:
+                remapped = np.zeros_like(child)
+            lvl0 = np.stack([remapped, tgt], axis=2).reshape(-1, 2)
+            out_levels.append(np.ascontiguousarray(lvl0, np.int32))
+            continue
+        tpres = tgt > 0
+        # LSB-first bit packing: slot s -> word s>>5, bit s&31
+        cb = np.packbits(present, axis=1, bitorder="little")
+        cb = np.ascontiguousarray(cb).view("<u4").astype(np.uint32)
+        tb = np.packbits(tpres, axis=1, bitorder="little")
+        tb = np.ascontiguousarray(tb).view("<u4").astype(np.uint32)
+        counts = present.sum(axis=1, dtype=np.int64)
+        tcounts = tpres.sum(axis=1, dtype=np.int64)
+        cbase = np.zeros(n_nodes, np.int64)
+        tbase = np.zeros(n_nodes, np.int64)
+        if n_nodes:
+            np.cumsum(counts[:-1], out=cbase[1:])
+            np.cumsum(tcounts[:-1], out=tbase[1:])
+        rows = np.empty((max(n_nodes, 1), 18), np.uint32)
+        rows[:] = 0
+        if n_nodes:
+            rows[:n_nodes, 0] = cbase.astype(np.uint32)
+            # target_base carries the GLOBAL concat offset, so the walk
+            # derives the final targets index with no per-level offset
+            # bookkeeping (padding rows keep 0; their bitmap is 0 so the
+            # sentinel slot is never selected)
+            rows[:n_nodes, 1] = (tbase + t_off).astype(np.uint32)
+            rows[:n_nodes, 2:10] = cb.reshape(n_nodes, -1)[:, :8]
+            rows[:n_nodes, 10:18] = tb.reshape(n_nodes, -1)[:, :8]
+        lvl_targets = tgt[tpres].astype(np.int32)
+        t_off += len(lvl_targets)
+        out_levels.append(rows)
+        targets_parts.append(lvl_targets)
+    result = (out_levels, np.concatenate(targets_parts))
+    try:
+        object.__setattr__(tables, "_poptrie_cache", result)
+    except (AttributeError, TypeError):
+        pass
+    return result
+
+
+def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = True):
     """Host-side arrays in the exact layout device_tables uploads:
-    mask_len sentinel applied, rows bucket-padded when ``pad``.  Shared by
-    device_tables and patch_device_tables so a patched device state is
-    bit-identical to a fresh upload."""
+    mask_len sentinel applied, trie levels in the poptrie device form,
+    rows bucket-padded when ``pad``.  Shared by device_tables and
+    patch_device_tables so a patched device state is bit-identical to a
+    fresh upload.  ``with_trie=False`` skips the (seconds-at-scale)
+    poptrie transform and returns empty levels/targets — for patch calls
+    whose dirty hint proves the trie is untouched."""
     mask_len = tables.mask_len.copy()
     mask_len[tables.num_entries :] = -1
     # copy=False: the compiler already stores these as uint32; a blind
@@ -120,7 +227,10 @@ def _host_device_layout(tables: CompiledTables, pad: bool):
     key_words = tables.key_words.astype(np.uint32, copy=False)
     mask_words = tables.mask_words.astype(np.uint32, copy=False)
     rules = tables.rules
-    trie_levels = list(tables.trie_levels)
+    if with_trie:
+        trie_levels, trie_targets = build_poptrie(tables)
+    else:
+        trie_levels, trie_targets = [], np.zeros(1, np.int32)
     root_lut = tables.root_lut
     if pad:
         n = _row_bucket(mask_len.shape[0])
@@ -128,21 +238,23 @@ def _host_device_layout(tables: CompiledTables, pad: bool):
         mask_words = _pad_rows(mask_words, n)
         mask_len = _pad_rows(mask_len, n, fill=-1)  # padding rows are inert
         rules = _pad_rows(rules, n)
-        # level padding rows are unreachable (node ids only reach
-        # allocated nodes) and zero = [no child, no target] anyway
+        # level padding rows are unreachable (child ranks only reach
+        # allocated nodes) and zero = empty bitmaps anyway
         trie_levels = [_pad_rows(l, _row_bucket(l.shape[0])) for l in trie_levels]
+        trie_targets = _pad_rows(trie_targets, _row_bucket(trie_targets.shape[0]))
         root_lut = _pad_rows(root_lut, _row_bucket(root_lut.shape[0]))
-    return key_words, mask_words, mask_len, rules, trie_levels, root_lut
+    return (key_words, mask_words, mask_len, rules, trie_levels,
+            trie_targets, root_lut)
 
 
 @functools.lru_cache(maxsize=None)
-def _sparse_expand_jit(n_rows: int):
-    """zeros(n_rows, 2) int32 scattered from (idx, vals) — the device
-    side of the sparse trie-level transfer.  One jit per level row count;
-    retraces per nnz shape are cheap and the persistent compile cache
-    carries them across processes."""
+def _sparse_expand_jit(n_rows: int, n_cols: int, dtype: str):
+    """zeros scattered from (idx, vals) — the device side of the sparse
+    trie-level transfer.  One jit per level shape; retraces per nnz shape
+    are cheap and the persistent compile cache carries them across
+    processes."""
     def f(idx, vals):
-        return jnp.zeros((n_rows, 2), jnp.int32).at[idx].set(vals)
+        return jnp.zeros((n_rows, n_cols), dtype).at[idx].set(vals)
 
     return jax.jit(f)
 
@@ -201,9 +313,8 @@ def device_tables(
         field) and upcast on device.
     The resident DeviceTables is bit-identical to a direct upload — the
     patch path diffs against it with no knowledge of how it traveled."""
-    key_words, mask_words, mask_len, rules, trie_levels, root_lut = (
-        _host_device_layout(tables, pad)
-    )
+    (key_words, mask_words, mask_len, rules, trie_levels, trie_targets,
+     root_lut) = _host_device_layout(tables, pad)
     put = lambda a: jax.device_put(jnp.asarray(a), device)
 
     # -- rules: narrow to u16 when every field fits ---------------------
@@ -212,17 +323,23 @@ def device_tables(
     else:
         rules_dev = put(rules)  # empty, or wide values (adversarial content)
 
-    # -- trie levels: sparse scatter below the density limit ------------
+    # -- trie levels: sparse scatter below the density limit (the DIR-16
+    # root level is ~0-60% dense; poptrie node rows are mostly dense by
+    # construction, so they usually ship whole — and are ~30x smaller
+    # than the slot arrays they replaced) --------------------------------
     levels_dev = []
     for tbl in trie_levels:
         n = tbl.shape[0]
         if n == 0:
             levels_dev.append(put(tbl))
             continue
-        nnz = np.nonzero(np.ascontiguousarray(tbl).view(np.int64).reshape(-1))[0]
-        if len(nnz) <= n * _SPARSE_DENSITY_LIMIT:
+        flat = np.ascontiguousarray(tbl).reshape(n, -1)
+        nnz = np.nonzero(flat.any(axis=1))[0]
+        # sparse ships (4 + rowbytes) per nnz row vs rowbytes per row
+        row_b = flat.shape[1] * tbl.dtype.itemsize
+        if len(nnz) * (4 + row_b) <= _SPARSE_DENSITY_LIMIT * n * row_b:
             levels_dev.append(
-                _sparse_expand_jit(n)(
+                _sparse_expand_jit(n, tbl.shape[1], str(tbl.dtype))(
                     put(nnz.astype(np.int32)), put(tbl[nnz])
                 )
             )
@@ -235,6 +352,7 @@ def device_tables(
         mask_len=put(mask_len),
         rules=rules_dev,
         trie_levels=tuple(levels_dev),
+        trie_targets=put(trie_targets),
         root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
@@ -337,24 +455,29 @@ def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
     compile (~10s measured at the 1M tier).  The executable cache is
     keyed on abstract shapes/dtypes, and every <= _PATCH_CAP-row patch
     uses the SAME capped scatter shape (_scatter_cap), so one warm per
-    array shape covers all small edits.  Each warm scatters zeros into a
-    zeros SCRATCH array of the resident array's shape — no readback of
-    resident values, no touching the live tables; the scratch and its
-    scatter result are dropped as soon as the executable exists."""
+    array shape covers all small edits.  Each warm scatters against the
+    RESIDENT array — _scatter is non-donating, so the live table is
+    never mutated (XLA materializes copy-then-scatter) and the discarded
+    result is the only transient allocation; scattering into a separate
+    zeros scratch would double the transient HBM right after a full load,
+    when the double-buffer contract may still hold the previous
+    generation live."""
     seen = set()
     for arr in (
         dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
-        *dev.trie_levels, dev.root_lut,
+        *dev.trie_levels, dev.trie_targets, dev.root_lut,
     ):
         key = (arr.shape, str(arr.dtype))
         if arr.shape[0] == 0 or key in seen:
             continue
         seen.add(key)
         cap = _scatter_cap(1, arr.shape[0])
-        scratch = jax.device_put(jnp.zeros(arr.shape, arr.dtype), device)
         pidx = np.zeros(cap, np.int64)
+        # index 0 rewritten with... whatever value row 0 holds is NOT
+        # needed: the scatter result is discarded, so writing zeros into
+        # the COPY is harmless — the resident buffer is untouched.
         prows = np.zeros((cap,) + arr.shape[1:], arr.dtype)
-        _scatter(scratch, pidx, prows, device)
+        _scatter(arr, pidx, prows, device)
 
 
 def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
@@ -401,23 +524,33 @@ def patch_device_tables(
     ``dev`` must have been built with ``pad=True``.  Returns
     (new DeviceTables, total_rows_changed), or None when the structure
     changed beyond the row buckets (level count, bucket growth,
-    compaction shrink past a bucket) and the caller re-uploads in full."""
+    compaction shrink past a bucket) and the caller re-uploads in full.
+
+    Trie levels live on device in the poptrie form (build_poptrie), and
+    a CIDR edit renumbers/ranks nodes, so per-level changes are diffed
+    on the poptrie HOST arrays — the level hint (slot-space row numbers)
+    does not apply to them and only accelerates the dense arrays; a
+    rules-only edit (the common Map.Update case) leaves every level's
+    poptrie bytes identical and the diff is a cheap vectorized compare."""
     if len(dev.trie_levels) != len(new.trie_levels) or len(
         old.trie_levels
     ) != len(new.trie_levels):
         return None
-    o = _host_device_layout(old, pad=False)
-    nw = _host_device_layout(new, pad=False)
-    # only trie levels / root_lut go through put: pad fill is 0 for both
+    # Rules-only edits (the common Map.Update) leave the trie untouched —
+    # the dirty hint proves it (its level lists track slot-space repush
+    # writes), so the poptrie transform AND the per-level diffs are
+    # skipped entirely and the resident level arrays carry over.
+    trie_unchanged = hint is not None and all(
+        len(h) == 0 for h in hint.get("levels", [np.zeros(1)])
+    )
+    o = _host_device_layout(old, pad=False, with_trie=not trie_unchanged)
+    nw = _host_device_layout(new, pad=False, with_trie=not trie_unchanged)
+    # only trie levels / targets / root_lut go through put: pad fill is 0
     put = lambda a: jax.device_put(
         jnp.asarray(_pad_rows(a, _row_bucket(a.shape[0]))), device
     )
     total = 0
 
-    hint_levels = hint["levels"] if hint is not None else None
-    if hint_levels is not None and len(hint_levels) != len(dev.trie_levels):
-        # check before any device work so a stale hint wastes no scatters
-        return None
     dense = []
     for dl, ol, nl, fill in zip(
         (dev.key_words, dev.mask_words, dev.mask_len, dev.rules),
@@ -433,24 +566,32 @@ def patch_device_tables(
             return None
         dense.append(p[0])
         total += p[1]
-    levels = []
-    for i, (dl, ol, nl) in enumerate(zip(dev.trie_levels, o[4], nw[4])):
-        if hint_levels is not None:
-            p = _patch_array_rows(dl, nl, hint_levels[i], device)
-        else:
+    if trie_unchanged:
+        levels = list(dev.trie_levels)
+        trie_targets = dev.trie_targets
+    else:
+        levels = []
+        for dl, ol, nl in zip(dev.trie_levels, o[4], nw[4]):
             p = _patch_array(dl, ol, nl, device)
+            if p is None:
+                # this level's bucket changed (or the delta is too
+                # large): re-upload just this level
+                levels.append(put(nl))
+                total += len(nl)
+            else:
+                levels.append(p[0])
+                total += p[1]
+        p = _patch_array(dev.trie_targets, o[5], nw[5], device)
         if p is None:
-            # this level's bucket changed (or the delta is too large):
-            # re-upload just this level
-            levels.append(put(nl))
-            total += len(nl)
+            trie_targets = put(nw[5])
+            total += len(nw[5])
         else:
-            levels.append(p[0])
-            total += p[1]
-    p = _patch_array(dev.root_lut, o[5], nw[5], device)
+            trie_targets, k = p
+            total += k
+    p = _patch_array(dev.root_lut, o[6], nw[6], device)
     if p is None:
-        root_lut = put(nw[5])
-        total += len(nw[5])
+        root_lut = put(nw[6])
+        total += len(nw[6])
     else:
         root_lut, k = p
         total += k
@@ -461,6 +602,7 @@ def patch_device_tables(
             mask_len=dense[2],
             rules=dense[3],
             trie_levels=tuple(levels),
+            trie_targets=trie_targets,
             root_lut=root_lut,
             num_entries=jax.device_put(
                 jnp.asarray(np.int32(new.num_entries)), device
@@ -659,46 +801,97 @@ def lpm_dense(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     return jnp.where(jnp.max(score, axis=1) > 0, tidx, -1)
 
 
+def _popcount32(x: jax.Array) -> jax.Array:
+    """SWAR popcount on uint32 lanes (no native popcount in jnp) — 5
+    vector ops, fused by XLA into the walk's per-level arithmetic."""
+    x = x - ((x >> 1) & np.uint32(0x55555555))
+    x = (x & np.uint32(0x33333333)) + ((x >> 2) & np.uint32(0x33333333))
+    x = (x + (x >> 4)) & np.uint32(0x0F0F0F0F)
+    return (x * np.uint32(0x01010101)) >> 24
+
+
 def trie_walk(
-    trie_levels, root_lut: jax.Array, batch: DeviceBatch
+    trie_levels, trie_targets: jax.Array, root_lut: jax.Array,
+    batch: DeviceBatch
 ) -> jax.Array:
-    """Variable-stride trie walk: ONE packed (child, target) row gather
-    per level, statically unrolled over the level count (bounded by the
-    table's longest prefix); no data-dependent control flow.  Returns the
+    """Poptrie walk (build_poptrie layout): the DIR-16 root level is one
+    direct-indexed slot-row gather; every deeper level is ONE (18-word)
+    node-row gather + bitmap-rank arithmetic — the child id is
+    child_base + rank(nib) (implicit numbering, no pointer gather), and
+    target hits record only a global index into ``trie_targets``,
+    resolved with a single gather AFTER the walk.  Statically unrolled
+    over the level count; no data-dependent control flow.  Returns the
     target index or -1.
 
-    Slot targets at a level cover prefixes with mask_len in
-    (prev_boundary, boundary]; the IPv4 packet-side cap (entries longer
-    than /32 cannot match a v4 packet, kernel.c:207) is the boundary test
+    vs the previous slot-array walk: each deep level's gather now lands
+    in an array ~30x smaller (nodes, not nodes x 256 slots), which is
+    what the gather-bound walk's throughput follows; the rank math is
+    ~60 cheap VPU ops per level.
+
+    Targets at a level cover prefixes with mask_len in (prev_boundary,
+    boundary]; the IPv4 packet-side cap (entries longer than /32 cannot
+    match a v4 packet, kernel.c:207) is the boundary test
     ``bit_end <= cap_bits`` — boundaries are 16, 24, 32, 40, ... so 32
     always lands exactly on one."""
     strides = trie_level_strides(len(trie_levels))
     lut_size = root_lut.shape[0]
     if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
-    node = jnp.where(
+    root = jnp.where(
         if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
     )
-    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
-    best = jnp.full_like(node, -1)
 
-    bit_end = 0
-    for stride, tbl in zip(strides, trie_levels):
+    # -- level 0: direct-indexed DIR-16 root --------------------------------
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    rows0 = jnp.take(trie_levels[0], root * 65536 + nib0, axis=0)
+    best0 = jnp.where(rows0[:, 1] > 0, rows0[:, 1] - 1, -1)
+    alive = rows0[:, 0] > 0  # child ids are stored +1 (0 = none)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+
+    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    win = jnp.zeros_like(node, dtype=jnp.uint32)  # targets[0] sentinel
+    widx8 = jnp.arange(8, dtype=jnp.int32)[None, :]
+
+    bit_end = strides[0]
+    for stride, tbl in zip(strides[1:], trie_levels[1:]):
         bit_start, bit_end = bit_end, bit_end + stride
-        w = bit_start // 32
+        w32 = bit_start // 32
         shift = 32 - stride - (bit_start % 32)
         nib = (
-            (batch.ip_words[:, w] >> np.uint32(shift)) & np.uint32((1 << stride) - 1)
+            (batch.ip_words[:, w32] >> np.uint32(shift))
+            & np.uint32((1 << stride) - 1)
         ).astype(jnp.int32)
-        e = node * (1 << stride) + nib  # node 0 is the all-null node
-        rows = jnp.take(tbl, e, axis=0)  # (B, 2): [child, target+1]
-        ok = (rows[:, 1] > 0) & (bit_end <= cap_bits)
-        best = jnp.where(ok, rows[:, 1] - 1, best)
-        node = rows[:, 0]
-    return best
+        r = jnp.take(tbl, node, axis=0)  # (B, 18) uint32, clipped indices
+        w = (nib >> 5)[:, None]          # bitmap word 0..7
+        below = (np.uint32(1) << (nib & 31).astype(jnp.uint32)) - 1
+        cb = r[:, 2:10]
+        tb = r[:, 10:18]
+        pc_cb = _popcount32(cb)
+        pc_tb = _popcount32(tb)
+        prefix = jnp.sum(jnp.where(widx8 < w, pc_cb, 0), axis=1)
+        tprefix = jnp.sum(jnp.where(widx8 < w, pc_tb, 0), axis=1)
+        cw = jnp.sum(jnp.where(widx8 == w, cb, 0), axis=1)
+        tw = jnp.sum(jnp.where(widx8 == w, tb, 0), axis=1)
+        bit = (nib & 31).astype(jnp.uint32)
+        ok_t = (
+            alive
+            & (((tw >> bit) & 1) > 0)
+            & (bit_end <= cap_bits)
+        )
+        win = jnp.where(
+            ok_t, r[:, 1] + tprefix + _popcount32(tw & below), win
+        )
+        alive = alive & (((cw >> bit) & 1) > 0)
+        node = jnp.where(
+            alive, (r[:, 0] + prefix + _popcount32(cw & below)).astype(jnp.int32), 0
+        )
+    tval = jnp.take(trie_targets, win.astype(jnp.int32))
+    return jnp.where(tval > 0, tval - 1, best0)
 
 
 def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
-    return trie_walk(tables.trie_levels, tables.root_lut, batch)
+    return trie_walk(
+        tables.trie_levels, tables.trie_targets, tables.root_lut, batch
+    )
 
 
 def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:

@@ -89,6 +89,7 @@ def shard_tables(tables: CompiledTables, mesh: Mesh) -> DeviceTables:
         # The dense sharded step never walks the trie; don't ship or
         # replicate the (potentially large) level arrays.
         trie_levels=(),
+        trie_targets=put(np.zeros(1, np.int32), P()),
         root_lut=put(padded.root_lut, P()),
         num_entries=put(np.int32(padded.num_entries), P()),
     )
@@ -174,6 +175,7 @@ def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 0):
         mask_len=P("rules"),
         rules=P("rules", None, None),
         trie_levels=tuple(P() for _ in range(n_trie_levels)),
+        trie_targets=P(),
         root_lut=P(),
         num_entries=P(),
     )
@@ -199,9 +201,11 @@ def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 0):
 
 
 class ShardedTrieTables(NamedTuple):
-    """Per-shard trie state stacked on a leading "rules" axis."""
+    """Per-shard trie state stacked on a leading "rules" axis (levels in
+    the poptrie device form, jaxpath.build_poptrie)."""
 
-    trie_levels: Tuple[jax.Array, ...]  # each (R, rows_l, 2) int32
+    trie_levels: Tuple[jax.Array, ...]  # (R, rows_0, 2) i32, then (R, n_l, 18) u32
+    trie_targets: jax.Array             # (R, Tt) int32
     root_lut: jax.Array                 # (R, L) int32
     mask_len: jax.Array                 # (R, T) int32, -1 padding
     rules: jax.Array                    # (R, T, W, 7) int32
@@ -239,12 +243,16 @@ def build_trie_shards(tables: CompiledTables, shards: int) -> ShardedTrieTables:
         widths = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, widths, constant_values=fill)
 
+    # per-shard poptrie transforms (padding rows are zero = empty nodes /
+    # sentinel targets, unreachable by construction)
+    pops = [jaxpath.build_poptrie(s) for s in subs]
     levels = []
     for l in range(n_levels):
-        rows = max(s.trie_levels[l].shape[0] for s in subs)
-        levels.append(
-            np.stack([pad_to(s.trie_levels[l], rows) for s in subs])
-        )
+        rows = max(p[0][l].shape[0] for p in pops)
+        stacked = np.stack([pad_to(p[0][l], rows) for p in pops])
+        levels.append(stacked)
+    t_len = max(p[1].shape[0] for p in pops)
+    trie_targets = np.stack([pad_to(p[1], t_len) for p in pops])
     lut_len = max(s.root_lut.shape[0] for s in subs)
     root_lut = np.stack([pad_to(s.root_lut, lut_len) for s in subs])
     T = max(s.mask_len.shape[0] for s in subs)
@@ -257,7 +265,8 @@ def build_trie_shards(tables: CompiledTables, shards: int) -> ShardedTrieTables:
     )
     rules = np.stack([pad_to(s.rules, T) for s in subs])
     return ShardedTrieTables(
-        trie_levels=tuple(np.asarray(a, np.int32) for a in levels),
+        trie_levels=tuple(levels),
+        trie_targets=trie_targets.astype(np.int32),
         root_lut=root_lut.astype(np.int32),
         mask_len=mask_len.astype(np.int32),
         rules=rules.astype(np.int32),
@@ -274,6 +283,7 @@ def shard_tables_trie(tables: CompiledTables, mesh: Mesh) -> ShardedTrieTables:
 
     return ShardedTrieTables(
         trie_levels=tuple(put(t, P("rules", None, None)) for t in host.trie_levels),
+        trie_targets=put(host.trie_targets, P("rules", None)),
         root_lut=put(host.root_lut, P("rules", None)),
         mask_len=put(host.mask_len, P("rules", None)),
         rules=put(host.rules, P("rules", None, None, None)),
@@ -285,7 +295,9 @@ def _sharded_trie_step(tables: ShardedTrieTables, batch: DeviceBatch):
     gather for the score, then the same pmax/psum winner selection as the
     dense path."""
     local_levels = tuple(t[0] for t in tables.trie_levels)  # drop shard dim
-    tidx = jaxpath.trie_walk(local_levels, tables.root_lut[0], batch)
+    tidx = jaxpath.trie_walk(
+        local_levels, tables.trie_targets[0], tables.root_lut[0], batch
+    )
     matched = tidx >= 0
     safe = jnp.clip(tidx, 0)
     best = jnp.where(
@@ -308,6 +320,7 @@ def make_sharded_trie_classifier(mesh: Mesh, n_trie_levels: int):
     )
     table_specs = ShardedTrieTables(
         trie_levels=tuple(P("rules", None, None) for _ in range(n_trie_levels)),
+        trie_targets=P("rules", None),
         root_lut=P("rules", None),
         mask_len=P("rules", None),
         rules=P("rules", None, None, None),

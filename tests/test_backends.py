@@ -550,7 +550,8 @@ def test_compact_transfer_upload_bit_identical():
                 mask_len=jnp.asarray(host[2]),
                 rules=jnp.asarray(host[3]),
                 trie_levels=tuple(jnp.asarray(l) for l in host[4]),
-                root_lut=jnp.asarray(host[5]),
+                trie_targets=jnp.asarray(host[5]),
+                root_lut=jnp.asarray(host[6]),
                 num_entries=jnp.asarray(np.int32(tables.num_entries)),
             )
             for a, b in zip(jax.tree.leaves(dev), jax.tree.leaves(direct)):
