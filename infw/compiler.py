@@ -321,9 +321,19 @@ class CompiledTables:
             if self.content
             else np.zeros((0, self.rule_width, RULE_COLS), np.int32)
         )
-        level_arrays = {
-            f"trie_level_{i}": tbl for i, tbl in enumerate(self.trie_levels)
-        }
+        # Trie levels persist SPARSELY (nnz row index + rows): the slot
+        # arrays are ~1% occupied at scale, and deflating 3.4GB of zeros
+        # on every checkpoint save (then inflating on restart) costs
+        # minutes the restart-to-enforcement budget doesn't have.
+        level_arrays = {}
+        for i, tbl in enumerate(self.trie_levels):
+            # any() over the non-row axes (reshape(n, -1) rejects n == 0)
+            nnz = np.nonzero(tbl.any(axis=tuple(range(1, tbl.ndim))))[0]
+            level_arrays[f"trie_level_{i}_nnz"] = nnz.astype(np.int64)
+            level_arrays[f"trie_level_{i}_rows"] = tbl[nnz]
+            level_arrays[f"trie_level_{i}_shape"] = np.asarray(
+                tbl.shape, np.int64
+            )
         np.savez_compressed(
             path,
             meta=json.dumps(meta),
@@ -351,6 +361,18 @@ class CompiledTables:
             content = {}
             for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
                 content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = content_rules[i]
+            trie_levels = []
+            for i in range(meta["n_trie_levels"]):
+                if f"trie_level_{i}" in z:
+                    # pre-sparse archive format
+                    trie_levels.append(z[f"trie_level_{i}"])
+                    continue
+                rows = z[f"trie_level_{i}_rows"]
+                tbl = np.zeros(
+                    tuple(z[f"trie_level_{i}_shape"]), rows.dtype
+                )
+                tbl[z[f"trie_level_{i}_nnz"]] = rows
+                trie_levels.append(tbl)
             return cls(
                 rule_width=meta["rule_width"],
                 num_entries=meta["num_entries"],
@@ -358,9 +380,7 @@ class CompiledTables:
                 mask_words=z["mask_words"],
                 mask_len=z["mask_len"],
                 rules=z["rules"],
-                trie_levels=[
-                    z[f"trie_level_{i}"] for i in range(meta["n_trie_levels"])
-                ],
+                trie_levels=trie_levels,
                 root_lut=z["root_lut"],
                 content=content,
             )

@@ -628,30 +628,53 @@ def device_batch(batch: PacketBatch, device=None) -> DeviceBatch:
 
 
 def unpack_wire(wire: jax.Array) -> DeviceBatch:
-    """Device-side inverse of PacketBatch.pack_wire / pack_wire_v4,
-    discriminated by the (static) wire width: (B, 7) carries the full
-    128-bit source address, (B, 4) the family-compact v4 layout (IP word 0
-    only, high words reconstructed as zeros — the v4 key invariant).
-    Pure elementwise bit ops, fused by XLA into whatever consumes the
-    fields — the packed descriptor never round-trips HBM."""
+    """Device-side inverse of PacketBatch.pack_wire / pack_wire_v4 /
+    packets.narrow_wire, discriminated by the (static) wire width:
+    (B, 7) full layout, (B, 4) v4-compact (IP word 0 only, high words
+    reconstructed as zeros — the v4 key invariant), (B, 3) / (B, 6) the
+    NARROW layouts (ifindex folded into w0, dst_port overlaid with the
+    ICMP fields in one l4 word — lossless for classification, see
+    narrow_wire).  Pure elementwise bit ops, fused by XLA into whatever
+    consumes the fields — the packed descriptor never round-trips HBM."""
     w0 = wire[:, 0]
     w1 = wire[:, 1]
-    if wire.shape[1] == 4:
+    narrow = wire.shape[1] in (3, 6)
+    ip_off = 2 if narrow else 3
+    if wire.shape[1] in (3, 4):
         ip_words = jnp.concatenate(
-            [wire[:, 3:4], jnp.zeros((wire.shape[0], 3), wire.dtype)], axis=1
+            [
+                wire[:, ip_off : ip_off + 1],
+                jnp.zeros((wire.shape[0], 3), wire.dtype),
+            ],
+            axis=1,
         )
     else:
-        ip_words = wire[:, 3:7]
+        ip_words = wire[:, ip_off : ip_off + 4]
+    proto = ((w0 >> 3) & 0xFF).astype(jnp.int32)
+    if narrow:
+        is_icmp = (proto == IPPROTO_ICMP) | (proto == IPPROTO_ICMPV6)
+        l4w = (w1 & 0xFFFF).astype(jnp.int32)
+        ifindex = ((w0 >> 11) & 0xFFFF).astype(jnp.int32)
+        dst_port = jnp.where(is_icmp, 0, l4w)
+        icmp_type = jnp.where(is_icmp, l4w >> 8, 0)
+        icmp_code = jnp.where(is_icmp, l4w & 0xFF, 0)
+        pkt_len = ((w1 >> 16) & 0xFFFF).astype(jnp.int32)
+    else:
+        ifindex = wire[:, 2].astype(jnp.int32)
+        dst_port = (w1 & 0xFFFF).astype(jnp.int32)
+        icmp_type = ((w0 >> 11) & 0xFF).astype(jnp.int32)
+        icmp_code = ((w0 >> 19) & 0xFF).astype(jnp.int32)
+        pkt_len = (((w1 >> 16) & 0xFFFF) | ((w0 >> 27) << 16)).astype(jnp.int32)
     return DeviceBatch(
         kind=(w0 & 3).astype(jnp.int32),
         l4_ok=((w0 >> 2) & 1).astype(jnp.int32),
-        ifindex=wire[:, 2].astype(jnp.int32),
+        ifindex=ifindex,
         ip_words=ip_words,
-        proto=((w0 >> 3) & 0xFF).astype(jnp.int32),
-        dst_port=(w1 & 0xFFFF).astype(jnp.int32),
-        icmp_type=((w0 >> 11) & 0xFF).astype(jnp.int32),
-        icmp_code=((w0 >> 19) & 0xFF).astype(jnp.int32),
-        pkt_len=(((w1 >> 16) & 0xFFFF) | ((w0 >> 27) << 16)).astype(jnp.int32),
+        proto=proto,
+        dst_port=dst_port,
+        icmp_type=icmp_type,
+        icmp_code=icmp_code,
+        pkt_len=pkt_len,
     )
 
 

@@ -559,6 +559,57 @@ def test_compact_transfer_upload_bit_identical():
                 np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_narrow_wire_classify_lossless():
+    """The narrow wire transform overlays dst_port with the ICMP fields
+    and folds the ifindex into w0; classification must stay bit-exact vs
+    the oracle even for adversarial batches carrying garbage in the
+    unused field (a synthetic ICMP packet with a nonzero dst_port, a TCP
+    packet with nonzero icmp_type — the scan never reads the overlaid
+    field for that protocol)."""
+    from infw.packets import make_batch, narrow_wire
+
+    rng = np.random.default_rng(91)
+    tables = testing.random_tables(rng, n_entries=40, width=8,
+                                   ifindexes=(2, 3))
+    batch = testing.random_batch(rng, tables, n_packets=400)
+    # poison the overlaid fields
+    batch.dst_port = np.where(
+        np.isin(batch.proto, (1, 58)), 4444, batch.dst_port
+    ).astype(np.int32)
+    batch.icmp_type = np.where(
+        batch.proto == 6, 77, batch.icmp_type
+    ).astype(np.int32)
+    # the narrow form must engage for this batch
+    assert narrow_wire(batch.pack_wire()) is not None
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    check_against_oracle(clf, tables, batch)
+    clf.close()
+
+
+def test_narrow_wire_fallback_wide_values():
+    """Wide ifindex (>= 2^16) or pkt_len (>= 2^16) rows must refuse the
+    narrow form (return None) and classify correctly via the full wire."""
+    from infw.packets import make_batch, narrow_wire
+
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, 6, 80, 0, 0, 0, 1]
+    content = {LpmKey(24 + 32, 70000, bytes([10, 0, 0, 0]) + bytes(12)): rows}
+    tables = compile_tables_from_content(content, rule_width=4)
+    batch = make_batch(src=["10.0.0.9", "10.0.0.9"], proto=[6, 6],
+                       dst_port=[80, 81], ifindex=[70000, 70000])
+    assert narrow_wire(batch.pack_wire()) is None
+    batch2 = make_batch(src=["10.0.0.9"], proto=[6], dst_port=[80], ifindex=[2])
+    batch2.pkt_len = np.asarray([1 << 17], np.int32)
+    assert narrow_wire(batch2.pack_wire()) is None
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    check_against_oracle(clf, tables, batch)
+    out = clf.classify(batch)
+    assert out.xdp.tolist() == [1, 2]
+    clf.close()
+
+
 def test_classifier_incremental_load_uses_patch():
     """A small rule edit on a loaded trie-path classifier must take the
     incremental device patch, and verdicts must match the oracle."""
