@@ -69,7 +69,12 @@ class DeviceTables(NamedTuple):
     key_words: jax.Array    # (T, 5) uint32
     mask_words: jax.Array   # (T, 5) uint32
     mask_len: jax.Array     # (T,) int32
-    rules: jax.Array        # (T, R, 7) int32
+    #: (T, R, 5) uint16 packed rule rows [rid|act<<8, proto|icmpType<<8,
+    #: icmpCode, portStart, portEnd] when every field fits (syncer tables
+    #: always; 10B/rule halves the per-packet rules gather, the scan's
+    #: dominant HBM cost) — (T, R, 7) int32 otherwise (adversarial direct
+    #: content with wide values)
+    rules: jax.Array
     trie_levels: Tuple[jax.Array, ...]
     trie_targets: jax.Array  # (1 + total present targets,) int32
     root_lut: jax.Array     # (max_if+1,) int32
@@ -226,7 +231,18 @@ def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = Tru
     # astype would copy the full arrays on every patch diff
     key_words = tables.key_words.astype(np.uint32, copy=False)
     mask_words = tables.mask_words.astype(np.uint32, copy=False)
-    rules = tables.rules
+    # memoized per tables instance (same pattern as _poptrie_cache): the
+    # patch path calls this for BOTH generations on every edit, and a
+    # full repack is O(table) host work the hint fast path must not pay
+    rules = getattr(tables, "_packed_rules_cache", None)
+    if rules is None:
+        rules = pack_rules_u16(tables.rules)
+        if rules is None:
+            rules = tables.rules  # wide values: int32 layout
+        try:
+            object.__setattr__(tables, "_packed_rules_cache", rules)
+        except (AttributeError, TypeError):
+            pass
     if with_trie:
         trie_levels, trie_targets = build_poptrie(tables)
     else:
@@ -259,9 +275,24 @@ def _sparse_expand_jit(n_rows: int, n_cols: int, dtype: str):
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=None)
-def _upcast_rules_jit():
-    return jax.jit(lambda r16: r16.astype(jnp.int32))
+def pack_rules_u16(rules: np.ndarray):
+    """(T, R, 7) int32 -> (T, R, 5) uint16 packed rule rows, or None when
+    any field exceeds its packed width (ruleId/proto/icmp/action 8 bits,
+    ports 16).  The scan gathers one row per packet, so the row is the
+    HBM cost that matters: 10B/rule vs 28B."""
+    if rules.size == 0:
+        return np.zeros(rules.shape[:2] + (5,), np.uint16)
+    mx = rules.max(axis=(0, 1))
+    mn = int(rules.min())
+    if mn < 0 or (mx[[0, 1, 4, 5, 6]] > 0xFF).any() or (mx[[2, 3]] > 0xFFFF).any():
+        return None
+    out = np.empty(rules.shape[:2] + (5,), np.uint16)
+    out[..., 0] = rules[..., 0] | (rules[..., 6] << 8)   # rid | act
+    out[..., 1] = rules[..., 1] | (rules[..., 4] << 8)   # proto | icmpType
+    out[..., 2] = rules[..., 5]                          # icmpCode
+    out[..., 3] = rules[..., 2]                          # portStart
+    out[..., 4] = rules[..., 3]                          # portEnd
+    return out
 
 
 @functools.lru_cache(maxsize=None)
@@ -317,12 +348,6 @@ def device_tables(
      root_lut) = _host_device_layout(tables, pad)
     put = lambda a: jax.device_put(jnp.asarray(a), device)
 
-    # -- rules: narrow to u16 when every field fits ---------------------
-    if rules.size and 0 <= int(rules.min()) and int(rules.max()) < 65536:
-        rules_dev = _upcast_rules_jit()(put(rules.astype(np.uint16)))
-    else:
-        rules_dev = put(rules)  # empty, or wide values (adversarial content)
-
     # -- trie levels: sparse scatter below the density limit (the DIR-16
     # root level is ~0-60% dense; poptrie node rows are mostly dense by
     # construction, so they usually ship whole — and are ~30x smaller
@@ -350,7 +375,7 @@ def device_tables(
         key_words=put(key_words),
         mask_words=_mask_words_dev_jit()(put(mask_len)),
         mask_len=put(mask_len),
-        rules=rules_dev,
+        rules=put(rules),
         trie_levels=tuple(levels_dev),
         trie_targets=put(trie_targets),
         root_lut=put(root_lut),
@@ -920,8 +945,11 @@ def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
 def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:
     """Vectorized ordered first-match scan (kernel.c:222-258).
 
-    rows: (B, R, 7) int32 — already gathered (zeroed for no-LPM-match
-    packets, which then yield ruleId==0 everywhere -> UNDEF).
+    rows: (B, R, 5) uint16 packed (pack_rules_u16 — the resident form
+    for in-range tables, halving the gather bytes that dominate this
+    path) or (B, R, 7) int32 — already gathered (zeroed for
+    no-LPM-match packets, which then yield ruleId==0 everywhere ->
+    UNDEF).
 
     Perf note (the single biggest lever on this path): the first-match
     select is a min-index + masked-sum, NOT take_along_axis.  On TPU the
@@ -932,8 +960,18 @@ def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:
     gather forces a separate materialize-and-gather pass.  The scan also
     runs in (R, B) orientation so packets ride the 128-wide vector lanes;
     the transpose folds into the preceding rules gather."""
-    s = jnp.transpose(rows, (2, 1, 0))  # (7, R, B): field, rule, packet
-    rid, rproto, ps, pe, it, ic, act = (s[i] for i in range(7))
+    if rows.shape[-1] == 5:
+        s = jnp.transpose(rows.astype(jnp.int32), (2, 1, 0))  # (5, R, B)
+        rid = s[0] & 0xFF
+        act = s[0] >> 8
+        rproto = s[1] & 0xFF
+        it = s[1] >> 8
+        ic = s[2]
+        ps = s[3]
+        pe = s[4]
+    else:
+        s = jnp.transpose(rows, (2, 1, 0))  # (7, R, B): field, rule, packet
+        rid, rproto, ps, pe, it, ic, act = (s[i] for i in range(7))
 
     proto = batch.proto[None, :]
     dport = batch.dst_port[None, :]

@@ -557,6 +557,9 @@ def test_compact_transfer_upload_bit_identical():
             for a, b in zip(jax.tree.leaves(dev), jax.tree.leaves(direct)):
                 assert a.dtype == b.dtype
                 np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # in-range tables get the packed u16 rule rows; wide ruleIds keep i32
+    assert jaxpath.device_tables(variants[0]).rules.dtype == jnp.uint16
+    assert jaxpath.device_tables(variants[1]).rules.dtype == jnp.int32
 
 
 def test_narrow_wire_classify_lossless():
