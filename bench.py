@@ -7,7 +7,7 @@ MAX_RULES_PER_TARGET capacity, bpf/ingress_node_firewall.h:13-14 — is
 printed LAST so drivers that parse the final line keep recording the
 same series as previous rounds):
 
-  1. config 3: 100K-CIDR LPM (variable-stride trie walk, XLA) — the
+  1. config 3: 100K-CIDR LPM (poptrie walk, XLA) — the
      scale tier of the reference's LPM trie map
      (bpf/ingress_node_firewall_kernel.c:218-219, map :43-57).
   2. config 5a: 10M-packet frames-file replay through the daemon's
@@ -290,7 +290,7 @@ def bench_trie_100k(rng, on_tpu):
                       ifindexes=(2, 3, 4)),
         metric_of=lambda t: (
             f"packet classifications/sec/chip @{t.num_entries // 1000}K CIDRs "
-            "(variable-stride LPM trie, XLA, family-split chunks)"
+            "(poptrie LPM walk, XLA, family-split chunks)"
         ),
     )
 
@@ -443,7 +443,7 @@ def bench_adversarial_1m(rng, on_tpu):
                       group_size=16),
         metric_of=lambda t: (
             f"packet classifications/sec/chip @{t.num_entries/1e6:.0f}M-entry "
-            "adversarial overlap table (LPM trie, XLA, family-split chunks)"
+            "adversarial overlap table (poptrie LPM walk, XLA, family-split chunks)"
         ),
     )
 
@@ -469,7 +469,7 @@ def bench_8iface(rng, on_tpu):
         metric_of=lambda t: (
             f"packet classifications/sec/chip, 8 ifaces x per-iface "
             f"rulesets @{t.num_entries // 1000}K entries "
-            "(mixed-ifindex batch, LPM trie)"
+            "(mixed-ifindex batch, poptrie)"
         ),
     )
 

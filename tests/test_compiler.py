@@ -402,3 +402,60 @@ def test_consumed_snapshot_guards_mutation():
         it.apply(_random_content(rng, 1))
     with pytest.raises(CompileError):
         it.snapshot()
+
+
+def test_poptrie_structural_invariants():
+    """build_poptrie's implicit child numbering must be self-consistent:
+    at every level the child-bitmap popcounts sum to the next level's
+    node count, child_base is their exclusive prefix sum, target_base
+    carries the global concat offsets, and the targets array length is
+    1 (sentinel) + all target bits."""
+    import numpy as np
+
+    from infw import testing
+    from infw.kernels.jaxpath import build_poptrie
+
+    rng = np.random.default_rng(17)
+    tables = testing.random_tables_fast(
+        rng, n_entries=4000, width=4, group_size=6, ifindexes=(2, 5, 9)
+    )
+    levels, targets = build_poptrie(tables)
+
+    def pops(words):
+        return np.unpackbits(
+            np.ascontiguousarray(words).view(np.uint8), bitorder="little"
+        ).reshape(words.shape[0], -1).sum(axis=1)
+
+    # level 0 child ids (stored +1) must reference renumbered level-1 ids
+    lvl0_children = levels[0][:, 0]
+    n1 = levels[1].shape[0]
+    live0 = lvl0_children[lvl0_children > 0]
+    assert len(np.unique(live0)) == len(live0)  # single-parent
+    if len(live0):
+        assert int(live0.max()) <= n1
+
+    t_off = 1
+    for l in range(1, len(levels)):
+        rows = levels[l]
+        cb = rows[:, 2:10]
+        tb = rows[:, 10:18]
+        ccounts = pops(cb)
+        tcounts = pops(tb)
+        # child_base = exclusive prefix sum of child counts
+        np.testing.assert_array_equal(
+            rows[:, 0].astype(np.int64),
+            np.concatenate([[0], np.cumsum(ccounts)[:-1]]),
+        )
+        # target_base carries the global offset
+        np.testing.assert_array_equal(
+            rows[:, 1].astype(np.int64),
+            t_off + np.concatenate([[0], np.cumsum(tcounts)[:-1]]),
+        )
+        t_off += int(tcounts.sum())
+        # every implied child id is a valid next-level node
+        if l + 1 < len(levels):
+            assert int(ccounts.sum()) == levels[l + 1].shape[0]
+        else:
+            assert int(ccounts.sum()) == 0  # deepest level has no children
+    assert len(targets) == t_off
+    assert targets[0] == 0 and (targets[1:] > 0).all()
