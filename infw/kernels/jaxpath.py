@@ -891,10 +891,18 @@ def trie_walk(
     )
 
     # -- level 0: direct-indexed DIR-16 root --------------------------------
+    # OOB policy for every gather in the walk: indices are in-range by
+    # construction (child ranks only reach allocated nodes; dead lanes
+    # pin to 0), and should a future build bug break that, the lane
+    # FAILS CLOSED — an explicit range mask invalidates it (clip-mode
+    # gathers keep the read itself deterministic; relying on jnp.take's
+    # default FILL or on clamping alone would leave wrong-verdict paths).
     nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
-    rows0 = jnp.take(trie_levels[0], root * 65536 + nib0, axis=0)
-    best0 = jnp.where(rows0[:, 1] > 0, rows0[:, 1] - 1, -1)
-    alive = rows0[:, 0] > 0  # child ids are stored +1 (0 = none)
+    e0 = root * 65536 + nib0
+    in0 = (e0 >= 0) & (e0 < trie_levels[0].shape[0])
+    rows0 = jnp.take(trie_levels[0], e0, axis=0, mode="clip")
+    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1] - 1, -1)
+    alive = in0 & (rows0[:, 0] > 0)  # child ids are stored +1 (0 = none)
     node = jnp.where(alive, rows0[:, 0] - 1, 0)
 
     cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
@@ -910,7 +918,9 @@ def trie_walk(
             (batch.ip_words[:, w32] >> np.uint32(shift))
             & np.uint32((1 << stride) - 1)
         ).astype(jnp.int32)
-        r = jnp.take(tbl, node, axis=0)  # (B, 18) uint32, clipped indices
+        in_l = (node >= 0) & (node < tbl.shape[0])
+        alive = alive & in_l
+        r = jnp.take(tbl, node, axis=0, mode="clip")
         w = (nib >> 5)[:, None]          # bitmap word 0..7
         below = (np.uint32(1) << (nib & 31).astype(jnp.uint32)) - 1
         cb = r[:, 2:10]
@@ -934,8 +944,10 @@ def trie_walk(
         node = jnp.where(
             alive, (r[:, 0] + prefix + _popcount32(cw & below)).astype(jnp.int32), 0
         )
-    tval = jnp.take(trie_targets, win.astype(jnp.int32))
-    return jnp.where(tval > 0, tval - 1, best0)
+    win = win.astype(jnp.int32)
+    in_w = (win >= 0) & (win < trie_targets.shape[0])
+    tval = jnp.take(trie_targets, win, mode="clip")
+    return jnp.where(in_w & (tval > 0), tval - 1, best0)
 
 
 def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:

@@ -127,6 +127,27 @@ def test_table_swap_is_idempotent_and_atomic():
     clf.close()
 
 
+def test_auto_path_selection_flips_across_dense_limit():
+    """Path choice is automatic by table size (dense up to the limit,
+    trie beyond — the reference's analogue is MAX_TARGETS map sizing),
+    and a reload across the boundary flips the path atomically in both
+    directions with verdicts bit-exact throughout."""
+    rng = np.random.default_rng(53)
+    small = testing.random_tables(rng, n_entries=20, width=8)
+    big = testing.random_tables(rng, n_entries=60, width=8)
+    clf = TpuClassifier(dense_limit=30)
+    clf.load_tables(small)
+    assert clf.active_path == "dense"
+    check_against_oracle(clf, small, testing.random_batch(rng, small, 200))
+    clf.load_tables(big)  # grow past the limit: dense -> trie
+    assert clf.active_path == "trie"
+    check_against_oracle(clf, big, testing.random_batch(rng, big, 200))
+    clf.load_tables(small)  # shrink back: trie -> dense
+    assert clf.active_path == "dense"
+    check_against_oracle(clf, small, testing.random_batch(rng, small, 200))
+    clf.close()
+
+
 def test_classify_after_close_raises():
     clf = TpuClassifier()
     clf.close()
