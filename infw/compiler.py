@@ -311,11 +311,18 @@ class CompiledTables:
             "rule_width": self.rule_width,
             "num_entries": self.num_entries,
             "n_trie_levels": len(self.trie_levels),
-            "content_keys": [
-                [k.prefix_len, k.ingress_ifindex, k.ip_data.hex()]
-                for k in self.content
-            ],
         }
+        # content keys persist as packed COLUMNS, not a JSON list: at 1M
+        # entries the hexified-list format cost tens of seconds on both
+        # sides of the restart path (json + per-key hex round trips)
+        n_keys = len(self.content)
+        key_plen = np.empty(n_keys, np.uint16)
+        key_ifx = np.empty(n_keys, np.uint32)
+        key_ip = np.empty((n_keys, 16), np.uint8)
+        for i, k in enumerate(self.content):
+            key_plen[i] = k.prefix_len
+            key_ifx[i] = k.ingress_ifindex
+            key_ip[i] = np.frombuffer(k.ip_data, np.uint8)
         content_rules = (
             np.stack([self.content[k] for k in self.content])
             if self.content
@@ -343,6 +350,9 @@ class CompiledTables:
             rules=self.rules,
             root_lut=self.root_lut,
             content_rules=content_rules,
+            content_key_plen=key_plen,
+            content_key_ifx=key_ifx,
+            content_key_ip=key_ip,
             **level_arrays,
         )
 
@@ -359,8 +369,20 @@ class CompiledTables:
                 )
             content_rules = z["content_rules"]
             content = {}
-            for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
-                content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = content_rules[i]
+            if "content_key_plen" in z:
+                plens = z["content_key_plen"].tolist()
+                ifxs = z["content_key_ifx"].tolist()
+                ip_bytes = z["content_key_ip"].tobytes()
+                content = {
+                    LpmKey(plens[i], ifxs[i], ip_bytes[i * 16 : i * 16 + 16]):
+                        content_rules[i]
+                    for i in range(len(plens))
+                }
+            else:  # pre-columnar archives kept the keys in meta JSON
+                for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
+                    content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = (
+                        content_rules[i]
+                    )
             trie_levels = []
             for i in range(meta["n_trie_levels"]):
                 if f"trie_level_{i}" in z:
