@@ -508,11 +508,16 @@ def bench_incremental_update(rng, on_tpu):
         mode, n_rows = clf._last_load
         log(f"update {i}: {lats[-1]*1e3:.0f} ms mode={mode} rows={n_rows}")
         assert mode == "patch", "patch path must engage for 1-key edits"
-    med = sorted(lats)[len(lats) // 2]
+    # best-of-N, like the replay tier: each sample rides 2-3 tunnel RPCs,
+    # so the median measures link spikes (samples ranged 167ms-1.6s
+    # across recorded runs), while the min is the dataplane's capability
+    best = min(lats)
+    log(f"update: best {best*1e3:.0f} ms of {sorted(int(l*1e3) for l in lats)}")
     emit(
-        f"1-key rule update to device @{n_entries // 1000}K entries "
+        f"1-key rule update to device @{n_entries // 1000}K entries, "
+        f"best of {len(lats)} "
         f"(incremental diff-scatter patch; full reload {t_full:.1f}s)",
-        med * 1e3, "ms", vs_baseline=t_full / med,
+        best * 1e3, "ms", vs_baseline=t_full / best,
     )
     clf.close()
 
