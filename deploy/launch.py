@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import shlex
 import signal
 import subprocess
@@ -70,13 +71,22 @@ def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
         # script this replaces quoted \"$STATE_DIR\" at every use).
         argv = []
         for tok in shlex.split(comp["run"]):
-            for key, val in subs.items():
-                tok = tok.replace(f"<{key}>", str(val))
-            if "<" in tok and ">" in tok:
+            # Detect placeholders on the TEMPLATE token, before
+            # substitution: a substituted value that itself contains
+            # angle brackets (a path, a node name) must not trip a
+            # false "unfilled placeholder" error.
+            unfilled = [
+                m for m in re.findall(r"<([A-Za-z][A-Za-z0-9_-]*)>", tok)
+                if m not in subs
+            ]
+            if unfilled:
                 raise SystemExit(
-                    f"component {name}: unfilled placeholder in run "
+                    f"component {name}: unfilled placeholder "
+                    f"{', '.join(f'<{m}>' for m in unfilled)} in run "
                     f"token: {tok}"
                 )
+            for key, val in subs.items():
+                tok = tok.replace(f"<{key}>", str(val))
             argv.append(tok)
         argv[0] = sys.executable  # the bundle says "python"; use ours
         env = dict(os.environ)

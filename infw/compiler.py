@@ -880,6 +880,8 @@ class IncrementalTables:
         # path got that atomicity for free).
         for key in upserts:
             _validate_key(key)
+        for key in deletes:
+            _validate_key(key)
         max_mask = max((k.mask_len for k in upserts), default=0)
         if trie_levels_for_mask(max_mask) > self.trie.n_levels:
             raise CompileError(
@@ -1039,6 +1041,14 @@ def _validate_key(key: LpmKey) -> None:
         raise CompileError(f"ifindex {key.ingress_ifindex} out of supported range")
     if not (32 <= key.prefix_len <= 160):
         raise CompileError(f"prefixLen {key.prefix_len} out of range [32,160]")
+    # Downstream layouts assume the reference's fixed 16-byte ip_data
+    # (bpf/ingress_node_firewall.h:86); the columnar checkpoint writer
+    # frombuffer()s it into a 16-wide row, so enforce the invariant here
+    # at the boundary instead of surfacing as a broadcast error at save.
+    if len(key.ip_data) != 16:
+        raise CompileError(
+            f"ip_data must be exactly 16 bytes, got {len(key.ip_data)}"
+        )
 
 
 def compile_tables_from_content(

@@ -172,6 +172,16 @@ def build_poptrie(tables: CompiledTables):
         # next level's renumbering: present children in (node, slot) order
         perm = child[present]
         if l == 0:
+            # The walk computes e0 = root * 65536 + nib0 in int32; keep
+            # the root level small enough that the product cannot wrap
+            # (>= 32768 root nodes would need a ~17GB host slot array
+            # long before this fires, but wrap would silently turn deny
+            # entries into UNDEF/PASS via the OOB mask).
+            if n_nodes * 65536 > np.iinfo(np.int32).max:
+                raise ValueError(
+                    f"poptrie root level has {n_nodes} nodes; int32 "
+                    "DIR-16 indexing supports at most 32767"
+                )
             # remap child ids to renumbered-level-1 ids + 1 (0 = none)
             if len(slot_levels) > 1:
                 n_next = slot_levels[1].shape[0] // (1 << strides[1])
@@ -515,7 +525,8 @@ def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
     bucket/dtype no longer matches or the hint is too large to win."""
     nb = dev_arr.shape[0]
     if (
-        tuple(dev_arr.shape[1:]) != new_np.shape[1:]
+        dev_arr.dtype != new_np.dtype
+        or tuple(dev_arr.shape[1:]) != new_np.shape[1:]
         or _row_bucket(new_np.shape[0]) != nb
     ):
         return None
@@ -893,10 +904,11 @@ def trie_walk(
     # -- level 0: direct-indexed DIR-16 root --------------------------------
     # OOB policy for every gather in the walk: indices are in-range by
     # construction (child ranks only reach allocated nodes; dead lanes
-    # pin to 0), and should a future build bug break that, the lane
-    # FAILS CLOSED — an explicit range mask invalidates it (clip-mode
-    # gathers keep the read itself deterministic; relying on jnp.take's
-    # default FILL or on clamping alone would leave wrong-verdict paths).
+    # pin to 0; build_poptrie bounds the root level so e0 below cannot
+    # wrap int32), and should a future build bug break that, the lane is
+    # INVALIDATED — an explicit range mask forces it to UNDEF, i.e. XDP
+    # PASS (deterministic, never a wrong-verdict read; note this default
+    # is allow, matching the kernel's no-match semantics, kernel.c:453).
     nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
     e0 = root * 65536 + nib0
     in0 = (e0 >= 0) & (e0 < trie_levels[0].shape[0])

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Component isolation for the trie classify path (round-5 ask #1).
+
+The round-4 profiling pinned 'walk alone 64.5 M pkts/s, walk+rules
+17.8 M @100K' but never separated the rules GATHER from the scan's
+TRANSPOSE from the scan arithmetic.  This script times, with the bench's
+chained-loop methodology (results feed back into ip words + ports so
+nothing hoists), the cumulative stages:
+
+  A  walk only                       (tidx as the chained result)
+  B  walk + rules gather + fold      (gather forced, no transpose/scan)
+  C  walk + gather + transpose+fold  (adds the (B,R,5)->(5,R,B) transpose)
+  D  walk + gather + full scan       (current classify, minus finalize)
+  E  full classify                   (with finalize/stats)
+  F  D but scan in B-major layout    (transpose-free scan variant)
+  G  B with rules pre-flattened (T, R*5) u16 row gather
+  H  B with rules padded to (T, 128) u16 rows (lane-aligned gather)
+
+Run on the real chip: python tools/profile_trie.py [n_entries] [width]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from infw import testing
+from infw.constants import IPPROTO_ICMP, IPPROTO_ICMPV6, IPPROTO_SCTP, IPPROTO_TCP, IPPROTO_UDP, KIND_IPV4
+from infw.kernels import jaxpath
+
+from bench import chained_throughput
+
+
+def rule_scan_bmajor(rows, batch):
+    """Transpose-free ordered first-match scan: same semantics as
+    jaxpath.rule_scan but operating in (B, R) orientation — packets ride
+    sublanes, rules ride lanes; no (B,R,5)->(5,R,B) shuffle."""
+    r = rows.astype(jnp.int32)  # (B, R, 5)
+    rid = r[:, :, 0] & 0xFF
+    act = r[:, :, 0] >> 8
+    rproto = r[:, :, 1] & 0xFF
+    it = r[:, :, 1] >> 8
+    ic = r[:, :, 2]
+    ps = r[:, :, 3]
+    pe = r[:, :, 4]
+    proto = batch.proto[:, None]
+    dport = batch.dst_port[:, None]
+    valid = rid != 0
+    proto_eq = (rproto != 0) & (rproto == proto)
+    is_transport = (
+        (rproto == IPPROTO_TCP) | (rproto == IPPROTO_UDP) | (rproto == IPPROTO_SCTP)
+    )
+    port_hit = jnp.where(pe == 0, dport == ps, (dport >= ps) & (dport < pe))
+    fam = jnp.where(batch.kind == KIND_IPV4, IPPROTO_ICMP, IPPROTO_ICMPV6)[:, None]
+    icmp_hit = (
+        (rproto == fam) & (it == batch.icmp_type[:, None]) & (ic == batch.icmp_code[:, None])
+    )
+    hit = valid & ((proto_eq & ((is_transport & port_hit) | icmp_hit)) | (rproto == 0))
+    R = rid.shape[1]
+    idx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    first = jnp.min(jnp.where(hit, idx, R), axis=1)
+    any_hit = first < R
+    sel = hit & (idx == first[:, None])
+    rid_f = jnp.sum(jnp.where(sel, rid, 0), axis=1)
+    act_f = jnp.sum(jnp.where(sel, act, 0), axis=1)
+    return jnp.where(
+        any_hit,
+        ((rid_f.astype(jnp.uint32) & 0xFFFFFF) << 8) | (act_f.astype(jnp.uint32) & 0xFF),
+        0,
+    ).astype(jnp.uint32)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    print(f"backend={jax.default_backend()} entries={n_entries} width={width}",
+          file=sys.stderr, flush=True)
+    if on_tpu:
+        from infw.platform import enable_jax_compile_cache
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    rng = np.random.default_rng(2024)
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    # v4-only sub-batch with truncated walk depth — the family the daemon
+    # actually steers; keeps every variant on identical work
+    kinds = np.asarray(batch.kind)
+    idx = np.nonzero(kinds == KIND_IPV4)[0]
+    sub = batch.take(idx)
+    db = jaxpath.device_batch(sub)
+    dt = jaxpath.device_tables(tables)
+    depth = jaxpath.v4_trie_depth(len(dt.trie_levels))
+    dtv4 = dt._replace(trie_levels=dt.trie_levels[:depth])
+    n = len(idx)
+    print(f"v4 sub-batch {n} packets, walk depth {depth}", file=sys.stderr, flush=True)
+
+    # pre-built alternate rule layouts
+    rules_np = np.asarray(dt.rules)  # (T, R, 5) u16
+    T, R, _ = rules_np.shape
+    rules_flat = jax.device_put(rules_np.reshape(T, R * 5))
+    rules_pad = np.zeros((T, 128), np.uint16)
+    rules_pad[:, : R * 5] = rules_np.reshape(T, R * 5)
+    rules_pad = jax.device_put(rules_pad)
+
+    def fold16(x):  # cheap consume: (B, ...) u16 -> (B,) u32, forces the gather
+        return jnp.sum(x.astype(jnp.uint32), axis=tuple(range(1, x.ndim)))
+
+    def walk_only(tabs, b):
+        return jaxpath.lpm_trie(tabs, b).astype(jnp.uint32)
+
+    def walk_gather(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(tabs.rules, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
+        return fold16(rows) + tidx.astype(jnp.uint32)
+
+    def walk_gather_t(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(tabs.rules, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
+        s = jnp.transpose(rows.astype(jnp.int32), (2, 1, 0))
+        return jnp.sum(s.astype(jnp.uint32), axis=(0, 1)) + tidx.astype(jnp.uint32)
+
+    def walk_scan(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(tabs.rules, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
+        return jaxpath.rule_scan(rows, b)
+
+    def full(tabs, b):
+        res, _x, _s = jaxpath.classify(tabs, b, use_trie=True)
+        return res
+
+    def walk_scan_bmajor(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(tabs.rules, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
+        return rule_scan_bmajor(rows, b)
+
+    def gather_flat(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(rules_flat, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None], rows, 0)
+        return fold16(rows) + tidx.astype(jnp.uint32)
+
+    def gather_pad128(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(rules_pad, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None], rows, 0)
+        return fold16(rows) + tidx.astype(jnp.uint32)
+
+    variants = [
+        ("A walk only", walk_only),
+        ("B walk+gather+fold", walk_gather),
+        ("C walk+gather+transpose", walk_gather_t),
+        ("D walk+gather+scan", walk_scan),
+        ("E full classify", full),
+        ("F walk+gather+scan(Bmajor)", walk_scan_bmajor),
+        ("G gather (T,R*5) flat", gather_flat),
+        ("H gather (T,128) pad", gather_pad128),
+    ]
+    results = {}
+    for name, fn in variants:
+        try:
+            thr = chained_throughput(fn, dtv4, db, n, on_tpu, name)
+            results[name] = thr
+        except Exception as e:
+            print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
+    print("\n=== summary ===", file=sys.stderr, flush=True)
+    for name, thr in results.items():
+        print(f"{name}: {thr/1e6:.1f} M pkts/s ({1e9/thr:.1f} ns/pkt)",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Round-2 component isolation: v6 full-depth walk cost + flat-gather
+variants feeding the REAL scan (tools/profile_trie.py found the 3D
+(T,R,5) rules gather costs ~2.4x a flat (T,R*5) gather of the same
+bytes).
+
+  v4 I  walk + flat-gather + reshape + current scan
+  v4 J  walk + pad128-gather + lane-sliced B-major scan
+  v6 L  walk only (full depth)
+  v6 M  current full classify
+  v6 I6 walk + flat-gather + reshape + current scan
+  v6 D8/D5 walk truncated to 8/5 levels (timing-only, wrong verdicts):
+        depth scaling of the v6 walk
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from infw import testing
+from infw.constants import KIND_IPV4, KIND_IPV6
+from infw.kernels import jaxpath
+
+from bench import chained_throughput
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if on_tpu:
+        from infw.platform import enable_jax_compile_cache
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    rng = np.random.default_rng(2024)
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    kinds = np.asarray(batch.kind)
+    dt = jaxpath.device_tables(tables)
+    print(f"levels={len(dt.trie_levels)}", file=sys.stderr, flush=True)
+
+    rules_np = np.asarray(dt.rules)
+    T, R, _ = rules_np.shape
+    rules_flat = jax.device_put(rules_np.reshape(T, R * 5))
+    rules_pad = np.zeros((T, 128), np.uint16)
+    rules_pad[:, : R * 5] = rules_np.reshape(T, R * 5)
+    rules_pad = jax.device_put(rules_pad)
+
+    def scan_flat(tabs, b):
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(rules_flat, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None], rows, 0)
+        return jaxpath.rule_scan(rows.reshape(-1, R, 5), b)
+
+    def scan_pad_lane(tabs, b):
+        from infw.constants import (
+            IPPROTO_ICMP, IPPROTO_ICMPV6, IPPROTO_SCTP, IPPROTO_TCP, IPPROTO_UDP)
+        tidx = jaxpath.lpm_trie(tabs, b)
+        rows = jnp.take(rules_pad, jnp.clip(tidx, 0), axis=0)
+        rows = jnp.where((tidx >= 0)[:, None], rows, 0).astype(jnp.int32)
+        # lane-sliced B-major scan: field f of rule r at lane r*5+f would
+        # interleave; pad layout keeps (R,5) flattened -> slice strided
+        r3 = rows[:, : R * 5].reshape(-1, R, 5)
+        rid = r3[:, :, 0] & 0xFF
+        act = r3[:, :, 0] >> 8
+        rproto = r3[:, :, 1] & 0xFF
+        it = r3[:, :, 1] >> 8
+        ic = r3[:, :, 2]
+        ps = r3[:, :, 3]
+        pe = r3[:, :, 4]
+        proto = b.proto[:, None]
+        dport = b.dst_port[:, None]
+        valid = rid != 0
+        proto_eq = (rproto != 0) & (rproto == proto)
+        is_transport = (
+            (rproto == IPPROTO_TCP) | (rproto == IPPROTO_UDP) | (rproto == IPPROTO_SCTP))
+        port_hit = jnp.where(pe == 0, dport == ps, (dport >= ps) & (dport < pe))
+        fam = jnp.where(b.kind == KIND_IPV4, IPPROTO_ICMP, IPPROTO_ICMPV6)[:, None]
+        icmp_hit = ((rproto == fam) & (it == b.icmp_type[:, None])
+                    & (ic == b.icmp_code[:, None]))
+        hit = valid & ((proto_eq & ((is_transport & port_hit) | icmp_hit)) | (rproto == 0))
+        idx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        first = jnp.min(jnp.where(hit, idx, R), axis=1)
+        any_hit = first < R
+        sel = hit & (idx == first[:, None])
+        rid_f = jnp.sum(jnp.where(sel, rid, 0), axis=1)
+        act_f = jnp.sum(jnp.where(sel, act, 0), axis=1)
+        return jnp.where(
+            any_hit,
+            ((rid_f.astype(jnp.uint32) & 0xFFFFFF) << 8)
+            | (act_f.astype(jnp.uint32) & 0xFF),
+            0,
+        ).astype(jnp.uint32)
+
+    def walk_only(tabs, b):
+        return jaxpath.lpm_trie(tabs, b).astype(jnp.uint32)
+
+    def full(tabs, b):
+        res, _x, _s = jaxpath.classify(tabs, b, use_trie=True)
+        return res
+
+    results = {}
+
+    # --- v4, truncated depth ---
+    idx4 = np.nonzero(kinds == KIND_IPV4)[0]
+    db4 = jaxpath.device_batch(batch.take(idx4))
+    depth = jaxpath.v4_trie_depth(len(dt.trie_levels))
+    dtv4 = dt._replace(trie_levels=dt.trie_levels[:depth])
+    for name, fn in (
+        ("v4 I flat+scan", scan_flat),
+        ("v4 J pad128+lane-scan", scan_pad_lane),
+    ):
+        try:
+            results[name] = chained_throughput(fn, dtv4, db4, len(idx4), on_tpu, name)
+        except Exception as e:
+            print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
+
+    # --- v6, full depth ---
+    idx6 = np.nonzero(kinds == KIND_IPV6)[0]
+    db6 = jaxpath.device_batch(batch.take(idx6))
+    v6_variants = [
+        ("v6 L walk only", walk_only, dt),
+        ("v6 M full classify", full, dt),
+        ("v6 I6 flat+scan", scan_flat, dt),
+        ("v6 D8 walk@8lvl (timing only)", walk_only,
+         dt._replace(trie_levels=dt.trie_levels[:8])),
+        ("v6 D5 walk@5lvl (timing only)", walk_only,
+         dt._replace(trie_levels=dt.trie_levels[:5])),
+    ]
+    for name, fn, tabs in v6_variants:
+        try:
+            results[name] = chained_throughput(fn, tabs, db6, len(idx6), on_tpu, name)
+        except Exception as e:
+            print(f"{name} FAILED: {e}", file=sys.stderr, flush=True)
+
+    print("\n=== summary ===", file=sys.stderr, flush=True)
+    for name, thr in results.items():
+        print(f"{name}: {thr/1e6:.1f} M pkts/s ({1e9/thr:.1f} ns/pkt)",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
