@@ -10,6 +10,11 @@
 #
 # The per-packet pmax/psum rules-axis combine stays on each host's ICI;
 # only the data axis and the final stats reduction cross DCN.
+#
+# The run line comes from the BUNDLE (deploy/bundle/manifest.json,
+# component daemon-multihost) via the launcher — this script only maps
+# its positional contract onto launcher flags, the same way
+# single-node.sh does.
 set -euo pipefail
 
 COORD="${1:?usage: multi-host.sh COORDINATOR_HOST:PORT NUM_PROCESSES [STATE_DIR]}"
@@ -20,8 +25,11 @@ REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$REPO_DIR"
 mkdir -p "$STATE_DIR"
 
-INFW_COORDINATOR="$COORD" \
-INFW_NUM_PROCESSES="$NPROC" \
-INFW_PROCESS_ID="${INFW_PROCESS_ID:?set INFW_PROCESS_ID to this hosts rank}" \
 NODE_NAME="${NODE_NAME:-$(hostname)}" \
-exec python -m infw.daemon --state-dir "$STATE_DIR" --backend tpu
+exec python deploy/launch.py \
+  --component daemon-multihost \
+  --coordinator "$COORD" \
+  --num-processes "$NPROC" \
+  --process-id "${INFW_PROCESS_ID:?set INFW_PROCESS_ID to this hosts rank}" \
+  --state-dir "$STATE_DIR" \
+  --backend "${INFW_BACKEND:-tpu}"

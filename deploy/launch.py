@@ -54,15 +54,59 @@ def load_bundle(path: str = BUNDLE_PATH) -> dict:
     return bundle
 
 
-def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
+def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None,
+               only: str | None = None, flag_env: dict | None = None):
     """[(name, argv, env)] in bundle launch order.  ``subs`` fills the
     run templates' <placeholders>; ``extra_args`` appends per-component
-    argv (e.g. ephemeral ports for tests)."""
+    argv (e.g. ephemeral ports for tests).  ``only`` selects a single
+    component by name (the way a DaemonSet pod runs one declared
+    container) — required for components marked ``standalone`` (e.g.
+    daemon-multihost), which never join the default composition.
+    ``flag_env`` maps launcher flag names to values; a component's
+    ``envFromFlags`` contract routes them into its environment."""
     components = bundle["components"]
-    order = bundle.get("launchOrder", sorted(components))
+    if only is not None:
+        if only not in components:
+            raise SystemExit(
+                f"unknown component {only!r}; bundle declares "
+                f"{sorted(components)}"
+            )
+        order = [only]
+    else:
+        order = bundle.get("launchOrder", sorted(components))
     unknown = [n for n in order if n not in components]
     if unknown:
         raise SystemExit(f"bundle launchOrder names unknown components: {unknown}")
+    # standalone components (daemon-multihost) carry an env contract the
+    # default composition cannot satisfy — launching one there would hang
+    # a distributed job on a rank that never joins; they are reachable
+    # only through an explicit --component selection.
+    standalone_in_order = [
+        n for n in order if components[n].get("standalone") and n != only
+    ]
+    if standalone_in_order:
+        raise SystemExit(
+            f"standalone components {standalone_in_order} cannot join the "
+            "default composition; launch them with --component"
+        )
+    # Conversely: multihost flags with no component consuming them would
+    # silently launch a single-host plan while the coordinator waits for
+    # this rank forever.
+    if flag_env:
+        consumed = {
+            f for n in order
+            for f in components[n].get("envFromFlags", {}).values()
+        }
+        dropped = sorted(
+            f for f, v in flag_env.items()
+            if v is not None and f not in consumed
+        )
+        if dropped:
+            raise SystemExit(
+                f"flags --{' --'.join(dropped)} are not consumed by any "
+                "launched component (did you mean --component "
+                "daemon-multihost?)"
+            )
     plan = []
     for name in order:
         comp = components[name]
@@ -98,6 +142,13 @@ def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None):
             env["NODE_NAME"] = str(subs["node-name"])
         for var, default in ENV_DEFAULTS.items():
             env.setdefault(var, default)
+        # The bundle's envFromFlags contract: launcher flags become the
+        # component's env (the daemonset fieldRef/env-injection role) —
+        # an explicit flag beats an inherited environment variable.
+        for var, flag in comp.get("envFromFlags", {}).items():
+            val = (flag_env or {}).get(flag)
+            if val is not None:
+                env[var] = str(val)
         missing = [
             var for var in comp.get("env", {}).get("required", [])
             if not env.get(var)
@@ -172,6 +223,17 @@ def main(argv=None) -> int:
     ap.add_argument("--ephemeral-ports", action="store_true",
                     help="bind daemon metrics/health to ephemeral ports "
                          "(tests / multiple compositions per host)")
+    ap.add_argument("--component", default=None,
+                    help="launch ONLY this bundle component (required for "
+                         "standalone components, e.g. daemon-multihost)")
+    ap.add_argument("--coordinator", default=None,
+                    help="multihost: coordinator host:port "
+                         "(bundle envFromFlags -> INFW_COORDINATOR)")
+    ap.add_argument("--num-processes", default=None,
+                    help="multihost: total process count "
+                         "(-> INFW_NUM_PROCESSES)")
+    ap.add_argument("--process-id", default=None,
+                    help="multihost: this host's rank (-> INFW_PROCESS_ID)")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the launch plan and exit")
     args = ap.parse_args(argv)
@@ -185,19 +247,35 @@ def main(argv=None) -> int:
         "events-socket": args.events_socket
         or os.path.join(state_dir, "events.sock"),
     }
+    # ephemeral ports for every component that DECLARES ports in the
+    # bundle (not a hardcoded name list — daemon-multihost binds the same
+    # metrics/health pair as daemon)
     extra = (
         {
-            "daemon": ["--metrics-port", "0", "--health-port", "0"],
-            "manager": ["--metrics-port", "0", "--health-port", "0"],
+            name: ["--metrics-port", "0", "--health-port", "0"]
+            for name, comp in bundle["components"].items()
+            if comp.get("ports")
         }
         if args.ephemeral_ports else {}
     )
-    plan = build_plan(bundle, subs, extra)
+    flag_env = {
+        "coordinator": args.coordinator,
+        "num-processes": args.num_processes,
+        "process-id": args.process_id,
+    }
+    plan = build_plan(bundle, subs, extra, only=args.component,
+                      flag_env=flag_env)
     print(f"launch: bundle {bundle['name']} v{bundle['version']} "
           f"({len(plan)} components)", flush=True)
     if args.dry_run:
         for name, argv_, env in plan:
             print(f"  {name}: {' '.join(shlex.quote(a) for a in argv_)}")
+            # envFromFlags routing is part of the plan — print it so a
+            # dry run (and the tests) can verify the injected contract
+            injected = bundle["components"][name].get("envFromFlags", {})
+            for var in injected:
+                if var in env:
+                    print(f"    env {var}={env[var]}")
         return 0
     return launch(plan, state_dir)
 

@@ -103,3 +103,97 @@ def test_compose_scripts_parse(script):
     p = os.path.join(DEPLOY, "compose", script)
     assert os.access(p, os.X_OK), f"{script} must be executable"
     subprocess.run(["bash", "-n", p], check=True)
+
+
+def _scrubbed_env():
+    """os.environ minus INFW_* so inherited multihost vars on the test
+    host cannot satisfy (or pollute) the bundle env contract."""
+    return {k: v for k, v in os.environ.items()
+            if not k.startswith("INFW_")}
+
+
+def _launcher_dry_run(*args, env=None):
+    import sys
+    return subprocess.run(
+        [sys.executable, os.path.join(DEPLOY, "launch.py"), "--dry-run",
+         *args],
+        capture_output=True, text=True,
+        env=env if env is not None else dict(os.environ),
+    )
+
+
+def test_multihost_component_plan():
+    """The multi-host composition is bundle-declared (round-4 weak #5):
+    --component daemon-multihost + the coordinator flags produce a
+    single-component plan whose env carries the jax.distributed contract
+    (envFromFlags -> INFW_COORDINATOR/INFW_NUM_PROCESSES/INFW_PROCESS_ID,
+    the daemonset env-injection role).  Env is scrubbed so the asserted
+    values can only come from the flags."""
+    r = _launcher_dry_run(
+        "--component", "daemon-multihost",
+        "--coordinator", "h0:8476", "--num-processes", "4",
+        "--process-id", "1", "--state-dir", "/tmp/infw-mh-test",
+        "--backend", "cpu", "--node-name", "mh-node",
+        env=_scrubbed_env(),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 components" in r.stdout
+    assert "infw.daemon" in r.stdout
+    assert "--backend cpu" in r.stdout
+    assert "env INFW_COORDINATOR=h0:8476" in r.stdout
+    assert "env INFW_NUM_PROCESSES=4" in r.stdout
+    assert "env INFW_PROCESS_ID=1" in r.stdout
+
+
+def test_multihost_component_requires_contract():
+    """Without the coordinator flags (and with the env scrubbed) the
+    bundle env contract must reject the launch, naming the missing
+    variables."""
+    r = _launcher_dry_run(
+        "--component", "daemon-multihost", "--state-dir", "/tmp/x",
+        "--node-name", "mh-node", env=_scrubbed_env(),
+    )
+    assert r.returncode != 0
+    assert "INFW_COORDINATOR" in r.stderr + r.stdout
+
+
+def test_multihost_flags_without_component_rejected():
+    """Multihost flags that no launched component consumes must fail the
+    launch instead of silently starting a single-host composition (the
+    coordinator would wait forever for this rank)."""
+    r = _launcher_dry_run(
+        "--coordinator", "h0:8476", "--num-processes", "4",
+        "--process-id", "1", "--state-dir", "/tmp/x",
+        "--node-name", "n", env=_scrubbed_env(),
+    )
+    assert r.returncode != 0
+    assert "not consumed" in r.stderr + r.stdout
+
+
+def test_ephemeral_ports_cover_declared_ports():
+    """--ephemeral-ports keys off the bundle's declared ports, so the
+    multihost daemon gets the same treatment as the default daemon."""
+    r = _launcher_dry_run(
+        "--component", "daemon-multihost",
+        "--coordinator", "h0:8476", "--num-processes", "4",
+        "--process-id", "0", "--state-dir", "/tmp/x",
+        "--node-name", "n", "--ephemeral-ports", env=_scrubbed_env(),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "--metrics-port 0" in r.stdout
+
+
+def test_multihost_script_routes_through_launcher():
+    """multi-host.sh must not hand-roll the daemon run line: it execs the
+    bundle launcher with the multihost component."""
+    with open(os.path.join(DEPLOY, "compose", "multi-host.sh")) as f:
+        body = f.read()
+    assert "launch.py" in body
+    assert "--component daemon-multihost" in body
+    assert "python -m infw.daemon" not in body
+
+
+def test_unknown_component_rejected():
+    r = _launcher_dry_run("--component", "no-such", "--state-dir", "/tmp/x")
+    assert r.returncode != 0
+    assert "unknown component" in r.stderr + r.stdout
