@@ -71,11 +71,15 @@ class DeviceTables(NamedTuple):
     key_words: jax.Array    # (T, 5) uint32
     mask_words: jax.Array   # (T, 5) uint32
     mask_len: jax.Array     # (T,) int32
-    #: (T, R, 5) uint16 packed rule rows [rid|act<<8, proto|icmpType<<8,
-    #: icmpCode, portStart, portEnd] when every field fits (syncer tables
-    #: always; 10B/rule halves the per-packet rules gather, the scan's
-    #: dominant HBM cost) — (T, R, 7) int32 otherwise (adversarial direct
-    #: content with wide values)
+    #: (T, R*5) uint16 FLATTENED packed rule rows [rid|act<<8,
+    #: proto|icmpType<<8, icmpCode, portStart, portEnd] per rule when
+    #: every field fits (syncer tables always) — (T, R*7) int32 otherwise
+    #: (adversarial direct content with wide values).  Flattened 2D on
+    #: purpose: XLA's row gather from a 2D (T, W) layout measures ~2.4x
+    #: faster than the same bytes as (T, R, C) 3D (tools/profile_trie.py
+    #: variants B vs G on v5e); classify reshapes to (B, R, C) after the
+    #: gather, which fuses into the scan.  The mesh rules-sharded path
+    #: keeps its own 3D layout (parallel/mesh.py).
     rules: jax.Array
     trie_levels: Tuple[jax.Array, ...]
     trie_targets: jax.Array  # (1 + total present targets,) int32
@@ -251,6 +255,8 @@ def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = Tru
         rules = pack_rules_u16(tables.rules)
         if rules is None:
             rules = tables.rules  # wide values: int32 layout
+        # flattened 2D device layout (see DeviceTables.rules)
+        rules = np.ascontiguousarray(rules).reshape(rules.shape[0], -1)
         try:
             object.__setattr__(tables, "_packed_rules_cache", rules)
         except (AttributeError, TypeError):
@@ -1065,6 +1071,18 @@ def finalize(result: jax.Array, batch: DeviceBatch) -> Tuple[jax.Array, jax.Arra
     return result, xdp, stats.astype(jnp.int32)
 
 
+def gather_rule_rows(rules: jax.Array, tidx: jax.Array) -> jax.Array:
+    """Per-packet rule rows for the scan: (B, R, C) from either the
+    flattened 2D device layout (fast-gather form, see DeviceTables.rules)
+    or a 3D (T, R, C) layout (mesh shards).  No-LPM-match packets get
+    all-zero rows -> ruleId 0 everywhere -> UNDEF."""
+    rows = jnp.take(rules, jnp.clip(tidx, 0), axis=0)
+    if rows.ndim == 2:
+        c = 5 if rows.dtype == jnp.uint16 else 7
+        rows = rows.reshape(rows.shape[0], -1, c)
+    return jnp.where((tidx >= 0)[:, None, None], rows, 0)
+
+
 def classify(
     tables: DeviceTables, batch: DeviceBatch, *, use_trie: bool
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -1073,9 +1091,7 @@ def classify(
         tidx = lpm_trie(tables, batch)
     else:
         tidx = lpm_dense(tables, batch)
-    rows = jnp.take(tables.rules, jnp.clip(tidx, 0), axis=0)
-    rows = jnp.where((tidx >= 0)[:, None, None], rows, 0)
-    result = rule_scan(rows, batch)
+    result = rule_scan(gather_rule_rows(tables.rules, tidx), batch)
     return finalize(result, batch)
 
 

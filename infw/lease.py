@@ -1,0 +1,205 @@
+"""Single-writer lease — the manager's leader election.
+
+The reference manager runs with controller-runtime leader election
+(/root/reference/main.go:76-85, LeaderElectionID
+"e9b59492.ingress-nodefw.openshift.io"): one manager process holds a
+renewable lease object; a second instance blocks in standby until the
+lease expires, then takes over.  Two active managers against one store
+would fight (duplicate NodeState writes, conflicting status rollups),
+exactly like two un-elected controller-runtime managers against one API
+server.
+
+Two implementations of one contract:
+
+- ``InMemoryLease`` — same-process instances sharing an
+  ``InMemoryStore`` (the envtest role);
+- ``FileLease`` — cross-process single-writer over a shared state dir
+  (the compose deployment, where the dir IS the cluster API), using
+  atomic create (O_EXCL) for first acquisition and write-then-verify
+  for steal/renew.
+
+Takeover semantics (matching the leader-election contract):
+
+- ``try_acquire`` succeeds when the lease is free, expired (steal), or
+  already held by this holder (re-entrant refresh);
+- ``renew`` succeeds ONLY while this holder still owns the lease; a
+  renewal failure means another instance stole an expired lease and the
+  caller must stop acting as leader (controller-runtime treats this as
+  fatal and exits the process; Manager.stop()s itself);
+- holders never block each other's clocks: a crashed leader is taken
+  over after at most ``duration_s`` without any cleanup.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger("infw.lease")
+
+
+class InMemoryLease:
+    """Thread-safe lease for same-process manager instances."""
+
+    def __init__(self, duration_s: float = 15.0) -> None:
+        self.duration_s = float(duration_s)
+        self._lock = threading.Lock()
+        self._holder: Optional[str] = None
+        self._expires_at = 0.0
+
+    def holder(self) -> Optional[Tuple[str, float]]:
+        with self._lock:
+            if self._holder is None or time.time() >= self._expires_at:
+                return None
+            return self._holder, self._expires_at
+
+    def try_acquire(self, holder: str) -> bool:
+        now = time.time()
+        with self._lock:
+            if (
+                self._holder is None
+                or self._holder == holder
+                or now >= self._expires_at
+            ):
+                stolen = (
+                    self._holder is not None
+                    and self._holder != holder
+                    and now >= self._expires_at
+                )
+                if stolen:
+                    log.info(
+                        "lease: %s taking over expired lease from %s",
+                        holder, self._holder,
+                    )
+                self._holder = holder
+                self._expires_at = now + self.duration_s
+                return True
+            return False
+
+    def renew(self, holder: str) -> bool:
+        now = time.time()
+        with self._lock:
+            if self._holder == holder and now < self._expires_at:
+                self._expires_at = now + self.duration_s
+                return True
+            return False
+
+    def release(self, holder: str) -> None:
+        with self._lock:
+            if self._holder == holder:
+                self._holder = None
+                self._expires_at = 0.0
+
+
+class FileLease:
+    """Cross-process lease over a shared file.
+
+    First acquisition uses O_CREAT|O_EXCL (atomic on one filesystem).
+    Steal and renew write a temp file, os.replace() it over the lease,
+    then RE-READ to verify this holder won — two concurrent stealers
+    both replace, but only the last writer survives the verify, and the
+    loser backs off.  The verify read happens after a short settle so a
+    racing replace lands before we conclude."""
+
+    def __init__(self, path: str, duration_s: float = 15.0,
+                 settle_s: float = 0.05) -> None:
+        self.path = path
+        self.duration_s = float(duration_s)
+        self.settle_s = float(settle_s)
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError):
+            # torn write from a crashed holder: treat as expired garbage
+            return {}
+
+    def _write(self, holder: str) -> dict:
+        rec = {
+            "holder": holder,
+            "acquired_at": time.time(),
+            "expires_at": time.time() + self.duration_s,
+            "pid": os.getpid(),
+        }
+        tmp = f"{self.path}.{holder}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        return rec
+
+    def holder(self) -> Optional[Tuple[str, float]]:
+        rec = self._read()
+        if not rec or not rec.get("holder"):
+            return None
+        if time.time() >= float(rec.get("expires_at", 0)):
+            return None
+        return rec["holder"], float(rec["expires_at"])
+
+    def try_acquire(self, holder: str) -> bool:
+        rec = self._read()
+        if rec is None:
+            # free: atomic exclusive create wins or loses cleanly
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            with os.fdopen(fd, "w") as f:
+                json.dump({
+                    "holder": holder,
+                    "acquired_at": time.time(),
+                    "expires_at": time.time() + self.duration_s,
+                    "pid": os.getpid(),
+                }, f)
+                f.flush()
+                os.fsync(f.fileno())
+            return True
+        if (
+            rec.get("holder") == holder
+            and time.time() < float(rec.get("expires_at", 0))
+        ):
+            self._write(holder)  # re-entrant refresh while still held
+            return True
+        if time.time() < float(rec.get("expires_at", 0)):
+            return False
+        # Expired — even when the stale record names THIS holder: a
+        # concurrent stealer may be mid write-then-verify, so an expired
+        # own record must go through the same verified steal, not a bare
+        # refresh (two leaders otherwise).
+        prev = rec.get("holder")
+        self._write(holder)
+        time.sleep(self.settle_s)
+        cur = self._read() or {}
+        won = cur.get("holder") == holder
+        if won and prev:
+            log.info("lease: %s took over expired lease from %s (file %s)",
+                     holder, prev, self.path)
+        return won
+
+    def renew(self, holder: str) -> bool:
+        rec = self._read()
+        if (
+            not rec
+            or rec.get("holder") != holder
+            or time.time() >= float(rec.get("expires_at", 0))
+        ):
+            return False
+        self._write(holder)
+        time.sleep(self.settle_s)
+        cur = self._read() or {}
+        return cur.get("holder") == holder
+
+    def release(self, holder: str) -> None:
+        rec = self._read()
+        if rec and rec.get("holder") == holder:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass

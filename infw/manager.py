@@ -28,6 +28,7 @@ import os
 import queue
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
@@ -86,6 +87,8 @@ class Manager:
         register_nodes: Optional[List[str]] = None,
         metrics_port: int = DEFAULT_METRICS_PORT,
         health_port: int = DEFAULT_HEALTH_PORT,
+        lease=None,
+        lease_holder: Optional[str] = None,
     ) -> None:
         self.store = store if store is not None else InMemoryStore()
         self.namespace = namespace
@@ -130,6 +133,15 @@ class Manager:
             except AlreadyExistsError:
                 pass
 
+        # Single-writer lease (leader election, main.go:76-85): start()
+        # blocks in standby until the lease is acquired; a renewal
+        # failure (another instance stole an expired lease) stops this
+        # manager — the controller-runtime leader-loss-is-fatal contract.
+        self.lease = lease
+        self.lease_holder = lease_holder or f"mgr-{os.getpid()}-{id(self):x}"
+        self.is_leader = lease is None  # leaderless single-writer default
+        self.lease_lost = False
+
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -158,12 +170,23 @@ class Manager:
     # -- work queue ----------------------------------------------------------
 
     def enqueue_fanout(self) -> None:
+        # Standby instances (lease not yet acquired) must not act OR
+        # accumulate an unbounded queue; the post-acquisition full resync
+        # in start() covers anything that happened while standing by.
+        if not self.is_leader:
+            return
         self._queue.put(("fanout", None))
 
     def enqueue_config(self, name: str) -> None:
+        if not self.is_leader:
+            return
         self._queue.put(("config", name))
 
     def _on_nodestate_event(self, event: str, obj) -> None:
+        # Only the leader mirrors exports — a standby writing the same
+        # export tmp files would race the leader's os.replace protocol.
+        if not self.is_leader:
+            return
         if self.export_dir is not None:
             path = os.path.join(self.export_dir, f"{obj.metadata.name}.json")
             if event == DELETED:
@@ -460,7 +483,48 @@ class Manager:
 
         return Handler
 
-    def start(self) -> None:
+    def _await_lease(self, timeout: Optional[float]) -> bool:
+        """Standby loop: poll try_acquire until leadership or timeout/stop.
+        Returns True when this instance is the leader."""
+        deadline = None if timeout is None else time.time() + timeout
+        poll = max(0.05, self.lease.duration_s / 10.0)
+        while not self._stop.is_set():
+            if self.lease.try_acquire(self.lease_holder):
+                self.is_leader = True
+                log.info("lease acquired holder=%s", self.lease_holder)
+                return True
+            if deadline is not None and time.time() >= deadline:
+                return False
+            time.sleep(poll)
+        return False
+
+    def _renew_loop(self) -> None:
+        interval = self.lease.duration_s / 3.0
+        while not self._stop.wait(interval):
+            if not self.lease.renew(self.lease_holder):
+                # Another instance took over an expired lease: stop acting
+                # as leader immediately (fatal, like controller-runtime's
+                # leader-election loss).
+                self.lease_lost = True
+                self.is_leader = False
+                log.error(
+                    "lease lost holder=%s (stolen after expiry); stopping",
+                    self.lease_holder,
+                )
+                threading.Thread(target=self.stop, daemon=True).start()
+                return
+
+    def start(self, lease_timeout: Optional[float] = None) -> bool:
+        """Bring the manager up.  With a lease configured this blocks in
+        standby until leadership is acquired (pass ``lease_timeout`` to
+        bound the wait; returns False if it expires un-acquired — the
+        instance stays standby and can be start()ed again)."""
+        if self.lease is not None and not self.is_leader:
+            if not self._await_lease(lease_timeout):
+                return False
+            t = threading.Thread(target=self._renew_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
         handler = self._make_handler()
         for port in {self.metrics_port, self.health_port}:
             srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -479,9 +543,11 @@ class Manager:
         self.enqueue_fanout()
         self.enqueue_config(DEFAULT_CONFIG_NAME)
         log.info(
-            "manager started namespace=%s platform=%s devices=%d",
+            "manager started namespace=%s platform=%s devices=%d leader=%s",
             self.namespace, self.platform.backend, self.platform.num_devices,
+            self.is_leader,
         )
+        return True
 
     def stop(self) -> None:
         self._stop.set()
@@ -493,6 +559,9 @@ class Manager:
         for srv in self._servers:
             srv.shutdown()
             srv.server_close()
+        if self.lease is not None and self.is_leader:
+            self.is_leader = False
+            self.lease.release(self.lease_holder)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -517,6 +586,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--disable-webhook", dest="enable_webhook", action="store_false")
     p.add_argument("--metrics-port", type=int, default=DEFAULT_METRICS_PORT)
     p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
+    p.add_argument("--lease-file", default=None,
+                   help="single-writer lease file (leader election, "
+                        "main.go:76-85); default <export-dir>/manager.lease "
+                        "when --export-dir is set, 'none' disables")
+    p.add_argument("--lease-duration", type=float, default=15.0,
+                   help="lease duration in seconds; a crashed leader is "
+                        "taken over after at most this long")
     args = p.parse_args(argv)
 
     # Mirrors the hard env guards at main.go:87-99.
@@ -528,6 +604,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
     )
+    lease = None
+    lease_file = args.lease_file
+    if lease_file is None and args.export_dir:
+        lease_file = os.path.join(args.export_dir, "manager.lease")
+    if lease_file and lease_file != "none":
+        from .lease import FileLease
+
+        os.makedirs(os.path.dirname(os.path.abspath(lease_file)),
+                    exist_ok=True)
+        lease = FileLease(lease_file, duration_s=args.lease_duration)
+        log.info("single-writer lease at %s (duration %.0fs)",
+                 lease_file, args.lease_duration)
+
     mgr = Manager(
         namespace=args.namespace,
         daemon_image=args.daemon_image,
@@ -537,14 +626,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         register_nodes=args.register_node,
         metrics_port=args.metrics_port,
         health_port=args.health_port,
+        lease=lease,
     )
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    mgr.start()
+
+    def on_signal(*_a):
+        stop.set()
+        mgr._stop.set()  # unblocks a standby _await_lease wait too
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    if not mgr.start():  # blocks in standby until the lease is acquired
+        log.info("exiting before leadership (signalled in standby)")
+        return 0
     try:
+        # a lease loss stop()s the manager from its renew thread; exit
+        # the process then (controller-runtime semantics) so a supervisor
+        # can restart us into standby
         while not stop.wait(0.5):
-            pass
+            if mgr.lease_lost:
+                log.error("exiting after lease loss")
+                return 1
     finally:
         mgr.stop()
     return 0

@@ -1,11 +1,7 @@
 #!/usr/bin/env python
-"""Gather microbenchmark: cost of take(tbl(N,W)u32, idx(B,)) per packet
-as a function of row width W, table rows N, and index distribution.
-Chained so nothing hoists: idx feeds on the gathered values.
-
-Also: attempt a Pallas kernel doing jnp.take from a VMEM-resident table
-(does Mosaic support vectorized dynamic gather at all, and how fast).
-"""
+"""Gather microbenchmark (slim): cost of take(tbl(N,W)u32, idx(B,)) per
+row vs row width, plus a Pallas in-VMEM gather attempt.  Uses the
+bench's chained two-point-slope methodology."""
 import os
 import sys
 import time
@@ -19,26 +15,43 @@ import jax.numpy as jnp
 B = 1 << 20
 
 
-def timeit(fn, *args):
-    fn(*args)[0].block_until_ready()
-    k1, k2 = 3, 23
-    def run(k):
+def slope(step, idx0, label):
+    @jax.jit
+    def loop(k, idx):
+        def body(i, idx):
+            return step(idx ^ i.astype(jnp.int32))
+        return jax.lax.fori_loop(0, k, body, idx)
+
+    loop(1, idx0).block_until_ready()
+    idx_host = np.asarray(idx0)
+    salt = [0]
+
+    def best_of(k, attempts=3):
         best = float("inf")
-        for _ in range(3):
+        for _ in range(attempts):
+            # fresh input CONTENT per attempt: the tunnel's dispatch
+            # layer memoizes byte-identical executions, so re-timing the
+            # same (k, idx0) would time cached replays
+            salt[0] += 1
+            idx = jax.device_put(idx_host ^ np.int32(salt[0]))
+            idx.block_until_ready()
             t0 = time.perf_counter()
-            r = args
-            for _ in range(k):
-                r = fn(*r)
-            r[0].block_until_ready()
+            loop(k, idx).block_until_ready()
             best = min(best, time.perf_counter() - t0)
         return best
-    b1 = run(k1)
+
+    k1, k2 = 3, 23
+    b1 = best_of(k1)
     while True:
-        b2 = run(k2)
-        if b2 - b1 > 0.3 or k2 > 3000:
+        b2 = best_of(k2)
+        if b2 - b1 >= 0.5 or k2 >= 2000:
             break
         k2 *= 3
-    return (b2 - b1) / (k2 - k1)
+        b1 = best_of(k1)
+    dt = (b2 - b1) / (k2 - k1)
+    print(f"{label}: {dt/B*1e9:6.2f} ns/row ({B*1e-6/dt:6.1f} M rows/s)",
+          file=sys.stderr, flush=True)
+    return dt
 
 
 def main():
@@ -46,79 +59,52 @@ def main():
         from infw.platform import enable_jax_compile_cache
         enable_jax_compile_cache("/tmp/infw-jax-cache")
     rng = np.random.default_rng(7)
+    N = 65536
+    idx0 = jax.device_put(rng.integers(0, N, B, dtype=np.int64).astype(np.int32))
 
-    print("=== XLA gather: rows (N,W) u32, random idx ===", file=sys.stderr)
-    for N in (4096, 65536, 1 << 20):
-        for W in (2, 8, 18, 32, 64):
-            tbl = jax.device_put(
-                rng.integers(0, 2**31, (N, W), dtype=np.int64).astype(np.uint32))
-            idx0 = jax.device_put(
-                rng.integers(0, N, B, dtype=np.int64).astype(np.int32))
+    for W in (8, 32, 64, 128, 256):
+        tbl = jax.device_put(
+            rng.integers(0, 2**31, (N, W), dtype=np.int64).astype(np.uint32))
 
-            @jax.jit
-            def step(idx, tbl=tbl, N=N):
-                rows = jnp.take(tbl, idx, axis=0)
-                s = jnp.sum(rows.astype(jnp.uint32), axis=1)
-                return ((idx + s.astype(jnp.int32)) % N,)
+        def step(idx, tbl=tbl):
+            rows = jnp.take(tbl, jnp.clip(idx, 0, N - 1), axis=0)
+            s = jnp.sum(rows.astype(jnp.uint32), axis=1)
+            return (idx + s.astype(jnp.int32)) % N
 
-            dt = timeit(step, idx0)
-            print(f"N={N:8d} W={W:3d} ({W*4:4d}B rows): "
-                  f"{dt/B*1e9:6.2f} ns/row  ({B*W*4/dt/1e9:6.1f} GB/s)",
-                  file=sys.stderr, flush=True)
+        slope(step, idx0, f"xla take N=65536 W={W} ({W*4}B)")
 
-    print("=== sorted (locality) idx, N=65536 W=18 ===", file=sys.stderr)
-    N, W = 65536, 18
-    tbl = jax.device_put(
-        rng.integers(0, 2**31, (N, W), dtype=np.int64).astype(np.uint32))
-    idx_sorted = jax.device_put(
-        np.sort(rng.integers(0, N, B, dtype=np.int64)).astype(np.int32))
-
-    @jax.jit
-    def step_s(idx, tbl=tbl):
-        rows = jnp.take(tbl, idx, axis=0)
-        s = jnp.sum(rows.astype(jnp.uint32), axis=1)
-        # keep idx VALUES the same (sorted) but defeat memoization via xor 0
-        return (idx + (s & 0).astype(jnp.int32),)
-
-    dt = timeit(step_s, idx_sorted)
-    print(f"sorted: {dt/B*1e9:6.2f} ns/row", file=sys.stderr, flush=True)
-
-    print("=== Pallas in-VMEM gather attempt ===", file=sys.stderr)
+    print("=== Pallas in-VMEM gather attempt ===", file=sys.stderr, flush=True)
     try:
         from jax.experimental import pallas as pl
 
-        N2, W2 = 4096, 8   # 128KB table -> VMEM
+        N2 = 4096
         tblv = jax.device_put(
-            rng.integers(0, 2**31, (N2, W2 * 16), dtype=np.int64).astype(np.uint32))
-        idx0 = jax.device_put(
+            rng.integers(0, 2**31, (N2, 128), dtype=np.int64).astype(np.uint32))
+        idxs = jax.device_put(
             rng.integers(0, N2, B, dtype=np.int64).astype(np.int32))
-
         BB = 1024
 
         def kern(idx_ref, tbl_ref, out_ref):
-            idx = idx_ref[:]
-            rows = jnp.take(tbl_ref[:], idx, axis=0)
+            rows = jnp.take(tbl_ref[:], idx_ref[:], axis=0)
             out_ref[:] = jnp.sum(rows.astype(jnp.uint32), axis=1, keepdims=True)
 
         @jax.jit
-        def pstep(idx, tbl=tblv):
+        def pstep(idx):
             s = pl.pallas_call(
                 kern,
                 out_shape=jax.ShapeDtypeStruct((B, 1), jnp.uint32),
                 grid=(B // BB,),
                 in_specs=[
                     pl.BlockSpec((BB,), lambda i: (i,)),
-                    pl.BlockSpec((N2, W2 * 16), lambda i: (0, 0)),
+                    pl.BlockSpec((N2, 128), lambda i: (0, 0)),
                 ],
                 out_specs=pl.BlockSpec((BB, 1), lambda i: (i, 0)),
-            )(idx, tbl)
-            return ((idx + s[:, 0].astype(jnp.int32)) % N2,)
+            )(jnp.clip(idx, 0, N2 - 1), tblv)
+            return (idx + s[:, 0].astype(jnp.int32)) % N2
 
-        dt = timeit(pstep, idx0)
-        print(f"pallas vmem take N={N2} row={W2*64}B: {dt/B*1e9:6.2f} ns/row",
-              file=sys.stderr, flush=True)
+        slope(pstep, idxs, "pallas vmem take N=4096 row=512B")
     except Exception as e:
-        print(f"pallas gather FAILED: {type(e).__name__}: {str(e)[:500]}",
+        print(f"pallas gather FAILED: {type(e).__name__}: {str(e)[:600]}",
               file=sys.stderr, flush=True)
 
 
