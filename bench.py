@@ -180,6 +180,55 @@ def chained_throughput(classify_step, dt, db, n_packets, on_tpu, label):
     return thr
 
 
+def chained_wire_throughput(dt, wire, n_packets, on_tpu, label):
+    """Two-point chained slope over the WIRE-format classify (the
+    daemon's production path) with device-resident input: iteration
+    i+1's ip word AND port word depend on iteration i's verdicts, same
+    honesty rules as chained_throughput."""
+    ip_col = wire.shape[1] - 1  # narrow layouts end with the ip word(s)
+
+    @jax.jit
+    def loop(k, dt, w):
+        def step(i, carry):
+            w, acc = carry
+            res, _stats = jaxpath.classify_wire(dt, w, use_trie=True)
+            res = res.astype(jnp.uint32)
+            w = w.at[:, 1].set(w[:, 1] ^ (res & 1).astype(w.dtype))
+            pert = ((res & 0xF) ^ (i.astype(jnp.uint32) & 0xF)).astype(w.dtype)
+            w = w.at[:, ip_col].set(w[:, ip_col] ^ pert)
+            return w, acc + jnp.sum(res.astype(jnp.uint32))
+
+        return jax.lax.fori_loop(0, k, step, (w, jnp.uint32(0)))[1]
+
+    t0 = time.perf_counter()
+    int(loop(1, dt, wire))
+    log(f"{label}: loop compile {time.perf_counter()-t0:.1f}s")
+    k1, k2 = (3, 23) if on_tpu else (1, 3)
+    int(loop(k1, dt, wire))
+
+    def best_of(k, attempts=3):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            int(loop(k, dt, wire))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    _MIN_SIGNAL_S = 0.5 if on_tpu else 0.05
+    best1 = best_of(k1)
+    while True:
+        best2 = best_of(k2)
+        if best2 - best1 >= _MIN_SIGNAL_S or k2 >= 6000:
+            break
+        k2 *= 4
+    dt_s = (best2 - best1) / (k2 - k1)
+    if dt_s <= 0:
+        raise RuntimeError(f"{label}: non-monotonic timing")
+    thr = n_packets / dt_s
+    log(f"{label}: {thr/1e6:.2f} M packets/s (device-resident wire)")
+    return thr
+
+
 def family_split_throughput(dt, batch, on_tpu, label):
     """Aggregate throughput with the daemon's family steering
     (infw/daemon.py ingest regroups chunks by family): the v4 sub-batch
@@ -343,7 +392,20 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
         d.pipeline_depth = 16
         d.max_tick_packets = 16 << 20
         d.debug_lookup = False
-        d.ring = EventRing(capacity=4096)
+        # production-default ring sizing + a draining logger with the
+        # binary spill sink, so the replay measures the REAL event
+        # pipeline (round-4 weak #2: 20-57% of deny events were lost at
+        # exactly this load with the 4096 ring and no drainer)
+        d.ring = EventRing(capacity=1 << 21)
+        from infw.obs.events import EventsLogger
+
+        ev_lines = open(os.path.join(state_dir, "events.log"), "a")
+        d.events_logger = EventsLogger(
+            d.ring, lambda l: ev_lines.write(l + "\n"),
+            spill_path=os.path.join(state_dir, "deny-events.bin"),
+            poll_interval_s=0.02,
+        )
+        d.events_logger.start()
 
         class _Syncer:
             classifier = clf
@@ -426,9 +488,56 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
             f"daemon ingest replay sustained @{n_total/1e6:.0f}M packets, "
             f"min of {n_passes} "
             f"({tables.num_entries // 1000}K-CIDR trie, incl. file read + "
-            "parse + verdict sidecar + stats)",
+            "parse + verdict sidecar + stats + deny events)",
             thr, "packets/s",
         )
+
+        # deny-event fidelity at the recorded sustained rate: drain what
+        # is still queued, then report loss over everything seen
+        deadline = time.time() + 30
+        while len(d.ring) and time.time() < deadline:
+            time.sleep(0.05)
+        d.events_logger.stop()
+        seen = d.ring.queued_total + d.ring.lost_samples
+        loss_pct = 100.0 * d.ring.lost_samples / max(seen, 1)
+        log(f"replay events: queued={d.ring.queued_total} "
+            f"lost={d.ring.lost_samples} "
+            f"spilled={d.events_logger.spilled_total} loss={loss_pct:.3f}%")
+        emit(
+            "replay deny-event loss at sustained rate "
+            f"(ring {1 << 21} events, batch records + binary spill)",
+            loss_pct, "percent", vs_baseline=0.0,
+        )
+
+        # Device-attributable replay rate (round-4 weak: the end-to-end
+        # number is hostage to the tunnel's 8-17MB/s H2D): the SAME wire
+        # chunks the daemon ships, classified from device-resident
+        # buffers in a chained loop — the rate the dataplane would
+        # sustain if the link were free (an on-node PCIe deployment).
+        try:
+            from infw.constants import KIND_IPV6 as _K6
+
+            kinds = np.asarray(batch.kind)
+            idx4 = np.nonzero(kinds != _K6)[0]
+            sub = batch.take(idx4)
+            wire, v4_only = sub.pack_wire_subset(
+                np.arange(len(sub), dtype=np.int64)
+            )
+            dtab = jaxpath.device_tables(tables)
+            if v4_only:
+                depth = jaxpath.v4_trie_depth(len(dtab.trie_levels))
+                dtab = dtab._replace(trie_levels=dtab.trie_levels[:depth])
+            dw = jnp.asarray(wire)
+
+            thr_dev = chained_wire_throughput(
+                dtab, dw, len(sub), on_tpu, "replay-device")
+            emit(
+                "replay device-attributable classify rate "
+                "(device-resident wire chunks, chained, v4 share)",
+                thr_dev, "packets/s",
+            )
+        except Exception as e:
+            log(f"replay device-attributable tier FAILED: {e}")
     finally:
         shutil.rmtree(state_dir, ignore_errors=True)
 
@@ -477,23 +586,31 @@ def bench_8iface(rng, on_tpu):
 # --- incremental rule-update latency --------------------------------------
 
 
-def bench_incremental_update(rng, on_tpu):
-    """1-key rule edit -> device latency at 100K entries: the Map.Update
-    analogue (loader.go:200-218).  The patch path diffs host tables and
-    ships only changed rows; a full reload re-uploads the whole table."""
+def bench_incremental_update(rng, on_tpu, n_entries=None, width=8,
+                             table_kw=None):
+    """1-key RULE edit and 1-key CIDR ADD -> device latency: the
+    Map.Update analogue (loader.go:200-218).  The rules edit takes the
+    diff-scatter patch (ships only changed rows); the CIDR add takes the
+    structural overlay (a tiny dense side-table upload — the main trie's
+    poptrie form is untouched, round-4 missing #2)."""
     from infw.backend.tpu import TpuClassifier
-    from infw.compiler import IncrementalTables
+    from infw.compiler import IncrementalTables, LpmKey, compile_tables_from_content
 
-    n_entries = 100_000 if on_tpu else 2_000
-    tables = testing.random_tables_fast(rng, n_entries=n_entries, width=8,
-                                        ifindexes=(2, 3, 4))
-    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    if n_entries is None:
+        n_entries = 100_000 if on_tpu else 2_000
+    tkw = dict(n_entries=n_entries, width=width, ifindexes=(2, 3, 4))
+    tkw.update(table_kw or {})
+    tier = (f"{n_entries // 1000}K" if n_entries < 1_000_000
+            else f"{n_entries/1e6:.0f}M")
+    tables = testing.random_tables_fast(rng, **tkw)
+    it = IncrementalTables.from_content(tables.content,
+                                        rule_width=tkw["width"])
     clf = TpuClassifier(force_path="trie")
     t0 = time.perf_counter()
     clf.load_tables(it.snapshot())
     it.clear_dirty()  # device baseline established
     t_full = time.perf_counter() - t0
-    log(f"update: full load @{n_entries}: {t_full:.2f}s")
+    log(f"update@{tier}: full load: {t_full:.2f}s")
     keys = list(it.content)
     lats = []
     for i in range(5):
@@ -506,18 +623,53 @@ def bench_incremental_update(rng, on_tpu):
         it.clear_dirty()
         lats.append(time.perf_counter() - t0)
         mode, n_rows = clf._last_load
-        log(f"update {i}: {lats[-1]*1e3:.0f} ms mode={mode} rows={n_rows}")
+        log(f"update@{tier} {i}: {lats[-1]*1e3:.0f} ms mode={mode} rows={n_rows}")
         assert mode == "patch", "patch path must engage for 1-key edits"
     # best-of-N, like the replay tier: each sample rides 2-3 tunnel RPCs,
     # so the median measures link spikes (samples ranged 167ms-1.6s
     # across recorded runs), while the min is the dataplane's capability
     best = min(lats)
-    log(f"update: best {best*1e3:.0f} ms of {sorted(int(l*1e3) for l in lats)}")
+    log(f"update@{tier}: best {best*1e3:.0f} ms of "
+        f"{sorted(int(l*1e3) for l in lats)}")
     emit(
-        f"1-key rule update to device @{n_entries // 1000}K entries, "
+        f"1-key rule update to device @{tier} entries, "
         f"best of {len(lats)} "
         f"(incremental diff-scatter patch; full reload {t_full:.1f}s)",
         best * 1e3, "ms", vs_baseline=t_full / best,
+    )
+
+    # structural CIDR ADD via the overlay (the syncer's routing: a new
+    # identity never touches the main trie's device form)
+    overlay = {}
+    snap = it.snapshot()
+    it.clear_dirty()
+    add_lats = []
+    for i in range(5):
+        new_key = LpmKey(
+            prefix_len=56,
+            ingress_ifindex=2,
+            ip_data=bytes([203, 0, 113 + i, 0]) + bytes(12),
+        )
+        rows = np.zeros((tkw["width"], 7), np.int32)
+        rows[1] = [1, 6, 443, 0, 0, 0, 1]
+        t0 = time.perf_counter()
+        overlay[new_key] = rows
+        ov_tables = compile_tables_from_content(
+            dict(overlay), rule_width=tkw["width"])
+        clf.load_tables(snap, dirty_hint=it.peek_dirty(), overlay=ov_tables)
+        it.clear_dirty()
+        add_lats.append(time.perf_counter() - t0)
+        mode, n_rows = clf._last_load
+        log(f"cidr-add@{tier} {i}: {add_lats[-1]*1e3:.0f} ms mode={mode}")
+        assert mode == "patch", "CIDR add must not re-upload the main table"
+    best_add = min(add_lats)
+    log(f"cidr-add@{tier}: best {best_add*1e3:.0f} ms of "
+        f"{sorted(int(l*1e3) for l in add_lats)}")
+    emit(
+        f"1-key CIDR add to device @{tier} entries, best of "
+        f"{len(add_lats)} (structural overlay, main trie untouched; "
+        f"full reload {t_full:.1f}s)",
+        best_add * 1e3, "ms", vs_baseline=t_full / best_add,
     )
     clf.close()
 
@@ -608,6 +760,75 @@ def bench_wire_latency(tables, batch, on_tpu):
         )
 
 
+# --- on-device verdict latency ---------------------------------------------
+
+
+def bench_device_latency(tables, batch, on_tpu):
+    """Device-resident per-batch verdict latency (round-4 weak #3: wire
+    p50 through the tunnel is unmeasurable — 0.0 ms above a +-30-50 ms
+    jitter floor is a statement about the link, not the dataplane).
+
+    Methodology: k single-batch classifies CHAINED on device (iteration
+    i+1's ports and ip words depend on i's verdicts — same honesty rules
+    as the throughput loops), timed as a two-point slope; the slope IS
+    the steady-state per-batch latency with zero host/link involvement.
+    Reported per batch size alongside the wire numbers; the wire tier
+    keeps the link-floor split for the host path."""
+    from infw.constants import KIND_IPV4
+
+    dt = jaxpath.device_tables(tables)
+    for bs in (32, 64, 128, 256, 1024, 4096):
+        sub = batch.slice(0, bs)
+        db = jaxpath.device_batch(sub)
+        word_sel = (
+            jnp.arange(4, dtype=jnp.int32)[None, :]
+            == jnp.where(db.kind == KIND_IPV4, 0, 3)[:, None]
+        )
+
+        @jax.jit
+        def loop(k, dt, db, word_sel=word_sel):
+            def step(i, carry):
+                dport, ip, acc = carry
+                res, _x, _s = jaxpath.classify(
+                    dt, db._replace(dst_port=dport, ip_words=ip),
+                    use_trie=False,
+                )
+                dport = (dport + (res & 1).astype(jnp.int32)) % 65536
+                pert = (res & 0xF) ^ (i.astype(jnp.uint32) & 0xF)
+                ip = jnp.where(word_sel, ip ^ pert[:, None], ip)
+                return dport, ip, acc + jnp.sum(res.astype(jnp.uint32))
+
+            return jax.lax.fori_loop(
+                0, k, step, (db.dst_port, db.ip_words, jnp.uint32(0))
+            )[2]
+
+        int(loop(1, dt, db))  # compile
+        k1, k2 = (16, 64) if on_tpu else (2, 6)
+
+        def best_of(k, attempts=3):
+            best = float("inf")
+            for _ in range(attempts):
+                t0 = time.perf_counter()
+                int(loop(k, dt, db))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        b1 = best_of(k1)
+        while True:
+            b2 = best_of(k2)
+            if b2 - b1 >= (0.5 if on_tpu else 0.05) or k2 >= 2_000_000:
+                break
+            k2 *= 4
+        lat = (b2 - b1) / (k2 - k1)
+        log(f"device latency @batch={bs}: {lat*1e6:.1f} us/batch "
+            f"({lat/bs*1e9:.0f} ns/packet, slope k={k1}->{k2})")
+        emit(
+            f"verdict latency on-device @batch={bs} "
+            "(chained slope, 1000-CIDR dense, no host/link)",
+            lat * 1e3, "ms", vs_baseline=0.0,
+        )
+
+
 # --- config 2 headline -----------------------------------------------------
 
 
@@ -682,6 +903,16 @@ def main():
         bench_incremental_update(rng, on_tpu)
     except Exception as e:
         log(f"incremental update FAILED: {e}")
+    try:
+        # the 1M tier, where the poptrie re-transform the overlay avoids
+        # would cost seconds (round-4 weak #6: no 1M update line)
+        bench_incremental_update(
+            rng, on_tpu,
+            n_entries=1_000_000 if on_tpu else 10_000,
+            width=4, table_kw=dict(group_size=16),
+        )
+    except Exception as e:
+        log(f"incremental update @1M FAILED: {e}")
 
     try:
         tables, batch, thr = bench_dense_headline(rng, on_tpu)
@@ -691,6 +922,10 @@ def main():
         bench_wire_latency(tables, batch, on_tpu)
     except Exception as e:
         log(f"wire latency FAILED: {e}")
+    try:
+        bench_device_latency(tables, batch, on_tpu)
+    except Exception as e:
+        log(f"device latency FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
     # contiguous block, then the headline LAST (drivers that parse the

@@ -38,6 +38,10 @@ from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 class TpuClassifier:
     """Single-chip device classifier."""
 
+    #: the syncer may route structurally-new keys to a dense side-table
+    #: (load_tables(..., overlay=...), jaxpath.classify_with_overlay)
+    supports_overlay = True
+
     def __init__(
         self,
         device=None,
@@ -54,19 +58,28 @@ class TpuClassifier:
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         self._tables: Optional[CompiledTables] = None
-        self._active = None  # (path, device tables, block_b or None, wide_rids)
+        self._active = None  # (path, dev tables, block_b|None, wide_rids, overlay dev|None)
         self._last_load = None  # ("patch"|"full", rows) — introspection/tests
+        self._ov_cache = None   # (overlay CompiledTables, device copy)
         self._closed = False
 
     # -- rule loading -------------------------------------------------------
 
-    def load_tables(self, tables: CompiledTables, dirty_hint=None) -> None:
+    def load_tables(self, tables: CompiledTables, dirty_hint=None,
+                    overlay: Optional[CompiledTables] = None) -> None:
         """Swap in a newly compiled ruleset.
 
         ``dirty_hint`` (IncrementalTables.peek_dirty()) accelerates the
         incremental device patch: with it, the patch scatters exactly the
         hinted rows with NO full-table host diff — a 1-key edit costs a
-        couple of small transfers regardless of table size."""
+        couple of small transfers regardless of table size.
+
+        ``overlay`` is a SMALL dense side-table of structurally-new keys
+        (CIDR adds since the main table's last full build): it uploads in
+        kilobytes and the classify combines both tables by longest
+        prefix (jaxpath.classify_with_overlay), so a 1-key CIDR add
+        never pays the main trie's re-transform.  Callers (the syncer)
+        keep identities disjoint between main and overlay."""
         if self._closed:
             raise RuntimeError("classifier is closed")
         path = self._force_path or (
@@ -133,9 +146,31 @@ class TpuClassifier:
                 # editable immediately, loader.go:381-407).
                 jaxpath.warm_patch_scatters(dev, self._device)
             block_b = None
+        ov_dev = None
+        if overlay is not None and overlay.num_entries > 0:
+            if path != "trie" or wide_rids:
+                # refusing beats silently dropping live rules: the caller
+                # (syncer) must merge the overlay into the main table when
+                # the classifier cannot honor it on this path
+                raise ValueError(
+                    f"overlay not supported on path={path} "
+                    f"(wide_rids={wide_rids}); merge it into the main table"
+                )
+            with self._lock:
+                cached = self._ov_cache
+            if cached is not None and cached[0] is overlay:
+                ov_dev = cached[1]  # unchanged overlay: keep device copy
+            else:
+                # bucket-padded like the main table so overlay growth
+                # re-specializes jit only per pow2 bucket
+                ov_dev = jaxpath.device_tables(
+                    overlay, self._device, pad=True
+                )
+                with self._lock:
+                    self._ov_cache = (overlay, ov_dev)
         with self._lock:
             self._tables = tables
-            self._active = (path, dev, block_b, wide_rids)
+            self._active = (path, dev, block_b, wide_rids, ov_dev)
 
     # -- classify -----------------------------------------------------------
 
@@ -156,7 +191,7 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev, block_b, wide_rids = self._active
+            path, dev, block_b, wide_rids, ov_dev = self._active
         if wide_rids:
             return self._classify_async_wide(dev, batch, apply_stats)
         # Packed wire format: 24B/packet H2D (12B for v4-compactable
@@ -170,7 +205,8 @@ class TpuClassifier:
         compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
         wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
         return self._dispatch_wire(
-            path, dev, block_b, wire_np, v4_only, kind, apply_stats
+            path, dev, block_b, wire_np, v4_only, kind, apply_stats,
+            ov_dev=ov_dev,
         )
 
     def supports_packed(self) -> bool:
@@ -190,18 +226,20 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev, block_b, wide_rids = self._active
+            path, dev, block_b, wide_rids, ov_dev = self._active
         if wide_rids:
             raise RuntimeError(
                 "wide-ruleId tables need the full-batch path (supports_packed)"
             )
         kind = (wire_np[:, 0] & 3).astype(np.int32)
         return self._dispatch_wire(
-            path, dev, block_b, wire_np, v4_only, kind, apply_stats
+            path, dev, block_b, wire_np, v4_only, kind, apply_stats,
+            ov_dev=ov_dev,
         )
 
     def _dispatch_wire(
-        self, path, dev, block_b, wire_np, v4_only, kind, apply_stats
+        self, path, dev, block_b, wire_np, v4_only, kind, apply_stats,
+        ov_dev=None,
     ) -> PendingClassify:
         n = wire_np.shape[0]
         if wire_np.shape[1] in (4, 7):
@@ -220,6 +258,10 @@ class TpuClassifier:
             fused = pallas_dense.jitted_classify_pallas_wire_fused(
                 self._interpret, block_b
             )(dev, wire)
+        elif ov_dev is not None:
+            fused = jaxpath.jitted_classify_wire_overlay_fused(True, v4_only)(
+                dev, ov_dev, wire
+            )
         else:
             # Depth specialization: a batch with no IPv6 packets walks only
             # the ≤/32 trie levels (3 gathers instead of up to 15) — the

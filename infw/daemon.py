@@ -253,7 +253,7 @@ class Daemon:
         ingest_chunk: int = DEFAULT_INGEST_CHUNK,
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
         max_tick_packets: int = DEFAULT_MAX_TICK_PACKETS,
-        event_ring_size: int = 4096,
+        event_ring_size: int = 1 << 21,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -324,7 +324,15 @@ class Daemon:
             self.ring,
             sink,
             iface_names={i.index: i.name for i in self.registry.list()},
+            # replay-scale batches drain as vectorized binary rows next
+            # to events.log; the line sink gets one summary line each
+            spill_path=os.path.join(
+                os.path.dirname(self.events_path), "deny-events.bin"
+            ),
         )
+        # deny-event loss/queue totals on /metrics (events.go:79-82's
+        # LostSamples, exported instead of only logged)
+        self.metrics_registry.register_counters(self.ring)
         self.debug_buffer = DebugLookupBuffer()
 
         self._stop = threading.Event()
@@ -495,7 +503,8 @@ class Daemon:
             os.replace(jpath + ".tmp", jpath)
             os.remove(fctx["path"])
             clf.stats.add(stats_from_results(results, np.asarray(batch.pkt_len)))
-            emit_deny_events(self.ring, results, batch.ifindex, batch.pkt_len, fb)
+            emit_deny_events(self.ring, results, batch.ifindex,
+                             batch.pkt_len, fb, batch=batch)
             processed += 1
 
         def seg_done(fctx) -> None:
@@ -793,7 +802,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
     p.add_argument("--max-tick-packets", type=int,
                    default=DEFAULT_MAX_TICK_PACKETS)
-    p.add_argument("--event-ring-size", type=int, default=4096,
+    p.add_argument("--event-ring-size", type=int, default=1 << 21,
                    help="deny-event ring capacity, minimum 64 (overflow "
                         "drops new records and counts them as lost "
                         "samples, like the kernel perf ring)")

@@ -83,6 +83,16 @@ class DeviceTables(NamedTuple):
     rules: jax.Array
     trie_levels: Tuple[jax.Array, ...]
     trie_targets: jax.Array  # (1 + total present targets,) int32
+    #: Joined target rows (build_joined): row p = [tidx+1 lo, tidx+1 hi,
+    #: mask_len, packed rules R*5] uint16 (or [tidx+1, mask_len, rules
+    #: R*7] int32 for wide tables), indexed by the SAME positions the
+    #: walk's win tracking produces — so the trie path's final gather
+    #: returns the rules in ONE fat row (row width is free up to ~512B,
+    #: tools/profile_gather.py) instead of a separate trie_targets
+    #: resolve + rules gather (two diverse ~8-11ns gathers -> one).
+    #: Shape (1, 1) uint16 when inactive (duplication-gated; dense path;
+    #: mesh shards) — the static shape selects the walk at trace time.
+    joined: jax.Array
     root_lut: jax.Array     # (max_if+1,) int32
     num_entries: jax.Array  # () int32
 
@@ -233,6 +243,133 @@ def build_poptrie(tables: CompiledTables):
     return result
 
 
+#: joined-targets duplication gate: a trie whose leaf-pushed slot
+#: expansion duplicates targets more than this (positions per entry)
+#: falls back to the two-gather walk rather than paying the rule-row
+#: duplication in device memory
+JOINED_DUP_LIMIT = 2.5
+
+
+def _packed_rules_flat(tables: CompiledTables):
+    """(T, R*5) uint16 flattened packed rules, or (T, R*7) int32 for
+    wide tables — memoized (shared with _host_device_layout)."""
+    rules = getattr(tables, "_packed_rules_cache", None)
+    if rules is None:
+        rules = pack_rules_u16(tables.rules)
+        if rules is None:
+            rules = tables.rules
+        rules = np.ascontiguousarray(rules).reshape(rules.shape[0], -1)
+        try:
+            object.__setattr__(tables, "_packed_rules_cache", rules)
+        except (AttributeError, TypeError):
+            pass
+    return rules
+
+
+def build_joined(tables: CompiledTables):
+    """Joined target rows for the one-gather trie tail (see
+    DeviceTables.joined): returns (joined, l0_joined, sorted_t, order)
+    or None when the duplication gate trips.
+
+    - ``joined`` row p (p < len(targets)) corresponds to targets
+      position p: [tidx+1 (2 x u16), mask_len, packed rules] — so the
+      walk's win position indexes it DIRECTLY; rows for the root level's
+      targets are appended once per unique root tidx.
+    - ``l0_joined`` is levels[0] with the target column rewritten from
+      tidx+1 to the appended joined index.
+    - ``(sorted_t, order)``: positions grouped by tidx+1 (argsort of the
+      row->tidx+1 map) so a rules-only edit can find and patch exactly
+      the joined rows of the dirty entries (searchsorted, no scan).
+
+    Memoized on the tables instance alongside the poptrie cache."""
+    cached = getattr(tables, "_joined_cache", None)
+    if cached is not None:
+        return None if cached == "none" else cached
+    levels, targets = build_poptrie(tables)
+    rules_flat = _packed_rules_flat(tables)
+    T = rules_flat.shape[0]
+    l0 = levels[0]
+    rt = l0[:, 1]
+    uniq = np.unique(rt[rt > 0])  # root target values (tidx+1)
+    t_vals = np.concatenate([targets.astype(np.int64), uniq.astype(np.int64)])
+    total = len(t_vals)
+    result = None
+    if total <= max(4096, JOINED_DUP_LIMIT * (T + 1)):
+        tidx = np.maximum(t_vals - 1, 0)
+        ml = np.maximum(tables.mask_len, 0)
+        valid = (t_vals > 0)[:, None]
+        if rules_flat.dtype == np.uint16:
+            joined = np.empty((total, 3 + rules_flat.shape[1]), np.uint16)
+            joined[:, 0] = t_vals & 0xFFFF
+            joined[:, 1] = (t_vals >> 16) & 0xFFFF
+            joined[:, 2] = np.minimum(ml[tidx], 0xFFFF)
+            joined[:, 3:] = rules_flat[tidx]
+        else:
+            joined = np.empty((total, 2 + rules_flat.shape[1]), np.int32)
+            joined[:, 0] = t_vals
+            joined[:, 1] = ml[tidx]
+            joined[:, 2:] = rules_flat[tidx]
+        joined *= valid.astype(joined.dtype)  # sentinel/zero rows stay zero
+        l0j = l0.copy()
+        nz = rt > 0
+        l0j[nz, 1] = (
+            len(targets) + np.searchsorted(uniq, rt[nz])
+        ).astype(np.int32)
+        order = np.argsort(t_vals, kind="stable").astype(np.int64)
+        result = (joined, l0j, t_vals[order], order)
+    try:
+        object.__setattr__(
+            tables, "_joined_cache", result if result is not None else "none"
+        )
+    except (AttributeError, TypeError):
+        pass
+    return result
+
+
+def joined_patch_rows(
+    old: CompiledTables, new: CompiledTables, dirty_tidx: np.ndarray
+):
+    """(positions, rows) scatter payload updating the joined array for a
+    RULES-ONLY edit: positions come from the OLD generation's cached
+    position map (the trie — and therefore the position layout — is
+    unchanged, which is exactly what the caller's dirty hint proves),
+    row contents from the NEW tables' packed rules.  Never triggers a
+    poptrie/joined rebuild of the new snapshot.  Returns None when the
+    packed-rule layout changed (caller falls back to full upload)."""
+    built = build_joined(old)
+    if built is None:
+        return None
+    joined_old, _l0j, sorted_t, order = built
+    new_flat = _packed_rules_flat(new)
+    if new_flat.dtype != _packed_rules_flat(old).dtype or (
+        new_flat.shape[1] != _packed_rules_flat(old).shape[1]
+    ):
+        return None
+    vals = np.unique(np.asarray(dirty_tidx, np.int64)) + 1
+    vals = vals[vals > 0]
+    lo = np.searchsorted(sorted_t, vals, side="left")
+    hi = np.searchsorted(sorted_t, vals, side="right")
+    parts = [order[a:b] for a, b in zip(lo, hi)]
+    pos = np.concatenate(parts) if parts else np.zeros(0, np.int64)
+    if len(pos) == 0:
+        return pos, joined_old[:0]
+    t = np.repeat(vals, hi - lo)
+    tidx = np.minimum(t - 1, new_flat.shape[0] - 1)
+    ml = np.maximum(new.mask_len, 0)
+    if new_flat.dtype == np.uint16:
+        rows = np.empty((len(pos), 3 + new_flat.shape[1]), np.uint16)
+        rows[:, 0] = t & 0xFFFF
+        rows[:, 1] = (t >> 16) & 0xFFFF
+        rows[:, 2] = np.minimum(ml[tidx], 0xFFFF)
+        rows[:, 3:] = new_flat[tidx]
+    else:
+        rows = np.empty((len(pos), 2 + new_flat.shape[1]), np.int32)
+        rows[:, 0] = t
+        rows[:, 1] = ml[tidx]
+        rows[:, 2:] = new_flat[tidx]
+    return pos, rows
+
+
 def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = True):
     """Host-side arrays in the exact layout device_tables uploads:
     mask_len sentinel applied, trie levels in the poptrie device form,
@@ -261,8 +398,13 @@ def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = Tru
             object.__setattr__(tables, "_packed_rules_cache", rules)
         except (AttributeError, TypeError):
             pass
+    joined = np.zeros((1, 1), np.uint16)  # inactive placeholder
     if with_trie:
         trie_levels, trie_targets = build_poptrie(tables)
+        built = build_joined(tables)
+        if built is not None:
+            joined, l0j, _st, _o = built
+            trie_levels = [l0j] + list(trie_levels[1:])
     else:
         trie_levels, trie_targets = [], np.zeros(1, np.int32)
     root_lut = tables.root_lut
@@ -277,8 +419,10 @@ def _host_device_layout(tables: CompiledTables, pad: bool, with_trie: bool = Tru
         trie_levels = [_pad_rows(l, _row_bucket(l.shape[0])) for l in trie_levels]
         trie_targets = _pad_rows(trie_targets, _row_bucket(trie_targets.shape[0]))
         root_lut = _pad_rows(root_lut, _row_bucket(root_lut.shape[0]))
+        if joined.shape[0] > 1:
+            joined = _pad_rows(joined, _row_bucket(joined.shape[0]))
     return (key_words, mask_words, mask_len, rules, trie_levels,
-            trie_targets, root_lut)
+            trie_targets, root_lut, joined)
 
 
 @functools.lru_cache(maxsize=None)
@@ -363,7 +507,7 @@ def device_tables(
     The resident DeviceTables is bit-identical to a direct upload — the
     patch path diffs against it with no knowledge of how it traveled."""
     (key_words, mask_words, mask_len, rules, trie_levels, trie_targets,
-     root_lut) = _host_device_layout(tables, pad)
+     root_lut, joined) = _host_device_layout(tables, pad)
     put = lambda a: jax.device_put(jnp.asarray(a), device)
 
     # -- trie levels: sparse scatter below the density limit (the DIR-16
@@ -396,6 +540,7 @@ def device_tables(
         rules=put(rules),
         trie_levels=tuple(levels_dev),
         trie_targets=put(trie_targets),
+        joined=put(joined),
         root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
@@ -508,7 +653,7 @@ def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
     seen = set()
     for arr in (
         dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
-        *dev.trie_levels, dev.trie_targets, dev.root_lut,
+        *dev.trie_levels, dev.trie_targets, dev.joined, dev.root_lut,
     ):
         key = (arr.shape, str(arr.dtype))
         if arr.shape[0] == 0 or key in seen:
@@ -613,6 +758,34 @@ def patch_device_tables(
     if trie_unchanged:
         levels = list(dev.trie_levels)
         trie_targets = dev.trie_targets
+        joined = dev.joined
+        if dev.joined.shape[0] > 1:
+            # the joined array carries RULE BYTES, so a rules-only edit
+            # must patch its rows too (positions from the old
+            # generation's cached map; trie unchanged = positions valid)
+            pr = joined_patch_rows(old, new, hint["dense"])
+            if pr is None:
+                return None
+            pos, rows = pr
+            k = len(pos)
+            if k:
+                nb = dev.joined.shape[0]
+                if (
+                    rows.dtype != dev.joined.dtype
+                    or rows.shape[1:] != tuple(dev.joined.shape[1:])
+                    or int(pos.max()) >= nb
+                    or k > nb // 4
+                ):
+                    return None
+                cap = _scatter_cap(k, nb)
+                pidx = np.empty(cap, np.int64)
+                pidx[:k] = pos
+                pidx[k:] = pos[-1]
+                prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
+                prows[:k] = rows
+                prows[k:] = rows[-1]
+                joined = _scatter(dev.joined, pidx, prows, device)
+                total += k
     else:
         levels = []
         for dl, ol, nl in zip(dev.trie_levels, o[4], nw[4]):
@@ -632,6 +805,13 @@ def patch_device_tables(
         else:
             trie_targets, k = p
             total += k
+        p = _patch_array(dev.joined, o[7], nw[7], device)
+        if p is None:
+            joined = put(nw[7])
+            total += len(nw[7])
+        else:
+            joined, k = p
+            total += k
     p = _patch_array(dev.root_lut, o[6], nw[6], device)
     if p is None:
         root_lut = put(nw[6])
@@ -647,6 +827,7 @@ def patch_device_tables(
             rules=dense[3],
             trie_levels=tuple(levels),
             trie_targets=trie_targets,
+            joined=joined,
             root_lut=root_lut,
             num_entries=jax.device_put(
                 jnp.asarray(np.int32(new.num_entries)), device
@@ -824,6 +1005,44 @@ def jitted_classify_wire_fused(use_trie: bool, v4_only: bool = False):
     return jax.jit(f)
 
 
+def classify_wire_overlay(
+    tables: DeviceTables,
+    overlay: DeviceTables,
+    wire: jax.Array,
+    *,
+    use_trie: bool,
+    v4_only: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """classify_wire with the overlay combine (see classify_with_overlay);
+    the v4 depth truncation applies to the main trie only."""
+    if v4_only and use_trie:
+        depth = v4_trie_depth(len(tables.trie_levels))
+        tables = tables._replace(trie_levels=tables.trie_levels[:depth])
+    res, _xdp, stats = classify_with_overlay(
+        tables, overlay, unpack_wire(wire), use_trie=use_trie
+    )
+    return res.astype(jnp.uint16), stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_wire_overlay_fused(use_trie: bool, v4_only: bool = False):
+    def f(tables: DeviceTables, overlay: DeviceTables, wire: jax.Array):
+        return fuse_wire_outputs(
+            *classify_wire_overlay(
+                tables, overlay, wire, use_trie=use_trie, v4_only=v4_only
+            )
+        )
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_with_overlay(use_trie: bool):
+    return jax.jit(
+        functools.partial(classify_with_overlay, use_trie=use_trie)
+    )
+
+
 def host_finalize_wire(res16: np.ndarray, kind: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side completion of the wire path: widen results to u32 and
     rebuild the XDP verdict exactly as finalize() does on device
@@ -974,6 +1193,83 @@ def lpm_trie(tables: DeviceTables, batch: DeviceBatch) -> jax.Array:
     )
 
 
+def trie_walk_joined(
+    trie_levels, joined: jax.Array, root_lut: jax.Array, batch: DeviceBatch
+) -> jax.Array:
+    """The poptrie walk with the joined-targets tail: identical level
+    loop to trie_walk, but the win is a POSITION that indexes ``joined``
+    directly (level-0's target column was rewritten to appended joined
+    rows by build_joined), so target resolve + rules fetch collapse into
+    ONE fat-row gather.  Returns the (B, W) joined rows; row 0 / invalid
+    lanes read all-zero (-> ruleId 0 -> UNDEF)."""
+    strides = trie_level_strides(len(trie_levels))
+    lut_size = root_lut.shape[0]
+    if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
+    root = jnp.where(
+        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
+    )
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    e0 = root * 65536 + nib0
+    in0 = (e0 >= 0) & (e0 < trie_levels[0].shape[0])
+    rows0 = jnp.take(trie_levels[0], e0, axis=0, mode="clip")
+    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)
+    alive = in0 & (rows0[:, 0] > 0)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+
+    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    win = jnp.zeros_like(node, dtype=jnp.uint32)
+    widx8 = jnp.arange(8, dtype=jnp.int32)[None, :]
+
+    bit_end = strides[0]
+    for stride, tbl in zip(strides[1:], trie_levels[1:]):
+        bit_start, bit_end = bit_end, bit_end + stride
+        w32 = bit_start // 32
+        shift = 32 - stride - (bit_start % 32)
+        nib = (
+            (batch.ip_words[:, w32] >> np.uint32(shift))
+            & np.uint32((1 << stride) - 1)
+        ).astype(jnp.int32)
+        in_l = (node >= 0) & (node < tbl.shape[0])
+        alive = alive & in_l
+        r = jnp.take(tbl, node, axis=0, mode="clip")
+        w = (nib >> 5)[:, None]
+        below = (np.uint32(1) << (nib & 31).astype(jnp.uint32)) - 1
+        cb = r[:, 2:10]
+        tb = r[:, 10:18]
+        pc_cb = _popcount32(cb)
+        pc_tb = _popcount32(tb)
+        prefix = jnp.sum(jnp.where(widx8 < w, pc_cb, 0), axis=1)
+        tprefix = jnp.sum(jnp.where(widx8 < w, pc_tb, 0), axis=1)
+        cw = jnp.sum(jnp.where(widx8 == w, cb, 0), axis=1)
+        tw = jnp.sum(jnp.where(widx8 == w, tb, 0), axis=1)
+        bit = (nib & 31).astype(jnp.uint32)
+        ok_t = (
+            alive
+            & (((tw >> bit) & 1) > 0)
+            & (bit_end <= cap_bits)
+        )
+        win = jnp.where(
+            ok_t, r[:, 1] + tprefix + _popcount32(tw & below), win
+        )
+        alive = alive & (((cw >> bit) & 1) > 0)
+        node = jnp.where(
+            alive, (r[:, 0] + prefix + _popcount32(cw & below)).astype(jnp.int32), 0
+        )
+    win = win.astype(jnp.int32)
+    pos = jnp.where(win > 0, win, best0)
+    in_p = (pos > 0) & (pos < joined.shape[0])
+    rows = jnp.take(joined, jnp.clip(pos, 0, joined.shape[0] - 1), axis=0,
+                    mode="clip")
+    return jnp.where(in_p[:, None], rows, 0)
+
+
+def joined_rule_rows(rows: jax.Array) -> jax.Array:
+    """(B, W) joined rows -> (B, R, C) scan operand."""
+    if rows.dtype == jnp.uint16:
+        return rows[:, 3:].reshape(rows.shape[0], -1, 5)
+    return rows[:, 2:].reshape(rows.shape[0], -1, 7)
+
+
 def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:
     """Vectorized ordered first-match scan (kernel.c:222-258).
 
@@ -1083,15 +1379,73 @@ def gather_rule_rows(rules: jax.Array, tidx: jax.Array) -> jax.Array:
     return jnp.where((tidx >= 0)[:, None, None], rows, 0)
 
 
+def _raw_result_and_score(
+    tables: DeviceTables, batch: DeviceBatch, *, use_trie: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """(raw scan result, LPM score) where score = mask_len + 1 of the
+    winning entry (0 = no match) — the combine key for the overlay path
+    (equal scores are impossible across disjoint tables: same mask_len
+    matching one packet implies the same masked prefix, and identities
+    are deduplicated at compile/routing time)."""
+    if use_trie and tables.joined.shape[0] > 1:
+        rows = trie_walk_joined(
+            tables.trie_levels, tables.joined, tables.root_lut, batch
+        )
+        if rows.dtype == jnp.uint16:
+            matched = (rows[:, 0].astype(jnp.int32)
+                       | (rows[:, 1].astype(jnp.int32) << 16)) > 0
+            ml = rows[:, 2].astype(jnp.int32)
+        else:
+            matched = rows[:, 0] > 0
+            ml = rows[:, 1]
+        score = jnp.where(matched, ml + 1, 0)
+        return rule_scan(joined_rule_rows(rows), batch), score
+    if use_trie:
+        tidx = lpm_trie(tables, batch)
+    else:
+        tidx = lpm_dense(tables, batch)
+    ml = jnp.take(tables.mask_len, jnp.clip(tidx, 0), mode="clip")
+    score = jnp.where(tidx >= 0, ml + 1, 0)
+    return rule_scan(gather_rule_rows(tables.rules, tidx), batch), score
+
+
 def classify(
     tables: DeviceTables, batch: DeviceBatch, *, use_trie: bool
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full forward pass: LPM -> gather rules -> scan -> finalize."""
+    if use_trie and tables.joined.shape[0] > 1:
+        # one-gather tail: the walk's win position returns the rules row
+        rows = trie_walk_joined(
+            tables.trie_levels, tables.joined, tables.root_lut, batch
+        )
+        result = rule_scan(joined_rule_rows(rows), batch)
+        return finalize(result, batch)
     if use_trie:
         tidx = lpm_trie(tables, batch)
     else:
         tidx = lpm_dense(tables, batch)
     result = rule_scan(gather_rule_rows(tables.rules, tidx), batch)
+    return finalize(result, batch)
+
+
+def classify_with_overlay(
+    tables: DeviceTables,
+    overlay: DeviceTables,
+    batch: DeviceBatch,
+    *,
+    use_trie: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Main-table classify combined with a SMALL dense overlay table —
+    the structural-update fast path (the Map.Update analogue for CIDR
+    ADDS, loader.go:200-218): new keys land in the overlay (a dense
+    compare over <= a few hundred entries, uploaded in kilobytes) so the
+    main trie's device form is untouched; the longest-prefix winner
+    across both tables is selected by mask_len score.  Equal scores
+    cannot occur (the router keeps identities disjoint), so strict
+    greater-than gives the overlay exactly kernel-LPM semantics."""
+    raw_m, score_m = _raw_result_and_score(tables, batch, use_trie=use_trie)
+    raw_o, score_o = _raw_result_and_score(overlay, batch, use_trie=False)
+    result = jnp.where(score_o > score_m, raw_o, raw_m)
     return finalize(result, batch)
 
 

@@ -90,6 +90,7 @@ def shard_tables(tables: CompiledTables, mesh: Mesh) -> DeviceTables:
         # replicate the (potentially large) level arrays.
         trie_levels=(),
         trie_targets=put(np.zeros(1, np.int32), P()),
+        joined=put(np.zeros((1, 1), np.uint16), P()),
         root_lut=put(padded.root_lut, P()),
         num_entries=put(np.int32(padded.num_entries), P()),
     )
@@ -176,6 +177,7 @@ def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 0):
         rules=P("rules", None, None),
         trie_levels=tuple(P() for _ in range(n_trie_levels)),
         trie_targets=P(),
+        joined=P(),
         root_lut=P(),
         num_entries=P(),
     )

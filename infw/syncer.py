@@ -144,6 +144,22 @@ class DataplaneSyncer:
         # Incremental deltas applied to the updater but not yet persisted
         # to any checkpoint (journal or base); survives failed loads.
         self._pending_deltas: List[Tuple[Dict[LpmKey, np.ndarray], List[LpmKey]]] = []
+        # Structural-add overlay (the CIDR-add Map.Update analogue,
+        # loader.go:200-218): NEW keys route into this small side dict —
+        # classified as a dense side-table combined by longest prefix
+        # (jaxpath.classify_with_overlay) — so a 1-key CIDR add never
+        # pays the main trie's poptrie re-transform.  Merged into the
+        # main table when it outgrows OVERLAY_CAP.  Deletes of MAIN keys
+        # remain structural (node repush + re-transform).
+        self._overlay: Dict[LpmKey, np.ndarray] = {}
+        self._overlay_compiled = None  # (rule_width, CompiledTables) memo
+
+    #: overlay size bound: beyond this the dense side-compare starts to
+    #: cost real per-packet time, so the overlay merges into the main trie
+    OVERLAY_CAP = 1024
+    #: only route to the overlay when the main table is trie-path scale
+    #: (a dense-path main table rebuilds in milliseconds anyway)
+    OVERLAY_MIN_MAIN = 4096
 
     # -- public surface ------------------------------------------------------
 
@@ -217,6 +233,8 @@ class DataplaneSyncer:
             self._attached.clear()
             self._content = {}
             self._updater = None
+            self._overlay = {}  # restored from the sidecar on restart
+            self._overlay_compiled = None
 
     # -- lifecycle internals -------------------------------------------------
 
@@ -230,8 +248,28 @@ class DataplaneSyncer:
         ck = self._load_checkpoint()
         if ck is not None:
             tables, attached = ck
-            self._classifier.load_tables(tables)
+            self._load_overlay({k.masked_identity() for k in tables.content})
+            self._overlay_compiled = None
+            if self._overlay and getattr(
+                self._classifier, "supports_overlay", False
+            ) and tables.num_entries > self.OVERLAY_MIN_MAIN:
+                self._classifier.load_tables(
+                    tables,
+                    overlay=self._compile_overlay(tables.rule_width),
+                )
+            else:
+                # overlay unsupported by this backend: fold it into the
+                # restored content through one compile
+                if self._overlay:
+                    merged = dict(tables.content)
+                    merged.update(self._overlay)
+                    self._overlay = {}
+                    tables = compile_tables_from_content(
+                        merged, rule_width=tables.rule_width
+                    )
+                self._classifier.load_tables(tables)
             self._content = dict(tables.content)
+            self._content.update(self._overlay)
             valid = self._valid_fn()
             for name in attached:
                 if not valid(name):
@@ -254,7 +292,15 @@ class DataplaneSyncer:
         self._classifier = None
         self._content = {}
         self._updater = None
+        self._overlay = {}
+        self._overlay_compiled = None
         self._remove_checkpoint()
+        p = self._overlay_path()
+        if p is not None:
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
 
     def _detach_unmanaged_interfaces(
         self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]
@@ -337,24 +383,78 @@ class DataplaneSyncer:
             # the next sync must reconcile from what the updater holds.
             base = self._updater.content
             base_by_ident = {k.masked_identity(): v for k, v in base.items()}
+            ov_by_ident = {k.masked_identity(): k for k in self._overlay}
             desired_idents = {k.masked_identity() for k in desired}
             deletes = [
                 k for k in base
                 if k.masked_identity() not in desired_idents
             ]
-            upserts = {
-                k: v for k, v in desired.items()
-                if not _rules_equal(base_by_ident.get(k.masked_identity()), v)
-            }
+            ov_deletes = [
+                k for k in self._overlay
+                if k.masked_identity() not in desired_idents
+            ]
+            upserts = {}
+            ov_upserts = {}
+            new_keys = {}
+            for k, v in desired.items():
+                ident = k.masked_identity()
+                if ident in base_by_ident:
+                    if not _rules_equal(base_by_ident[ident], v):
+                        upserts[k] = v
+                elif ident in ov_by_ident:
+                    if not _rules_equal(
+                        self._overlay.get(ov_by_ident[ident]), v
+                    ):
+                        ov_upserts[k] = v
+                else:
+                    new_keys[k] = v
+            # journal records reflect the DESIRED diff regardless of how
+            # it was routed, so restart replay reconstructs everything
+            journal_upserts = {**upserts, **ov_upserts, **new_keys}
+            journal_deletes = deletes + ov_deletes
+            if ov_deletes or ov_upserts:
+                self._overlay_compiled = None
+            for k in ov_deletes:
+                self._overlay.pop(k, None)
+            for k, v in ov_upserts.items():
+                self._overlay.pop(ov_by_ident[k.masked_identity()], None)
+                self._overlay[k] = v
+            # gate on the POST-delete size: a delete-heavy sync can
+            # shrink the main table onto the dense path, where the
+            # classifier cannot honor an overlay (it raises rather than
+            # silently dropping rules) — merge instead
+            overlay_ok = (
+                getattr(self._classifier, "supports_overlay", False)
+                and len(base) - len(deletes) > self.OVERLAY_MIN_MAIN
+            )
+            if overlay_ok and (
+                len(self._overlay) + len(new_keys) <= self.OVERLAY_CAP
+            ):
+                # structural ADD fast path: new keys go to the dense
+                # side-table; the main trie's device form is untouched
+                if new_keys:
+                    self._overlay_compiled = None
+                self._overlay.update(new_keys)
+            else:
+                # overflow (or no overlay support): merge everything into
+                # the main table — the amortized structural slow path
+                if self._overlay or new_keys:
+                    upserts = {**upserts, **self._overlay, **new_keys}
+                    self._overlay = {}
+                    self._overlay_compiled = None
             self._updater.apply(upserts, deletes)
-            log.info("incremental table update: %d upserts, %d deletes",
-                     len(upserts), len(deletes))
+            log.info(
+                "incremental table update: %d main upserts, %d main "
+                "deletes, %d overlay adds/updates (%d overlay total)",
+                len(upserts), len(deletes),
+                len(ov_upserts) + len(new_keys), len(self._overlay),
+            )
             # Deltas accumulate until a checkpoint (journal or base)
             # actually persists them: a failed device load leaves the
             # delta pending, so the NEXT successful sync still journals
             # it instead of silently dropping it from the checkpoint.
-            if upserts or deletes:
-                self._pending_deltas.append((upserts, deletes))
+            if journal_upserts or journal_deletes:
+                self._pending_deltas.append((journal_upserts, journal_deletes))
             incremental = True
             if self._updater.maybe_compact():
                 log.info("compacted table: tombstones reclaimed")
@@ -363,6 +463,8 @@ class DataplaneSyncer:
             self._updater = IncrementalTables.from_content(
                 desired, rule_width=width
             )
+            self._overlay = {}  # full rebuild absorbs everything
+            self._overlay_compiled = None
             incremental = False
         tables = self._updater.snapshot()
         # Dirty rows accumulated since the last SUCCESSFUL load: the
@@ -370,10 +472,17 @@ class DataplaneSyncer:
         # re-uploading the table.  Cleared only after load_tables returns
         # (a failed load keeps accumulating, so the next attempt's hint
         # still covers this generation's changes).
-        self._classifier.load_tables(
-            tables, dirty_hint=self._updater.peek_dirty()
-        )
+        if getattr(self._classifier, "supports_overlay", False):
+            self._classifier.load_tables(
+                tables, dirty_hint=self._updater.peek_dirty(),
+                overlay=self._compile_overlay(width),
+            )
+        else:
+            self._classifier.load_tables(
+                tables, dirty_hint=self._updater.peek_dirty()
+            )
         self._updater.clear_dirty()
+        self._save_overlay()
         self._content = dict(desired)
         # Checkpointing follows the same O(delta) discipline as the device
         # path: an incremental sync appends small journal records (one per
@@ -383,6 +492,73 @@ class DataplaneSyncer:
         if incremental and self._journal_pending():
             return
         self._save_checkpoint(tables)
+
+    def _compile_overlay(self, width: int) -> Optional[CompiledTables]:
+        """Small dense CompiledTables from the overlay dict, or None when
+        empty.  Memoized until the overlay mutates — a rules-only edit to
+        the MAIN table must not pay an overlay recompile + re-upload (the
+        classifier also reuses its device copy for the same instance)."""
+        if not self._overlay:
+            self._overlay_compiled = None
+            return None
+        cached = getattr(self, "_overlay_compiled", None)
+        if cached is not None and cached[0] == width:
+            return cached[1]
+        ct = compile_tables_from_content(
+            dict(self._overlay), rule_width=width
+        )
+        self._overlay_compiled = (width, ct)
+        return ct
+
+    def _overlay_path(self) -> Optional[str]:
+        if not self._checkpoint_dir:
+            return None
+        return os.path.join(self._checkpoint_dir, "overlay.json")
+
+    def _save_overlay(self) -> None:
+        """Sidecar checkpoint for the overlay: the journal carries its
+        deltas too, but a journal-overflow base rewrite saves only the
+        main updater's snapshot — this tiny file keeps overlay keys
+        restorable across that."""
+        path = self._overlay_path()
+        if path is None:
+            return
+        if not self._overlay:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            return
+        os.makedirs(self._checkpoint_dir, exist_ok=True)
+        rec = [
+            [k.prefix_len, k.ingress_ifindex, k.ip_data.hex(),
+             np.asarray(v, np.int32).tolist()]
+            for k, v in self._overlay.items()
+        ]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    def _load_overlay(self, content_idents) -> None:
+        """Restore the overlay sidecar, dropping entries the restored
+        main content already covers (journal replay may have landed them
+        in the main table)."""
+        path = self._overlay_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            self._overlay = {
+                key: np.asarray(rows, np.int32)
+                for p, i, h, rows in rec
+                if (key := LpmKey(p, i, bytes.fromhex(h))).masked_identity()
+                not in content_idents
+            }
+        except (ValueError, KeyError, TypeError) as e:
+            log.warning("overlay sidecar unreadable (%s); dropping", e)
+            self._overlay = {}
 
     def _desired_width(self, iface_ingress_rules) -> int:
         if self._rule_width is not None:

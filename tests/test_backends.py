@@ -572,6 +572,7 @@ def test_compact_transfer_upload_bit_identical():
                 rules=jnp.asarray(host[3]),
                 trie_levels=tuple(jnp.asarray(l) for l in host[4]),
                 trie_targets=jnp.asarray(host[5]),
+                joined=jnp.asarray(host[7]),
                 root_lut=jnp.asarray(host[6]),
                 num_entries=jnp.asarray(np.int32(tables.num_entries)),
             )

@@ -298,3 +298,78 @@ def test_statistics_register_moves_between_registries():
     assert r2.collectors() == [a]
     a.unregister()
     assert r2.collectors() == []
+
+
+def test_batch_deny_record_pipeline(tmp_path):
+    """Replay-scale deny sets travel as ONE BatchDenyRecord (vectorized
+    columns), drain as binary spill rows, and export loss/queue counters
+    on the metrics registry (round-4 weak #2)."""
+    import numpy as np
+
+    from infw.obs import events as ev
+    from infw.obs.statistics import Registry
+    from infw.packets import make_batch
+
+    n = ev.BATCH_EMIT_THRESHOLD * 2
+    batch = make_batch(
+        src=["10.0.0.1"] * n, proto=[6] * n, ifindex=[2] * n,
+        dst_port=[80] * n)
+    results = np.full(n, (7 << 8) | 1, np.uint32)  # ruleId 7, DENY
+    ring = ev.EventRing(capacity=n + 10)
+    seen = ev.emit_deny_events(
+        ring, results, np.asarray(batch.ifindex), np.asarray(batch.pkt_len),
+        batch=batch)
+    assert seen == n
+    assert len(ring) == n
+    assert ring.lost_samples == 0
+
+    spill = str(tmp_path / "deny.bin")
+    lines = []
+    logger = ev.EventsLogger(ring, lines.append, spill_path=spill)
+    assert logger.drain_once() == n
+    assert logger.spilled_total == n
+    rows = np.fromfile(spill, dtype=ev.BatchDenyRecord.SPILL_DTYPE)
+    assert len(rows) == n
+    assert int(rows["result"][0]) == (7 << 8) | 1
+    assert bytes(rows["src"][0][:4]) == bytes([10, 0, 0, 1])
+    assert int(rows["dst_port"][0]) == 80
+    assert any("spilled" in l for l in lines)
+
+    # partial-fit batch: overflow is accounted, prefix delivered
+    small = ev.EventRing(capacity=100)
+    ev.emit_deny_events(
+        small, results, np.asarray(batch.ifindex),
+        np.asarray(batch.pkt_len), batch=batch)
+    assert len(small) == 100
+    assert small.lost_samples == n - 100
+
+    reg = Registry()
+    reg.register_counters(small)
+    text = reg.render_text()
+    assert f"ingressnodefirewall_node_events_lost_total {n - 100}" in text
+    assert "ingressnodefirewall_node_events_queued_total 100" in text
+    assert "# TYPE ingressnodefirewall_node_events_lost_total counter" in text
+
+
+def test_batch_deny_record_lines_without_spill():
+    """No spill sink: batch records render compact per-event lines with
+    the src address decoded from the parsed columns."""
+    import numpy as np
+
+    from infw.obs import events as ev
+    from infw.packets import make_batch
+
+    n = ev.BATCH_EMIT_THRESHOLD + 1
+    batch = make_batch(
+        src=["192.0.2.9"] * n, proto=[17] * n, ifindex=[3] * n,
+        dst_port=[53] * n)
+    results = np.full(n, (2 << 8) | 1, np.uint32)
+    ring = ev.EventRing(capacity=2 * n)
+    ev.emit_deny_events(
+        ring, results, np.asarray(batch.ifindex), np.asarray(batch.pkt_len),
+        batch=batch)
+    lines = []
+    logger = ev.EventsLogger(ring, lines.append, iface_names={3: "eth1"})
+    assert logger.drain_once() == n
+    assert any("ruleId 2 action Drop" in l and "if eth1" in l for l in lines)
+    assert any("ipv4 src addr 192.0.2.9" in l for l in lines)

@@ -701,3 +701,120 @@ def test_pending_delta_survives_failed_load_into_journal(make_syncer, tmp_path):
     assert s2.classifier.load_count == 1  # adopt only: checkpoint was current
     got = verdicts(s2, src=["10.1.1.1"], proto=[6], dport=[80], ifidx=[IF0])
     assert got == [XDP_DROP]
+
+
+# --- structural-add overlay (round-5 ask #2) --------------------------------
+
+
+def _many_cidrs(n):
+    return [f"10.{(i >> 8) & 255}.{i & 255}.0/24" for i in range(n)]
+
+
+def test_overlay_structural_add_fast_path(make_syncer):
+    """A NEW CIDR added to a trie-scale table routes to the dense
+    overlay: the main device table takes a zero-or-tiny patch (no
+    poptrie re-transform), and verdicts combine both tables with
+    longest-prefix semantics."""
+    from infw.backend.tpu import TpuClassifier
+
+    s = make_syncer(
+        classifier_factory=lambda: TpuClassifier(force_path="trie")
+    )
+    n = DataplaneSyncer.OVERLAY_MIN_MAIN + 50
+    cidrs = _many_cidrs(n)
+    rules = [tcp_rule(1, "80", ACTION_DENY)]
+    s.sync_interface_ingress_rules({"dummy0": [ingress(cidrs, rules)]}, False)
+    assert s.classifier._last_load[0] == "full"
+    assert verdicts(s, ["192.0.9.9"], [6], [80], [IF0]) == [XDP_PASS]
+
+    # add one new CIDR -> overlay (Deny, so a dropped add fails loudly)
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs + ["192.0.9.0/24"], rules)]}, False)
+    assert len(s._overlay) == 1
+    mode, n_rows = s.classifier._last_load
+    assert mode == "patch", "main table must not re-upload for an add"
+    assert verdicts(s, ["192.0.9.9"], [6], [80], [IF0]) == [XDP_DROP]
+    assert verdicts(s, ["10.0.1.1"], [6], [80], [IF0]) == [XDP_DROP]
+    assert verdicts(s, ["192.0.10.9"], [6], [80], [IF0]) == [XDP_PASS]
+
+    # longest-prefix across tables: a /25 overlay Allow nested in an
+    # existing main /24 Deny must win for its half of the space
+    s.sync_interface_ingress_rules(
+        {"dummy0": [
+            ingress(cidrs + ["192.0.9.0/24"], rules),
+            ingress(["10.0.1.0/25"], [tcp_rule(1, "80", ACTION_ALLOW)]),
+        ]},
+        False,
+    )
+    assert verdicts(s, ["10.0.1.1"], [6], [80], [IF0]) == [XDP_PASS]
+    assert verdicts(s, ["10.0.1.200"], [6], [80], [IF0]) == [XDP_DROP]
+
+    # rules edit of an overlay key patches the overlay in place
+    s.sync_interface_ingress_rules(
+        {"dummy0": [
+            ingress(cidrs + ["192.0.9.0/24"], rules),
+            ingress(["10.0.1.0/25"], [tcp_rule(1, "80", ACTION_DENY)]),
+        ]},
+        False,
+    )
+    assert verdicts(s, ["10.0.1.1"], [6], [80], [IF0]) == [XDP_DROP]
+
+    # deleting the overlay keys drains the overlay without touching main
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs, rules)]}, False)
+    assert len(s._overlay) == 0
+    assert verdicts(s, ["192.0.9.9"], [6], [80], [IF0]) == [XDP_PASS]
+    assert verdicts(s, ["10.0.1.1"], [6], [80], [IF0]) == [XDP_DROP]
+
+    # content introspection reflects the union view throughout
+    assert len(s.get_classifier_map_content_for_test()) == n
+
+
+def test_overlay_overflow_merges_into_main(make_syncer):
+    from infw.backend.tpu import TpuClassifier
+
+    s = make_syncer(
+        classifier_factory=lambda: TpuClassifier(force_path="trie")
+    )
+    s.OVERLAY_CAP = 3  # instance override
+    n = DataplaneSyncer.OVERLAY_MIN_MAIN + 10
+    cidrs = _many_cidrs(n)
+    rules = [tcp_rule(1, "80", ACTION_DENY)]
+    s.sync_interface_ingress_rules({"dummy0": [ingress(cidrs, rules)]}, False)
+    extra = [f"192.0.{i}.0/24" for i in range(5)]
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs + extra[:2], rules)]}, False)
+    assert len(s._overlay) == 2
+    # 3 more would exceed the cap: everything merges into main
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs + extra, rules)]}, False)
+    assert len(s._overlay) == 0
+    for ip, want in (("192.0.0.1", XDP_DROP), ("192.0.4.1", XDP_DROP),
+                     ("192.9.0.1", XDP_PASS)):
+        assert verdicts(s, [ip], [6], [80], [IF0]) == [want]
+
+
+def test_overlay_survives_restart(make_syncer, registry, tmp_path):
+    """The overlay sidecar restores across a daemon restart even after a
+    base checkpoint rewrite that excluded overlay keys."""
+    from infw.backend.tpu import TpuClassifier
+
+    factory = lambda: TpuClassifier(force_path="trie")
+    s = make_syncer(classifier_factory=factory)
+    n = DataplaneSyncer.OVERLAY_MIN_MAIN + 10
+    cidrs = _many_cidrs(n)
+    rules = [tcp_rule(1, "80", ACTION_DENY)]
+    s.sync_interface_ingress_rules({"dummy0": [ingress(cidrs, rules)]}, False)
+    s.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs + ["192.0.9.0/24"], rules)]}, False)
+    assert len(s._overlay) == 1
+    s.shutdown()
+
+    s2 = DataplaneSyncer(
+        classifier_factory=factory, registry=registry,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    s2.sync_interface_ingress_rules(
+        {"dummy0": [ingress(cidrs + ["192.0.9.0/24"], rules)]}, False)
+    assert verdicts(s2, ["192.0.9.9"], [6], [80], [IF0]) == [XDP_DROP]
+    assert verdicts(s2, ["10.0.1.1"], [6], [80], [IF0]) == [XDP_DROP]

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Prototype: compressed merged-node poptrie walk + joined target/rules
+gather (round-5 ask #1).
+
+Design under test (vs the current per-level poptrie walk in
+infw/kernels/jaxpath.py):
+
+1. ALL deep levels merge into ONE global node array, so a lane's walk
+   state is (node_id, bit_pos) and path-compressed chains collapse:
+   each node row is [child_base, target_base, skip_len, skip_bits,
+   child_bm x8, target_bm x8] (20 u32 = 80B, inside the flat-gather
+   cost window).  A step consumes skip_len (<=24) + 8 bits, so a /128
+   chain is 4 steps instead of 14 levels.  Only chains with NO targets
+   compress (leaf-pushed targets pin their nodes), preserving bit-exact
+   LPM semantics.
+2. The walk's winning target index gathers ONE joined row
+   [tidx+1 (2xu16), packed rules (R*5 u16)] — the separate
+   trie_targets resolve + rules gather collapse into one fat gather
+   (row width is free up to ~512B, tools/profile_gather.py).
+
+Verifies bit-exactness vs the production classify, then times v4/v6
+with the chained-loop methodology.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from infw import testing
+from infw.compiler import trie_level_strides
+from infw.constants import KIND_IPV4, KIND_IPV6
+from infw.kernels import jaxpath
+
+from bench import chained_throughput
+
+MAX_SKIP = 24  # skip+nibble <= 32 bits/step: extraction window stays in 2 words
+MODE = os.environ.get('INFW_PROTO_MODE', 'full')
+if MODE != 'full':
+    MAX_SKIP = 0  # disable chain compression entirely
+
+
+
+def build_cpoptrie(tables):
+    """Host transform: slot-trie levels -> (l0, nodes, joined, d_max).
+
+    l0:    (n0*65536, 2) int32 [global_node_id+1, joined_idx (0=none)]
+    nodes: (N, 20) uint32 rows
+    joined:(J, 2+R*5) uint16 [lo(tidx+1), hi(tidx+1), packed rules]
+    """
+    slot_levels = tables.trie_levels
+    strides = trie_level_strides(len(slot_levels))
+    assert strides[0] == 16 and all(s == 8 for s in strides[1:])
+    packed = jaxpath.pack_rules_u16(tables.rules)
+    assert packed is not None, "prototype assumes packed u16 rules"
+    R5 = packed.shape[1] * 5
+    packed2 = packed.reshape(packed.shape[0], R5)
+
+    # joined rows: start with sentinel 0; dedupe by tidx (targets that
+    # appear in many slots share one joined row)
+    joined_rows = [np.zeros(2 + R5, np.uint16)]
+    joined_of = {}  # tidx -> joined idx
+
+    def joined_idx(tidx):
+        j = joined_of.get(tidx)
+        if j is None:
+            j = len(joined_rows)
+            row = np.empty(2 + R5, np.uint16)
+            row[0] = (tidx + 1) & 0xFFFF
+            row[1] = (tidx + 1) >> 16
+            row[2:] = packed2[tidx]
+            joined_rows.append(row)
+            joined_of[tidx] = j
+        return j
+
+    nodes = []  # list of row lists; filled post-order-ish with patching
+
+    def slots_of(level):
+        return 1 << strides[level]
+
+    import sys as _sys
+    _sys.setrecursionlimit(100000)
+
+    def emit(level, node_id, skip_len, skip_bits):
+        """Emit the compressed node rooted at slot-trie (level, node_id),
+        absorbing the given pending skip prefix.  Returns global id."""
+        slots = slots_of(level)
+        tbl = slot_levels[level]
+        seg = tbl[node_id * slots:(node_id + 1) * slots]
+        child = seg[:, 0]
+        tgt = seg[:, 1]
+        cmask = child != 0
+        tmask = tgt > 0
+        n_child = int(cmask.sum())
+        n_tgt = int(tmask.sum())
+        # chain compression: exactly one child, no targets, skip budget
+        if (
+            n_tgt == 0 and n_child == 1 and level + 1 < len(slot_levels)
+            and skip_len + 8 <= MAX_SKIP
+        ):
+            nib = int(np.nonzero(cmask)[0][0])
+            return emit(level + 1, int(child[nib]),
+                        skip_len + 8, (skip_bits << 8) | nib)
+        gid = len(nodes)
+        nodes.append(None)  # reserve
+        # children emitted in slot order, ids referenced via child_base +
+        # rank; they are NOT contiguous here (prototype stores explicit
+        # per-child ids in a side list and uses base=-rank trick instead)
+        child_ids = []
+        for nib in np.nonzero(cmask)[0]:
+            if level + 1 < len(slot_levels):
+                child_ids.append(emit(level + 1, int(child[nib]), 0, 0))
+            else:
+                child_ids.append(0)  # no deeper level: dead pointer
+        # prototype contiguity: children were emitted depth-first so they
+        # are NOT contiguous; re-emit via indirection is complex — instead
+        # store children in a flat side array and make child_base point
+        # into it (production would renumber; one extra u32 indirection
+        # costs nothing here because the side array IS the id list)
+        row = np.zeros(20, np.uint32)
+        row[0] = 0  # child_base patched below
+        row[2] = skip_len
+        row[3] = skip_bits
+        cb = np.packbits(cmask, bitorder="little")
+        row[4:12] = np.ascontiguousarray(cb).view("<u4")
+        tb = np.packbits(tmask, bitorder="little")
+        row[12:20] = np.ascontiguousarray(tb).view("<u4")
+        nodes[gid] = (row, child_ids,
+                      [joined_idx(int(t) - 1) for t in tgt[tmask]])
+        return gid
+
+    # root level: per ifindex root node, 65536 slots
+    n0 = slot_levels[0].shape[0] // 65536
+    l0 = np.zeros((n0 * 65536, 2), np.int32)
+    seg0 = slot_levels[0].reshape(-1, 2)
+    for e0 in np.nonzero((seg0 != 0).any(axis=1))[0]:
+        child, tgt = int(seg0[e0, 0]), int(seg0[e0, 1])
+        if child and len(slot_levels) > 1:
+            l0[e0, 0] = emit(1, child, 0, 0) + 1
+        if tgt > 0:
+            l0[e0, 1] = joined_idx(tgt - 1)
+
+    # flatten: child lists -> contiguous via side array ("kids") with
+    # child_base indexing kids, kids holding global ids.  The walk then
+    # does nodes-row gather + kids gather (2 gathers/step).  Production
+    # would renumber nodes so children are contiguous (1 gather/step);
+    # for the prototype we emulate that cost by renumbering HERE.
+    order = []          # new id -> old id, BFS so children contiguous
+    kid_lists = {i: nodes[i][1] for i in range(len(nodes))}
+    new_of = {}
+    from collections import deque
+    dq = deque()
+    for e0 in np.nonzero(l0[:, 0])[0]:
+        old = l0[e0, 0] - 1
+        if old not in new_of:
+            new_of[old] = len(order)
+            order.append(old)
+            dq.append(old)
+    while dq:
+        old = dq.popleft()
+        for k in kid_lists[old]:
+            if k not in new_of:
+                new_of[k] = len(order)
+                order.append(k)
+                dq.append(k)
+    # BFS does NOT guarantee a node's children contiguous if shared —
+    # but this trie is a tree (each node one parent), and BFS emits each
+    # parent's children as one consecutive run. true.
+    N = len(order)
+    node_arr = np.zeros((max(N, 1), 20), np.uint32)
+    tgt_parts = []
+    tbase = 0
+    # target_base: global offsets into a flat per-node target list
+    for new_id, old in enumerate(order):
+        row, child_ids, tgt_joined = nodes[old]
+        r = row.copy()
+        if child_ids:
+            r[0] = new_of[child_ids[0]]
+            # assert contiguity
+            for k, cid in enumerate(child_ids):
+                assert new_of[cid] == r[0] + k, "children not contiguous"
+        r[1] = tbase
+        tbase += len(tgt_joined)
+        tgt_parts.extend(tgt_joined)
+        node_arr[new_id] = r
+    tgt_arr = np.asarray(tgt_parts if tgt_parts else [0], np.int32)
+    l0n = l0.copy()
+    nz = l0[:, 0] > 0
+    l0n[nz, 0] = np.vectorize(lambda o: new_of[o] + 1)(l0[nz, 0] - 1)
+
+    joined = np.stack(joined_rows)
+    # depth: longest step chain
+    def depth(gid):
+        _row, kids, _t = nodes[gid]
+        return 1 + (max((depth(k) for k in kids), default=0))
+    d_max = max((depth(l0[e0, 0] - 1)
+                 for e0 in np.nonzero(l0[:, 0])[0]), default=0)
+    return l0n, node_arr, tgt_arr, joined, d_max
+
+
+def extract_bits(ip_words, pos, n):
+    """(B,) values of the n bits at absolute bit offset pos (pos, n
+    dynamic per lane, n <= 32, window spans <= 2 words) of the 128-bit
+    address (4 big-endian u32 words, bit 0 = MSB of word 0).  Pure u32
+    math — JAX without x64 silently narrows 64-bit dtypes."""
+    w = jnp.clip(pos >> 5, 0, 4).astype(jnp.int32)
+    # word pick as pure-VPU selects (a take_along_axis here lowers to a
+    # real per-lane gather op per step — measured ~10x slower)
+    zeros = jnp.zeros_like(ip_words[:, 0])
+
+    def pick(widx):
+        out = zeros
+        for k in range(4):
+            out = jnp.where(widx == k, ip_words[:, k], out)
+        return out
+
+    lo = pick(w).astype(jnp.uint32)
+    hi = pick(w + 1).astype(jnp.uint32)
+    off = (pos & 31).astype(jnp.uint32)
+    n = n.astype(jnp.uint32)
+    # top32 = first 32 bits of the window starting at bit `off` of lo
+    hi_part = jnp.where(off == 0, jnp.uint32(0), hi >> (jnp.uint32(32) - off))
+    top32 = (lo << off) | hi_part
+    out = jnp.where(n == 0, jnp.uint32(0), top32 >> (jnp.uint32(32) - n))
+    return out
+
+
+def cwalk(l0, nodes, tgts, joined, root_lut, batch, d_max, cap_override=None):
+    """The compressed walk; returns (B, 2+R*5) joined rows."""
+    lut_size = root_lut.shape[0]
+    if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
+    root = jnp.where(
+        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0)
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    e0 = root * 65536 + nib0
+    in0 = (e0 >= 0) & (e0 < l0.shape[0])
+    rows0 = jnp.take(l0, e0, axis=0, mode="clip")
+    root_j = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)  # joined idx
+    alive = in0 & (rows0[:, 0] > 0)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    pos = jnp.full_like(node, 16)
+    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    widx8 = jnp.arange(8, dtype=jnp.uint32)[None, :]
+    # deep win: position into the flat per-node target list, resolved to
+    # a joined idx ONCE after the loop (one gather, like the original)
+    win_tpos = jnp.zeros_like(node)
+    win_ok = jnp.zeros_like(alive)
+
+    static_pos = 16
+    for _step in range(d_max):
+        in_n = (node >= 0) & (node < nodes.shape[0])
+        alive = alive & in_n
+        r = jnp.take(nodes, node, axis=0, mode="clip")
+        skip_len = r[:, 2]
+        if MODE == 'nocompress_static':
+            # all skips are 0 by construction; static per-step position
+            bit_start = static_pos
+            w32 = bit_start // 32
+            shift = 32 - 8 - (bit_start % 32)
+            nib = ((batch.ip_words[:, w32] >> np.uint32(shift))
+                   & np.uint32(0xFF)).astype(jnp.int32) if w32 < 4 else jnp.zeros_like(node)
+            static_pos += 8
+            pos = pos + 8
+        else:
+            skip_ok = jnp.where(
+                skip_len > 0,
+                extract_bits(batch.ip_words, pos, skip_len) == r[:, 3],
+                True,
+            )
+            alive = alive & skip_ok
+            pos = pos + skip_len.astype(jnp.int32)
+            nib = extract_bits(
+                batch.ip_words, pos, jnp.full_like(pos, 8)).astype(jnp.int32)
+            pos = pos + 8
+        w = (nib >> 5)[:, None].astype(jnp.uint32)
+        below = (np.uint32(1) << (nib & 31).astype(jnp.uint32)) - 1
+        cb = r[:, 4:12]
+        tb = r[:, 12:20]
+        pc_tb = jaxpath._popcount32(tb)
+        pc_cb = jaxpath._popcount32(cb)
+        prefix = jnp.sum(jnp.where(widx8 < w, pc_cb, 0), axis=1)
+        tprefix = jnp.sum(jnp.where(widx8 < w, pc_tb, 0), axis=1)
+        cw = jnp.sum(jnp.where(widx8 == w, cb, 0), axis=1)
+        tw = jnp.sum(jnp.where(widx8 == w, tb, 0), axis=1)
+        bit = (nib & 31).astype(jnp.uint32)
+        ok_t = alive & (((tw >> bit) & 1) > 0) & (pos <= cap_bits)
+        tpos = (r[:, 1] + tprefix + jaxpath._popcount32(tw & below)).astype(jnp.int32)
+        win_tpos = jnp.where(ok_t, tpos, win_tpos)
+        win_ok = win_ok | ok_t
+        alive = alive & (((cw >> bit) & 1) > 0)
+        node = jnp.where(
+            alive, (r[:, 0] + prefix + jaxpath._popcount32(cw & below)).astype(jnp.int32), 0)
+
+    in_t = win_ok & (win_tpos >= 0) & (win_tpos < tgts.shape[0])
+    deep_j = jnp.where(
+        in_t,
+        jnp.take(tgts, jnp.clip(win_tpos, 0, tgts.shape[0] - 1)),
+        0,
+    )
+    best = jnp.where(in_t & (deep_j > 0), deep_j, root_j)
+    in_b = (best >= 0) & (best < joined.shape[0])
+    return jnp.take(joined, jnp.where(in_b, best, 0), axis=0, mode="clip")
+
+
+def classify_c(l0, nodes, tgts, joined, root_lut, batch, d_max, R):
+    rows = cwalk(l0, nodes, tgts, joined, root_lut, batch, d_max)
+    rules = rows[:, 2:].reshape(rows.shape[0], R, 5)
+    # joined row 0 is all-zero -> rid 0 -> UNDEF, so no extra masking
+    result = jaxpath.rule_scan(rules, batch)
+    return jaxpath.finalize(result, batch)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if on_tpu:
+        from infw.platform import enable_jax_compile_cache
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    rng = np.random.default_rng(2024)
+    t0 = time.perf_counter()
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))
+    print(f"table build {time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    l0, node_arr, tgt_arr, joined, d_max = build_cpoptrie(tables)
+    print(f"cpoptrie build {time.perf_counter()-t0:.1f}s: "
+          f"nodes={len(node_arr)} joined={len(joined)} tgts={len(tgt_arr)} "
+          f"d_max={d_max} (levels was {len(tables.trie_levels)})",
+          file=sys.stderr, flush=True)
+
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    R = tables.rule_width
+
+    dev = dict(
+        l0=jax.device_put(l0), nodes=jax.device_put(node_arr),
+        tgts=jax.device_put(tgt_arr), joined=jax.device_put(joined),
+        root_lut=jax.device_put(tables.root_lut.astype(np.int32)),
+    )
+    dt = jaxpath.device_tables(tables)
+
+    # bit-exactness vs the production trie path
+    db_all = jaxpath.device_batch(batch)
+    fn = jax.jit(lambda b: classify_c(
+        dev["l0"], dev["nodes"], dev["tgts"], dev["joined"],
+        dev["root_lut"], b, d_max, R))
+    t0 = time.perf_counter()
+    got = np.asarray(fn(db_all)[0])
+    print(f"compile+first {time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
+    ref = np.asarray(jaxpath.jitted_classify(True)(dt, db_all)[0])
+    mism = np.nonzero(got != ref)[0]
+    if len(mism):
+        i = mism[0]
+        print(f"MISMATCH {len(mism)}/{len(got)} first@{i}: got={got[i]:x} "
+              f"ref={ref[i]:x} kind={batch.kind[i]} if={batch.ifindex[i]}",
+              file=sys.stderr, flush=True)
+        return 1
+    print("bit-exact vs production trie classify OK", file=sys.stderr, flush=True)
+
+    kinds = np.asarray(batch.kind)
+    results = {}
+    for name, sel in (("v4", kinds == KIND_IPV4), ("v6", kinds == KIND_IPV6)):
+        idx = np.nonzero(sel)[0]
+        db = jaxpath.device_batch(batch.take(idx))
+
+        def step(_dt, b):
+            res, _x, _s = classify_c(
+                dev["l0"], dev["nodes"], dev["tgts"], dev["joined"],
+                dev["root_lut"], b, d_max, R)
+            return res
+
+        results[f"cpoptrie {name}"] = chained_throughput(
+            step, dt, db, len(idx), on_tpu, f"cpoptrie {name}")
+
+    print("\n=== summary ===", file=sys.stderr, flush=True)
+    for name, thr in results.items():
+        print(f"{name}: {thr/1e6:.1f} M pkts/s ({1e9/thr:.1f} ns/pkt)",
+              file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Measure the production classify with the joined-targets walk vs the
+legacy two-gather walk, per family, at the 100K tier."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from infw import testing
+from infw.constants import KIND_IPV4, KIND_IPV6
+from infw.kernels import jaxpath
+
+from bench import chained_throughput
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
+    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if on_tpu:
+        from infw.platform import enable_jax_compile_cache
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    rng = np.random.default_rng(2024)
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))
+    dt = jaxpath.device_tables(tables)
+    built = jaxpath.build_joined(tables)
+    print(f"joined active={built is not None} "
+          f"rows={dt.joined.shape} targets={dt.trie_targets.shape}",
+          file=sys.stderr, flush=True)
+    dt_legacy = dt._replace(joined=jax.device_put(np.zeros((1, 1), np.uint16)))
+
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    kinds = np.asarray(batch.kind)
+
+    def full(tabs, b):
+        res, _x, _s = jaxpath.classify(tabs, b, use_trie=True)
+        return res
+
+    results = {}
+    for fam, sel in (("v4", kinds == KIND_IPV4), ("v6", kinds == KIND_IPV6)):
+        idx = np.nonzero(sel)[0]
+        db = jaxpath.device_batch(batch.take(idx))
+        for name, tabs in (("joined", dt), ("legacy", dt_legacy)):
+            t = tabs
+            if fam == "v4":
+                depth = jaxpath.v4_trie_depth(len(t.trie_levels))
+                t = t._replace(trie_levels=t.trie_levels[:depth])
+            key = f"{fam} {name}"
+            try:
+                results[key] = chained_throughput(
+                    full, t, db, len(idx), on_tpu, key)
+            except Exception as e:
+                print(f"{key} FAILED: {e}", file=sys.stderr, flush=True)
+
+    print("\n=== summary ===", file=sys.stderr, flush=True)
+    for name, thr in results.items():
+        print(f"{name}: {thr/1e6:.1f} M pkts/s ({1e9/thr:.1f} ns/pkt)",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
