@@ -471,10 +471,11 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
             assert done == n_files, f"processed {done}/{n_files}"
             pass_times.append(dt_s)
             best_dt = min(best_dt, dt_s)
-            # narrow wire: 12B/packet v4, 24B v6; fused readback ~2B+stats
+            # wire8: 8B/packet v4 (pkt_len host-side, 4-bit if-dict),
+            # 24B narrow v6; fused readback 2B/packet (v4: no stats)
             from infw.constants import KIND_IPV6 as _K6
             n_v6 = int((np.asarray(batch.kind) == _K6).sum()) * n_files
-            h2d_mb = ((n_total - n_v6) * 12 + n_v6 * 24) / 1e6
+            h2d_mb = ((n_total - n_v6) * 8 + n_v6 * 24) / 1e6
             log(f"replay pass {p}: {n_files} x {n_file} packets in {dt_s:.1f}s "
                 f"(+{t_write:.1f}s file write) -> {n_total/dt_s/1e6:.2f} M "
                 f"pkts/s; ~{h2d_mb/dt_s:.0f} MB/s effective H2D; "

@@ -31,7 +31,7 @@ import numpy as np
 from ..compiler import CompiledTables
 from ..constants import KIND_IPV6
 from ..kernels import jaxpath, pallas_dense
-from ..packets import PacketBatch, narrow_wire
+from ..packets import PacketBatch, narrow_wire, wire8
 from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 
 
@@ -242,6 +242,18 @@ class TpuClassifier:
         ov_dev=None,
     ) -> PendingClassify:
         n = wire_np.shape[0]
+        if path == "trie" and wire_np.shape[1] == 4:
+            # 8B/packet transfer (packets.wire8): classification never
+            # reads pkt_len, so the length stays host-side and byte
+            # statistics are computed from the returned verdicts; the
+            # ifindex travels as a 4-bit dictionary index.  The link is
+            # the replay bottleneck (8-17MB/s tunnel), so 12B -> 8B is a
+            # direct 1.5x on the sustained end-to-end rate.
+            w8 = wire8(wire_np)
+            if w8 is not None:
+                return self._dispatch_wire8(
+                    dev, ov_dev, wire_np, w8, kind, apply_stats
+                )
         if wire_np.shape[1] in (4, 7):
             # Narrow transfer (packets.narrow_wire): one word less per
             # packet on the H2D link when the chunk qualifies — the link
@@ -282,6 +294,47 @@ class TpuClassifier:
                 self._stats.add(stats_delta)
             results, xdp = jaxpath.host_finalize_wire(res16, kind)
             return ClassifyOutput(results=results, xdp=xdp, stats_delta=stats_delta)
+
+        return PendingClassify(materialize)
+
+    def _dispatch_wire8(
+        self, dev, ov_dev, wire4_np, w8, kind, apply_stats
+    ) -> PendingClassify:
+        """The 8B-wire dispatch: res16-only D2H; statistics (incl. exact
+        byte counts) derive host-side from the verdicts + the pkt_len
+        column that never crossed the link."""
+        wire8_np, ifmap = w8
+        n = wire4_np.shape[0]
+        # full-layout pkt_len reconstruction (pack_wire w1>>16 plus the
+        # w0>>27 high-bit stash)
+        pkt_len = (
+            ((wire4_np[:, 1] >> 16) & 0xFFFF)
+            | ((wire4_np[:, 0] >> 27) << 16)
+        ).astype(np.int64)
+        wire = jax.device_put(wire8_np, self._device)
+        ifm = jax.device_put(ifmap, self._device)
+        if ov_dev is not None:
+            fused = jaxpath.jitted_classify_wire8_fused(True)(
+                dev, ov_dev, wire, ifm
+            )
+        else:
+            fused = jaxpath.jitted_classify_wire8_fused(False)(dev, wire, ifm)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16 = jaxpath.unpack_res16_host(np.asarray(fused), n)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            stats_delta = stats_from_results(results, pkt_len)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
 
         return PendingClassify(materialize)
 

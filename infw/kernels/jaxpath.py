@@ -903,6 +903,86 @@ def unpack_wire(wire: jax.Array) -> DeviceBatch:
     )
 
 
+def unpack_wire8(wire: jax.Array, ifmap: jax.Array) -> DeviceBatch:
+    """Device-side inverse of packets.wire8: (B, 2) uint32 rows + the
+    (16,) int32 ifindex dictionary.  pkt_len is reconstructed as ZERO —
+    this format never carries lengths; byte statistics are computed
+    host-side from the returned verdicts (daemon.stats_from_results), so
+    callers must NOT consume the device stats of a wire8 classify."""
+    w0 = wire[:, 0]
+    proto = ((w0 >> 3) & 0xFF).astype(jnp.int32)
+    is_icmp = (proto == IPPROTO_ICMP) | (proto == IPPROTO_ICMPV6)
+    l4w = ((w0 >> 15) & 0xFFFF).astype(jnp.int32)
+    ifd = ((w0 >> 11) & 0xF).astype(jnp.int32)
+    ifindex = jnp.take(ifmap, ifd, mode="clip").astype(jnp.int32)
+    zeros = jnp.zeros_like(proto)
+    return DeviceBatch(
+        kind=(w0 & 3).astype(jnp.int32),
+        l4_ok=((w0 >> 2) & 1).astype(jnp.int32),
+        ifindex=ifindex,
+        ip_words=jnp.concatenate(
+            [wire[:, 1:2], jnp.zeros((wire.shape[0], 3), wire.dtype)], axis=1
+        ),
+        proto=proto,
+        dst_port=jnp.where(is_icmp, 0, l4w),
+        icmp_type=jnp.where(is_icmp, l4w >> 8, 0),
+        icmp_code=jnp.where(is_icmp, l4w & 0xFF, 0),
+        pkt_len=zeros,
+    )
+
+
+def _pack_res16(res16: jax.Array) -> jax.Array:
+    """(B,) u16 -> ceil(B/2) int32 single-buffer D2H payload.  The
+    (nw, 2) u16 -> u32 bitcast is a pure reinterpretation, no
+    lane-crossing shuffles (the strided r[0::2] | r[1::2] << 16 form
+    measures ~40% slower on the chip)."""
+    r = res16
+    if r.shape[0] % 2:
+        r = jnp.concatenate([r, jnp.zeros(1, jnp.uint16)])
+    packed = jax.lax.bitcast_convert_type(r.reshape(-1, 2), jnp.uint32)
+    return jax.lax.bitcast_convert_type(packed, jnp.int32)
+
+
+def unpack_res16_host(arr: np.ndarray, b: int) -> np.ndarray:
+    u = arr.view(np.uint32)
+    res16 = np.empty(len(u) * 2, np.uint16)
+    res16[0::2] = u & 0xFFFF
+    res16[1::2] = u >> 16
+    return res16[:b]
+
+
+def classify_wire8(
+    tables: DeviceTables, wire: jax.Array, ifmap: jax.Array,
+    overlay: "Optional[DeviceTables]" = None, *, v4_only: bool = True
+) -> jax.Array:
+    """wire8 classify: res16-only packed D2H (stats are host-derived for
+    this format; the wire is v4-compact by construction, so the walk
+    truncates like classify_wire's v4_only path)."""
+    if v4_only:
+        depth = v4_trie_depth(len(tables.trie_levels))
+        tables = tables._replace(trie_levels=tables.trie_levels[:depth])
+    batch = unpack_wire8(wire, ifmap)
+    if overlay is not None:
+        res, _x, _s = classify_with_overlay(
+            tables, overlay, batch, use_trie=True
+        )
+    else:
+        res, _x, _s = classify(tables, batch, use_trie=True)
+    return _pack_res16(res.astype(jnp.uint16))
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_wire8_fused(overlay: bool, v4_only: bool = True):
+    if overlay:
+        def f(tables, ov, wire, ifmap):
+            return classify_wire8(tables, wire, ifmap, ov, v4_only=v4_only)
+    else:
+        def f(tables, wire, ifmap):
+            return classify_wire8(tables, wire, ifmap, v4_only=v4_only)
+
+    return jax.jit(f)
+
+
 def v4_trie_depth(n_levels: int) -> int:
     """Number of leading trie levels whose bit boundary is within the IPv4
     packet-side cap (32 bits): entries longer than /32 can never match a
@@ -971,26 +1051,13 @@ def fuse_wire_outputs(res16: jax.Array, stats: jax.Array) -> jax.Array:
     for 24KB of stats.  Layout: ceil(B/2) words of u16-pair-packed
     results, then stats flattened; bitcast (not convert) so the high
     result's top bit survives the int32 view."""
-    b = res16.shape[0]
-    r = res16
-    if b % 2:
-        r = jnp.concatenate([r, jnp.zeros(1, jnp.uint16)])
-    # (nw, 2) u16 -> (nw,) u32 bitcast: a pure reinterpretation, no
-    # lane-crossing shuffles (the strided r[0::2] | r[1::2] << 16 form
-    # measures ~40% slower on the chip).
-    packed = jax.lax.bitcast_convert_type(r.reshape(-1, 2), jnp.uint32)
-    return jnp.concatenate(
-        [jax.lax.bitcast_convert_type(packed, jnp.int32), stats.reshape(-1)]
-    )
+    return jnp.concatenate([_pack_res16(res16), stats.reshape(-1)])
 
 
 def split_wire_outputs(arr: np.ndarray, b: int) -> Tuple[np.ndarray, np.ndarray]:
     """Host inverse of fuse_wire_outputs -> (results_u16[b], stats_i32)."""
-    u = arr.view(np.uint32)
     nw = (b + 1) // 2
-    res16 = np.empty(nw * 2, np.uint16)
-    res16[0::2] = u[:nw] & 0xFFFF
-    res16[1::2] = u[:nw] >> 16
+    res16 = unpack_res16_host(arr[:nw], b)
     stats = arr[nw:].reshape(MAX_TARGETS, 6)
     return res16[:b], stats
 

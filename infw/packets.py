@@ -274,6 +274,20 @@ def expand_wire_v4(w: np.ndarray) -> np.ndarray:
     return out
 
 
+def _l4_word(w0: np.ndarray, w1: np.ndarray) -> np.ndarray:
+    """The 16-bit l4 overlay shared by narrow_wire and wire8: dst_port
+    for transport rows, type<<8|code for the family ICMPs — lossless for
+    classification because the ordered scan never reads both
+    (kernel.c:222-258)."""
+    proto = (w0 >> 3) & 0xFF
+    is_icmp = (proto == IPPROTO_ICMP) | (proto == IPPROTO_ICMPV6)
+    return np.where(
+        is_icmp,
+        ((w0 >> 11) & 0xFF) << 8 | ((w0 >> 19) & 0xFF),
+        w1 & 0xFFFF,
+    ).astype(np.uint32)
+
+
 def narrow_wire(w: np.ndarray):
     """(n, 4|7) wire -> the NARROW (n, 3|6) format, or None when the rows
     don't qualify.  Saves one word per packet (v4 16B -> 12B, v6 28B ->
@@ -298,15 +312,48 @@ def narrow_wire(w: np.ndarray):
         return np.zeros((0, w.shape[1] - 1), np.uint32)
     if (w0 >> 27).any() or (ifx >> 16).any():
         return None  # pkt_len >= 64KiB or wide ifindex: keep the full form
-    proto = (w0 >> 3) & 0xFF
-    is_icmp = (proto == IPPROTO_ICMP) | (proto == IPPROTO_ICMPV6)
-    l4w = np.where(
-        is_icmp,
-        ((w0 >> 11) & 0xFF) << 8 | ((w0 >> 19) & 0xFF),  # type<<8 | code
-        w[:, 1] & 0xFFFF,                                # dst_port
-    ).astype(np.uint32)
+    l4w = _l4_word(w0, w[:, 1])
     out = np.empty((w.shape[0], w.shape[1] - 1), np.uint32)
     out[:, 0] = (w0 & 0x7FF) | (ifx << 11)
     out[:, 1] = l4w | (w[:, 1] & 0xFFFF0000)  # pktLen low 16 stays in place
     out[:, 2:] = w[:, 3:]
     return out
+
+
+def wire8(w: np.ndarray):
+    """(n, 4) v4-compact wire -> the 8-BYTE format, or None when the rows
+    don't qualify: (n, 2) uint32 rows plus the (16,) int32 ifindex
+    dictionary the device decodes through.
+
+    The byte diet beyond the 12B narrow form comes from two observations:
+    (a) classification itself never reads pkt_len — it exists only for
+    byte statistics, which the host can compute EXACTLY from the returned
+    verdicts and its own pkt_len column (stats_from_results), so the
+    length never needs to cross the link; (b) a chunk rarely spans more
+    than a handful of interfaces, so a 4-bit dictionary index replaces
+    the 16-bit ifindex (the bond-expansion world of interfaces.go:85-116
+    still fits: 15 member links per chunk).
+
+    Layout:  w0: kind(2) | l4_ok(1)<<2 | proto(8)<<3 | ifdict(4)<<11 |
+                 l4word(16)<<15          (l4word as in narrow_wire)
+             w1: ip word 0
+    Device-side inverse: kernels.jaxpath.unpack_wire8 (needs the dict).
+    Qualifies only v4-compact chunks (ip words 1..3 zero — the caller's
+    pack_wire_v4 contract)."""
+    if w.shape[1] != 4:
+        return None
+    if w.shape[0] == 0:
+        return np.zeros((0, 2), np.uint32), np.full(16, -1, np.int32)
+    w0 = w[:, 0]
+    ifx = w[:, 2]
+    uniq = np.unique(ifx)
+    if len(uniq) > 15:
+        return None
+    ifmap = np.full(16, -1, np.int32)
+    ifmap[: len(uniq)] = uniq.astype(np.int64)
+    ifdict = np.searchsorted(uniq, ifx).astype(np.uint32)
+    l4w = _l4_word(w0, w[:, 1])
+    out = np.empty((w.shape[0], 2), np.uint32)
+    out[:, 0] = (w0 & 0x7FF) | (ifdict << 11) | (l4w << 15)
+    out[:, 1] = w[:, 3]
+    return out, ifmap

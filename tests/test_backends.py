@@ -709,3 +709,85 @@ def test_device_patch_with_hints_matches_full_upload_under_churn():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         prev = new
     assert used_hint > 0  # the hint path must actually engage
+
+
+def test_wire8_format_roundtrip_and_dispatch():
+    """The 8B/packet wire format (packets.wire8): device decode must
+    reconstruct every classification field, verdicts must match the
+    oracle, and statistics (computed HOST-side for this format — pkt_len
+    never crosses the link) must equal the device-stats path."""
+    import jax
+
+    from infw.backend.tpu import TpuClassifier
+    from infw.kernels import jaxpath
+    from infw.packets import wire8
+
+    rng = np.random.default_rng(91)
+    tables = testing.random_tables_fast(
+        rng, n_entries=6000, width=4, v6_fraction=0.0, ifindexes=(2, 3, 9))
+    batch = testing.random_batch_fast(rng, tables, n_packets=3000)
+    kinds = np.asarray(batch.kind)
+    v4 = batch.take(np.nonzero(kinds != 2)[0])  # no v6: v4-compactable
+    # honor the pack_wire_v4 caller contract the dispatch gate enforces
+    # (ip words 1..3 all zero): non-IP kinds may carry junk there that
+    # classification never reads
+    v4.ip_words[:, 1:] = 0
+
+    w4 = v4.pack_wire_v4()
+    w8 = wire8(w4)
+    assert w8 is not None
+    wire8_np, ifmap = w8
+    assert wire8_np.shape[1] == 2
+    db = jaxpath.unpack_wire8(
+        jax.numpy.asarray(wire8_np), jax.numpy.asarray(ifmap))
+    for field in ("kind", "l4_ok", "ifindex", "proto"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(db, field)), getattr(v4, field),
+            err_msg=field)
+    # the l4 word is an overlay (narrow_wire semantics): dst_port is
+    # meaningful only for transport rows, icmp fields only for icmp rows
+    # — exactly what the ordered scan reads (kernel.c:222-258)
+    is_icmp = np.isin(v4.proto, (1, 58))
+    np.testing.assert_array_equal(
+        np.asarray(db.dst_port)[~is_icmp], v4.dst_port[~is_icmp])
+    np.testing.assert_array_equal(
+        np.asarray(db.icmp_type)[is_icmp], v4.icmp_type[is_icmp])
+    np.testing.assert_array_equal(
+        np.asarray(db.icmp_code)[is_icmp], v4.icmp_code[is_icmp])
+    np.testing.assert_array_equal(
+        np.asarray(db.ip_words), np.asarray(v4.ip_words).astype(np.uint32))
+
+    # dispatch through the classifier: wire8 engages on the trie path
+    jaxpath.jitted_classify_wire8_fused.cache_clear()
+    clf = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    out = clf.classify(v4)
+    assert jaxpath.jitted_classify_wire8_fused.cache_info().currsize > 0, (
+        "the 8B wire path must actually engage, not fall back to narrow")
+    ref = oracle.classify(tables, v4)
+    np.testing.assert_array_equal(out.results, ref.results)
+    np.testing.assert_array_equal(out.xdp, ref.xdp)
+    # host-derived stats must equal the oracle's per-rule aggregation
+    for rid, vals in ref.stats.items():
+        np.testing.assert_array_equal(out.stats_delta[rid], vals,
+                                      err_msg=f"rule {rid}")
+    nz = np.nonzero(out.stats_delta.any(axis=1))[0]
+    assert set(nz) == set(ref.stats), "extra stats rows"
+    clf.close()
+
+
+def test_wire8_fallback_on_many_interfaces():
+    from infw.packets import wire8
+
+    rng = np.random.default_rng(92)
+    tables = testing.random_tables_fast(
+        rng, n_entries=200, width=4, v6_fraction=0.0,
+        ifindexes=tuple(range(2, 30)))
+    batch = testing.random_batch_fast(
+        rng, tables, n_packets=2000)
+    kinds = np.asarray(batch.kind)
+    v4 = batch.take(np.nonzero(kinds != 2)[0])
+    ifx = np.asarray(v4.ifindex)
+    if len(np.unique(ifx)) <= 15:  # force >15 distinct ifindexes
+        v4.ifindex = (np.arange(len(v4)) % 20 + 2).astype(np.int32)
+    assert wire8(v4.pack_wire_v4()) is None

@@ -380,9 +380,17 @@ def test_full_compose_stack_cr_to_sidecar_event(tmp_path):
     )
     procs = {"launcher": launcher}
     try:
-        deadline = time.time() + 30
+        # generous: under a loaded machine (the INFW_BIG_TESTS run
+        # allocates GBs right before this test) process spawn + jax
+        # import can exceed 30s
+        deadline = time.time() + 120
         while time.time() < deadline and not (state / "apply").is_dir():
             time.sleep(0.1)
+        assert (state / "apply").is_dir(), (
+            "launcher stack did not come up; launcher output:\n"
+            + (launcher.stdout.read().decode(errors="replace")
+               if launcher.poll() is not None else "(still starting)")
+        )
 
         # a CR that trips the failsafe webhook: rejected with the verdict
         # in its status file (the API-call error of webhook.go, as a file)
