@@ -457,6 +457,12 @@ class VarTrie:
             self._prio.append(np.zeros(2 * slots, np.int64))
             self.n_nodes.append(1)
         self.roots: Dict[int, int] = {}
+        # Monotonic mutation stamp: bumped by any write into the slot
+        # arrays, so snapshot() can prove "trie unchanged since the last
+        # snapshot" and reuse the previous level copies instead of
+        # re-copying multi-GB buffers (measured: the per-edit snapshot
+        # copy was the dominant cost of a 1-key rule edit at 1M entries).
+        self.mutations = 0
         # Dirty-row tracking (None = off): per-level lists of slot-row
         # index arrays written since the last drain — a SUPERSET of the
         # rows whose values changed, which is exactly what the device
@@ -487,6 +493,7 @@ class VarTrie:
 
     def _alloc_nodes(self, level: int, count: int) -> int:
         """Allocate `count` fresh zeroed nodes; return the first id."""
+        self.mutations += 1
         first = self.n_nodes[level]
         need = (first + count) * self._slots(level)
         cur = self._ct[level].shape[0]
@@ -591,6 +598,7 @@ class VarTrie:
             np.cumsum(span) - span, span
         )
         flat = node.astype(np.int64)[rep] * slots + base[rep] + offs
+        self.mutations += 1
         prio = ((mask_len.astype(np.int64) + 1) << 40) | seq.astype(np.int64)
         np.maximum.at(self._prio[level], flat, prio[rep])
         won = self._prio[level][flat] == prio[rep]
@@ -610,6 +618,7 @@ class VarTrie:
         prefixes that terminate there (child links are untouched) — the
         node-local delete path."""
         slots = self._slots(level)
+        self.mutations += 1
         sl = slice(node * slots, (node + 1) * slots)
         self._ct[level][sl, 1] = 0
         self._prio[level][sl] = 0
@@ -632,14 +641,27 @@ class VarTrie:
         of the (multi-GB at 1M entries) node arrays — and leaves the trie
         unusable for further inserts; only for builders about to be
         dropped (the one-shot compile_tables_from_content path)."""
-        levels = []
-        for l in range(self.n_levels):
-            n = self.n_nodes[l] * self._slots(l)
-            if consume:
-                self._ct[l].resize((n, 2), refcheck=False)
-                levels.append(self._ct[l])
-            else:
-                levels.append(self._ct[l][:n].copy())
+        cached = getattr(self, "_levels_cache", None)
+        if (
+            not consume
+            and cached is not None
+            and cached[0] == self.mutations
+        ):
+            levels = list(cached[1])
+        else:
+            levels = []
+            for l in range(self.n_levels):
+                n = self.n_nodes[l] * self._slots(l)
+                if consume:
+                    self._ct[l].resize((n, 2), refcheck=False)
+                    levels.append(self._ct[l])
+                else:
+                    levels.append(self._ct[l][:n].copy())
+            if not consume:
+                # the copies are immutable once handed out (CompiledTables
+                # arrays are never written), so consecutive unchanged
+                # snapshots can share them by reference
+                self._levels_cache = (self.mutations, tuple(levels))
         root_lut = np.zeros(max_ifindex + 1, np.int32)
         for ifindex, node in self.roots.items():
             root_lut[ifindex] = node
