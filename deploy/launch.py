@@ -247,14 +247,15 @@ def main(argv=None) -> int:
         "events-socket": args.events_socket
         or os.path.join(state_dir, "events.sock"),
     }
-    # ephemeral ports for every component that DECLARES ports in the
-    # bundle (not a hardcoded name list — daemon-multihost binds the same
-    # metrics/health pair as daemon)
+    # ephemeral ports for every component that declares the
+    # metrics/health port pair in the bundle (daemon, daemon-multihost,
+    # manager — components with OTHER ports, e.g. metrics-proxy, do not
+    # accept these flags)
     extra = (
         {
             name: ["--metrics-port", "0", "--health-port", "0"]
             for name, comp in bundle["components"].items()
-            if comp.get("ports")
+            if "metrics" in comp.get("ports", {})
         }
         if args.ephemeral_ports else {}
     )

@@ -1,0 +1,206 @@
+"""Authenticated metrics fronting — the kube-rbac-proxy sidecar role.
+
+The reference daemonset fronts the daemon's loopback-bound metrics
+endpoint with kube-rbac-proxy: TLS on :9301, bearer-token authentication
+(SubjectAccessReview), upstream http://127.0.0.1:39301
+(/root/reference/bindata/manifests/daemon/daemonset.yaml:68-113).  The
+daemon itself never listens off-host.
+
+This module is the idiomatic reduction of that sidecar for the
+process-composition deployment: a small reverse proxy that
+
+- listens on an OUTWARD address (TLS when ``--certfile``/``--keyfile``
+  are provided — the reference's tls-cert-file/tls-private-key-file
+  pair, daemonset.yaml:77-79);
+- authenticates every request with a static bearer token read from a
+  file (the ServiceAccount-token role; rotation = rewrite the file, it
+  is re-read per request so no restart is needed);
+- forwards ONLY ``GET /metrics`` to the loopback upstream and relays
+  the exposition text; everything else is 401/403/404 — deny by
+  default, exactly the proxy's posture.
+
+Usage (also declared as the ``metrics-proxy`` bundle component):
+
+    python -m infw.obs.metricsproxy --listen 0.0.0.0:9301 \
+        --upstream 127.0.0.1:39301 --token-file /var/run/infw/token \
+        [--certfile tls.crt --keyfile tls.key]
+"""
+from __future__ import annotations
+
+import argparse
+import hmac
+import logging
+import os
+import signal
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger("infw.obs.metricsproxy")
+
+#: upstream fetches must never route through http_proxy/HTTP_PROXY — the
+#: target is the node-local loopback, which a corporate proxy cannot reach
+_OPENER = urllib.request.build_opener(urllib.request.ProxyHandler({}))
+
+DEFAULT_LISTEN_PORT = 9301  # daemonset.yaml:72 (kube-rbac-proxy :9301)
+
+
+def read_token(path: str) -> Optional[str]:
+    """Re-read per request: token rotation must not need a restart."""
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+class MetricsProxy:
+    def __init__(
+        self,
+        upstream: str,
+        token_file: str,
+        listen_host: str = "0.0.0.0",
+        listen_port: int = DEFAULT_LISTEN_PORT,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.upstream = upstream
+        self.token_file = token_file
+        self.timeout_s = timeout_s
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                want = read_token(proxy.token_file)
+                if not want:
+                    # missing/unreadable token file: fail CLOSED
+                    self._send(503, "token file unavailable\n")
+                    return
+                auth = self.headers.get("Authorization", "")
+                try:
+                    ok = auth.startswith("Bearer ") and hmac.compare_digest(
+                        auth[len("Bearer "):].strip().encode(), want.encode()
+                    )
+                except (TypeError, UnicodeError):
+                    ok = False
+                if not ok:
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", "Bearer")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if self.path != "/metrics":
+                    self._send(404, "only /metrics is proxied\n")
+                    return
+                try:
+                    with _OPENER.open(
+                        f"http://{proxy.upstream}/metrics",
+                        timeout=proxy.timeout_s,
+                    ) as r:
+                        body = r.read()
+                except (urllib.error.URLError, OSError) as e:
+                    self._send(502, f"upstream unavailable: {e}\n")
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # only GET /metrics is forwarded (the docstring contract)
+                self._send(405, "method not allowed\n")
+
+        self._server = ThreadingHTTPServer((listen_host, listen_port), Handler)
+        self.tls = False
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            # handshake deferred to the per-connection HANDLER thread:
+            # with do_handshake_on_connect=True the handshake runs inside
+            # accept() on the single serve_forever thread, so one stalled
+            # client would block every other scrape (and shutdown)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            self.tls = True
+        elif listen_host not in ("127.0.0.1", "localhost", "::1"):
+            log.warning(
+                "metrics proxy listening on %s WITHOUT TLS: the bearer "
+                "token travels in cleartext; pass --certfile/--keyfile "
+                "(the reference kube-rbac-proxy always terminates TLS)",
+                listen_host,
+            )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        log.info(
+            "metrics proxy listening on :%d (tls=%s) -> http://%s/metrics",
+            self.port, self.tls, self.upstream,
+        )
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="infw-metrics-proxy", description=__doc__)
+    p.add_argument("--listen", default=f"0.0.0.0:{DEFAULT_LISTEN_PORT}",
+                   help="host:port to serve on (rbac-proxy :9301)")
+    p.add_argument("--upstream", default="127.0.0.1:39301",
+                   help="loopback metrics endpoint to front")
+    p.add_argument("--token-file", required=True,
+                   help="bearer token file (re-read per request)")
+    p.add_argument("--certfile", default=None, help="TLS certificate chain")
+    p.add_argument("--keyfile", default=None, help="TLS private key")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    host, _, port = args.listen.rpartition(":")
+    proxy = MetricsProxy(
+        upstream=args.upstream, token_file=args.token_file,
+        listen_host=host or "0.0.0.0", listen_port=int(port),
+        certfile=args.certfile, keyfile=args.keyfile,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    proxy.start()
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,168 @@
+"""Authenticated metrics fronting (the kube-rbac-proxy sidecar role,
+/root/reference/bindata/manifests/daemon/daemonset.yaml:68-113): bearer
+auth, deny-by-default routing, token rotation without restart, TLS."""
+import http.server
+import os
+import ssl
+import subprocess
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from infw.obs.metricsproxy import MetricsProxy
+
+EXPOSITION = "ingressnodefirewall_node_packet_deny_total 7\n"
+
+
+@pytest.fixture
+def upstream():
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = EXPOSITION.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def _get(url, token=None, ctx=None):
+    req = urllib.request.Request(url)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    return urllib.request.urlopen(req, timeout=5, context=ctx)
+
+
+def test_bearer_auth_and_routing(tmp_path, upstream):
+    tok = tmp_path / "token"
+    tok.write_text("s3cret\n")
+    proxy = MetricsProxy(upstream=upstream, token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0)
+    proxy.start()
+    base = f"http://127.0.0.1:{proxy.port}"
+    try:
+        # correct token -> relayed exposition
+        with _get(f"{base}/metrics", "s3cret") as r:
+            assert r.read().decode() == EXPOSITION
+        # no token / wrong token -> 401 with WWW-Authenticate
+        for t in (None, "wrong"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{base}/metrics", t)
+            assert e.value.code == 401
+            assert e.value.headers.get("WWW-Authenticate") == "Bearer"
+        # authenticated but non-metrics path -> 404 (deny by default)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/healthz", "s3cret")
+        assert e.value.code == 404
+        # token rotation without restart: file re-read per request
+        tok.write_text("rotated")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/metrics", "s3cret")
+        assert e.value.code == 401
+        with _get(f"{base}/metrics", "rotated") as r:
+            assert r.read().decode() == EXPOSITION
+        # missing token file -> fail closed (503), never open
+        os.remove(tok)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/metrics", "rotated")
+        assert e.value.code == 503
+    finally:
+        proxy.stop()
+
+
+def test_tls_fronting(tmp_path, upstream):
+    crt, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", crt, "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    tok = tmp_path / "token"
+    tok.write_text("t")
+    proxy = MetricsProxy(upstream=upstream, token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0,
+                         certfile=crt, keyfile=key)
+    assert proxy.tls
+    proxy.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with _get(f"https://127.0.0.1:{proxy.port}/metrics", "t", ctx) as r:
+            assert r.read().decode() == EXPOSITION
+        # plaintext client against the TLS listener fails the handshake
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(f"http://127.0.0.1:{proxy.port}/metrics", "t")
+    finally:
+        proxy.stop()
+
+
+def test_upstream_down_is_502(tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("t")
+    proxy = MetricsProxy(upstream="127.0.0.1:1", token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0)
+    proxy.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{proxy.port}/metrics", "t")
+        assert e.value.code == 502
+    finally:
+        proxy.stop()
+
+
+def test_post_rejected_405(tmp_path, upstream):
+    tok = tmp_path / "token"
+    tok.write_text("t")
+    proxy = MetricsProxy(upstream=upstream, token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0)
+    proxy.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/metrics", data=b"x", method="POST")
+        req.add_header("Authorization", "Bearer t")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 405
+    finally:
+        proxy.stop()
+
+
+def test_stalled_tls_client_does_not_block_scrapes(tmp_path, upstream):
+    """A TCP client that never sends a ClientHello must not wedge other
+    scrapes (the handshake runs on the per-connection handler thread,
+    not in accept())."""
+    import socket
+
+    crt, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", crt, "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    tok = tmp_path / "token"
+    tok.write_text("t")
+    proxy = MetricsProxy(upstream=upstream, token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0,
+                         certfile=crt, keyfile=key)
+    proxy.start()
+    stalled = socket.create_connection(("127.0.0.1", proxy.port))
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with _get(f"https://127.0.0.1:{proxy.port}/metrics", "t", ctx) as r:
+            assert r.read().decode() == EXPOSITION
+    finally:
+        stalled.close()
+        proxy.stop()
