@@ -154,8 +154,11 @@ class DataplaneSyncer:
         self._overlay: Dict[LpmKey, np.ndarray] = {}
         self._overlay_compiled = None  # (rule_width, CompiledTables) memo
 
-    #: overlay size bound: beyond this the dense side-compare starts to
-    #: cost real per-packet time, so the overlay merges into the main trie
+    #: overlay size bound: the combine costs ~9-10 ns/packet FIXED while
+    #: any overlay is active (measured v5e, size-independent 64->1024
+    #: entries — tools/profile_overlay.py), so the cap bounds memory and
+    #: compile variety, not marginal cost; overflow merges into the main
+    #: trie (paying one re-transform)
     OVERLAY_CAP = 1024
     #: only route to the overlay when the main table is trie-path scale
     #: (a dense-path main table rebuilds in milliseconds anyway)

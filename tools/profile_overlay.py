@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Overlay cost check: classify throughput at the 100K tier with a dense
+overlay of 0 / 64 / 512 / 1024 entries active (the structural-add side
+table) — validates the OVERLAY_CAP sizing."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from infw import testing
+from infw.compiler import LpmKey, compile_tables_from_content
+from infw.constants import KIND_IPV4
+from infw.kernels import jaxpath
+
+from bench import chained_throughput
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from infw.platform import enable_jax_compile_cache
+        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    rng = np.random.default_rng(2024)
+    n_entries = 100_000 if on_tpu else 2_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, ifindexes=(2, 3, 4))
+    dt = jaxpath.device_tables(tables)
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    kinds = np.asarray(batch.kind)
+    idx4 = np.nonzero(kinds == KIND_IPV4)[0]
+    db = jaxpath.device_batch(batch.take(idx4))
+    depth = jaxpath.v4_trie_depth(len(dt.trie_levels))
+    dtv4 = dt._replace(trie_levels=dt.trie_levels[:depth])
+
+    def mk_overlay(n):
+        content = {}
+        i = 0
+        while len(content) < n:
+            content[LpmKey(56, 2, bytes([203, 0, (i >> 8) & 255, i & 255])
+                           + bytes(12))] = np.array(
+                [[0] * 7, [1, 6, 443, 0, 0, 0, 1]], np.int32)
+            i += 1
+        ct = compile_tables_from_content(content, rule_width=4)
+        return jaxpath.device_tables(ct, pad=True)
+
+    results = {}
+    for n_ov in (0, 64, 512, 1024):
+        if n_ov == 0:
+            def step(t, b):
+                res, _x, _s = jaxpath.classify(t, b, use_trie=True)
+                return res
+        else:
+            ov = mk_overlay(n_ov)
+
+            def step(t, b, ov=ov):
+                res, _x, _s = jaxpath.classify_with_overlay(
+                    t, ov, b, use_trie=True)
+                return res
+
+        label = f"v4 overlay={n_ov}"
+        results[label] = chained_throughput(
+            step, dtv4, db, len(idx4), on_tpu, label)
+
+    print("\n=== summary ===", file=sys.stderr, flush=True)
+    for name, thr in results.items():
+        print(f"{name}: {thr/1e6:.1f} M pkts/s ({1e9/thr:.1f} ns/pkt)",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
