@@ -229,27 +229,42 @@ def chained_wire_throughput(dt, wire, n_packets, on_tpu, label):
     return thr
 
 
-def family_split_throughput(dt, batch, on_tpu, label):
-    """Aggregate throughput with the daemon's family steering
-    (infw/daemon.py ingest regroups chunks by family): the v4 sub-batch
-    walks only the trie levels reachable under the 32-bit cap (3 gathers),
-    the v6 sub-batch the full depth.  Combined = total packets over the
-    summed per-family batch times."""
+def family_split_throughput(dt, batch, on_tpu, label, tables=None):
+    """Aggregate throughput with the daemon's steering (infw/daemon.py
+    ingest regroups chunks): the v4 sub-batch walks only the trie levels
+    reachable under the 32-bit cap (3 gathers); v6 sub-batches further
+    split by DEPTH CLASS (jaxpath.build_depth_lut — each root slot knows
+    how many deep levels its subtree can need; measured, 52%% of bench
+    v6 packets need <=3 of the 14).  Combined = total packets over the
+    summed per-group batch times."""
     from infw.constants import KIND_IPV6
 
     kinds = np.asarray(batch.kind)
+    groups = [("v4", None, np.nonzero(kinds != KIND_IPV6)[0])]
+    idx6 = np.nonzero(kinds == KIND_IPV6)[0]
+    if tables is not None and len(idx6):
+        lut = jaxpath.build_depth_lut(tables)
+        classes = jaxpath.depth_classes(len(dt.trie_levels))
+        for d, g in jaxpath.depth_group_indices(
+            np.asarray(tables.root_lut, np.int64), lut, classes,
+            batch.ifindex, batch.ip_words, idx6,
+        ):
+            label_d = classes[-1] if d is None else d
+            groups.append((f"v6<=d{label_d}", d, g))
+    elif len(idx6):
+        groups.append(("v6", None, idx6))
+
     total_t, total_n = 0.0, 0
-    for name, idx in (
-        ("v4", np.nonzero(kinds != KIND_IPV6)[0]),
-        ("v6", np.nonzero(kinds == KIND_IPV6)[0]),
-    ):
+    for name, depth, idx in groups:
         if len(idx) == 0:
             continue
         sub = jaxpath.device_batch(batch.take(idx))
         dtab = dt
         if name == "v4":
-            depth = jaxpath.v4_trie_depth(len(dt.trie_levels))
-            dtab = dt._replace(trie_levels=dt.trie_levels[:depth])
+            d = jaxpath.v4_trie_depth(len(dt.trie_levels))
+            dtab = dt._replace(trie_levels=dt.trie_levels[:d])
+        elif depth is not None:
+            dtab = dt._replace(trie_levels=dt.trie_levels[: 1 + depth])
 
         def step(dtab, b):
             res, _xdp, _stats = jaxpath.classify(dtab, b, use_trie=True)
@@ -261,7 +276,7 @@ def family_split_throughput(dt, batch, on_tpu, label):
         total_t += len(idx) / thr
         total_n += len(idx)
     combined = total_n / total_t
-    log(f"{label}: combined family-split {combined/1e6:.2f} M classifications/s")
+    log(f"{label}: combined steered-split {combined/1e6:.2f} M classifications/s")
     return combined
 
 
@@ -324,7 +339,7 @@ def trie_tier(rng, on_tpu, *, label, metric_of, table_kw, spot_n,
     spot_check(results_of, tables, batch,
                n=spot_n if on_tpu else 2_000, label=label)
 
-    thr = family_split_throughput(dt, batch, on_tpu, label)
+    thr = family_split_throughput(dt, batch, on_tpu, label, tables=tables)
     emit(metric_of(tables), thr, "packets/s")
     return tables
 
@@ -339,7 +354,7 @@ def bench_trie_100k(rng, on_tpu):
                       ifindexes=(2, 3, 4)),
         metric_of=lambda t: (
             f"packet classifications/sec/chip @{t.num_entries // 1000}K CIDRs "
-            "(poptrie LPM walk, XLA, family-split chunks)"
+            "(poptrie LPM walk, XLA, family+depth-steered chunks)"
         ),
     )
 
@@ -553,7 +568,8 @@ def bench_adversarial_1m(rng, on_tpu):
                       group_size=16),
         metric_of=lambda t: (
             f"packet classifications/sec/chip @{t.num_entries/1e6:.0f}M-entry "
-            "adversarial overlap table (poptrie LPM walk, XLA, family-split chunks)"
+            "adversarial overlap table (poptrie LPM walk, XLA, "
+            "family+depth-steered chunks)"
         ),
     )
 

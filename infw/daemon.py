@@ -559,15 +559,46 @@ class Daemon:
         if not files:
             return processed
 
-        # ---- phase 2: family-homogeneous jobs spanning files ----
-        # v4-only jobs take the truncated trie walk (3 gathers, not 15).
+        # ---- phase 2: family- and depth-homogeneous jobs spanning files --
+        # v4-only jobs take the truncated trie walk (3 gathers, not 15);
+        # v6 jobs additionally split by the classifier's depth classes
+        # (v6_depth_groups): most v6 packets' root slots need only a few
+        # deep levels, and walk cost is linear in levels.
         jobs: deque = deque()
-        for want_v6 in (False, True):
+        depth_groups_of = getattr(clf, "v6_depth_groups", None)
+        group_keys = [(False, None)]
+        if depth_groups_of is None:
+            group_keys.append((True, None))
+        else:
+            # discover this generation's classes from the first v6 split
+            seen_depths = set()
+            per_file_v6 = {}
+            for fctx in files:
+                kinds = np.asarray(fctx["batch"].kind)
+                g = np.nonzero(kinds == KIND_IPV6)[0]
+                b = fctx["batch"]
+                groups = depth_groups_of(b.ifindex, b.ip_words, g)
+                per_file_v6[id(fctx)] = dict(
+                    (d, idx) for d, idx in groups
+                )
+                seen_depths.update(d for d, _ in groups)
+            # d is the (class, generation) pair from v6_depth_groups;
+            # shallow classes first, full depth (class None) last
+            group_keys += [(True, d) for d in sorted(
+                seen_depths,
+                key=lambda d: (d[0] is None, -1 if d[0] is None else d[0]),
+            )]
+        for want_v6, depth in group_keys:
             cur = []
             cur_n = 0
             for fctx in files:
-                kinds = np.asarray(fctx["batch"].kind)
-                g = np.nonzero((kinds == KIND_IPV6) == want_v6)[0]
+                if want_v6 and depth_groups_of is not None:
+                    g = per_file_v6[id(fctx)].get(depth)
+                    if g is None:
+                        continue
+                else:
+                    kinds = np.asarray(fctx["batch"].kind)
+                    g = np.nonzero((kinds == KIND_IPV6) == want_v6)[0]
                 pos = 0
                 while pos < len(g):
                     take = g[pos : pos + (self.ingest_chunk - cur_n)]
@@ -576,10 +607,12 @@ class Daemon:
                     cur_n += len(take)
                     pos += len(take)
                     if cur_n >= self.ingest_chunk:
-                        jobs.append({"segments": cur, "retry": False})
+                        jobs.append({"segments": cur, "retry": False,
+                                     "depth": depth})
                         cur, cur_n = [], 0
             if cur:
-                jobs.append({"segments": cur, "retry": False})
+                jobs.append({"segments": cur, "retry": False,
+                             "depth": depth})
 
         packed_ok = (
             getattr(clf, "supports_packed", None) is not None
@@ -624,7 +657,8 @@ class Daemon:
                 v4_only = all(v4 for _w, v4 in parts)
                 try:
                     return clf.classify_async_packed(
-                        wire, v4_only, apply_stats=False
+                        wire, v4_only, apply_stats=False,
+                        depth=job.get("depth"),
                     )
                 except RuntimeError:
                     # A concurrent load_tables can flip the table to
@@ -653,7 +687,8 @@ class Daemon:
             if not job["retry"]:
                 log.warning("ingest job failed (%s); retrying per file", err)
                 for f, idx in job["segments"]:
-                    jobs.append({"segments": [(f, idx)], "retry": True})
+                    jobs.append({"segments": [(f, idx)], "retry": True,
+                                 "depth": job.get("depth")})
                 return
             for f, _idx in job["segments"]:
                 if not f["failed"]:

@@ -23,7 +23,7 @@ Two LPM strategies, selected by table size:
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -364,6 +364,9 @@ def _seed_caches_forward(
         object.__setattr__(new, "_packed_rules_cache", new_packed)
         # trie untouched: the poptrie transform is identical — share it
         object.__setattr__(new, "_poptrie_cache", pop)
+        dlut = getattr(old, "_depth_lut_cache", None)
+        if dlut is not None:
+            object.__setattr__(new, "_depth_lut_cache", dlut)
         built = getattr(old, "_joined_cache", None)
         if built is not None and built != "none":
             joined_old, l0j, sorted_t, order = built
@@ -1056,6 +1059,96 @@ def jitted_classify_wire8_fused(overlay: bool, v4_only: bool = True):
     return jax.jit(f)
 
 
+def build_depth_lut(tables: CompiledTables) -> np.ndarray:
+    """(n0*65536,) int8 per-root-slot DEEP-LEVEL requirement: the number
+    of trie levels BELOW the DIR-16 root reachable under each root slot
+    — i.e. packets whose (root, top-16-bits) slot maps to value d are
+    fully classified by trie_levels[:1+d].
+
+    This is the depth-steering analogue of the v4 family split: measured
+    on the 100K bench table, 52% of v6 packets need <=3 deep levels (26%
+    need none at all) while the static walk pays all 14 — and the walk
+    cost is linear in levels (~2.45 ns/level on v5e).  The LUT is a
+    TABLE-SHAPE property: conservative under deletes (targets only
+    disappear, depth never grows), recomputed on any structural load
+    (the host-cache carry-forward only survives provably rules-only
+    edits, see _seed_caches_forward).
+
+    Memoized on the tables instance."""
+    cached = getattr(tables, "_depth_lut_cache", None)
+    if cached is not None:
+        return cached
+    levels = tables.trie_levels
+    strides = trie_level_strides(len(levels))
+    depth_next = None  # per-node depth of the NEXT level
+    for l in range(len(levels) - 1, 0, -1):
+        slots = 1 << strides[l]
+        child = levels[l].reshape(-1, slots, 2)[:, :, 0]
+        if depth_next is None:
+            d = np.ones(child.shape[0], np.int8)
+        else:
+            cd = np.where(
+                child > 0,
+                depth_next[np.clip(child, 0, len(depth_next) - 1)],
+                0,
+            )
+            d = (1 + cd.max(axis=1, initial=0)).astype(np.int8)
+        depth_next = d
+    l0 = levels[0].reshape(-1, 2)
+    if depth_next is None:
+        lut = np.zeros(l0.shape[0], np.int8)
+    else:
+        lut = np.where(
+            l0[:, 0] > 0,
+            depth_next[np.clip(l0[:, 0], 0, len(depth_next) - 1)],
+            0,
+        ).astype(np.int8)
+    try:
+        object.__setattr__(tables, "_depth_lut_cache", lut)
+    except (AttributeError, TypeError):
+        pass
+    return lut
+
+
+#: deep-level class thresholds for depth steering: each v6 chunk walks
+#: the smallest class >= its packets' LUT depth.  A fixed small set
+#: bounds the number of compiled executables.
+DEPTH_CLASS_THRESHOLDS = (0, 3, 7)
+
+
+def depth_group_indices(root_lut_np, lut, classes, ifindex, ip_words, idx):
+    """Host-side depth-class binning shared by the classifier's
+    v6_depth_groups and the bench's steered split: returns
+    [(class_or_None, positions)] partitioning ``idx``; the last class is
+    reported as None (full depth — untruncated executable).  Out-of-range
+    ifindexes bin to class 0 (they resolve to the reserved null root
+    whose subtree is empty)."""
+    ifx = np.asarray(ifindex)[idx].astype(np.int64)
+    ok = (ifx >= 0) & (ifx < len(root_lut_np))
+    root = np.where(ok, root_lut_np[np.clip(ifx, 0, len(root_lut_np) - 1)], 0)
+    nib0 = (
+        np.asarray(ip_words)[idx, 0].astype(np.uint32) >> 16
+    ).astype(np.int64)
+    e0 = root * 65536 + nib0
+    in0 = ok & (e0 >= 0) & (e0 < len(lut))
+    pd = np.where(in0, lut[np.clip(e0, 0, len(lut) - 1)], 0)
+    out = []
+    prev = -1
+    for c in classes:
+        sub = idx[np.nonzero((pd > prev) & (pd <= c))[0]]
+        prev = c
+        if len(sub):
+            out.append((None if c == classes[-1] else int(c), sub))
+    return out
+
+
+def depth_classes(n_levels: int):
+    """The usable class list for a table of ``n_levels`` trie levels:
+    thresholds below the full deep depth, plus the full depth."""
+    full = n_levels - 1
+    return tuple(t for t in DEPTH_CLASS_THRESHOLDS if t < full) + (full,)
+
+
 def v4_trie_depth(n_levels: int) -> int:
     """Number of leading trie levels whose bit boundary is within the IPv4
     packet-side cap (32 bits): entries longer than /32 can never match a
@@ -1072,7 +1165,8 @@ def v4_trie_depth(n_levels: int) -> int:
 
 
 def classify_wire(
-    tables: DeviceTables, wire: jax.Array, *, use_trie: bool, v4_only: bool = False
+    tables: DeviceTables, wire: jax.Array, *, use_trie: bool,
+    v4_only: bool = False, depth: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Wire-format forward pass: packed descriptors in, (results_u16,
     stats) out.  The D2H payload is 2B/packet — ruleId ≤ 255 always holds
@@ -1083,11 +1177,17 @@ def classify_wire(
     ``v4_only`` is the depth-specialization fast path: when the caller
     guarantees the batch holds no IPv6 packets, the trie walk is truncated
     to the levels reachable under the 32-bit cap — a /128-deep table walks
-    3 gathers instead of 15.  The truncated level tuple changes the pytree
+    3 gathers instead of 15.  ``depth`` is the v6 analogue (depth-class
+    steering, build_depth_lut): the caller guarantees every packet's root
+    slot needs at most ``depth`` DEEP levels, so the walk keeps
+    trie_levels[:1+depth].  The truncated level tuple changes the pytree
     structure, so jit compiles a separate (cheaper) executable."""
-    if v4_only and use_trie:
-        depth = v4_trie_depth(len(tables.trie_levels))
-        tables = tables._replace(trie_levels=tables.trie_levels[:depth])
+    if use_trie and v4_only:
+        d = v4_trie_depth(len(tables.trie_levels))
+        tables = tables._replace(trie_levels=tables.trie_levels[:d])
+    elif use_trie and depth is not None:
+        tables = tables._replace(
+            trie_levels=tables.trie_levels[: 1 + depth])
     res, _xdp, stats = classify(tables, unpack_wire(wire), use_trie=use_trie)
     return res.astype(jnp.uint16), stats
 
@@ -1109,9 +1209,11 @@ def check_wire_ruleids(tables: CompiledTables) -> None:
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify_wire(use_trie: bool, v4_only: bool = False):
+def jitted_classify_wire(use_trie: bool, v4_only: bool = False,
+                         depth: Optional[int] = None):
     return jax.jit(
-        functools.partial(classify_wire, use_trie=use_trie, v4_only=v4_only)
+        functools.partial(classify_wire, use_trie=use_trie,
+                          v4_only=v4_only, depth=depth)
     )
 
 
@@ -1136,10 +1238,12 @@ def split_wire_outputs(arr: np.ndarray, b: int) -> Tuple[np.ndarray, np.ndarray]
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify_wire_fused(use_trie: bool, v4_only: bool = False):
+def jitted_classify_wire_fused(use_trie: bool, v4_only: bool = False,
+                               depth: Optional[int] = None):
     def f(tables: DeviceTables, wire: jax.Array) -> jax.Array:
         return fuse_wire_outputs(
-            *classify_wire(tables, wire, use_trie=use_trie, v4_only=v4_only)
+            *classify_wire(tables, wire, use_trie=use_trie,
+                           v4_only=v4_only, depth=depth)
         )
 
     return jax.jit(f)
@@ -1152,12 +1256,16 @@ def classify_wire_overlay(
     *,
     use_trie: bool,
     v4_only: bool = False,
+    depth: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """classify_wire with the overlay combine (see classify_with_overlay);
-    the v4 depth truncation applies to the main trie only."""
-    if v4_only and use_trie:
-        depth = v4_trie_depth(len(tables.trie_levels))
-        tables = tables._replace(trie_levels=tables.trie_levels[:depth])
+    the v4/depth truncation applies to the main trie only."""
+    if use_trie and v4_only:
+        d = v4_trie_depth(len(tables.trie_levels))
+        tables = tables._replace(trie_levels=tables.trie_levels[:d])
+    elif use_trie and depth is not None:
+        tables = tables._replace(
+            trie_levels=tables.trie_levels[: 1 + depth])
     res, _xdp, stats = classify_with_overlay(
         tables, overlay, unpack_wire(wire), use_trie=use_trie
     )
@@ -1165,11 +1273,13 @@ def classify_wire_overlay(
 
 
 @functools.lru_cache(maxsize=None)
-def jitted_classify_wire_overlay_fused(use_trie: bool, v4_only: bool = False):
+def jitted_classify_wire_overlay_fused(use_trie: bool, v4_only: bool = False,
+                                       depth: Optional[int] = None):
     def f(tables: DeviceTables, overlay: DeviceTables, wire: jax.Array):
         return fuse_wire_outputs(
             *classify_wire_overlay(
-                tables, overlay, wire, use_trie=use_trie, v4_only=v4_only
+                tables, overlay, wire, use_trie=use_trie, v4_only=v4_only,
+                depth=depth,
             )
         )
 
