@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench native entry-check dryrun-multichip clean
+.PHONY: test test-fast bench native entry-check dryrun-multichip \
+	spill-read clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -25,6 +26,12 @@ native:
 entry-check:
 	$(PY) -c "import __graft_entry__ as g, jax; fn, args = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*args)); print('entry OK')"
+
+# Decode a binary deny-event spill into reference-format event lines
+# (the operator-facing consumer of the sustained-rate event path).
+# Usage: make spill-read SPILL=path/to/deny-events.bin [ARGS=--follow]
+spill-read:
+	$(PY) tools/spill_read.py $(SPILL) $(ARGS)
 
 # Full distributed step on a virtual 8-device CPU mesh.
 dryrun-multichip:

@@ -9,21 +9,28 @@ same series as previous rounds):
 
   1. config 3: 100K-CIDR LPM (poptrie walk, XLA) — the
      scale tier of the reference's LPM trie map
-     (bpf/ingress_node_firewall_kernel.c:218-219, map :43-57).
+     (bpf/ingress_node_firewall_kernel.c:218-219, map :43-57) — with
+     the per-depth-class split and standalone FULL-DEPTH v6 lines
+     (XLA walk vs the fused Pallas deep-walk kernel, pallas_walk.py).
   2. config 5a: 10M-packet frames-file replay through the daemon's
      pipelined ingest (read + vectorized parse + classify + verdict
      sidecar + stats/events), sustained packets/s, min of 3 passes.
-  3. config 5b: 1M-entry adversarial overlap table classified on chip.
+  3. config 5b: 1M-entry adversarial overlap table classified on chip,
+     with the same per-class split + standalone deep-class lines.
   4. config 4: 8 interfaces x per-iface rulesets, mixed-ifindex batch.
-  5. 1-key incremental device update latency.
-  6. wire-path p50 verdict latency (pack -> H2D -> classify -> 2B/packet
+  5. BASELINE configs 1 (single-CIDR/single-rule, CPU reference C++)
+     and 2 (1K mixed-family CIDRs x 16 mixed-protocol rules).
+  6. 1-key incremental device update latency: rules edit, CIDR add
+     (overlay), structural DELETE, and the overlay-overflow merge spike.
+  7. wire-path p50 verdict latency (pack -> H2D -> classify -> 2B/packet
      readback), batch sweep 32..4096 incl. pinned-device-input mode.
-  7. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
+  8. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
      dense kernel.
 
 After all tiers, every recorded metric line is RE-EMITTED in one final
-block (headline last) so drivers that keep only the output tail still
-record the full set.
+block, then ONE compact single-line JSON with the complete metric set
+(emit_compact_record) lands immediately before the headline so even a
+tail capture of a few lines holds every ladder metric.
 
 Timing methodology (the device is reached through a tunnel whose dispatch
 layer memoizes repeated identical executions and whose block_until_ready
@@ -88,6 +95,29 @@ def re_emit_recorded():
     log(f"re-emitting {len(_RECORDED)} recorded metric lines")
     for line in _RECORDED:
         print(line, flush=True)
+
+
+def emit_compact_record(headline_metric=None, headline_value=None):
+    """ONE compact single-line JSON holding every ladder metric — the
+    truncation-proof record (round-5 verdict weak #6: the re-emit block
+    outgrew the driver's tail budget and BENCH_r05.json lost the
+    trie/replay/8-iface lines mid-block; a single line survives any tail
+    capture that keeps its last few lines).  Printed immediately before
+    the headline so both always land inside the tail window."""
+    items = []
+    for line in _RECORDED:
+        d = json.loads(line)
+        items.append({"metric": d["metric"], "value": d["value"],
+                      "unit": d["unit"]})
+    if headline_metric is not None:
+        items.append({
+            "metric": headline_metric,
+            "value": round(headline_value,
+                           3 if headline_value < 1e3 else 1),
+            "unit": "packets/s",
+        })
+    print(json.dumps({"bench_record": items}, separators=(",", ":")),
+          flush=True)
 
 
 def fail(reason):
@@ -234,27 +264,41 @@ def family_split_throughput(dt, batch, on_tpu, label, tables=None):
     ingest regroups chunks): the v4 sub-batch walks only the trie levels
     reachable under the 32-bit cap (3 gathers); v6 sub-batches further
     split by DEPTH CLASS (jaxpath.build_depth_lut — each root slot knows
-    how many deep levels its subtree can need; measured, 52%% of bench
-    v6 packets need <=3 of the 14).  Combined = total packets over the
-    summed per-group batch times."""
+    how many deep levels its subtree can need), with thresholds TUNED to
+    the table's depth histogram (jaxpath.tune_depth_classes — the 1M
+    adversarial histogram differs from the 100K one, round-5 ask #3).
+    Combined = total packets over the summed per-group batch times.
+
+    Returns (combined, per_group) where per_group rows are
+    (name, depth_class_or_None, positions, throughput) — the caller
+    emits the per-class ladder split and the standalone full-depth
+    line from them."""
     from infw.constants import KIND_IPV6
 
     kinds = np.asarray(batch.kind)
     groups = [("v4", None, np.nonzero(kinds != KIND_IPV6)[0])]
     idx6 = np.nonzero(kinds == KIND_IPV6)[0]
+    full_depth_names = set()
     if tables is not None and len(idx6):
         lut = jaxpath.build_depth_lut(tables)
-        classes = jaxpath.depth_classes(len(dt.trie_levels))
+        classes = jaxpath.tune_depth_classes(tables)
+        hist = jaxpath.depth_class_histogram(tables)
+        log(f"{label}: depth histogram (slots per deep-level requirement) "
+            f"{list(hist)}; tuned classes {classes}")
         for d, g in jaxpath.depth_group_indices(
             np.asarray(tables.root_lut, np.int64), lut, classes,
             batch.ifindex, batch.ip_words, idx6,
         ):
             label_d = classes[-1] if d is None else d
-            groups.append((f"v6<=d{label_d}", d, g))
+            name = f"v6<=d{label_d}"
+            if d is None:
+                full_depth_names.add(name)
+            groups.append((name, d, g))
     elif len(idx6):
         groups.append(("v6", None, idx6))
 
     total_t, total_n = 0.0, 0
+    per_group = []
     for name, depth, idx in groups:
         if len(idx) == 0:
             continue
@@ -275,9 +319,15 @@ def family_split_throughput(dt, batch, on_tpu, label, tables=None):
         )
         total_t += len(idx) / thr
         total_n += len(idx)
+        per_group.append((name, depth, idx, thr))
     combined = total_n / total_t
+    split = ", ".join(
+        f"{name}: {len(idx)} pkts @ {thr/1e6:.2f} M/s"
+        for name, _d, idx, thr in per_group
+    )
+    log(f"{label}: per-class split — {split}")
     log(f"{label}: combined steered-split {combined/1e6:.2f} M classifications/s")
-    return combined
+    return combined, per_group
 
 
 def spot_check(fn_results, tables, batch, n=2000, label=""):
@@ -312,11 +362,17 @@ def spot_check(fn_results, tables, batch, n=2000, label=""):
 
 
 def trie_tier(rng, on_tpu, *, label, metric_of, table_kw, spot_n,
-              batch_check=None):
+              batch_check=None, deep_lines=False):
     """One trie-path tier: build table -> upload -> compile wire path ->
     spot-check vs oracle -> family-split chained throughput -> emit.
     Shared by the 100K-CIDR, 1M-adversarial and 8-iface tiers so a
-    methodology fix lands in all of them at once."""
+    methodology fix lands in all of them at once.
+
+    ``deep_lines=True`` additionally emits the standalone FULL-DEPTH v6
+    class as its own ladder lines — the XLA walk and the fused Pallas
+    deep-walk kernel (kernels.pallas_walk) — since that class is the
+    throughput floor every deep-heavy adversarial mix converges to
+    (round-5 verdict asks #2/#3)."""
     t0 = time.perf_counter()
     tables = testing.random_tables_fast(rng, **table_kw)
     log(f"{label}: table build {time.perf_counter()-t0:.1f}s "
@@ -339,9 +395,83 @@ def trie_tier(rng, on_tpu, *, label, metric_of, table_kw, spot_n,
     spot_check(results_of, tables, batch,
                n=spot_n if on_tpu else 2_000, label=label)
 
-    thr = family_split_throughput(dt, batch, on_tpu, label, tables=tables)
+    thr, per_group = family_split_throughput(
+        dt, batch, on_tpu, label, tables=tables
+    )
     emit(metric_of(tables), thr, "packets/s")
+    if deep_lines:
+        try:
+            deep_class_lines(tables, batch, per_group, on_tpu, label)
+        except Exception as e:
+            log(f"{label}: deep-class lines FAILED: {e}")
     return tables
+
+
+def deep_class_lines(tables, batch, per_group, on_tpu, label):
+    """Standalone ladder lines for the full-depth v6 class: the XLA walk
+    rate (from the steered split, no re-measure) and the fused Pallas
+    deep-walk kernel on the SAME packets, with the extraction memory
+    math in the log (round-5 weak #4: memory headroom at 1M was
+    undiscussed)."""
+    from infw.kernels import pallas_walk
+
+    tier = (f"{tables.num_entries // 1000}K"
+            if tables.num_entries < 1_000_000
+            else f"{tables.num_entries/1e6:.0f}M")
+    deep = [(idx, thr) for name, d, idx, thr in per_group
+            if d is None and name.startswith("v6")]
+    if not deep:
+        log(f"{label}: no full-depth v6 packets in the mix; skipping "
+            "deep-class lines")
+        return
+    deep_idx, thr_xla = deep[0]
+    emit(
+        f"standalone full-depth v6 class @{tier} entries "
+        f"({len(deep_idx)} pkts of the adversarial mix, XLA poptrie walk)",
+        thr_xla, "packets/s",
+    )
+
+    classes = jaxpath.tune_depth_classes(tables)
+    min_depth = classes[-2] if len(classes) >= 2 else None
+    t0 = time.perf_counter()
+    built = pallas_walk.build_walk_tables_meta(tables, min_depth=min_depth)
+    if built is None:
+        log(f"{label}: fused deep walk unavailable for this table "
+            f"(VMEM gate {pallas_walk.DEFAULT_VMEM_BUDGET/1e6:.0f} MB or "
+            "layout); the XLA walk line above stands alone")
+        return
+    wt, meta = built
+    jdesc = (f"joined planes {wt.joined.shape[0]} rows x "
+             f"{wt.joined.shape[1]} B VMEM-resident"
+             if meta["tail"] == "fused" else
+             f"positions tail ({wt.joined_u16.shape[0]} u16 rows in HBM, "
+             "one XLA fat-row gather)")
+    log(f"{label}: fused walk tables built {time.perf_counter()-t0:.1f}s "
+        f"(extraction threshold >{min_depth} deep levels, "
+        f"tail={meta['tail']}): "
+        f"levels {[l.shape[0] for l in wt.levels]} rows, {jdesc}, "
+        f"{len(meta['tidx_sorted'])} resident targets, "
+        f"VMEM {meta['vmem_bytes']/1e6:.2f} MB of "
+        f"{pallas_walk.DEFAULT_VMEM_BUDGET/1e6:.0f} MB budget")
+    sub = jaxpath.device_batch(batch.take(deep_idx))
+    interpret = not on_tpu
+
+    def step(wtab, b):
+        res, _xdp, _stats = pallas_walk.classify_walk(
+            wtab, b, interpret=interpret
+        )
+        return res
+
+    thr_fused = chained_throughput(
+        step, wt, sub, len(deep_idx), on_tpu, f"{label}/v6-deep-fused"
+    )
+    emit(
+        f"standalone full-depth v6 class @{tier} entries "
+        f"(fused Pallas deep-walk kernel, VMEM-resident extracted tail, "
+        f"{meta['tail']} rules tail; "
+        f"XLA walk {thr_xla/1e6:.1f} M/s on the same packets)",
+        thr_fused, "packets/s",
+    )
 
 
 # --- config 3: 100K-CIDR trie --------------------------------------------
@@ -349,7 +479,7 @@ def trie_tier(rng, on_tpu, *, label, metric_of, table_kw, spot_n,
 
 def bench_trie_100k(rng, on_tpu):
     return trie_tier(
-        rng, on_tpu, label="trie100k", spot_n=100_000,
+        rng, on_tpu, label="trie100k", spot_n=100_000, deep_lines=True,
         table_kw=dict(n_entries=100_000 if on_tpu else 2_000, width=8,
                       ifindexes=(2, 3, 4)),
         metric_of=lambda t: (
@@ -563,7 +693,7 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
 
 def bench_adversarial_1m(rng, on_tpu):
     trie_tier(
-        rng, on_tpu, label="adv1m", spot_n=100_000,
+        rng, on_tpu, label="adv1m", spot_n=100_000, deep_lines=True,
         table_kw=dict(n_entries=1_000_000 if on_tpu else 10_000, width=4,
                       group_size=16),
         metric_of=lambda t: (
@@ -687,6 +817,53 @@ def bench_incremental_update(rng, on_tpu, n_entries=None, width=8,
         f"{len(add_lats)} (structural overlay, main trie untouched; "
         f"full reload {t_full:.1f}s)",
         best_add * 1e3, "ms", vs_baseline=t_full / best_add,
+    )
+
+    # 1-key structural DELETE (round-5 weak #5: implemented but
+    # unmeasured): tombstone + node-local repush in the compiler
+    # (compiler.py purgeKeys analogue), then the diff-scatter device
+    # patch — the Map.Delete analogue (loader.go:633-647).  Unlike the
+    # CIDR add there is no overlay shortcut: the trie itself changes, so
+    # this measures the real structural-edit device path.
+    del_lats = []
+    for i in range(5):
+        key = keys[-(i + 1)]  # distinct from the rule-edit keys above
+        t0 = time.perf_counter()
+        it.apply({}, deletes=[key])
+        clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+        del_lats.append(time.perf_counter() - t0)
+        mode, n_rows = clf._last_load
+        log(f"delete@{tier} {i}: {del_lats[-1]*1e3:.0f} ms mode={mode} "
+            f"rows={n_rows}")
+    best_del = min(del_lats)
+    log(f"delete@{tier}: best {best_del*1e3:.0f} ms of "
+        f"{sorted(int(l*1e3) for l in del_lats)}")
+    emit(
+        f"1-key structural delete to device @{tier} entries, best of "
+        f"{len(del_lats)} (tombstone + node-local repush + diff-scatter "
+        f"patch; full reload {t_full:.1f}s)",
+        best_del * 1e3, "ms", vs_baseline=t_full / best_del,
+    )
+
+    # Overlay-overflow merge spike (round-5 weak #5): the amortized slow
+    # path a long-running daemon pays when the dense side-table outgrows
+    # OVERLAY_CAP and its accumulated keys merge into the main trie
+    # (syncer.py overflow branch) — measured as the structural apply of
+    # every overlay key plus the device load, in one timed step.
+    t0 = time.perf_counter()
+    it.apply(dict(overlay))
+    clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    t_merge = time.perf_counter() - t0
+    mode, n_rows = clf._last_load
+    log(f"overlay-merge@{tier}: {t_merge*1e3:.0f} ms mode={mode} "
+        f"rows={n_rows} ({len(overlay)} overlay keys into main)")
+    emit(
+        f"overlay-overflow merge into main table @{tier} entries "
+        f"({len(overlay)} accumulated structural adds, {mode} load; "
+        f"full reload {t_full:.1f}s)",
+        t_merge * 1e3, "ms", vs_baseline=t_full / t_merge,
     )
     clf.close()
 
@@ -846,6 +1023,87 @@ def bench_device_latency(tables, batch, on_tpu):
         )
 
 
+# --- BASELINE configs 1 and 2 (round-5 missing #2) -------------------------
+
+
+def bench_baseline_config1(rng, on_tpu):
+    """BASELINE config 1: the reference sample posture — one source
+    CIDR, one TCP port-range rule, one interface — classified by the CPU
+    reference backend (the native C++ classifier, the framework's
+    differential oracle).  This is the native-component baseline the
+    ladder's TPU tiers are compared against; reference analogue
+    /root/reference/config/samples/."""
+    from infw.backend.cpu_ref import CpuRefClassifier
+    from infw.compiler import LpmKey, compile_tables_from_content
+
+    rows = np.zeros((2, 7), np.int32)
+    rows[1] = [1, 6, 800, 900, 0, 0, 1]  # ruleId 1, TCP 800-900, DENY
+    content = {
+        LpmKey(prefix_len=24 + 32, ingress_ifindex=2,
+               ip_data=bytes([192, 168, 10, 0]) + bytes(12)): rows
+    }
+    tables = compile_tables_from_content(content, rule_width=2)
+    clf = CpuRefClassifier()
+    clf.load_tables(tables)
+    n = 2**20 if on_tpu else 2**16
+    batch = testing.random_batch_fast(rng, tables, n_packets=n)
+
+    def results_of(sub):
+        return clf.classify(sub, apply_stats=False).results
+
+    spot_check(results_of, tables, batch, label="baseline-config1")
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        clf.classify(batch, apply_stats=False)
+        best = min(best, time.perf_counter() - t0)
+    thr = n / best
+    log(f"baseline-config1: {thr/1e6:.2f} M pkts/s (native C++ reference, "
+        f"best of 3, {n} packets)")
+    emit(
+        "BASELINE config 1: single CIDR x single TCP port-range rule, "
+        "CPU reference classifier (native C++)",
+        thr, "packets/s",
+    )
+
+
+def bench_baseline_config2(rng, on_tpu):
+    """BASELINE config 2: 1K mixed-family (IPv4+IPv6) source CIDRs x 16
+    ordered mixed TCP/UDP/ICMP rules — measured explicitly instead of
+    being implied by the 1000x100 dense headline (round-5 missing #2)."""
+    tables = testing.random_tables_fast(
+        rng, n_entries=1000, width=16, v6_fraction=0.5, ifindexes=(2, 3)
+    )
+    n_packets = 2**20 if on_tpu else 2**14
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    pt = jax.tree.map(jax.device_put, pallas_dense.build_pallas_tables(tables))
+    db = jaxpath.device_batch(batch)
+    interpret = not on_tpu
+    block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
+    fn = pallas_dense.jitted_classify_pallas(interpret, block_b)
+    np.asarray(fn(pt, db)[0])  # compile
+
+    def results_of(sub):
+        return np.asarray(fn(pt, jaxpath.device_batch(sub))[0])
+
+    spot_check(results_of, tables, batch, label="baseline-config2")
+
+    def step(ptab, b):
+        res, _xdp, _stats = pallas_dense.classify_pallas(
+            ptab, b, interpret=interpret, block_b=block_b
+        )
+        return res
+
+    thr = chained_throughput(
+        step, pt, db, n_packets, on_tpu, "baseline-config2"
+    )
+    emit(
+        "BASELINE config 2: 1K mixed-family CIDRs x 16 ordered "
+        "TCP/UDP/ICMP rules (Pallas int8 dense)",
+        thr, "packets/s",
+    )
+
+
 # --- config 2 headline -----------------------------------------------------
 
 
@@ -917,6 +1175,14 @@ def main():
     except Exception as e:
         log(f"8iface FAILED: {e}")
     try:
+        bench_baseline_config1(rng, on_tpu)
+    except Exception as e:
+        log(f"baseline config 1 FAILED: {e}")
+    try:
+        bench_baseline_config2(rng, on_tpu)
+    except Exception as e:
+        log(f"baseline config 2 FAILED: {e}")
+    try:
         bench_incremental_update(rng, on_tpu)
     except Exception as e:
         log(f"incremental update FAILED: {e}")
@@ -945,15 +1211,18 @@ def main():
         log(f"device latency FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
-    # contiguous block, then the headline LAST (drivers that parse the
-    # final line keep recording the same series as previous rounds; a
-    # tail-capture driver now gets the full set either way).
-    re_emit_recorded()
-    emit(
+    # contiguous block, then ONE compact single-line JSON holding the
+    # complete metric set (headline included) immediately before the
+    # headline — a tail-limited driver capture that keeps only its last
+    # lines can never again lose the trie/replay/8-iface lines
+    # (round-5 weak #6: the multi-line re-emit block outgrew the tail).
+    headline_metric = (
         "packet classifications/sec/chip @100K rules "
-        "(1000 CIDRs x 100 rules, Pallas int8 dense)",
-        thr, "packets/s", record=False,
+        "(1000 CIDRs x 100 rules, Pallas int8 dense)"
     )
+    re_emit_recorded()
+    emit_compact_record(headline_metric, thr)
+    emit(headline_metric, thr, "packets/s", record=False)
     return 0
 
 

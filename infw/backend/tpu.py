@@ -22,6 +22,7 @@ the XLA trie path (100K+ CIDRs).  Design points:
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -30,7 +31,7 @@ import numpy as np
 
 from ..compiler import CompiledTables
 from ..constants import KIND_IPV6
-from ..kernels import jaxpath, pallas_dense
+from ..kernels import jaxpath, pallas_dense, pallas_walk
 from ..packets import PacketBatch, narrow_wire, wire8
 from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 
@@ -48,6 +49,7 @@ class TpuClassifier:
         dense_limit: int = pallas_dense.MAX_DENSE_TARGETS,
         force_path: Optional[str] = None,  # "dense" | "trie" | None (auto)
         interpret: Optional[bool] = None,
+        fused_deep: Optional[bool] = None,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -55,12 +57,34 @@ class TpuClassifier:
         self._interpret = (
             interpret if interpret is not None else pallas_dense.default_interpret()
         )
+        # Fused deep-walk dispatch (kernels.pallas_walk): the
+        # depth-steered FULL-DEPTH v6 class — the throughput floor every
+        # adversarial mix converges to — runs as one Pallas grid pass
+        # with the deep tail VMEM-resident instead of one XLA HBM gather
+        # per level.  Defaults on for real TPU; interpret mode keeps the
+        # (faster-on-CPU) XLA walk unless explicitly enabled — tests opt
+        # in with fused_deep=True.  Precedence: explicit constructor arg
+        # (e.g. the daemon's --no-fused-deep) > INFW_FUSED_DEEP env >
+        # backend default.
+        if fused_deep is None:
+            env = os.environ.get("INFW_FUSED_DEEP", "")
+            if env:
+                fused_deep = env not in ("0", "false", "no")
+        self._fused_deep = (
+            fused_deep if fused_deep is not None else not self._interpret
+        )
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         self._tables: Optional[CompiledTables] = None
-        self._active = None  # (path, dev tables, block_b|None, wide_rids, overlay dev|None)
+        # (path, dev tables, block_b|None, wide_rids, overlay dev|None,
+        #  fused walk dev|None)
+        self._active = None
         self._last_load = None  # ("patch"|"full", rows) — introspection/tests
         self._ov_cache = None   # (overlay CompiledTables, device copy)
+        # host meta of the resident fused-walk tables: (tidx_sorted,
+        # min_depth) — the rules-only-edit staleness check (see
+        # load_tables); guarded by _lock alongside _active
+        self._walk_meta = None
         # depth-class steering state (trie path): (root_lut np, depth
         # LUT np, class tuple, generation); None off the trie path.
         # The generation token guards callers that grouped against an
@@ -154,16 +178,39 @@ class TpuClassifier:
                 jaxpath.warm_patch_scatters(dev, self._device)
             block_b = None
         steer_parts = None
+        walk_dev = None
+        walk_meta = None
+        defer_walk = False
         if path == "trie":
             # per-root-slot deep-level requirement (conservative across
             # rules-only patches via the cache carry-forward; recomputed
-            # from the snapshot's slot arrays on structural loads)
+            # from the snapshot's slot arrays on structural loads);
+            # thresholds are TUNED to this table's depth histogram
+            # (jaxpath.tune_depth_classes) rather than the static set
             lut = jaxpath.build_depth_lut(tables)
+            classes = jaxpath.tune_depth_classes(tables)
             steer_parts = (
                 np.asarray(tables.root_lut, np.int64),
                 lut,
-                jaxpath.depth_classes(len(tables.trie_levels)),
+                classes,
             )
+            if self._fused_deep and not wide_rids:
+                structural_patch = dirty_hint is not None and any(
+                    len(h) for h in dirty_hint.get("levels", ())
+                )
+                if structural_patch:
+                    # A structural incremental edit (CIDR delete, overlay
+                    # merge) must stay at diff-scatter-patch latency: the
+                    # full walk rebuild (depth LUT + extraction + byte
+                    # packing + upload) runs in the BACKGROUND and
+                    # installs when ready; until then the full-depth
+                    # class takes the XLA walk — the fallback contract,
+                    # never a wrong verdict.
+                    defer_walk = True
+                else:
+                    walk_dev, walk_meta = self._build_walk(
+                        tables, classes, dirty_hint
+                    )
         ov_dev = None
         if overlay is not None and overlay.num_entries > 0:
             if path != "trie" or wide_rids:
@@ -188,7 +235,8 @@ class TpuClassifier:
                     self._ov_cache = (overlay, ov_dev)
         with self._lock:
             self._tables = tables
-            self._active = (path, dev, block_b, wide_rids, ov_dev)
+            self._active = (path, dev, block_b, wide_rids, ov_dev, walk_dev)
+            self._walk_meta = walk_meta
             # the generation token is assigned INSIDE the install lock:
             # two concurrent loads must never install different tables
             # under one token, or a stale grouping would pass the
@@ -198,6 +246,87 @@ class TpuClassifier:
                 steer_parts + (self._depth_gen,)
                 if steer_parts is not None else None
             )
+        if defer_walk:
+            self._spawn_walk_rebuild(tables, steer_parts[2])
+
+    def _build_walk(self, tables: CompiledTables, classes, dirty_hint):
+        """Fused-walk tables for the full-depth steering class.
+
+        The joined byte planes bake RULE BYTES into the resident layout,
+        so a rules-only edit whose dirty targets intersect the walk's
+        kept tidx set must rebuild; a non-intersecting edit (the common
+        1-key case at scale — the deep tail is a small extracted subset)
+        carries the resident walk forward untouched.  Any build failure
+        degrades to the XLA walk, never to a refusal."""
+        min_depth = classes[-2] if len(classes) >= 2 else None
+        rules_only = dirty_hint is not None and all(
+            len(h) == 0 for h in dirty_hint.get("levels", [np.zeros(1)])
+        )
+        with self._lock:
+            prev_active, prev_meta = self._active, self._walk_meta
+        if (
+            rules_only
+            and prev_meta is not None
+            and prev_active is not None
+            and len(prev_active) > 5
+            and prev_active[5] is not None
+            and prev_meta["min_depth"] == min_depth
+        ):
+            dirty = np.unique(np.asarray(dirty_hint.get("dense", ()), np.int64))
+            tidx_sorted = prev_meta["tidx_sorted"]
+            if not bool(np.isin(dirty, tidx_sorted).any()):
+                return prev_active[5], prev_meta
+            # dirty targets ARE resident: rewrite exactly their joined
+            # byte-plane rows on device (kilobytes) — the trie is
+            # untouched, so levels/l0 carry over
+            try:
+                patched = pallas_walk.patch_walk_joined(
+                    prev_active[5], prev_meta, tables, dirty, self._device
+                )
+            except Exception:
+                patched = None
+            if patched is not None:
+                return patched, prev_meta
+        try:
+            built = pallas_walk.build_walk_tables_meta(
+                tables, min_depth=min_depth, device=self._device
+            )
+        except Exception:
+            built = None
+        if built is None:
+            return None, None
+        return built
+
+    def _spawn_walk_rebuild(self, tables: CompiledTables, classes) -> None:
+        """Background fused-walk rebuild after a structural edit: build
+        off-thread, install under the lock ONLY if this table generation
+        is still resident (a newer load supersedes the result — its own
+        walk build wins).  Classify dispatches read ``_active`` under the
+        lock, so they pick the walk up at the next chunk."""
+        min_depth = classes[-2] if len(classes) >= 2 else None
+
+        def work():
+            try:
+                built = pallas_walk.build_walk_tables_meta(
+                    tables, min_depth=min_depth, device=self._device
+                )
+            except Exception:
+                built = None
+            if built is None:
+                return
+            wt, meta = built
+            with self._lock:
+                if (
+                    self._tables is tables
+                    and self._active is not None
+                    and self._active[0] == "trie"
+                ):
+                    self._active = self._active[:5] + (wt,)
+                    self._walk_meta = meta
+
+        threading.Thread(
+            target=work, name="infw-walk-rebuild", daemon=True
+        ).start()
 
     # -- classify -----------------------------------------------------------
 
@@ -218,7 +347,7 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev, block_b, wide_rids, ov_dev = self._active
+            path, dev, block_b, wide_rids, ov_dev, _walk = self._active
         if wide_rids:
             return self._classify_async_wide(dev, batch, apply_stats)
         # Packed wire format: 24B/packet H2D (12B for v4-compactable
@@ -280,27 +409,34 @@ class TpuClassifier:
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
-            path, dev, block_b, wide_rids, ov_dev = self._active
+            path, dev, block_b, wide_rids, ov_dev, walk_dev = self._active
         if wide_rids:
             raise RuntimeError(
                 "wide-ruleId tables need the full-batch path (supports_packed)"
             )
         kind = (wire_np[:, 0] & 3).astype(np.int32)
         d = None
+        use_walk = None
         if depth is not None:
             dclass, gen = depth
             with self._lock:
                 cur_gen = self._depth_steer[3] if self._depth_steer else -1
             if dclass is not None and gen == cur_gen:
                 d = int(dclass)
+            elif dclass is None and gen == cur_gen:
+                # the declared FULL-DEPTH class of the current
+                # generation: eligible for the fused Pallas deep walk
+                # (its extraction threshold came from the same class
+                # list this grouping used — the gen token proves it)
+                use_walk = walk_dev
         return self._dispatch_wire(
             path, dev, block_b, wire_np, v4_only, kind, apply_stats,
-            ov_dev=ov_dev, depth=d,
+            ov_dev=ov_dev, depth=d, walk_dev=use_walk,
         )
 
     def _dispatch_wire(
         self, path, dev, block_b, wire_np, v4_only, kind, apply_stats,
-        ov_dev=None, depth=None,
+        ov_dev=None, depth=None, walk_dev=None,
     ) -> PendingClassify:
         n = wire_np.shape[0]
         if path == "trie" and wire_np.shape[1] == 4:
@@ -331,6 +467,16 @@ class TpuClassifier:
             fused = pallas_dense.jitted_classify_pallas_wire_fused(
                 self._interpret, block_b
             )(dev, wire)
+        elif walk_dev is not None and ov_dev is None:
+            # Fused deep walk: the whole v6 descent (level walk +
+            # popcount-rank child step + joined rules tail) in one
+            # Pallas grid pass with the extracted deep tail
+            # VMEM-resident — no per-level HBM gather excursions.  The
+            # overlay combine needs the XLA walk's score plumbing, so
+            # overlay generations keep the XLA path for this class.
+            fused = pallas_walk.jitted_classify_walk_wire_fused(
+                self._interpret
+            )(walk_dev, wire)
         elif ov_dev is not None:
             fused = jaxpath.jitted_classify_wire_overlay_fused(
                 True, v4_only, depth

@@ -220,15 +220,23 @@ def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
     return out
 
 
-def make_classifier_factory(backend: str):
+def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None):
+    """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
+    dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
+    the backend default (on for real TPU hardware, off in interpret
+    mode).  The CPU reference backend ignores it."""
     if backend == "cpu":
         from .backend.cpu_ref import CpuRefClassifier
 
         return CpuRefClassifier
     if backend == "tpu":
+        import functools
+
         from .backend.tpu import TpuClassifier
 
-        return TpuClassifier
+        if fused_deep is None:
+            return TpuClassifier
+        return functools.partial(TpuClassifier, fused_deep=fused_deep)
     raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
 
 
@@ -254,6 +262,7 @@ class Daemon:
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
         max_tick_packets: int = DEFAULT_MAX_TICK_PACKETS,
         event_ring_size: int = 1 << 21,
+        fused_deep: Optional[bool] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -286,7 +295,9 @@ class Daemon:
         self.stats = Statistics(poll_period_s=poll_period_s)
         self.stats.register(self.metrics_registry)
         self.syncer = DataplaneSyncer(
-            classifier_factory=make_classifier_factory(backend),
+            classifier_factory=make_classifier_factory(
+                backend, fused_deep=fused_deep
+            ),
             registry=self.registry,
             stats_poller=self.stats,
             checkpoint_dir=os.path.join(state_dir, "checkpoint"),
@@ -842,6 +853,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "drops new records and counts them as lost "
                         "samples, like the kernel perf ring)")
     p.add_argument(
+        "--no-fused-deep", action="store_true",
+        default=os.environ.get("INFW_FUSED_DEEP", "") in ("0", "false", "no"),
+        help="disable the fused Pallas deep-walk dispatch for full-depth "
+             "v6 chunks (kernels.pallas_walk); the XLA per-level walk "
+             "serves them instead",
+    )
+    p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
         help="unixgram socket to ship deny-event lines to (the events "
@@ -879,6 +897,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         event_ring_size=args.event_ring_size,
         pipeline_depth=args.pipeline_depth,
         events_socket=args.events_socket or None,
+        fused_deep=False if args.no_fused_deep else None,
     )
     stop = threading.Event()
 

@@ -266,10 +266,8 @@ def _packed_rules_flat(tables: CompiledTables):
     return rules
 
 
-def build_joined(tables: CompiledTables):
-    """Joined target rows for the one-gather trie tail (see
-    DeviceTables.joined): returns (joined, l0_joined, sorted_t, order)
-    or None when the duplication gate trips.
+def joined_layout(tables: CompiledTables):
+    """UNGATED joined-targets layout: (joined, l0_joined, t_vals).
 
     - ``joined`` row p (p < len(targets)) corresponds to targets
       position p: [tidx+1 (2 x u16), mask_len, packed rules] — so the
@@ -277,44 +275,72 @@ def build_joined(tables: CompiledTables):
       targets are appended once per unique root tidx.
     - ``l0_joined`` is levels[0] with the target column rewritten from
       tidx+1 to the appended joined index.
-    - ``(sorted_t, order)``: positions grouped by tidx+1 (argsort of the
-      row->tidx+1 map) so a rules-only edit can find and patch exactly
-      the joined rows of the dirty entries (searchsorted, no scan).
+    - ``t_vals`` maps joined row -> tidx+1 (0 = sentinel/padding).
 
-    Memoized on the tables instance alongside the poptrie cache."""
-    cached = getattr(tables, "_joined_cache", None)
+    build_joined applies the device-memory duplication gate on top; the
+    fused Pallas walk (kernels.pallas_walk) consumes this directly — its
+    gate is the VMEM budget after deep-tail extraction, not HBM
+    duplication.  Memoized per tables instance (both consumers run on
+    every full load)."""
+    cached = getattr(tables, "_joined_layout_cache", None)
     if cached is not None:
-        return None if cached == "none" else cached
+        return cached
     levels, targets = build_poptrie(tables)
     rules_flat = _packed_rules_flat(tables)
-    T = rules_flat.shape[0]
     l0 = levels[0]
     rt = l0[:, 1]
     uniq = np.unique(rt[rt > 0])  # root target values (tidx+1)
     t_vals = np.concatenate([targets.astype(np.int64), uniq.astype(np.int64)])
     total = len(t_vals)
+    tidx = np.maximum(t_vals - 1, 0)
+    ml = np.maximum(tables.mask_len, 0)
+    valid = (t_vals > 0)[:, None]
+    if rules_flat.dtype == np.uint16:
+        joined = np.empty((total, 3 + rules_flat.shape[1]), np.uint16)
+        joined[:, 0] = t_vals & 0xFFFF
+        joined[:, 1] = (t_vals >> 16) & 0xFFFF
+        joined[:, 2] = np.minimum(ml[tidx], 0xFFFF)
+        joined[:, 3:] = rules_flat[tidx]
+    else:
+        joined = np.empty((total, 2 + rules_flat.shape[1]), np.int32)
+        joined[:, 0] = t_vals
+        joined[:, 1] = ml[tidx]
+        joined[:, 2:] = rules_flat[tidx]
+    joined *= valid.astype(joined.dtype)  # sentinel/zero rows stay zero
+    l0j = l0.copy()
+    nz = rt > 0
+    l0j[nz, 1] = (
+        len(targets) + np.searchsorted(uniq, rt[nz])
+    ).astype(np.int32)
+    result = (joined, l0j, t_vals)
+    try:
+        object.__setattr__(tables, "_joined_layout_cache", result)
+    except (AttributeError, TypeError):
+        pass
+    return result
+
+
+def build_joined(tables: CompiledTables):
+    """Joined target rows for the one-gather trie tail (see
+    DeviceTables.joined): returns (joined, l0_joined, sorted_t, order)
+    or None when the duplication gate trips.
+
+    ``(sorted_t, order)``: positions grouped by tidx+1 (argsort of the
+    row->tidx+1 map) so a rules-only edit can find and patch exactly
+    the joined rows of the dirty entries (searchsorted, no scan).
+
+    Memoized on the tables instance alongside the poptrie cache."""
+    cached = getattr(tables, "_joined_cache", None)
+    if cached is not None:
+        return None if cached == "none" else cached
+    _levels, targets = build_poptrie(tables)
+    T = _packed_rules_flat(tables).shape[0]
+    rt = _levels[0][:, 1]
+    uniq = np.unique(rt[rt > 0])
+    total = len(targets) + len(uniq)
     result = None
     if total <= max(4096, JOINED_DUP_LIMIT * (T + 1)):
-        tidx = np.maximum(t_vals - 1, 0)
-        ml = np.maximum(tables.mask_len, 0)
-        valid = (t_vals > 0)[:, None]
-        if rules_flat.dtype == np.uint16:
-            joined = np.empty((total, 3 + rules_flat.shape[1]), np.uint16)
-            joined[:, 0] = t_vals & 0xFFFF
-            joined[:, 1] = (t_vals >> 16) & 0xFFFF
-            joined[:, 2] = np.minimum(ml[tidx], 0xFFFF)
-            joined[:, 3:] = rules_flat[tidx]
-        else:
-            joined = np.empty((total, 2 + rules_flat.shape[1]), np.int32)
-            joined[:, 0] = t_vals
-            joined[:, 1] = ml[tidx]
-            joined[:, 2:] = rules_flat[tidx]
-        joined *= valid.astype(joined.dtype)  # sentinel/zero rows stay zero
-        l0j = l0.copy()
-        nz = rt > 0
-        l0j[nz, 1] = (
-            len(targets) + np.searchsorted(uniq, rt[nz])
-        ).astype(np.int32)
+        joined, l0j, t_vals = joined_layout(tables)
         order = np.argsort(t_vals, kind="stable").astype(np.int64)
         result = (joined, l0j, t_vals[order], order)
     try:
@@ -1147,6 +1173,63 @@ def depth_classes(n_levels: int):
     thresholds below the full deep depth, plus the full depth."""
     full = n_levels - 1
     return tuple(t for t in DEPTH_CLASS_THRESHOLDS if t < full) + (full,)
+
+
+def depth_class_histogram(tables: CompiledTables) -> np.ndarray:
+    """(full_depth + 1,) root-slot counts per deep-level requirement —
+    the depth histogram the steering thresholds are tuned against.
+    Index d = number of DIR-16 slots whose subtree needs exactly d deep
+    levels (build_depth_lut); slot mass is the available proxy for
+    packet mass (the bench logs the per-class packet split so the
+    recorded run shows both)."""
+    lut = build_depth_lut(tables)
+    full = max(len(tables.trie_levels) - 1, 0)
+    return np.bincount(
+        np.asarray(lut, np.int64), minlength=full + 1
+    )[: full + 1]
+
+
+def tune_depth_classes(tables: CompiledTables, max_classes: int = 4):
+    """Depth-class thresholds tuned to THIS table's depth histogram
+    instead of the static DEPTH_CLASS_THRESHOLDS (which were picked
+    against the 100K bench table and under-split the 1M adversarial
+    histogram — round-5 verdict ask #3): up to ``max_classes - 1``
+    thresholds at equal-mass quantiles of the sub-full-depth slot mass,
+    deduped, always ending with the full depth.  Degenerate histograms
+    (no sub-full mass, single level) fall back to the static classes.
+    Memoized on the tables instance (rides the build_depth_lut cache
+    plus its own) — the classifier asks on every load."""
+    cached = getattr(tables, "_depth_classes_cache", None)
+    if cached is not None:
+        return cached
+    full = len(tables.trie_levels) - 1
+    if full <= 0:
+        return (max(full, 0),)
+    hist = depth_class_histogram(tables).astype(np.float64)
+    below = hist[:full]
+    # depth 0 ("no deep levels") always gets its own class: it is the
+    # cheapest executable AND the dominant slot mass on real tables, so
+    # quantiles are computed over the REMAINING (depth >= 1) mass — a
+    # depth-0-dominated histogram would otherwise collapse every
+    # threshold to 0 and leave the full class covering depths 1..full.
+    mass = below[1:].sum()
+    if mass <= 0:
+        result = depth_classes(len(tables.trie_levels))
+    else:
+        cum = np.cumsum(below[1:]) / mass  # cum[i] = mass at depth <= i+1
+        picks = {0}
+        n_thresh = max(max_classes - 2, 1)
+        for k in range(1, n_thresh + 1):
+            q = k / (n_thresh + 1)
+            d = 1 + int(np.searchsorted(cum, q))
+            if 0 < d < full:
+                picks.add(d)
+        result = tuple(sorted(picks)) + (full,)
+    try:
+        object.__setattr__(tables, "_depth_classes_cache", result)
+    except (AttributeError, TypeError):
+        pass
+    return result
 
 
 def v4_trie_depth(n_levels: int) -> int:

@@ -1,0 +1,71 @@
+"""JAX version-compat shims for the parallel layer.
+
+``shard_map`` moved twice across the JAX versions this repo must run on:
+
+- jax <= 0.4.x exposes it at ``jax.experimental.shard_map.shard_map``
+  with the replication checker flag named ``check_rep``;
+- newer jax promotes it to ``jax.shard_map`` and renames the flag to
+  ``check_vma`` (varying-manual-axes checking).
+
+The mesh code calls :func:`shard_map` below with the NEW spelling
+(``check_vma``); the shim resolves whichever implementation the installed
+JAX provides and translates the flag.  Centralized here so the next
+rename costs one edit instead of one per call site.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "enable_cpu_collectives"]
+
+
+def enable_cpu_collectives() -> None:
+    """Best-effort switch-on of cross-process collectives on the CPU
+    backend (the Gloo stand-in for DCN used by the 2-process tests and
+    the compose multi-host dryrun).  jax 0.4.3x gates them behind
+    ``jax_cpu_collectives_implementation`` (default: none — any
+    multi-process computation fails with "Multiprocess computations
+    aren't implemented on the CPU backend"); newer jax enables them by
+    default and may drop the flag, hence best-effort.  Must run BEFORE
+    ``jax.distributed.initialize``."""
+    for name, value in (
+        ("jax_cpu_collectives_implementation", "gloo"),
+        ("jax_cpu_enable_gloo_collectives", True),
+    ):
+        try:
+            jax.config.update(name, value)
+            return
+        except (AttributeError, ValueError):
+            continue
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map`` wrapper (new-API signature)."""
+    impl = getattr(jax, "shard_map", None)
+    if impl is not None:
+        for kw in (
+            {} if check_vma is None else {"check_vma": check_vma},
+            {},
+        ):
+            try:
+                return impl(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+                )
+            except TypeError:
+                # e.g. a jax that has jax.shard_map but still spells the
+                # flag check_rep — retry without it (the flag only relaxes
+                # a static checker, never changes results)
+                continue
+    from jax.experimental.shard_map import shard_map as legacy
+
+    for kw in (
+        {} if check_vma is None else {"check_rep": check_vma},
+        {},
+    ):
+        try:
+            return legacy(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+            )
+        except TypeError:
+            continue
+    raise RuntimeError("no usable shard_map implementation in this JAX")

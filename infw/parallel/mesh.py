@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compiler import CompiledTables
 from ..kernels import jaxpath
 from ..kernels.jaxpath import DeviceBatch, DeviceTables
+from .compat import shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, rules_shards: int = 1) -> Mesh:
@@ -181,7 +182,7 @@ def make_sharded_classifier(mesh: Mesh, n_trie_levels: int = 0):
         root_lut=P(),
         num_entries=P(),
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         _sharded_step,
         mesh=mesh,
         in_specs=(table_specs, batch_specs),
@@ -327,7 +328,7 @@ def make_sharded_trie_classifier(mesh: Mesh, n_trie_levels: int):
         mask_len=P("rules", None),
         rules=P("rules", None, None, None),
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         _sharded_trie_step,
         mesh=mesh,
         in_specs=(table_specs, batch_specs),

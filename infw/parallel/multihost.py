@@ -72,6 +72,18 @@ def init_distributed(
     if not coord or n <= 1:
         log.info("single-process mode (no coordinator configured)")
         return False
+    # NOTE: must not touch jax.devices()/default_backend() here — backend
+    # initialization before distributed.initialize would pin the process
+    # to its local devices only.  Read the platform from config/env.
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS", "")
+        or ""
+    )
+    if platforms.startswith("cpu"):
+        from .compat import enable_cpu_collectives
+
+        enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coord, num_processes=n, process_id=pid
     )

@@ -1,0 +1,368 @@
+"""Fused Pallas deep-walk kernel (interpret mode on CPU; the same
+kernel compiles via Mosaic on real TPU — exercised by bench.py):
+bit-exactness vs the CPU oracle and the XLA trie walk on deep-heavy
+adversarial v6 mixes, the deep-tail extraction contract, OOB/fail-closed
+lanes, and the steering partition (covers everything, never
+double-classifies)."""
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.backend.tpu import TpuClassifier
+from infw.constants import KIND_IPV6, XDP_PASS
+from infw.kernels import jaxpath, pallas_walk
+
+
+def _tables_and_batch(seed=42, n_entries=3000, n_packets=2048, width=8,
+                      v6_fraction=0.3):
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, group_size=6,
+        v6_fraction=v6_fraction,
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    return tables, batch
+
+
+def _xla_results(tables, batch):
+    dt = jaxpath.device_tables(tables)
+    return jaxpath.jitted_classify(True)(dt, jaxpath.device_batch(batch))
+
+
+def test_walk_full_structure_matches_xla_and_oracle():
+    """Mixed-depth mix: full walk tables (no extraction) must match the
+    XLA trie path on EVERY packet (v4, shallow v6, deep v6, malformed)
+    and the scalar oracle on a prefix."""
+    tables, batch = _tables_and_batch()
+    wt = pallas_walk.build_walk_tables(tables, vmem_budget=64 << 20)
+    assert wt is not None
+    res, xdp, stats = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(batch)
+    )
+    res2, xdp2, stats2 = _xla_results(tables, batch)
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(res2))
+    np.testing.assert_array_equal(np.asarray(xdp), np.asarray(xdp2))
+    np.testing.assert_array_equal(np.asarray(stats), np.asarray(stats2))
+    ref = oracle.classify(tables, batch.slice(0, 800))
+    np.testing.assert_array_equal(np.asarray(res)[:800], ref.results)
+    np.testing.assert_array_equal(np.asarray(xdp)[:800], ref.xdp)
+
+
+def test_walk_all_deep_class_matches_oracle():
+    """All-deep adversarial mix: every packet in the batch belongs to the
+    full-depth steering class, classified through EXTRACTED walk tables."""
+    tables, batch = _tables_and_batch(seed=7, n_entries=5000,
+                                      n_packets=4096, v6_fraction=0.6)
+    classes = jaxpath.tune_depth_classes(tables)
+    assert len(classes) >= 2, "table too shallow for extraction coverage"
+    thr = classes[-2]
+    lut = jaxpath.build_depth_lut(tables)
+    idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+    deep = [g for d, g in jaxpath.depth_group_indices(
+        np.asarray(tables.root_lut, np.int64), lut, classes,
+        batch.ifindex, batch.ip_words, idx6,
+    ) if d is None]
+    assert deep and len(deep[0]) > 50, "mix generated no deep packets"
+    sub = batch.take(deep[0])
+
+    wt = pallas_walk.build_walk_tables(tables, min_depth=thr,
+                                       vmem_budget=64 << 20)
+    assert wt is not None
+    res, xdp, _ = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(sub)
+    )
+    ref = oracle.classify(tables, sub)
+    np.testing.assert_array_equal(np.asarray(res), ref.results)
+    np.testing.assert_array_equal(np.asarray(xdp), ref.xdp)
+
+
+def test_walk_extraction_shrinks_working_set():
+    """The deep-tail extraction must actually shrink the VMEM working
+    set (that is the 1M-tier fit story), not just remap it."""
+    tables, _ = _tables_and_batch(seed=7, n_entries=5000, n_packets=64)
+    classes = jaxpath.tune_depth_classes(tables)
+    full = pallas_walk.build_walk_tables_meta(tables, vmem_budget=256 << 20)
+    deep = pallas_walk.build_walk_tables_meta(
+        tables, min_depth=classes[-2], vmem_budget=256 << 20
+    )
+    assert full is not None and deep is not None
+    assert deep[1]["vmem_bytes"] < full[1]["vmem_bytes"]
+    # extraction must keep a strict subset of the rule rows resident
+    assert len(deep[1]["tidx_sorted"]) < len(full[1]["tidx_sorted"])
+
+
+def test_walk_positions_tail_matches_oracle():
+    """When the RULE_STRIDE-padded byte planes exceed the VMEM budget
+    (the 1M-tier shape), the kernel falls back to the positions tail:
+    level walk fused, rules via ONE XLA fat-row gather from the
+    compacted joined u16 — still bit-exact."""
+    rng = np.random.default_rng(2024)
+    tables = testing.random_tables_fast(
+        rng, n_entries=10_000, width=4, group_size=16
+    )
+    classes = jaxpath.tune_depth_classes(tables)
+    built = pallas_walk.build_walk_tables_meta(
+        tables, min_depth=classes[-2]
+    )
+    assert built is not None
+    wt, meta = built
+    assert meta["tail"] == "positions"
+    assert wt.joined.shape[0] == 1  # placeholder
+    assert wt.joined_u16.shape[0] > 1
+
+    batch = testing.random_batch_fast(rng, tables, n_packets=4096)
+    lut = jaxpath.build_depth_lut(tables)
+    idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+    deep = [g for d, g in jaxpath.depth_group_indices(
+        np.asarray(tables.root_lut, np.int64), lut, classes,
+        batch.ifindex, batch.ip_words, idx6,
+    ) if d is None]
+    assert deep and len(deep[0]) > 50
+    sub = batch.take(deep[0])
+    res, xdp, stats = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(sub)
+    )
+    res2, xdp2, stats2 = _xla_results(tables, sub)
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(res2))
+    np.testing.assert_array_equal(np.asarray(xdp), np.asarray(xdp2))
+    np.testing.assert_array_equal(np.asarray(stats), np.asarray(stats2))
+
+    # rules-only patch rewrites joined_u16 rows in place
+    tidx_resident = meta["tidx_sorted"]
+    assert len(tidx_resident)
+    patched = pallas_walk.patch_walk_joined(
+        wt, meta, tables, tidx_resident[:1]
+    )
+    assert patched is not None and patched is not wt
+    # unchanged rules -> identical rows -> identical verdicts
+    res3, _x3, _s3 = pallas_walk.jitted_classify_walk(True)(
+        patched, jaxpath.device_batch(sub)
+    )
+    np.testing.assert_array_equal(np.asarray(res3), np.asarray(res))
+
+
+def test_walk_oob_and_fail_closed():
+    """Invalidated lanes resolve deterministically to UNDEF -> XDP_PASS
+    (the kernel's no-match semantics, kernel.c:453), never to a stale or
+    wrong verdict: out-of-range ifindex, unknown ifindex, malformed
+    frames, and — with extraction — shallow packets outside the deep
+    class."""
+    from infw.packets import make_batch
+
+    tables, batch = _tables_and_batch(seed=3, n_entries=1500)
+    wt = pallas_walk.build_walk_tables(tables, vmem_budget=64 << 20)
+
+    b = make_batch(
+        src=["2001:db8::1", "10.0.0.1", "2001:db8::2"],
+        proto=[6, 17, 6],
+        dst_port=[80, 53, 443],
+        ifindex=[10_000_000, -3, 9999],  # OOB / negative / unknown
+    )
+    res, xdp, _ = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(b)
+    )
+    assert (np.asarray(res) == 0).all()
+    assert (np.asarray(xdp) == XDP_PASS).all()
+
+    # malformed packets keep the XLA path's verdicts exactly
+    res_all, xdp_all, _ = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(batch)
+    )
+    res_x, xdp_x, _ = _xla_results(tables, batch)
+    np.testing.assert_array_equal(np.asarray(res_all), np.asarray(res_x))
+    np.testing.assert_array_equal(np.asarray(xdp_all), np.asarray(xdp_x))
+
+    # extraction: packets OUTSIDE the deep class read the UNDEF sentinel
+    classes = jaxpath.tune_depth_classes(tables)
+    if len(classes) >= 2:
+        wt_deep = pallas_walk.build_walk_tables(
+            tables, min_depth=classes[-2], vmem_budget=64 << 20
+        )
+        lut = jaxpath.build_depth_lut(tables)
+        idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+        shallow = [g for d, g in jaxpath.depth_group_indices(
+            np.asarray(tables.root_lut, np.int64), lut, classes,
+            batch.ifindex, batch.ip_words, idx6,
+        ) if d is not None]
+        if shallow and len(shallow[0]):
+            sub = batch.take(shallow[0][:64])
+            res_s, xdp_s, _ = pallas_walk.jitted_classify_walk(True)(
+                wt_deep, jaxpath.device_batch(sub)
+            )
+            assert (np.asarray(res_s) == 0).all()
+            assert (np.asarray(xdp_s) == XDP_PASS).all()
+
+
+def test_walk_wire_path_matches_batch_path():
+    tables, batch = _tables_and_batch(seed=9, n_entries=800,
+                                      n_packets=512)
+    wt = pallas_walk.build_walk_tables(tables, vmem_budget=64 << 20)
+    import jax.numpy as jnp
+
+    wire = batch.pack_wire()
+    fused = np.asarray(
+        pallas_walk.jitted_classify_walk_wire_fused(True)(wt, jnp.asarray(wire))
+    )
+    res_b, _xdp, stats_b = pallas_walk.jitted_classify_walk(True)(
+        wt, jaxpath.device_batch(batch)
+    )
+    got16, got_stats = jaxpath.split_wire_outputs(fused, len(batch))
+    np.testing.assert_array_equal(
+        got16.astype(np.uint32), np.asarray(res_b).astype(np.uint32) & 0xFFFF
+    )
+    np.testing.assert_array_equal(got_stats, np.asarray(stats_b))
+
+
+def test_steering_partition_covers_exactly_once():
+    """The per-class partition must cover every v6 packet exactly once
+    and never double-classify (disjoint positions, union == idx)."""
+    tables, batch = _tables_and_batch(seed=5, n_entries=4000,
+                                      n_packets=4096, v6_fraction=0.5)
+    classes = jaxpath.tune_depth_classes(tables)
+    lut = jaxpath.build_depth_lut(tables)
+    idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+    groups = jaxpath.depth_group_indices(
+        np.asarray(tables.root_lut, np.int64), lut, classes,
+        batch.ifindex, batch.ip_words, idx6,
+    )
+    all_pos = np.concatenate([g for _d, g in groups]) if groups else idx6[:0]
+    assert len(all_pos) == len(idx6), "partition must cover every packet"
+    assert len(np.unique(all_pos)) == len(all_pos), "no double-classify"
+    np.testing.assert_array_equal(np.sort(all_pos), np.sort(idx6))
+    # class labels are strictly increasing with None (full depth) last
+    labels = [d for d, _g in groups]
+    assert labels == sorted(
+        labels, key=lambda d: (d is None, -1 if d is None else d)
+    )
+
+
+def test_tuned_depth_classes_shape():
+    tables, _ = _tables_and_batch(seed=5, n_entries=4000, n_packets=64,
+                                  v6_fraction=0.5)
+    classes = jaxpath.tune_depth_classes(tables)
+    full = len(tables.trie_levels) - 1
+    assert classes[-1] == full
+    assert list(classes) == sorted(set(classes))
+    assert all(t < full for t in classes[:-1])
+    assert classes[0] == 0  # the cheap no-deep-levels class survives tuning
+    # memoized per instance
+    assert jaxpath.tune_depth_classes(tables) is classes
+
+
+def test_backend_fused_dispatch_matches_xla():
+    """TpuClassifier(fused_deep=True) must produce verdicts identical to
+    the XLA path for every depth group of a steered v6 batch, and the
+    fused walk tables must actually be installed for the trie path."""
+    tables, batch = _tables_and_batch(seed=11, n_entries=2500,
+                                      n_packets=2048)
+    results = {}
+    for fused in (False, True):
+        clf = TpuClassifier(force_path="trie", fused_deep=fused)
+        clf.load_tables(tables)
+        assert (clf._active[5] is not None) == fused
+        idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+        res = {}
+        for (d, gen), g in clf.v6_depth_groups(
+            batch.ifindex, batch.ip_words, idx6
+        ):
+            if len(g) == 0:
+                continue
+            wire, v4 = batch.pack_wire_subset(g)
+            out = clf.classify_async_packed(wire, v4, depth=(d, gen)).result()
+            res.update(zip(g.tolist(), out.results.tolist()))
+        results[fused] = res
+        clf.close()
+    assert results[True] == results[False]
+
+
+def test_backend_structural_edit_defers_walk_rebuild():
+    """A structural incremental edit (CIDR delete) must NOT pay the full
+    walk rebuild on the blocking load path: the load installs with the
+    walk absent (XLA fallback serves the deep class) and a background
+    rebuild installs fresh walk tables for the same generation."""
+    import time
+
+    from infw.compiler import IncrementalTables
+
+    tables, _batch = _tables_and_batch(seed=21, n_entries=2000)
+    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    clf = TpuClassifier(force_path="trie", fused_deep=True)
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    assert clf._active[5] is not None
+
+    key = next(iter(it.content))
+    it.apply({}, deletes=[key])
+    clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    # the background rebuild installs for THIS generation (poll briefly)
+    deadline = time.time() + 60
+    while time.time() < deadline and clf._active[5] is None:
+        time.sleep(0.05)
+    assert clf._active[5] is not None, "background walk rebuild never landed"
+    assert clf._walk_meta is not None
+    clf.close()
+
+
+def test_backend_walk_survives_nonintersecting_rule_patch():
+    """A rules-only 1-key edit whose target is OUTSIDE the extracted
+    deep tail must carry the resident walk tables forward (no rebuild);
+    an edit INSIDE it must swap them out for fresh ones."""
+    from infw.compiler import IncrementalTables
+
+    tables, batch = _tables_and_batch(seed=13, n_entries=2500)
+    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    clf = TpuClassifier(force_path="trie", fused_deep=True)
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    walk0 = clf._active[5]
+    assert walk0 is not None
+    tidx_resident = clf._walk_meta["tidx_sorted"]
+
+    keys_by_t = {
+        it._ident_to_t[k.masked_identity()]: k for k in it.content
+    }
+    resident = set(tidx_resident.tolist())
+    outside = next((t for t in sorted(keys_by_t) if t not in resident), None)
+    inside = next((t for t in sorted(keys_by_t) if t in resident), None)
+    if outside is None:
+        pytest.skip("every target resident in the deep tail on this seed")
+
+    def flip(t):
+        key = keys_by_t[t]
+        rows = it.content[key].copy()
+        rows[0, 6] = 1 if rows[0, 6] == 2 else 2
+        it.apply({key: rows})
+        clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+
+    flip(outside)
+    assert clf._last_load[0] == "patch"
+    assert clf._active[5] is walk0, "non-intersecting edit must not rebuild"
+
+    if inside is not None:
+        walk1 = clf._active[5]
+        flip(inside)
+        assert clf._last_load[0] == "patch"
+        assert clf._active[5] is not walk1, (
+            "dirty deep-tail rules must refresh the resident joined planes"
+        )
+        # levels carry over by reference (rules-only edit, trie untouched)
+        assert clf._active[5].levels[0] is walk1.levels[0]
+        # and the patched walk serves fresh rule bytes: deep class verdicts
+        # still match the oracle
+        lut = jaxpath.build_depth_lut(clf.tables)
+        classes = jaxpath.tune_depth_classes(clf.tables)
+        idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+        deep = [g for d, g in jaxpath.depth_group_indices(
+            np.asarray(clf.tables.root_lut, np.int64), lut, classes,
+            batch.ifindex, batch.ip_words, idx6,
+        ) if d is None]
+        if deep and len(deep[0]):
+            sub = batch.take(deep[0])
+            res, _x, _s = pallas_walk.jitted_classify_walk(True)(
+                clf._active[5], jaxpath.device_batch(sub)
+            )
+            ref = oracle.classify(clf.tables, sub)
+            np.testing.assert_array_equal(np.asarray(res), ref.results)
+    clf.close()
