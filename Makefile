@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-fast bench native entry-check dryrun-multichip \
-	spill-read clean
+	spill-read wire-check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -26,6 +26,13 @@ native:
 entry-check:
 	$(PY) -c "import __graft_entry__ as g, jax; fn, args = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*args)); print('entry OK')"
+
+# Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
+# 10K-packet replay smoke through the real daemon ingest on CPU
+# (verdicts checked bit-exact vs the oracle, delta engagement asserted).
+wire-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_wire_codec.py -q
+	JAX_PLATFORMS=cpu $(PY) tools/wire_smoke.py
 
 # Decode a binary deny-event spill into reference-format event lines
 # (the operator-facing consumer of the sustained-rate event path).

@@ -13,8 +13,12 @@ same series as previous rounds):
      the per-depth-class split and standalone FULL-DEPTH v6 lines
      (XLA walk vs the fused Pallas deep-walk kernel, pallas_walk.py).
   2. config 5a: 10M-packet frames-file replay through the daemon's
-     pipelined ingest (read + vectorized parse + classify + verdict
-     sidecar + stats/events), sustained packets/s, min of 3 passes.
+     pipelined ingest (read + vectorized parse + compressed-wire classify
+     + verdict sidecar + stats/events), sustained packets/s — min AND
+     median of 3 passes, with the raw-bytes link floor measured in the
+     same record, a link-normalized dataplane-attributable line, the
+     delta+varint codec's bytes/packet, and a double-buffered-vs-
+     serialized H2D overlap A/B.
   3. config 5b: 1M-entry adversarial overlap table classified on chip,
      with the same per-class split + standalone deep-class lines.
   4. config 4: 8 interfaces x per-iface rulesets, mixed-ifindex batch.
@@ -537,6 +541,12 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
         d.pipeline_depth = 16
         d.max_tick_packets = 16 << 20
         d.debug_lookup = False
+        # double-buffered ingestion (the production default): the next
+        # chunk's compressed payload is encoded + its H2D copy started
+        # while the current chunk's classify runs; the serialized A/B
+        # control below measures the margin in the same record
+        d.h2d_overlap = True
+        d.h2d_stage_depth = 2
         # production-default ring sizing + a draining logger with the
         # binary spill sink, so the replay measures the REAL event
         # pipeline (round-4 weak #2: 20-57% of deny events were lost at
@@ -607,6 +617,12 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
         log(f"replay: host phase (read+parse+pack) {t_host_file:.2f}s/file "
             f"-> {n_file/t_host_file/1e6:.2f} M pkts/s host-only floor")
 
+        def _wire_totals():
+            s = clf.wire_stats() if hasattr(clf, "wire_stats") else {}
+            return (sum(v[0] for v in s.values()),
+                    sum(v[1] for v in s.values()), s)
+
+        pk0, by0, _ = _wire_totals()
         best_dt, pass_times = float("inf"), []
         for p in range(n_passes):
             t_write = write_pass_files(p)
@@ -616,19 +632,17 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
             assert done == n_files, f"processed {done}/{n_files}"
             pass_times.append(dt_s)
             best_dt = min(best_dt, dt_s)
-            # wire8: 8B/packet v4 (pkt_len host-side, 4-bit if-dict),
-            # 24B narrow v6; fused readback 2B/packet (v4: no stats)
-            from infw.constants import KIND_IPV6 as _K6
-            n_v6 = int((np.asarray(batch.kind) == _K6).sum()) * n_files
-            h2d_mb = ((n_total - n_v6) * 8 + n_v6 * 24) / 1e6
             log(f"replay pass {p}: {n_files} x {n_file} packets in {dt_s:.1f}s "
                 f"(+{t_write:.1f}s file write) -> {n_total/dt_s/1e6:.2f} M "
-                f"pkts/s; ~{h2d_mb/dt_s:.0f} MB/s effective H2D; "
+                f"pkts/s; "
                 f"device-attributable ~{max(dt_s - n_files*t_host_file, 0):.1f}s "
                 f"if unpipelined host cost {n_files*t_host_file:.1f}s; "
                 f"ring lost_samples={d.ring.lost_samples}")
+        pk1, by1, fmt_split = _wire_totals()
         thr = n_total / best_dt
-        log(f"replay: min-of-{n_passes} {thr/1e6:.2f} M packets/s "
+        med_dt = sorted(pass_times)[len(pass_times) // 2]
+        log(f"replay: min-of-{n_passes} {thr/1e6:.2f} M packets/s, "
+            f"median {n_total/med_dt/1e6:.2f} M "
             f"(passes: {', '.join(f'{t:.1f}s' for t in pass_times)})")
         emit(
             f"daemon ingest replay sustained @{n_total/1e6:.0f}M packets, "
@@ -637,6 +651,125 @@ def bench_replay_10m(rng, tables, on_tpu, n_passes=3):
             "parse + verdict sidecar + stats + deny events)",
             thr, "packets/s",
         )
+        # median alongside min (round-6 ask: a single lucky pass through
+        # the tunnel must not be the only recorded number)
+        emit(
+            f"daemon ingest replay sustained @{n_total/1e6:.0f}M packets, "
+            f"median of {n_passes} (same passes as the min line)",
+            n_total / med_dt, "packets/s",
+        )
+        # compressed-wire accounting: the delta codec's measured average
+        # payload bytes per packet (the ≤6 B/packet target; v6 chunks
+        # ride the 24B narrow wire and are reported as the blend in the
+        # log so the link-floor line stays interpretable)
+        if pk1 > pk0:
+            blend = (by1 - by0) / (pk1 - pk0)
+            log("replay wire formats (packets, bytes): " + ", ".join(
+                f"{k}: {v[0]}, {v[1]}" for k, v in sorted(fmt_split.items()))
+                + f"; all-format blend {blend:.2f} B/packet")
+            dstats = fmt_split.get("delta")
+            if dstats and dstats[0]:
+                bpp = dstats[1] / dstats[0]
+                emit(
+                    "replay compressed wire bytes/packet (delta+varint "
+                    "codec, v4 share; target <= 6)",
+                    bpp, "bytes/packet", vs_baseline=round(bpp / 8.0, 3),
+                )
+            else:
+                emit(
+                    "replay compressed wire bytes/packet (delta codec "
+                    "NOT engaged — all-format blend)",
+                    blend, "bytes/packet", vs_baseline=round(blend / 8.0, 3),
+                )
+
+        # H2D-overlap A/B in the same record, two controls so the new
+        # staging is not credited with the pre-existing classify
+        # pipelining: (a) staged H2D off but the 16-deep classify window
+        # kept — isolates the double-buffered prepare stage; (b) fully
+        # serialized (no staging, pipeline depth 1) — the total overlap
+        # win of the pipeline over chunk-at-a-time ingest.
+        try:
+            d.h2d_overlap = False
+            t_write = write_pass_files(n_passes)
+            t0 = time.perf_counter()
+            done = d.process_ingest_once()
+            dt_nostage = time.perf_counter() - t0
+            assert done == n_files, f"processed {done}/{n_files}"
+            d.pipeline_depth = 1
+            t_write = write_pass_files(n_passes + 1)
+            t0 = time.perf_counter()
+            done = d.process_ingest_once()
+            dt_serial = time.perf_counter() - t0
+            assert done == n_files, f"processed {done}/{n_files}"
+            # controls are ONE pass each, so compare them to the MEDIAN
+            # staged pass, not the min — min-vs-single-pass would credit
+            # tunnel weather (1-31 MB/s between passes) to the staging
+            log(f"replay overlap A/B: staged+pipelined median {med_dt:.1f}s "
+                f"(best {best_dt:.1f}s), "
+                f"no-stage (pipeline 16) {dt_nostage:.1f}s, "
+                f"fully serialized {dt_serial:.1f}s")
+            emit(
+                "replay H2D staging speedup (double-buffered prepare vs "
+                "unstaged, classify pipeline kept; vs median staged pass)",
+                dt_nostage / med_dt, "x",
+                vs_baseline=round(dt_nostage / med_dt, 3),
+            )
+            emit(
+                "replay pipeline overlap speedup (staged + 16-deep "
+                "pipeline vs fully serialized chunks; vs median staged "
+                "pass)",
+                dt_serial / med_dt, "x",
+                vs_baseline=round(dt_serial / med_dt, 3),
+            )
+        except Exception as e:
+            log(f"replay no-overlap control FAILED: {e}")
+        finally:
+            d.h2d_overlap = True
+            d.pipeline_depth = 16
+
+        # raw-bytes link floor IN the record: ship the same number of
+        # compressed bytes as one measured pass, chunked like the ingest
+        # jobs, with no decode/classify behind them — the hard ceiling
+        # the link imposes on ANY codec, so the record separates "the
+        # wire is slow" from "the dataplane is slow".
+        try:
+            per_pass_bytes = int((by1 - by0) / max(n_passes, 1))
+            if per_pass_bytes > 0:
+                n_jobs = max(1, n_total // d.ingest_chunk)
+                chunk_b = max(1, per_pass_bytes // n_jobs)
+                rng_f = np.random.default_rng(424242)
+                bufs = [
+                    rng_f.integers(0, 256, chunk_b, dtype=np.uint8)
+                    for _ in range(-(-per_pass_bytes // chunk_b))
+                ]
+                t0 = time.perf_counter()
+                handles = [jax.device_put(b) for b in bufs]
+                for h in handles:
+                    h.block_until_ready()
+                floor_s = time.perf_counter() - t0
+                del handles
+                thr_floor = n_total / floor_s
+                log(f"replay raw-bytes link floor: {per_pass_bytes/1e6:.1f} MB "
+                    f"in {floor_s:.2f}s = {per_pass_bytes/floor_s/1e6:.1f} MB/s "
+                    f"-> {thr_floor/1e6:.2f} M pkts/s ceiling")
+                emit(
+                    "replay raw-bytes link floor (same compressed bytes, "
+                    "no compute)",
+                    thr_floor, "packets/s",
+                )
+                # link-normalized dataplane-attributable rate: the pass
+                # time with the raw link cost subtracted — what the SAME
+                # pipeline sustains once the link is not the wall (the
+                # on-node PCIe deployment), bounded away from the
+                # divide-by-zero when a pass ran entirely at the floor
+                attr_dt = max(best_dt - floor_s, 0.02 * best_dt)
+                emit(
+                    "replay link-normalized dataplane-attributable rate "
+                    "(best pass minus raw-bytes link floor)",
+                    n_total / attr_dt, "packets/s",
+                )
+        except Exception as e:
+            log(f"replay link-floor tier FAILED: {e}")
 
         # deny-event fidelity at the recorded sustained rate: drain what
         # is still queued, then report loss over everything seen

@@ -25,6 +25,13 @@ REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$REPO_DIR"
 mkdir -p "$STATE_DIR"
 
+# Metrics exposed off-host go through the TLS proxy: launch it alongside
+# (separate launcher process — daemon-multihost is a standalone
+# component) with `deploy/launch.py --component metrics-proxy
+# --state-dir "$STATE_DIR"`; TLS is on by default (self-signed pair
+# minted under $STATE_DIR/tls), plaintext only behind the explicit
+# INFW_INSECURE_METRICS=1 opt-out that launch.py honors.
+
 NODE_NAME="${NODE_NAME:-$(hostname)}" \
 exec python deploy/launch.py \
   --component daemon-multihost \

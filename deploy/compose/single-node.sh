@@ -11,6 +11,13 @@
 # role); this script only resolves the deployment knobs and delegates to
 # the bundle-driven launcher.
 #
+# The metrics proxy (the kube-rbac-proxy sidecar role) joins the
+# composition by default with TLS ON: launch.py mints a self-signed pair
+# under $STATE_DIR/tls (reused across restarts) unless the operator
+# provides one.  Plaintext metrics require the EXPLICIT opt-out
+# INFW_INSECURE_METRICS=1 (the bearer token then travels in the clear);
+# INFW_METRICS_PROXY=0 drops the proxy entirely (loopback-only metrics).
+#
 # Usage: deploy/compose/single-node.sh [STATE_DIR] [BACKEND]
 set -euo pipefail
 
@@ -20,8 +27,25 @@ NODE_NAME="${NODE_NAME:-$(hostname)}"
 EVENTS_SOCK="${INFW_EVENTS_SOCKET:-$STATE_DIR/events.sock}"
 REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
 
+# falsy-value parsing matches launch.py exactly (case-insensitive "",
+# 0, false, no, off) so the TLS posture cannot invert between entry
+# points; tr (not ${var,,}) keeps bash 3.2 working
+lower() { printf '%s' "$1" | tr '[:upper:]' '[:lower:]'; }
+EXTRA=()
+case "$(lower "${INFW_METRICS_PROXY:-1}")" in
+  ""|0|false|no|off) ;;
+  *) EXTRA+=(--with-metrics-proxy) ;;
+esac
+case "$(lower "${INFW_INSECURE_METRICS:-}")" in
+  ""|0|false|no|off) ;;
+  *) EXTRA+=(--insecure-metrics) ;;
+esac
+
+# ${EXTRA[@]+...}: expanding an EMPTY array as "${EXTRA[@]}" trips
+# `set -u` on bash < 4.4
 exec python "$REPO_DIR/deploy/launch.py" \
   --state-dir "$STATE_DIR" \
   --backend "$BACKEND" \
   --node-name "$NODE_NAME" \
-  --events-socket "$EVENTS_SOCK"
+  --events-socket "$EVENTS_SOCK" \
+  ${EXTRA[@]+"${EXTRA[@]}"}

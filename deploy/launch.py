@@ -55,16 +55,22 @@ def load_bundle(path: str = BUNDLE_PATH) -> dict:
 
 
 def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None,
-               only: str | None = None, flag_env: dict | None = None):
+               only: str | None = None, flag_env: dict | None = None,
+               include: list | None = None):
     """[(name, argv, env)] in bundle launch order.  ``subs`` fills the
     run templates' <placeholders>; ``extra_args`` appends per-component
     argv (e.g. ephemeral ports for tests).  ``only`` selects a single
     component by name (the way a DaemonSet pod runs one declared
     container) — required for components marked ``standalone`` (e.g.
     daemon-multihost), which never join the default composition.
-    ``flag_env`` maps launcher flag names to values; a component's
-    ``envFromFlags`` contract routes them into its environment."""
+    ``include`` appends explicitly requested standalone components to
+    the default order (--with-metrics-proxy): an explicit request is the
+    same consent --component gives, so the standalone guard exempts
+    them.  ``flag_env`` maps launcher flag names to values; a
+    component's ``envFromFlags`` contract routes them into its
+    environment."""
     components = bundle["components"]
+    include = list(include or [])
     if only is not None:
         if only not in components:
             raise SystemExit(
@@ -73,16 +79,19 @@ def build_plan(bundle: dict, subs: dict, extra_args: dict | None = None,
             )
         order = [only]
     else:
-        order = bundle.get("launchOrder", sorted(components))
+        order = list(bundle.get("launchOrder", sorted(components)))
+        order += [n for n in include if n not in order]
     unknown = [n for n in order if n not in components]
     if unknown:
         raise SystemExit(f"bundle launchOrder names unknown components: {unknown}")
     # standalone components (daemon-multihost) carry an env contract the
     # default composition cannot satisfy — launching one there would hang
     # a distributed job on a rank that never joins; they are reachable
-    # only through an explicit --component selection.
+    # only through an explicit --component selection (or an explicit
+    # ``include`` request).
     standalone_in_order = [
-        n for n in order if components[n].get("standalone") and n != only
+        n for n in order
+        if components[n].get("standalone") and n != only and n not in include
     ]
     if standalone_in_order:
         raise SystemExit(
@@ -226,6 +235,22 @@ def main(argv=None) -> int:
     ap.add_argument("--component", default=None,
                     help="launch ONLY this bundle component (required for "
                          "standalone components, e.g. daemon-multihost)")
+    ap.add_argument("--with-metrics-proxy", action="store_true",
+                    help="add the authenticated metrics proxy to the "
+                         "composition (TLS on by default — a self-signed "
+                         "pair is minted under <state-dir>/tls when no "
+                         "operator pair exists)")
+    ap.add_argument("--insecure-metrics", action="store_true",
+                    # a security knob must not misparse common spellings
+                    # (False/NO/off) in the insecure direction:
+                    # case-insensitive, "off" included
+                    default=os.environ.get("INFW_INSECURE_METRICS", "")
+                    .strip().lower()
+                    not in ("", "0", "false", "no", "off"),
+                    help="serve the metrics proxy over PLAINTEXT (the "
+                         "bearer token then travels in the clear) — an "
+                         "explicit opt-out of the default-on TLS; also "
+                         "via INFW_INSECURE_METRICS=1")
     ap.add_argument("--coordinator", default=None,
                     help="multihost: coordinator host:port "
                          "(bundle envFromFlags -> INFW_COORDINATOR)")
@@ -264,8 +289,52 @@ def main(argv=None) -> int:
         "num-processes": args.num_processes,
         "process-id": args.process_id,
     }
+    if args.with_metrics_proxy and args.component not in (None, "metrics-proxy"):
+        # silently dropping the proxy would leave the operator believing
+        # off-node metrics are TLS-fronted while nothing is listening
+        raise SystemExit(
+            "--with-metrics-proxy joins the DEFAULT composition; with "
+            f"--component {args.component} nothing would launch the proxy "
+            "— run a second launcher with --component metrics-proxy"
+        )
+    include = ["metrics-proxy"] if (
+        args.with_metrics_proxy and args.component is None
+        and "metrics-proxy" in bundle["components"]
+    ) else []
+    proxy_in_plan = args.component == "metrics-proxy" or bool(include)
+    if proxy_in_plan:
+        # DEFAULT-ON TLS (satellite of the reference posture: the
+        # kube-rbac-proxy sidecar always terminates TLS): mint a
+        # self-signed pair under the state dir unless the operator
+        # explicitly opted into plaintext.  The bearer-token file the
+        # run template points at is bootstrapped alongside so a fresh
+        # state dir comes up authenticated, never open.
+        proxy_args = []
+        if not args.insecure_metrics:
+            crt = os.path.join(state_dir, "tls", "metrics-tls.crt")
+            key = os.path.join(state_dir, "tls", "metrics-tls.key")
+            if not args.dry_run:
+                if REPO_DIR not in sys.path:  # invoked by absolute path
+                    sys.path.insert(0, REPO_DIR)
+                from infw.obs.metricsproxy import ensure_self_signed
+
+                crt, key = ensure_self_signed(os.path.join(state_dir, "tls"))
+            proxy_args += ["--certfile", crt, "--keyfile", key]
+        if not args.dry_run:
+            token_path = os.path.join(state_dir, "metrics-token")
+            if not os.path.exists(token_path):
+                import secrets
+
+                os.makedirs(state_dir, exist_ok=True)
+                fd = os.open(token_path + ".tmp",
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "w") as f:
+                    f.write(secrets.token_hex(32))
+                os.replace(token_path + ".tmp", token_path)
+        extra = dict(extra)
+        extra["metrics-proxy"] = extra.get("metrics-proxy", []) + proxy_args
     plan = build_plan(bundle, subs, extra, only=args.component,
-                      flag_env=flag_env)
+                      flag_env=flag_env, include=include)
     print(f"launch: bundle {bundle['name']} v{bundle['version']} "
           f"({len(plan)} components)", flush=True)
     if args.dry_run:

@@ -31,9 +31,16 @@ import numpy as np
 
 from ..compiler import CompiledTables
 from ..constants import KIND_IPV6
-from ..kernels import jaxpath, pallas_dense, pallas_walk
-from ..packets import PacketBatch, narrow_wire, wire8
+from ..kernels import jaxpath, pallas_dense, pallas_walk, wire_decode
+from ..packets import PacketBatch, encode_delta_wire, narrow_wire, wire8
 from .base import ClassifyOutput, PendingClassify, StatsAccumulator
+
+#: H2D wire codec choices (the daemon's --wire-codec knob): "auto" picks
+#: per chunk by measured compressed size (delta when it beats the wire8
+#: 8 B/packet floor), "wire8"/"delta" force a format (with the usual
+#: eligibility fallbacks — an ineligible chunk degrades down the
+#: delta -> wire8 -> narrow -> full chain, never refuses)
+WIRE_CODECS = ("auto", "wire8", "delta")
 
 
 class TpuClassifier:
@@ -50,6 +57,8 @@ class TpuClassifier:
         force_path: Optional[str] = None,  # "dense" | "trie" | None (auto)
         interpret: Optional[bool] = None,
         fused_deep: Optional[bool] = None,
+        wire_codec: Optional[str] = None,
+        decode_pallas: Optional[bool] = None,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -73,8 +82,29 @@ class TpuClassifier:
         self._fused_deep = (
             fused_deep if fused_deep is not None else not self._interpret
         )
+        # H2D wire codec (--wire-codec / INFW_WIRE_CODEC, CLI beats env,
+        # same precedence shape as fused_deep): constructor arg > env >
+        # "auto" (per-chunk choice by measured compressed size).
+        if wire_codec is None:
+            wire_codec = os.environ.get("INFW_WIRE_CODEC") or "auto"
+        if wire_codec not in WIRE_CODECS:
+            raise ValueError(
+                f"unknown wire codec {wire_codec!r} (expected one of "
+                f"{WIRE_CODECS})"
+            )
+        self._wire_codec = wire_codec
+        # Pallas fixed-stride decode variant (kernels.wire_decode): off by
+        # default everywhere until a recorded TPU run proves it over the
+        # XLA decode; tests opt in explicitly.
+        if decode_pallas is None:
+            env = os.environ.get("INFW_DECODE_PALLAS", "")
+            decode_pallas = env not in ("", "0", "false", "no")
+        self._decode_pallas = bool(decode_pallas)
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
+        # per-format H2D accounting {fmt: [packets, payload bytes]} — the
+        # bench reads this to put bytes/packet in the replay record
+        self._wire_counts = {}
         self._tables: Optional[CompiledTables] = None
         # (path, dev tables, block_b|None, wide_rids, overlay dev|None,
         #  fused walk dev|None)
@@ -406,6 +436,21 @@ class TpuClassifier:
         9-array subset copy entirely.  Caller contract: supports_packed()
         is True for the current table generation; kind is recovered from
         wire w0 for the host-side XDP rebuild."""
+        return self.classify_prepared(
+            self.prepare_packed(wire_np, v4_only, depth=depth),
+            apply_stats=apply_stats,
+        )
+
+    def prepare_packed(self, wire_np: np.ndarray, v4_only: bool, depth=None):
+        """First half of classify_async_packed: choose the wire format
+        (delta / wire8 / narrow / full per the codec knob and chunk
+        eligibility) and START the H2D copy of the chosen payload,
+        returning an opaque plan for classify_prepared.  The daemon's
+        double-buffered ingest stages the NEXT chunk's plan while the
+        current chunk's classify runs, so the transfer hides under
+        compute; the plan snapshots the table generation at prepare
+        time — in-flight plans finish on the tables they were staged
+        against (the double-buffer swap contract)."""
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
@@ -429,28 +474,106 @@ class TpuClassifier:
                 # (its extraction threshold came from the same class
                 # list this grouping used — the gen token proves it)
                 use_walk = walk_dev
-        return self._dispatch_wire(
-            path, dev, block_b, wire_np, v4_only, kind, apply_stats,
+        return self._plan_wire(
+            path, dev, block_b, wire_np, v4_only, kind,
             ov_dev=ov_dev, depth=d, walk_dev=use_walk,
         )
+
+    def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
+        """Second half: launch the classify on a prepare_packed plan."""
+        return self._launch_wire(plan, apply_stats)
+
+    def _note_wire(self, fmt: str, n: int, nbytes: int) -> None:
+        with self._lock:
+            c = self._wire_counts.setdefault(fmt, [0, 0])
+            c[0] += n
+            c[1] += nbytes
+
+    def wire_stats(self):
+        """{format: (packets, H2D payload bytes)} since construction."""
+        with self._lock:
+            return {k: tuple(v) for k, v in self._wire_counts.items()}
+
+    @staticmethod
+    def _wire4_pkt_len(wire4_np: np.ndarray) -> np.ndarray:
+        """Full pkt_len reconstruction from the 4-word wire (pack_wire
+        w1>>16 plus the w0>>27 high-bit stash) — stays host-side for the
+        sub-12B formats, whose statistics derive from the verdicts."""
+        return (
+            ((wire4_np[:, 1] >> 16) & 0xFFFF)
+            | ((wire4_np[:, 0] >> 27) << 16)
+        ).astype(np.int64)
 
     def _dispatch_wire(
         self, path, dev, block_b, wire_np, v4_only, kind, apply_stats,
         ov_dev=None, depth=None, walk_dev=None,
     ) -> PendingClassify:
+        return self._launch_wire(
+            self._plan_wire(
+                path, dev, block_b, wire_np, v4_only, kind,
+                ov_dev=ov_dev, depth=depth, walk_dev=walk_dev,
+            ),
+            apply_stats,
+        )
+
+    def _plan_wire(
+        self, path, dev, block_b, wire_np, v4_only, kind,
+        ov_dev=None, depth=None, walk_dev=None,
+    ):
+        """Format choice + H2D staging.  Returns the plan consumed by
+        _launch_wire; every jax.device_put here is async, so a staged
+        plan's transfer overlaps whatever the device is running."""
         n = wire_np.shape[0]
-        if path == "trie" and wire_np.shape[1] == 4:
+        plan = {
+            "path": path, "dev": dev, "block_b": block_b, "ov_dev": ov_dev,
+            "depth": depth, "walk_dev": walk_dev, "v4_only": v4_only,
+            "kind": kind, "n": n,
+        }
+        put = lambda a: jax.device_put(a, self._device)
+        if path == "trie" and wire_np.shape[1] == 4 and n:
+            codec = self._wire_codec
+            if codec in ("auto", "delta"):
+                # delta+varint compressed wire (packets.encode_delta_wire):
+                # sorted-chunk IP deltas + dictionary meta, ~4-6 B/packet;
+                # "auto" takes it only when it beats the wire8 floor.
+                enc = encode_delta_wire(
+                    wire_np,
+                    max_bytes_per_pkt=8.0 if codec == "auto" else None,
+                )
+                if enc is not None:
+                    # what actually crosses the link: the BUCKET-padded
+                    # payload plus the dict/ifmap headers — the auto gate
+                    # and the byte counters reason about shipped bytes,
+                    # not the unpadded stream (a payload just over its
+                    # bucket step would otherwise "win" on paper while
+                    # shipping wire8-sized buffers)
+                    shipped = (
+                        wire_decode.payload_bucket(len(enc.payload))
+                        + 256 * 4 + enc.ifmap.nbytes
+                    )
+                    if codec == "delta" or shipped < 8 * n:
+                        plan.update(
+                            fmt="delta", enc=enc,
+                            pkt_len=self._wire4_pkt_len(wire_np),
+                            payload=put(wire_decode.pad_payload(enc.payload)),
+                            dictv=put(wire_decode.pad_dict(enc.dict_vals)),
+                            ifmap=put(enc.ifmap),
+                        )
+                        self._note_wire("delta", n, shipped)
+                        return plan
             # 8B/packet transfer (packets.wire8): classification never
             # reads pkt_len, so the length stays host-side and byte
             # statistics are computed from the returned verdicts; the
-            # ifindex travels as a 4-bit dictionary index.  The link is
-            # the replay bottleneck (8-17MB/s tunnel), so 12B -> 8B is a
-            # direct 1.5x on the sustained end-to-end rate.
+            # ifindex travels as a 4-bit dictionary index.
             w8 = wire8(wire_np)
             if w8 is not None:
-                return self._dispatch_wire8(
-                    dev, ov_dev, wire_np, w8, kind, apply_stats
+                wire8_np, ifmap = w8
+                plan.update(
+                    fmt="wire8", pkt_len=self._wire4_pkt_len(wire_np),
+                    wire=put(wire8_np), ifmap=put(ifmap),
                 )
+                self._note_wire("wire8", n, wire8_np.nbytes + ifmap.nbytes)
+                return plan
         if wire_np.shape[1] in (4, 7):
             # Narrow transfer (packets.narrow_wire): one word less per
             # packet on the H2D link when the chunk qualifies — the link
@@ -458,7 +581,19 @@ class TpuClassifier:
             nw = narrow_wire(wire_np)
             if nw is not None:
                 wire_np = nw
-        wire = jax.device_put(wire_np, self._device)
+        plan.update(fmt="wire", wire=put(wire_np))
+        self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
+        return plan
+
+    def _launch_wire(self, plan, apply_stats: bool) -> PendingClassify:
+        if plan["fmt"] == "delta":
+            return self._launch_delta(plan, apply_stats)
+        if plan["fmt"] == "wire8":
+            return self._launch_wire8(plan, apply_stats)
+        path, dev, block_b = plan["path"], plan["dev"], plan["block_b"]
+        ov_dev, depth, walk_dev = plan["ov_dev"], plan["depth"], plan["walk_dev"]
+        v4_only, kind, n = plan["v4_only"], plan["kind"], plan["n"]
+        wire = plan["wire"]
         # Fused single-buffer output: results + stats come back in ONE
         # D2H materialization (jaxpath.fuse_wire_outputs) — each readback
         # RPC pays the link's sync floor, so two arrays per chunk would
@@ -506,22 +641,13 @@ class TpuClassifier:
 
         return PendingClassify(materialize)
 
-    def _dispatch_wire8(
-        self, dev, ov_dev, wire4_np, w8, kind, apply_stats
-    ) -> PendingClassify:
-        """The 8B-wire dispatch: res16-only D2H; statistics (incl. exact
+    def _launch_wire8(self, plan, apply_stats: bool) -> PendingClassify:
+        """The 8B-wire launch: res16-only D2H; statistics (incl. exact
         byte counts) derive host-side from the verdicts + the pkt_len
         column that never crossed the link."""
-        wire8_np, ifmap = w8
-        n = wire4_np.shape[0]
-        # full-layout pkt_len reconstruction (pack_wire w1>>16 plus the
-        # w0>>27 high-bit stash)
-        pkt_len = (
-            ((wire4_np[:, 1] >> 16) & 0xFFFF)
-            | ((wire4_np[:, 0] >> 27) << 16)
-        ).astype(np.int64)
-        wire = jax.device_put(wire8_np, self._device)
-        ifm = jax.device_put(ifmap, self._device)
+        dev, ov_dev = plan["dev"], plan["ov_dev"]
+        kind, n, pkt_len = plan["kind"], plan["n"], plan["pkt_len"]
+        wire, ifm = plan["wire"], plan["ifmap"]
         if ov_dev is not None:
             fused = jaxpath.jitted_classify_wire8_fused(True)(
                 dev, ov_dev, wire, ifm
@@ -537,6 +663,47 @@ class TpuClassifier:
             from ..daemon import stats_from_results  # lazy: no import cycle
 
             res16 = jaxpath.unpack_res16_host(np.asarray(fused), n)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            stats_delta = stats_from_results(results, pkt_len)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def _launch_delta(self, plan, apply_stats: bool) -> PendingClassify:
+        """Compressed-wire launch (packets.encode_delta_wire +
+        kernels.wire_decode): the device decodes the ~4-6 B/packet stream
+        on-chip and classifies in SORTED order; the host inverse-permutes
+        the returned verdicts back to chunk order (the permutation, like
+        pkt_len, never crosses the link).  res16-only D2H, host-derived
+        statistics — the wire8 readback contract."""
+        dev, ov_dev = plan["dev"], plan["ov_dev"]
+        kind, n, pkt_len = plan["kind"], plan["n"], plan["pkt_len"]
+        enc = plan["enc"]
+        fn = wire_decode.jitted_classify_delta_fused(
+            ov_dev is not None, n, enc.dict_mode, enc.fixed_w,
+            use_pallas=self._decode_pallas and enc.fixed_w > 0,
+            interpret=self._interpret,
+        )
+        if ov_dev is not None:
+            fused = fn(dev, ov_dev, plan["payload"], plan["dictv"],
+                       plan["ifmap"])
+        else:
+            fused = fn(dev, plan["payload"], plan["dictv"], plan["ifmap"])
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16_sorted = jaxpath.unpack_res16_host(np.asarray(fused), n)
+            res16 = np.empty(n, np.uint16)
+            res16[enc.perm] = res16_sorted
             results, xdp = jaxpath.host_finalize_wire(res16, kind)
             stats_delta = stats_from_results(results, pkt_len)
             if apply_stats:

@@ -71,6 +71,12 @@ DEFAULT_INGEST_CHUNK = 1 << 16     # packets per in-flight sub-batch
 # is <=1.8MB, so memory is trivial either way).
 DEFAULT_PIPELINE_DEPTH = 16
 DEFAULT_MAX_TICK_PACKETS = 4 << 20   # parse-ahead bound for one ingest tick
+# Double-buffered ingestion: how many UPCOMING jobs are host-packed +
+# codec-encoded with their H2D copy already started while earlier jobs'
+# classifies run (prepare_packed).  2 keeps one transfer in flight ahead
+# of the compute at all times (classic double buffering) without holding
+# more than ~2 chunks of extra pinned wire memory.
+DEFAULT_H2D_STAGE_DEPTH = 2
 
 _FRAMES_MAGIC = b"INFW1\n"
 _FRAMES_MAGIC2 = b"INFW2\n"
@@ -220,11 +226,15 @@ def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
     return out
 
 
-def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None):
+def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
+                            wire_codec: Optional[str] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
-    mode).  The CPU reference backend ignores it."""
+    mode).  ``wire_codec`` selects the H2D wire format (auto | wire8 |
+    delta — the --wire-codec knob); None keeps the backend default (the
+    INFW_WIRE_CODEC env, else auto).  The CPU reference backend ignores
+    both."""
     if backend == "cpu":
         from .backend.cpu_ref import CpuRefClassifier
 
@@ -234,9 +244,14 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None):
 
         from .backend.tpu import TpuClassifier
 
-        if fused_deep is None:
+        kw = {}
+        if fused_deep is not None:
+            kw["fused_deep"] = fused_deep
+        if wire_codec is not None:
+            kw["wire_codec"] = wire_codec
+        if not kw:
             return TpuClassifier
-        return functools.partial(TpuClassifier, fused_deep=fused_deep)
+        return functools.partial(TpuClassifier, **kw)
     raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
 
 
@@ -263,6 +278,9 @@ class Daemon:
         max_tick_packets: int = DEFAULT_MAX_TICK_PACKETS,
         event_ring_size: int = 1 << 21,
         fused_deep: Optional[bool] = None,
+        wire_codec: Optional[str] = None,
+        h2d_overlap: bool = True,
+        h2d_stage_depth: int = DEFAULT_H2D_STAGE_DEPTH,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -273,6 +291,8 @@ class Daemon:
         self.ingest_chunk = max(1, int(ingest_chunk))
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.max_tick_packets = max(1, int(max_tick_packets))
+        self.h2d_overlap = bool(h2d_overlap)
+        self.h2d_stage_depth = max(1, int(h2d_stage_depth))
         self.registry = registry if registry is not None else default_registry
 
         self.nodestates_dir = os.path.join(state_dir, "nodestates")
@@ -296,7 +316,7 @@ class Daemon:
         self.stats.register(self.metrics_registry)
         self.syncer = DataplaneSyncer(
             classifier_factory=make_classifier_factory(
-                backend, fused_deep=fused_deep
+                backend, fused_deep=fused_deep, wire_codec=wire_codec
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -641,10 +661,26 @@ class Daemon:
                 return n
             return min(1 << max(6, (n - 1).bit_length()), self.ingest_chunk)
 
-        def dispatch(job):
-            """Returns a PendingClassify, or raises (eager backends raise
-            HERE, async ones at .result())."""
-            nonlocal packed_ok
+        # Double-buffered ingestion: ``prepare`` does the HOST half of a
+        # dispatch (segment gather + wire pack + codec encode) and — on
+        # backends exposing prepare_packed — STARTS the H2D copy of the
+        # payload; ``launch`` invokes the classify on the staged plan.
+        # The drain loop below keeps up to ``h2d_stage_depth`` prepared
+        # jobs ahead of the in-flight window, so while one chunk's
+        # classify runs on device, the next chunk's transfer is already
+        # in flight and the one after that is being packed/encoded on
+        # the host — the pipeline never stalls on a cold H2D copy.
+        # (getattr defaults keep the bench/tests' Daemon.__new__ ingest
+        # harnesses working without listing every knob.)
+        h2d_overlap = bool(getattr(self, "h2d_overlap", True))
+        can_stage = packed_ok and hasattr(clf, "prepare_packed")
+
+        def prepare(job):
+            """Host pack (+ staged H2D start).  Returns the launch
+            payload, or None when every segment already failed; raises
+            like the old dispatch did (the caller maps it to
+            job_failed)."""
+            nonlocal packed_ok, can_stage
             segs = [(f, idx) for f, idx in job["segments"] if not f["failed"]]
             job["segments"] = segs
             if not segs:
@@ -666,10 +702,38 @@ class Daemon:
                     padrows[:, 0] = KIND_OTHER
                     wire = np.concatenate([wire, padrows])
                 v4_only = all(v4 for _w, v4 in parts)
+                if can_stage and h2d_overlap:
+                    try:
+                        plan = clf.prepare_packed(
+                            wire, v4_only, depth=job.get("depth")
+                        )
+                        return ("plan", plan)
+                    except RuntimeError:
+                        if clf.supports_packed() or clf.active_path is None:
+                            raise
+                        packed_ok = can_stage = False
+                        log.warning(
+                            "table flipped to wide-ruleId mid-tick; "
+                            "falling back to unpacked classify"
+                        )
+                else:
+                    return ("wire", wire, v4_only, job.get("depth"))
+            merged = packets_mod.concat(
+                [f["batch"].take(idx) for f, idx in segs]
+            ).pad_to(_bucket(n))
+            return ("batch", merged)
+
+        def launch(job, prep):
+            """Dispatch the prepared job.  Returns a PendingClassify, or
+            raises (eager backends raise HERE, async ones at .result())."""
+            nonlocal packed_ok, can_stage
+            if prep[0] == "plan":
+                return clf.classify_prepared(prep[1], apply_stats=False)
+            if prep[0] == "wire":
+                _tag, wire, v4_only, depth = prep
                 try:
                     return clf.classify_async_packed(
-                        wire, v4_only, apply_stats=False,
-                        depth=job.get("depth"),
+                        wire, v4_only, apply_stats=False, depth=depth,
                     )
                 except RuntimeError:
                     # A concurrent load_tables can flip the table to
@@ -681,15 +745,22 @@ class Daemon:
                     # unpacked path would raise identically, so re-raise.
                     if clf.supports_packed() or clf.active_path is None:
                         raise
-                    packed_ok = False  # sticky for the rest of the tick
+                    packed_ok = can_stage = False  # sticky for this tick
                     log.warning(
                         "table flipped to wide-ruleId mid-tick; "
                         "falling back to unpacked classify"
                     )
-            merged = packets_mod.concat(
-                [f["batch"].take(idx) for f, idx in segs]
-            ).pad_to(_bucket(n))
-            return clf.classify_async(merged, apply_stats=False)
+                    # job["segments"] as filtered at PREPARE time — the
+                    # drain's offset walk is aligned to that list, so a
+                    # file failing between prepare and launch must not
+                    # re-filter here (drain skips failed files on write)
+                    segs = job["segments"]
+                    n = sum(len(idx) for _f, idx in segs)
+                    merged = packets_mod.concat(
+                        [f["batch"].take(idx) for f, idx in segs]
+                    ).pad_to(_bucket(n))
+                    return clf.classify_async(merged, apply_stats=False)
+            return clf.classify_async(prep[1], apply_stats=False)
 
         def job_failed(job, err) -> None:
             """A merged job's fault cannot be attributed to one file:
@@ -724,16 +795,39 @@ class Daemon:
                 seg_done(f)
 
         inflight: deque = deque()
-        while jobs or inflight:
-            while jobs and len(inflight) < self.pipeline_depth:
+        staged: deque = deque()
+        stage_depth = (
+            getattr(self, "h2d_stage_depth", DEFAULT_H2D_STAGE_DEPTH)
+            if h2d_overlap else 1
+        )
+        def stage_more() -> None:
+            # keep the staging window full: the NEXT jobs' host pack +
+            # codec encode + H2D start run while earlier classifies are
+            # still on device (and while drain_one blocks below)
+            while jobs and len(staged) < stage_depth:
                 job = jobs.popleft()
                 try:
-                    pending = dispatch(job)
+                    prep = prepare(job)
+                except Exception as e:
+                    job_failed(job, e)
+                    continue
+                if prep is not None:
+                    staged.append((job, prep))
+
+        while jobs or staged or inflight:
+            stage_more()
+            while staged and len(inflight) < self.pipeline_depth:
+                job, prep = staged.popleft()
+                try:
+                    pending = launch(job, prep)
                 except Exception as e:
                     job_failed(job, e)
                     continue
                 if pending is not None:
                     inflight.append((job, pending))
+                # top up staging as the window drains so the lookahead
+                # never collapses mid-burst
+                stage_more()
             if inflight:
                 drain_one()
         return processed
@@ -860,6 +954,23 @@ def main(argv: Optional[List[str]] = None) -> int:
              "serves them instead",
     )
     p.add_argument(
+        "--wire-codec", choices=["auto", "wire8", "delta"],
+        default=os.environ.get("INFW_WIRE_CODEC") or None,
+        help="H2D wire format for packed trie chunks (the --no-fused-deep "
+             "precedence pattern: CLI beats INFW_WIRE_CODEC, env beats the "
+             "default): auto = per-chunk choice by measured compressed "
+             "size (delta when it beats wire8's 8 B/packet), wire8/delta "
+             "= force, with eligibility fallbacks",
+    )
+    p.add_argument(
+        "--no-h2d-overlap", action="store_true",
+        default=os.environ.get("INFW_H2D_OVERLAP", "") in ("0", "false", "no"),
+        help="disable double-buffered ingestion (the next chunk's H2D "
+             "copy overlapping the current chunk's classify); chunks then "
+             "stage one at a time — the A/B control the bench's overlap "
+             "margin line measures against",
+    )
+    p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
         help="unixgram socket to ship deny-event lines to (the events "
@@ -870,6 +981,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.node_name:
         p.error("environment variable NODE_NAME or --node-name is required")
+
+    # argparse validates `choices` only for explicitly passed args, not
+    # env-derived defaults — a bad INFW_WIRE_CODEC must fail the launch
+    # here, not fail-open later (TpuClassifier raising inside the sync
+    # loop leaves an empty dataplane that PASSes every packet)
+    if args.wire_codec is not None and args.wire_codec not in (
+        "auto", "wire8", "delta"
+    ):
+        p.error(
+            f"invalid INFW_WIRE_CODEC {args.wire_codec!r} "
+            "(expected auto|wire8|delta)"
+        )
 
     logging.basicConfig(
         level=logging.INFO,
@@ -898,6 +1021,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         pipeline_depth=args.pipeline_depth,
         events_socket=args.events_socket or None,
         fused_deep=False if args.no_fused_deep else None,
+        wire_codec=args.wire_codec,
+        h2d_overlap=not args.no_h2d_overlap,
     )
     stop = threading.Event()
 

@@ -4,4 +4,9 @@
   reference-capacity tables, multibit-trie walk for 100K+ entries).
 - pallas_dense: fused Pallas TPU kernel for the dense path (MXU bit-matmul
   LPM + one-hot rule gather + scan + stats).
+- pallas_walk: fused Pallas deep-walk kernel for the full-depth v6
+  steering class (VMEM-resident extracted deep tail).
+- wire_decode: on-device decode of the delta+varint compressed wire
+  (parallel XLA varint decode; Pallas prefix-scan for fixed-stride
+  plans).
 """

@@ -33,11 +33,12 @@ import logging
 import os
 import signal
 import ssl
+import subprocess
 import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 log = logging.getLogger("infw.obs.metricsproxy")
 
@@ -46,6 +47,61 @@ log = logging.getLogger("infw.obs.metricsproxy")
 _OPENER = urllib.request.build_opener(urllib.request.ProxyHandler({}))
 
 DEFAULT_LISTEN_PORT = 9301  # daemonset.yaml:72 (kube-rbac-proxy :9301)
+
+
+def ensure_self_signed(
+    dir_path: str, cn: str = "infw-metrics", days: int = 3650
+) -> Tuple[str, str]:
+    """Generate (once) and return a self-signed TLS pair under
+    ``dir_path`` — the deployment bootstrap behind DEFAULT-ON TLS: the
+    compose/launcher path always fronts the proxy with TLS, minting this
+    pair when no operator-provided one exists (the reference's
+    kube-rbac-proxy likewise always terminates TLS; serving the bearer
+    token in cleartext requires the explicit --insecure-metrics opt-out).
+    Idempotent: an existing pair is reused, never regenerated.  The key
+    is written 0600 via tmp+rename so a crash cannot leave a readable
+    partial key."""
+    os.makedirs(dir_path, exist_ok=True)
+    crt = os.path.join(dir_path, "metrics-tls.crt")
+    key = os.path.join(dir_path, "metrics-tls.key")
+    if os.path.exists(crt) and os.path.exists(key):
+        return crt, key
+    tmp_crt, tmp_key = crt + ".tmp", key + ".tmp"
+    # pre-create the tmp key 0600 BEFORE openssl writes it (openssl
+    # truncates an existing file, keeping its mode): the private key is
+    # never on disk with umask-default permissions, even transiently or
+    # across a crash mid-generation
+    os.close(os.open(tmp_key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600))
+    try:
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", tmp_key, "-out", tmp_crt, "-days", str(days),
+                 "-nodes", "-subj", f"/CN={cn}",
+                 "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+                check=True, capture_output=True,
+            )
+        except FileNotFoundError:
+            raise RuntimeError(
+                "openssl not found: cannot mint the default-on metrics "
+                "TLS pair; install openssl, provide --certfile/--keyfile, "
+                "or opt out with --insecure-metrics"
+            ) from None
+        except subprocess.CalledProcessError as e:
+            err = (e.stderr or b"").decode(errors="replace").strip()
+            raise RuntimeError(
+                f"openssl failed to mint the metrics TLS pair: {err}"
+            ) from None
+        os.replace(tmp_key, key)
+        os.replace(tmp_crt, crt)
+    finally:
+        for leftover in (tmp_key, tmp_crt):
+            try:
+                os.unlink(leftover)
+            except FileNotFoundError:
+                pass
+    log.info("generated self-signed metrics TLS pair under %s", dir_path)
+    return crt, key
 
 
 def read_token(path: str) -> Optional[str]:
@@ -181,14 +237,23 @@ def main(argv=None) -> int:
                    help="bearer token file (re-read per request)")
     p.add_argument("--certfile", default=None, help="TLS certificate chain")
     p.add_argument("--keyfile", default=None, help="TLS private key")
+    p.add_argument(
+        "--auto-tls-dir", default=None,
+        help="generate (once) and use a self-signed TLS pair under this "
+             "directory when no --certfile is given — the compose "
+             "launcher's default-on TLS bootstrap",
+    )
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    certfile, keyfile = args.certfile, args.keyfile
+    if certfile is None and args.auto_tls_dir:
+        certfile, keyfile = ensure_self_signed(args.auto_tls_dir)
     host, _, port = args.listen.rpartition(":")
     proxy = MetricsProxy(
         upstream=args.upstream, token_file=args.token_file,
         listen_host=host or "0.0.0.0", listen_port=int(port),
-        certfile=args.certfile, keyfile=args.keyfile,
+        certfile=certfile, keyfile=keyfile,
     )
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -758,8 +758,11 @@ def test_wire8_format_roundtrip_and_dispatch():
         np.asarray(db.ip_words), np.asarray(v4.ip_words).astype(np.uint32))
 
     # dispatch through the classifier: wire8 engages on the trie path
+    # (pinned via the codec knob — the default "auto" codec prefers the
+    # delta format when it compresses below 8 B/packet, which this
+    # corpus does; the delta dispatch has its own tests)
     jaxpath.jitted_classify_wire8_fused.cache_clear()
-    clf = TpuClassifier(force_path="trie")
+    clf = TpuClassifier(force_path="trie", wire_codec="wire8")
     clf.load_tables(tables)
     out = clf.classify(v4)
     assert jaxpath.jitted_classify_wire8_fused.cache_info().currsize > 0, (

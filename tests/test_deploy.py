@@ -197,3 +197,70 @@ def test_unknown_component_rejected():
     r = _launcher_dry_run("--component", "no-such", "--state-dir", "/tmp/x")
     assert r.returncode != 0
     assert "unknown component" in r.stderr + r.stdout
+
+
+def test_metrics_proxy_tls_on_by_default():
+    """The metrics proxy launches with TLS by default (the reference's
+    kube-rbac-proxy always terminates TLS): both the --component and the
+    --with-metrics-proxy paths carry --certfile/--keyfile pointing under
+    the state dir's tls/ directory."""
+    for args in (
+        ("--component", "metrics-proxy"),
+        ("--with-metrics-proxy",),
+    ):
+        r = _launcher_dry_run(
+            *args, "--state-dir", "/tmp/infw-tls-plan",
+            "--node-name", "n0", env=_scrubbed_env(),
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "--certfile /tmp/infw-tls-plan/tls/metrics-tls.crt" in r.stdout
+        assert "--keyfile /tmp/infw-tls-plan/tls/metrics-tls.key" in r.stdout
+
+
+def test_metrics_proxy_plaintext_requires_explicit_opt_out():
+    """--insecure-metrics (or INFW_INSECURE_METRICS=1) is the ONLY way to
+    a plaintext proxy; the flag removes the TLS pair from the run line."""
+    r = _launcher_dry_run(
+        "--component", "metrics-proxy", "--insecure-metrics",
+        "--state-dir", "/tmp/infw-tls-plan", "--node-name", "n0",
+        env=_scrubbed_env(),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "--certfile" not in r.stdout
+    env = _scrubbed_env()
+    env["INFW_INSECURE_METRICS"] = "1"
+    r2 = _launcher_dry_run(
+        "--component", "metrics-proxy",
+        "--state-dir", "/tmp/infw-tls-plan", "--node-name", "n0", env=env,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "--certfile" not in r2.stdout
+
+
+def test_with_metrics_proxy_joins_default_composition():
+    """--with-metrics-proxy appends the standalone proxy to the default
+    launch order (the explicit request is the standalone-guard consent);
+    without it the default composition stays proxy-free."""
+    r = _launcher_dry_run(
+        "--with-metrics-proxy", "--state-dir", "/tmp/infw-tls-plan",
+        "--node-name", "n0", env=_scrubbed_env(),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "4 components" in r.stdout
+    assert "infw.obs.metricsproxy" in r.stdout
+    r2 = _launcher_dry_run(
+        "--state-dir", "/tmp/infw-tls-plan", "--node-name", "n0",
+        env=_scrubbed_env(),
+    )
+    assert r2.returncode == 0
+    assert "infw.obs.metricsproxy" not in r2.stdout
+
+
+def test_single_node_script_defaults_metrics_proxy_tls():
+    """single-node.sh fronts metrics with the TLS proxy by default and
+    routes the plaintext opt-out through --insecure-metrics."""
+    with open(os.path.join(DEPLOY, "compose", "single-node.sh")) as f:
+        body = f.read()
+    assert "--with-metrics-proxy" in body
+    assert "--insecure-metrics" in body
+    assert "INFW_INSECURE_METRICS" in body

@@ -166,3 +166,41 @@ def test_stalled_tls_client_does_not_block_scrapes(tmp_path, upstream):
     finally:
         stalled.close()
         proxy.stop()
+
+
+def test_ensure_self_signed_generates_and_reuses(tmp_path, upstream):
+    """The default-on TLS bootstrap (ensure_self_signed): mints a pair
+    once (key 0600), reuses it on the next call, and the proxy serves
+    TLS with it — the path compose/launch.py takes when no operator
+    pair exists."""
+    import stat
+
+    from infw.obs.metricsproxy import ensure_self_signed
+
+    d = str(tmp_path / "tls")
+    crt, key = ensure_self_signed(d)
+    assert os.path.exists(crt) and os.path.exists(key)
+    assert stat.S_IMODE(os.stat(key).st_mode) == 0o600
+    m1 = (os.path.getmtime(crt), os.path.getmtime(key))
+    crt2, key2 = ensure_self_signed(d)  # idempotent: no regeneration
+    assert (crt2, key2) == (crt, key)
+    assert (os.path.getmtime(crt), os.path.getmtime(key)) == m1
+
+    tok = tmp_path / "token"
+    tok.write_text("t")
+    proxy = MetricsProxy(upstream=upstream, token_file=str(tok),
+                         listen_host="127.0.0.1", listen_port=0,
+                         certfile=crt, keyfile=key)
+    assert proxy.tls
+    proxy.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with _get(f"https://127.0.0.1:{proxy.port}/metrics", "t", ctx) as r:
+            assert r.read().decode() == EXPOSITION
+        # plaintext against the default-on TLS listener fails closed
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(f"http://127.0.0.1:{proxy.port}/metrics", "t")
+    finally:
+        proxy.stop()

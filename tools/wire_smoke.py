@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Wire-codec smoke: a 10K-packet frames-file replay through the real
+daemon ingest on CPU (JAX_PLATFORMS=cpu), with the delta+varint codec
+engaged, verified bit-exact against the LPM oracle — plus a host codec
+round-trip.  The `make wire-check` target runs this after the codec unit
+suite; it is the fast local gate for wire-format changes (the full bench
+replay tier is the recorded TPU measurement).
+
+Exit 0 on success; any verdict mismatch, codec ineligibility on the
+smoke corpus, or decode failure is fatal.
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from infw import oracle, testing
+    from infw.backend.tpu import TpuClassifier
+    from infw.daemon import (
+        Daemon, parse_frames_buf, read_frames_any, write_frames_file_v2,
+    )
+    from infw.obs.events import EventRing, EventsLogger
+    from infw.obs.pcap import build_frames_bulk
+    from infw.packets import decode_delta_host, encode_delta_wire
+
+    rng = np.random.default_rng(2024)
+    t0 = time.perf_counter()
+    # > dense limit so the trie path (the codec's home) serves the table
+    tables = testing.random_tables_fast(
+        rng, n_entries=6000, width=4, ifindexes=(2, 3, 4))
+    batch = testing.random_batch_fast(rng, tables, n_packets=10_000)
+    fb = build_frames_bulk(
+        batch.kind, batch.ip_words, batch.proto, batch.dst_port,
+        batch.icmp_type, batch.icmp_code, l4_ok=batch.l4_ok)
+    fb.ifindex = np.asarray(batch.ifindex, np.uint32)
+    print(f"smoke: table+batch built in {time.perf_counter()-t0:.1f}s")
+
+    # host codec round-trip on the replay corpus's v4 share
+    v4 = batch.take(np.nonzero(np.asarray(batch.kind) != 2)[0])
+    v4.ip_words[:, 1:] = 0
+    w4 = v4.pack_wire_v4()
+    enc = encode_delta_wire(w4)
+    if enc is None:
+        print("FAIL: delta codec ineligible on the smoke corpus")
+        return 1
+    cols = decode_delta_host(enc)
+    if not (cols[7] == w4[enc.perm, 3]).all():
+        print("FAIL: host codec round-trip mismatch")
+        return 1
+    print(f"smoke: codec round-trip OK "
+          f"({enc.wire_bytes / enc.n:.2f} B/packet, "
+          f"plan={'fixed' + str(enc.fixed_w) if enc.fixed_w else 'varint'})")
+
+    clf = TpuClassifier(wire_codec="auto")
+    clf.load_tables(tables)
+    with tempfile.TemporaryDirectory(prefix="infw-wire-smoke-") as sd:
+        d = Daemon.__new__(Daemon)  # ingest-only harness (bench.py pattern)
+        d.ingest_dir = os.path.join(sd, "ingest")
+        d.out_dir = os.path.join(sd, "out")
+        os.makedirs(d.ingest_dir)
+        os.makedirs(d.out_dir)
+        d.ingest_chunk = 4096
+        d.pipeline_depth = 4
+        d.max_tick_packets = 1 << 20
+        d.debug_lookup = False
+        d.h2d_overlap = True
+        d.h2d_stage_depth = 2
+        d.ring = EventRing(capacity=1 << 16)
+        d.events_logger = EventsLogger(d.ring, lambda line: None)
+
+        class _Syncer:
+            classifier = clf
+
+        d.syncer = _Syncer()
+        path = os.path.join(d.ingest_dir, "smoke.frames")
+        write_frames_file_v2(path, fb)
+        parsed = parse_frames_buf(read_frames_any(path))
+        t0 = time.perf_counter()
+        done = d.process_ingest_once()
+        dt = time.perf_counter() - t0
+        if done != 1:
+            print(f"FAIL: processed {done}/1 files")
+            return 1
+        stats = clf.wire_stats()
+        if "delta" not in stats or stats["delta"][0] == 0:
+            print(f"FAIL: delta codec never engaged (wire stats: {stats})")
+            return 1
+        rb = np.fromfile(
+            os.path.join(d.out_dir, "smoke.frames.verdicts.bin"), dtype="<u4")
+        ref = oracle.HashLpmOracle(tables).classify(parsed)
+        if not (rb == ref.results).all():
+            bad = int((rb != ref.results).sum())
+            print(f"FAIL: {bad}/{len(rb)} verdicts differ from the oracle")
+            return 1
+        bpp = {k: round(v[1] / max(v[0], 1), 2) for k, v in stats.items()}
+        print(f"smoke: 10K-packet replay OK in {dt:.1f}s "
+              f"(wire bytes/packet by format: {bpp})")
+    clf.close()
+    print("wire-smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
