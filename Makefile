@@ -4,14 +4,16 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench native entry-check dryrun-multichip \
-	spill-read wire-check clean
+.PHONY: test test-fast bench bench-checked native entry-check \
+	dryrun-multichip spill-read wire-check lint static-check clean
 
+# Full suite including slow-marked scale tests (1M analyzer tier, full
+# registry audit); the tier-1 budgeted run and test-fast exclude them.
 test:
 	$(PY) -m pytest tests/ -q
 
 test-fast:
-	$(PY) -m pytest tests/ -q -x
+	$(PY) -m pytest tests/ -q -x -m "not slow"
 
 # One JSON line on stdout; diagnostics on stderr (driver contract).
 bench:
@@ -22,10 +24,42 @@ bench:
 native:
 	$(MAKE) -C infw/backend/native
 
-# Single-chip compile check of the flagship forward step.
+# Single-chip compile check of the flagship forward step, then the
+# static hot-path audit (x64 leaks, host callbacks, recompile lint,
+# Pallas VMEM budget) over every registered jitted entrypoint —
+# --strict so warnings fail CI too.
 entry-check:
 	$(PY) -c "import __graft_entry__ as g, jax; fn, args = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*args)); print('entry OK')"
+	JAX_PLATFORMS=cpu $(PY) tools/infw_lint.py jax --strict
+
+# Lint (ruff when installed, AST fallback otherwise — same conservative
+# F + E9 rule set; see pyproject.toml [tool.ruff]).
+lint:
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check infw tools tests deploy bench.py __graft_entry__.py; \
+	else \
+		$(PY) tools/_lint_fallback.py; \
+	fi
+
+# Repo-level static gate: rule-table semantics + jitted hot-path audit.
+#   1. examples lint — the shipped deny-all example INTENTIONALLY denies
+#      failsafe ports (that finding is the analyzer's demo; see README
+#      "Static analysis"), so that one check is silenced here;
+#   2. the acceptance gate: a table with one injected shadowed rule and
+#      one Allow/Deny conflict must report EXACTLY those two findings,
+#      each witness confirmed by replay against the CPU oracle;
+#   3. the jax audit across the shape ladder, strict.
+static-check: lint
+	$(PY) tools/infw_lint.py rules --ignore failsafe-violation --strict
+	$(PY) tools/infw_lint.py rules --acceptance
+	JAX_PLATFORMS=cpu $(PY) tools/infw_lint.py jax --strict
+	@echo "static-check OK"
+
+# Bench behind the static gate (benchruns/README.md: jaxpr drift must
+# not silently change what the bench measures).  `make bench` itself is
+# left untouched — its stdout is a driver contract.
+bench-checked: static-check bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -1,0 +1,32 @@
+"""Static analysis over the firewall control plane and the jitted hot path.
+
+Two prongs (neither runs in the packet path):
+
+- ``rules``: exact interval/prefix-algebra semantic analysis of a merged
+  rule table — shadowed/redundant rules, LPM-dead sourceCIDRs,
+  cross-object Allow/Deny conflicts, failsafe-coverage proof, and the
+  documented closed-vs-half-open range asymmetry between the admission
+  webhook and the dataplane.  Every per-rule finding carries a concrete
+  witness 5-tuple the differential harness can replay against the CPU
+  oracle.
+- ``jaxcheck``: jaxpr-level audit of the registered jitted entrypoints
+  (``infw.kernels.kernel_entrypoints``) — x64/dtype leaks, host
+  callbacks in the packet path, recompile-trigger lint across the bench
+  shape ladder, and a VMEM budget estimate for each Pallas kernel's
+  block specs.
+
+CLI: ``tools/infw_lint.py`` (``rules`` / ``jax`` subcommands);
+``make static-check`` is the repo-level gate.
+"""
+from . import rules  # noqa: F401  (re-export for infw.analysis.rules)
+
+SEVERITIES = ("error", "warning", "info")
+
+
+def max_severity(findings) -> str:
+    """Highest severity present in ``findings`` ('info' when empty)."""
+    rank = {s: i for i, s in enumerate(SEVERITIES)}
+    best = len(SEVERITIES) - 1
+    for f in findings:
+        best = min(best, rank.get(f.severity, len(SEVERITIES) - 1))
+    return SEVERITIES[best]

@@ -1,0 +1,1019 @@
+"""Rule-table semantic analyzer.
+
+The admission webhook (infw.validate) checks per-object *shape*; nothing
+in the reference proves anything about the *semantics* of the merged
+table the dataplane actually runs.  This module closes that gap with
+exact interval/prefix algebra over the compiled table content (the
+``LpmKey -> (R, 7) rule rows`` map — the same representation every
+backend classifies from), so spec-level and content-level analysis share
+one engine.
+
+Checks (check ids):
+
+- ``shadowed-rule``     an earlier rule whose match set covers a later
+                        rule with a DIFFERENT action — the later rule is
+                        unreachable and the user's intent is silently
+                        inverted (error).
+- ``redundant-rule``    same coverage, same action — unreachable but
+                        harmless (info).
+- ``lpm-dead-cidr``     a prefix fully covered by more-specific siblings
+                        — no packet ever longest-matches it (warning
+                        when the covering rules differ, info otherwise).
+- ``allow-deny-conflict`` / ``cross-object-conflict``
+                        a descendant prefix's verdict contradicts its
+                        nearest ancestor's on an overlapping
+                        (proto, port/icmp) cell — legal, but packets in
+                        the descendant silently bypass the ancestor's
+                        intent (warning).  The spec-level wrapper
+                        upgrades the id to ``cross-object-conflict``
+                        when the two cells come from different
+                        IngressNodeFirewall objects.
+- ``failsafe-violation`` a reachable Deny verdict on a failsafe port
+                        (failsaferules).  The webhook only checks
+                        explicit TCP/UDP rules; catch-all Deny rules and
+                        direct content sail through it (error).  Zero
+                        findings == the failsafe coverage proof.
+- ``range-asymmetry``   a Deny port range whose closed-interval webhook
+                        check disagrees with the dataplane's half-open
+                        match at a failsafe port (the documented
+                        asymmetry, validate.py:14-16) (warning).
+- ``unmatchable-rule``  a rule no packet can ever match: empty port
+                        range, unknown protocol number, or an ICMP
+                        family unreachable from this prefix (info).
+- ``duplicate-order`` / ``aliasing-cidrs`` / ``compile-error``
+                        spec-level merge hazards (error).
+
+Every per-rule finding carries a concrete witness 5-tuple
+(src address, proto, dst port, icmp type/code + ifindex and family)
+and the packed result the dataplane must produce for it —
+``replay_witnesses`` confirms them against the CPU oracle, and the
+property tests replay them against the native C++ reference classifier.
+"""
+from __future__ import annotations
+
+import ipaddress
+from bisect import bisect_left, bisect_right
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import failsaferules
+from ..compiler import CompiledTables, LpmKey
+from ..constants import (
+    ALLOW,
+    DENY,
+    IPPROTO_ICMP,
+    IPPROTO_ICMPV6,
+    IPPROTO_SCTP,
+    IPPROTO_TCP,
+    IPPROTO_UDP,
+    KIND_IPV4,
+    KIND_IPV6,
+)
+from ..oracle import _scan_rules
+from ..packets import PacketBatch
+
+_TRANSPORT = (IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP)
+_KNOWN_PROTOS = (0, IPPROTO_TCP, IPPROTO_UDP, IPPROTO_SCTP, IPPROTO_ICMP,
+                 IPPROTO_ICMPV6)
+
+#: entries above this skip the pairwise ancestor/descendant conflict
+#: probe (the only super-linear check) — a capped run says so with an
+#: ``analysis-capped`` info finding instead of silently truncating
+CONFLICT_MAX_ENTRIES = 65536
+
+#: chunk of entries per vectorized (T, R, R) cover pass
+_CHUNK_T = 4096
+
+
+# --- findings ---------------------------------------------------------------
+
+
+@dataclass
+class Witness:
+    """A concrete packet the finding predicts the verdict of.
+
+    ``expect_result`` is the packed (ruleId << 8 | action) u32 the
+    dataplane must return for this packet — the replay harness checks
+    it bit-exact against the CPU oracle / native reference."""
+
+    ifindex: int
+    src_addr: str
+    kind: int  # KIND_IPV4 | KIND_IPV6
+    proto: int
+    dst_port: int
+    icmp_type: int
+    icmp_code: int
+    expect_result: int
+
+    @property
+    def expect_rule_id(self) -> int:
+        return (self.expect_result >> 8) & 0xFFFFFF
+
+    @property
+    def expect_action(self) -> int:
+        return self.expect_result & 0xFF
+
+    def to_dict(self) -> dict:
+        return {
+            "ifindex": self.ifindex,
+            "srcAddr": self.src_addr,
+            "kind": "v4" if self.kind == KIND_IPV4 else "v6",
+            "proto": self.proto,
+            "dstPort": self.dst_port,
+            "icmpType": self.icmp_type,
+            "icmpCode": self.icmp_code,
+            "expectRuleId": self.expect_rule_id,
+            "expectAction": {ALLOW: "Allow", DENY: "Deny"}.get(
+                self.expect_action, "Undef"
+            ),
+        }
+
+
+@dataclass
+class Finding:
+    check: str
+    severity: str  # "error" | "warning" | "info"
+    entry: str     # human label of the table cell, e.g. "if2 10.0.0.0/8"
+    message: str
+    orders: Tuple[int, ...] = ()
+    witness: Optional[Witness] = None
+    objects: Tuple[str, ...] = ()  # spec-level attribution
+
+    def to_dict(self) -> dict:
+        d = {
+            "check": self.check,
+            "severity": self.severity,
+            "entry": self.entry,
+            "message": self.message,
+            "orders": list(self.orders),
+        }
+        if self.witness is not None:
+            d["witness"] = self.witness.to_dict()
+        if self.objects:
+            d["objects"] = list(self.objects)
+        return d
+
+
+def witness_batch(witnesses: Sequence[Witness]) -> PacketBatch:
+    """Witness 5-tuples -> a PacketBatch the differential harness can
+    feed to any classifier backend."""
+    b = len(witnesses)
+    words = np.zeros((b, 4), np.uint32)
+    for i, w in enumerate(witnesses):
+        ip = ipaddress.ip_address(w.src_addr)
+        data = bytearray(16)
+        if isinstance(ip, ipaddress.IPv4Address):
+            data[0:4] = ip.packed
+        else:
+            data[0:16] = ip.packed
+        for j in range(4):
+            words[i, j] = int.from_bytes(bytes(data[4 * j : 4 * j + 4]), "big")
+    return PacketBatch(
+        kind=np.array([w.kind for w in witnesses], np.int32),
+        l4_ok=np.ones(b, np.int32),
+        ifindex=np.array([w.ifindex for w in witnesses], np.int32),
+        ip_words=words,
+        proto=np.array([w.proto for w in witnesses], np.int32),
+        dst_port=np.array([w.dst_port for w in witnesses], np.int32),
+        icmp_type=np.array([w.icmp_type for w in witnesses], np.int32),
+        icmp_code=np.array([w.icmp_code for w in witnesses], np.int32),
+        pkt_len=np.full(b, 100, np.int32),
+    )
+
+
+# --- entry geometry ---------------------------------------------------------
+
+
+class _Entries:
+    """Deduped table entries with the prefix-algebra index.
+
+    Addresses are 128-bit Python ints (big-endian over the 16-byte key
+    data, masked); per-ifindex sorted (lo, mask, t) lists support the
+    descendant/ancestor range queries exactly (prefix intervals are
+    nested or disjoint, never partially overlapping)."""
+
+    def __init__(self, content: Dict[LpmKey, np.ndarray]):
+        dedup: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray]] = {}
+        for key, rows in content.items():
+            dedup[key.masked_identity()] = (key, np.asarray(rows, np.int32))
+        self.keys: List[LpmKey] = []
+        self.rows: List[np.ndarray] = []
+        self.ifx: List[int] = []
+        self.mask: List[int] = []
+        self.lo: List[int] = []
+        for ident, (key, rows) in dedup.items():
+            self.keys.append(key)
+            self.rows.append(rows)
+            self.ifx.append(key.ingress_ifindex)
+            self.mask.append(key.mask_len)
+            self.lo.append(int.from_bytes(ident[2], "big"))
+        self.T = len(self.keys)
+        # per-ifindex sorted (lo, mask, t)
+        self._by_if: Dict[int, List[Tuple[int, int, int]]] = {}
+        for t in range(self.T):
+            self._by_if.setdefault(self.ifx[t], []).append(
+                (self.lo[t], self.mask[t], t)
+            )
+        for lst in self._by_if.values():
+            lst.sort()
+        self._los: Dict[int, List[int]] = {
+            ifx: [e[0] for e in lst] for ifx, lst in self._by_if.items()
+        }
+        self._dead: Dict[int, bool] = {}
+
+    def size(self, t: int) -> int:
+        return 1 << (128 - self.mask[t])
+
+    def hi(self, t: int) -> int:
+        return self.lo[t] + self.size(t)
+
+    def label(self, t: int) -> str:
+        m = self.mask[t]
+        lo = self.lo[t]
+        if m <= 32 and (lo & ((1 << 96) - 1)) == 0:
+            addr = str(ipaddress.IPv4Address(lo >> 96))
+        else:
+            addr = str(ipaddress.IPv6Address(lo))
+        return f"if{self.ifx[t]} {addr}/{m}"
+
+    # -- range queries -------------------------------------------------------
+
+    def in_range(self, t: int) -> List[Tuple[int, int, int]]:
+        """All OTHER entries whose lo falls inside entry t's prefix —
+        its descendants plus same-lo ancestors."""
+        lst = self._by_if[self.ifx[t]]
+        los = self._los[self.ifx[t]]
+        a = bisect_left(los, self.lo[t])
+        b = bisect_right(los, self.hi(t) - 1)
+        return [e for e in lst[a:b] if e[2] != t]
+
+    def descendants(self, t: int) -> List[Tuple[int, int, int]]:
+        m = self.mask[t]
+        return [e for e in self.in_range(t) if e[1] > m]
+
+    def ancestor_map(self) -> Dict[int, int]:
+        """entry -> its nearest (deepest) strictly-containing entry, for
+        every entry that has one.  One O(n) stack sweep per ifindex
+        (prefix intervals are nested or disjoint, so the enclosing block
+        is always the top of the containment stack)."""
+        out: Dict[int, int] = {}
+        for lst in self._by_if.values():
+            stack: List[Tuple[int, int, int]] = []  # (lo, hi, t)
+            for lo, m, t in lst:
+                hi = lo + (1 << (128 - m))
+                while stack and stack[-1][1] <= lo:
+                    stack.pop()
+                if stack:
+                    out[t] = stack[-1][2]
+                stack.append((lo, hi, t))
+        return out
+
+    def deepest_match(self, t_excl: int, addr: int, ifindex: int,
+                      v4_packet: bool) -> Optional[int]:
+        """Longest-prefix winner for ``addr`` excluding entry ``t_excl``
+        (used to resolve what a dead entry's traffic really hits)."""
+        best = None
+        best_mask = -1
+        for lo_a, m_a, t_a in self._by_if.get(ifindex, ()):
+            if t_a == t_excl:
+                continue
+            if v4_packet and m_a > 32:
+                continue
+            if m_a > best_mask and (addr >> (128 - m_a) if m_a else 0) == (
+                lo_a >> (128 - m_a) if m_a else 0
+            ):
+                best, best_mask = t_a, m_a
+        return best
+
+    # -- liveness / free addresses -------------------------------------------
+
+    def _gap(self, span_lo: int, span_size: int,
+             blocks: List[Tuple[int, int]]) -> Optional[int]:
+        """First address in [span_lo, span_lo + span_size) not covered by
+        the (lo, size) blocks, or None when fully covered."""
+        cur = span_lo
+        end = span_lo + span_size
+        for lo, size in sorted(blocks):
+            if lo > cur:
+                return cur
+            cur = max(cur, lo + size)
+            if cur >= end:
+                return None
+        return cur if cur < end else None
+
+    def free_addr(self, t: int, want_v4: bool) -> Optional[int]:
+        """A 128-bit address that longest-matches entry t for the wanted
+        packet family (v4 packets cannot reach entries with mask > 32 —
+        the packet-side key cap)."""
+        m = self.mask[t]
+        if want_v4:
+            if m > 32:
+                return None
+            blocks = [
+                (lo >> 96, 1 << (32 - mk))
+                for lo, mk, _ in self.descendants(t)
+                if mk <= 32
+            ]
+            g = self._gap(self.lo[t] >> 96, 1 << (32 - m), blocks)
+            return None if g is None else g << 96
+        blocks = [
+            (lo, 1 << (128 - mk)) for lo, mk, _ in self.descendants(t)
+        ]
+        return self._gap(self.lo[t], self.size(t), blocks)
+
+    def is_dead(self, t: int) -> bool:
+        """True when no packet of any family can longest-match entry t.
+
+        For mask <= 32 the v4 projection decides: coverage of the 32-bit
+        space by mask' <= 32 descendants extends to the full 128-bit
+        space too (prefix masks only constrain their first mask' bits),
+        while mask' > 32 descendants can never cover a mask <= 32 prefix
+        (they cannot match v4 packets at all)."""
+        cached = self._dead.get(t)
+        if cached is not None:
+            return cached
+        m = self.mask[t]
+        dead = self.free_addr(t, want_v4=m <= 32) is None
+        self._dead[t] = dead
+        return dead
+
+
+def _addr_str(addr: int, kind: int) -> str:
+    if kind == KIND_IPV4:
+        return str(ipaddress.IPv4Address(addr >> 96))
+    return str(ipaddress.IPv6Address(addr))
+
+
+# --- rule-row algebra -------------------------------------------------------
+
+
+def _row_fields(rows: np.ndarray):
+    """(..., R, 7) -> per-field views."""
+    return (rows[..., 0], rows[..., 1], rows[..., 2], rows[..., 3],
+            rows[..., 4], rows[..., 5], rows[..., 6])
+
+
+def _matchable_rows(
+    rows: np.ndarray, v4_live: np.ndarray, v6_live: np.ndarray
+) -> np.ndarray:
+    """(T, R, 7) + per-entry family liveness -> (T, R) bool: rules some
+    reachable packet can actually match."""
+    rid, proto, ps, pe, _it, _ic, _act = _row_fields(rows)
+    valid = rid != 0
+    known = np.isin(proto, _KNOWN_PROTOS)
+    empty = np.isin(proto, _TRANSPORT) & (pe != 0) & (pe <= ps)
+    v4 = v4_live[:, None]
+    v6 = v6_live[:, None]
+    fam_ok = np.where(
+        proto == IPPROTO_ICMP, v4,
+        np.where(proto == IPPROTO_ICMPV6, v6, v4 | v6),
+    )
+    return valid & known & ~empty & fam_ok
+
+
+def _cover_matrix(rows: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """(C, R, 7) packed rows + (C, R) matchable -> (C, R, R) bool where
+    cover[c, i, j] means every packet matching rule j also matches rule
+    i (i, j are SCAN positions; only i < j entries are meaningful)."""
+    rid, proto, ps, pe, it, ic, _act = _row_fields(rows.astype(np.int64))
+    R = rows.shape[-2]
+    mi = m[:, :, None]
+    mj = m[:, None, :]
+    tri = np.tril(np.ones((R, R), bool), -1).T  # [i, j] True iff i < j
+    catch_i = (proto == 0)[:, :, None]
+    is_tr = np.isin(proto, _TRANSPORT)
+    same_t = (
+        is_tr[:, :, None] & is_tr[:, None, :]
+        & (proto[:, :, None] == proto[:, None, :])
+    )
+    psi, pei = ps[:, :, None], pe[:, :, None]
+    psj, pej = ps[:, None, :], pe[:, None, :]
+    j_single = pej == 0
+    cover_t = same_t & np.where(
+        j_single,
+        np.where(pei == 0, psi == psj, (psi <= psj) & (psj < pei)),
+        np.where(
+            pei == 0,
+            (pej == psj + 1) & (psi == psj),
+            (psi <= psj) & (pej <= pei),
+        ),
+    )
+    is_ic = np.isin(proto, (IPPROTO_ICMP, IPPROTO_ICMPV6))
+    same_ic = is_ic[:, :, None] & (proto[:, :, None] == proto[:, None, :])
+    cover_ic = (
+        same_ic
+        & (it[:, :, None] == it[:, None, :])
+        & (ic[:, :, None] == ic[:, None, :])
+    )
+    return mi & mj & tri & (catch_i | cover_t | cover_ic)
+
+
+def _rule_cell(row: np.ndarray) -> Optional[Tuple[int, int, int, int]]:
+    """Representative (proto, dport, icmp_type, icmp_code) packet cell
+    inside the rule's match set, or None for a match-nothing rule."""
+    _rid, proto, ps, pe, it, ic, _act = (int(x) for x in row)
+    if proto == 0:
+        return (255, 0, 0, 0)  # unassigned protocol: only catch-alls match
+    if proto in _TRANSPORT:
+        if pe != 0 and pe <= ps:
+            return None
+        return (proto, ps, 0, 0)
+    if proto in (IPPROTO_ICMP, IPPROTO_ICMPV6):
+        return (proto, 0, it, ic)
+    return None
+
+
+def _scan(rows: np.ndarray, cell: Tuple[int, int, int, int], is_v4: bool) -> int:
+    """Packed first-match result for a packet cell (the oracle's ordered
+    scan, bit-exact)."""
+    proto, dport, itype, icode = cell
+    return _scan_rules(rows, proto, dport, itype, icode, is_v4)
+
+
+def _cell_kind(entries: _Entries, t: int, proto: int) -> Optional[int]:
+    """Packet family a witness for (entry t, proto cell) must use, or
+    None when no reachable family can carry that protocol."""
+    v4_ok = entries.mask[t] <= 32 and entries.free_addr(t, True) is not None
+    v6_ok = entries.free_addr(t, False) is not None
+    if proto == IPPROTO_ICMP:
+        return KIND_IPV4 if v4_ok else None
+    if proto == IPPROTO_ICMPV6:
+        return KIND_IPV6 if v6_ok else None
+    if v4_ok:
+        return KIND_IPV4
+    return KIND_IPV6 if v6_ok else None
+
+
+def _make_witness(
+    entries: _Entries, t: int, cell: Tuple[int, int, int, int]
+) -> Optional[Witness]:
+    """Witness packet hitting entry t at the given cell, with the
+    expected packed verdict from the entry's own ordered scan."""
+    kind = _cell_kind(entries, t, cell[0])
+    if kind is None:
+        return None
+    addr = entries.free_addr(t, kind == KIND_IPV4)
+    if addr is None:
+        return None
+    expect = _scan(entries.rows[t], cell, kind == KIND_IPV4)
+    return Witness(
+        ifindex=entries.ifx[t],
+        src_addr=_addr_str(addr, kind),
+        kind=kind,
+        proto=cell[0],
+        dst_port=cell[1],
+        icmp_type=cell[2],
+        icmp_code=cell[3],
+        expect_result=int(expect),
+    )
+
+
+# --- the content-level engine -----------------------------------------------
+
+
+def analyze_content(
+    content,
+    checks: Optional[Iterable[str]] = None,
+    conflict_max_entries: int = CONFLICT_MAX_ENTRIES,
+) -> List[Finding]:
+    """Analyze compiled table content (``Dict[LpmKey, rows]`` or a
+    CompiledTables).  ``checks`` restricts to a subset of check ids."""
+    if isinstance(content, CompiledTables):
+        content = content.content
+    entries = _Entries(content)
+    want = None if checks is None else set(checks)
+
+    def on(check: str) -> bool:
+        return want is None or check in want
+
+    findings: List[Finding] = []
+    if entries.T == 0:
+        return findings
+
+    width = max(r.shape[0] for r in entries.rows)
+    rows_t = np.zeros((entries.T, width, 7), np.int32)
+    for t, r in enumerate(entries.rows):
+        rows_t[t, : r.shape[0]] = r
+
+    live = np.ones(entries.T, bool)
+    dead_idx = _dead_candidates(entries)
+    for t in dead_idx:
+        if not entries.is_dead(t):
+            continue
+        live[t] = False
+        if on("lpm-dead-cidr"):
+            findings.append(_dead_finding(entries, t))
+
+    # per-entry matchability flags (for live entries)
+    mask_arr = np.asarray(entries.mask, np.int64)
+    v4_live = (mask_arr <= 32) & live
+    match_t = _matchable_rows(rows_t, v4_live, live) & live[:, None]
+
+    if on("unmatchable-rule"):
+        findings.extend(_unmatchable_findings(entries, rows_t, match_t, live))
+    if on("shadowed-rule") or on("redundant-rule"):
+        findings.extend(
+            _shadow_findings(entries, rows_t, match_t, live, on)
+        )
+    if on("failsafe-violation"):
+        findings.extend(_failsafe_findings(entries, rows_t, live))
+    if on("range-asymmetry"):
+        findings.extend(_asymmetry_findings(entries, rows_t, match_t, live))
+    if on("allow-deny-conflict"):
+        findings.extend(
+            _conflict_findings(entries, rows_t, match_t, live,
+                               conflict_max_entries)
+        )
+    order = {"error": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: (order.get(f.severity, 3), f.check, f.entry))
+    return findings
+
+
+def analyze_tables(tables: CompiledTables, **kw) -> List[Finding]:
+    return analyze_content(tables.content, **kw)
+
+
+def _dead_candidates(entries: _Entries) -> List[int]:
+    """Entries that have at least one descendant (cheap reject first:
+    an entry whose descendants' block sizes cannot sum to its own size
+    is provably not fully covered — float64 with margin, exact check
+    only for survivors)."""
+    out = []
+    for t in range(entries.T):
+        desc = entries.descendants(t)
+        if not desc:
+            continue
+        m = entries.mask[t]
+        if m <= 32:
+            need = float(1 << (32 - m))
+            total = sum(
+                float(1 << (32 - mk)) for _, mk, _ in desc if mk <= 32
+            )
+        else:
+            need = float(1 << (128 - m))
+            total = sum(float(1 << (128 - mk)) for _, mk, _ in desc)
+        if total >= 0.99 * need:
+            out.append(t)
+    return out
+
+
+def _dead_finding(entries: _Entries, t: int) -> Finding:
+    """lpm-dead-cidr with a witness proving the traffic lands elsewhere:
+    the entry's base address classifies to the deepest covering sibling's
+    verdict."""
+    rows = entries.rows[t]
+    rid = rows[:, 0]
+    cell = None
+    for r in range(rows.shape[0]):
+        if rid[r] != 0:
+            cell = _rule_cell(rows[r])
+            if cell is not None:
+                break
+    witness = None
+    differs = False
+    if cell is not None:
+        v4 = entries.mask[t] <= 32
+        kind = KIND_IPV4 if (v4 and cell[0] != IPPROTO_ICMPV6) else KIND_IPV6
+        if cell[0] == IPPROTO_ICMP and kind != KIND_IPV4:
+            cell = (255, 0, 0, 0)
+        winner = entries.deepest_match(
+            t, entries.lo[t], entries.ifx[t], kind == KIND_IPV4
+        )
+        if winner is not None:
+            expect = _scan(entries.rows[winner], cell, kind == KIND_IPV4)
+            own = _scan(rows, cell, kind == KIND_IPV4)
+            differs = (expect & 0xFF) != (own & 0xFF)
+            witness = Witness(
+                ifindex=entries.ifx[t],
+                src_addr=_addr_str(entries.lo[t], kind),
+                kind=kind,
+                proto=cell[0],
+                dst_port=cell[1],
+                icmp_type=cell[2],
+                icmp_code=cell[3],
+                expect_result=int(expect),
+            )
+    return Finding(
+        check="lpm-dead-cidr",
+        severity="warning" if differs else "info",
+        entry=entries.label(t),
+        message=(
+            "prefix is fully covered by more-specific siblings; no packet "
+            "ever longest-matches it"
+            + (" (covering verdicts differ)" if differs else "")
+        ),
+        witness=witness,
+    )
+
+
+def _unmatchable_findings(entries, rows_t, match_t, live) -> List[Finding]:
+    out = []
+    valid = rows_t[..., 0] != 0
+    bad = valid & ~match_t & live[:, None]
+    for t, r in zip(*np.nonzero(bad)):
+        row = rows_t[t, r]
+        proto, ps, pe = int(row[1]), int(row[2]), int(row[3])
+        if proto in _TRANSPORT and pe != 0 and pe <= ps:
+            why = f"empty half-open port range {ps}-{pe}"
+        elif proto not in _KNOWN_PROTOS:
+            why = f"unknown protocol {proto} never matches the rule scan"
+        else:
+            why = "ICMP family unreachable from this prefix"
+        out.append(Finding(
+            check="unmatchable-rule",
+            severity="info",
+            entry=entries.label(int(t)),
+            message=f"rule order {int(row[0])}: {why}",
+            orders=(int(row[0]),),
+        ))
+    return out
+
+
+def _shadow_findings(entries, rows_t, match_t, live, on) -> List[Finding]:
+    out = []
+    T, width = rows_t.shape[:2]
+    # adaptive chunk: keep the (C, R, R) broadcast under ~2M cells
+    chunk = max(64, _CHUNK_T * 256 // max(256, width * width))
+    for c0 in range(0, T, chunk):
+        c1 = min(c0 + chunk, T)
+        cover = _cover_matrix(rows_t[c0:c1], match_t[c0:c1])
+        if not cover.any():
+            continue
+        for tt in np.nonzero(cover.any(axis=(1, 2)))[0]:
+            t = c0 + int(tt)
+            if not live[t]:
+                continue
+            cov = cover[tt]
+            for j in np.nonzero(cov.any(axis=0))[0]:
+                i = int(np.argmax(cov[:, j]))
+                ri, rj = rows_t[t, i], rows_t[t, int(j)]
+                same = int(ri[6]) == int(rj[6])
+                check = "redundant-rule" if same else "shadowed-rule"
+                if not on(check):
+                    continue
+                cell = _rule_cell(rj)
+                witness = (
+                    _make_witness(entries, t, cell) if cell is not None else None
+                )
+                if witness is not None and witness.expect_rule_id == int(rj[0]):
+                    witness = None  # shadow claim not actually true
+                if witness is None and not same:
+                    continue
+                out.append(Finding(
+                    check=check,
+                    severity="info" if same else "error",
+                    entry=entries.label(t),
+                    message=(
+                        f"rule order {int(rj[0])} is unreachable: order "
+                        f"{int(ri[0])} already matches every packet it "
+                        "would match"
+                        + ("" if same else
+                           f" with the opposite action "
+                           f"({_act_name(int(ri[6]))} vs {_act_name(int(rj[6]))})")
+                    ),
+                    orders=(int(ri[0]), int(rj[0])),
+                    witness=witness,
+                ))
+    return out
+
+
+def _act_name(a: int) -> str:
+    return {ALLOW: "Allow", DENY: "Deny"}.get(a, f"action{a}")
+
+
+def _failsafe_findings(entries, rows_t, live) -> List[Finding]:
+    out = []
+    T = rows_t.shape[0]
+    rid, proto, ps, pe, _it, _ic, act = _row_fields(rows_t.astype(np.int64))
+    valid = rid != 0
+    per_entry: Dict[int, List[Tuple[str, int, int]]] = {}
+    for fs_proto, fs_list in (
+        (IPPROTO_TCP, failsaferules.get_tcp()),
+        (IPPROTO_UDP, failsaferules.get_udp()),
+    ):
+        for fs in fs_list:
+            port = fs.port
+            hit = valid & (
+                ((proto == fs_proto)
+                 & np.where(pe == 0, ps == port, (ps <= port) & (port < pe)))
+                | (proto == 0)
+            )
+            any_hit = hit.any(axis=1)
+            first = np.argmax(hit, axis=1)
+            denied = any_hit & (act[np.arange(T), first] == DENY) & live
+            for t in np.nonzero(denied)[0]:
+                per_entry.setdefault(int(t), []).append(
+                    (fs.service_name, fs_proto, port)
+                )
+    for t, hits in per_entry.items():
+        svc, fs_proto, port = hits[0]
+        cell = (fs_proto, port, 0, 0)
+        witness = _make_witness(entries, t, cell)
+        if witness is None:
+            continue
+        denying = witness.expect_rule_id
+        names = ", ".join(sorted({f"{h[0]}:{h[2]}" for h in hits}))
+        out.append(Finding(
+            check="failsafe-violation",
+            severity="error",
+            entry=entries.label(t),
+            message=(
+                f"reachable Deny covers failsafe port(s) {names} "
+                f"(rule order {denying})"
+            ),
+            orders=(denying,),
+            witness=witness,
+        ))
+    return out
+
+
+def _asymmetry_findings(entries, rows_t, match_t, live) -> List[Finding]:
+    out = []
+    fs_ports = {
+        IPPROTO_TCP: {fs.port for fs in failsaferules.get_tcp()},
+        IPPROTO_UDP: {fs.port for fs in failsaferules.get_udp()},
+    }
+    rid, proto, _ps, pe, _it, _ic, act = _row_fields(rows_t)
+    cand = (
+        match_t & (act == DENY) & (pe != 0)
+        & ((proto == IPPROTO_TCP) | (proto == IPPROTO_UDP))
+        & live[:, None]
+    )
+    for t, r in zip(*np.nonzero(cand)):
+        p = int(proto[t, r])
+        end = int(pe[t, r])
+        if end not in fs_ports[p]:
+            continue
+        cell = (p, end, 0, 0)
+        witness = _make_witness(entries, int(t), cell)
+        out.append(Finding(
+            check="range-asymmetry",
+            severity="warning",
+            entry=entries.label(int(t)),
+            message=(
+                f"Deny range ends at failsafe port {end}: the webhook's "
+                "CLOSED-interval check treats it as covered while the "
+                "dataplane's half-open match never denies it"
+            ),
+            orders=(int(rid[t, r]),),
+            witness=witness,
+        ))
+    return out
+
+
+def _conflict_findings(entries, rows_t, match_t, live, cap) -> List[Finding]:
+    acts = rows_t[..., 6][rows_t[..., 0] != 0]
+    if not ((acts == ALLOW).any() and (acts == DENY).any()):
+        return []
+    if entries.T > cap:
+        return [Finding(
+            check="analysis-capped",
+            severity="info",
+            entry=f"{entries.T} entries",
+            message=(
+                f"allow-deny-conflict probe skipped above "
+                f"{cap} entries (pass conflict_max_entries to raise)"
+            ),
+        )]
+    out = []
+    anc_map = entries.ancestor_map()
+    for t in range(entries.T):
+        if not live[t]:
+            continue
+        anc = anc_map.get(t)
+        if anc is None or not live[anc]:
+            continue
+        cells = []
+        for src in (anc, t):
+            for r in np.nonzero(match_t[src])[0]:
+                cell = _rule_cell(rows_t[src, int(r)])
+                if cell is not None and cell not in cells:
+                    cells.append(cell)
+        for cell in cells[:32]:
+            kind = _cell_kind(entries, t, cell[0])
+            if kind is None:
+                continue
+            is_v4 = kind == KIND_IPV4
+            if is_v4 and entries.mask[anc] > 32:
+                continue
+            res_t = _scan(entries.rows[t], cell, is_v4)
+            res_a = _scan(entries.rows[anc], cell, is_v4)
+            act_t, act_a = res_t & 0xFF, res_a & 0xFF
+            if {act_t, act_a} == {ALLOW, DENY}:
+                witness = _make_witness(entries, t, cell)
+                if witness is None:
+                    continue
+                out.append(Finding(
+                    check="allow-deny-conflict",
+                    severity="warning",
+                    entry=entries.label(t),
+                    message=(
+                        f"verdict {_act_name(act_t)} (rule order "
+                        f"{(res_t >> 8) & 0xFFFFFF}) contradicts ancestor "
+                        f"{entries.label(anc)}'s {_act_name(act_a)} (rule "
+                        f"order {(res_a >> 8) & 0xFFFFFF}) on an "
+                        f"overlapping cell"
+                    ),
+                    orders=((res_a >> 8) & 0xFFFFFF, (res_t >> 8) & 0xFFFFFF),
+                    witness=witness,
+                ))
+                break
+    return out
+
+
+# --- replay harness ---------------------------------------------------------
+
+
+def replay_witnesses(
+    tables, findings: Sequence[Finding], classifier=None
+) -> List[Tuple[Finding, bool, int]]:
+    """Replay every finding's witness against a classifier and check the
+    predicted packed result bit-exact.
+
+    ``classifier``: anything with ``classify(batch) -> ClassifyResult``;
+    defaults to the NumPy LPM oracle over ``tables`` (a CompiledTables or
+    content dict).  Returns [(finding, confirmed, got_result)]."""
+    from .. import oracle
+    from ..compiler import compile_tables_from_content
+
+    with_w = [f for f in findings if f.witness is not None]
+    if not with_w:
+        return []
+    if classifier is None:
+        if not isinstance(tables, CompiledTables):
+            tables = compile_tables_from_content(dict(tables))
+        classifier = oracle.HashLpmOracle(tables)
+    batch = witness_batch([f.witness for f in with_w])
+    res = classifier.classify(batch)
+    out = []
+    for i, f in enumerate(with_w):
+        got = int(res.results[i])
+        out.append((f, got == f.witness.expect_result, got))
+    return out
+
+
+# --- spec-level wrapper -----------------------------------------------------
+
+
+@dataclass
+class _Cell:
+    cidr: str
+    rules: List = field(default_factory=list)       # protocol rule specs
+    sources: Dict[int, str] = field(default_factory=dict)  # order -> object
+
+
+def analyze_infs(
+    infs: Sequence,
+    iface_index: Optional[Dict[str, int]] = None,
+    checks: Optional[Iterable[str]] = None,
+    content_sink: Optional[List] = None,
+) -> List[Finding]:
+    """Semantic analysis of the MERGED table a set of IngressNodeFirewall
+    objects compiles to (grouped by nodeSelector, merged per interface
+    and CIDR exactly like the fan-out controller's mergeRuleSet), with
+    per-object attribution on cross-object findings."""
+    from ..compiler import CompileError, build_key, encode_rules
+    from ..spec import IngressNodeFirewallRules
+
+    if checks is not None:
+        checks = set(checks)
+        if "cross-object-conflict" in checks:
+            # the content engine's id for the same analysis
+            checks.add("allow-deny-conflict")
+    findings: List[Finding] = []
+
+    def emit(f: Finding) -> None:
+        """Spec-level findings honor the same ``checks`` filter the
+        content engine applies to its own."""
+        if checks is None or f.check in checks:
+            findings.append(f)
+    groups: Dict[tuple, list] = {}
+    for inf in infs:
+        sel = tuple(sorted(dict(inf.spec.node_selector).items()))
+        groups.setdefault(sel, []).append(inf)
+
+    for sel, group in groups.items():
+        # iface -> cidr -> _Cell with merged rules + attribution
+        per_iface: Dict[str, Dict[str, _Cell]] = {}
+        for inf in group:
+            name = inf.metadata.name or "<unnamed>"
+            for iface in inf.spec.interfaces:
+                cells = per_iface.setdefault(iface, {})
+                for ingress in inf.spec.ingress:
+                    for cidr in ingress.source_cidrs:
+                        cell = cells.setdefault(cidr, _Cell(cidr=cidr))
+                        for rule in ingress.rules:
+                            if rule.order in cell.sources:
+                                emit(Finding(
+                                    check="duplicate-order",
+                                    severity="error",
+                                    entry=f"{iface} {cidr}",
+                                    message=(
+                                        f"order {rule.order} defined by both "
+                                        f"{cell.sources[rule.order]!r} and "
+                                        f"{name!r}; the controller refuses "
+                                        "this merge"
+                                    ),
+                                    orders=(rule.order,),
+                                    objects=tuple(sorted(
+                                        {cell.sources[rule.order], name}
+                                    )),
+                                ))
+                                continue
+                            cell.sources[rule.order] = name
+                            cell.rules.append(rule)
+
+        for iface, cells in sorted(per_iface.items()):
+            if iface_index is not None:
+                ifx = iface_index.get(iface)
+                if ifx is None:
+                    continue
+            else:
+                ifx = 2 + sorted(per_iface).index(iface)
+            content: Dict[LpmKey, np.ndarray] = {}
+            attribution: Dict[Tuple[int, int, bytes], Dict[int, str]] = {}
+            width = 2
+            for cell in cells.values():
+                width = max(
+                    width, max((r.order for r in cell.rules), default=0) + 1
+                )
+            for cidr, cell in cells.items():
+                try:
+                    key = build_key(ifx, cidr)
+                    rows = encode_rules(
+                        IngressNodeFirewallRules(
+                            source_cidrs=[cidr], rules=cell.rules
+                        ),
+                        width,
+                    )
+                except CompileError as e:
+                    emit(Finding(
+                        check="compile-error",
+                        severity="error",
+                        entry=f"{iface} {cidr}",
+                        message=str(e),
+                        objects=tuple(sorted(set(cell.sources.values()))),
+                    ))
+                    continue
+                ident = key.masked_identity()
+                if ident in attribution:
+                    emit(Finding(
+                        check="aliasing-cidrs",
+                        severity="error",
+                        entry=f"{iface} {cidr}",
+                        message=(
+                            f"sourceCIDR {cidr!r} aliases another cell's "
+                            "masked LPM identity; the compiler keeps only "
+                            "the last writer and the other cell's rules "
+                            "silently vanish"
+                        ),
+                        objects=tuple(sorted(set(cell.sources.values()))),
+                    ))
+                attribution[ident] = dict(cell.sources)
+                content[key] = rows
+
+            # content-level label of each cell, for attribution scoping
+            label_entries = _Entries(content)
+            label_by_t = {
+                label_entries.label(t): label_entries.keys[t].masked_identity()
+                for t in range(label_entries.T)
+            }
+            cell_findings = analyze_content(content, checks=checks)
+            for f in cell_findings:
+                # attribute orders only through the cells the finding
+                # actually names (its own entry label + any label quoted
+                # in the message, e.g. the conflict's ancestor)
+                idents = {
+                    ident for label, ident in label_by_t.items()
+                    if label == f.entry or label in f.message
+                }
+                srcs = set()
+                for ident in idents:
+                    sources = attribution.get(ident, {})
+                    for o in f.orders:
+                        if o in sources:
+                            srcs.add(sources[o])
+                f.objects = tuple(sorted(srcs))
+                f.entry = f"{iface} {f.entry}"
+                if (
+                    f.check == "allow-deny-conflict"
+                    and len(f.objects) > 1
+                ):
+                    f.check = "cross-object-conflict"
+            findings.extend(cell_findings)
+            if content_sink is not None:
+                # (compiled content, its findings): the replay seam for
+                # callers confirming witnesses against a classifier
+                content_sink.append((content, cell_findings))
+    return findings
+
+
+def analyze_store(store, checks: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Analyze the merged state of every IngressNodeFirewall in a store."""
+    from ..spec import IngressNodeFirewall
+
+    return analyze_infs(
+        store.list(IngressNodeFirewall.KIND), checks=checks
+    )

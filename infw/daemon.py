@@ -34,7 +34,6 @@ import os
 import signal
 import struct
 import threading
-import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -42,7 +41,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import platform as platform_mod
-from .backend.base import Classifier
 from .compiler import CompileError
 from .constants import KIND_IPV6, KIND_OTHER, MAX_TARGETS
 from .interfaces import InterfaceError, InterfaceRegistry, default_registry

@@ -13,7 +13,7 @@ verdict — PASS == reachable, DROP == unreachable (SURVEY.md §4 carry-over).
 from __future__ import annotations
 
 import ipaddress
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .backend.cpu_ref import CpuRefClassifier
@@ -23,7 +23,6 @@ from .constants import (
     IPPROTO_SCTP,
     IPPROTO_TCP,
     IPPROTO_UDP,
-    XDP_DROP,
     XDP_PASS,
 )
 from .interfaces import Interface, InterfaceRegistry

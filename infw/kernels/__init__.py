@@ -9,4 +9,260 @@
 - wire_decode: on-device decode of the delta+varint compressed wire
   (parallel XLA varint decode; Pallas prefix-scan for fixed-stride
   plans).
+
+This package also hosts the ENTRYPOINT REGISTRY for the static hot-path
+auditor (infw.analysis.jaxcheck / ``tools/infw_lint.py jax``): every
+jitted function the production dispatch can launch (classify, wire
+decode, fused walk) is enumerated by ``kernel_entrypoints()`` with a
+builder that produces the jitted callable plus canonical arguments at a
+requested batch size, so the auditor can capture jaxprs on the bench
+shape ladder without a device.  New hot-path entrypoints belong here —
+an unregistered entrypoint is invisible to ``make static-check``.
 """
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, NamedTuple, Tuple
+
+import numpy as np
+
+
+class EntrypointUnavailable(RuntimeError):
+    """The entrypoint cannot be built in this environment (e.g. the
+    delta encoder declines the canonical corpus); the auditor records it
+    as skipped instead of failing."""
+
+
+class KernelEntrypoint(NamedTuple):
+    """One registered jitted hot-path entrypoint.
+
+    ``build(batch_size)`` returns ``(jitted_fn, args)`` ready to trace or
+    call; building twice at the same size must return the SAME jitted
+    object (the factory-identity half of the recompile lint)."""
+
+    name: str
+    kind: str  # "xla" | "pallas"
+    build: Callable[[int], Tuple[Callable, tuple]]
+
+
+# -- canonical fixtures ------------------------------------------------------
+#
+# Tiny but structurally representative tables: the "deep" variant is
+# v6-heavy with /48-/128 masks so the trie compiles its full level
+# ladder; the "small" variant sits in the dense-path regime.  Cached per
+# process — the auditor traces many entrypoints against the same tables.
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_tables(deep: bool):
+    from .. import testing
+
+    rng = np.random.default_rng(7 if deep else 5)
+    if deep:
+        return testing.random_tables_fast(
+            rng, n_entries=512, width=4, v6_fraction=0.9, ifindexes=(2, 3)
+        )
+    return testing.random_tables_fast(
+        rng, n_entries=64, width=4, v6_fraction=0.3, ifindexes=(2, 3)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_device_tables(deep: bool):
+    from . import jaxpath
+
+    return jaxpath.device_tables(_fixture_tables(deep))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_batch(b: int):
+    from .. import testing
+
+    rng = np.random.default_rng(13)
+    return testing.random_batch_fast(rng, _fixture_tables(True), n_packets=b)
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_device_batch(b: int):
+    from . import jaxpath
+
+    return jaxpath.device_batch(_fixture_batch(b))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_wire(b: int):
+    import jax
+
+    return jax.device_put(_fixture_batch(b).pack_wire())
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_overlay_tables():
+    from .. import testing
+    from . import jaxpath
+
+    rng = np.random.default_rng(23)
+    ov = testing.random_tables_fast(
+        rng, n_entries=16, width=4, v6_fraction=0.3, ifindexes=(2, 3)
+    )
+    return jaxpath.device_tables(ov)
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_delta(b: int):
+    """(enc, payload_dev, dict_dev, ifmap_dev) for the delta-decode
+    entrypoint: a v4-compact sorted-friendly corpus the encoder accepts."""
+    import jax
+
+    from ..packets import encode_delta_wire
+    from . import wire_decode
+
+    batch = _fixture_batch(b)
+    idx = np.nonzero(np.asarray(batch.kind) != 2)[0]
+    if len(idx) == 0:
+        raise EntrypointUnavailable("canonical corpus has no v4 packets")
+    v4 = batch.take(idx)
+    v4.ip_words[:, 1:] = 0
+    wire = v4.pack_wire_v4()
+    enc = encode_delta_wire(wire)
+    if enc is None:
+        raise EntrypointUnavailable(
+            "delta encoder declined the canonical corpus"
+        )
+    return (
+        enc,
+        jax.device_put(wire_decode.pad_payload(enc.payload)),
+        jax.device_put(wire_decode.pad_dict(enc.dict_vals)),
+        jax.device_put(enc.ifmap),
+    )
+
+
+# -- builders ----------------------------------------------------------------
+
+
+def _build_classify(use_trie: bool):
+    def build(b: int):
+        from . import jaxpath
+
+        fn = jaxpath.jitted_classify(use_trie)
+        return fn, (_fixture_device_tables(use_trie), _fixture_device_batch(b))
+
+    return build
+
+
+def _build_classify_wire_fused(b: int):
+    from . import jaxpath
+
+    fn = jaxpath.jitted_classify_wire_fused(True)
+    return fn, (_fixture_device_tables(True), _fixture_wire(b))
+
+
+def _build_classify_wire_overlay(b: int):
+    from . import jaxpath
+
+    fn = jaxpath.jitted_classify_wire_overlay_fused(True)
+    return fn, (
+        _fixture_device_tables(True),
+        _fixture_overlay_tables(),
+        _fixture_wire(b),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_wire8(b: int):
+    import jax
+
+    from ..packets import wire8
+
+    batch = _fixture_batch(b)
+    idx = np.nonzero(np.asarray(batch.kind) != 2)[0]
+    v4 = batch.take(idx)
+    v4.ip_words[:, 1:] = 0
+    packed = wire8(v4.pack_wire_v4())
+    if packed is None:
+        raise EntrypointUnavailable(
+            "wire8 packer declined the canonical corpus"
+        )
+    wire8_np, ifmap = packed
+    return jax.device_put(wire8_np), jax.device_put(ifmap)
+
+
+def _build_wire8(b: int):
+    from . import jaxpath
+
+    wire, ifmap = _fixture_wire8(b)
+    fn = jaxpath.jitted_classify_wire8_fused(False)
+    return fn, (_fixture_device_tables(True), wire, ifmap)
+
+
+def _build_delta_decode(b: int):
+    from . import wire_decode
+
+    enc, payload, dictv, ifmap = _fixture_delta(b)
+    fn = wire_decode.jitted_classify_delta_fused(
+        False, enc.n, enc.dict_mode, enc.fixed_w,
+        use_pallas=False, interpret=True,
+    )
+    return fn, (_fixture_device_tables(True), payload, dictv, ifmap)
+
+
+def _build_pallas_dense(b: int):
+    from . import pallas_dense
+
+    pt = _fixture_pallas_tables()
+    fn = pallas_dense.jitted_classify_pallas(True)
+    return fn, (pt, _fixture_device_batch(b))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_pallas_tables():
+    from . import pallas_dense
+
+    return pallas_dense.build_pallas_tables(_fixture_tables(False))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_walk_tables():
+    from . import pallas_walk
+
+    wt = pallas_walk.build_walk_tables(_fixture_tables(True))
+    if wt is None:
+        raise EntrypointUnavailable(
+            "fused-walk tables failed to build for the canonical fixture"
+        )
+    return wt
+
+
+def _build_pallas_walk(b: int):
+    from . import pallas_walk
+
+    fn = pallas_walk.jitted_classify_walk(True)
+    return fn, (_fixture_walk_tables(), _fixture_device_batch(b))
+
+
+def kernel_entrypoints() -> List[KernelEntrypoint]:
+    """The registered jitted hot-path entrypoints, in dispatch order of
+    the TPU backend (backend/tpu.py _launch_wire and friends)."""
+    return [
+        KernelEntrypoint("classify/xla-dense", "xla", _build_classify(False)),
+        KernelEntrypoint("classify/xla-trie", "xla", _build_classify(True)),
+        KernelEntrypoint(
+            "classify-wire/xla-trie-fused", "xla", _build_classify_wire_fused
+        ),
+        KernelEntrypoint(
+            "classify-wire/xla-overlay-fused", "xla",
+            _build_classify_wire_overlay,
+        ),
+        KernelEntrypoint(
+            "classify-wire8/xla-fused", "xla", _build_wire8
+        ),
+        KernelEntrypoint(
+            "wire-decode/delta-fused", "xla", _build_delta_decode
+        ),
+        KernelEntrypoint(
+            "classify/pallas-dense", "pallas", _build_pallas_dense
+        ),
+        KernelEntrypoint(
+            "classify/pallas-walk", "pallas", _build_pallas_walk
+        ),
+    ]

@@ -20,7 +20,7 @@ import struct
 import threading
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Iterable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -137,6 +137,42 @@ class BatchDenyRecord:
         out["icmp_type"] = (self.icmp_type & 0xFF).astype(np.uint8)
         out["icmp_code"] = (self.icmp_code & 0xFF).astype(np.uint8)
         return out
+
+
+@dataclass
+class AnalysisEventRecord:
+    """One static-analysis finding traveling the event pipeline.
+
+    The syncer's opt-in pre-sync gate (infw.syncer, INFW_SYNC_ANALYSIS)
+    downgrades analyzer findings to these records instead of blocking
+    the sync: operators see them in the same stream as deny events
+    (and the ring's counters account for them like any other record)."""
+
+    severity: str
+    check: str
+    entry: str
+    message: str
+
+    def lines(self) -> List[str]:
+        return [
+            f"analysis {self.severity} [{self.check}] {self.entry}: "
+            f"{self.message}"
+        ]
+
+
+def emit_analysis_findings(ring: "EventRing", findings) -> int:
+    """Push analyzer findings (infw.analysis.rules.Finding) into the
+    ring as AnalysisEventRecords; returns how many were queued (the
+    ring's usual overflow accounting applies)."""
+    n = 0
+    for f in findings:
+        before = ring.queued_total
+        ring.push(AnalysisEventRecord(
+            severity=f.severity, check=f.check, entry=f.entry,
+            message=f.message,
+        ))
+        n += ring.queued_total - before
+    return n
 
 
 def convert_xdp_action_to_string(action: int) -> str:
@@ -393,6 +429,11 @@ class EventsLogger:
         for rec in self._ring.pop_all():
             if isinstance(rec, BatchDenyRecord):
                 n += self._drain_batch(rec)
+                continue
+            if isinstance(rec, AnalysisEventRecord):
+                for line in rec.lines():
+                    self._sink(line)
+                n += 1
                 continue
             name = self._iface_names.get(rec.hdr.if_id, "?")
             for line in decode_event_lines(rec, name):

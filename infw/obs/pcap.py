@@ -26,7 +26,7 @@ import ipaddress
 import os
 import struct
 import subprocess
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

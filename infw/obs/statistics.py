@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 import weakref
 from typing import Dict, List, Optional
 

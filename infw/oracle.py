@@ -23,7 +23,6 @@ from .constants import (
     IPPROTO_TCP,
     IPPROTO_UDP,
     KIND_IPV4,
-    KIND_IPV6,
     KIND_MALFORMED,
     KIND_OTHER,
     MAX_TARGETS,

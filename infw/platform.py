@@ -13,7 +13,7 @@ the daemon backend and mesh shape.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List
 
 
 @dataclass(frozen=True)

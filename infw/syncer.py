@@ -120,6 +120,8 @@ class DataplaneSyncer:
         detach_fn: Optional[Callable[[str], None]] = None,
         is_valid_interface: Optional[Callable[[str], bool]] = None,
         ebusy_retry_interval_s: float = XDP_EBUSY_RETRY_INTERVAL_S,
+        analysis_mode: Optional[str] = None,
+        analysis_ring=None,
     ) -> None:
         self._factory = classifier_factory
         self._registry = registry if registry is not None else interfaces_mod.default_registry
@@ -132,6 +134,22 @@ class DataplaneSyncer:
         # (ebpfsyncer.go:26, mocked at ebpfsyncer_test.go:1249-1251).
         self._is_valid_interface = is_valid_interface
         self._ebusy_interval = ebusy_retry_interval_s
+        # Opt-in pre-sync semantic analysis of the desired table
+        # (infw.analysis.rules).  "off" (default) skips it; "events"
+        # downgrades findings to AnalysisEventRecords on analysis_ring
+        # (never blocks); "block" additionally fails the sync on
+        # error-severity findings BEFORE any interface is touched.
+        # Constructor arg beats the INFW_SYNC_ANALYSIS env var.
+        if analysis_mode is None:
+            analysis_mode = os.environ.get("INFW_SYNC_ANALYSIS") or "off"
+        if analysis_mode not in ("off", "events", "block"):
+            raise ValueError(
+                f"unknown analysis mode {analysis_mode!r} "
+                "(expected off|events|block)"
+            )
+        self._analysis_mode = analysis_mode
+        self._analysis_ring = analysis_ring
+        self.last_analysis_findings: List = []
 
         self._lock = threading.Lock()
         self._classifier: Optional[Classifier] = None
@@ -192,6 +210,7 @@ class DataplaneSyncer:
                 # string, out-of-range order...) leaves the dataplane exactly
                 # as it was — no interfaces detached, last-good rules intact.
                 desired, width = self._build_desired_content(iface_ingress_rules)
+                self._pre_sync_analysis(desired)
                 self._detach_unmanaged_interfaces(iface_ingress_rules)
                 self._attach_new_interfaces(iface_ingress_rules)
                 self._load_ingress_node_firewall_rules(desired, width)
@@ -339,6 +358,36 @@ class DataplaneSyncer:
                         time.sleep(self._ebusy_interval)
             if last is not None:
                 raise SyncError(f"failed to attach interface {name}: {last}")
+
+    def _pre_sync_analysis(self, desired: Dict[LpmKey, np.ndarray]) -> None:
+        """Opt-in semantic gate over the desired content (pure — runs
+        before any interface or device mutation).  Findings downgrade to
+        emitted events by default; only mode="block" turns error-severity
+        findings into a SyncError."""
+        if self._analysis_mode == "off":
+            return
+        from .analysis import rules as analysis_rules
+
+        findings = analysis_rules.analyze_content(desired)
+        self.last_analysis_findings = findings
+        if not findings:
+            return
+        for f in findings:
+            log.log(
+                logging.ERROR if f.severity == "error" else logging.WARNING,
+                "pre-sync analysis: %s [%s] %s: %s",
+                f.severity, f.check, f.entry, f.message,
+            )
+        if self._analysis_ring is not None:
+            from .obs.events import emit_analysis_findings
+
+            emit_analysis_findings(self._analysis_ring, findings)
+        errors = [f for f in findings if f.severity == "error"]
+        if self._analysis_mode == "block" and errors:
+            raise SyncError(
+                f"pre-sync analysis found {len(errors)} error finding(s): "
+                + "; ".join(f"[{f.check}] {f.entry}" for f in errors[:5])
+            )
 
     def _build_desired_content(
         self, iface_ingress_rules: Dict[str, List[IngressNodeFirewallRules]]

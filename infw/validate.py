@@ -31,7 +31,6 @@ from .spec import (
     PROTOCOL_TYPE_UDP,
     IngressNodeFirewall,
     IngressNodeFirewallProtocolRule,
-    IngressNodeFirewallRules,
 )
 
 IFNAMSIZ = 16

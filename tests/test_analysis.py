@@ -1,0 +1,421 @@
+"""Rule-table semantic analyzer tests (infw.analysis.rules).
+
+The property core: every finding's witness 5-tuple must replay bit-exact
+against the NATIVE CPU reference classifier (backend/cpu_ref) — the
+analyzer's claims are statements about what the dataplane does, so they
+are checked against the dataplane, not against the analyzer's own model.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from infw import failsaferules, testing
+from infw.analysis import rules as ar
+from infw.backend.cpu_ref import CpuRefClassifier
+from infw.compiler import LpmKey, compile_tables_from_content
+from infw.constants import ALLOW, DENY, IPPROTO_TCP, IPPROTO_UDP
+from infw.spec import (
+    ACTION_ALLOW,
+    ACTION_DENY,
+    IngressNodeFirewall,
+    IngressNodeFirewallProtocolRule,
+    IngressNodeFirewallProtoRule,
+    IngressNodeFirewallRules,
+    IngressNodeProtocolConfig,
+    PROTOCOL_TYPE_TCP,
+    PROTOCOL_TYPE_UNSET,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def row(order, proto, ps, pe, it, ic, act):
+    r = np.zeros(7, np.int32)
+    r[:] = [order, proto, ps, pe, it, ic, act]
+    return r
+
+
+def rows(width, *rs):
+    m = np.zeros((width, 7), np.int32)
+    for r in rs:
+        m[r[0]] = r
+    return m
+
+
+def v4(a, b, c, d):
+    return bytes([a, b, c, d]) + bytes(12)
+
+
+def key(data, mask, ifx=2):
+    return LpmKey(mask + 32, ifx, data)
+
+
+def cpu_ref_for(content):
+    clf = CpuRefClassifier()
+    clf.load_tables(compile_tables_from_content(dict(content)))
+    return clf
+
+
+# --- the acceptance gate ----------------------------------------------------
+
+
+ACCEPTANCE = {
+    key(v4(10, 0, 0, 0), 8): rows(4, row(1, IPPROTO_TCP, 443, 0, 0, 0, ALLOW)),
+    key(v4(10, 1, 0, 0), 16): rows(4, row(1, IPPROTO_TCP, 443, 0, 0, 0, DENY)),
+    key(v4(192, 168, 0, 0), 16): rows(
+        4,
+        row(1, IPPROTO_TCP, 1000, 2000, 0, 0, ALLOW),
+        row(2, IPPROTO_TCP, 1500, 0, 0, 0, DENY),
+    ),
+}
+
+
+def test_acceptance_exactly_two_findings():
+    findings = ar.analyze_content(ACCEPTANCE)
+    got = {(f.check, f.entry) for f in findings}
+    assert got == {
+        ("shadowed-rule", "if2 192.168.0.0/16"),
+        ("allow-deny-conflict", "if2 10.1.0.0/16"),
+    }
+    # both witnesses confirmed by the oracle AND the native reference
+    for clf in (None, cpu_ref_for(ACCEPTANCE)):
+        replays = ar.replay_witnesses(ACCEPTANCE, findings, classifier=clf)
+        assert len(replays) == 2
+        assert all(ok for _, ok, _ in replays), replays
+
+
+def test_acceptance_cli_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "infw_lint.py"),
+         "rules", "--acceptance", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["ok"] is True
+    assert len(doc["findings"]) == 2
+    assert all(c["confirmed"] for c in doc["confirmed"])
+
+
+# --- witness property: analyzer claims == dataplane behavior ----------------
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29])
+def test_witnesses_replay_on_native_reference(seed):
+    """Every witness produced on an adversarial random table classifies
+    to exactly the predicted packed result on the native C++ reference
+    (in particular: every shadowed rule's witness yields the SHADOWING
+    rule's verdict, never the shadowed rule's)."""
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables_fast(rng, n_entries=200, width=8)
+    findings = ar.analyze_content(tables.content)
+    with_w = [f for f in findings if f.witness is not None]
+    assert with_w, "adversarial tables should produce witnessed findings"
+    replays = ar.replay_witnesses(
+        tables.content, findings, classifier=cpu_ref_for(tables.content)
+    )
+    bad = [(f.check, f.entry, got, f.witness.expect_result)
+           for f, ok, got in replays if not ok]
+    assert not bad, bad
+    # shadowed-rule witnesses specifically must NOT hit the shadowed rule
+    for f in with_w:
+        if f.check == "shadowed-rule":
+            assert f.witness.expect_rule_id != f.orders[1]
+
+
+def test_clean_adversarial_table_reports_zero_findings():
+    rng = np.random.default_rng(5)
+    tables = testing.clean_tables_fast(rng, n_entries=50_000, width=4)
+    assert tables.num_entries == 50_000
+    findings = ar.analyze_content(tables.content)
+    assert findings == []
+
+
+@pytest.mark.slow
+def test_clean_adversarial_table_1m_zero_findings():
+    rng = np.random.default_rng(5)
+    tables = testing.clean_tables_fast(rng, n_entries=1_000_000, width=4)
+    findings = ar.analyze_content(tables.content)
+    assert findings == []
+
+
+# --- individual checks ------------------------------------------------------
+
+
+def test_lpm_dead_cidr_with_conflicting_verdicts():
+    content = {
+        key(v4(10, 0, 0, 0), 24): rows(4, row(1, IPPROTO_TCP, 80, 0, 0, 0, ALLOW)),
+        key(v4(10, 0, 0, 0), 25): rows(4, row(1, IPPROTO_TCP, 80, 0, 0, 0, DENY)),
+        key(v4(10, 0, 0, 128), 25): rows(4, row(1, IPPROTO_TCP, 80, 0, 0, 0, DENY)),
+    }
+    findings = ar.analyze_content(content)
+    dead = [f for f in findings if f.check == "lpm-dead-cidr"]
+    assert len(dead) == 1
+    assert dead[0].entry == "if2 10.0.0.0/24"
+    assert dead[0].severity == "warning"  # covering verdicts differ
+    # the witness proves traffic lands on the /25's verdict
+    (f, ok, got), = ar.replay_witnesses(content, dead)
+    assert ok and (got & 0xFF) == DENY
+
+
+def test_lpm_dead_requires_full_cover():
+    content = {
+        key(v4(10, 0, 0, 0), 24): rows(4, row(1, IPPROTO_TCP, 80, 0, 0, 0, ALLOW)),
+        key(v4(10, 0, 0, 0), 25): rows(4, row(1, IPPROTO_TCP, 80, 0, 0, 0, DENY)),
+    }
+    assert not [f for f in ar.analyze_content(content)
+                if f.check == "lpm-dead-cidr"]
+
+
+def test_catchall_deny_is_failsafe_violation():
+    content = {key(bytes(16), 0): rows(4, row(1, 0, 0, 0, 0, 0, DENY))}
+    findings = ar.analyze_content(content)
+    fs = [f for f in findings if f.check == "failsafe-violation"]
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "6443" in fs[0].message
+    (f, ok, got), = ar.replay_witnesses(content, fs)
+    assert ok and (got & 0xFF) == DENY
+
+
+def test_allow_before_catchall_deny_is_failsafe_proof():
+    """The recommended failsafe posture: explicit Allows over every
+    failsafe port, then deny-all — the analyzer must prove it safe."""
+    specs = [row(90, 0, 0, 0, 0, 0, DENY)]
+    order = 1
+    for fs in failsaferules.get_tcp():
+        specs.insert(0, row(order, IPPROTO_TCP, fs.port, 0, 0, 0, ALLOW))
+        order += 1
+    for fs in failsaferules.get_udp():
+        specs.insert(0, row(order, IPPROTO_UDP, fs.port, 0, 0, 0, ALLOW))
+        order += 1
+    content = {key(bytes(16), 0): rows(91, *specs)}
+    findings = ar.analyze_content(content)
+    assert not [f for f in findings if f.check == "failsafe-violation"]
+    # and the failsafe Allow set itself is shadow-free (regression pin
+    # for the shipped failsaferules list: no duplicate/covered ports)
+    assert not [f for f in findings
+                if f.check in ("shadowed-rule", "redundant-rule")]
+
+
+def test_shipped_failsafe_list_is_duplicate_free():
+    tcp = [fs.port for fs in failsaferules.get_tcp()]
+    udp = [fs.port for fs in failsaferules.get_udp()]
+    assert len(set(tcp)) == len(tcp)
+    assert len(set(udp)) == len(udp)
+
+
+def test_range_asymmetry_warning():
+    content = {
+        key(v4(10, 9, 0, 0), 16): rows(
+            4, row(1, IPPROTO_TCP, 5000, 6443, 0, 0, DENY)
+        ),
+    }
+    findings = ar.analyze_content(content)
+    asym = [f for f in findings if f.check == "range-asymmetry"]
+    assert len(asym) == 1
+    # the witness shows port 6443 is NOT denied by this rule (half-open)
+    (f, ok, got), = ar.replay_witnesses(content, asym)
+    assert ok
+    assert (got & 0xFF) != DENY
+    # and no failsafe violation: 6443 is outside the half-open range
+    assert not [f for f in findings if f.check == "failsafe-violation"]
+
+
+def test_redundant_vs_shadowed_severity():
+    content = {
+        key(v4(10, 8, 0, 0), 16): rows(
+            8,
+            row(1, IPPROTO_TCP, 100, 200, 0, 0, DENY),
+            row(2, IPPROTO_TCP, 150, 0, 0, 0, DENY),    # redundant
+            row(3, IPPROTO_TCP, 120, 0, 0, 0, ALLOW),   # shadowed
+        ),
+    }
+    by_check = {}
+    for f in ar.analyze_content(content):
+        by_check.setdefault(f.check, []).append(f)
+    assert [f.orders for f in by_check["redundant-rule"]] == [(1, 2)]
+    assert by_check["redundant-rule"][0].severity == "info"
+    assert [f.orders for f in by_check["shadowed-rule"]] == [(1, 3)]
+    assert by_check["shadowed-rule"][0].severity == "error"
+
+
+def test_unmatchable_rule_info():
+    content = {
+        key(v4(10, 7, 0, 0), 16): rows(
+            4,
+            row(1, IPPROTO_TCP, 500, 500, 0, 0, DENY),  # empty half-open range
+            row(2, 47, 0, 0, 0, 0, DENY),               # GRE: scan never matches
+        ),
+    }
+    checks = [f.check for f in ar.analyze_content(content)]
+    assert checks.count("unmatchable-rule") == 2
+
+
+# --- spec-level wrapper -----------------------------------------------------
+
+
+def tcp_rule(order, ports, action):
+    return IngressNodeFirewallProtocolRule(
+        order=order,
+        protocol_config=IngressNodeProtocolConfig(
+            protocol=PROTOCOL_TYPE_TCP,
+            tcp=IngressNodeFirewallProtoRule(ports=ports),
+        ),
+        action=action,
+    )
+
+
+def make_inf(name, cidr_rules, interfaces=("eth0",), selector=None):
+    return IngressNodeFirewall.from_dict({
+        "metadata": {"name": name},
+        "spec": {
+            "nodeSelector": {"matchLabels": selector or {"fw": "on"}},
+            "interfaces": list(interfaces),
+            "ingress": [
+                {"sourceCIDRs": [cidr],
+                 "rules": [r.to_dict() for r in rules]}
+                for cidr, rules in cidr_rules
+            ],
+        },
+    })
+
+
+def test_cross_object_conflict_attribution():
+    inf_a = make_inf("allow-web", [("10.0.0.0/8", [tcp_rule(1, 443, ACTION_ALLOW)])])
+    inf_b = make_inf("deny-sub", [("10.1.0.0/16", [tcp_rule(2, 443, ACTION_DENY)])])
+    findings = ar.analyze_infs([inf_a, inf_b])
+    conf = [f for f in findings if f.check == "cross-object-conflict"]
+    assert len(conf) == 1
+    assert set(conf[0].objects) == {"allow-web", "deny-sub"}
+    assert conf[0].witness is not None
+
+
+def test_same_object_conflict_keeps_plain_check_id():
+    inf = make_inf("one", [
+        ("10.0.0.0/8", [tcp_rule(1, 443, ACTION_ALLOW)]),
+        ("10.1.0.0/16", [tcp_rule(2, 443, ACTION_DENY)]),
+    ])
+    findings = ar.analyze_infs([inf])
+    assert [f.check for f in findings] == ["allow-deny-conflict"]
+
+
+def test_duplicate_order_across_objects():
+    inf_a = make_inf("a", [("10.0.0.0/8", [tcp_rule(1, 80, ACTION_ALLOW)])])
+    inf_b = make_inf("b", [("10.0.0.0/8", [tcp_rule(1, 81, ACTION_DENY)])])
+    findings = ar.analyze_infs([inf_a, inf_b])
+    dup = [f for f in findings if f.check == "duplicate-order"]
+    assert len(dup) == 1 and set(dup[0].objects) == {"a", "b"}
+
+
+def test_aliasing_cidrs_flagged():
+    inf = make_inf("alias", [
+        ("10.0.0.1/8", [tcp_rule(1, 80, ACTION_ALLOW)]),
+        ("10.0.0.2/8", [tcp_rule(2, 81, ACTION_DENY)]),
+    ])
+    findings = ar.analyze_infs([inf])
+    assert [f.check for f in findings if f.check == "aliasing-cidrs"]
+
+
+def test_shipped_denyall_example_is_flagged():
+    with open(os.path.join(REPO, "examples",
+                           "ingressnodefirewall-denyall.json")) as f:
+        inf = IngressNodeFirewall.from_dict(json.load(f))
+    findings = ar.analyze_infs([inf])
+    fs = [f for f in findings if f.check == "failsafe-violation"]
+    assert len(fs) == 1
+    assert fs[0].objects == ("ingressnodefirewall-denyall",)
+
+
+# --- syncer pre-sync gate ---------------------------------------------------
+
+
+def _catchall_deny_rules():
+    return [IngressNodeFirewallRules(
+        source_cidrs=["0.0.0.0/0"],
+        rules=[IngressNodeFirewallProtocolRule(
+            order=1,
+            protocol_config=IngressNodeProtocolConfig(
+                protocol=PROTOCOL_TYPE_UNSET
+            ),
+            action=ACTION_DENY,
+        )],
+    )]
+
+
+@pytest.fixture
+def gate_registry():
+    from infw.interfaces import Interface, InterfaceRegistry
+
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    return reg
+
+
+def test_syncer_gate_events_mode(gate_registry):
+    from infw.obs.events import AnalysisEventRecord, EventRing
+    from infw.syncer import DataplaneSyncer
+
+    ring = EventRing(capacity=64)
+    s = DataplaneSyncer(
+        classifier_factory=CpuRefClassifier,
+        registry=gate_registry,
+        analysis_mode="events",
+        analysis_ring=ring,
+    )
+    s.sync_interface_ingress_rules({"dummy0": _catchall_deny_rules()}, False)
+    # sync succeeded (events mode never blocks) and findings were emitted
+    assert s.classifier is not None
+    assert any(f.check == "failsafe-violation"
+               for f in s.last_analysis_findings)
+    recs = ring.pop_all()
+    assert any(isinstance(r, AnalysisEventRecord)
+               and r.check == "failsafe-violation" for r in recs)
+    assert all(r.lines() for r in recs if isinstance(r, AnalysisEventRecord))
+
+
+def test_syncer_gate_block_mode(gate_registry):
+    from infw.syncer import DataplaneSyncer, SyncError
+
+    s = DataplaneSyncer(
+        classifier_factory=CpuRefClassifier,
+        registry=gate_registry,
+        analysis_mode="block",
+    )
+    with pytest.raises(SyncError, match="failsafe-violation"):
+        s.sync_interface_ingress_rules(
+            {"dummy0": _catchall_deny_rules()}, False
+        )
+    # the gate fired BEFORE any interface mutation
+    assert s.attached_interfaces() == set()
+    # a clean ruleset syncs fine in block mode
+    s.sync_interface_ingress_rules({"dummy0": [IngressNodeFirewallRules(
+        source_cidrs=["192.0.2.0/24"],
+        rules=[tcp_rule(1, 80, ACTION_DENY)],
+    )]}, False)
+    assert s.attached_interfaces() == {"dummy0"}
+
+
+def test_syncer_gate_off_by_default(gate_registry):
+    from infw.syncer import DataplaneSyncer
+
+    s = DataplaneSyncer(
+        classifier_factory=CpuRefClassifier, registry=gate_registry
+    )
+    s.sync_interface_ingress_rules({"dummy0": _catchall_deny_rules()}, False)
+    assert s.last_analysis_findings == []
+
+
+def test_events_logger_drains_analysis_records():
+    from infw.obs.events import EventRing, EventsLogger, emit_analysis_findings
+
+    ring = EventRing(capacity=8)
+    n = emit_analysis_findings(ring, ar.analyze_content(ACCEPTANCE))
+    assert n == 2
+    lines = []
+    logger = EventsLogger(ring, lines.append)
+    assert logger.drain_once() == 2
+    assert any("shadowed-rule" in line for line in lines)

@@ -22,7 +22,6 @@ from infw.spec import (
     IngressNodeFirewallConfig,
     IngressNodeFirewallConfigSpec,
     IngressNodeFirewallNodeState,
-    IngressNodeFirewallRules,
     IngressNodeFirewallSpec,
     NODE_STATE_SYNC_ERROR,
     NODE_STATE_SYNC_OK,

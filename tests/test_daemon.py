@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 import infw.nodestate_controller as nsc_mod
-from infw.backend.cpu_ref import CpuRefClassifier
 from infw.constants import IPPROTO_TCP
 from infw.daemon import Daemon, read_frames_file, write_frames_file
 from infw.interfaces import Interface, InterfaceRegistry
@@ -27,7 +26,6 @@ from infw.spec import (
     ObjectMeta,
 )
 from infw.store import InMemoryStore, NotFoundError
-from infw.syncer import DataplaneSyncer
 from test_syncer import ingress, tcp_rule
 
 NS = "ingress-node-firewall-system"

@@ -189,7 +189,6 @@ def test_icmp_family_gating():
     content = {LpmKey(32, 2, bytes(16)): rows}
     tables = compile_tables_from_content(content, rule_width=4)
     from infw.packets import make_batch
-    import numpy as np_
 
     batch = make_batch(
         src=["1.1.1.1", "2002:db8::1", "1.1.1.1", "2002:db8::1"],

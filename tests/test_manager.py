@@ -8,7 +8,7 @@ import time
 
 import pytest
 
-from infw.manager import Manager, inf_admission, main as manager_main
+from infw.manager import Manager, main as manager_main
 from infw.platform import get_platform_info
 from infw.spec import (
     ACTION_ALLOW,

@@ -2,7 +2,6 @@
 virtual 8-device mesh: mesh layout invariants (rules axis stays
 process-local), local-data assembly via make_array_from_process_local_data,
 and the full multihost classify path bit-exact vs the oracle."""
-import jax
 import os
 import numpy as np
 import pytest

@@ -3,11 +3,9 @@ deny-event pipeline line formats, and the statistics poller/exposition
 (reference: pkg/metrics/statistics.go behaviors + the e2e suites'
 metrics/events assertions, e2e.go:1143-1356,1560-1620)."""
 import re
-import threading
 import time
 
 import numpy as np
-import pytest
 
 from infw import oracle
 from infw.backend.cpu_ref import CpuRefClassifier

@@ -13,13 +13,12 @@ import subprocess
 import sys
 import time
 
-import numpy as np
 import pytest
 
 from infw.daemon import Daemon, write_frames_file_v2
 from infw.interfaces import Interface, InterfaceRegistry
 from infw.obs.pcap import FramesBuf, build_frame
-from infw.obs.sidecar import UnixDatagramSink, serve_socket, tail_file
+from infw.obs.sidecar import UnixDatagramSink, tail_file
 
 NODE = "node-a"
 

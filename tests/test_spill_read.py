@@ -6,7 +6,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from infw.obs import events as ev
 from infw.packets import make_batch

@@ -5,12 +5,11 @@ tables become golden verdict vectors; bpffs pins become the compiled-table
 checkpoint; the `once = sync.Once{}` restart trick becomes
 reset_singleton_for_test().
 """
-import numpy as np
 import pytest
 
 from infw import syncer as syncer_mod
 from infw.backend.cpu_ref import CpuRefClassifier
-from infw.constants import DENY, UNDEF, XDP_DROP, XDP_PASS
+from infw.constants import DENY, XDP_DROP, XDP_PASS
 from infw.interfaces import Interface, InterfaceRegistry
 from infw.packets import make_batch
 from infw.spec import (

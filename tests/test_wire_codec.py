@@ -17,7 +17,6 @@ from infw.packets import (
     decode_delta_host,
     delta_section_offsets,
     encode_delta_wire,
-    make_batch,
     varint_encode,
 )
 

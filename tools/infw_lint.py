@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""infw static-analysis CLI.
+
+Subcommands:
+
+  rules   Semantic analysis of rule tables (infw.analysis.rules): by
+          default lints the shipped example specs; ``--spec FILE`` lints
+          JSON documents (an IngressNodeFirewall, a list of them, or a
+          NodeState-shaped {"interfaceIngressRules": ...} map);
+          ``--acceptance`` runs the built-in injected-defect table and
+          verifies the analyzer reports EXACTLY the injected findings
+          with oracle-confirmed witnesses (the repo gate).
+  jax     Hot-path audit (infw.analysis.jaxcheck) of every registered
+          jitted entrypoint: x64 leaks, host callbacks, recompile lint
+          on the bench shape ladder, Pallas VMEM budget.  Run under
+          JAX_PLATFORMS=cpu — no TPU needed.
+
+Exit status: 1 when any error-severity finding exists (or, with
+``--strict``, any warning too); 0 otherwise.  ``--json`` prints one
+machine-readable JSON document on stdout instead of text lines.
+
+Silencing: ``--ignore CHECK[,CHECK...]`` drops findings by check id
+(e.g. ``--ignore failsafe-violation`` when linting an intentional
+deny-all spec); see README "Static analysis".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from _common import repo_root, setup_repo_path
+
+setup_repo_path()
+
+
+# --- rules subcommand -------------------------------------------------------
+
+
+def _load_spec_docs(paths: List[str]):
+    """JSON files -> (infs, content_maps)."""
+    from infw.spec import IngressNodeFirewall, IngressNodeFirewallNodeState
+
+    infs, states = [], []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        docs = doc if isinstance(doc, list) else [doc]
+        for d in docs:
+            kind = d.get("kind", IngressNodeFirewall.KIND)
+            if kind == IngressNodeFirewall.KIND:
+                infs.append(IngressNodeFirewall.from_dict(d))
+            elif kind == IngressNodeFirewallNodeState.KIND or (
+                "interfaceIngressRules" in d.get("spec", d)
+            ):
+                states.append(IngressNodeFirewallNodeState.from_dict(
+                    d if "spec" in d else {"spec": d}
+                ))
+            else:
+                print(f"warning: {path}: skipping kind {kind!r}",
+                      file=sys.stderr)
+    return infs, states
+
+
+def _default_example_specs() -> List[str]:
+    ex_dir = os.path.join(repo_root(), "examples")
+    out = []
+    for name in sorted(os.listdir(ex_dir)) if os.path.isdir(ex_dir) else []:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(ex_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and doc.get("kind") == "IngressNodeFirewall":
+            out.append(path)
+    return out
+
+
+def _acceptance_content():
+    """The injected-defect table of the repo gate: one shadowed rule and
+    one Allow/Deny conflict, nothing else."""
+    import numpy as np
+
+    from infw.compiler import LpmKey
+    from infw.constants import ALLOW, DENY, IPPROTO_TCP
+
+    def rows(*specs):
+        m = np.zeros((4, 7), np.int32)
+        for order, proto, ps, pe, act in specs:
+            m[order] = [order, proto, ps, pe, 0, 0, act]
+        return m
+
+    v4 = lambda a, b, c, d: bytes([a, b, c, d]) + bytes(12)
+    key = lambda data, mask: LpmKey(mask + 32, 2, data)
+    return {
+        key(v4(10, 0, 0, 0), 8): rows((1, IPPROTO_TCP, 443, 0, ALLOW)),
+        key(v4(10, 1, 0, 0), 16): rows((1, IPPROTO_TCP, 443, 0, DENY)),
+        key(v4(192, 168, 0, 0), 16): rows(
+            (1, IPPROTO_TCP, 1000, 2000, ALLOW),
+            (2, IPPROTO_TCP, 1500, 0, DENY),
+        ),
+    }
+
+
+def _run_acceptance(as_json: bool) -> int:
+    from infw.analysis import rules as ar
+
+    content = _acceptance_content()
+    findings = ar.analyze_content(content)
+    report = {
+        "findings": [f.to_dict() for f in findings],
+        "confirmed": [],
+        "ok": False,
+    }
+    problems = []
+    want = {("shadowed-rule", "if2 192.168.0.0/16"),
+            ("allow-deny-conflict", "if2 10.1.0.0/16")}
+    got = {(f.check, f.entry) for f in findings}
+    if got != want:
+        problems.append(f"expected exactly {sorted(want)}, got {sorted(got)}")
+    replays = ar.replay_witnesses(content, findings)
+    for f, ok, got_res in replays:
+        report["confirmed"].append(
+            {"check": f.check, "confirmed": ok, "got": got_res}
+        )
+        if not ok:
+            problems.append(
+                f"{f.check}: witness replay got {got_res:#x}, expected "
+                f"{f.witness.expect_result:#x}"
+            )
+    if len(replays) != 2:
+        problems.append(f"expected 2 witnesses to replay, got {len(replays)}")
+    report["ok"] = not problems
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        for f in findings:
+            _print_finding(f)
+        for p in problems:
+            print(f"ACCEPTANCE FAIL: {p}")
+        if not problems:
+            print("acceptance: 2 injected findings reported, both witnesses "
+                  "oracle-confirmed")
+    return 0 if not problems else 1
+
+
+def _print_finding(f) -> None:
+    loc = f" [{', '.join(f.objects)}]" if f.objects else ""
+    print(f"{f.severity:7s} {f.check:22s} {f.entry}{loc}: {f.message}")
+    if f.witness is not None:
+        w = f.witness.to_dict()
+        print(f"        witness: src={w['srcAddr']} if={w['ifindex']} "
+              f"proto={w['proto']} dport={w['dstPort']} "
+              f"icmp={w['icmpType']}/{w['icmpCode']} -> "
+              f"rule {w['expectRuleId']} {w['expectAction']}")
+
+
+def cmd_rules(args) -> int:
+    from infw.analysis import rules as ar
+
+    if args.acceptance:
+        return _run_acceptance(args.json)
+
+    findings = []
+    groups = []  # (compiled content, findings) pairs for --confirm
+    paths = args.spec or _default_example_specs()
+    infs, states = _load_spec_docs(paths)
+    if infs:
+        findings.extend(ar.analyze_infs(infs, content_sink=groups))
+    for ns in states:
+        for iface, ingress in ns.spec.interface_ingress_rules.items():
+            from infw.spec import IngressNodeFirewall, IngressNodeFirewallSpec
+
+            synth = IngressNodeFirewall(
+                spec=IngressNodeFirewallSpec(
+                    interfaces=[iface], ingress=ingress
+                )
+            )
+            synth.metadata.name = ns.metadata.name or "nodestate"
+            findings.extend(ar.analyze_infs([synth], content_sink=groups))
+
+    ignore = set((args.ignore or "").split(",")) - {""}
+    findings = [f for f in findings if f.check not in ignore]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+
+    confirmed = None
+    if args.confirm:
+        confirmed = []
+        for content, group_findings in groups:
+            kept = [f for f in group_findings if f.check not in ignore]
+            for f, ok, got in ar.replay_witnesses(content, kept):
+                confirmed.append((f, ok, got))
+                if not ok:
+                    n_err += 1
+                    print(f"CONFIRM FAIL {f.check} {f.entry}: oracle "
+                          f"returned {got:#x}, witness predicted "
+                          f"{f.witness.expect_result:#x}", file=sys.stderr)
+
+    if args.json:
+        doc = {
+            "specs": paths,
+            "findings": [f.to_dict() for f in findings],
+            "errors": n_err,
+            "warnings": n_warn,
+        }
+        if confirmed is not None:
+            doc["confirmed"] = [
+                {"check": f.check, "entry": f.entry, "confirmed": ok,
+                 "got": got}
+                for f, ok, got in confirmed
+            ]
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in findings:
+            _print_finding(f)
+        tail = ""
+        if confirmed is not None:
+            n_ok = sum(1 for _, ok, _ in confirmed)
+            tail = f", {n_ok}/{len(confirmed)} witnesses oracle-confirmed"
+        print(f"rules: {len(paths)} spec file(s), {len(findings)} finding(s) "
+              f"({n_err} error, {n_warn} warning){tail}")
+    if n_err or (args.strict and n_warn):
+        return 1
+    return 0
+
+
+# --- jax subcommand ---------------------------------------------------------
+
+
+def cmd_jax(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from infw.analysis import jaxcheck
+
+    ladder = tuple(
+        int(x) for x in (args.ladder or "256,1024").split(",") if x
+    )
+    names = [x for x in (args.entries or "").split(",") if x] or None
+    reports = jaxcheck.audit_all(
+        names=names,
+        ladder=ladder,
+        vmem_budget=args.vmem_budget,
+        execute=not args.no_execute,
+    )
+    summary = jaxcheck.summarize(reports)
+    if args.json:
+        print(json.dumps({
+            "reports": [r.to_dict() for r in reports],
+            "summary": summary,
+        }, indent=2))
+    else:
+        for r in reports:
+            status = "OK" if not any(
+                f.severity in ("error", "warning") for f in r.findings
+            ) else "FAIL"
+            print(f"{status:4s} {r.entry:35s} kind={r.kind:6s} "
+                  f"shapes={r.shapes} eqns={r.n_eqns} "
+                  f"pallas={r.n_pallas_calls} vmem={r.vmem_bytes}B")
+            for f in r.findings:
+                print(f"     {f.severity}: [{f.check}] {f.message}")
+                if f.detail:
+                    for line in f.detail.splitlines():
+                        print(f"       | {line}")
+        print(f"jax: {summary}")
+    if summary["error"] or (args.strict and summary["warning"]):
+        return 1
+    return 0
+
+
+# --- main -------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="infw_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_rules = sub.add_parser("rules", help="rule-table semantic analysis")
+    p_rules.add_argument("--spec", action="append", metavar="FILE",
+                         help="JSON spec file(s); default: examples/*.json")
+    p_rules.add_argument("--json", action="store_true")
+    p_rules.add_argument("--strict", action="store_true",
+                         help="warnings also exit nonzero")
+    p_rules.add_argument("--ignore", metavar="CHECKS",
+                         help="comma-separated check ids to drop")
+    p_rules.add_argument("--confirm", action="store_true",
+                         help="replay every witness against the CPU oracle "
+                              "(a failed replay counts as an error)")
+    p_rules.add_argument("--acceptance", action="store_true",
+                         help="run the built-in injected-defect gate")
+    p_rules.set_defaults(fn=cmd_rules)
+
+    p_jax = sub.add_parser("jax", help="jitted hot-path audit")
+    p_jax.add_argument("--json", action="store_true")
+    p_jax.add_argument("--strict", action="store_true",
+                       help="warnings also exit nonzero")
+    p_jax.add_argument("--entries", metavar="NAMES",
+                       help="comma-separated entrypoint subset")
+    p_jax.add_argument("--ladder", metavar="SIZES",
+                       help="batch-size ladder (default 256,1024)")
+    p_jax.add_argument("--vmem-budget", type=int, metavar="BYTES")
+    p_jax.add_argument("--no-execute", action="store_true",
+                       help="trace-only (skip the run-twice recompile lint)")
+    p_jax.set_defaults(fn=cmd_jax)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

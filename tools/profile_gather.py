@@ -2,11 +2,12 @@
 """Gather microbenchmark (slim): cost of take(tbl(N,W)u32, idx(B,)) per
 row vs row width, plus a Pallas in-VMEM gather attempt.  Uses the
 bench's chained two-point-slope methodology."""
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import jax_setup, setup_repo_path
+
+setup_repo_path()
 
 import numpy as np
 import jax
@@ -55,9 +56,7 @@ def slope(step, idx0, label):
 
 
 def main():
-    if jax.default_backend() == "tpu":
-        from infw.platform import enable_jax_compile_cache
-        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    jax_setup()
     rng = np.random.default_rng(7)
     N = 65536
     idx0 = jax.device_put(rng.integers(0, N, B, dtype=np.int64).astype(np.int32))

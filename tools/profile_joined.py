@@ -1,15 +1,14 @@
 #!/usr/bin/env python
 """Measure the production classify with the joined-targets walk vs the
 legacy two-gather walk, per family, at the 100K tier."""
-import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import jax_setup, scale_args, setup_repo_path
+
+setup_repo_path()
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from infw import testing
 from infw.constants import KIND_IPV4, KIND_IPV6
@@ -19,12 +18,8 @@ from bench import chained_throughput
 
 
 def main():
-    on_tpu = jax.default_backend() == "tpu"
-    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
-    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    if on_tpu:
-        from infw.platform import enable_jax_compile_cache
-        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    on_tpu = jax_setup()
+    n_entries, width = scale_args(sys.argv, 100_000, 2_000, on_tpu=on_tpu)
     rng = np.random.default_rng(2024)
     tables = testing.random_tables_fast(
         rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))

@@ -2,13 +2,13 @@
 """Overlay cost check: classify throughput at the 100K tier with a dense
 overlay of 0 / 64 / 512 / 1024 entries active (the structural-add side
 table) — validates the OVERLAY_CAP sizing."""
-import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import jax_setup, setup_repo_path
+
+setup_repo_path()
 
 import numpy as np
-import jax
 
 from infw import testing
 from infw.compiler import LpmKey, compile_tables_from_content
@@ -19,10 +19,7 @@ from bench import chained_throughput
 
 
 def main():
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        from infw.platform import enable_jax_compile_cache
-        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    on_tpu = jax_setup()
     rng = np.random.default_rng(2024)
     n_entries = 100_000 if on_tpu else 2_000
     tables = testing.random_tables_fast(

@@ -12,11 +12,11 @@ bytes).
   v6 D8/D5 walk truncated to 8/5 levels (timing-only, wrong verdicts):
         depth scaling of the v6 walk
 """
-import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import jax_setup, scale_args, setup_repo_path
+
+setup_repo_path()
 
 import numpy as np
 import jax
@@ -30,12 +30,8 @@ from bench import chained_throughput
 
 
 def main():
-    on_tpu = jax.default_backend() == "tpu"
-    n_entries = int(sys.argv[1]) if len(sys.argv) > 1 else (100_000 if on_tpu else 2_000)
-    width = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    if on_tpu:
-        from infw.platform import enable_jax_compile_cache
-        enable_jax_compile_cache("/tmp/infw-jax-cache")
+    on_tpu = jax_setup()
+    n_entries, width = scale_args(sys.argv, 100_000, 2_000, on_tpu=on_tpu)
     rng = np.random.default_rng(2024)
     tables = testing.random_tables_fast(
         rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4))

@@ -34,9 +34,9 @@ from typing import Dict, Iterator, List
 
 import numpy as np
 
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+from _common import setup_repo_path
+
+setup_repo_path()
 
 from infw.constants import (  # noqa: E402
     DENY,

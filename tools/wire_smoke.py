@@ -14,7 +14,9 @@ import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import setup_repo_path
+
+setup_repo_path()
 
 import numpy as np  # noqa: E402
 
