@@ -5,7 +5,13 @@
 PY ?= python
 
 .PHONY: test test-fast bench bench-checked native entry-check \
-	dryrun-multichip spill-read wire-check lint static-check clean
+	dryrun-multichip mesh-check spill-read wire-check lint static-check \
+	clean
+
+# 8 virtual host devices for every CPU-side audit/gate: the mesh serving
+# entrypoints (classify-mesh/*) need a multi-device pool to build, and a
+# single-device audit would silently skip them.
+MESH_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 # Full suite including slow-marked scale tests (1M analyzer tier, full
 # registry audit); the tier-1 budgeted run and test-fast exclude them.
@@ -31,7 +37,7 @@ native:
 entry-check:
 	$(PY) -c "import __graft_entry__ as g, jax; fn, args = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*args)); print('entry OK')"
-	JAX_PLATFORMS=cpu $(PY) tools/infw_lint.py jax --strict
+	$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict
 
 # Lint (ruff when installed, AST fallback otherwise — same conservative
 # F + E9 rule set; see pyproject.toml [tool.ruff]).
@@ -53,7 +59,7 @@ lint:
 static-check: lint
 	$(PY) tools/infw_lint.py rules --ignore failsafe-violation --strict
 	$(PY) tools/infw_lint.py rules --acceptance
-	JAX_PLATFORMS=cpu $(PY) tools/infw_lint.py jax --strict
+	$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict
 	@echo "static-check OK"
 
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
@@ -74,10 +80,21 @@ wire-check:
 spill-read:
 	$(PY) tools/spill_read.py $(SPILL) $(ARGS)
 
-# Full distributed step on a virtual 8-device CPU mesh.
+# Full distributed step on a virtual 8-device CPU mesh, then the
+# measured multi-chip throughput ladder (bench.multichip_ladder) whose
+# final MULTICHIP_BENCH line is the driver's MULTICHIP record.
 dryrun-multichip:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(MESH_ENV) \
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Multi-chip serving gate: the mesh parity suite (MeshTpuClassifier vs
+# single-chip TpuClassifier vs the CPU oracle, incl. reshard/overlay/
+# edge cases) plus the smoke scaling bench — all on 8 simulated host
+# devices, so the production mesh path is exercised on every run
+# without TPU hardware.
+mesh-check:
+	$(MESH_ENV) $(PY) -m pytest tests/test_mesh.py tests/test_mesh_serving.py -q
+	$(MAKE) dryrun-multichip
 
 clean:
 	rm -rf infw/backend/native/_build **/__pycache__ .pytest_cache

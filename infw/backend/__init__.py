@@ -1,8 +1,36 @@
 """Classifier backends.
 
-- tpu: JAX/Pallas device classifier (dense MXU kernel or XLA trie path).
+- tpu: JAX/Pallas single-chip device classifier (dense MXU kernel or XLA
+  trie path).
+- mesh: multi-chip serving classifier — the same contract as tpu on a
+  ("data", "rules") device mesh (data-sharded wire, optional
+  rules-sharded tables, one device-side stats psum).  Selected by the
+  daemon's --mesh / INFW_MESH knob; falls back to tpu when the device
+  pool is too small.
 - cpu_ref: native C++ reference classifier (ctypes), the differential
   oracle and CPU fallback — the parity component for the reference's one
   native-code piece (the XDP C program).
+
+The heavy backends import jax at module load, so they are NOT imported
+here eagerly; use :func:`classifier_class` (or import the module
+directly) to resolve one by name.
 """
 from .base import Classifier, ClassifyOutput  # noqa: F401
+
+
+def classifier_class(name: str):
+    """Resolve a backend name to its classifier class: "tpu", "mesh",
+    or "cpu"."""
+    if name == "tpu":
+        from .tpu import TpuClassifier
+
+        return TpuClassifier
+    if name == "mesh":
+        from .mesh import MeshTpuClassifier
+
+        return MeshTpuClassifier
+    if name == "cpu":
+        from .cpu_ref import CpuRefClassifier
+
+        return CpuRefClassifier
+    raise ValueError(f"unknown backend {name!r} (expected tpu|mesh|cpu)")

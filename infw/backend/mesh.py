@@ -1,0 +1,390 @@
+"""Multi-chip TPU classifier backend: the mesh serving path.
+
+``MeshTpuClassifier`` serves the same ``classify_async`` /
+``prepare_packed`` / ``classify_prepared`` contract as the single-chip
+``TpuClassifier``, so the daemon's double-buffered delta-wire ingest and
+depth-class steering work unchanged — but the dataplane spans a
+``("data", "rules")`` device mesh (parallel.mesh):
+
+- **data axis** (the per-CPU XDP lanes of the reference, PAPER.md §2):
+  the packed wire is sharded over "data" at prepare time, so the H2D
+  staging of the next chunk runs per chip while the current chunk's
+  classify executes; per-shard statistics are combined on device with
+  ONE psum and the host reads a single merged ``stats_delta`` instead of
+  N per-chip copies.
+- **rules axis** (tensor parallelism over targets, the hXDP
+  parallel-lane analogue): with ``rules_shards > 1`` the rule table is
+  partitioned across chips — dense tables target-sharded, trie tables as
+  per-shard tries — and the global longest-prefix winner is selected
+  with pmax over match scores.
+
+Kernel parity with the single chip: the replicated configurations
+(``rules_shards == 1``) run the SAME kernels under shard_map — the int8
+Pallas dense kernel, the XLA trie walk with v4/depth truncation, the
+fused Pallas deep walk for the full-depth steering class, and the
+replicated overlay combine.  Rule loading on those configurations keeps
+the single-chip incremental contract: a 1-key rules edit diff-scatter
+patches the mesh-resident arrays (the small patch rows broadcast to
+every chip — kilobytes), and a structural CIDR add ships as the
+broadcast overlay side-table, the main trie untouched.
+
+The rules-sharded configurations rebuild their per-shard partition on
+every load (the round-robin entry split renumbers shard membership on
+any structural edit) and refuse overlays — the syncer merges into the
+main table instead, exactly as it does for the single-chip paths that
+cannot honor one.
+
+Wire formats on the mesh: wire / narrow / wire8.  The delta+varint codec
+is per-chunk sequential (one varint stream + one inverse permutation per
+encode) and does not shard along the data axis, so ``--wire-codec
+delta``/``auto`` degrades per chunk down the familiar
+delta -> wire8 -> narrow -> full chain starting at wire8 — never
+refuses, same contract as an ineligible chunk on one chip.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..compiler import CompiledTables
+from ..constants import KIND_OTHER
+from ..kernels import jaxpath
+from ..packets import PacketBatch, narrow_wire, wire8
+from ..parallel import mesh as meshmod
+from .base import ClassifyOutput, PendingClassify
+from .tpu import TpuClassifier
+
+log = logging.getLogger("infw.backend.mesh")
+
+
+def parse_mesh_spec(spec: str) -> Tuple[int, int]:
+    """"DATAxRULES" (e.g. "4x2") or a bare device count "8" (rules=1)
+    -> (data_shards, rules_shards).  Raises ValueError on junk — the
+    daemon CLI surfaces this at launch, not inside the sync loop."""
+    m = re.fullmatch(r"\s*(\d+)\s*(?:[xX]\s*(\d+))?\s*", spec or "")
+    if not m:
+        raise ValueError(
+            f"bad mesh spec {spec!r} (expected DATAxRULES, e.g. 4x2, or a "
+            "device count)"
+        )
+    data = int(m.group(1))
+    rules = int(m.group(2)) if m.group(2) else 1
+    if data < 1 or rules < 1:
+        raise ValueError(f"mesh axes must be positive, got {spec!r}")
+    return data, rules
+
+
+def resolve_mesh_spec(spec: str) -> Optional[Mesh]:
+    """Build the serving mesh for a --mesh/INFW_MESH spec, or None when
+    the daemon should FALL BACK to the single-chip classifier: a 1x1
+    spec, or a device pool too small for the requested shape (logged —
+    a daemon scheduled onto a single-chip node with a fleet-wide mesh
+    setting must come up serving, not crash-loop)."""
+    data, rules = parse_mesh_spec(spec)
+    if data * rules <= 1:
+        return None
+    n_avail = len(jax.devices())
+    if data * rules > n_avail:
+        log.warning(
+            "mesh %dx%d needs %d devices but only %d visible; "
+            "falling back to the single-chip classifier",
+            data, rules, data * rules, n_avail,
+        )
+        return None
+    return meshmod.make_mesh(data * rules, rules_shards=rules)
+
+
+class MeshTpuClassifier(TpuClassifier):
+    """Multi-chip device classifier on a ("data", "rules") mesh.
+
+    With ``rules_shards == 1`` (the default, pure data parallelism) all
+    table state is REPLICATED on the mesh — placement, incremental
+    patching, overlay broadcast and the fused-walk build all reuse the
+    single-chip machinery verbatim, with the replicated NamedSharding
+    standing in for the single device — and only the dispatch differs:
+    the wire shards over "data" and runs under shard_map with a device-
+    side stats psum.  With ``rules_shards > 1`` the table itself is
+    partitioned (see module docstring)."""
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        data_shards: Optional[int] = None,
+        rules_shards: int = 1,
+        **kw,
+    ) -> None:
+        if mesh is None:
+            n_avail = len(jax.devices())
+            data = data_shards or max(n_avail // max(rules_shards, 1), 1)
+            mesh = meshmod.make_mesh(
+                data * rules_shards, rules_shards=rules_shards
+            )
+        self._mesh = mesh
+        self._data_shards = mesh.shape["data"]
+        self._rules_shards = mesh.shape["rules"]
+        self._replicated = NamedSharding(mesh, P())
+        self._data_sharding = NamedSharding(mesh, P("data", None))
+        # The replicated sharding IS the placement: every device_put /
+        # scatter-patch / walk-build in the single-chip machinery takes a
+        # jax.device_put target, and a NamedSharding broadcasts where a
+        # Device pins.
+        super().__init__(device=self._replicated, **kw)
+        #: the overlay side-table broadcasts in kilobytes on the
+        #: replicated configs; the rules-sharded partition cannot honor
+        #: one (the syncer merges instead)
+        self.supports_overlay = self._rules_shards == 1
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    # -- rule loading -------------------------------------------------------
+
+    def load_tables(self, tables: CompiledTables, dirty_hint=None,
+                    overlay: Optional[CompiledTables] = None) -> None:
+        if self._rules_shards == 1:
+            # Replicated tables: the whole single-chip load path — dense
+            # Pallas build, diff-scatter patch, overlay cache, fused-walk
+            # build/patch, depth steering — runs against the mesh via the
+            # replicated placement.  A 1-key edit ships the same
+            # kilobytes as on one chip, broadcast.
+            return super().load_tables(
+                tables, dirty_hint=dirty_hint, overlay=overlay
+            )
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        if overlay is not None and overlay.num_entries > 0:
+            raise ValueError(
+                f"overlay not supported on the rules-sharded mesh "
+                f"(rules_shards={self._rules_shards}); merge it into the "
+                "main table"
+            )
+        path = self._force_path or (
+            "dense" if tables.num_entries <= self._dense_limit else "trie"
+        )
+        wide_rids = False
+        try:
+            jaxpath.check_wire_ruleids(tables)
+        except ValueError:
+            wide_rids = True
+        steer_parts = None
+        if path == "dense":
+            dev = meshmod.shard_tables(tables, self._mesh)
+        else:
+            # Per-shard tries are a PARTITION of the entry set: any
+            # structural change renumbers the round-robin split, so the
+            # sharded configuration re-places on every load (the
+            # incremental patch story belongs to the replicated config).
+            dev = meshmod.shard_tables_trie(tables, self._mesh)
+            lut = jaxpath.build_depth_lut(tables)
+            classes = jaxpath.tune_depth_classes(tables)
+            steer_parts = (
+                np.asarray(tables.root_lut, np.int64), lut, classes,
+            )
+        with self._lock:
+            self._tables = tables
+            self._active = (path, dev, None, wide_rids, None, None)
+            self._walk_meta = None
+            self._last_load = ("full", tables.num_entries)
+            self._depth_gen += 1
+            self._depth_steer = (
+                steer_parts + (self._depth_gen,)
+                if steer_parts is not None else None
+            )
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _mesh_pad_rows(self, arr: np.ndarray) -> np.ndarray:
+        """Pad wire rows to a multiple of 2*data_shards: equal shard
+        sizes for the "data" split, EVEN rows per shard so the u16 pair
+        packing of the fused output never straddles a shard boundary.
+        Pad rows are KIND_OTHER — always PASS, never counted — and the
+        host slices them off at materialize."""
+        n = arr.shape[0]
+        m = 2 * self._data_shards
+        npad = (-n) % m
+        if npad == 0 and n > 0:
+            return arr
+        rows = np.zeros((max(npad, m if n == 0 else npad), arr.shape[1]),
+                        arr.dtype)
+        rows[:, 0] = KIND_OTHER
+        return np.concatenate([arr, rows])
+
+    def _plan_wire(
+        self, path, dev, block_b, wire_np, v4_only, kind,
+        ov_dev=None, depth=None, walk_dev=None,
+    ):
+        """Mesh format choice + per-shard H2D staging: the chosen payload
+        is placed with the "data" sharding, which starts one async copy
+        per chip — the staged-plan transfer overlaps whatever every chip
+        is running (the double-buffer contract, now per shard)."""
+        n = wire_np.shape[0]
+        plan = {
+            "path": path, "dev": dev, "block_b": block_b, "ov_dev": ov_dev,
+            "depth": depth, "walk_dev": walk_dev, "v4_only": v4_only,
+            "kind": kind, "n": n,
+        }
+        put_data = lambda a: jax.device_put(a, self._data_sharding)
+        replicated_trie = path == "trie" and self._rules_shards == 1
+        if (
+            replicated_trie and wire_np.shape[1] == 4 and n
+            and self._wire_codec in ("auto", "wire8", "delta")
+        ):
+            # wire8 is the mesh's compressed format: 8 B/packet, a fixed
+            # per-row layout that shards cleanly over "data" (the delta
+            # stream is sequential per chunk and does not — see module
+            # docstring), with the ifindex dictionary replicated.
+            w8 = wire8(wire_np)
+            if w8 is not None:
+                wire8_np, ifmap = w8
+                wire8_np = self._mesh_pad_rows(wire8_np)
+                plan.update(
+                    fmt="wire8", pkt_len=self._wire4_pkt_len(wire_np),
+                    wire=put_data(wire8_np),
+                    ifmap=jax.device_put(ifmap, self._replicated),
+                )
+                self._note_wire("wire8", n, wire8_np.nbytes + ifmap.nbytes)
+                return plan
+        if wire_np.shape[1] in (4, 7):
+            nw = narrow_wire(wire_np)
+            if nw is not None:
+                wire_np = nw
+        wire_np = self._mesh_pad_rows(wire_np)
+        plan.update(fmt="wire", wire=put_data(wire_np))
+        self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
+        return plan
+
+    def _launch_wire(self, plan, apply_stats: bool) -> PendingClassify:
+        if plan["fmt"] == "wire8":
+            return self._launch_wire8(plan, apply_stats)
+        path, dev, block_b = plan["path"], plan["dev"], plan["block_b"]
+        ov_dev, depth, walk_dev = (
+            plan["ov_dev"], plan["depth"], plan["walk_dev"]
+        )
+        v4_only, kind, n = plan["v4_only"], plan["kind"], plan["n"]
+        wire = plan["wire"]
+        mesh = self._mesh
+        if path == "dense":
+            if self._rules_shards > 1:
+                fn = meshmod.jitted_mesh_wire(mesh, "dense-sharded", dev)
+            else:
+                fn = meshmod.jitted_mesh_wire(
+                    mesh, "pallas-dense", dev,
+                    interpret=self._interpret, block_b=block_b,
+                )
+            fused = fn(dev, wire)
+        elif walk_dev is not None and ov_dev is None:
+            # Fused Pallas deep walk per shard — same kernel, same
+            # overlay exclusion, as the single-chip dispatch.
+            fn = meshmod.jitted_mesh_wire(
+                mesh, "walk", walk_dev, interpret=self._interpret
+            )
+            fused = fn(walk_dev, wire)
+        elif ov_dev is not None:
+            fn = meshmod.jitted_mesh_wire(
+                mesh, "trie-overlay", dev, v4_only=v4_only, depth=depth,
+                overlay=ov_dev,
+            )
+            fused = fn(dev, ov_dev, wire)
+        elif self._rules_shards > 1:
+            fn = meshmod.jitted_mesh_wire(
+                mesh, "trie-sharded", dev, v4_only=v4_only, depth=depth
+            )
+            fused = fn(dev, wire)
+        else:
+            fn = meshmod.jitted_mesh_wire(
+                mesh, "trie", dev, v4_only=v4_only, depth=depth
+            )
+            fused = fn(dev, wire)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        data_shards = self._data_shards
+
+        def materialize() -> ClassifyOutput:
+            res16, stats = meshmod.split_fused_wire_outputs(
+                np.asarray(fused), n, data_shards
+            )
+            stats_delta = jaxpath.merge_stats_host(stats)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def _launch_wire8(self, plan, apply_stats: bool) -> PendingClassify:
+        dev, ov_dev = plan["dev"], plan["ov_dev"]
+        kind, n, pkt_len = plan["kind"], plan["n"], plan["pkt_len"]
+        fn = meshmod.jitted_mesh_wire8(self._mesh, dev, overlay=ov_dev)
+        if ov_dev is not None:
+            fused = fn(dev, ov_dev, plan["wire"], plan["ifmap"])
+        else:
+            fused = fn(dev, plan["wire"], plan["ifmap"])
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        data_shards = self._data_shards
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16, _ = meshmod.split_fused_wire_outputs(
+                np.asarray(fused), n, data_shards, with_stats=False
+            )
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            stats_delta = stats_from_results(results, pkt_len)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def _classify_async_wide(
+        self, dev, batch: PacketBatch, apply_stats: bool
+    ) -> PendingClassify:
+        """u32 results path for wide-ruleId tables, on the mesh: the
+        DeviceBatch shards over "data", results come back 4B/packet."""
+        n = len(batch)
+        bp = -(-max(n, 1) // self._data_shards) * self._data_shards
+        db = meshmod.shard_batch(batch.pad_to(bp), self._mesh)
+        if self._rules_shards > 1:
+            # dev is ShardedTrieTables (trie) or mesh DeviceTables (dense)
+            if isinstance(dev, meshmod.ShardedTrieTables):
+                fn = meshmod.make_sharded_trie_classifier(
+                    self._mesh, len(dev.trie_levels)
+                )
+            else:
+                fn = meshmod.make_sharded_classifier(
+                    self._mesh, len(dev.trie_levels)
+                )
+        else:
+            fn = meshmod.jitted_mesh_classify(self._mesh, "trie", dev)
+        res, xdp, stats = fn(dev, db)
+        for arr in (res, xdp, stats):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
+
+        def materialize() -> ClassifyOutput:
+            stats_delta = jaxpath.merge_stats_host(np.asarray(stats))
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=np.asarray(res)[:n], xdp=np.asarray(xdp)[:n],
+                stats_delta=stats_delta,
+            )
+
+        return PendingClassify(materialize)

@@ -225,31 +225,46 @@ def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
 
 
 def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
-                            wire_codec: Optional[str] = None):
+                            wire_codec: Optional[str] = None,
+                            mesh: Optional[str] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
     mode).  ``wire_codec`` selects the H2D wire format (auto | wire8 |
     delta — the --wire-codec knob); None keeps the backend default (the
-    INFW_WIRE_CODEC env, else auto).  The CPU reference backend ignores
-    both."""
-    if backend == "cpu":
-        from .backend.cpu_ref import CpuRefClassifier
+    INFW_WIRE_CODEC env, else auto).  ``mesh`` is the multi-chip serving
+    spec ("DATAxRULES", the --mesh / INFW_MESH knob): when it resolves
+    against the visible device pool the factory produces the
+    MeshTpuClassifier; when it does not (single-chip node, 1x1 spec) the
+    daemon falls back to the single-chip classifier and keeps serving.
+    The CPU reference backend ignores all three."""
+    from .backend import classifier_class
 
-        return CpuRefClassifier
+    if backend == "cpu":
+        return classifier_class("cpu")
     if backend == "tpu":
         import functools
-
-        from .backend.tpu import TpuClassifier
 
         kw = {}
         if fused_deep is not None:
             kw["fused_deep"] = fused_deep
         if wire_codec is not None:
             kw["wire_codec"] = wire_codec
+        if mesh:
+            from .backend.mesh import resolve_mesh_spec
+
+            m = resolve_mesh_spec(mesh)  # None -> single-chip fallback
+            if m is not None:
+                log.info(
+                    "serving on a %dx%d (data x rules) device mesh",
+                    m.shape["data"], m.shape["rules"],
+                )
+                return functools.partial(
+                    classifier_class("mesh"), mesh=m, **kw
+                )
         if not kw:
-            return TpuClassifier
-        return functools.partial(TpuClassifier, **kw)
+            return classifier_class("tpu")
+        return functools.partial(classifier_class("tpu"), **kw)
     raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
 
 
@@ -279,6 +294,7 @@ class Daemon:
         wire_codec: Optional[str] = None,
         h2d_overlap: bool = True,
         h2d_stage_depth: int = DEFAULT_H2D_STAGE_DEPTH,
+        mesh: Optional[str] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -314,7 +330,8 @@ class Daemon:
         self.stats.register(self.metrics_registry)
         self.syncer = DataplaneSyncer(
             classifier_factory=make_classifier_factory(
-                backend, fused_deep=fused_deep, wire_codec=wire_codec
+                backend, fused_deep=fused_deep, wire_codec=wire_codec,
+                mesh=mesh,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -961,6 +978,16 @@ def main(argv: Optional[List[str]] = None) -> int:
              "= force, with eligibility fallbacks",
     )
     p.add_argument(
+        "--mesh",
+        default=os.environ.get("INFW_MESH") or None,
+        help="multi-chip serving mesh as DATAxRULES (e.g. 8x1, 4x2) or a "
+             "bare device count (rules=1); CLI beats INFW_MESH.  Packets "
+             "shard over the data axis, the rule table over the rules "
+             "axis (per-shard tries above the dense limit).  When the "
+             "visible device pool is smaller than the spec the daemon "
+             "logs a warning and serves single-chip",
+    )
+    p.add_argument(
         "--no-h2d-overlap", action="store_true",
         default=os.environ.get("INFW_H2D_OVERLAP", "") in ("0", "false", "no"),
         help="disable double-buffered ingestion (the next chunk's H2D "
@@ -992,6 +1019,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             "(expected auto|wire8|delta)"
         )
 
+    # Same launch-time validation posture as the wire codec: a bad
+    # INFW_MESH (or --mesh) must fail here with a usage error, not raise
+    # inside the sync loop and leave an empty PASS-everything dataplane.
+    # Gated on the tpu backend: the cpu backend ignores the knob, and
+    # importing backend.mesh (which imports jax) would break the jax-free
+    # CPU deployment path for a fleet-wide INFW_MESH setting.
+    if args.mesh is not None and args.backend == "tpu":
+        from .backend.mesh import parse_mesh_spec
+
+        try:
+            parse_mesh_spec(args.mesh)
+        except ValueError as e:
+            p.error(str(e))
+
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -1021,6 +1062,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         fused_deep=False if args.no_fused_deep else None,
         wire_codec=args.wire_codec,
         h2d_overlap=not args.no_h2d_overlap,
+        mesh=args.mesh,
     )
     stop = threading.Event()
 

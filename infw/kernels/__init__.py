@@ -240,9 +240,116 @@ def _build_pallas_walk(b: int):
     return fn, (_fixture_walk_tables(), _fixture_device_batch(b))
 
 
+# -- mesh (multi-chip serving) fixtures/builders -----------------------------
+#
+# The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
+# parallel/mesh.py jitted_mesh_wire) is hot-path too: register it so the
+# strict jax audit (x64 leaks, host callbacks, recompile lint, Pallas
+# VMEM budget) covers the multi-chip programs.  The builders need a
+# multi-device pool (the audit env forces 8 virtual CPU devices, see
+# Makefile entry-check); on a single-device host they report
+# EntrypointUnavailable instead of failing.
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh(rules_shards: int):
+    import jax
+
+    from ..parallel import mesh as meshmod
+
+    n = len(jax.devices())
+    n -= n % rules_shards
+    if n < 2 or n < rules_shards:
+        raise EntrypointUnavailable(
+            f"mesh entrypoints need >=2 devices (rules_shards="
+            f"{rules_shards}); have {len(jax.devices())}"
+        )
+    return meshmod.make_mesh(n, rules_shards=rules_shards)
+
+
+def _mesh_data_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("data", None))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh_wire(b: int, rules_shards: int):
+    import jax
+
+    mesh = _fixture_mesh(rules_shards)
+    data = mesh.shape["data"]
+    if b % data != 0:
+        # An odd device pool (e.g. 6 visible -> data axis 3) may not
+        # divide a ladder batch: skip, don't fail the strict audit with
+        # a raw sharding ValueError.
+        raise EntrypointUnavailable(
+            f"ladder batch {b} not divisible over the {data}-wide data "
+            "axis of this device pool"
+        )
+    return jax.device_put(
+        _fixture_batch(b).pack_wire(), _mesh_data_sharding(mesh)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh_dense_tables():
+    from ..parallel import mesh as meshmod
+
+    return meshmod.shard_tables(_fixture_tables(False), _fixture_mesh(2))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh_trie_tables():
+    from ..parallel import mesh as meshmod
+
+    return meshmod.shard_tables_trie(_fixture_tables(True), _fixture_mesh(2))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh_walk_tables():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _fixture_mesh(1)
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda a: jax.device_put(a, rep), _fixture_walk_tables()
+    )
+
+
+def _build_mesh_sharded_dense(b: int):
+    from ..parallel import mesh as meshmod
+
+    dev = _fixture_mesh_dense_tables()
+    fn = meshmod.jitted_mesh_wire(_fixture_mesh(2), "dense-sharded", dev)
+    return fn, (dev, _fixture_mesh_wire(b, 2))
+
+
+def _build_mesh_sharded_trie(b: int):
+    from ..parallel import mesh as meshmod
+
+    dev = _fixture_mesh_trie_tables()
+    fn = meshmod.jitted_mesh_wire(_fixture_mesh(2), "trie-sharded", dev)
+    return fn, (dev, _fixture_mesh_wire(b, 2))
+
+
+def _build_mesh_walk(b: int):
+    from ..parallel import mesh as meshmod
+    from . import pallas_walk
+
+    dev = _fixture_mesh_walk_tables()
+    fn = meshmod.jitted_mesh_wire(
+        _fixture_mesh(1), "walk", dev,
+        interpret=pallas_walk.default_interpret(),
+    )
+    return fn, (dev, _fixture_mesh_wire(b, 1))
+
+
 def kernel_entrypoints() -> List[KernelEntrypoint]:
     """The registered jitted hot-path entrypoints, in dispatch order of
-    the TPU backend (backend/tpu.py _launch_wire and friends)."""
+    the TPU backend (backend/tpu.py _launch_wire and friends), then the
+    mesh serving programs (backend/mesh.py)."""
     return [
         KernelEntrypoint("classify/xla-dense", "xla", _build_classify(False)),
         KernelEntrypoint("classify/xla-trie", "xla", _build_classify(True)),
@@ -264,5 +371,16 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify/pallas-walk", "pallas", _build_pallas_walk
+        ),
+        KernelEntrypoint(
+            "classify-mesh/sharded-dense-wire", "xla",
+            _build_mesh_sharded_dense,
+        ),
+        KernelEntrypoint(
+            "classify-mesh/sharded-trie-wire", "xla",
+            _build_mesh_sharded_trie,
+        ),
+        KernelEntrypoint(
+            "classify-mesh/walk-wire", "pallas", _build_mesh_walk
         ),
     ]

@@ -907,13 +907,22 @@ def patch_device_tables(
         else:
             trie_targets, k = p
             total += k
-        p = _patch_array(dev.joined, o[7], nw[7], device)
-        if p is None:
-            joined = put(nw[7])
-            total += len(nw[7])
+        if nw[7].shape[0] <= 1:
+            # Inactive joined placeholder: it must stay EXACTLY (1, 1) —
+            # classify selects the joined walk on joined.shape[0] > 1, so
+            # the bucket-padded put() below would flip a non-joined table
+            # into walking a zero-width rules tail (and _patch_array
+            # always refuses the placeholder: _row_bucket(1) == 8 != 1).
+            joined = jax.device_put(jnp.asarray(nw[7]), device)
+            total += 0 if dev.joined.shape[0] <= 1 else 1
         else:
-            joined, k = p
-            total += k
+            p = _patch_array(dev.joined, o[7], nw[7], device)
+            if p is None:
+                joined = put(nw[7])
+                total += len(nw[7])
+            else:
+                joined, k = p
+                total += k
     p = _patch_array(dev.root_lut, o[6], nw[6], device)
     if p is None:
         root_lut = put(nw[6])

@@ -103,14 +103,15 @@ def make_global_mesh(rules_shards: Optional[int] = None) -> Mesh:
     ``rules_shards`` defaults to all of one host's local devices (max
     rules capacity per packet-shard); it must divide the local device
     count to preserve host containment."""
+    from .mesh import validate_mesh_axes
+
     devices = jax.devices()
     local = jax.local_device_count()
     shards = rules_shards or local
-    if local % shards != 0:
-        raise ValueError(
-            f"rules_shards={shards} must divide the local device count "
-            f"{local} so the rules axis stays on one host (ICI)"
-        )
+    # Same rule set (and wording) as parallel.mesh.make_mesh, applied to
+    # the LOCAL device count: the rules axis must fit within, and divide,
+    # one host's devices so the per-packet combine stays on ICI.
+    validate_mesh_axes(local, shards, local, what="local devices (ICI)")
     # Global devices ordered process-major: rows of the mesh fill one
     # host's devices before moving to the next, keeping each "rules" group
     # process-local.

@@ -8,6 +8,33 @@ from infw import oracle, testing
 from infw.parallel import mesh as meshmod
 
 
+def test_make_mesh_validation_unified():
+    """make_mesh used to silently truncate to the first n devices (and
+    reshape-crash when asked for more than exist); make_global_mesh
+    duplicated the divisibility check with a different message.  Both
+    now share validate_mesh_axes: raise on oversubscription, on
+    rules_shards > n_devices, and on non-divisible axes — with one
+    wording."""
+    from infw.parallel import multihost
+
+    n = len(jax.devices())
+    with pytest.raises(ValueError, match="visible"):
+        meshmod.make_mesh(n * 2)
+    with pytest.raises(ValueError, match="cannot be wider"):
+        meshmod.make_mesh(4, rules_shards=8)
+    with pytest.raises(ValueError, match="not divisible"):
+        meshmod.make_mesh(6, rules_shards=4)
+    with pytest.raises(ValueError, match="must be positive"):
+        meshmod.make_mesh(4, rules_shards=0)
+    # make_global_mesh: same rule set applied to the local device count
+    with pytest.raises(ValueError, match="cannot be wider"):
+        multihost.make_global_mesh(rules_shards=n * 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        multihost.make_global_mesh(rules_shards=3)
+    m = meshmod.make_mesh(8, rules_shards=2)
+    assert dict(m.shape) == {"data": 4, "rules": 2}
+
+
 @pytest.mark.parametrize("rules_shards", [1, 2, 4])
 def test_sharded_classify_matches_oracle(rules_shards):
     assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"

@@ -1,0 +1,416 @@
+"""Multi-chip serving parity: the MeshTpuClassifier must be bit-exact
+against the single-chip TpuClassifier and the CPU oracle on every mesh
+configuration and wire path (runs on the virtual 8-device CPU mesh the
+conftest forces).
+
+Covers the ISSUE-4 edge cases: target count not divisible by
+rules_shards (padding sentinel rows), empty table, v4-only batches, a
+mid-stream load_tables reshard (both the full re-place of the sharded
+partition and the replicated config's diff-scatter patch), the overlay
+broadcast, wide ruleIds, and the daemon factory / --mesh spec wiring.
+"""
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.backend.mesh import (
+    MeshTpuClassifier,
+    parse_mesh_spec,
+    resolve_mesh_spec,
+)
+from infw.backend.tpu import TpuClassifier
+from infw.compiler import (
+    IncrementalTables,
+    LpmKey,
+    compile_tables_from_content,
+)
+from infw.constants import KIND_IPV6
+
+
+def _single(tables, **kw):
+    clf = TpuClassifier(interpret=True, **kw)
+    clf.load_tables(tables)
+    return clf
+
+
+def _mesh(tables, data, rules, **kw):
+    clf = MeshTpuClassifier(
+        data_shards=data, rules_shards=rules, interpret=True, **kw
+    )
+    if tables is not None:
+        clf.load_tables(tables)
+    return clf
+
+
+def _assert_parity(mesh_clf, single_clf, tables, batch, oracle_check=True):
+    got = mesh_clf.classify(batch, apply_stats=False)
+    want = single_clf.classify(batch, apply_stats=False)
+    np.testing.assert_array_equal(got.results, want.results)
+    np.testing.assert_array_equal(got.xdp, want.xdp)
+    np.testing.assert_array_equal(got.stats_delta, want.stats_delta)
+    if oracle_check:
+        ref = oracle.classify(tables, batch)
+        np.testing.assert_array_equal(got.results, ref.results)
+        np.testing.assert_array_equal(got.xdp, ref.xdp)
+        assert testing.stats_dict_from_array(got.stats_delta) == ref.stats
+    return got
+
+
+@pytest.mark.parametrize("data,rules", [(8, 1), (4, 2), (2, 4)])
+def test_mesh_dense_parity(data, rules):
+    """Dense path: replicated int8 Pallas kernel under shard_map
+    (rules=1) and the target-sharded XLA dense partial (rules>1), all
+    bit-exact vs single chip and oracle — one merged stats_delta."""
+    rng = np.random.default_rng(5)
+    tables = testing.random_tables(rng, n_entries=60, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=301)
+    _assert_parity(
+        _mesh(tables, data, rules), _single(tables), tables, batch
+    )
+
+
+@pytest.mark.parametrize("data,rules", [(8, 1), (2, 4)])
+def test_mesh_trie_parity(data, rules):
+    """Trie path: replicated XLA walk (rules=1) and per-shard tries over
+    "rules" (rules>1) vs single chip and oracle."""
+    rng = np.random.default_rng(7)
+    tables = testing.random_tables(
+        rng, n_entries=90, width=8, overlap_fraction=0.5
+    )
+    batch = testing.random_batch(rng, tables, n_packets=333)
+    _assert_parity(
+        _mesh(tables, data, rules, force_path="trie"),
+        _single(tables, force_path="trie"), tables, batch,
+    )
+
+
+def test_mesh_targets_not_divisible_by_rules_shards():
+    """37 targets over 4 rule shards: the shard padding rows carry the
+    mask_len == -1 sentinel and must never match."""
+    rng = np.random.default_rng(11)
+    tables = testing.random_tables(rng, n_entries=37, width=8)
+    batch = testing.random_batch(rng, tables, n_packets=256)
+    _assert_parity(
+        _mesh(tables, 2, 4), _single(tables), tables, batch
+    )
+    _assert_parity(
+        _mesh(tables, 2, 4, force_path="trie"),
+        _single(tables, force_path="trie"), tables, batch,
+    )
+
+
+def test_mesh_empty_table():
+    """An empty ruleset classifies everything to UNDEF/PASS on every
+    mesh configuration, like the single chip."""
+    rng = np.random.default_rng(13)
+    seed = testing.random_tables(rng, n_entries=8, width=4)
+    empty = compile_tables_from_content({}, rule_width=4)
+    batch = testing.random_batch(rng, seed, n_packets=128)
+    for data, rules in ((8, 1), (2, 4)):
+        for force in (None, "trie"):
+            m = _mesh(empty, data, rules, force_path=force)
+            s = _single(empty, force_path=force)
+            _assert_parity(m, s, empty, batch)
+
+
+def test_mesh_v4_only_batch():
+    """A v4-only compactable batch takes the compact wire (and the
+    wire8 format on the replicated trie config) — parity end to end."""
+    rng = np.random.default_rng(17)
+    tables = testing.random_tables_fast(
+        rng, n_entries=3000, width=4, v6_fraction=0.0, ifindexes=(2, 3)
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=640)
+    batch.ip_words[:, 1:] = 0
+    keep = np.asarray(batch.kind) != KIND_IPV6
+    batch = batch.take(np.nonzero(keep)[0])
+    m = _mesh(tables, 8, 1, force_path="trie")
+    s = _single(tables, force_path="trie")
+    _assert_parity(m, s, tables, batch, oracle_check=False)
+    ref = oracle.classify(tables, batch)
+    got = m.classify(batch, apply_stats=False)
+    np.testing.assert_array_equal(got.results, ref.results)
+    # the compact (B, 4) wire must have engaged the 8 B/packet format
+    assert "wire8" in m.wire_stats(), m.wire_stats()
+
+
+def test_mesh_packed_contract_and_depth_steering():
+    """The daemon's exact hot loop — v6_depth_groups + prepare_packed /
+    classify_prepared staged plans — against the mesh, including the
+    fused Pallas deep walk for the full-depth class (replicated config),
+    bit-exact vs the single chip running the same flow."""
+    rng = np.random.default_rng(23)
+    tables = testing.random_tables_fast(
+        rng, n_entries=3000, width=8, group_size=6, ifindexes=(2, 3, 4)
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=1024)
+    m = _mesh(tables, 8, 1, force_path="trie", fused_deep=True)
+    s = _single(tables, force_path="trie", fused_deep=True)
+    assert m._active[5] is not None, "fused walk must build on the mesh"
+    assert m.supports_packed()
+
+    def run(clf):
+        res = np.zeros(len(batch), np.uint32)
+        stats = None
+        kinds = np.asarray(batch.kind)
+        idx6 = np.nonzero(kinds == KIND_IPV6)[0]
+        groups = clf.v6_depth_groups(batch.ifindex, batch.ip_words, idx6)
+        groups.append((None, np.nonzero(kinds != KIND_IPV6)[0]))
+        walked = False
+        for key, idx in groups:
+            if len(idx) == 0:
+                continue
+            wire, v4 = batch.pack_wire_subset(
+                np.ascontiguousarray(idx, np.int64)
+            )
+            plan = clf.prepare_packed(wire, v4, depth=key)
+            out = clf.classify_prepared(plan, apply_stats=False).result()
+            res[idx] = out.results
+            stats = (out.stats_delta if stats is None
+                     else stats + out.stats_delta)
+            if key is not None and key[0] is None:
+                walked = True
+        return res, stats, walked
+
+    res_m, stats_m, walked = run(m)
+    res_s, stats_s, _ = run(s)
+    assert walked, "the full-depth steering class must appear"
+    np.testing.assert_array_equal(res_m, res_s)
+    np.testing.assert_array_equal(stats_m, stats_s)
+
+
+def test_mesh_midstream_reshard_rules_sharded():
+    """A load_tables against a live rules-sharded mesh re-partitions the
+    per-shard tries; verdicts flip to the new ruleset, bit-exact."""
+    rng = np.random.default_rng(29)
+    t1 = testing.random_tables(rng, n_entries=50, width=8)
+    t2 = testing.random_tables(rng, n_entries=73, width=8)
+    batch = testing.random_batch(rng, t1, n_packets=256)
+    m = _mesh(t1, 2, 4, force_path="trie")
+    s = _single(t1, force_path="trie")
+    _assert_parity(m, s, t1, batch)
+    m.load_tables(t2)
+    s.load_tables(t2)
+    _assert_parity(m, s, t2, batch)
+
+
+def test_mesh_midstream_patch_replicated():
+    """On the replicated config a 1-key rules edit must take the
+    diff-scatter patch path (kilobytes broadcast, not a full re-put) and
+    stay bit-exact; a structural CIDR add ships as the broadcast
+    overlay, the main table untouched."""
+    rng = np.random.default_rng(31)
+    tables = testing.random_tables_fast(
+        rng, n_entries=2000, width=8, ifindexes=(2, 3)
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=512)
+    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    m = _mesh(None, 8, 1, force_path="trie")
+    s = TpuClassifier(interpret=True, force_path="trie")
+    m.load_tables(it.snapshot())
+    s.load_tables(it.snapshot())
+    it.clear_dirty()
+
+    key = list(it.content)[7]
+    rows = it.content[key].copy()
+    rows[0, 6] = 1 if rows[0, 6] == 2 else 2
+    it.apply({key: rows})
+    m.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    s.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    assert m._last_load[0] == "patch", m._last_load
+    _assert_parity(m, s, it.snapshot(), batch, oracle_check=False)
+
+    assert m.supports_overlay
+    ov_key = LpmKey(
+        prefix_len=24 + 32, ingress_ifindex=2,
+        ip_data=bytes([203, 0, 113, 0]) + bytes(12),
+    )
+    ovrows = np.zeros((8, 7), np.int32)
+    ovrows[1] = [1, 6, 443, 0, 0, 0, 1]
+    ov = compile_tables_from_content({ov_key: ovrows}, rule_width=8)
+    m.load_tables(it.snapshot(), dirty_hint=it.peek_dirty(), overlay=ov)
+    s.load_tables(it.snapshot(), dirty_hint=it.peek_dirty(), overlay=ov)
+    assert m._last_load[0] == "patch", m._last_load
+    _assert_parity(m, s, it.snapshot(), batch, oracle_check=False)
+
+
+def test_mesh_overlay_refused_on_rules_sharded():
+    rng = np.random.default_rng(37)
+    tables = testing.random_tables(rng, n_entries=40, width=4)
+    ov = compile_tables_from_content(
+        {
+            LpmKey(prefix_len=24 + 32, ingress_ifindex=2,
+                   ip_data=bytes([198, 18, 0, 0]) + bytes(12)):
+            np.array([[0] * 7, [1, 6, 80, 0, 0, 0, 1]] + [[0] * 7] * 2,
+                     np.int32),
+        },
+        rule_width=4,
+    )
+    m = _mesh(None, 2, 4, force_path="trie")
+    assert not m.supports_overlay
+    with pytest.raises(ValueError, match="overlay"):
+        m.load_tables(tables, overlay=ov)
+
+
+def test_mesh_wide_ruleids():
+    """ruleIds > 255 cannot ride the 2B wire result: the mesh must take
+    the u32 path (sharded tries / replicated classify) losslessly."""
+    rng = np.random.default_rng(41)
+    seed = testing.random_tables(rng, n_entries=40, width=8)
+    content = {}
+    for i, (k, v) in enumerate(seed.content.items()):
+        rows = v.copy()
+        rows[rows[:, 0] > 0, 0] = 300 + i
+        content[k] = rows
+    tables = compile_tables_from_content(content, rule_width=8)
+    batch = testing.random_batch(rng, seed, n_packets=200)
+    for data, rules in ((8, 1), (2, 4)):
+        m = _mesh(tables, data, rules, force_path="trie")
+        s = _single(tables, force_path="trie")
+        assert not m.supports_packed()
+        _assert_parity(m, s, tables, batch, oracle_check=False)
+        ref = oracle.classify(tables, batch)
+        got = m.classify(batch, apply_stats=False)
+        np.testing.assert_array_equal(got.results, ref.results)
+
+
+def test_mesh_cpu_ref_parity_10k():
+    """Scale tier: a 10K nested/overlapping table on the widest mesh vs
+    the native C++ reference classifier."""
+    from infw.backend.cpu_ref import CpuRefClassifier
+
+    rng = np.random.default_rng(43)
+    tables = testing.random_tables_fast(
+        rng, n_entries=10_000, width=8, group_size=6
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=2048)
+    ref = CpuRefClassifier()
+    ref.load_tables(tables)
+    want = ref.classify(batch, apply_stats=False)
+    for data, rules in ((8, 1), (2, 4)):
+        m = _mesh(tables, data, rules, force_path="trie")
+        got = m.classify(batch, apply_stats=False)
+        np.testing.assert_array_equal(got.results, want.results)
+        np.testing.assert_array_equal(got.xdp, want.xdp)
+        np.testing.assert_array_equal(got.stats_delta, want.stats_delta)
+
+
+# --- daemon wiring -----------------------------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("4x2") == (4, 2)
+    assert parse_mesh_spec(" 8 ") == (8, 1)
+    assert parse_mesh_spec("2X2") == (2, 2)
+    for bad in ("", "x", "4x", "ax2", "0x2", "4x0", "-4"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_resolve_mesh_spec_fallback():
+    assert resolve_mesh_spec("1x1") is None  # explicit single chip
+    assert resolve_mesh_spec("64x2") is None  # pool too small -> fallback
+    m = resolve_mesh_spec("4x2")
+    assert m is not None and dict(m.shape) == {"data": 4, "rules": 2}
+
+
+def test_factory_mesh_selection():
+    from infw.daemon import make_classifier_factory
+
+    f = make_classifier_factory("tpu", mesh="4x2")
+    assert f.func is MeshTpuClassifier
+    # too-large spec falls back to the single-chip class
+    f2 = make_classifier_factory("tpu", mesh="64x1")
+    assert f2 is TpuClassifier
+    # cpu backend ignores the knob
+    from infw.backend.cpu_ref import CpuRefClassifier
+
+    assert make_classifier_factory("cpu", mesh="4x2") is CpuRefClassifier
+
+
+def test_daemon_ingest_on_mesh(tmp_path):
+    """One real ingest tick through the daemon's staged pipeline against
+    the mesh classifier: frames file in, verdicts bit-exact vs oracle."""
+    from infw.daemon import Daemon, write_frames_file_v2
+    from infw.obs.events import EventRing, EventsLogger
+    from infw.obs.pcap import build_frames_bulk
+
+    rng = np.random.default_rng(47)
+    tables = testing.random_tables_fast(
+        rng, n_entries=2000, width=8, ifindexes=(2, 3)
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=4096)
+    fb = build_frames_bulk(
+        batch.kind, batch.ip_words, batch.proto, batch.dst_port,
+        batch.icmp_type, batch.icmp_code, l4_ok=batch.l4_ok,
+    )
+    fb.ifindex = np.asarray(batch.ifindex, np.uint32)
+
+    clf = MeshTpuClassifier(
+        data_shards=4, rules_shards=2, interpret=True, force_path="trie"
+    )
+    clf.load_tables(tables)
+
+    d = Daemon.__new__(Daemon)  # ingest-only harness (bench pattern)
+    d.ingest_dir = str(tmp_path / "ingest")
+    d.out_dir = str(tmp_path / "out")
+    import os
+
+    os.makedirs(d.ingest_dir)
+    os.makedirs(d.out_dir)
+    d.ingest_chunk = 1024
+    d.pipeline_depth = 4
+    d.max_tick_packets = 1 << 20
+    d.debug_lookup = False
+    d.h2d_overlap = True
+    d.h2d_stage_depth = 2
+    d.ring = EventRing(capacity=1 << 12)
+    d.events_logger = EventsLogger(d.ring, lambda line: None)
+
+    class _Syncer:
+        classifier = clf
+
+    d.syncer = _Syncer()
+    write_frames_file_v2(str(tmp_path / "ingest" / "a.frames"), fb)
+    assert d.process_ingest_once() == 1
+
+    verdicts = np.fromfile(
+        str(tmp_path / "out" / "a.frames.verdicts.bin"), "<u4"
+    )
+    from infw.obs.pcap import parse_frames_buf
+
+    parsed = parse_frames_buf(fb)
+    ref = oracle.classify(tables, parsed)
+    np.testing.assert_array_equal(verdicts, ref.results)
+
+
+# --- regression: joined-placeholder patch corruption -------------------------
+
+
+def test_patch_keeps_inactive_joined_placeholder():
+    """A diff-based (structural) patch of a table whose joined layout is
+    INACTIVE must keep the (1, 1) placeholder: bucket-padding it flips
+    classify into the joined walk with a zero-width rules tail (the
+    crash the mesh parity suite originally surfaced)."""
+    from infw.kernels import jaxpath
+
+    rng = np.random.default_rng(53)
+    tables = testing.random_tables_fast(
+        rng, n_entries=3000, width=8, group_size=6, ifindexes=(2, 3, 4)
+    )
+    assert jaxpath.build_joined(tables) is None  # inactive on this table
+    it = IncrementalTables.from_content(tables.content, rule_width=8)
+    snap = it.snapshot()
+    dev = jaxpath.device_tables(tables, pad=True)
+    assert dev.joined.shape == (1, 1)
+    patched = jaxpath.patch_device_tables(dev, tables, snap)
+    assert patched is not None
+    nd, _rows = patched
+    assert nd.joined.shape == (1, 1)
+    batch = testing.random_batch_fast(rng, tables, n_packets=256)
+    res, _xdp, _stats = jaxpath.jitted_classify(True)(
+        nd, jaxpath.device_batch(batch)
+    )
+    ref = oracle.classify(snap, batch)
+    np.testing.assert_array_equal(np.asarray(res), ref.results)
