@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast bench bench-checked native entry-check \
 	dryrun-multichip mesh-check spill-read wire-check lint static-check \
-	clean
+	state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -48,18 +48,50 @@ lint:
 		$(PY) tools/_lint_fallback.py; \
 	fi
 
-# Repo-level static gate: rule-table semantics + jitted hot-path audit.
+# Patch-path model checker (infw.analysis.statecheck): seeded op
+# sequences over the device-table edit state machine — after every op
+# the incrementally-patched device state must be bit-identical to a
+# cold rebuild and classify-equivalent to the CPU oracle — plus two
+# injected-defect acceptances:
+#   1. --inject-defect re-introduces the PR-4 joined-placeholder
+#      bucket-padding bug; the checker must catch it with a shrunk
+#      reproducer of <= 3 ops (exit 0 = caught);
+#   2. the strict jax audit must FAIL on a deliberately injected
+#      implicit host->device transfer (and pass without it — the plain
+#      strict audit runs in entry-check/static-check).
+# Must be green before any bench record is published (benchruns/README).
+state-check:
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --strict
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect
+	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
+		--inject-transfer-defect --entries defect/implicit-transfer \
+		>/dev/null 2>&1; rc=$$?; \
+	if [ $$rc -eq 1 ]; then \
+		echo "transfer-lint injection caught"; \
+	elif [ $$rc -eq 0 ]; then \
+		echo "state-check FAIL: injected implicit transfer NOT caught"; \
+		exit 1; \
+	else \
+		echo "state-check FAIL: inject audit exited $$rc (want 1 = caught)"; \
+		exit 1; \
+	fi
+
+# Repo-level static gate: rule-table semantics + jitted hot-path audit
+# + the patch-path model checker.
 #   1. examples lint — the shipped deny-all example INTENTIONALLY denies
 #      failsafe ports (that finding is the analyzer's demo; see README
 #      "Static analysis"), so that one check is silenced here;
 #   2. the acceptance gate: a table with one injected shadowed rule and
 #      one Allow/Deny conflict must report EXACTLY those two findings,
 #      each witness confirmed by replay against the CPU oracle;
-#   3. the jax audit across the shape ladder, strict.
+#   3. the jax audit across the shape ladder, strict (incl. the
+#      transfer-guard lint);
+#   4. the state checker with its injected-defect acceptances.
 static-check: lint
 	$(PY) tools/infw_lint.py rules --ignore failsafe-violation --strict
 	$(PY) tools/infw_lint.py rules --acceptance
 	$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict
+	$(MAKE) state-check
 	@echo "static-check OK"
 
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must

@@ -1,6 +1,6 @@
 """Static analysis over the firewall control plane and the jitted hot path.
 
-Two prongs (neither runs in the packet path):
+Three prongs (none runs in the packet path):
 
 - ``rules``: exact interval/prefix-algebra semantic analysis of a merged
   rule table — shadowed/redundant rules, LPM-dead sourceCIDRs,
@@ -11,12 +11,22 @@ Two prongs (neither runs in the packet path):
   oracle.
 - ``jaxcheck``: jaxpr-level audit of the registered jitted entrypoints
   (``infw.kernels.kernel_entrypoints``) — x64/dtype leaks, host
-  callbacks in the packet path, recompile-trigger lint across the bench
+  callbacks in the packet path, implicit host<->device transfers (the
+  ``jax.transfer_guard`` lint), recompile-trigger lint across the bench
   shape ladder, and a VMEM budget estimate for each Pallas kernel's
   block specs.
+- ``statecheck`` (+ ``shrink``): the patch-path model checker — seeded
+  op sequences over the device-table edit state machine, with every
+  incrementally-patched state proven bit-identical to a cold rebuild
+  and classify-equivalent to the CPU oracle; device-table invariant
+  contracts runnable standalone or as ``INFW_CHECK_INVARIANTS=1``
+  runtime hooks; failures shrink to minimal paste-able reproducers.
+  (Imported lazily — ``from infw.analysis import statecheck`` — since
+  it pulls in jax.)
 
-CLI: ``tools/infw_lint.py`` (``rules`` / ``jax`` subcommands);
-``make static-check`` is the repo-level gate.
+CLI: ``tools/infw_lint.py`` (``rules`` / ``jax`` / ``state``
+subcommands); ``make static-check`` is the repo-level gate and
+``make state-check`` the patch-path slice of it.
 """
 from . import rules  # noqa: F401  (re-export for infw.analysis.rules)
 

@@ -16,6 +16,12 @@ asserts, without a TPU:
   shape twice produces an identical jaxpr (no trace-time value
   dependence), and executing the bench shape ladder plus a repeat shape
   compiles exactly once per distinct shape (``_cache_size``);
+- **no implicit transfers**: a warmed entrypoint must execute under
+  ``jax.transfer_guard("disallow")`` — H2D staging is explicitly scoped
+  to the prepare/plan half of the dispatch (device_put), so any implicit
+  host<->device round trip inside the jitted hot path (an uncommitted
+  numpy operand, a host fallback) fails the strict audit, including the
+  mesh entrypoints on the 8-virtual-device pool;
 - **VMEM budget**: for each ``pallas_call``, the resident block-spec
   bytes (double-buffered for grid-blocked operands) must fit the
   documented per-core budget (``pallas_walk.DEFAULT_VMEM_BUDGET`` with
@@ -26,6 +32,7 @@ without re-tracing.  CLI: ``tools/infw_lint.py jax``.
 """
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -305,7 +312,68 @@ def audit_entry(
 
     if execute:
         rep.findings.extend(_recompile_lint(ep, ladder))
+        rep.findings.extend(_transfer_lint(ep, ladder))
     return rep
+
+
+def _transfer_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
+    """Execute each ladder shape under ``jax.transfer_guard("disallow")``
+    after one unguarded warm run (which compiles the executable and
+    stages/commits every operand — the explicitly scoped device_put half
+    of the dispatch).  The guarded re-run must then be transfer-free:
+    any failure means an implicit host<->device transfer inside the hot
+    path, which would serialize the async dispatch pipeline per chunk."""
+    import jax
+
+    from ..kernels import EntrypointUnavailable
+
+    out: List[AuditFinding] = []
+    for b in dict.fromkeys(int(x) for x in ladder):
+        try:
+            fn, args = ep.build(b)
+            jax.block_until_ready(fn(*args))  # warm OUTSIDE the guard
+        except EntrypointUnavailable:
+            continue  # already reported by the trace pass
+        except Exception:
+            continue  # build/run failures belong to the other lints
+        try:
+            with jax.transfer_guard("disallow"):
+                jax.block_until_ready(fn(*args))
+        except Exception as e:
+            msg = str(e).splitlines()[0][:300]
+            out.append(AuditFinding(
+                entry=ep.name,
+                check="implicit-transfer",
+                severity="error",
+                message=(
+                    f"batch {b}: implicit transfer in the packet path "
+                    f"(jax.transfer_guard('disallow')): {msg}"
+                ),
+            ))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _transfer_defect_jit():
+    import jax
+
+    return jax.jit(lambda x: x + 1)
+
+
+def transfer_defect_entrypoint():
+    """A deliberately defective entrypoint whose operand stays HOST-side
+    (an uncommitted numpy array), so every call implicitly transfers —
+    the acceptance fixture proving the strict audit actually fails on an
+    implicit transfer (``tools/infw_lint.py jax
+    --inject-transfer-defect`` / ``make state-check``)."""
+    import numpy as np
+
+    from ..kernels import KernelEntrypoint
+
+    def build(b: int):
+        return _transfer_defect_jit(), (np.zeros(int(b), np.int32),)
+
+    return KernelEntrypoint("defect/implicit-transfer", "xla", build)
 
 
 def _recompile_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
@@ -360,12 +428,20 @@ def audit_all(
     ladder: Sequence[int] = DEFAULT_LADDER,
     vmem_budget: Optional[int] = None,
     execute: bool = True,
+    include_transfer_defect: bool = False,
 ) -> List[EntryReport]:
-    """Audit every registered entrypoint (or the named subset)."""
+    """Audit every registered entrypoint (or the named subset).
+
+    ``include_transfer_defect`` appends the deliberately defective
+    host-operand entrypoint — the audit must then FAIL (the injected
+    acceptance of the transfer lint)."""
     from ..kernels import kernel_entrypoints
 
+    eps = list(kernel_entrypoints())
+    if include_transfer_defect:
+        eps.append(transfer_defect_entrypoint())
     reports = []
-    for ep in kernel_entrypoints():
+    for ep in eps:
         if names and ep.name not in names:
             continue
         reports.append(

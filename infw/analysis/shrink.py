@@ -1,0 +1,181 @@
+"""Deterministic counterexample shrinking for the state checker.
+
+Given a failing (base table, op sequence) from
+``infw.analysis.statecheck``, reduce it to a minimal reproducer along
+three axes — drop ops, shrink the base table, shrink the witness batch —
+re-running the equivalence engine on every candidate.  The search is
+purely deterministic (fixed candidate order, no randomness), so the same
+failing case always shrinks to the same minimal repro; the result prints
+as a literal, paste-able test case (:meth:`Repro.code`).
+
+The total number of engine re-runs is budgeted (``max_runs``): shrinking
+is a debugging aid on an already-failing gate, so a partially-shrunk
+repro on budget exhaustion beats an unbounded search.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..compiler import LpmKey
+from .statecheck import (
+    CONFIGS,
+    EditOp,
+    Failure,
+    StateConfig,
+    _key_code,
+    _rules_code,
+    run_ops,
+)
+
+
+@dataclass
+class Repro:
+    """A (possibly minimal) reproducer: re-running :func:`statecheck.
+    run_ops` on (base, ops, witness_b) reproduces ``failure``."""
+
+    config: StateConfig
+    base: Dict[LpmKey, np.ndarray]
+    ops: List[EditOp]
+    witness_b: int
+    failure: Failure
+    backend: str = "tpu"
+    seed: int = 0
+    runs_spent: int = 0
+
+    def code(self) -> str:
+        """The paste-able test case."""
+        lines = [
+            f"# minimal statecheck reproducer "
+            f"(config={self.config.name!r}, seed={self.seed}, "
+            f"{len(self.ops)} op(s), {len(self.base)} base entries)",
+            f"# failure: {self.failure.phase}: {self.failure.message}",
+            "import numpy as np",
+            "from infw.compiler import LpmKey",
+            "from infw.analysis import statecheck",
+            "",
+            "base = {",
+        ]
+        for k in sorted(
+            self.base,
+            key=lambda k: (k.ingress_ifindex, k.prefix_len, k.ip_data),
+        ):
+            lines.append(f"    {_key_code(k)}:")
+            lines.append(f"        {_rules_code(self.base[k])},")
+        lines.append("}")
+        lines.append("ops = [")
+        for op in self.ops:
+            lines.append(f"    {op.code()},")
+        lines.append("]")
+        lines.append(
+            f"failure = statecheck.run_ops(base, ops, "
+            f"config={self.config.name!r}, witness_b={self.witness_b}, "
+            f"backend={self.backend!r}, seed={self.seed})"
+        )
+        lines.append("assert failure is None, failure")
+        return "\n".join(lines)
+
+
+class _Budget:
+    def __init__(self, n: int):
+        self.left = n
+        self.spent = 0
+
+    def take(self) -> bool:
+        if self.left <= 0:
+            return False
+        self.left -= 1
+        self.spent += 1
+        return True
+
+
+def _truncate(ops: List[EditOp], failure: Failure) -> List[EditOp]:
+    """Ops after the failing step cannot matter: the engine checks every
+    prefix and returns the FIRST failure."""
+    if failure.step < 0:
+        return []
+    return ops[: failure.step + 1]
+
+
+def shrink_case(
+    base: Dict[LpmKey, np.ndarray],
+    ops: List[EditOp],
+    config,
+    failure: Failure,
+    *,
+    witness_b: int,
+    backend: str = "tpu",
+    seed: int = 0,
+    max_runs: int = 48,
+) -> Repro:
+    """Deterministically shrink a failing case.  Phases, in order:
+
+    1. truncate after the failing step (free — no re-run);
+    2. greedy op removal, last-to-first, to a fixpoint;
+    3. chunked base-table removal (halving chunk sizes, ddmin-style);
+    4. witness-batch halving.
+
+    Every kept candidate must still fail (any phase/step counts as "still
+    failing" — a shrink that morphs a classify divergence into a contract
+    violation at the same defect is a better repro, not a loss)."""
+    cfg = CONFIGS[config] if isinstance(config, str) else config
+    budget = _Budget(max_runs)
+
+    def rerun(b, o, wb) -> Optional[Failure]:
+        if not budget.take():
+            return None
+        return run_ops(b, o, cfg, witness_b=wb, backend=backend, seed=seed)
+
+    ops = _truncate(list(ops), failure)
+
+    # -- phase 2: greedy op removal -----------------------------------------
+    changed = True
+    while changed and len(ops) > 1:
+        changed = False
+        for i in reversed(range(len(ops))):
+            cand = ops[:i] + ops[i + 1:]
+            f2 = rerun(base, cand, witness_b)
+            if f2 is not None:
+                ops = _truncate(cand, f2)
+                failure = f2
+                changed = True
+                break
+
+    # -- phase 3: base-table shrink -----------------------------------------
+    keys = sorted(
+        base, key=lambda k: (k.ingress_ifindex, k.prefix_len, k.ip_data)
+    )
+    chunk = max(len(keys) // 2, 1)
+    while budget.left > 0:
+        i = 0
+        while i < len(keys) and budget.left > 0:
+            cand_keys = keys[:i] + keys[i + chunk:]
+            cand = {k: base[k] for k in cand_keys}
+            f2 = rerun(cand, ops, witness_b)
+            if f2 is not None:
+                keys = cand_keys
+                base = cand
+                ops = _truncate(ops, f2)
+                failure = f2
+            else:
+                i += chunk
+        if chunk == 1:
+            break
+        chunk = max(chunk // 2, 1)
+
+    # -- phase 4: witness shrink --------------------------------------------
+    wb = witness_b
+    while wb > 8 and budget.left > 0:
+        f2 = rerun(base, ops, wb // 2)
+        if f2 is None:
+            break
+        wb //= 2
+        ops = _truncate(ops, f2)
+        failure = f2
+
+    return Repro(
+        config=cfg, base=base, ops=ops, witness_b=wb, failure=failure,
+        backend=backend, seed=seed, runs_spent=budget.spent,
+    )

@@ -1,0 +1,1052 @@
+"""Patch-path model checker: incremental-vs-rebuild equivalence engine.
+
+The riskiest code in the stack is the *incremental state machinery* that
+mutates device-resident tables in place (jaxpath.patch_device_tables /
+joined_patch_rows / the overlay side-table / pallas_walk.patch_walk_joined
+/ the mesh-replicated diff-scatter broadcast): the packed, bucketed
+layout that makes the hot path fast makes in-place edits subtle, and a
+wrong patch is invisible until some packet takes the corrupted row (the
+PR-4 joined-placeholder bucket-padding bug shipped exactly this way).
+
+This module proves the state transitions instead of spot-checking them:
+
+- **operation model**: the edit alphabet the syncer/backends actually
+  emit — key add/delete, CIDR add (overlay side-table vs merge),
+  rules-only edit (joined-plane patch), rule-order change, overlay
+  overflow/spill, full re-place — as declarative :class:`EditOp`
+  records, with a seeded generator (:func:`build_case`) sampling op
+  sequences over ``infw.testing`` table distributions;
+- **equivalence engine**: after every prefix of an op sequence
+  (:func:`run_ops`), the incrementally-patched device state must be
+  *bit-identical* to a cold ``device_tables(compile(spec), pad=True)``
+  rebuild from a cache-stripped snapshot clone (so corrupted host-cache
+  carry-forward cannot poison both sides), and classify output on a
+  seeded witness batch must match the CPU oracle exactly (results, XDP
+  verdicts, statistics);
+- **invariant contracts**: :func:`check_device_tables` — a static pass
+  over a resident :class:`DeviceTables` (shapes, dtypes, pad-fill
+  values, mask-word reconstruction, joined-plane consistency, trie
+  child/target bounds, row buckets) runnable standalone and as opt-in
+  runtime hooks (``INFW_CHECK_INVARIANTS=1`` on the TPU/mesh backends
+  and the syncer) at every patch boundary;
+- **shrinking**: on failure, ``infw.analysis.shrink`` deterministically
+  reduces the op sequence, the base table and the witness batch to a
+  minimal reproducer and prints it as a paste-able test case.
+
+CLI: ``tools/infw_lint.py state`` (``--json/--strict/--seed/--ops``);
+``make state-check`` is the repo gate, including the injected-defect
+acceptance (``--inject-defect`` re-introduces the PR-4 bug behind
+``jaxpath._INJECT_JOINED_PAD_BUG`` and proves the checker catches it
+with a shrunk reproducer).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..compiler import (
+    CompileError,
+    CompiledTables,
+    IncrementalTables,
+    LpmKey,
+    compile_tables_from_content,
+)
+from ..constants import IPPROTO_TCP, KIND_IPV6, MAX_TARGETS
+from ..kernels import jaxpath
+
+
+class InvariantViolation(AssertionError):
+    """A resident device table violated the invariant contracts (the
+    deep, data-level pass — the always-on shape contract raises
+    jaxpath.DeviceTableInvariantError instead)."""
+
+
+#: rng stream salts: case generation and witness batches draw from
+#: DISJOINT seeded streams so shrinking ops never perturbs witnesses
+_CASE_SALT = 0x57A7EC4C
+_WITNESS_SALT = 0x57A7BA7C
+
+
+# --- operation model --------------------------------------------------------
+
+
+EDIT_KINDS = (
+    "key_add",        # structural new key, merged into the main table
+    "cidr_add",       # structural new key, overlay-routed when eligible
+    "key_delete",     # tombstone + node repush (or overlay removal)
+    "rules_edit",     # rules-only edit of an existing key (joined patch)
+    "order_change",   # permute rule order within an entry (rules-only)
+    "overlay_spill",  # bulk adds forcing the overlay overflow merge
+    "full_replace",   # rebuild the updater from current content
+)
+
+
+@dataclass
+class EditOp:
+    """One declarative edit of the device-table state machine.
+
+    ``key``/``rules`` carry the payload for single-key ops; ``items``
+    the bulk payload of ``overlay_spill``.  Ops are self-contained (they
+    embed concrete keys and rule matrices), so a shrunk sequence prints
+    as a literal, paste-able reproducer."""
+
+    kind: str
+    key: Optional[LpmKey] = None
+    rules: Optional[np.ndarray] = None
+    items: Tuple[Tuple[LpmKey, np.ndarray], ...] = ()
+
+    def describe(self) -> str:
+        if self.kind == "full_replace":
+            return "full_replace"
+        if self.kind == "overlay_spill":
+            return f"overlay_spill(+{len(self.items)} keys)"
+        k = self.key
+        return (f"{self.kind}({k.ingress_ifindex}:"
+                f"{k.ip_data.hex()[:12]}../{k.mask_len})")
+
+    def code(self) -> str:
+        """Literal constructor expression for the shrunk reproducer."""
+        parts = [f"kind={self.kind!r}"]
+        if self.key is not None:
+            parts.append(f"key={_key_code(self.key)}")
+        if self.rules is not None:
+            parts.append(f"rules={_rules_code(self.rules)}")
+        if self.items:
+            items = ", ".join(
+                f"({_key_code(k)}, {_rules_code(r)})" for k, r in self.items
+            )
+            parts.append(f"items=({items},)")
+        return f"statecheck.EditOp({', '.join(parts)})"
+
+
+def _key_code(k: LpmKey) -> str:
+    return (f"LpmKey({k.prefix_len}, {k.ingress_ifindex}, "
+            f"bytes.fromhex({k.ip_data.hex()!r}))")
+
+
+def _rules_code(rules: np.ndarray) -> str:
+    rules = np.asarray(rules)
+    specs = [
+        (int(i), tuple(int(x) for x in rules[i]))
+        for i in np.nonzero(rules.any(axis=1))[0]
+    ]
+    return f"statecheck.rules_from_specs({rules.shape[0]}, {specs!r})"
+
+
+def rules_from_specs(width: int, specs) -> np.ndarray:
+    """Inverse of _rules_code: (row, (rid, proto, portStart, portEnd,
+    icmpType, icmpCode, action)) pairs -> a (width, 7) rule matrix."""
+    rows = np.zeros((width, 7), np.int32)
+    for row, vals in specs:
+        rows[row] = vals
+    return rows
+
+
+# --- table configurations ---------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StateConfig:
+    """One named (distribution, classifier) configuration of the state
+    machine under check."""
+
+    name: str
+    n_entries: int = 48
+    width: int = 8
+    v6_fraction: float = 0.3
+    distribution: str = "general"   # "general" | "gate-tripped"
+    force_path: Optional[str] = "trie"
+    fused_deep: bool = False
+    steered: bool = False           # classify via the depth-steered packed path
+    overlay: bool = False           # syncer-style overlay routing for cidr_add
+    overlay_cap: int = 6
+    wide: bool = False              # seed one wide ruleId (u32 results path)
+    wide_edit_p: float = 0.0        # P(a rules_edit introduces a wide ruleId)
+    witness_b: int = 192
+
+
+CONFIGS: Dict[str, StateConfig] = {
+    c.name: c
+    for c in (
+        # the dense Pallas path rebuilds per load — covered for the
+        # classify/invariant halves of the engine (raw equivalence is
+        # trivially full-upload vs full-upload)
+        StateConfig("dense", n_entries=24, width=6, force_path=None,
+                    witness_b=128),
+        StateConfig("trie", steered=True),
+        StateConfig("overlay", overlay=True),
+        StateConfig("fused", n_entries=56, v6_fraction=0.85,
+                    fused_deep=True, steered=True),
+        StateConfig("wide", wide=True, wide_edit_p=0.2),
+        # joined duplication gate tripped: the table keeps the inactive
+        # (1, 1) joined placeholder — the PR-4 bug's layout regime and
+        # the injected-defect acceptance substrate
+        StateConfig("nojoined", distribution="gate-tripped", width=4),
+    )
+}
+
+
+def make_content(config: StateConfig, rng) -> Dict[LpmKey, np.ndarray]:
+    """Seeded base-table content for a configuration, drawn from the
+    infw.testing distributions."""
+    from .. import testing
+
+    if config.distribution == "gate-tripped":
+        content = dict(
+            testing.gate_tripped_tables(
+                rng, n_entries=config.n_entries, width=config.width
+            ).content
+        )
+    else:
+        content = dict(
+            testing.random_tables(
+                rng, n_entries=config.n_entries, width=config.width,
+                v6_fraction=config.v6_fraction,
+            ).content
+        )
+    if config.wide:
+        # one deterministic wide-ruleId entry flips the table onto the
+        # u32 (non-wire) results path
+        k = min(content, key=lambda k: (k.ingress_ifindex, k.ip_data,
+                                        k.prefix_len))
+        rows = np.zeros((config.width, 7), np.int32)
+        rows[1] = [70001, IPPROTO_TCP, 443, 0, 0, 0, 1]
+        content[k] = rows
+    return content
+
+
+def _sample_key(config: StateConfig, rng, taken) -> LpmKey:
+    """A fresh key from the configuration's distribution (identity not
+    in ``taken``)."""
+    v4_lens = (0, 8, 13, 16, 24, 30, 32)
+    v6_lens = (0, 32, 48, 64, 96, 128)
+    for _ in range(64):
+        if config.distribution == "gate-tripped":
+            mask = (17, 18, 24)[int(rng.integers(0, 3))]
+            data = bytes(
+                [10, int(rng.integers(0, 256)), int(rng.integers(0, 2)) * 128, 0]
+            ) + bytes(12)
+        elif rng.random() < config.v6_fraction:
+            mask = int(v6_lens[rng.integers(0, len(v6_lens))])
+            data = bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+        else:
+            mask = int(v4_lens[rng.integers(0, len(v4_lens))])
+            data = bytes(rng.integers(0, 256, 4, dtype=np.uint8)) + bytes(12)
+        ifx = (2, 3)[int(rng.integers(0, 2))]
+        key = LpmKey(mask + 32, ifx, data)
+        if key.masked_identity() not in taken:
+            return key
+    raise RuntimeError("could not sample a fresh key (distribution exhausted)")
+
+
+def _sample_rules(config: StateConfig, rng) -> np.ndarray:
+    from .. import testing
+
+    rows = testing.random_rules(rng, config.width)
+    if config.wide_edit_p and rng.random() < config.wide_edit_p:
+        rows = rows.copy()
+        rows[1] = [69000 + int(rng.integers(0, 1000)), IPPROTO_TCP,
+                   int(rng.integers(1, 65535)), 0, 0, 0, 1]
+    return rows
+
+
+def _permuted_rules(rng, rows: np.ndarray) -> Optional[np.ndarray]:
+    """Order change: the populated rule payloads reassigned to the same
+    populated order slots (index == order == ruleId stays intact)."""
+    rows = np.asarray(rows)
+    pop = np.nonzero(rows[:, 0] == np.arange(rows.shape[0]))[0]
+    pop = pop[pop > 0]
+    if len(pop) < 2:
+        return None
+    perm = rng.permutation(len(pop))
+    out = np.zeros_like(rows)
+    for dst, src in zip(pop, pop[perm]):
+        r = rows[src].copy()
+        r[0] = dst
+        out[dst] = r
+    return out
+
+
+def generate_ops(
+    rng, config: StateConfig, base_content: Dict[LpmKey, np.ndarray],
+    n_ops: int,
+) -> List[EditOp]:
+    """Sample a seeded op sequence over the evolving key set.  Ops carry
+    concrete keys/rules, so the sequence replays identically regardless
+    of how the driver routes them."""
+    kinds = list(EDIT_KINDS)
+    probs = np.array([0.14, 0.14, 0.15, 0.25, 0.10, 0.07, 0.15])
+    probs /= probs.sum()
+    keys: List[LpmKey] = list(base_content)
+    idents = {k.masked_identity() for k in keys}
+    key_rules = {k: np.asarray(v) for k, v in base_content.items()}
+    ops: List[EditOp] = []
+    for _ in range(n_ops):
+        kind = str(rng.choice(kinds, p=probs))
+        if kind in ("rules_edit", "order_change", "key_delete") and not keys:
+            kind = "key_add"
+        if kind == "full_replace":
+            ops.append(EditOp(kind="full_replace"))
+            continue
+        if kind == "overlay_spill":
+            items = []
+            for _ in range(config.overlay_cap + 2):
+                k = _sample_key(config, rng, idents)
+                idents.add(k.masked_identity())
+                r = _sample_rules(config, rng)
+                keys.append(k)
+                key_rules[k] = r
+                items.append((k, r))
+            ops.append(EditOp(kind="overlay_spill", items=tuple(items)))
+            continue
+        if kind in ("key_add", "cidr_add"):
+            k = _sample_key(config, rng, idents)
+            idents.add(k.masked_identity())
+            r = _sample_rules(config, rng)
+            keys.append(k)
+            key_rules[k] = r
+            ops.append(EditOp(kind=kind, key=k, rules=r))
+            continue
+        i = int(rng.integers(0, len(keys)))
+        k = keys[i]
+        if kind == "key_delete":
+            keys.pop(i)
+            idents.discard(k.masked_identity())
+            key_rules.pop(k, None)
+            ops.append(EditOp(kind="key_delete", key=k))
+            continue
+        if kind == "order_change":
+            r = _permuted_rules(rng, key_rules.get(k, np.zeros((config.width, 7))))
+            if r is None:
+                r = _sample_rules(config, rng)
+                kind = "rules_edit"
+        else:
+            r = _sample_rules(config, rng)
+        key_rules[k] = r
+        ops.append(EditOp(kind=kind, key=k, rules=r))
+    return ops
+
+
+# --- invariant contracts ----------------------------------------------------
+
+
+def _mask_words_host(mask_len: np.ndarray) -> np.ndarray:
+    """Host reference of jaxpath._mask_words_dev_jit: (T,) mask lengths
+    -> (T, 5) uint32 [ifindex-word, ip words] with the -1 sentinel rows
+    all-zero."""
+    ml = np.asarray(mask_len, np.int64)
+    valid = ml >= 0
+    w = np.arange(4)[None, :]
+    bits = np.clip(ml[:, None] - 32 * w, 0, 32).astype(np.uint64)
+    full = np.uint64(0xFFFFFFFF)
+    ip = np.where(
+        bits > 0, (full << (np.uint64(32) - bits)) & full, 0
+    ).astype(np.uint32)
+    if0 = np.where(valid, np.uint32(0xFFFFFFFF), np.uint32(0))[:, None]
+    return np.concatenate([if0, ip * valid[:, None]], axis=1)
+
+
+def check_device_tables(dev: "jaxpath.DeviceTables") -> List[str]:
+    """Static invariant pass over a resident padded DeviceTables; returns
+    violation strings (empty = contract holds).
+
+    Contracts: dense-group row bucket and dtypes, pad/tombstone fill
+    (mask_len == -1 rows carry zero keys/masks/rules), device mask-word
+    reconstruction, u16 rule-row width evenness, joined-plane activity
+    and consistency (the (1,1)-placeholder contract, row width vs the
+    rules layout, tidx bounds, zero sentinel rows), trie level dtypes,
+    DIR-16 root sizing, child/target range bounds against the next
+    level, the targets[0] == 0 sentinel, root-LUT bounds, and entry-count
+    accounting — the (1,1)->(8,1) bug class and its relatives become
+    named violations at the table, not a downstream parity mystery."""
+    v: List[str] = []
+    kw = np.asarray(dev.key_words)
+    mw = np.asarray(dev.mask_words)
+    ml = np.asarray(dev.mask_len)
+    rules = np.asarray(dev.rules)
+    joined = np.asarray(dev.joined)
+    targets = np.asarray(dev.trie_targets)
+    root_lut = np.asarray(dev.root_lut)
+    levels = [np.asarray(l) for l in dev.trie_levels]
+    n_entries = int(np.asarray(dev.num_entries))
+    nb = kw.shape[0]
+
+    # -- dense group ---------------------------------------------------------
+    for name, arr, dt in (
+        ("key_words", kw, np.uint32), ("mask_words", mw, np.uint32),
+        ("mask_len", ml, np.int32),
+    ):
+        if arr.dtype != dt:
+            v.append(f"{name}: dtype {arr.dtype}, want {dt.__name__}")
+        if arr.shape[0] != nb:
+            v.append(f"{name}: {arr.shape[0]} rows, dense group has {nb}")
+    if nb != jaxpath._row_bucket(nb):
+        v.append(f"dense row count {nb} is not a valid row bucket")
+    if kw.shape[1:] != (5,) or mw.shape[1:] != (5,):
+        v.append("key/mask words are not 5-wide (ifindex + 4 ip words)")
+    if rules.dtype == np.uint16:
+        if rules.shape[1] % 5:
+            v.append(
+                f"u16 rules row width {rules.shape[1]} not a multiple of 5"
+            )
+    elif rules.dtype == np.int32:
+        if rules.shape[1] % 7:
+            v.append(
+                f"i32 rules row width {rules.shape[1]} not a multiple of 7"
+            )
+    else:
+        v.append(f"rules: dtype {rules.dtype}, want uint16 or int32")
+    if not (0 <= n_entries <= nb):
+        v.append(f"num_entries {n_entries} outside [0, {nb}]")
+    live = ml >= 0
+    if int(live.sum()) > n_entries:
+        v.append(
+            f"{int(live.sum())} live rows (mask_len >= 0) exceed "
+            f"num_entries {n_entries}"
+        )
+    dead = ~live
+    if kw[dead].any() or mw[dead].any() or rules[dead].any():
+        v.append(
+            "pad/tombstone fill violated: a mask_len == -1 row carries "
+            "nonzero key/mask/rule bytes"
+        )
+    if not np.array_equal(mw, _mask_words_host(ml)):
+        v.append(
+            "mask_words do not match the device reconstruction from "
+            "mask_len (prefix-mask contract)"
+        )
+
+    # -- trie levels ---------------------------------------------------------
+    if levels:
+        l0 = levels[0]
+        if l0.dtype != np.int32 or (l0.size and l0.shape[1] != 2):
+            v.append(f"trie level 0: want (n, 2) int32, got {l0.shape} {l0.dtype}")
+        if l0.shape[0] % 65536:
+            v.append(
+                f"trie level 0 has {l0.shape[0]} rows — not whole DIR-16 "
+                "nodes (65536 slots each)"
+            )
+        nxt = levels[1].shape[0] if len(levels) > 1 else 0
+        if l0.size and int(l0[:, 0].max(initial=0)) > nxt:
+            v.append(
+                f"trie level 0 child id {int(l0[:, 0].max())} exceeds "
+                f"level-1 row count {nxt}"
+            )
+        pos_bound = joined.shape[0] if joined.shape[0] > 1 else max(
+            len(targets), 1
+        )
+        if l0.size and int(l0[:, 1].max(initial=0)) > pos_bound:
+            v.append(
+                f"trie level 0 target value {int(l0[:, 1].max())} exceeds "
+                f"its index space ({pos_bound})"
+            )
+        for i, lvl in enumerate(levels[1:], start=1):
+            if lvl.dtype != np.uint32 or (lvl.size and lvl.shape[1] != 18):
+                v.append(
+                    f"trie level {i}: want (n, 18) uint32 poptrie rows, got "
+                    f"{lvl.shape} {lvl.dtype}"
+                )
+                continue
+            if lvl.shape[0] != jaxpath._row_bucket(lvl.shape[0]) and (
+                lvl.shape[0] != 0
+            ):
+                # pad=False layouts are legal standalone; the serving
+                # contract is bucketed — flag only clear violations of
+                # bucket idempotence (a (1, x) placeholder-ish shape)
+                if lvl.shape[0] <= 1:
+                    v.append(f"trie level {i} has degenerate {lvl.shape[0]} rows")
+            if not lvl.size:
+                continue
+            cb = jaxpath._popcount32(lvl[:, 2:10].astype(np.uint32)).sum(axis=1)
+            tb = jaxpath._popcount32(lvl[:, 10:18].astype(np.uint32)).sum(axis=1)
+            nxt = levels[i + 1].shape[0] if i + 1 < len(levels) else 0
+            has_c = cb > 0
+            if has_c.any():
+                worst = int((lvl[:, 0].astype(np.int64) + cb)[has_c].max())
+                if worst > nxt:
+                    v.append(
+                        f"trie level {i} child range reaches {worst} > "
+                        f"level-{i + 1} rows {nxt}"
+                    )
+            has_t = tb > 0
+            if has_t.any():
+                bound = max(
+                    len(targets),
+                    joined.shape[0] if joined.shape[0] > 1 else 0,
+                )
+                worst = int((lvl[:, 1].astype(np.int64) + tb)[has_t].max())
+                if worst > bound:
+                    v.append(
+                        f"trie level {i} target range reaches {worst} > "
+                        f"targets index space {bound}"
+                    )
+    if targets.dtype != np.int32:
+        v.append(f"trie_targets: dtype {targets.dtype}, want int32")
+    if len(targets) and int(targets[0]) != 0:
+        v.append("trie_targets[0] != 0 (the no-target sentinel)")
+    if root_lut.dtype != np.int32:
+        v.append(f"root_lut: dtype {root_lut.dtype}, want int32")
+    if levels and root_lut.size:
+        worst = (int(root_lut.max(initial=0)) + 1) * 65536
+        if worst > max(levels[0].shape[0], 65536):
+            v.append(
+                f"root_lut node id {int(root_lut.max())} addresses slot "
+                f"{worst} beyond trie level 0 ({levels[0].shape[0]} rows)"
+            )
+
+    # -- joined plane --------------------------------------------------------
+    if joined.shape[0] <= 1:
+        meta_w = 3 if joined.dtype == np.uint16 else 2
+        if joined.shape[1] != 1 and joined.shape[1] != meta_w + rules.shape[1]:
+            v.append(
+                f"inactive joined row width {joined.shape[1]} is neither "
+                "the (1, 1) placeholder nor the sentinel joined layout — "
+                "the PR-4 bucket-padding bug class"
+            )
+        elif joined.any():
+            v.append(
+                "inactive joined row carries nonzero bytes (the single "
+                "row is the tidx+1 == 0 sentinel)"
+            )
+    else:
+        if joined.dtype != rules.dtype:
+            v.append(
+                f"joined dtype {joined.dtype} != rules dtype {rules.dtype}"
+            )
+        meta_w = 3 if joined.dtype == np.uint16 else 2
+        if joined.shape[1] != meta_w + rules.shape[1]:
+            v.append(
+                f"joined row width {joined.shape[1]} != {meta_w} + rules "
+                f"width {rules.shape[1]}"
+            )
+        if joined.shape[0] != jaxpath._row_bucket(joined.shape[0]):
+            v.append(
+                f"active joined row count {joined.shape[0]} is not a valid "
+                "row bucket"
+            )
+        if joined.shape[1] > meta_w:  # wide enough to hold the tidx columns
+            if joined.dtype == np.uint16:
+                t = joined[:, 0].astype(np.int64) | (
+                    joined[:, 1].astype(np.int64) << 16
+                )
+            else:
+                t = joined[:, 0].astype(np.int64)
+            if int(t.max(initial=0)) > nb:
+                v.append(
+                    f"joined tidx+1 value {int(t.max())} exceeds the dense "
+                    f"row bucket {nb}"
+                )
+            sentinel = t == 0
+            if joined[sentinel].any():
+                v.append(
+                    "a joined sentinel row (tidx+1 == 0) carries rule bytes"
+                )
+            if int(t.max(initial=0)) == 0:
+                v.append(
+                    "active joined plane holds no live rows — classify "
+                    "would walk an all-sentinel rules tail"
+                )
+    return v
+
+
+def check_sharded_tables(dev) -> List[str]:
+    """Minimal consistency pass for the rules-sharded mesh layouts
+    (which re-place on every load and are NOT the bucketed patch
+    layout): dtypes and the dead-row fill contract."""
+    v: List[str] = []
+    ml = np.asarray(dev.mask_len)
+    rules = np.asarray(dev.rules)
+    dead = ml < 0
+    if rules[dead].any():
+        v.append("sharded dead row (mask_len < 0) carries nonzero rules")
+    for i, lvl in enumerate(dev.trie_levels):
+        a = np.asarray(lvl)
+        want = np.int32 if i == 0 else np.uint32
+        if a.dtype != want:
+            v.append(f"sharded trie level {i}: dtype {a.dtype}, want {want.__name__}")
+    return v
+
+
+# --- the equivalence engine -------------------------------------------------
+
+
+@dataclass
+class Failure:
+    """First divergence found while checking an op sequence."""
+
+    step: int    # op index whose post-state failed; -1 = initial load
+    phase: str   # "load-error" | "invariant" | "raw" | "overlay-raw"
+                 # | "walk" | "classify" | "stats"
+    message: str
+    detail: str = ""
+
+    def __str__(self) -> str:
+        where = "initial load" if self.step < 0 else f"after op {self.step}"
+        s = f"[{self.phase}] {where}: {self.message}"
+        return s + (f"\n{self.detail}" if self.detail else "")
+
+    def to_dict(self) -> dict:
+        return {"step": self.step, "phase": self.phase,
+                "message": self.message, "detail": self.detail}
+
+
+def _cold_clone(t: CompiledTables) -> CompiledTables:
+    """A cache-stripped clone sharing the snapshot's raw arrays: every
+    derived structure (poptrie, packed rules, joined layout, depth LUT)
+    recomputes from scratch, so host-cache carry-forward corruption
+    cannot poison both sides of the equivalence compare."""
+    return CompiledTables(
+        rule_width=t.rule_width,
+        num_entries=t.num_entries,
+        key_words=t.key_words,
+        mask_words=t.mask_words,
+        mask_len=t.mask_len,
+        rules=t.rules,
+        trie_levels=list(t.trie_levels),
+        root_lut=t.root_lut,
+        content=t.content,
+    )
+
+
+def _named_device_arrays(dev):
+    if isinstance(dev, jaxpath.DeviceTables):
+        yield "key_words", dev.key_words
+        yield "mask_words", dev.mask_words
+        yield "mask_len", dev.mask_len
+        yield "rules", dev.rules
+        for i, l in enumerate(dev.trie_levels):
+            yield f"trie_levels[{i}]", l
+        yield "trie_targets", dev.trie_targets
+        yield "joined", dev.joined
+        yield "root_lut", dev.root_lut
+        yield "num_entries", dev.num_entries
+    else:
+        import jax
+
+        for i, leaf in enumerate(jax.tree.leaves(dev)):
+            yield f"leaf[{i}]", leaf
+
+
+def _first_mismatch(got, want) -> Optional[str]:
+    """Name + description of the first bit-level difference between two
+    device pytrees, or None when identical."""
+    got_list = list(_named_device_arrays(got))
+    want_list = list(_named_device_arrays(want))
+    if len(got_list) != len(want_list):
+        return (f"structure: {len(got_list)} arrays resident vs "
+                f"{len(want_list)} in the cold rebuild")
+    for (name, a), (_, b) in zip(got_list, want_list):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return (f"{name}: resident {a.shape} {a.dtype} vs cold rebuild "
+                    f"{b.shape} {b.dtype}")
+        if not np.array_equal(a, b):
+            flat_a = a.reshape(a.shape[0], -1) if a.ndim else a.reshape(1, 1)
+            flat_b = b.reshape(*flat_a.shape)
+            rows = np.nonzero((flat_a != flat_b).any(axis=1))[0]
+            r = int(rows[0])
+            return (f"{name}: {len(rows)} row(s) differ, first at row {r}: "
+                    f"resident {flat_a[r][:8].tolist()} vs cold "
+                    f"{flat_b[r][:8].tolist()}")
+    return None
+
+
+def _drain_walk_rebuilds(timeout: float = 30.0) -> None:
+    """Join any in-flight background fused-walk rebuild so checks see a
+    settled state (deterministic across runs)."""
+    for t in threading.enumerate():
+        if t.name == "infw-walk-rebuild":
+            t.join(timeout=timeout)
+
+
+def _classify_steered(clf, batch):
+    """Depth-steered packed classify — the daemon's family/depth-class
+    split reduced to one job per group, engaging the v4-truncated walk,
+    the per-class executables and the fused deep walk."""
+    n = len(batch)
+    results = np.zeros(n, np.uint32)
+    xdp = np.zeros(n, np.int32)
+    stats = np.zeros((MAX_TARGETS, 4), np.int64)
+    kinds = np.asarray(batch.kind)
+    v6 = np.nonzero(kinds == KIND_IPV6)[0]
+    non_v6 = np.nonzero(kinds != KIND_IPV6)[0]
+    jobs = []
+    if len(non_v6):
+        jobs.append((None, non_v6))
+    jobs += [
+        (d, idx)
+        for d, idx in clf.v6_depth_groups(batch.ifindex, batch.ip_words, v6)
+        if len(idx)
+    ]
+    for depth, idx in jobs:
+        wire, v4_only = batch.pack_wire_subset(np.asarray(idx, np.int64))
+        out = clf.classify_async_packed(
+            wire, v4_only, apply_stats=False, depth=depth
+        ).result()
+        results[idx] = out.results
+        xdp[idx] = out.xdp
+        stats += out.stats_delta
+    return results, xdp, stats
+
+
+class _Driver:
+    """Drives a classifier through EditOps, mirroring the syncer's
+    routing (overlay side-table vs merge vs full rebuild), and exposes
+    the model content + resident device state to the checker."""
+
+    def __init__(self, base_content, config: StateConfig, backend: str,
+                 witness_b: int, seed: int, mesh_shards=None):
+        self.config = config
+        self.witness_b = witness_b
+        self.seed = seed
+        self.updater = IncrementalTables.from_content(
+            dict(base_content), rule_width=config.width
+        )
+        self.overlay: Dict[LpmKey, np.ndarray] = {}
+        self._ov_memo: Optional[CompiledTables] = None
+        if backend == "mesh":
+            from ..backend.mesh import MeshTpuClassifier
+
+            data = mesh_shards or 4
+            self.clf = MeshTpuClassifier(
+                data_shards=data, rules_shards=1, interpret=True,
+                force_path=config.force_path, fused_deep=config.fused_deep,
+            )
+        else:
+            from ..backend.tpu import TpuClassifier
+
+            self.clf = TpuClassifier(
+                interpret=True, force_path=config.force_path,
+                fused_deep=config.fused_deep,
+            )
+        self.snapshot: Optional[CompiledTables] = None
+        try:
+            self._load()
+        except Exception:
+            self.close()  # never leak a classifier on a failed first load
+            raise
+
+    def close(self) -> None:
+        try:
+            self.clf.close()
+        except Exception:
+            pass
+
+    # -- op application (the syncer's routing, distilled) -------------------
+
+    def _load(self) -> None:
+        snap = self.updater.snapshot()
+        hint = self.updater.peek_dirty()
+        if getattr(self.clf, "supports_overlay", False):
+            self.clf.load_tables(
+                snap, dirty_hint=hint, overlay=self._compiled_overlay()
+            )
+        else:
+            if self.overlay:
+                raise RuntimeError("overlay routed to a non-overlay backend")
+            self.clf.load_tables(snap, dirty_hint=hint)
+        self.updater.clear_dirty()
+        self.snapshot = snap
+
+    def _compiled_overlay(self) -> Optional[CompiledTables]:
+        if not self.overlay:
+            self._ov_memo = None
+            return None
+        if self._ov_memo is None:
+            self._ov_memo = compile_tables_from_content(
+                dict(self.overlay), rule_width=self.config.width
+            )
+        return self._ov_memo
+
+    def _apply_main(self, ups, dels) -> None:
+        try:
+            if ups and not self.updater.fits(ups):
+                raise CompileError("trie depth exceeded; rebuild")
+            self.updater.apply(ups, dels)
+            # syncer discipline: reclaim tombstones when they dominate
+            # (a full re-place; hints invalid across it)
+            self.updater.maybe_compact()
+        except CompileError:
+            # mirror the syncer's rebuild: fresh updater absorbs the
+            # overlay too
+            content = dict(self.updater.content)
+            del_idents = {k.masked_identity() for k in dels}
+            content = {
+                k: v for k, v in content.items()
+                if k.masked_identity() not in del_idents
+            }
+            content.update(ups)
+            content.update(self.overlay)
+            self.overlay = {}
+            self._ov_memo = None
+            self.updater = IncrementalTables.from_content(
+                content, rule_width=self.config.width
+            )
+        self._load()
+
+    def apply(self, op: EditOp) -> None:
+        cfg = self.config
+        if op.kind == "full_replace":
+            content = dict(self.updater.content)
+            content.update(self.overlay)
+            self.overlay = {}
+            self._ov_memo = None
+            self.updater = IncrementalTables.from_content(
+                content, rule_width=cfg.width
+            )
+            self._load()
+            return
+        if op.kind == "overlay_spill":
+            ups = dict(self.overlay)
+            self.overlay = {}
+            self._ov_memo = None
+            ups.update({k: r for k, r in op.items})
+            self._apply_main(ups, [])
+            return
+        ident = op.key.masked_identity()
+        ov_key = next(
+            (k for k in self.overlay if k.masked_identity() == ident), None
+        )
+        if op.kind == "key_delete":
+            if ov_key is not None:
+                del self.overlay[ov_key]
+                self._ov_memo = None
+                self._load()
+            else:
+                self._apply_main({}, [op.key])
+            return
+        if ov_key is not None:
+            # edit of an overlay-resident key stays in the overlay
+            del self.overlay[ov_key]
+            self.overlay[op.key] = op.rules
+            self._ov_memo = None
+            self._load()
+            return
+        in_main = ident in self.updater._ident_to_t
+        route_overlay = (
+            op.kind == "cidr_add" and not in_main and cfg.overlay
+            and getattr(self.clf, "supports_overlay", False)
+        )
+        if route_overlay and len(self.overlay) < cfg.overlay_cap:
+            self.overlay[op.key] = op.rules
+            self._ov_memo = None
+            self._load()
+            return
+        if route_overlay:
+            # overflow: spill the whole overlay + the new key into the
+            # main table (one structural merge)
+            ups = dict(self.overlay)
+            self.overlay = {}
+            self._ov_memo = None
+            ups[op.key] = op.rules
+            self._apply_main(ups, [])
+            return
+        self._apply_main({op.key: op.rules}, [])
+
+    # -- checks --------------------------------------------------------------
+
+    def _classify(self, batch):
+        if self.config.steered and getattr(
+            self.clf, "supports_packed", lambda: False
+        )():
+            return _classify_steered(self.clf, batch)
+        out = self.clf.classify(batch, apply_stats=False)
+        return out.results, out.xdp, out.stats_delta
+
+    def check(self, step: int) -> Optional[Failure]:
+        from .. import oracle, testing
+        from ..kernels import pallas_walk
+
+        with self.clf._lock:
+            active = self.clf._active
+        _path, dev, _bb, _wide, ov_dev, walk_dev = active
+        snap = self.snapshot
+        clone = _cold_clone(snap)
+        device = self.clf._device
+        if isinstance(dev, jaxpath.DeviceTables):
+            viols = check_device_tables(dev)
+            if viols:
+                return Failure(step, "invariant",
+                               f"{len(viols)} contract violation(s)",
+                               "\n".join(viols))
+            fresh = jaxpath.device_tables(clone, device, pad=True)
+            m = _first_mismatch(dev, fresh)
+            if m:
+                return Failure(
+                    step, "raw",
+                    "patched device state diverged from the cold "
+                    "device_tables(compile(spec), pad=True) rebuild", m,
+                )
+        if ov_dev is not None:
+            viols = check_device_tables(ov_dev)
+            if viols:
+                return Failure(step, "invariant",
+                               f"overlay: {len(viols)} violation(s)",
+                               "\n".join(viols))
+            ovc = self._compiled_overlay()
+            if ovc is None:
+                return Failure(step, "overlay-raw",
+                               "device overlay resident but the model "
+                               "overlay is empty")
+            fresh_ov = jaxpath.device_tables(
+                _cold_clone(ovc), device, pad=True
+            )
+            m = _first_mismatch(ov_dev, fresh_ov)
+            if m:
+                return Failure(step, "overlay-raw",
+                               "overlay device state diverged from its "
+                               "cold rebuild", m)
+        if walk_dev is not None:
+            classes = jaxpath.tune_depth_classes(clone)
+            min_depth = classes[-2] if len(classes) >= 2 else None
+            built = pallas_walk.build_walk_tables_meta(
+                clone, min_depth=min_depth, device=device
+            )
+            if built is None:
+                return Failure(step, "walk",
+                               "fused walk resident but the cold rebuild "
+                               "declined to build")
+            m = _first_mismatch(walk_dev, built[0])
+            if m:
+                return Failure(step, "walk",
+                               "patched fused-walk tables diverged from "
+                               "the cold rebuild", m)
+        # -- classify equivalence vs the CPU oracle over the merged spec --
+        merged = dict(self.updater.content)
+        merged.update(self.overlay)
+        model = compile_tables_from_content(
+            merged, rule_width=self.config.width
+        )
+        rng = np.random.default_rng([_WITNESS_SALT, self.seed, step + 1])
+        if model.num_entries > 4096:
+            batch = testing.random_batch_fast(rng, model, self.witness_b)
+            ref = oracle.HashLpmOracle(model).classify(batch)
+        else:
+            batch = testing.random_batch(rng, model, self.witness_b)
+            ref = oracle.classify(model, batch)
+        results, xdp, stats = self._classify(batch)
+        if not np.array_equal(results, ref.results):
+            bad = np.nonzero(results != ref.results)[0]
+            i = int(bad[0])
+            return Failure(
+                step, "classify",
+                f"{len(bad)}/{len(batch)} witness verdict(s) diverge from "
+                "the CPU oracle",
+                f"first at packet {i}: got {int(results[i]):#x}, oracle "
+                f"{int(ref.results[i]):#x} (kind={int(batch.kind[i])}, "
+                f"if={int(batch.ifindex[i])}, "
+                f"ip={np.asarray(batch.ip_words)[i].tolist()})",
+            )
+        if not np.array_equal(xdp, ref.xdp):
+            bad = np.nonzero(xdp != ref.xdp)[0]
+            return Failure(step, "classify",
+                           f"{len(bad)} XDP verdict(s) diverge",
+                           f"first at packet {int(bad[0])}")
+        from ..testing import stats_dict_from_array
+
+        if stats_dict_from_array(stats) != ref.stats:
+            return Failure(step, "stats",
+                           "witness statistics diverge from the oracle",
+                           f"got {stats_dict_from_array(stats)}, "
+                           f"want {ref.stats}")
+        return None
+
+
+def run_ops(
+    base_content: Dict[LpmKey, np.ndarray],
+    ops: Sequence[EditOp],
+    config="trie",
+    *,
+    witness_b: Optional[int] = None,
+    backend: str = "tpu",
+    mesh_shards: Optional[int] = None,
+    seed: int = 0,
+) -> Optional[Failure]:
+    """Run one op sequence through the equivalence engine; returns the
+    first Failure, or None when every prefix checks out.  ``config`` is
+    a CONFIGS name or a StateConfig; reproducers emitted by the shrinker
+    call exactly this function."""
+    cfg = CONFIGS[config] if isinstance(config, str) else config
+    wb = witness_b or cfg.witness_b
+    try:
+        drv = _Driver(base_content, cfg, backend, wb, seed,
+                      mesh_shards=mesh_shards)
+    except Exception as e:  # initial load must never fail
+        return Failure(-1, "load-error", f"{type(e).__name__}: {e}")
+    try:
+        if cfg.fused_deep:
+            _drain_walk_rebuilds()
+        f = drv.check(-1)
+        if f is not None:
+            return f
+        for i, op in enumerate(ops):
+            try:
+                drv.apply(op)
+                if cfg.fused_deep:
+                    _drain_walk_rebuilds()
+            except Exception as e:
+                return Failure(i, "load-error",
+                               f"{op.describe()} raised "
+                               f"{type(e).__name__}: {e}")
+            f = drv.check(i)
+            if f is not None:
+                return f
+        return None
+    finally:
+        drv.close()
+
+
+def build_case(
+    config, seed: int, n_ops: int
+) -> Tuple[Dict[LpmKey, np.ndarray], List[EditOp]]:
+    """Seeded (base content, op sequence) for a configuration — the
+    deterministic entry the CLI, the tests and the shrinker all share."""
+    cfg = CONFIGS[config] if isinstance(config, str) else config
+    rng = np.random.default_rng([_CASE_SALT, seed])
+    base = make_content(cfg, rng)
+    ops = generate_ops(rng, cfg, base, n_ops)
+    return base, ops
+
+
+def run_config(
+    config,
+    seed: int = 0,
+    n_ops: int = 8,
+    *,
+    backend: str = "tpu",
+    witness_b: Optional[int] = None,
+    shrink_on_failure: bool = True,
+    max_shrink_runs: int = 48,
+) -> dict:
+    """Generate + run one seeded case; on failure, shrink to a minimal
+    reproducer.  Returns the CLI/report dict."""
+    cfg = CONFIGS[config] if isinstance(config, str) else config
+    base, ops = build_case(cfg, seed, n_ops)
+    failure = run_ops(base, ops, cfg, witness_b=witness_b,
+                      backend=backend, seed=seed)
+    out = {
+        "config": cfg.name, "seed": seed, "ops": len(ops),
+        "entries": len(base), "backend": backend,
+        "ok": failure is None,
+    }
+    if failure is not None:
+        out["failure"] = failure.to_dict()
+        if shrink_on_failure:
+            from .shrink import shrink_case
+
+            repro = shrink_case(
+                base, list(ops), cfg, failure,
+                witness_b=witness_b or cfg.witness_b, backend=backend,
+                seed=seed, max_runs=max_shrink_runs,
+            )
+            out["shrunk"] = {
+                "ops": len(repro.ops),
+                "entries": len(repro.base),
+                "witness_b": repro.witness_b,
+                "repro": repro.code(),
+            }
+    return out

@@ -186,6 +186,20 @@ class MeshTpuClassifier(TpuClassifier):
             steer_parts = (
                 np.asarray(tables.root_lut, np.int64), lut, classes,
             )
+        if self._check_invariants:
+            # The sharded partitions are NOT the bucket-padded patch
+            # layout (they re-place on every load), so the deep
+            # DeviceTables contract doesn't apply; run the minimal
+            # sharded consistency pass instead.  The replicated config
+            # inherits the full check via super().load_tables.
+            from ..analysis import statecheck  # lazy: no import cycle
+
+            viols = statecheck.check_sharded_tables(dev)
+            if viols:
+                raise statecheck.InvariantViolation(
+                    "sharded-table invariant contract violated:\n  "
+                    + "\n  ".join(viols)
+                )
         with self._lock:
             self._tables = tables
             self._active = (path, dev, None, wide_rids, None, None)

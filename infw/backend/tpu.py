@@ -59,6 +59,7 @@ class TpuClassifier:
         fused_deep: Optional[bool] = None,
         wire_codec: Optional[str] = None,
         decode_pallas: Optional[bool] = None,
+        check_invariants: Optional[bool] = None,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -100,6 +101,17 @@ class TpuClassifier:
             env = os.environ.get("INFW_DECODE_PALLAS", "")
             decode_pallas = env not in ("", "0", "false", "no")
         self._decode_pallas = bool(decode_pallas)
+        # Opt-in deep invariant contracts at every patch boundary
+        # (infw.analysis.statecheck.check_device_tables): shapes, dtypes,
+        # pad-fill values, mask-word reconstruction, trie child/target
+        # bounds, joined-plane consistency.  The cheap shape-only half
+        # (jaxpath.assert_patched_tables) is ALWAYS on; this adds the
+        # data-level pass — device reads, so opt in via the constructor
+        # or INFW_CHECK_INVARIANTS=1.
+        if check_invariants is None:
+            env = os.environ.get("INFW_CHECK_INVARIANTS", "")
+            check_invariants = env not in ("", "0", "false", "no")
+        self._check_invariants = bool(check_invariants)
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
@@ -241,6 +253,13 @@ class TpuClassifier:
                     walk_dev, walk_meta = self._build_walk(
                         tables, classes, dirty_hint
                     )
+                    if walk_dev is not None:
+                        # pre-compile the walk's joined-plane patch
+                        # scatters (one per array shape, lru-deduped) so
+                        # the first fused-path rules edit is compile-free
+                        pallas_walk.warm_walk_patch_scatters(
+                            walk_dev, self._device
+                        )
         ov_dev = None
         if overlay is not None and overlay.num_entries > 0:
             if path != "trie" or wide_rids:
@@ -263,6 +282,10 @@ class TpuClassifier:
                 )
                 with self._lock:
                     self._ov_cache = (overlay, ov_dev)
+        if self._check_invariants:
+            # deep contract pass BEFORE install: a violating generation
+            # never serves (the patch boundary is the mutation site)
+            self._run_invariant_check(dev, ov_dev)
         with self._lock:
             self._tables = tables
             self._active = (path, dev, block_b, wide_rids, ov_dev, walk_dev)
@@ -278,6 +301,29 @@ class TpuClassifier:
             )
         if defer_walk:
             self._spawn_walk_rebuild(tables, steer_parts[2])
+
+    def _run_invariant_check(self, dev, ov_dev) -> None:
+        """Opt-in deep invariant pass (INFW_CHECK_INVARIANTS=1 /
+        check_invariants=True) over the about-to-install device tables;
+        raises statecheck.InvariantViolation so the bad generation never
+        installs.  Only DeviceTables layouts are checkable (the dense
+        path's Pallas tables and the mesh shard structures have their own
+        minimal checks)."""
+        from ..analysis import statecheck  # lazy: no import cycle
+
+        viols = []
+        if isinstance(dev, jaxpath.DeviceTables):
+            viols += statecheck.check_device_tables(dev)
+        if ov_dev is not None:
+            viols += [
+                f"overlay: {v}"
+                for v in statecheck.check_device_tables(ov_dev)
+            ]
+        if viols:
+            raise statecheck.InvariantViolation(
+                "device-table invariant contract violated at the patch "
+                "boundary:\n  " + "\n  ".join(viols)
+            )
 
     def _build_walk(self, tables: CompiledTables, classes, dirty_hint):
         """Fused-walk tables for the full-depth steering class.
@@ -345,6 +391,7 @@ class TpuClassifier:
             if built is None:
                 return
             wt, meta = built
+            pallas_walk.warm_walk_patch_scatters(wt, self._device)
             with self._lock:
                 if (
                     self._tables is tables

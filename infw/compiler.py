@@ -775,7 +775,14 @@ class IncrementalTables:
         for t, (ident, (key, _r)) in enumerate(entries):
             self._ident_to_t[ident] = t
             self._ident_to_key[ident] = key
-        self.content = dict(content)
+        # content mirrors the LIVE table: aliased keys collapsed to the
+        # dedup winner.  Keeping every input key (the old dict(content))
+        # left the losing alias behind as a ghost — a later delete of
+        # that identity popped only the tracked key, so any rebuild,
+        # compaction or checkpoint restore RESURRECTED the deleted entry
+        # (found by the statecheck equivalence engine: device state and
+        # content permanently diverged after one aliased delete).
+        self.content = {key: rules for _ident, (key, rules) in entries}
         # Long-lived instances track dirty rows from here so the device
         # patch path can skip the full-table diff.  The hint stays
         # INVALID until the first clear_dirty(): hints are deltas against

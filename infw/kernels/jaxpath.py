@@ -23,6 +23,7 @@ Two LPM strategies, selected by table size:
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -107,6 +108,31 @@ class DeviceBatch(NamedTuple):
     icmp_type: jax.Array  # (B,) int32
     icmp_code: jax.Array  # (B,) int32
     pkt_len: jax.Array    # (B,) int32
+
+
+class DeviceTableInvariantError(AssertionError):
+    """A device-table mutation violated the bucket/placeholder contract
+    (see assert_patched_tables) — raised at the mutation site so a bad
+    patch never installs, instead of surfacing later as a parity mystery
+    (the PR-4 joined-placeholder bucket-padding bug was exactly this
+    class: caught by accident, downstream, via the mesh parity suite)."""
+
+
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_JOINED_PAD_BUG env var), patch_device_tables re-introduces
+#: the PR-4 bug — bucket-padding the inactive (1, 1) joined placeholder
+#: to (8, 1) on structural patches, which flips classify into a
+#: zero-width joined walk.  The state-checker acceptance gate
+#: (tools/infw_lint.py state --inject-defect) proves the model checker
+#: catches this with a shrunk reproducer; never set it in production.
+_INJECT_JOINED_PAD_BUG = False
+
+
+def _inject_joined_pad_bug() -> bool:
+    if _INJECT_JOINED_PAD_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_JOINED_PAD_BUG", "")
+    return env not in ("", "0", "false", "no")
 
 
 def _row_bucket(n: int) -> int:
@@ -621,7 +647,7 @@ def device_tables(
         else:
             levels_dev.append(put(tbl))
 
-    return DeviceTables(
+    result = DeviceTables(
         key_words=put(key_words),
         mask_words=_mask_words_dev_jit()(put(mask_len)),
         mask_len=put(mask_len),
@@ -632,6 +658,11 @@ def device_tables(
         root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
+    if pad:
+        # same permanent contract the patch path enforces: a padded
+        # upload IS the layout every later patch diffs against
+        assert_patched_tables(result)
+    return result
 
 
 @functools.lru_cache(maxsize=None)
@@ -656,6 +687,13 @@ def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0
     if old_np.dtype != new_np.dtype or old_np.shape[1:] != new_np.shape[1:]:
         return None
     nb = dev_arr.shape[0]
+    if nb != _row_bucket(nb):
+        # The resident array is not bucket-shaped — e.g. the inactive
+        # (1, 1) joined placeholder, whose static shape SELECTS the
+        # classify walk and must never be scatter-patched or padded
+        # (the PR-4 bug class).  Refusing here is the permanent half of
+        # the bucket contract; assert_patched_tables is the other.
+        return None
     if (
         tuple(dev_arr.shape[1:]) != new_np.shape[1:]
         or _row_bucket(new_np.shape[0]) != nb
@@ -725,6 +763,29 @@ def _scatter(dev_arr, pidx: np.ndarray, prows: np.ndarray, device):
     )
 
 
+def _capped_scatter(dev_arr, pos: np.ndarray, rows: np.ndarray, device):
+    """Scatter ``rows`` at ``pos`` into ``dev_arr`` through the shared
+    capped executable (see _scatter_cap): every small patch of one array
+    shape reuses ONE warmed scatter compile.  Returns the patched array,
+    or None when the delta is too large to win over a re-upload/rebuild
+    (callers fall back).  Shared by the joined-row patch and the fused
+    walk's byte-plane patch (pallas_walk.patch_walk_joined)."""
+    nb = dev_arr.shape[0]
+    k = len(pos)
+    if k == 0:
+        return dev_arr
+    if k > nb // 4:
+        return None
+    cap = _scatter_cap(k, nb)
+    pidx = np.empty(cap, np.int64)
+    pidx[:k] = pos
+    pidx[k:] = pos[-1]
+    prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
+    prows[:k] = rows
+    prows[k:] = rows[-1]
+    return _scatter(dev_arr, pidx, prows, device)
+
+
 def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
     """Pre-compile the patch path's scatter executables so the FIRST
     incremental update after a (re)load does not pay the scatter-jit
@@ -738,13 +799,23 @@ def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
     zeros scratch would double the transient HBM right after a full load,
     when the double-buffer contract may still hold the previous
     generation live."""
+    warm_scatters(
+        (dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
+         *dev.trie_levels, dev.trie_targets, dev.joined, dev.root_lut),
+        device,
+    )
+
+
+def warm_scatters(arrays, device=None) -> None:
+    """Pre-compile the capped scatter executable for each distinct
+    (shape, dtype) among ``arrays`` (the shared body of
+    warm_patch_scatters, also used for the fused walk's patchable joined
+    planes).  Arrays with <= 1 rows are skipped: a non-bucket resident
+    (the (1, 1) placeholders) is never patchable by contract."""
     seen = set()
-    for arr in (
-        dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
-        *dev.trie_levels, dev.trie_targets, dev.joined, dev.root_lut,
-    ):
-        key = (arr.shape, str(arr.dtype))
-        if arr.shape[0] == 0 or key in seen:
+    for arr in arrays:
+        key = (tuple(arr.shape), str(arr.dtype))
+        if arr.shape[0] <= 1 or key in seen:
             continue
         seen.add(key)
         cap = _scatter_cap(1, arr.shape[0])
@@ -763,6 +834,8 @@ def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
     rewrite their identical value.  Returns (array, k) or None when the
     bucket/dtype no longer matches or the hint is too large to win."""
     nb = dev_arr.shape[0]
+    if nb != _row_bucket(nb):
+        return None  # non-bucket resident (placeholder): never patchable
     if (
         dev_arr.dtype != new_np.dtype
         or tuple(dev_arr.shape[1:]) != new_np.shape[1:]
@@ -876,17 +949,11 @@ def patch_device_tables(
                     rows.dtype != dev.joined.dtype
                     or rows.shape[1:] != tuple(dev.joined.shape[1:])
                     or int(pos.max()) >= nb
-                    or k > nb // 4
                 ):
                     return None
-                cap = _scatter_cap(k, nb)
-                pidx = np.empty(cap, np.int64)
-                pidx[:k] = pos
-                pidx[k:] = pos[-1]
-                prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
-                prows[:k] = rows
-                prows[k:] = rows[-1]
-                joined = _scatter(dev.joined, pidx, prows, device)
+                joined = _capped_scatter(dev.joined, pos, rows, device)
+                if joined is None:
+                    return None
                 total += k
     else:
         levels = []
@@ -908,12 +975,18 @@ def patch_device_tables(
             trie_targets, k = p
             total += k
         if nw[7].shape[0] <= 1:
-            # Inactive joined placeholder: it must stay EXACTLY (1, 1) —
-            # classify selects the joined walk on joined.shape[0] > 1, so
-            # the bucket-padded put() below would flip a non-joined table
-            # into walking a zero-width rules tail (and _patch_array
-            # always refuses the placeholder: _row_bucket(1) == 8 != 1).
-            joined = jax.device_put(jnp.asarray(nw[7]), device)
+            # Inactive joined row ((1, 1) placeholder or single-sentinel
+            # layout): it must keep its exact single-row shape — classify
+            # selects the joined walk on joined.shape[0] > 1, so a
+            # bucket-padded put() here would flip a non-joined table
+            # into walking a zero/garbage-width rules tail (and
+            # _patch_array always refuses it: _row_bucket(1) == 8 != 1).
+            # assert_patched_tables below enforces this as a permanent
+            # contract at the mutation site.
+            if _inject_joined_pad_bug():
+                joined = put(nw[7])  # the PR-4 defect, re-introduced
+            else:
+                joined = jax.device_put(jnp.asarray(nw[7]), device)
             total += 0 if dev.joined.shape[0] <= 1 else 1
         else:
             p = _patch_array(dev.joined, o[7], nw[7], device)
@@ -930,22 +1003,94 @@ def patch_device_tables(
     else:
         root_lut, k = p
         total += k
-    return (
-        DeviceTables(
-            key_words=dense[0],
-            mask_words=dense[1],
-            mask_len=dense[2],
-            rules=dense[3],
-            trie_levels=tuple(levels),
-            trie_targets=trie_targets,
-            joined=joined,
-            root_lut=root_lut,
-            num_entries=jax.device_put(
-                jnp.asarray(np.int32(new.num_entries)), device
-            ),
+    result = DeviceTables(
+        key_words=dense[0],
+        mask_words=dense[1],
+        mask_len=dense[2],
+        rules=dense[3],
+        trie_levels=tuple(levels),
+        trie_targets=trie_targets,
+        joined=joined,
+        root_lut=root_lut,
+        num_entries=jax.device_put(
+            jnp.asarray(np.int32(new.num_entries)), device
         ),
-        total,
     )
+    # Permanent post-patch contract (shape-only, negligible cost): the
+    # PR-4 bug class — a placeholder that stopped being exactly (1, 1),
+    # a de-bucketed row count — raises HERE, at the mutation site.
+    assert_patched_tables(result)
+    return result, total
+
+
+def assert_patched_tables(dev: DeviceTables) -> None:
+    """Cheap permanent shape contract on a padded/patched DeviceTables;
+    raises DeviceTableInvariantError on violation.  Checks only static
+    shapes/dtypes (no device reads), so it is always on — the deep
+    data-level pass lives in infw.analysis.statecheck.check_device_tables
+    and runs under INFW_CHECK_INVARIANTS / the model checker."""
+    nb = dev.key_words.shape[0]
+    for name, arr in (
+        ("key_words", dev.key_words), ("mask_words", dev.mask_words),
+        ("mask_len", dev.mask_len), ("rules", dev.rules),
+    ):
+        if arr.shape[0] != nb:
+            raise DeviceTableInvariantError(
+                f"dense row-count mismatch: {name} has {arr.shape[0]} rows, "
+                f"key_words has {nb}"
+            )
+    if nb != _row_bucket(nb):
+        raise DeviceTableInvariantError(
+            f"dense arrays have {nb} rows — not a valid row bucket "
+            f"(_row_bucket({nb}) == {_row_bucket(nb)})"
+        )
+    j = dev.joined
+    meta_w = 3 if j.dtype == jnp.uint16 else 2
+    if j.shape[0] <= 1:
+        # Inactive for classify (the walk selects on shape[0] > 1): the
+        # (1, 1) placeholder, or a single-sentinel-row joined layout
+        # from a tiny/empty table.  Any other width means something
+        # padded or truncated the placeholder.
+        if j.shape[1] != 1 and j.shape[1] != meta_w + dev.rules.shape[1]:
+            raise DeviceTableInvariantError(
+                f"inactive joined row has width {j.shape[1]} — neither "
+                "the (1, 1) placeholder nor the sentinel joined layout "
+                f"({meta_w} + rules width {dev.rules.shape[1]})"
+            )
+    else:
+        # ACTIVE for classify: the row must really carry
+        # [tidx, mask_len, rules] in the resident rules layout — a
+        # bucket-padded placeholder ((8, 1), the PR-4 bug) or a stale
+        # width would make classify walk a zero/garbage-width rules tail.
+        if j.dtype != dev.rules.dtype:
+            raise DeviceTableInvariantError(
+                f"active joined dtype {j.dtype} != rules dtype "
+                f"{dev.rules.dtype}"
+            )
+        if j.shape[1] != meta_w + dev.rules.shape[1]:
+            raise DeviceTableInvariantError(
+                f"active joined row width {j.shape[1]} != {meta_w} + rules "
+                f"width {dev.rules.shape[1]} (a bucket-padded placeholder "
+                "masquerading as an active joined plane — the PR-4 bug "
+                "class)"
+            )
+        if j.shape[0] != _row_bucket(j.shape[0]):
+            raise DeviceTableInvariantError(
+                f"active joined array has {j.shape[0]} rows — not a valid "
+                "row bucket"
+            )
+    for i, lvl in enumerate(dev.trie_levels):
+        n = lvl.shape[0]
+        if i == 0:
+            if n % 65536:
+                raise DeviceTableInvariantError(
+                    f"trie level 0 has {n} rows — not a whole number of "
+                    "DIR-16 root nodes (65536 slots each)"
+                )
+        elif n != _row_bucket(n):
+            raise DeviceTableInvariantError(
+                f"trie level {i} has {n} rows — not a valid row bucket"
+            )
 
 
 def device_batch(batch: PacketBatch, device=None) -> DeviceBatch:

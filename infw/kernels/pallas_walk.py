@@ -415,22 +415,38 @@ def patch_walk_joined(
     rows[:, 1] = (t >> 16) & 0xFFFF
     rows[:, 2] = np.minimum(ml[tidx], 0xFFFF)
     rows[:, 3:] = rules_flat[tidx]
-    pos_dev = jax.device_put(jnp.asarray(pos), device)
+    # Scatter through the shared capped executable (jaxpath._scatter_cap)
+    # — warmed at walk-build time by warm_walk_patch_scatters, so the
+    # FIRST fused-path rules edit doesn't pay a scatter-jit compile, and
+    # every small patch of one array shape reuses one compile (the
+    # previous per-nnz `.at[pos].set` compiled a fresh executable per
+    # distinct dirty-row count).  An oversized delta falls back to the
+    # full rebuild, same as the jaxpath patch contract.
+    from .jaxpath import _capped_scatter
+
     if wt.joined.shape[0] > 1:  # fused tail: patch the byte planes
         byte_rows = _split_joined_rows(rows)
         if byte_rows is None or byte_rows.shape[1] != wt.joined.shape[1]:
             return None
-        byte_rows = byte_rows[: len(pos)]
-        joined = wt.joined.at[pos_dev].set(
-            jax.device_put(jnp.asarray(byte_rows), device)
+        joined = _capped_scatter(
+            wt.joined, pos, byte_rows[: len(pos)], device
         )
-        return wt._replace(joined=joined)
+        return None if joined is None else wt._replace(joined=joined)
     if rows.shape[1] != wt.joined_u16.shape[1]:
         return None
-    joined_u16 = wt.joined_u16.at[pos_dev].set(
-        jax.device_put(jnp.asarray(rows), device)
-    )
-    return wt._replace(joined_u16=joined_u16)
+    joined_u16 = _capped_scatter(wt.joined_u16, pos, rows, device)
+    return None if joined_u16 is None else wt._replace(joined_u16=joined_u16)
+
+
+def warm_walk_patch_scatters(wt: WalkTables, device=None) -> None:
+    """Pre-compile the capped scatter executables for the resident walk's
+    patchable joined planes (the fused-path half of
+    jaxpath.warm_patch_scatters): one warm per (shape, dtype), so the
+    first rules-only edit against a fresh fused walk ships in
+    milliseconds instead of paying a scatter-jit compile."""
+    from .jaxpath import warm_scatters
+
+    warm_scatters((wt.joined, wt.joined_u16), device)
 
 
 # --- XLA pre-stage: the DIR-16 root gather -------------------------------

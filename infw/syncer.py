@@ -519,6 +519,10 @@ class DataplaneSyncer:
             self._overlay_compiled = None
             incremental = False
         tables = self._updater.snapshot()
+        if os.environ.get("INFW_CHECK_INVARIANTS", "") not in (
+            "", "0", "false", "no"
+        ):
+            self._check_overlay_contract()
         # Dirty rows accumulated since the last SUCCESSFUL load: the
         # device backend patches exactly those rows instead of diffing or
         # re-uploading the table.  Cleared only after load_tables returns
@@ -544,6 +548,34 @@ class DataplaneSyncer:
         if incremental and self._journal_pending():
             return
         self._save_checkpoint(tables)
+
+    def _check_overlay_contract(self) -> None:
+        """Opt-in (INFW_CHECK_INVARIANTS=1) overlay accounting contract,
+        checked at the sync boundary BEFORE the device load: the overlay
+        must respect its capacity bound and stay identity-disjoint from
+        the main table — the classify combine resolves ties by strict
+        mask-len score, which is only collision-free while no LPM
+        identity lives in both tables.  A violation here is a routing bug
+        in _load_ingress_node_firewall_rules, surfaced at the mutation
+        site instead of as a wrong-verdict mystery."""
+        if len(self._overlay) > self.OVERLAY_CAP:
+            raise SyncError(
+                f"overlay holds {len(self._overlay)} keys — exceeds "
+                f"OVERLAY_CAP={self.OVERLAY_CAP} (spill-to-merge routing "
+                "failed)"
+            )
+        if self._updater is None or not self._overlay:
+            return
+        main = {k.masked_identity() for k in self._updater.content}
+        dup = [
+            k for k in self._overlay if k.masked_identity() in main
+        ]
+        if dup:
+            raise SyncError(
+                f"{len(dup)} overlay key(s) alias main-table identities "
+                f"(first: {dup[0]}); the longest-prefix combine requires "
+                "disjoint identities"
+            )
 
     def _compile_overlay(self, width: int) -> Optional[CompiledTables]:
         """Small dense CompiledTables from the overlay dict, or None when

@@ -291,6 +291,32 @@ def clean_tables_fast(
     return compile_tables_from_content(content, rule_width=width)
 
 
+def gate_tripped_tables(
+    rng: np.random.Generator,
+    n_entries: int = 48,
+    ifindexes: Tuple[int, ...] = (2, 3),
+    width: int = 4,
+) -> CompiledTables:
+    """Tables whose joined-targets layout trips the duplication gate
+    (jaxpath.JOINED_DUP_LIMIT / the 4096-row floor), so the device state
+    keeps the INACTIVE ``(1, 1)`` joined placeholder on the trie path.
+
+    Mid-stride prefixes (/17 under distinct /16 bases) leaf-push into
+    2^(24-17) = 128 slots each, so ~40 entries already duplicate to
+    >4096 joined positions — the exact layout regime of the PR-4
+    placeholder bucket-padding bug, and the substrate of the state
+    checker's injected-defect acceptance gate."""
+    content: Dict[LpmKey, np.ndarray] = {}
+    for i in range(n_entries):
+        mask = 17 if i % 4 != 3 else 24  # mostly /17, some /24 siblings
+        data = bytes([10, i % 256, (i // 256) % 2 * 128, 0]) + bytes(12)
+        rows = np.zeros((width, 7), np.int32)
+        rows[1] = [1, IPPROTO_TCP, 70 + (i % 60000), 0, 0, 0, 1 + i % 2]
+        ifx = int(ifindexes[i % len(ifindexes)])
+        content[LpmKey(mask + 32, ifx, data)] = rows
+    return compile_tables_from_content(content, rule_width=width)
+
+
 def random_rules_bulk(
     rng: np.random.Generator, n: int, width: int
 ) -> np.ndarray:

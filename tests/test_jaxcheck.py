@@ -112,3 +112,28 @@ def test_full_registry_audit_clean():
     assert s["error"] == 0 and s["warning"] == 0, [
         f.to_dict() for f in jaxcheck.all_findings(reports)
     ]
+
+
+def test_transfer_lint_clean_entrypoint():
+    """A registered entrypoint with committed device operands runs clean
+    under jax.transfer_guard('disallow')."""
+    ep = _by_name()["classify/xla-dense"]
+    findings = jaxcheck._transfer_lint(ep, ladder=(128,))
+    assert findings == [], [f.to_dict() for f in findings]
+
+
+def test_transfer_lint_catches_host_operand():
+    """The deliberately defective entrypoint (host-resident numpy
+    operand) must produce an error-severity implicit-transfer finding —
+    the injected acceptance of the transfer lint."""
+    ep = jaxcheck.transfer_defect_entrypoint()
+    findings = jaxcheck._transfer_lint(ep, ladder=(128,))
+    assert findings, "implicit transfer not caught"
+    assert all(f.check == "implicit-transfer" for f in findings)
+    assert all(f.severity == "error" for f in findings)
+    # and through the audit_all plumbing the strict audit fails
+    reports = jaxcheck.audit_all(
+        names=["defect/implicit-transfer"], ladder=(128,),
+        include_transfer_defect=True,
+    )
+    assert jaxcheck.summarize(reports)["error"] >= 1

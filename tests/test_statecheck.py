@@ -1,0 +1,369 @@
+"""Patch-path model checker (infw.analysis.statecheck / shrink).
+
+Covers: seeded op-sequence equivalence (incrementally-patched device
+state bit-identical to a cold rebuild + classify-equivalent to the CPU
+oracle) across the dense/trie/overlay/wide/fused configurations and the
+mesh-replicated broadcast patch path; the device-table invariant
+contracts (standalone and as the runtime INFW_CHECK_INVARIANTS hook);
+shrinker determinism (same failing case -> same minimal repro); and the
+injected-defect acceptance — re-introducing the PR-4 joined-placeholder
+bucket-padding bug must be caught with a <= 3-op shrunk reproducer.
+"""
+import numpy as np
+import pytest
+
+from infw import testing
+from infw.analysis import statecheck
+from infw.analysis.shrink import shrink_case
+from infw.compiler import IncrementalTables, LpmKey
+from infw.constants import IPPROTO_TCP
+from infw.kernels import jaxpath
+
+
+@pytest.fixture
+def inject_joined_pad_bug():
+    jaxpath._INJECT_JOINED_PAD_BUG = True
+    try:
+        yield
+    finally:
+        jaxpath._INJECT_JOINED_PAD_BUG = False
+
+
+# --- operation model --------------------------------------------------------
+
+
+def test_op_generation_deterministic():
+    """Same seed -> byte-identical base content and op sequence (the
+    precondition for reproducible failures and shrink determinism)."""
+    base1, ops1 = statecheck.build_case("trie", seed=7, n_ops=12)
+    base2, ops2 = statecheck.build_case("trie", seed=7, n_ops=12)
+    assert list(base1) == list(base2)
+    for k in base1:
+        np.testing.assert_array_equal(base1[k], base2[k])
+    assert [op.code() for op in ops1] == [op.code() for op in ops2]
+    # a different seed gives a different sequence
+    _, ops3 = statecheck.build_case("trie", seed=8, n_ops=12)
+    assert [op.code() for op in ops1] != [op.code() for op in ops3]
+
+
+def test_op_alphabet_reachable():
+    """The generator emits every kind of the edit alphabet over a
+    moderate horizon."""
+    _, ops = statecheck.build_case("overlay", seed=3, n_ops=60)
+    kinds = {op.kind for op in ops}
+    assert kinds == set(statecheck.EDIT_KINDS)
+
+
+def test_editop_code_round_trips():
+    _, ops = statecheck.build_case("trie", seed=5, n_ops=8)
+    env = {"statecheck": statecheck, "LpmKey": LpmKey, "np": np}
+    for op in ops:
+        clone = eval(op.code(), env)
+        assert clone.kind == op.kind
+        if op.key is not None:
+            assert clone.key == op.key
+        if op.rules is not None:
+            np.testing.assert_array_equal(clone.rules, op.rules)
+
+
+# --- seeded op-sequence equivalence ----------------------------------------
+
+
+@pytest.mark.parametrize("config,n_ops", [
+    ("dense", 4), ("trie", 3), ("overlay", 5), ("wide", 4),
+    ("nojoined", 4),
+])
+def test_equivalence_clean_tree(config, n_ops):
+    rep = statecheck.run_config(
+        config, seed=4, n_ops=n_ops, shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+def test_equivalence_fused_walk():
+    """The fused deep-walk config: rules-only edits patch the resident
+    joined byte planes; structural edits rebuild in the background —
+    both must stay bit-identical to a cold walk build and oracle-exact
+    through the depth-steered packed classify."""
+    rep = statecheck.run_config(
+        "fused", seed=2, n_ops=2, shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+def test_equivalence_mesh_replicated():
+    """The mesh-replicated broadcast patch path (NamedSharding-as-device
+    diff-scatter) through the same engine."""
+    if len(__import__("jax").devices()) < 2:
+        pytest.skip("needs a multi-device pool")
+    rep = statecheck.run_config(
+        "trie", seed=2, n_ops=2, backend="mesh", shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+# --- invariant contracts ----------------------------------------------------
+
+
+def _clean_dev():
+    rng = np.random.default_rng(31)
+    tables = testing.random_tables(rng, n_entries=40, width=4)
+    return jaxpath.device_tables(tables, pad=True)
+
+
+def test_check_device_tables_clean():
+    assert statecheck.check_device_tables(_clean_dev()) == []
+
+
+def test_check_device_tables_flags_bucket_padded_placeholder():
+    """The PR-4 bug class as a static contract violation: an inactive
+    placeholder bucket-padded to (8, 1) reads as an ACTIVE joined plane
+    of width 1."""
+    import jax.numpy as jnp
+
+    dev = _clean_dev()._replace(joined=jnp.zeros((8, 1), jnp.uint16))
+    viols = statecheck.check_device_tables(dev)
+    assert any("joined" in v and "width 1" in v for v in viols), viols
+
+
+def test_check_device_tables_flags_fill_and_mask_violations():
+    import jax.numpy as jnp
+
+    dev = _clean_dev()
+    # a tombstone row carrying key bytes violates the fill contract
+    kw = np.asarray(dev.key_words).copy()
+    ml = np.asarray(dev.mask_len)
+    dead = int(np.nonzero(ml < 0)[0][0])
+    kw[dead, 1] = 7
+    viols = statecheck.check_device_tables(
+        dev._replace(key_words=jnp.asarray(kw))
+    )
+    assert any("fill" in v for v in viols), viols
+    # a mask_words row diverging from the mask_len reconstruction
+    mw = np.asarray(dev.mask_words).copy()
+    live = int(np.nonzero(ml >= 0)[0][0])
+    mw[live, 2] ^= 1
+    viols = statecheck.check_device_tables(
+        dev._replace(mask_words=jnp.asarray(mw))
+    )
+    assert any("mask_words" in v for v in viols), viols
+
+
+def test_assert_patched_tables_is_permanent_and_cheap():
+    """The always-on shape contract: clean padded tables pass; the
+    bucket-padded placeholder raises at the mutation site."""
+    import jax.numpy as jnp
+
+    dev = _clean_dev()
+    jaxpath.assert_patched_tables(dev)  # no raise
+    bad = dev._replace(joined=jnp.zeros((8, 1), jnp.uint16))
+    with pytest.raises(jaxpath.DeviceTableInvariantError):
+        jaxpath.assert_patched_tables(bad)
+
+
+def test_placeholder_survives_structural_patch():
+    """Satellite regression (the PR-4 fix as a guarded contract): on a
+    gate-tripped table the inactive (1, 1) placeholder must survive a
+    structural diff-based patch exactly, and the patched state must stay
+    bit-identical to a fresh upload."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    tables = testing.gate_tripped_tables(rng)
+    it = IncrementalTables.from_content(dict(tables.content), rule_width=4)
+    prev = it.snapshot()
+    it.clear_dirty()
+    dev = jaxpath.device_tables(prev, pad=True)
+    assert tuple(dev.joined.shape) == (1, 1)
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, IPPROTO_TCP, 80, 0, 0, 0, 2]
+    it.apply({LpmKey(24 + 32, 2, bytes([11, 0, 0, 0]) + bytes(12)): rows})
+    new = it.snapshot()
+    patched = jaxpath.patch_device_tables(dev, prev, new, hint=it.peek_dirty())
+    assert patched is not None
+    assert tuple(patched[0].joined.shape) == (1, 1)
+    fresh = jaxpath.device_tables(new, pad=True)
+    for a, b in zip(jax.tree.leaves(patched[0]), jax.tree.leaves(fresh)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_injected_bug_raises_at_mutation_site(inject_joined_pad_bug):
+    """With the PR-4 defect injected, the permanent contract refuses the
+    patch result before it can install."""
+    rng = np.random.default_rng(3)
+    tables = testing.gate_tripped_tables(rng)
+    it = IncrementalTables.from_content(dict(tables.content), rule_width=4)
+    prev = it.snapshot()
+    it.clear_dirty()
+    dev = jaxpath.device_tables(prev, pad=True)
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, IPPROTO_TCP, 80, 0, 0, 0, 2]
+    it.apply({LpmKey(24 + 32, 2, bytes([11, 0, 0, 0]) + bytes(12)): rows})
+    with pytest.raises(jaxpath.DeviceTableInvariantError):
+        jaxpath.patch_device_tables(
+            dev, prev, it.snapshot(), hint=it.peek_dirty()
+        )
+
+
+def test_runtime_invariant_hook_catches_bypassed_corruption(
+    inject_joined_pad_bug, monkeypatch
+):
+    """Layered defense: with the mutation-site assert bypassed, the
+    opt-in INFW_CHECK_INVARIANTS hook (the deep statecheck pass) still
+    refuses to install the corrupted generation."""
+    from infw.backend.tpu import TpuClassifier
+
+    monkeypatch.setattr(jaxpath, "assert_patched_tables", lambda dev: None)
+    rng = np.random.default_rng(3)
+    tables = testing.gate_tripped_tables(rng)
+    it = IncrementalTables.from_content(dict(tables.content), rule_width=4)
+    clf = TpuClassifier(
+        interpret=True, force_path="trie", check_invariants=True
+    )
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, IPPROTO_TCP, 80, 0, 0, 0, 2]
+    it.apply({LpmKey(24 + 32, 2, bytes([11, 0, 0, 0]) + bytes(12)): rows})
+    with pytest.raises(statecheck.InvariantViolation):
+        clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+
+
+# --- injected-defect acceptance + shrinker ---------------------------------
+
+
+def test_injected_defect_caught_and_shrunk(inject_joined_pad_bug):
+    """The acceptance gate: the checker catches the re-introduced PR-4
+    bug and shrinks the case to <= 3 ops; the shrinker is deterministic
+    (same failing case -> identical minimal repro)."""
+    base, ops = statecheck.build_case("nojoined", seed=0, n_ops=6)
+    failure = statecheck.run_ops(base, ops, "nojoined", seed=0)
+    assert failure is not None
+    assert "joined" in failure.message
+    r1 = shrink_case(base, list(ops), "nojoined", failure,
+                     witness_b=192, seed=0, max_runs=32)
+    assert len(r1.ops) <= 3
+    assert r1.failure is not None
+    # determinism: an identical second shrink produces the same repro
+    r2 = shrink_case(base, list(ops), "nojoined", failure,
+                     witness_b=192, seed=0, max_runs=32)
+    assert r1.code() == r2.code()
+    # the repro is paste-able and still fails standalone
+    env = {}
+    exec_lines = r1.code().replace("assert failure is None, failure", "")
+    exec(exec_lines, env)
+    assert env["failure"] is not None
+
+
+# --- warm-scatter coverage (first-edit recompile lint) ----------------------
+
+
+def _scatter_cache_size():
+    return jaxpath._scatter_rows_jit()._cache_size()
+
+
+def _one_key_edit(it, content):
+    k = sorted(content, key=lambda k: (k.ingress_ifindex, k.ip_data))[0]
+    rows = np.asarray(it.content[k]).copy()
+    rows[1, 2] = int(rows[1, 2]) % 60000 + 7
+    it.apply({k: rows})
+    return {k: rows}
+
+
+@pytest.mark.parametrize("variant", ["u16", "wide", "nojoined"])
+def test_patch_ladder_no_hidden_first_edit_compile(variant):
+    """warm_patch_scatters must cover every patchable array layout —
+    u16-joined, the wide-ruleId u32 path, the gate-tripped placeholder
+    regime — so the first incremental edit after a load compiles
+    NOTHING (the _cache_size recompile lint, mirroring the PR-4
+    wire-latency fix)."""
+    from infw.backend.tpu import TpuClassifier
+
+    rng = np.random.default_rng(41)
+    if variant == "nojoined":
+        content = dict(testing.gate_tripped_tables(rng).content)
+    else:
+        content = {}
+        for i in range(40):
+            rows = np.zeros((4, 7), np.int32)
+            rid = 70000 if (variant == "wide" and i == 0) else 1
+            rows[1] = [rid, IPPROTO_TCP, 80 + i, 0, 0, 0, 1]
+            content[LpmKey(24 + 32, 2, bytes([10, 1, i, 0]) + bytes(12))] = rows
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    size0 = _scatter_cache_size()
+    _one_key_edit(it, content)
+    clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    assert clf._last_load[0] == "patch"
+    # structural one-key add: trie-level scatters must be warmed too
+    rows = np.zeros((4, 7), np.int32)
+    rows[1] = [1, IPPROTO_TCP, 99, 0, 0, 0, 2]
+    it.apply({LpmKey(24 + 32, 2, bytes([12, 0, 0, 0]) + bytes(12)): rows})
+    clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+    it.clear_dirty()
+    grew = _scatter_cache_size() - size0
+    assert grew == 0, (
+        f"{grew} scatter executable(s) compiled on the first edits — "
+        "warm_patch_scatters missed a patchable layout"
+    )
+
+
+def test_fused_walk_patch_uses_warmed_scatter():
+    """patch_walk_joined must route through the shared capped scatter:
+    after warm_walk_patch_scatters, a rules-only joined patch of the
+    resident walk compiles nothing."""
+    from infw.kernels import pallas_walk
+
+    rng = np.random.default_rng(47)
+    tables = testing.random_tables_fast(
+        rng, n_entries=512, width=4, v6_fraction=0.9
+    )
+    built = pallas_walk.build_walk_tables_meta(tables)
+    if built is None:
+        pytest.skip("fused walk declined the fixture")
+    wt, meta = built
+    pallas_walk.warm_walk_patch_scatters(wt)
+    size0 = _scatter_cache_size()
+    t_vals = meta.get("t_vals")
+    assert t_vals is not None
+    live = np.nonzero(t_vals > 0)[0]
+    dirty = np.asarray([int(t_vals[live[0]] - 1)], np.int64)
+    patched = pallas_walk.patch_walk_joined(wt, meta, tables, dirty)
+    assert patched is not None and patched is not wt
+    assert _scatter_cache_size() == size0, (
+        "the fused-walk joined patch compiled a fresh scatter executable"
+    )
+
+
+# --- 1M-scale tier ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_statecheck_1m_tier():
+    """One seeded edit burst at the 1M tier: the equivalence engine
+    (cold-rebuild bit-identity + HashLpmOracle witness classify) holds
+    at production scale."""
+    rng = np.random.default_rng(13)
+    tables = testing.random_tables_fast(rng, n_entries=1_000_000, width=4)
+    cfg = statecheck.StateConfig(
+        "trie-1m", n_entries=1_000_000, width=4, witness_b=2048
+    )
+    keys = list(tables.content)
+    edit_rows = np.zeros((4, 7), np.int32)
+    edit_rows[1] = [1, IPPROTO_TCP, 4242, 0, 0, 0, 2]
+    add_rows = np.zeros((4, 7), np.int32)
+    add_rows[1] = [1, IPPROTO_TCP, 53, 0, 0, 0, 1]
+    ops = [
+        statecheck.EditOp(kind="rules_edit", key=keys[17], rules=edit_rows),
+        statecheck.EditOp(
+            kind="key_add",
+            key=LpmKey(24 + 32, 2, bytes([10, 200, 1, 0]) + bytes(12)),
+            rules=add_rows,
+        ),
+        statecheck.EditOp(kind="key_delete", key=keys[99]),
+    ]
+    failure = statecheck.run_ops(tables.content, ops, cfg, seed=13)
+    assert failure is None, failure

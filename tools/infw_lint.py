@@ -11,9 +11,23 @@ Subcommands:
           verifies the analyzer reports EXACTLY the injected findings
           with oracle-confirmed witnesses (the repo gate).
   jax     Hot-path audit (infw.analysis.jaxcheck) of every registered
-          jitted entrypoint: x64 leaks, host callbacks, recompile lint
-          on the bench shape ladder, Pallas VMEM budget.  Run under
+          jitted entrypoint: x64 leaks, host callbacks, implicit
+          host<->device transfers (jax.transfer_guard lint), recompile
+          lint on the bench shape ladder, Pallas VMEM budget.  Run under
           JAX_PLATFORMS=cpu — no TPU needed.
+          ``--inject-transfer-defect`` appends a deliberately defective
+          host-operand entrypoint; the audit must then exit nonzero (the
+          transfer-lint acceptance, wired into ``make state-check``).
+  state   Patch-path model checker (infw.analysis.statecheck): seeded
+          op sequences over the device-table edit state machine; after
+          every op the incrementally-patched device state must be
+          bit-identical to a cold rebuild and classify-equivalent to
+          the CPU oracle.  On failure the case shrinks to a minimal
+          paste-able reproducer (infw.analysis.shrink).
+          ``--inject-defect`` re-introduces the PR-4 joined-placeholder
+          bucket-padding bug (jaxpath._INJECT_JOINED_PAD_BUG) and
+          verifies the checker catches it with a <= 3-op shrunk repro —
+          exit 0 means CAUGHT.
 
 Exit status: 1 when any error-severity finding exists (or, with
 ``--strict``, any warning too); 0 otherwise.  ``--json`` prints one
@@ -246,6 +260,7 @@ def cmd_jax(args) -> int:
         ladder=ladder,
         vmem_budget=args.vmem_budget,
         execute=not args.no_execute,
+        include_transfer_defect=args.inject_transfer_defect,
     )
     summary = jaxcheck.summarize(reports)
     if args.json:
@@ -270,6 +285,123 @@ def cmd_jax(args) -> int:
     if summary["error"] or (args.strict and summary["warning"]):
         return 1
     return 0
+
+
+# --- state subcommand -------------------------------------------------------
+
+
+#: default configurations of the state-check gate: the trie patch path,
+#: the overlay routing, the wide-ruleId u32 path and the joined-gate-
+#: tripped placeholder regime.  dense/fused/mesh run in the pytest suite
+#: (tests/test_statecheck.py) — selectable here via --configs.
+DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined")
+
+
+def _run_inject_defect(args, as_json: bool) -> int:
+    """The injected-defect acceptance: re-introduce the PR-4 joined-
+    placeholder bucket-padding bug and prove the checker catches it with
+    a shrunk reproducer of <= 3 ops.  Exit 0 = caught."""
+    from infw.analysis import statecheck
+    from infw.kernels import jaxpath
+
+    if args.configs:
+        print("note: --inject-defect always runs the 'nojoined' config "
+              "(the only one in the placeholder layout regime); "
+              "--configs ignored", file=sys.stderr)
+    jaxpath._INJECT_JOINED_PAD_BUG = True
+    try:
+        report = statecheck.run_config(
+            "nojoined", seed=args.seed, n_ops=args.ops,
+            backend=args.backend, witness_b=args.witness,
+            max_shrink_runs=32,
+        )
+    finally:
+        jaxpath._INJECT_JOINED_PAD_BUG = False
+    problems = []
+    if report["ok"]:
+        problems.append(
+            "injected joined-placeholder defect NOT caught by the "
+            "equivalence engine"
+        )
+    else:
+        shrunk = report.get("shrunk") or {}
+        n = shrunk.get("ops", 10**9)
+        if n > 3:
+            problems.append(
+                f"shrunk reproducer has {n} ops (acceptance bound: 3)"
+            )
+    report["problems"] = problems
+    report["caught"] = not problems
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        if not problems:
+            f = report["failure"]
+            shrunk = report.get("shrunk") or {}
+            print(
+                "inject-defect: CAUGHT "
+                f"[{f['phase']}] {f['message']} — shrunk to "
+                f"{shrunk.get('ops')} op(s), {shrunk.get('entries')} "
+                f"entries, witness {shrunk.get('witness_b')}"
+            )
+            if shrunk.get("repro"):
+                print(shrunk["repro"])
+        for p in problems:
+            print(f"INJECT-DEFECT FAIL: {p}")
+    return 0 if not problems else 1
+
+
+def cmd_state(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.inject_defect:
+        return _run_inject_defect(args, args.json)
+    from infw.analysis import statecheck
+
+    if args.configs:
+        names = [x for x in args.configs.split(",") if x]
+    else:
+        names = list(DEFAULT_STATE_CONFIGS)
+    unknown = [n for n in names if n not in statecheck.CONFIGS]
+    if unknown:
+        print(f"unknown state config(s): {', '.join(unknown)} "
+              f"(have: {', '.join(statecheck.CONFIGS)})", file=sys.stderr)
+        return 2
+    reports = []
+    n_fail = 0
+    for name in names:
+        rep = statecheck.run_config(
+            name, seed=args.seed, n_ops=args.ops, backend=args.backend,
+            witness_b=args.witness,
+        )
+        reports.append(rep)
+        if not rep["ok"]:
+            n_fail += 1
+        if not args.json:
+            status = "OK  " if rep["ok"] else "FAIL"
+            print(f"{status} {name:10s} seed={rep['seed']} "
+                  f"ops={rep['ops']} entries={rep['entries']} "
+                  f"backend={rep['backend']}")
+            if not rep["ok"]:
+                f = rep["failure"]
+                print(f"     [{f['phase']}] step {f['step']}: {f['message']}")
+                if f.get("detail"):
+                    for line in f["detail"].splitlines():
+                        print(f"       | {line}")
+                shrunk = rep.get("shrunk")
+                if shrunk:
+                    print(f"     shrunk to {shrunk['ops']} op(s), "
+                          f"{shrunk['entries']} entries, witness "
+                          f"{shrunk['witness_b']}:")
+                    for line in shrunk["repro"].splitlines():
+                        print(f"       {line}")
+    if args.json:
+        print(json.dumps(
+            {"reports": reports, "failures": n_fail, "ok": n_fail == 0},
+            indent=2,
+        ))
+    else:
+        print(f"state: {len(reports)} config(s), {n_fail} failure(s)")
+    return 1 if n_fail else 0
 
 
 # --- main -------------------------------------------------------------------
@@ -308,7 +440,35 @@ def main(argv=None) -> int:
     p_jax.add_argument("--vmem-budget", type=int, metavar="BYTES")
     p_jax.add_argument("--no-execute", action="store_true",
                        help="trace-only (skip the run-twice recompile lint)")
+    p_jax.add_argument("--inject-transfer-defect", action="store_true",
+                       help="append a deliberately defective host-operand "
+                            "entrypoint (the audit must then fail)")
     p_jax.set_defaults(fn=cmd_jax)
+
+    p_state = sub.add_parser("state", help="patch-path model checker")
+    p_state.add_argument("--json", action="store_true")
+    p_state.add_argument("--strict", action="store_true",
+                         help="accepted for UX parity with rules/jax "
+                              "(every state failure is already an error)")
+    p_state.add_argument("--seed", type=int, default=0,
+                         help="case seed (default 0)")
+    p_state.add_argument("--ops", type=int, default=8,
+                         help="ops per sequence (default 8)")
+    p_state.add_argument("--configs", metavar="NAMES",
+                         help="comma-separated config subset "
+                              f"(default {','.join(DEFAULT_STATE_CONFIGS)})")
+    p_state.add_argument("--backend", choices=("tpu", "mesh"),
+                         default="tpu",
+                         help="classifier backend (mesh = replicated "
+                              "MeshTpuClassifier; needs a multi-device "
+                              "pool)")
+    p_state.add_argument("--witness", type=int, metavar="B",
+                         help="witness batch size override")
+    p_state.add_argument("--inject-defect", action="store_true",
+                         help="re-introduce the PR-4 joined-placeholder "
+                              "bucket-padding bug and verify the checker "
+                              "catches it (exit 0 = caught)")
+    p_state.set_defaults(fn=cmd_state)
 
     args = ap.parse_args(argv)
     return args.fn(args)
