@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench bench-checked native entry-check \
+.PHONY: test test-fast bench bench-checked build-bench native entry-check \
 	dryrun-multichip mesh-check spill-read wire-check lint static-check \
 	state-check clean
 
@@ -53,16 +53,22 @@ lint:
 # the incrementally-patched device state must be bit-identical to a
 # cold rebuild and classify-equivalent to the CPU oracle — plus two
 # injected-defect acceptances:
-#   1. --inject-defect re-introduces the PR-4 joined-placeholder
-#      bucket-padding bug; the checker must catch it with a shrunk
-#      reproducer of <= 3 ops (exit 0 = caught);
-#   2. the strict jax audit must FAIL on a deliberately injected
+#   1. --inject-defect (joined-pad) re-introduces the PR-4 joined-
+#      placeholder bucket-padding bug; the checker must catch it with a
+#      shrunk reproducer of <= 3 ops (exit 0 = caught);
+#   2. --inject-defect cskip zeroes the compressed layout's skip-node
+#      chain-bits words (jaxpath._INJECT_CSKIP_BUG); resident and cold
+#      rebuild share the defect, so the catch must come from oracle
+#      divergence — proving the classify-equivalence half covers the
+#      skip-node path;
+#   3. the strict jax audit must FAIL on a deliberately injected
 #      implicit host->device transfer (and pass without it — the plain
 #      strict audit runs in entry-check/static-check).
 # Must be green before any bench record is published (benchruns/README).
 state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --strict
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cskip
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -94,10 +100,21 @@ static-check: lint
 	$(MAKE) state-check
 	@echo "static-check OK"
 
+# The 1M cold-build microbenchmark (bench.bench_build): vectorized
+# columnar compiler vs the retired per-key reference on the SAME host
+# and content, runs INTERLEAVED so both see the same ambient load,
+# output bit-identity checked, with a regression threshold on the
+# measured speedup (INFW_BUILD_SPEEDUP_MIN, default 1.3x — observed
+# 1.7-2.3x interleaved on the 2-core CI host, up to ~5x under memory
+# pressure, while a reversion to per-key work lands at ~1x; the
+# recorded-baseline ratio is in the emitted vs_baseline field).
+build-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --build-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check bench
+bench-checked: static-check build-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -28,7 +28,15 @@ same series as previous rounds):
      (overlay), structural DELETE, and the overlay-overflow merge spike.
   7. wire-path p50 verdict latency (pack -> H2D -> classify -> 2B/packet
      readback), batch sweep 32..4096 incl. pinned-device-input mode.
-  8. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
+  8. build-path lines (ISSUE 6): 1M cold-build A/B — vectorized columnar
+     compiler vs the retired per-key reference on the BENCH_r05
+     adversarial substrate, bit-identity checked (also standalone as
+     `bench.py --build-bench`, `make build-bench`, with a regression
+     threshold) — and the 10M tier: columnar cold build, full reload,
+     compressed-poptrie (ctrie) classify throughput, 1-key joined
+     diff-scatter patch, 1-key structural overlay add (200K smoke
+     off-TPU).
+  9. config 2 headline: 1000 CIDRs x 100 rules, fused int8-MXU Pallas
      dense kernel.
 
 After all tiers, every recorded metric line is RE-EMITTED in one final
@@ -476,6 +484,312 @@ def deep_class_lines(tables, batch, per_group, on_tpu, label):
         f"XLA walk {thr_xla/1e6:.1f} M/s on the same packets)",
         thr_fused, "packets/s",
     )
+
+
+# --- cold-build microbench (make build-bench) ------------------------------
+
+#: BENCH_r05 cold table build @1M entries (the retired per-key
+#: compiler on the recorded TPU host) — the ISSUE-6 10x target's anchor
+BUILD_BASELINE_1M_S = 44.0
+
+
+def bench_build(rng, n_entries=1_000_000, legacy=True):
+    """Cold-build A/B at the 1M tier on the BENCH_r05 substrate (the
+    adversarial overlap distribution whose per-key compile was the
+    recorded 44s): the vectorized compiler (compile_tables_from_content,
+    now routed through the columnar sorted-prefix batch build) against
+    the retired per-key reference (from_content_legacy), SAME content
+    dict, with a tensor bit-identity cross-check tying the speedup to a
+    correctness proof.  Host-side only — no device, no tunnel jitter;
+    the two compilers run INTERLEAVED (C L C L C L), ratio min-vs-min:
+    this CI host's throughput swings ~2-3x with ambient load on a
+    scale of minutes, so back-to-back blocks (3xC then 1xL) hand
+    whichever path runs second a different machine — interleaving is
+    what makes the same-host ratio a property of the code.  The
+    clean-corpus pure-columns build (no dict input at all) is the 10M
+    tier's line (bench_scale_10m).
+
+    Returns {"columnar_s", "legacy_s"|None, "speedup"|None}."""
+    from infw.compiler import (
+        IncrementalTables,
+        compile_tables_from_content,
+    )
+
+    tier = (f"{n_entries/1e6:.0f}M" if n_entries >= 1_000_000
+            else f"{n_entries//1000}K")
+    t0 = time.perf_counter()
+    adv = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=4, group_size=16,
+        ifindexes=(2, 3, 4),
+    )
+    content = dict(adv.content)  # the dict INPUT of both paths, untimed
+    log(f"build@{tier}: adversarial corpus generated "
+        f"{time.perf_counter()-t0:.1f}s ({len(content)} keys)")
+    best = float("inf")
+    t_leg = float("inf")
+    ref = None
+    rounds = 3 if legacy else 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        tables = compile_tables_from_content(content, rule_width=4)
+        best = min(best, time.perf_counter() - t0)
+        if rounds:
+            rounds -= 1
+            t0 = time.perf_counter()
+            ref = IncrementalTables.from_content_legacy(
+                content, rule_width=4
+            ).snapshot(consume=True)
+            t_leg = min(t_leg, time.perf_counter() - t0)
+    emit(
+        f"cold table build @{tier} entries (vectorized columnar "
+        f"compiler, adversarial overlap mix, min of 3 interleaved; "
+        f"BENCH_r05 per-key baseline {BUILD_BASELINE_1M_S:.0f}s @1M)",
+        best, "s",
+        vs_baseline=(BUILD_BASELINE_1M_S * n_entries / 1e6) / best,
+    )
+    rec = {"columnar_s": best, "legacy_s": None, "speedup": None}
+    if not legacy:
+        return rec
+    emit(
+        f"cold table build @{tier} entries (retired per-key compiler, "
+        "same host/content, min of 3 interleaved — the in-record "
+        "denominator)",
+        t_leg, "s",
+        vs_baseline=(BUILD_BASELINE_1M_S * n_entries / 1e6) / t_leg,
+    )
+    # bit-identity: the speedup is only meaningful if both paths build
+    # the SAME tables
+    mismatch = []
+    for name in ("key_words", "mask_words", "mask_len", "rules", "root_lut"):
+        if not np.array_equal(getattr(tables, name), getattr(ref, name)):
+            mismatch.append(name)
+    if len(tables.trie_levels) != len(ref.trie_levels) or any(
+        not np.array_equal(a, b)
+        for a, b in zip(tables.trie_levels, ref.trie_levels)
+    ):
+        mismatch.append("trie_levels")
+    if mismatch:
+        raise RuntimeError(
+            f"build@{tier}: columnar vs per-key output mismatch in "
+            f"{mismatch} — the speedup line would be comparing different "
+            "tables"
+        )
+    log(f"build@{tier}: columnar output bit-identical to the per-key "
+        "reference")
+    rec["legacy_s"] = t_leg
+    rec["speedup"] = t_leg / best
+    emit(
+        f"cold-build speedup @{tier} entries (columnar vs per-key, same "
+        "host, bit-identical output)",
+        rec["speedup"], "x", vs_baseline=rec["speedup"] / 10.0,
+    )
+    return rec
+
+
+def bench_scale_10m(rng, on_tpu):
+    """The 10M-entry tier (ISSUE 6): columnar cold build -> compressed
+    (ctrie) device layout -> chained classify throughput -> 1-key
+    diff-scatter rules patch -> 1-key structural overlay add, all
+    through the production TpuClassifier dispatch.  Off-TPU the tier
+    runs a 200K smoke so the pipeline stays exercised on CPU hosts.
+
+    The clean /24+/48 distribution (testing.clean_columns_fast) is the
+    tier's corpus: at 10M entries even the adversarial generator's
+    C-level dict build costs real minutes, and the build-path numbers
+    here must measure the COMPILER, not the corpus generator (see
+    benchruns/README.md for the measurement rules)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import (
+        IncrementalTables, LpmKey, compile_tables_from_content,
+    )
+
+    n = 10_000_000 if on_tpu else 200_000
+    tier = f"{n/1e6:.0f}M" if n >= 1_000_000 else f"{n//1000}K"
+    t0 = time.perf_counter()
+    cols = testing.clean_columns_fast(rng, n, width=4)
+    log(f"scale@{tier}: corpus generated {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    it = IncrementalTables.from_columns(cols, rule_width=4)
+    snap = it.snapshot()
+    t_build = time.perf_counter() - t0
+    emit(
+        f"cold table build @{tier} entries (vectorized columnar "
+        "compiler, clean /24+/48 mix)",
+        t_build, "s",
+        vs_baseline=(BUILD_BASELINE_1M_S * n / 1e6) / t_build,
+    )
+    clf = TpuClassifier(force_path="ctrie")
+    t0 = time.perf_counter()
+    clf.load_tables(snap)
+    it.clear_dirty()
+    t_load = time.perf_counter() - t0
+    assert clf.active_path == "ctrie", clf.active_path
+    cdev, d_max = clf._active[1]
+    log(f"scale@{tier}: compressed layout resident — "
+        f"{cdev.nodes.shape[0]} skip-node rows, d_max {d_max} "
+        f"(vs {len(snap.trie_levels)} per-level walk levels), "
+        f"load {t_load:.1f}s")
+    emit(
+        f"full reload @{tier} entries (columnar compile + compressed "
+        f"poptrie transform + upload; build {t_build:.1f}s + load "
+        f"{t_load:.1f}s)",
+        t_build + t_load, "s",
+    )
+
+    # classify throughput through the compressed walk (device-resident
+    # wire, chained two-point slope — the standard honesty rules)
+    n_packets = 2**19 if on_tpu else 2**13
+    batch = testing.random_batch_fast(rng, snap, n_packets=n_packets)
+    t0 = time.perf_counter()
+    oracle_h = oracle.HashLpmOracle(snap)
+    log(f"scale@{tier}: hash oracle built {time.perf_counter()-t0:.1f}s")
+    wire_np = batch.pack_wire()
+    fn = jaxpath.jitted_classify_ctrie_wire_fused(d_max)
+    res16 = jaxpath.split_wire_outputs(
+        np.asarray(fn(cdev, jnp.asarray(batch.slice(0, 2000).pack_wire()))),
+        2000,
+    )[0]
+    got = jaxpath.host_finalize_wire(res16, batch.slice(0, 2000).kind)[0]
+    ref = oracle_h.classify(batch.slice(0, 2000))
+    if not np.array_equal(got, ref.results):
+        raise RuntimeError(f"scale@{tier}: ctrie verdicts diverge from "
+                           "the oracle")
+    log(f"scale@{tier}: verdict spot-check vs oracle OK (2000 packets)")
+    wire = jnp.asarray(wire_np)
+    ip_col = wire_np.shape[1] - 1
+
+    @jax.jit
+    def loop(k, cd, w):
+        def step(i, carry):
+            w, acc = carry
+            res, _stats = jaxpath.classify_ctrie_wire(cd, w, d_max=d_max)
+            res = res.astype(jnp.uint32)
+            w = w.at[:, 1].set(w[:, 1] ^ (res & 1).astype(w.dtype))
+            pert = ((res & 0xF) ^ (i.astype(jnp.uint32) & 0xF)).astype(w.dtype)
+            w = w.at[:, ip_col].set(w[:, ip_col] ^ pert)
+            return w, acc + jnp.sum(res.astype(jnp.uint32))
+
+        return jax.lax.fori_loop(0, k, step, (w, jnp.uint32(0)))[1]
+
+    t0 = time.perf_counter()
+    int(loop(1, cdev, wire))
+    log(f"scale@{tier}: loop compile {time.perf_counter()-t0:.1f}s")
+    k1, k2 = (3, 23) if on_tpu else (1, 3)
+
+    def best_of(k, attempts=3):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            int(loop(k, cdev, wire))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    _MIN_SIGNAL_S = 0.5 if on_tpu else 0.05
+    best1 = best_of(k1)
+    while True:
+        best2 = best_of(k2)
+        if best2 - best1 >= _MIN_SIGNAL_S or k2 >= 6000:
+            break
+        k2 *= 4
+    dt_s = (best2 - best1) / (k2 - k1)
+    if dt_s <= 0:
+        raise RuntimeError(f"scale@{tier}: non-monotonic timing")
+    emit(
+        f"packet classifications/sec/chip @{tier} entries "
+        f"(path/level-compressed poptrie walk, d_max {d_max}, XLA)",
+        n_packets / dt_s, "packets/s",
+    )
+
+    # 1-key RULES edit -> the per-tidx joined diff-scatter patch.  The
+    # timed region is the steady-state edit pipeline (apply + snapshot +
+    # device patch) — identical to the 100K/1M tiers so the lines stay
+    # comparable; note the non-consume snapshot's defensive copies are
+    # O(table size) and dominate at this tier (the device scatter is
+    # kilobytes).  The first edit's one-time lazy ident-map
+    # materialization (columns -> {LpmKey: rules} dicts) is timed
+    # separately, outside the patch latency.
+    t0 = time.perf_counter()
+    _ = it._ident_to_t  # force materialization once, outside the timing
+    log(f"scale@{tier}: lazy content materialization "
+        f"{time.perf_counter()-t0:.1f}s (one-time, first edit only)")
+    lats = []
+    for i in range(5):
+        ki = LpmKey(int(cols.prefix_len[i]), int(cols.ifindex[i]),
+                    cols.ip[i].tobytes())
+        rows = np.asarray(it.content[ki]).copy()
+        rows[1, 6] = 1 if rows[1, 6] == 2 else 2
+        t0 = time.perf_counter()
+        it.apply({ki: rows})
+        clf.load_tables(it.snapshot(), dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+        lats.append(time.perf_counter() - t0)
+        mode, n_rows = clf._last_load
+        log(f"scale@{tier} edit {i}: {lats[-1]*1e3:.0f} ms mode={mode} "
+            f"rows={n_rows}")
+        assert mode == "patch", "ctrie 1-key rules edit must diff-scatter"
+    emit(
+        f"1-key rule update to device @{tier} entries, best of "
+        f"{len(lats)} (compressed layout, per-tidx joined diff-scatter; "
+        f"full reload {t_build + t_load:.1f}s)",
+        min(lats) * 1e3, "ms",
+        vs_baseline=(t_build + t_load) / min(lats),
+    )
+
+    # 1-key structural CIDR add via the overlay side-table: the merged
+    # node array is untouched (the whole point — a structural re-place
+    # at this tier costs a full build)
+    snap2 = it.snapshot()
+    it.clear_dirty()
+    overlay = {}
+    add_lats = []
+    for i in range(5):
+        new_key = LpmKey(88, 2, bytes([0x20, 1, 0xD, 0xB8, 0, i]) + bytes(10))
+        rows = np.zeros((4, 7), np.int32)
+        rows[1] = [1, 6, 443, 0, 0, 0, 1]
+        t0 = time.perf_counter()
+        overlay[new_key] = rows
+        ov_tables = compile_tables_from_content(dict(overlay), rule_width=4)
+        clf.load_tables(snap2, dirty_hint=it.peek_dirty(), overlay=ov_tables)
+        it.clear_dirty()
+        add_lats.append(time.perf_counter() - t0)
+        mode, _ = clf._last_load
+        log(f"scale@{tier} cidr-add {i}: {add_lats[-1]*1e3:.0f} ms "
+            f"mode={mode}")
+        assert mode == "patch", "ctrie CIDR add must not re-upload"
+    emit(
+        f"1-key CIDR add to device @{tier} entries, best of "
+        f"{len(add_lats)} (structural overlay, compressed main table "
+        f"untouched; full reload {t_build + t_load:.1f}s)",
+        min(add_lats) * 1e3, "ms",
+        vs_baseline=(t_build + t_load) / min(add_lats),
+    )
+    clf.close()
+
+
+def build_bench_main() -> int:
+    """``make build-bench``: the 1M cold-build microbenchmark with a
+    regression threshold — exit 1 when the columnar compiler's measured
+    speedup over the in-record per-key denominator falls below
+    INFW_BUILD_SPEEDUP_MIN (the acceptance floor is host-normalized: a
+    gVisor CI host pays page-fault costs the TPU host does not, so the
+    gate compares against the SAME-host interleaved denominator, not
+    the recorded 44s anchor.  Measured on the 2-core CI host: ~2.1x
+    unloaded, up to ~5x under ambient memory pressure — the per-key
+    path's random small accesses degrade much faster than the columnar
+    streaming passes — so the floor is 1.3x: below the observed noise
+    band (1.66x worst case), while a reversion to per-key work lands
+    at ~1x)."""
+    threshold = float(os.environ.get("INFW_BUILD_SPEEDUP_MIN", "1.3"))
+    n = int(os.environ.get("INFW_BUILD_BENCH_ENTRIES", "1000000"))
+    rng = np.random.default_rng(2024)
+    rec = bench_build(rng, n_entries=n)
+    emit_compact_record()
+    if rec["speedup"] is None or rec["speedup"] < threshold:
+        log(f"build-bench FAIL: speedup {rec['speedup']} below the "
+            f"{threshold}x regression threshold")
+        return 1
+    log(f"build-bench OK: {rec['speedup']:.1f}x (threshold {threshold}x)")
+    return 0
 
 
 # --- config 3: 100K-CIDR trie --------------------------------------------
@@ -1472,6 +1786,19 @@ def main():
     except Exception as e:
         log(f"adv1m FAILED: {e}")
     try:
+        # ISSUE-6 build-path lines: columnar-vs-per-key cold build A/B
+        # @1M with the in-record denominator and bit-identity check
+        bench_build(rng)
+    except Exception as e:
+        log(f"build bench FAILED: {e}")
+    try:
+        # ISSUE-6 10M tier: cold build, full reload, compressed-walk
+        # classify throughput, 1-key joined diff-scatter patch, 1-key
+        # structural overlay add (200K smoke off-TPU)
+        bench_scale_10m(rng, on_tpu)
+    except Exception as e:
+        log(f"scale 10M FAILED: {e}")
+    try:
         bench_8iface(rng, on_tpu)
     except Exception as e:
         log(f"8iface FAILED: {e}")
@@ -1536,4 +1863,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--build-bench" in sys.argv:
+        sys.exit(build_bench_main())
     sys.exit(main())

@@ -185,6 +185,16 @@ CONFIGS: Dict[str, StateConfig] = {
         # (1, 1) joined placeholder — the PR-4 bug's layout regime and
         # the injected-defect acceptance substrate
         StateConfig("nojoined", distribution="gate-tripped", width=4),
+        # path/level-compressed poptrie (jaxpath.build_cpoptrie) through
+        # the production ctrie dispatch: plain steered, overlay-routed
+        # cidr adds, and the fused Pallas skip-node walk — the full
+        # EditOp alphabet over the ISSUE-6 layout.  The cskip
+        # injected-defect acceptance (infw_lint state --inject-defect
+        # cskip) runs the plain config under the zeroed-skip-bits bug.
+        StateConfig("ctrie", force_path="ctrie", steered=True),
+        StateConfig("ctrie-overlay", force_path="ctrie", overlay=True),
+        StateConfig("ctrie-fused", n_entries=56, v6_fraction=0.85,
+                    force_path="ctrie", fused_deep=True, steered=True),
     )
 }
 
@@ -552,6 +562,109 @@ def check_device_tables(dev: "jaxpath.DeviceTables") -> List[str]:
     return v
 
 
+def check_ctrie_tables(cdev) -> List[str]:
+    """Invariant contracts for the path/level-compressed poptrie layout
+    (jaxpath.CTrieTables) — the compressed-path half of
+    check_device_tables: dtypes, row buckets, skip-node bounds
+    (skip_len <= CPOP_MAX_SKIP, skip_bits inside the skip window),
+    child/target base ranges, the flat-target sentinel, and the
+    per-tidx joined row self-indexing.  Pad rows must be all-zero
+    (bitmaps 0 = unreachable)."""
+    v: List[str] = []
+    l0 = np.asarray(cdev.l0)
+    nodes = np.asarray(cdev.nodes)
+    targets = np.asarray(cdev.targets)
+    joined = np.asarray(cdev.joined)
+    root_lut = np.asarray(cdev.root_lut)
+    if l0.dtype != np.int32 or l0.ndim != 2 or l0.shape[1] != 2:
+        v.append(f"ctrie l0: shape {l0.shape} dtype {l0.dtype}, want (*, 2) "
+                 "int32")
+        return v
+    if l0.shape[0] % 65536:
+        v.append(f"ctrie l0 has {l0.shape[0]} rows — not a whole number of "
+                 "DIR-16 root nodes")
+    if nodes.dtype != np.uint32 or nodes.ndim != 2 or nodes.shape[1] != 20:
+        v.append(f"ctrie nodes: shape {nodes.shape} dtype {nodes.dtype}, "
+                 "want (*, 20) uint32")
+        return v
+    N = nodes.shape[0]
+    if N > 1 and N != jaxpath._row_bucket(N):
+        v.append(f"ctrie node count {N} is not a valid row bucket")
+    if targets.dtype != np.int32 or targets.ndim != 1:
+        v.append(f"ctrie targets: shape {targets.shape} dtype "
+                 f"{targets.dtype}, want 1-D int32")
+        return v
+    if len(targets) and targets[0] != 0:
+        v.append("ctrie targets[0] is not the 0 sentinel")
+    if int(l0[:, 0].max(initial=0)) > N:
+        v.append(f"l0 cnode id {int(l0[:, 0].max())} exceeds the node "
+                 f"array ({N} rows)")
+    if int(l0[:, 1].max(initial=0)) >= max(joined.shape[0], 1):
+        v.append(f"l0 tidx+1 {int(l0[:, 1].max())} exceeds the joined "
+                 f"matrix ({joined.shape[0]} rows)")
+    skip_len = nodes[:, 2].astype(np.int64)
+    skip_bits = nodes[:, 3].astype(np.int64)
+    if int(skip_len.max(initial=0)) > jaxpath.CPOP_MAX_SKIP:
+        v.append(f"skip_len {int(skip_len.max())} exceeds CPOP_MAX_SKIP "
+                 f"({jaxpath.CPOP_MAX_SKIP})")
+    if (skip_len % 8).any():
+        v.append("a skip_len is not a whole number of 8-bit strides")
+    over = skip_bits >= (np.int64(1) << np.clip(skip_len, 0, 32))
+    if bool((over & (skip_bits > 0)).any()):
+        i = int(np.nonzero(over & (skip_bits > 0))[0][0])
+        v.append(f"node {i}: skip_bits {int(skip_bits[i])} does not fit "
+                 f"its {int(skip_len[i])}-bit skip window")
+    cc = jaxpath._pc_rows(nodes[:, 4:12])
+    tc = jaxpath._pc_rows(nodes[:, 12:20])
+    cb = nodes[:, 0].astype(np.int64)
+    tb = nodes[:, 1].astype(np.int64)
+    live_c = cc > 0
+    if bool((cb[live_c] + cc[live_c] > N).any()):
+        v.append("a node's child range [child_base, child_base+count) "
+                 f"exceeds the node array ({N} rows)")
+    live_t = tc > 0
+    if bool((tb[live_t] + tc[live_t] > len(targets)).any()):
+        v.append("a node's target range exceeds the flat target array "
+                 f"({len(targets)} positions)")
+    # NOTE: no "empty row must be all-zero" contract for nodes — a real
+    # node with zero bitmaps still carries its BFS child_base/target_base
+    # (build_cpoptrie assigns bases unconditionally), and the walk treats
+    # it exactly like a pad row: both bitmaps read 0, the lane dies.
+    if int(targets.max(initial=0)) >= max(joined.shape[0], 1):
+        v.append(f"target tidx+1 {int(targets.max())} exceeds the joined "
+                 f"matrix ({joined.shape[0]} rows)")
+    if targets.min(initial=0) < 0:
+        v.append("negative tidx+1 in the flat target array")
+    if joined.dtype != np.uint16 or joined.ndim != 2 or joined.shape[1] < 3:
+        v.append(f"ctrie joined: shape {joined.shape} dtype {joined.dtype}, "
+                 "want (T+1, 3+R*5) uint16")
+        return v
+    if joined.shape[0] > 1 and joined.shape[0] != jaxpath._row_bucket(
+        joined.shape[0]
+    ):
+        v.append(f"ctrie joined row count {joined.shape[0]} is not a valid "
+                 "row bucket")
+    if joined[0].any():
+        v.append("joined row 0 (the UNDEF sentinel) carries nonzero bytes")
+    enc = joined[:, 0].astype(np.int64) | (joined[:, 1].astype(np.int64) << 16)
+    idx = np.arange(joined.shape[0], dtype=np.int64)
+    bad = (enc != 0) & (enc != idx)
+    if bool(bad.any()):
+        i = int(np.nonzero(bad)[0][0])
+        v.append(f"joined row {i} self-index encodes {int(enc[i])} — the "
+                 "per-tidx matrix must index itself (row t = tidx+1 = t)")
+    pad_rows = (enc == 0) & (idx > 0)
+    if joined[pad_rows].any():
+        v.append("a joined pad row carries nonzero bytes")
+    if root_lut.dtype != np.int32:
+        v.append(f"ctrie root_lut dtype {root_lut.dtype}, want int32")
+    n_roots = l0.shape[0] // 65536
+    if int(root_lut.max(initial=0)) >= max(n_roots, 1):
+        v.append(f"root_lut value {int(root_lut.max())} exceeds the "
+                 f"{n_roots} DIR-16 root node(s)")
+    return v
+
+
 def check_sharded_tables(dev) -> List[str]:
     """Minimal consistency pass for the rules-sharded mesh layouts
     (which re-place on every load and are NOT the bucketed patch
@@ -863,10 +976,36 @@ class _Driver:
 
         with self.clf._lock:
             active = self.clf._active
-        _path, dev, _bb, _wide, ov_dev, walk_dev = active
+        path, dev, _bb, _wide, ov_dev, walk_dev = active
         snap = self.snapshot
         clone = _cold_clone(snap)
         device = self.clf._device
+        if path == "ctrie":
+            # compressed layout: the resident (CTrieTables, d_max) must
+            # match a cold device_ctrie(compile(spec), pad=True) rebuild
+            # bit-for-bit, same contract as the per-level patch path
+            cdev, d_max = dev
+            viols = check_ctrie_tables(cdev)
+            if viols:
+                return Failure(step, "invariant",
+                               f"{len(viols)} ctrie contract violation(s)",
+                               "\n".join(viols))
+            fresh = jaxpath.device_ctrie(clone, device, pad=True)
+            if fresh is None:
+                return Failure(step, "raw",
+                               "ctrie resident but the cold rebuild "
+                               "declined the layout")
+            if d_max != fresh[1]:
+                return Failure(step, "raw",
+                               f"resident ctrie d_max {d_max} != cold "
+                               f"rebuild {fresh[1]}")
+            m = _first_mismatch(cdev, fresh[0])
+            if m:
+                return Failure(
+                    step, "raw",
+                    "patched ctrie device state diverged from the cold "
+                    "device_ctrie(compile(spec), pad=True) rebuild", m,
+                )
         if isinstance(dev, jaxpath.DeviceTables):
             viols = check_device_tables(dev)
             if viols:
@@ -903,14 +1042,29 @@ class _Driver:
         if walk_dev is not None:
             classes = jaxpath.tune_depth_classes(clone)
             min_depth = classes[-2] if len(classes) >= 2 else None
-            built = pallas_walk.build_walk_tables_meta(
-                clone, min_depth=min_depth, device=device
-            )
-            if built is None:
-                return Failure(step, "walk",
-                               "fused walk resident but the cold rebuild "
-                               "declined to build")
-            m = _first_mismatch(walk_dev, built[0])
+            if path == "ctrie":
+                built = pallas_walk.build_cwalk_tables_meta(
+                    clone, min_depth=min_depth, device=device
+                )
+                if built is None:
+                    return Failure(step, "walk",
+                                   "fused compressed walk resident but the "
+                                   "cold rebuild declined to build")
+                wt, dw = walk_dev
+                if dw != built[1]["d_max"]:
+                    return Failure(step, "walk",
+                                   f"resident cwalk d_max {dw} != cold "
+                                   f"rebuild {built[1]['d_max']}")
+                m = _first_mismatch(wt, built[0])
+            else:
+                built = pallas_walk.build_walk_tables_meta(
+                    clone, min_depth=min_depth, device=device
+                )
+                if built is None:
+                    return Failure(step, "walk",
+                                   "fused walk resident but the cold rebuild "
+                                   "declined to build")
+                m = _first_mismatch(walk_dev, built[0])
             if m:
                 return Failure(step, "walk",
                                "patched fused-walk tables diverged from "

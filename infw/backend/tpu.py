@@ -54,12 +54,13 @@ class TpuClassifier:
         self,
         device=None,
         dense_limit: int = pallas_dense.MAX_DENSE_TARGETS,
-        force_path: Optional[str] = None,  # "dense" | "trie" | None (auto)
+        force_path: Optional[str] = None,  # "dense"|"trie"|"ctrie"|None (auto)
         interpret: Optional[bool] = None,
         fused_deep: Optional[bool] = None,
         wire_codec: Optional[str] = None,
         decode_pallas: Optional[bool] = None,
         check_invariants: Optional[bool] = None,
+        compressed: Optional[bool] = None,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -112,6 +113,19 @@ class TpuClassifier:
             env = os.environ.get("INFW_CHECK_INVARIANTS", "")
             check_invariants = env not in ("", "0", "false", "no")
         self._check_invariants = bool(check_invariants)
+        # Path/level-compressed poptrie layout (jaxpath.build_cpoptrie):
+        # trie-sized tables serve from the merged skip-node array — the
+        # 10M-tier working-set layout — instead of the per-level walk.
+        # Precedence mirrors fused_deep: constructor arg (the daemon's
+        # --compressed) > INFW_COMPRESSED env > off.  force_path="ctrie"
+        # is the explicit per-instance form.  Ineligible tables (wide
+        # int32 ruleIds) fall back to the level walk at load time, never
+        # refuse.
+        if compressed is None:
+            env = os.environ.get("INFW_COMPRESSED", "")
+            if env:
+                compressed = env not in ("0", "false", "no")
+        self._compressed = bool(compressed) or force_path == "ctrie"
         self._lock = threading.Lock()
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
@@ -158,9 +172,38 @@ class TpuClassifier:
         path = self._force_path or (
             "dense" if tables.num_entries <= self._dense_limit else "trie"
         )
+        if path == "trie" and self._compressed and self._force_path is None:
+            # the compressed upgrade applies to the AUTO-selected trie
+            # path only: an explicit per-instance force_path="trie" must
+            # beat the constructor/env knob (the documented precedence),
+            # or every test/statecheck config pinning the per-level walk
+            # silently flips under INFW_COMPRESSED=1
+            path = "ctrie"
         # Build the next buffer off-lock (host packing + device_put can be
         # slow); swap under the lock.
         wide_rids = False
+        if path == "ctrie":
+            # Rules-only edit: carry the host caches forward BEFORE the
+            # eligibility probes below — joined_by_tidx and
+            # check_wire_ruleids memoize on first touch, so a fresh
+            # snapshot would repack the full rules tensor right here.
+            with self._lock:
+                seed_prev = self._tables
+            if seed_prev is not None and dirty_hint is not None:
+                jaxpath.seed_ctrie_caches_forward(
+                    seed_prev, tables, dirty_hint
+                )
+            # Compressed-layout eligibility: the per-tidx joined rows are
+            # u16-packed and the wire result carries the ruleId — wide
+            # tables serve from the level walk's u32 path instead (the
+            # same fallback contract as the fused deep walk).
+            try:
+                jaxpath.check_wire_ruleids(tables)
+            except ValueError:
+                path = "trie"
+            else:
+                if jaxpath.joined_by_tidx(tables) is None:
+                    path = "trie"
         if path == "dense":
             try:
                 pt = pallas_dense.build_pallas_tables(tables)
@@ -175,6 +218,46 @@ class TpuClassifier:
             dev = jax.tree.map(lambda a: jax.device_put(a, self._device), pt)
             block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
             self._last_load = ("full", tables.num_entries)
+        elif path == "ctrie":
+            # Compressed-poptrie resident form: dev is (CTrieTables,
+            # d_max) — d_max is the static walk-unroll bound and travels
+            # beside the pytree, not inside it.  Same incremental
+            # contract as the trie path: rules-only edits scatter the
+            # per-tidx joined rows, structural edits diff the merged
+            # node/target arrays row-wise; a layout shift past the row
+            # buckets (or a d_max change) re-uploads.
+            dev = None
+            block_b = None
+            with self._lock:
+                prev_tables, prev_active = self._tables, self._active
+            if (
+                prev_tables is not None
+                and prev_active is not None
+                and prev_active[0] == "ctrie"
+            ):
+                patched = jaxpath.patch_ctrie(
+                    prev_active[1][0], prev_tables, tables, self._device,
+                    hint=dirty_hint,
+                )
+                if patched is None and jaxpath.hint_trie_unchanged(
+                    dirty_hint
+                ):
+                    # only a rules-only hint takes a different path on
+                    # retry (structural row-diff instead of the joined
+                    # fast path); a structural hint already ran exactly
+                    # the diff a no-hint attempt would repeat
+                    patched = jaxpath.patch_ctrie(
+                        prev_active[1][0], prev_tables, tables, self._device
+                    )
+                if patched is not None:
+                    dev = (patched[0], prev_active[1][1])
+                    self._last_load = ("patch", patched[1])
+            if dev is None:
+                dev = jaxpath.device_ctrie(tables, self._device, pad=True)
+                self._last_load = ("full", tables.num_entries)
+                # same first-edit contract as the level walk: the patch
+                # scatters compile at load time, not on the first edit
+                jaxpath.warm_ctrie_patch_scatters(dev[0], self._device)
         else:
             try:
                 jaxpath.check_wire_ruleids(tables)
@@ -223,12 +306,14 @@ class TpuClassifier:
         walk_dev = None
         walk_meta = None
         defer_walk = False
-        if path == "trie":
+        if path in ("trie", "ctrie"):
             # per-root-slot deep-level requirement (conservative across
             # rules-only patches via the cache carry-forward; recomputed
             # from the snapshot's slot arrays on structural loads);
             # thresholds are TUNED to this table's depth histogram
-            # (jaxpath.tune_depth_classes) rather than the static set
+            # (jaxpath.tune_depth_classes) rather than the static set.
+            # The LUT is in LEVEL terms — conservative for the
+            # compressed walk, whose skip nodes only shrink step counts.
             lut = jaxpath.build_depth_lut(tables)
             classes = jaxpath.tune_depth_classes(tables)
             steer_parts = (
@@ -251,18 +336,23 @@ class TpuClassifier:
                     defer_walk = True
                 else:
                     walk_dev, walk_meta = self._build_walk(
-                        tables, classes, dirty_hint
+                        tables, classes, dirty_hint, path == "ctrie"
                     )
                     if walk_dev is not None:
                         # pre-compile the walk's joined-plane patch
                         # scatters (one per array shape, lru-deduped) so
                         # the first fused-path rules edit is compile-free
-                        pallas_walk.warm_walk_patch_scatters(
-                            walk_dev, self._device
-                        )
+                        if path == "ctrie":
+                            pallas_walk.warm_cwalk_patch_scatters(
+                                walk_dev[0], self._device
+                            )
+                        else:
+                            pallas_walk.warm_walk_patch_scatters(
+                                walk_dev, self._device
+                            )
         ov_dev = None
         if overlay is not None and overlay.num_entries > 0:
-            if path != "trie" or wide_rids:
+            if path not in ("trie", "ctrie") or wide_rids:
                 # refusing beats silently dropping live rules: the caller
                 # (syncer) must merge the overlay into the main table when
                 # the classifier cannot honor it on this path
@@ -300,7 +390,7 @@ class TpuClassifier:
                 if steer_parts is not None else None
             )
         if defer_walk:
-            self._spawn_walk_rebuild(tables, steer_parts[2])
+            self._spawn_walk_rebuild(tables, steer_parts[2], path == "ctrie")
 
     def _run_invariant_check(self, dev, ov_dev) -> None:
         """Opt-in deep invariant pass (INFW_CHECK_INVARIANTS=1 /
@@ -314,6 +404,12 @@ class TpuClassifier:
         viols = []
         if isinstance(dev, jaxpath.DeviceTables):
             viols += statecheck.check_device_tables(dev)
+        elif (
+            isinstance(dev, tuple)
+            and dev
+            and isinstance(dev[0], jaxpath.CTrieTables)
+        ):
+            viols += statecheck.check_ctrie_tables(dev[0])
         if ov_dev is not None:
             viols += [
                 f"overlay: {v}"
@@ -325,80 +421,115 @@ class TpuClassifier:
                 "boundary:\n  " + "\n  ".join(viols)
             )
 
-    def _build_walk(self, tables: CompiledTables, classes, dirty_hint):
-        """Fused-walk tables for the full-depth steering class.
+    def _build_walk(self, tables: CompiledTables, classes, dirty_hint,
+                    compressed: bool = False):
+        """Fused-walk tables for the full-depth steering class (level
+        walk or the compressed skip-node walk, per ``compressed``).
 
         The joined byte planes bake RULE BYTES into the resident layout,
         so a rules-only edit whose dirty targets intersect the walk's
         kept tidx set must rebuild; a non-intersecting edit (the common
         1-key case at scale — the deep tail is a small extracted subset)
         carries the resident walk forward untouched.  Any build failure
-        degrades to the XLA walk, never to a refusal."""
+        degrades to the XLA walk, never to a refusal.
+
+        Compressed-path resident form: (CWalkTables, d_max) — the unroll
+        bound travels beside the pytree into the jit-factory cache key."""
+        want_path = "ctrie" if compressed else "trie"
         min_depth = classes[-2] if len(classes) >= 2 else None
-        rules_only = dirty_hint is not None and all(
-            len(h) == 0 for h in dirty_hint.get("levels", [np.zeros(1)])
-        )
+        rules_only = jaxpath.hint_trie_unchanged(dirty_hint)
         with self._lock:
             prev_active, prev_meta = self._active, self._walk_meta
         if (
             rules_only
             and prev_meta is not None
             and prev_active is not None
+            and prev_active[0] == want_path
             and len(prev_active) > 5
             and prev_active[5] is not None
             and prev_meta["min_depth"] == min_depth
         ):
             dirty = np.unique(np.asarray(dirty_hint.get("dense", ()), np.int64))
-            tidx_sorted = prev_meta["tidx_sorted"]
-            if not bool(np.isin(dirty, tidx_sorted).any()):
-                return prev_active[5], prev_meta
+            if not compressed:
+                # level walk: the extracted joined planes hold ONLY the
+                # kept tidx rows — a non-intersecting edit carries the
+                # resident walk forward untouched.  The compressed
+                # walk's per-tidx matrix is FULL (root-level best0 hits
+                # index it directly, outside the kept target set), so
+                # every rules edit patches it.
+                tidx_sorted = prev_meta["tidx_sorted"]
+                if not bool(np.isin(dirty, tidx_sorted).any()):
+                    return prev_active[5], prev_meta
             # dirty targets ARE resident: rewrite exactly their joined
-            # byte-plane rows on device (kilobytes) — the trie is
-            # untouched, so levels/l0 carry over
+            # rows on device (kilobytes) — the trie is untouched, so
+            # levels/l0/nodes carry over
             try:
-                patched = pallas_walk.patch_walk_joined(
-                    prev_active[5], prev_meta, tables, dirty, self._device
-                )
+                if compressed:
+                    p = pallas_walk.patch_cwalk_joined(
+                        prev_active[5][0], prev_meta, tables, dirty,
+                        self._device,
+                    )
+                    patched = None if p is None else (p, prev_active[5][1])
+                else:
+                    patched = pallas_walk.patch_walk_joined(
+                        prev_active[5], prev_meta, tables, dirty,
+                        self._device,
+                    )
             except Exception:
                 patched = None
             if patched is not None:
                 return patched, prev_meta
-        try:
-            built = pallas_walk.build_walk_tables_meta(
-                tables, min_depth=min_depth, device=self._device
-            )
-        except Exception:
-            built = None
+        built = self._walk_build_fn(compressed)(tables, min_depth)
         if built is None:
             return None, None
-        return built
+        wt, meta = built
+        return ((wt, meta["d_max"]) if compressed else wt), meta
 
-    def _spawn_walk_rebuild(self, tables: CompiledTables, classes) -> None:
+    def _walk_build_fn(self, compressed: bool):
+        """(tables, min_depth) -> (walk tables, meta) | None, exception-
+        safe — the shared builder of the sync and background paths."""
+        def build(tables, min_depth):
+            try:
+                if compressed:
+                    return pallas_walk.build_cwalk_tables_meta(
+                        tables, min_depth=min_depth, device=self._device
+                    )
+                return pallas_walk.build_walk_tables_meta(
+                    tables, min_depth=min_depth, device=self._device
+                )
+            except Exception:
+                return None
+
+        return build
+
+    def _spawn_walk_rebuild(self, tables: CompiledTables, classes,
+                            compressed: bool = False) -> None:
         """Background fused-walk rebuild after a structural edit: build
         off-thread, install under the lock ONLY if this table generation
         is still resident (a newer load supersedes the result — its own
         walk build wins).  Classify dispatches read ``_active`` under the
         lock, so they pick the walk up at the next chunk."""
+        want_path = "ctrie" if compressed else "trie"
         min_depth = classes[-2] if len(classes) >= 2 else None
 
         def work():
-            try:
-                built = pallas_walk.build_walk_tables_meta(
-                    tables, min_depth=min_depth, device=self._device
-                )
-            except Exception:
-                built = None
+            built = self._walk_build_fn(compressed)(tables, min_depth)
             if built is None:
                 return
             wt, meta = built
-            pallas_walk.warm_walk_patch_scatters(wt, self._device)
+            if compressed:
+                pallas_walk.warm_cwalk_patch_scatters(wt, self._device)
+                resident = (wt, meta["d_max"])
+            else:
+                pallas_walk.warm_walk_patch_scatters(wt, self._device)
+                resident = wt
             with self._lock:
                 if (
                     self._tables is tables
                     and self._active is not None
-                    and self._active[0] == "trie"
+                    and self._active[0] == want_path
                 ):
-                    self._active = self._active[:5] + (wt,)
+                    self._active = self._active[:5] + (resident,)
                     self._walk_meta = meta
 
         threading.Thread(
@@ -577,7 +708,7 @@ class TpuClassifier:
             "kind": kind, "n": n,
         }
         put = lambda a: jax.device_put(a, self._device)
-        if path == "trie" and wire_np.shape[1] == 4 and n:
+        if path in ("trie", "ctrie") and wire_np.shape[1] == 4 and n:
             codec = self._wire_codec
             if codec in ("auto", "delta"):
                 # delta+varint compressed wire (packets.encode_delta_wire):
@@ -649,6 +780,26 @@ class TpuClassifier:
             fused = pallas_dense.jitted_classify_pallas_wire_fused(
                 self._interpret, block_b
             )(dev, wire)
+        elif path == "ctrie":
+            # Compressed skip-node walk: fused Pallas for the declared
+            # full-depth class (the extraction threshold travels with
+            # the gen token, same contract as the level walk); XLA
+            # compressed walk otherwise.  Depth-class truncation does
+            # not apply — d_max is already the path-compressed bound.
+            cdev, d_max = dev
+            if walk_dev is not None and ov_dev is None:
+                wt, dw = walk_dev
+                fused = pallas_walk.jitted_classify_cwalk_wire_fused(
+                    dw, self._interpret
+                )(wt, wire)
+            elif ov_dev is not None:
+                fused = jaxpath.jitted_classify_ctrie_wire_overlay_fused(
+                    d_max
+                )(cdev, ov_dev, wire)
+            else:
+                fused = jaxpath.jitted_classify_ctrie_wire_fused(d_max)(
+                    cdev, wire
+                )
         elif walk_dev is not None and ov_dev is None:
             # Fused deep walk: the whole v6 descent (level walk +
             # popcount-rank child step + joined rules tail) in one
@@ -695,7 +846,16 @@ class TpuClassifier:
         dev, ov_dev = plan["dev"], plan["ov_dev"]
         kind, n, pkt_len = plan["kind"], plan["n"], plan["pkt_len"]
         wire, ifm = plan["wire"], plan["ifmap"]
-        if ov_dev is not None:
+        if plan["path"] == "ctrie":
+            cdev, d_max = dev
+            fn = jaxpath.jitted_classify_ctrie_wire8_fused(
+                d_max, ov_dev is not None
+            )
+            fused = (
+                fn(cdev, ov_dev, wire, ifm)
+                if ov_dev is not None else fn(cdev, wire, ifm)
+            )
+        elif ov_dev is not None:
             fused = jaxpath.jitted_classify_wire8_fused(True)(
                 dev, ov_dev, wire, ifm
             )
@@ -730,11 +890,19 @@ class TpuClassifier:
         dev, ov_dev = plan["dev"], plan["ov_dev"]
         kind, n, pkt_len = plan["kind"], plan["n"], plan["pkt_len"]
         enc = plan["enc"]
-        fn = wire_decode.jitted_classify_delta_fused(
-            ov_dev is not None, n, enc.dict_mode, enc.fixed_w,
-            use_pallas=self._decode_pallas and enc.fixed_w > 0,
-            interpret=self._interpret,
-        )
+        use_pallas = self._decode_pallas and enc.fixed_w > 0
+        if plan["path"] == "ctrie":
+            cdev, d_max = dev
+            fn = wire_decode.jitted_classify_delta_ctrie_fused(
+                ov_dev is not None, d_max, n, enc.dict_mode, enc.fixed_w,
+                use_pallas=use_pallas, interpret=self._interpret,
+            )
+            dev = cdev
+        else:
+            fn = wire_decode.jitted_classify_delta_fused(
+                ov_dev is not None, n, enc.dict_mode, enc.fixed_w,
+                use_pallas=use_pallas, interpret=self._interpret,
+            )
         if ov_dev is not None:
             fused = fn(dev, ov_dev, plan["payload"], plan["dictv"],
                        plan["ifmap"])

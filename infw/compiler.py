@@ -22,6 +22,10 @@ icmpCode, action] — all int32.
 """
 from __future__ import annotations
 
+import os
+import sys
+import time
+from collections.abc import MutableMapping
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -67,6 +71,278 @@ MAX_IFINDEX = 1 << 20
 class CompileError(ValueError):
     pass
 
+
+# --- build profiling --------------------------------------------------------
+#
+# INFW_BUILD_PROFILE=1 turns every table build into an attributable
+# timeline: compile phases (dedup/dense/trie/snapshot), the poptrie
+# transform and the device upload each report once on stderr and
+# accumulate into a ``build_profile`` dict attached to the resulting
+# CompiledTables — so a build-time regression names its phase instead of
+# disappearing into one opaque wall-clock number.
+
+
+def build_profile_enabled() -> bool:
+    return os.environ.get("INFW_BUILD_PROFILE", "") not in ("", "0", "false", "no")
+
+
+def record_build_phase(tables, name: str, seconds: float) -> None:
+    """Report one named build phase (no-op unless INFW_BUILD_PROFILE=1).
+    ``tables`` may be None (phase before a CompiledTables exists) or any
+    object accepting a ``build_profile`` dict attribute."""
+    if not build_profile_enabled():
+        return
+    print(f"[infw-build] {name}: {seconds * 1e3:.1f} ms", file=sys.stderr,
+          flush=True)
+    if tables is not None:
+        prof = getattr(tables, "build_profile", None)
+        if prof is None:
+            prof = {}
+            try:
+                object.__setattr__(tables, "build_profile", prof)
+            except (AttributeError, TypeError):
+                return
+        prof[name] = prof.get(name, 0.0) + seconds
+
+
+class _PhaseTimer:
+    """Accumulates named phases for one build; .attach() pins the dict on
+    the built tables and emits the stderr lines.  Zero-cost when
+    profiling is off."""
+
+    def __init__(self):
+        self.enabled = build_profile_enabled()
+        self.phases: Dict[str, float] = {}
+        self._t0 = time.perf_counter() if self.enabled else 0.0
+
+    def lap(self, name: str) -> None:
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self.phases[name] = self.phases.get(name, 0.0) + (t - self._t0)
+        self._t0 = t
+
+    def attach(self, tables) -> None:
+        if not self.enabled:
+            return
+        for name, dt in self.phases.items():
+            record_build_phase(tables, name, dt)
+
+
+# --- columnar content -------------------------------------------------------
+
+
+@dataclass
+class TableColumns:
+    """Columnar LPM-map content: the whole desired table as four arrays
+    instead of a per-key Python dict — the input format of the
+    vectorized compiler (:meth:`IncrementalTables.from_columns`).
+
+    The 1M/10M-tier cold build was dominated by per-key Python work
+    (masked_identity/bytearray per key, dict inserts, per-row
+    np.asarray); columns keep every build step a NumPy batch op.
+
+      prefix_len: (T,) int32  — mask_len + 32 (LpmKey.prefix_len)
+      ifindex:    (T,) int64
+      ip:         (T, 16) uint8 — unmasked address bytes (LpmKey.ip_data)
+      rules:      (T, W, 7) int32 packed rule rows
+    """
+
+    prefix_len: np.ndarray
+    ifindex: np.ndarray
+    ip: np.ndarray
+    rules: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.prefix_len.shape[0])
+
+    @property
+    def mask_len(self) -> np.ndarray:
+        return self.prefix_len.astype(np.int64) - 32
+
+
+def columns_from_content(
+    content: Dict[LpmKey, np.ndarray], rule_width: Optional[int] = None
+) -> TableColumns:
+    """Dict content -> TableColumns.  The per-key iteration here is
+    C-level (fromiter / bytes join / stack); everything downstream is
+    vectorized."""
+    if isinstance(content, LazyContent):
+        cols = content.columns()
+        if cols is not None:
+            return cols
+    T = len(content)
+    plen = np.fromiter((k.prefix_len for k in content), np.int32, count=T)
+    ifx = np.fromiter((k.ingress_ifindex for k in content), np.int64, count=T)
+    ip_b = b"".join(k.ip_data for k in content)
+    lens = np.fromiter((len(k.ip_data) for k in content), np.int64, count=T)
+    if (lens != 16).any():
+        # per-key, not aggregate: two offsetting wrong-length keys
+        # (15 + 17) keep the total at 16*T but would misalign every
+        # later key's address bytes in the reshape below
+        bad = int(lens[lens != 16][0])
+        raise CompileError(
+            f"ip_data must be exactly 16 bytes, got {bad}"
+        )
+    ip = (
+        np.frombuffer(ip_b, np.uint8).reshape(T, 16)
+        if T else np.zeros((0, 16), np.uint8)
+    )
+    vals = list(content.values())
+    try:
+        rules = (
+            np.stack(vals).astype(np.int32, copy=False)
+            if T else np.zeros((0, rule_width or 2, RULE_COLS), np.int32)
+        )
+        if rules.ndim != 3 or rules.shape[2] != RULE_COLS:
+            raise ValueError
+    except ValueError:
+        # ragged widths (adversarial direct content): pad to the widest
+        W = max((np.asarray(v).shape[0] for v in vals), default=2)
+        rules = np.zeros((T, W, RULE_COLS), np.int32)
+        for i, v in enumerate(vals):
+            v = np.asarray(v, np.int32)
+            rules[i, : v.shape[0]] = v
+    return TableColumns(prefix_len=plen, ifindex=ifx, ip=ip, rules=rules)
+
+
+#: (129, 16) per-byte mask rows for every legal mask length — one gather
+#: replaces the clip/shift arithmetic per call (measured ~0.4s/1M)
+_BYTE_MASK_LUT = (
+    (0xFF00 >> np.clip(
+        np.arange(129)[:, None] - 8 * np.arange(16)[None, :], 0, 8
+    )) & 0xFF
+).astype(np.uint8)
+
+
+def mask_ip_bytes(ip: np.ndarray, mask_len: np.ndarray) -> np.ndarray:
+    """Vectorized LpmKey.masked_identity address masking: (T, 16) uint8
+    unmasked bytes + (T,) mask lengths -> masked bytes."""
+    ml = np.clip(np.asarray(mask_len, np.int64), 0, 128)
+    return ip & _BYTE_MASK_LUT[ml]
+
+
+def _validate_columns(cols: TableColumns) -> None:
+    """Vectorized _validate_key over a whole column set (same error
+    messages, first offender reported)."""
+    ifx = np.asarray(cols.ifindex, np.int64)
+    bad = (ifx < 0) | (ifx > MAX_IFINDEX)
+    if bad.any():
+        i = int(np.nonzero(bad)[0][0])
+        raise CompileError(f"ifindex {int(ifx[i])} out of supported range")
+    plen = np.asarray(cols.prefix_len, np.int64)
+    bad = (plen < 32) | (plen > 160)
+    if bad.any():
+        i = int(np.nonzero(bad)[0][0])
+        raise CompileError(
+            f"prefixLen {int(plen[i])} out of range [32,160]"
+        )
+    if cols.ip.shape[1:] != (16,):
+        raise CompileError(
+            f"ip columns must be (T, 16) uint8, got {cols.ip.shape}"
+        )
+
+
+def _dedup_columns(
+    cols: TableColumns,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Masked-identity dedup, vectorized: returns (win, masked_ip,
+    trie_order) where ``win[j]`` is the source row of the j-th surviving
+    entry.  Survivor ORDER is the first occurrence of each identity and
+    the surviving VALUE is the last writer — exactly the dict semantics
+    of successive Map.Update calls that the per-key path implemented.
+
+    ``trie_order`` permutes the surviving entries into ascending
+    (ifindex, masked address) order — the radix order the trie bulk
+    builder needs, handed over so it never re-sorts (the identity sort
+    here already produced it)."""
+    T = len(cols)
+    masked = mask_ip_bytes(cols.ip, cols.mask_len)
+    if T == 0:
+        z = np.zeros(0, np.int64)
+        return z, masked, z
+    k0 = np.asarray(cols.ifindex, np.int64)
+    mc = np.ascontiguousarray(masked)
+    k1 = mc[:, :8].reshape(T, 8).view(">u8")[:, 0]
+    k2 = mc[:, 8:].reshape(T, 8).view(">u8")[:, 0]
+    kp = np.asarray(cols.prefix_len, np.int64)
+    # primary (ifindex, address): group order doubles as the trie's
+    # radix order; prefix_len only tiebreaks identity groups.  lexsort
+    # is stable, so equal identities keep input order.
+    order = np.lexsort((kp, k2, k1, k0))
+    s0, s1, s2, sp = k0[order], k1[order], k2[order], kp[order]
+    new_group = np.empty(T, bool)
+    new_group[0] = True
+    new_group[1:] = (s0[1:] != s0[:-1]) | (s1[1:] != s1[:-1]) | (
+        s2[1:] != s2[:-1]
+    ) | (sp[1:] != sp[:-1])
+    starts = np.nonzero(new_group)[0]
+    ends = np.append(starts[1:], T)
+    first_idx = order[starts]   # first occurrence (defines entry order)
+    last_idx = order[ends - 1]  # last writer (defines the value)
+    perm = np.argsort(first_idx, kind="stable")
+    inv = np.empty(len(perm), np.int64)
+    inv[perm] = np.arange(len(perm))
+    return last_idx[perm], masked, inv
+
+
+def _content_dict_from_cols(plen, ifx, ip, rules) -> Dict[LpmKey, np.ndarray]:
+    """The one remaining per-key loop: columns -> {LpmKey: rules rows}.
+    Deferred behind LazyContent so cold builds (whose consumers only
+    touch the tensors) never pay it."""
+    K = len(plen)
+    ip_b = np.ascontiguousarray(ip, np.uint8).tobytes()
+    return {
+        LpmKey(int(plen[t]), int(ifx[t]), ip_b[16 * t : 16 * t + 16]): rules[t]
+        for t in range(K)
+    }
+
+
+class LazyContent(MutableMapping):
+    """Deferred {LpmKey: rules} content dict backed by columns.
+
+    Cold builds at the 1M/10M tier spend seconds materializing a million
+    LpmKey tuples that the serving path never reads; this mapping holds
+    the columnar source and builds the real dict only on first access.
+    ``columns()`` exposes the raw arrays without materializing (the
+    checkpoint writer's fast path) — valid only while untouched, since a
+    mutation after materialization leaves the columns stale."""
+
+    def __init__(self, plen, ifx, ip, rules):
+        self._cols = (plen, ifx, ip, rules)
+        self._d: Optional[Dict[LpmKey, np.ndarray]] = None
+
+    def columns(self) -> Optional[TableColumns]:
+        if self._d is not None:
+            return None  # possibly mutated: columns no longer authoritative
+        plen, ifx, ip, rules = self._cols
+        return TableColumns(
+            prefix_len=np.asarray(plen, np.int32),
+            ifindex=np.asarray(ifx, np.int64),
+            ip=ip, rules=rules,
+        )
+
+    def _ensure(self) -> Dict[LpmKey, np.ndarray]:
+        if self._d is None:
+            self._d = _content_dict_from_cols(*self._cols)
+        return self._d
+
+    def __getitem__(self, k):
+        return self._ensure()[k]
+
+    def __setitem__(self, k, v):
+        self._ensure()[k] = v
+
+    def __delitem__(self, k):
+        del self._ensure()[k]
+
+    def __iter__(self):
+        return iter(self._ensure())
+
+    def __len__(self):
+        if self._d is None:
+            return len(self._cols[0])
+        return len(self._d)
 
 class LpmKey(NamedTuple):
     """BpfLpmIpKeySt equivalent (bpf/ingress_node_firewall.h:83-87).
@@ -302,9 +578,10 @@ class CompiledTables:
             int(tbl.shape[0]) >> s for tbl, s in zip(self.trie_levels, strides)
         )
 
-    def save(self, path: str) -> None:
+    def save(self, path) -> None:
         """Persist compiled state (the pinned-map equivalent; see
-        infw.syncer checkpointing)."""
+        infw.syncer checkpointing).  ``path`` may be a filename or a
+        writable binary file object (to_bytes uses the latter)."""
         import json
 
         meta = {
@@ -314,18 +591,18 @@ class CompiledTables:
         }
         # content keys persist as packed COLUMNS, not a JSON list: at 1M
         # entries the hexified-list format cost tens of seconds on both
-        # sides of the restart path (json + per-key hex round trips)
-        n_keys = len(self.content)
-        key_plen = np.empty(n_keys, np.uint16)
-        key_ifx = np.empty(n_keys, np.uint32)
-        key_ip = np.empty((n_keys, 16), np.uint8)
-        for i, k in enumerate(self.content):
-            key_plen[i] = k.prefix_len
-            key_ifx[i] = k.ingress_ifindex
-            key_ip[i] = np.frombuffer(k.ip_data, np.uint8)
+        # sides of the restart path (json + per-key hex round trips).
+        # The column extraction itself is vectorized (and FREE when the
+        # content is an unmaterialized LazyContent — the columns ARE its
+        # backing store), so a 10M-row snapshot round-trip no longer
+        # pays a per-key Python loop on either side.
+        cols = columns_from_content(self.content, self.rule_width)
+        key_plen = np.asarray(cols.prefix_len, np.uint16)
+        key_ifx = np.asarray(cols.ifindex, np.uint32)
+        key_ip = cols.ip
         content_rules = (
-            np.stack([self.content[k] for k in self.content])
-            if self.content
+            np.asarray(cols.rules, np.int32)
+            if len(cols)
             else np.zeros((0, self.rule_width, RULE_COLS), np.int32)
         )
         # Trie levels persist SPARSELY (nnz row index + rows): the slot
@@ -357,7 +634,7 @@ class CompiledTables:
         )
 
     @classmethod
-    def load(cls, path: str) -> "CompiledTables":
+    def load(cls, path) -> "CompiledTables":
         import json
 
         with np.load(path, allow_pickle=False) as z:
@@ -368,17 +645,19 @@ class CompiledTables:
                     "archive); recompile from the declarative spec"
                 )
             content_rules = z["content_rules"]
-            content = {}
             if "content_key_plen" in z:
-                plens = z["content_key_plen"].tolist()
-                ifxs = z["content_key_ifx"].tolist()
-                ip_bytes = z["content_key_ip"].tobytes()
-                content = {
-                    LpmKey(plens[i], ifxs[i], ip_bytes[i * 16 : i * 16 + 16]):
-                        content_rules[i]
-                    for i in range(len(plens))
-                }
+                # Deferred key materialization: restore hands back the
+                # loaded COLUMNS behind LazyContent, so the restart path
+                # never builds a million LpmKey tuples unless something
+                # actually walks the dict.
+                content = LazyContent(
+                    z["content_key_plen"].astype(np.int64),
+                    z["content_key_ifx"].astype(np.int64),
+                    z["content_key_ip"],
+                    content_rules,
+                )
             else:  # pre-columnar archives kept the keys in meta JSON
+                content = {}
                 for i, (plen, ifidx, iphex) in enumerate(meta["content_keys"]):
                     content[LpmKey(plen, ifidx, bytes.fromhex(iphex))] = (
                         content_rules[i]
@@ -406,6 +685,21 @@ class CompiledTables:
                 root_lut=z["root_lut"],
                 content=content,
             )
+
+    def to_bytes(self) -> bytes:
+        """In-memory serialization (same columnar npz format as save) —
+        the vectorized snapshot round-trip used by checkpoint shipping."""
+        import io
+
+        buf = io.BytesIO()
+        self.save(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "CompiledTables":
+        import io
+
+        return cls.load(io.BytesIO(data))
 
 
 def _words_from_bytes(data: bytes) -> List[int]:
@@ -451,11 +745,16 @@ class VarTrie:
         self._ct: List[np.ndarray] = []
         self._prio: List[np.ndarray] = []     # 0 = empty slot
         self.n_nodes: List[int] = []          # incl. null node 0
+        #: per level: no slot has ever held a nonzero priority — the
+        #: bulk build's leaf push skips the existing-priority gather
+        #: (page-faulting ~2s/1M across the multi-GB virgin arrays)
+        self._virgin: List[bool] = []
         for s in self.strides:
             slots = 1 << s
             self._ct.append(np.zeros((2 * slots, 2), np.int32))
             self._prio.append(np.zeros(2 * slots, np.int64))
             self.n_nodes.append(1)
+            self._virgin.append(True)
         self.roots: Dict[int, int] = {}
         # Monotonic mutation stamp: bumped by any write into the slot
         # arrays, so snapshot() can prove "trie unchanged since the last
@@ -538,9 +837,13 @@ class VarTrie:
         mask_len: np.ndarray,
         target: np.ndarray,
         seq: np.ndarray,
+        sort_hint: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Insert E prefixes at once; returns (term_level, term_node) per
-        entry so callers can do node-local deletes later."""
+        entry so callers can do node-local deletes later.  ``sort_hint``
+        (optional) is a precomputed (ifindex, address)-ascending
+        permutation of the entries — the dedup pass already sorted them,
+        so the bulk builder reuses it instead of re-sorting."""
         E = len(target)
         mask_len = np.asarray(mask_len, np.int64)
         t_level = self.term_levels(mask_len)
@@ -549,34 +852,153 @@ class VarTrie:
                 f"mask_len {int(mask_len.max())} exceeds trie depth "
                 f"({self.n_levels} levels, {int(self.bit_ends[-1])} bits)"
             )
-        parent = self._root_for_vec(np.asarray(ifindex, np.int64))
-        term_node = np.where(t_level == 0, parent, 0)
+        empty = not self.roots and all(n == 1 for n in self.n_nodes)
+        osort = None
+        if empty and E > 4096 and getattr(self, "sorted_bulk", True):
+            term_node, osort = self._bulk_insert_sorted(
+                np.asarray(ifindex, np.int64), ip, t_level, sort_hint
+            )
+        else:
+            parent = self._root_for_vec(np.asarray(ifindex, np.int64))
+            term_node = np.where(t_level == 0, parent, 0)
+            for l in range(1, self.n_levels):
+                reach = t_level >= l
+                if not reach.any():
+                    break
+                slots_prev = self._slots(l - 1)
+                code = parent[reach] * slots_prev + self._level_slot(
+                    ip[reach], l - 1
+                )
+                existing = self._ct[l - 1][code, 0]
+                need = existing == 0
+                if need.any():
+                    uniq_codes = np.unique(code[need])
+                    first = self._alloc_nodes(l, len(uniq_codes))
+                    # Allocation may have grown level l's arrays but level
+                    # l-1's child array is untouched by _alloc_nodes(l, ...).
+                    self._ct[l - 1][uniq_codes, 0] = first + np.arange(
+                        len(uniq_codes), dtype=np.int32
+                    )
+                    self._record_rows(l - 1, uniq_codes)
+                    existing = self._ct[l - 1][code, 0]
+                parent[reach] = existing
+                term_node = np.where(t_level == l, parent, term_node)
+        if osort is not None:
+            # Leaf-push groups in ADDRESS order: bulk-path term nodes
+            # were allocated ascending in prefix order, so each group's
+            # expanded slot codes arrive nondecreasing — the winner
+            # sort degenerates to timsort run-merging and the priority/
+            # target scatters walk the slot arrays sequentially instead
+            # of faulting pages at random (~2x the leaf-push phase at
+            # the 1M tier).  Winners are order-independent: the
+            # composite (mask_len, seq) priority key is unique.
+            tl_s = t_level[osort]
+            for l in np.unique(t_level):
+                sel = osort[tl_s == l]
+                self._leaf_push(
+                    int(l), term_node[sel], ip[sel], mask_len[sel],
+                    target[sel], seq[sel],
+                )
+        else:
+            for l in np.unique(t_level):
+                m = t_level == l
+                self._leaf_push(
+                    int(l), term_node[m], ip[m], mask_len[m], target[m],
+                    seq[m]
+                )
+        return t_level.astype(np.int32), term_node.astype(np.int32)
+
+    def _bulk_insert_sorted(
+        self, ifindex: np.ndarray, ip: np.ndarray, t_level: np.ndarray,
+        sort_hint: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Sorted-prefix child construction for a cold build into an
+        EMPTY trie (the ISSUE-6 vectorized compiler core): one lexsort of
+        (ifindex, address bytes) up front, then every level's node
+        allocation is a neighbor-compare + cumsum over the radix-ordered
+        codes — no per-level np.unique sort, no existence gather (an
+        empty trie needs every first-seen code allocated).
+
+        Node numbering is BIT-IDENTICAL to the incremental path: both
+        allocate level-l nodes in ascending (parent, slot) code order,
+        and parent ids are themselves ascending in prefix order by
+        induction from the sorted root allocation.
+
+        Returns (term_node, osort) — the per-entry terminal node in
+        INPUT order plus the address permutation, which batch_insert
+        reuses to leaf-push in address order."""
+        E = len(ifindex)
+        mc = np.ascontiguousarray(ip)
+        if sort_hint is not None:
+            osort = sort_hint
+        else:
+            k1 = mc[:, :8].reshape(E, 8).view(">u8")[:, 0]
+            k2 = mc[:, 8:].reshape(E, 8).view(">u8")[:, 0]
+            osort = np.lexsort((k2, k1, ifindex))
+        ifx_s = ifindex[osort]
+        ip_s = mc[osort]
+        tlv_s = t_level[osort]
+
+        # roots in ascending ifindex order (what _root_for_vec allocates)
+        new_if = np.empty(E, bool)
+        if E:
+            new_if[0] = True
+            new_if[1:] = ifx_s[1:] != ifx_s[:-1]
+        uniq_if = ifx_s[new_if]
+        first_root = self._alloc_nodes(0, len(uniq_if))
+        for i, ifx in enumerate(uniq_if):
+            self.roots[int(ifx)] = first_root + i
+        parent_s = first_root + np.cumsum(new_if) - 1
+        term_s = np.where(tlv_s == 0, parent_s, 0)
+
+        slot_col0 = (ip_s[:, 0].astype(np.int64) << 8) | ip_s[:, 1]
+        # Shrinking active set: at level l only entries with t_level >= l
+        # are still descending, and `active` (ascending positions in the
+        # sorted order) keeps them in radix order, so the allocation
+        # numbering is untouched while per-level work tracks the
+        # survivor count instead of E — 64% of the 1M-adversarial mix
+        # terminates by level 2, so the full-E boolean masks were ~4x
+        # the element-work of the walk itself.
+        active = np.nonzero(tlv_s >= 1)[0]
+        par = parent_s[active]
+        tlv_a = tlv_s[active]
         for l in range(1, self.n_levels):
-            reach = t_level >= l
-            if not reach.any():
+            if not len(active):
                 break
             slots_prev = self._slots(l - 1)
-            code = parent[reach] * slots_prev + self._level_slot(ip[reach], l - 1)
-            existing = self._ct[l - 1][code, 0]
-            need = existing == 0
-            if need.any():
-                uniq_codes = np.unique(code[need])
-                first = self._alloc_nodes(l, len(uniq_codes))
-                # Allocation may have grown level l's arrays but level
-                # l-1's child array is untouched by _alloc_nodes(l, ...).
-                self._ct[l - 1][uniq_codes, 0] = first + np.arange(
-                    len(uniq_codes), dtype=np.int32
-                )
-                self._record_rows(l - 1, uniq_codes)
-                existing = self._ct[l - 1][code, 0]
-            parent[reach] = existing
-            term_node = np.where(t_level == l, parent, term_node)
-        for l in np.unique(t_level):
-            m = t_level == l
-            self._leaf_push(
-                int(l), term_node[m], ip[m], mask_len[m], target[m], seq[m]
+            # column-sliced slot bytes: _level_slot on a row subset would
+            # copy the full 16-byte rows per level just to read one column
+            slot = (
+                slot_col0[active] if l == 1
+                else ip_s[active, l].astype(np.int64)
             )
-        return t_level.astype(np.int32), term_node.astype(np.int32)
+            code = par * slots_prev + slot
+            # radix order: codes are nondecreasing, so "first occurrence"
+            # is one neighbor compare and the allocation rank a cumsum
+            is_first = np.empty(len(code), bool)
+            is_first[0] = True
+            is_first[1:] = code[1:] != code[:-1]
+            n_new = int(is_first.sum())
+            first = self._alloc_nodes(l, n_new)
+            uniq_codes = code[is_first]
+            self._ct[l - 1][uniq_codes, 0] = first + np.arange(
+                n_new, dtype=np.int32
+            )
+            self._record_rows(l - 1, uniq_codes)
+            child = first + np.cumsum(is_first) - 1
+            done = tlv_a == l
+            if done.any():
+                term_s[active[done]] = child[done]
+                keep = ~done
+                active = active[keep]
+                par = child[keep]
+                tlv_a = tlv_a[keep]
+            else:
+                par = child
+
+        term_node = np.empty(E, np.int64)
+        term_node[osort] = term_s
+        return term_node, osort
 
     def _leaf_push(
         self,
@@ -593,6 +1015,8 @@ class VarTrie:
         span = (np.int64(1) << (self.bit_ends[level] - mask_len)).astype(np.int64)
         base = self._level_slot(ip, level) & ~(span - 1)
         total = int(span.sum())
+        if total == 0:
+            return
         rep = np.repeat(np.arange(len(span)), span)
         offs = np.arange(total, dtype=np.int64) - np.repeat(
             np.cumsum(span) - span, span
@@ -600,10 +1024,49 @@ class VarTrie:
         flat = node.astype(np.int64)[rep] * slots + base[rep] + offs
         self.mutations += 1
         prio = ((mask_len.astype(np.int64) + 1) << 40) | seq.astype(np.int64)
-        np.maximum.at(self._prio[level], flat, prio[rep])
-        won = self._prio[level][flat] == prio[rep]
-        self._ct[level][flat[won], 1] = (target.astype(np.int32) + 1)[rep[won]]
-        self._record_rows(level, flat[won])
+        # Per-slot winner by one sort instead of np.maximum.at + a won
+        # mask: the ufunc.at scatter was the hottest single op of a 1M
+        # build (~10x the cost of this sort on the same expansion).
+        # Sorted by (slot, prio), the last element of each slot group is
+        # its max-prio candidate; ties cannot happen (seq is unique per
+        # entry), and `>=` against the resident prio preserves the old
+        # equal-priority-overwrites semantics exactly.
+        if int(flat.max()) < (1 << 30) and int(seq.max()) < (1 << 24):
+            # one int64 sort key instead of a 2-key lexsort (two stable
+            # argsorts): flat < 2^30 slots and seq < 2^24 always hold
+            # below ~16M entries; the compact (mask_len+1, seq) rank
+            # orders identically to the full 48-bit priority
+            compact = (
+                ((mask_len.astype(np.int64) + 1) << 24)
+                | seq.astype(np.int64)
+            )[rep]
+            order = np.argsort((flat << 32) | compact, kind="stable")
+            prio_e = None
+        else:
+            prio_e = prio[rep]
+            order = np.lexsort((prio_e, flat))
+        of = flat[order]
+        last = np.nonzero(np.append(of[1:] != of[:-1], True))[0]
+        wi = order[last]
+        fw = flat[wi]
+        # the full prio[rep] expansion is only materialized on the
+        # lexsort path — winners only need the W gathered priorities
+        pw = prio[rep[wi]] if prio_e is None else prio_e[wi]
+        if self._virgin[level]:
+            # untouched level: every resident priority is 0, skip the
+            # (page-faulting) existing-priority gather
+            take = slice(None)
+            wi_t = wi
+        else:
+            take = pw >= self._prio[level][fw]
+            fw = fw[take]
+            wi_t = wi[take]
+        self._virgin[level] = False
+        self._prio[level][fw] = pw[take]
+        self._ct[level][fw, 1] = (target.astype(np.int64) + 1)[rep[wi_t]].astype(
+            np.int32
+        )
+        self._record_rows(level, fw)
 
     def repush_node(
         self,
@@ -723,10 +1186,66 @@ class IncrementalTables:
         self._seq_arr = np.zeros(0, np.int64)
         self._live = np.zeros(0, bool)
         self._free: List[int] = []
-        self._ident_to_t: Dict[Tuple[int, int, bytes], int] = {}
-        self._ident_to_key: Dict[Tuple[int, int, bytes], LpmKey] = {}
-        self.content: Dict[LpmKey, np.ndarray] = {}
+        # ident/content maps materialize LAZILY from _lazy_cols (set by
+        # from_columns): the cold-build path never touches them, and
+        # building a million LpmKey tuples + dict inserts was a major
+        # slice of the per-key compile this PR removed.
+        self._i2t: Optional[Dict[Tuple[int, int, bytes], int]] = {}
+        self._i2k: Optional[Dict[Tuple[int, int, bytes], LpmKey]] = {}
+        self._content: Optional[Dict[LpmKey, np.ndarray]] = {}
+        self._lazy_cols = None  # (plen, ifx, ip_unmasked, rules) or None
+        self._build_timer: Optional[_PhaseTimer] = None
         self._max_ifindex = 0
+
+    # -- lazy ident/content maps --------------------------------------------
+
+    def _materialize_maps(self) -> None:
+        if self._content is not None:
+            return
+        plen, ifx, ip_u, rules = self._lazy_cols
+        K = len(plen)
+        ip_b = np.ascontiguousarray(ip_u, np.uint8).tobytes()
+        masked_b = np.ascontiguousarray(self._ip[:K]).tobytes()
+        content: Dict[LpmKey, np.ndarray] = {}
+        i2t: Dict[Tuple[int, int, bytes], int] = {}
+        i2k: Dict[Tuple[int, int, bytes], LpmKey] = {}
+        for t in range(K):
+            key = LpmKey(int(plen[t]), int(ifx[t]), ip_b[16 * t : 16 * t + 16])
+            ident = (
+                key.prefix_len, key.ingress_ifindex,
+                masked_b[16 * t : 16 * t + 16],
+            )
+            content[key] = rules[t]
+            i2t[ident] = t
+            i2k[ident] = key
+        self._content, self._i2t, self._i2k = content, i2t, i2k
+
+    @property
+    def content(self) -> Dict[LpmKey, np.ndarray]:
+        self._materialize_maps()
+        return self._content
+
+    @content.setter
+    def content(self, value) -> None:
+        self._content = value
+
+    @property
+    def _ident_to_t(self) -> Dict[Tuple[int, int, bytes], int]:
+        self._materialize_maps()
+        return self._i2t
+
+    @_ident_to_t.setter
+    def _ident_to_t(self, value) -> None:
+        self._i2t = value
+
+    @property
+    def _ident_to_key(self) -> Dict[Tuple[int, int, bytes], LpmKey]:
+        self._materialize_maps()
+        return self._i2k
+
+    @_ident_to_key.setter
+    def _ident_to_key(self, value) -> None:
+        self._i2k = value
 
     # -- construction --------------------------------------------------------
 
@@ -737,11 +1256,84 @@ class IncrementalTables:
         rule_width: int = MAX_RULES_PER_TARGET,
         min_trie_levels: int = 1,
     ) -> "IncrementalTables":
-        # Deduplicate by masked identity, later entries replacing earlier
-        # ones — what successive Map.Update calls do on the kernel trie.
-        # The identity is computed once per key and threaded through every
-        # later loop (3 masked-identity passes over 1M keys were ~15% of
-        # the whole compile).
+        """Vectorized build from dict content: one C-level pass converts
+        the dict to columns, then from_columns does everything as NumPy
+        batch ops.  Bit-identical to the retired per-key path (kept as
+        from_content_legacy for the cross-check suite and the build
+        bench)."""
+        return cls.from_columns(
+            columns_from_content(content, rule_width),
+            rule_width=rule_width,
+            min_trie_levels=min_trie_levels,
+        )
+
+    @classmethod
+    def from_columns(
+        cls,
+        cols: TableColumns,
+        rule_width: int = MAX_RULES_PER_TARGET,
+        min_trie_levels: int = 1,
+    ) -> "IncrementalTables":
+        """The vectorized compiler: columnar content -> live tables with
+        no per-key Python.  Dedup (masked identity, last-writer-wins,
+        first-occurrence order), validation, dense packing and the trie
+        batch insert are all NumPy batch ops; the {LpmKey: rules} maps
+        materialize lazily on first incremental edit."""
+        timer = _PhaseTimer()
+        _validate_columns(cols)
+        win, masked, trie_order = _dedup_columns(cols)
+        timer.lap("compile/dedup")
+        T = len(win)
+        R = rule_width
+        mask_len = cols.mask_len[win]
+        ifindex = np.asarray(cols.ifindex, np.int64)[win]
+        ip = np.ascontiguousarray(masked[win])  # dense rows: MASKED bytes
+        rules_win = np.asarray(cols.rules, np.int32)[win]
+        if rules_win.shape[1] == R:
+            rules_t = rules_win
+        else:
+            rules_t = np.zeros((T, R, RULE_COLS), np.int32)
+            w = min(rules_win.shape[1], R)
+            rules_t[:, :w] = rules_win[:, :w]
+        max_mask = int(mask_len.max()) if T else 0
+        self = cls(R, max(trie_levels_for_mask(max_mask), min_trie_levels))
+        timer.lap("compile/dense-pack")
+        self._bulk_init(ifindex, ip, mask_len, rules_t, sort_hint=trie_order)
+        timer.lap("compile/trie-insert")
+        # content mirrors the LIVE table: aliased keys collapsed to the
+        # dedup winner (keeping losing aliases left ghost entries a later
+        # delete resurrected — found by the statecheck engine).  The maps
+        # themselves are deferred: _materialize_maps builds them from
+        # these columns on first access.
+        self._content = self._i2t = self._i2k = None
+        self._lazy_cols = (
+            np.asarray(cols.prefix_len, np.int32)[win],
+            ifindex,
+            np.ascontiguousarray(cols.ip[win]),
+            rules_win,
+        )
+        self._build_timer = timer
+        # Long-lived instances track dirty rows from here so the device
+        # patch path can skip the full-table diff.  The hint stays
+        # INVALID until the first clear_dirty(): hints are deltas against
+        # a device generation, and no device has consumed this (re)build
+        # yet — an empty hint against an older resident table would
+        # silently patch nothing.
+        self.start_dirty_tracking()
+        self._dirty_invalid = True
+        return self
+
+    @classmethod
+    def from_content_legacy(
+        cls,
+        content: Dict[LpmKey, np.ndarray],
+        rule_width: int = MAX_RULES_PER_TARGET,
+        min_trie_levels: int = 1,
+    ) -> "IncrementalTables":
+        """The retired per-key reference build, byte-for-byte: the
+        cross-check suite asserts from_columns output equality against
+        this, and the build bench measures the speedup against it.  Do
+        not use on hot paths."""
         dedup: Dict[Tuple[int, int, bytes], Tuple[LpmKey, np.ndarray]] = {}
         for key, rules in content.items():
             _validate_key(key)
@@ -752,6 +1344,11 @@ class IncrementalTables:
 
         max_mask = max((k.mask_len for _, (k, _r) in entries), default=0)
         self = cls(R, max(trie_levels_for_mask(max_mask), min_trie_levels))
+        # the reference build keeps the incremental insert path end to
+        # end, so the build bench's legacy-vs-columnar A/B measures the
+        # real retired cost (the sorted bulk fast path is the new
+        # compiler's half)
+        self.trie.sorted_bulk = False
 
         ifindex = np.fromiter(
             (k.ingress_ifindex for _, (k, _r) in entries), np.int64, count=T
@@ -775,20 +1372,7 @@ class IncrementalTables:
         for t, (ident, (key, _r)) in enumerate(entries):
             self._ident_to_t[ident] = t
             self._ident_to_key[ident] = key
-        # content mirrors the LIVE table: aliased keys collapsed to the
-        # dedup winner.  Keeping every input key (the old dict(content))
-        # left the losing alias behind as a ghost — a later delete of
-        # that identity popped only the tracked key, so any rebuild,
-        # compaction or checkpoint restore RESURRECTED the deleted entry
-        # (found by the statecheck equivalence engine: device state and
-        # content permanently diverged after one aliased delete).
         self.content = {key: rules for _ident, (key, rules) in entries}
-        # Long-lived instances track dirty rows from here so the device
-        # patch path can skip the full-table diff.  The hint stays
-        # INVALID until the first clear_dirty(): hints are deltas against
-        # a device generation, and no device has consumed this (re)build
-        # yet — an empty hint against an older resident table would
-        # silently patch nothing.
         self.start_dirty_tracking()
         self._dirty_invalid = True
         return self
@@ -831,19 +1415,33 @@ class IncrementalTables:
         if n <= self._cap:
             return
         cap = max(n, 2 * self._cap, 16)
-        grow2 = lambda a, w: np.concatenate(
-            [a, np.zeros((cap - self._cap, w), a.dtype)]
-        )
-        grow1 = lambda a, fill=0: np.concatenate(
-            [a, np.full(cap - self._cap, fill, a.dtype)]
-        )
+        if self._cap == 0:
+            # fresh instance (the bulk-build path): straight calloc —
+            # concatenate-with-empty materialized every zero page eagerly
+            # (~0.4s of memset+copy per 1M build)
+            grow2 = lambda a, w: np.zeros((cap, w), a.dtype)
+            grow1 = lambda a, fill=0: (
+                np.zeros(cap, a.dtype) if fill == 0
+                else np.full(cap, fill, a.dtype)
+            )
+        else:
+            grow2 = lambda a, w: np.concatenate(
+                [a, np.zeros((cap - self._cap, w), a.dtype)]
+            )
+            grow1 = lambda a, fill=0: np.concatenate(
+                [a, np.full(cap - self._cap, fill, a.dtype)]
+            )
         self._key_words = grow2(self._key_words, 5)
         self._mask_words = grow2(self._mask_words, 5)
         self._mask_len = grow1(self._mask_len)
-        self._rules = np.concatenate(
-            [self._rules,
-             np.zeros((cap - self._cap, self.rule_width, RULE_COLS), np.int32)]
-        )
+        if self._cap == 0:
+            self._rules = np.zeros((cap, self.rule_width, RULE_COLS), np.int32)
+        else:
+            self._rules = np.concatenate(
+                [self._rules,
+                 np.zeros((cap - self._cap, self.rule_width, RULE_COLS),
+                          np.int32)]
+            )
         self._ip = grow2(self._ip, 16)
         self._term_level = grow1(self._term_level)
         self._term_node = grow1(self._term_node)
@@ -868,7 +1466,7 @@ class IncrementalTables:
 
     def _bulk_init(
         self, ifindex: np.ndarray, ip: np.ndarray, mask_len: np.ndarray,
-        rules: np.ndarray,
+        rules: np.ndarray, sort_hint: Optional[np.ndarray] = None,
     ) -> None:
         T = len(ifindex)
         self._ensure_cap(T)
@@ -877,7 +1475,9 @@ class IncrementalTables:
         seq = np.arange(T, dtype=np.int64)
         self._seq_arr[:T] = seq
         self._seq_next = T
-        lv, nd = self.trie.batch_insert(ifindex, ip, mask_len, t, seq)
+        lv, nd = self.trie.batch_insert(
+            ifindex, ip, mask_len, t, seq, sort_hint=sort_hint
+        )
         self._term_level[:T] = lv
         self._term_node[:T] = nd
         self._size = T
@@ -1052,7 +1652,15 @@ class IncrementalTables:
             a.resize((n,) + a.shape[1:], refcheck=False)
             return a
 
-        return CompiledTables(
+        if self._content is None:
+            # unmaterialized maps: the snapshot gets its OWN deferred
+            # view over the (immutable) columns — no million-key dict
+            # build on the cold path, and later updater edits cannot
+            # leak into the snapshot
+            content = LazyContent(*self._lazy_cols)
+        else:
+            content = self.content if consume else dict(self.content)
+        result = CompiledTables(
             rule_width=self.rule_width,
             num_entries=T,
             key_words=take(self._key_words),
@@ -1061,8 +1669,13 @@ class IncrementalTables:
             rules=take(self._rules),
             trie_levels=trie_levels,
             root_lut=root_lut,
-            content=self.content if consume else dict(self.content),
+            content=content,
         )
+        if self._build_timer is not None:
+            self._build_timer.lap("compile/snapshot")
+            self._build_timer.attach(result)
+            self._build_timer = None
+        return result
 
 
 def _validate_key(key: LpmKey) -> None:
@@ -1091,4 +1704,19 @@ def compile_tables_from_content(
     rules-shard compiles to the same static depth."""
     return IncrementalTables.from_content(
         content, rule_width=rule_width, min_trie_levels=min_trie_levels
+    ).snapshot(consume=True)
+
+
+def compile_tables_from_columns(
+    cols: TableColumns,
+    rule_width: int = MAX_RULES_PER_TARGET,
+    min_trie_levels: int = 1,
+) -> CompiledTables:
+    """The fully-vectorized cold build: columnar content in, immutable
+    CompiledTables out, zero per-key Python anywhere on the path (the
+    {LpmKey: rules} view materializes lazily only if someone reads it).
+    This is the 1M/10M-tier production build — ~10x the dict path's
+    speed at 1M entries on the bench host."""
+    return IncrementalTables.from_columns(
+        cols, rule_width=rule_width, min_trie_levels=min_trie_levels
     ).snapshot(consume=True)

@@ -226,7 +226,8 @@ def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
 
 def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             wire_codec: Optional[str] = None,
-                            mesh: Optional[str] = None):
+                            mesh: Optional[str] = None,
+                            compressed: Optional[bool] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -250,6 +251,8 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             kw["fused_deep"] = fused_deep
         if wire_codec is not None:
             kw["wire_codec"] = wire_codec
+        if compressed is not None:
+            kw["compressed"] = compressed
         if mesh:
             from .backend.mesh import resolve_mesh_spec
 
@@ -259,6 +262,13 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                     "serving on a %dx%d (data x rules) device mesh",
                     m.shape["data"], m.shape["rules"],
                 )
+                if kw.pop("compressed", None):
+                    # the compressed layout is single-chip for now: the
+                    # mesh shard programs walk the per-level form
+                    log.warning(
+                        "--compressed is single-chip only; the mesh "
+                        "backend serves the per-level trie layout"
+                    )
                 return functools.partial(
                     classifier_class("mesh"), mesh=m, **kw
                 )
@@ -292,6 +302,7 @@ class Daemon:
         event_ring_size: int = 1 << 21,
         fused_deep: Optional[bool] = None,
         wire_codec: Optional[str] = None,
+        compressed: Optional[bool] = None,
         h2d_overlap: bool = True,
         h2d_stage_depth: int = DEFAULT_H2D_STAGE_DEPTH,
         mesh: Optional[str] = None,
@@ -331,7 +342,7 @@ class Daemon:
         self.syncer = DataplaneSyncer(
             classifier_factory=make_classifier_factory(
                 backend, fused_deep=fused_deep, wire_codec=wire_codec,
-                mesh=mesh,
+                mesh=mesh, compressed=compressed,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -969,6 +980,22 @@ def main(argv: Optional[List[str]] = None) -> int:
              "serves them instead",
     )
     p.add_argument(
+        "--compressed", action="store_true",
+        default=os.environ.get("INFW_COMPRESSED", "")
+        not in ("", "0", "false", "no"),
+        help="serve trie-sized tables from the path/level-compressed "
+             "poptrie layout (jaxpath.build_cpoptrie): merged skip-node "
+             "array + per-tidx joined rows — the 10M-tier working-set "
+             "layout.  Ineligible tables (wide ruleIds) fall back to "
+             "the level walk per load.  CLI beats INFW_COMPRESSED",
+    )
+    p.add_argument(
+        "--no-compressed", action="store_true",
+        help="force the per-level walk layout even when INFW_COMPRESSED "
+             "is set (the off direction of --compressed, so the CLI can "
+             "beat the env var both ways)",
+    )
+    p.add_argument(
         "--wire-codec", choices=["auto", "wire8", "delta"],
         default=os.environ.get("INFW_WIRE_CODEC") or None,
         help="H2D wire format for packed trie chunks (the --no-fused-deep "
@@ -1061,6 +1088,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         events_socket=args.events_socket or None,
         fused_deep=False if args.no_fused_deep else None,
         wire_codec=args.wire_codec,
+        compressed=False if args.no_compressed
+        else (True if args.compressed else None),
         h2d_overlap=not args.no_h2d_overlap,
         mesh=args.mesh,
     )

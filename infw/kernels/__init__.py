@@ -240,6 +240,58 @@ def _build_pallas_walk(b: int):
     return fn, (_fixture_walk_tables(), _fixture_device_batch(b))
 
 
+# -- compressed (ctrie/cwalk) fixtures/builders ------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_ctrie():
+    from . import jaxpath
+
+    r = jaxpath.device_ctrie(_fixture_tables(True))
+    if r is None:
+        raise EntrypointUnavailable(
+            "compressed layout ineligible for the canonical fixture"
+        )
+    return r
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_cwalk_tables():
+    from . import pallas_walk
+
+    built = pallas_walk.build_cwalk_tables_meta(_fixture_tables(True))
+    if built is None:
+        raise EntrypointUnavailable(
+            "fused compressed-walk tables failed to build for the "
+            "canonical fixture"
+        )
+    return built
+
+
+def _build_ctrie_wire_fused(b: int):
+    from . import jaxpath
+
+    cdev, d_max = _fixture_ctrie()
+    fn = jaxpath.jitted_classify_ctrie_wire_fused(d_max)
+    return fn, (cdev, _fixture_wire(b))
+
+
+def _build_ctrie_wire_overlay(b: int):
+    from . import jaxpath
+
+    cdev, d_max = _fixture_ctrie()
+    fn = jaxpath.jitted_classify_ctrie_wire_overlay_fused(d_max)
+    return fn, (cdev, _fixture_overlay_tables(), _fixture_wire(b))
+
+
+def _build_pallas_cwalk(b: int):
+    from . import pallas_walk
+
+    wt, meta = _fixture_cwalk_tables()
+    fn = pallas_walk.jitted_classify_cwalk(meta["d_max"], True)
+    return fn, (wt, _fixture_device_batch(b))
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -371,6 +423,16 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify/pallas-walk", "pallas", _build_pallas_walk
+        ),
+        KernelEntrypoint(
+            "classify-wire/xla-ctrie-fused", "xla", _build_ctrie_wire_fused
+        ),
+        KernelEntrypoint(
+            "classify-wire/xla-ctrie-overlay-fused", "xla",
+            _build_ctrie_wire_overlay,
+        ),
+        KernelEntrypoint(
+            "classify/pallas-cwalk", "pallas", _build_pallas_cwalk
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -193,6 +194,9 @@ def build_poptrie(tables: CompiledTables):
     cached = getattr(tables, "_poptrie_cache", None)
     if cached is not None:
         return cached
+    from ..compiler import record_build_phase
+
+    _t0 = time.perf_counter()
     slot_levels = tables.trie_levels
     strides = trie_level_strides(len(slot_levels))
     out_levels = []
@@ -262,6 +266,7 @@ def build_poptrie(tables: CompiledTables):
         out_levels.append(rows)
         targets_parts.append(lvl_targets)
     result = (out_levels, np.concatenate(targets_parts))
+    record_build_phase(tables, "build_poptrie", time.perf_counter() - _t0)
     try:
         object.__setattr__(tables, "_poptrie_cache", result)
     except (AttributeError, TypeError):
@@ -376,6 +381,716 @@ def build_joined(tables: CompiledTables):
     except (AttributeError, TypeError):
         pass
     return result
+
+
+# --- path/level-compressed poptrie (the "cpoptrie" layout) ------------------
+#
+# The per-level poptrie walk pays one HBM gather per 8-bit level — a /128
+# table is 14 deep gathers even when most of the trie is single-child
+# chains (clean /48+/24 distributions at the 10M tier are ~all chain).
+# The compressed layout merges every deep level into ONE global node
+# array and collapses single-child no-target chains into SKIP nodes
+# ("path compression": each step consumes skip_len <= 24 chain bits plus
+# its own 8-bit stride, so the effective per-step stride is 8..32 bits,
+# selected by subtree occupancy — the level-compression dual).  Node row
+# (20 x u32, 80 B — inside the flat-gather cost window):
+#
+#   [child_base, target_base, skip_len, skip_bits,
+#    child_bitmap x8, target_bitmap x8]
+#
+# Children keep poptrie's implicit contiguous numbering (BFS order), so
+# the child id is child_base + rank(nib) with no pointer gather.  Target
+# hits record a position into a flat ``targets`` array of tidx+1 values;
+# the rules tail indexes a per-TARGET joined row matrix (row t+1 =
+# [tidx+1, mask_len, packed rules] — no leaf-push duplication, so the
+# JOINED_DUP_LIMIT gate never applies to this layout).
+#
+# Only chains with NO targets compress (leaf-pushed targets pin their
+# nodes), preserving bit-exact LPM semantics: the walk is verified
+# bit-identical to trie_walk/the CPU oracle by tests/test_pallas_walk.py
+# and the statecheck equivalence engine (compressed configs).
+
+#: max chain bits absorbed into one skip node: skip + the node's own
+#: 8-bit stride stays within a 32-bit extraction window (2 ip words)
+CPOP_MAX_SKIP = 24
+
+
+class CTrieTables(NamedTuple):
+    """Compressed-poptrie device operands (see module comment above).
+
+    ``d_max`` is NOT carried here — it is a static walk-unroll bound and
+    travels through the jitted-factory cache key instead (NamedTuple
+    fields are pytree leaves)."""
+
+    l0: jax.Array        # (n0*65536, 2) int32 [cnode_id+1, tidx+1]
+    nodes: jax.Array     # (N, 20) uint32 merged skip-node rows
+    targets: jax.Array   # (1 + n_tgt,) int32 tidx+1 values (0 sentinel)
+    joined: jax.Array    # (T+1, 3+R*5) uint16 per-tidx joined rows
+    root_lut: jax.Array  # (max_if+1,) int32
+
+
+#: TEST-ONLY defect injection for the skip-node path: zero out every
+#: skip_bits word so a packet whose skipped chain bits are nonzero
+#: wrongly fails (or passes) the skip compare — the statecheck
+#: acceptance gate (tools/infw_lint.py state --inject-defect=cskip)
+#: proves the model checker catches a compressed-walk defect via oracle
+#: divergence.  Never set in production.
+_INJECT_CSKIP_BUG = False
+
+
+def _inject_cskip_bug() -> bool:
+    if _INJECT_CSKIP_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_CSKIP_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
+def _single_child_nib(rows: np.ndarray) -> np.ndarray:
+    """Slot index of the single set child-bitmap bit per node (valid
+    only where the child count is exactly 1)."""
+    cbm = rows[:, 2:10].astype(np.uint32)
+    nz = cbm != 0
+    w = np.argmax(nz, axis=1)
+    wv = cbm[np.arange(len(rows)), w].astype(np.float64)
+    # log2 is exact for single-bit values up to 2^31
+    bit = np.zeros(len(rows), np.int64)
+    pos = wv > 0
+    bit[pos] = np.log2(wv[pos]).astype(np.int64)
+    return w.astype(np.int64) * 32 + bit
+
+
+def _pc_rows(words: np.ndarray) -> np.ndarray:
+    return _popcount32(words.astype(np.uint32)).sum(axis=1).astype(np.int64)
+
+
+def _crange_concat(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate [s, s+c) ranges, vectorized (int64)."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    ends = np.cumsum(counts)
+    offs = np.repeat(starts - np.concatenate([[0], ends[:-1]]), counts)
+    return offs + np.arange(total, dtype=np.int64)
+
+
+def build_cpoptrie(tables: CompiledTables):
+    """Host transform: poptrie levels -> the merged path-compressed node
+    array.  Fully vectorized (per-level scans + a d_max-bounded BFS of
+    array concatenations — no per-node Python), so it rides the same
+    build-time budget as build_poptrie whose output it consumes.
+
+    Returns (l0, nodes, targets, d_max):
+      l0      (n0*65536, 2) int32 [cnode_id+1, tidx+1]
+      nodes   (max(N,1), 20) uint32 skip-node rows
+      targets (1 + n_tgt,) int32 tidx+1 per target position (0 sentinel)
+      d_max   int — compressed walk depth (static unroll bound)
+
+    Memoized on the tables instance (keyed with the defect-injection
+    flag so the acceptance gate cannot serve a stale clean build)."""
+    inject = _inject_cskip_bug()
+    cached = getattr(tables, "_cpoptrie_cache", None)
+    if cached is not None and cached[0] == inject:
+        return cached[1]
+    from ..compiler import record_build_phase
+
+    _t0 = time.perf_counter()
+    levels, targets = build_poptrie(tables)
+    deep = [np.asarray(l, np.uint32) for l in levels[1:]]
+    L = len(deep)
+    n_l = [d.shape[0] for d in deep]
+    cc = [_pc_rows(d[:, 2:10]) if d.size else np.zeros(0, np.int64)
+          for d in deep]
+    tc = [_pc_rows(d[:, 10:18]) if d.size else np.zeros(0, np.int64)
+          for d in deep]
+    cb_base = [d[:, 0].astype(np.int64) if d.size else np.zeros(0, np.int64)
+               for d in deep]
+    tb_base = [d[:, 1].astype(np.int64) if d.size else np.zeros(0, np.int64)
+               for d in deep]
+    nib1 = [_single_child_nib(d) if d.size else np.zeros(0, np.int64)
+            for d in deep]
+
+    # -- top-down: pending skip accumulation + the skip/emit decision ----
+    pend_len = [np.zeros(n, np.int64) for n in n_l]
+    pend_bits = [np.zeros(n, np.int64) for n in n_l]
+    skipped = []
+    for l in range(L):
+        chain = (cc[l] == 1) & (tc[l] == 0) & (l + 1 < L)
+        sk = chain & (pend_len[l] + 8 <= CPOP_MAX_SKIP)
+        skipped.append(sk)
+        if l + 1 < L and sk.any():
+            idx = np.nonzero(sk)[0]
+            ch = cb_base[l][idx]  # the single child's id at level l+1
+            ok = ch < n_l[l + 1]
+            idx, ch = idx[ok], ch[ok]
+            pend_len[l + 1][ch] = pend_len[l][idx] + 8
+            pend_bits[l + 1][ch] = (pend_bits[l][idx] << 8) | nib1[l][idx]
+
+    # -- bottom-up: resolve every node to the emitted node absorbing it --
+    res_lvl = [None] * L
+    res_id = [None] * L
+    for l in range(L - 1, -1, -1):
+        lv = np.full(n_l[l], l, np.int64)
+        ids = np.arange(n_l[l], dtype=np.int64)
+        if l + 1 < L and n_l[l + 1]:
+            ch = np.clip(cb_base[l], 0, n_l[l + 1] - 1)
+            lv = np.where(skipped[l], res_lvl[l + 1][ch], lv)
+            ids = np.where(skipped[l], res_id[l + 1][ch], ids)
+        res_lvl[l], res_id[l] = lv, ids
+
+    # -- BFS numbering: emitted nodes in (parent, slot) order so every
+    # node's children stay contiguous (the implicit-numbering contract) --
+    l0 = np.asarray(levels[0], np.int32)
+    c0 = l0[:, 0].astype(np.int64)
+    has0 = c0 > 0
+    if L and has0.any() and n_l[0]:
+        ch0 = np.clip(c0[has0] - 1, 0, n_l[0] - 1)
+        f_lvl = res_lvl[0][ch0]
+        f_id = res_id[0][ch0]
+    else:
+        f_lvl = np.zeros(0, np.int64)
+        f_id = np.zeros(0, np.int64)
+
+    rows_out: list = []
+    tgt_out: list = []
+    total = 0
+    t_total = 1  # targets[0] sentinel
+    l0_child_new = np.zeros(len(c0), np.int64)
+    first_ids = None
+    d_max = 0
+    while len(f_lvl):
+        d_max += 1
+        n_f = len(f_lvl)
+        gids = total + np.arange(n_f, dtype=np.int64)
+        total += n_f
+        if first_ids is None:
+            first_ids = gids
+        # gather per-node data (grouped by source level)
+        cc_f = np.empty(n_f, np.int64)
+        tc_f = np.empty(n_f, np.int64)
+        cb_f = np.empty(n_f, np.int64)
+        tb_f = np.empty(n_f, np.int64)
+        pl_f = np.empty(n_f, np.int64)
+        pb_f = np.empty(n_f, np.int64)
+        bm_f = np.zeros((n_f, 16), np.uint32)
+        lvl_next = np.empty(n_f, np.int64)
+        for l in np.unique(f_lvl):
+            m = f_lvl == l
+            sel = f_id[m]
+            cc_f[m] = cc[l][sel]
+            tc_f[m] = tc[l][sel]
+            cb_f[m] = cb_base[l][sel]
+            tb_f[m] = tb_base[l][sel]
+            pl_f[m] = pend_len[l][sel]
+            pb_f[m] = pend_bits[l][sel]
+            bm_f[m] = deep[l][sel, 2:18]
+            lvl_next[m] = l + 1
+        # next frontier: resolved children, whole contiguous ranges
+        child_old = _crange_concat(cb_f, cc_f)
+        child_lvl_src = np.repeat(lvl_next, cc_f)
+        nf_lvl = np.empty(len(child_old), np.int64)
+        nf_id = np.empty(len(child_old), np.int64)
+        for l in np.unique(child_lvl_src):
+            m = child_lvl_src == l
+            if l >= L or n_l[l] == 0:
+                # dead pointers below the last level: resolve to self;
+                # their bitmaps are zero so the walk never descends
+                nf_lvl[m] = l - 1
+                nf_id[m] = 0
+                continue
+            sel = np.clip(child_old[m], 0, n_l[l] - 1)
+            nf_lvl[m] = res_lvl[l][sel]
+            nf_id[m] = res_id[l][sel]
+        # rows for this step
+        excl_c = np.concatenate([[0], np.cumsum(cc_f)[:-1]]) if n_f else []
+        excl_t = np.concatenate([[0], np.cumsum(tc_f)[:-1]]) if n_f else []
+        rows = np.zeros((n_f, 20), np.uint32)
+        rows[:, 0] = (total + excl_c).astype(np.uint32)
+        rows[:, 1] = (t_total + excl_t).astype(np.uint32)
+        rows[:, 2] = pl_f.astype(np.uint32)
+        rows[:, 3] = (
+            np.zeros(n_f, np.uint32) if inject else pb_f.astype(np.uint32)
+        )
+        rows[:, 4:20] = bm_f
+        rows_out.append(rows)
+        # flat targets in node order (values are global tidx+1)
+        tgt_out.append(targets[_crange_concat(tb_f, tc_f)].astype(np.int64))
+        t_total += int(tc_f.sum())
+        f_lvl, f_id = nf_lvl, nf_id
+
+    nodes = (
+        np.concatenate(rows_out) if rows_out else np.zeros((1, 20), np.uint32)
+    )
+    new_targets = np.concatenate(
+        [np.zeros(1, np.int64)] + tgt_out
+    ).astype(np.int32)
+    l0_new = l0.copy()
+    if first_ids is not None:
+        l0_child_new[:] = 0
+        l0_child_new[np.nonzero(has0)[0]] = first_ids + 1
+        l0_new[:, 0] = l0_child_new.astype(np.int32)
+    else:
+        l0_new[:, 0] = 0
+    result = (l0_new, nodes, new_targets, d_max)
+    record_build_phase(tables, "build_cpoptrie", time.perf_counter() - _t0)
+    try:
+        object.__setattr__(tables, "_cpoptrie_cache", (inject, result))
+    except (AttributeError, TypeError):
+        pass
+    return result
+
+
+def joined_by_tidx(tables: CompiledTables):
+    """(T+1, 3 + R*5) uint16 joined rows indexed DIRECTLY by tidx+1
+    (row 0 = the UNDEF sentinel): [tidx+1 lo, tidx+1 hi, mask_len,
+    packed rules].  One row per dense entry — no leaf-push duplication,
+    so the compressed walk's rules tail never trips the joined
+    duplication gate and a rules-only edit is a scatter at positions
+    dirty_tidx + 1.  Returns None for wide (int32) rule tables.
+    Memoized on the tables instance."""
+    cached = getattr(tables, "_joined_tidx_cache", None)
+    if cached is not None:
+        return None if isinstance(cached, str) else cached
+    rules_flat = _packed_rules_flat(tables)
+    if rules_flat.dtype != np.uint16:
+        try:
+            object.__setattr__(tables, "_joined_tidx_cache", "none")
+        except (AttributeError, TypeError):
+            pass
+        return None
+    T = rules_flat.shape[0]
+    rows = np.zeros((T + 1, 3 + rules_flat.shape[1]), np.uint16)
+    tvals = np.arange(1, T + 1, dtype=np.int64)
+    rows[1:, 0] = (tvals & 0xFFFF).astype(np.uint16)
+    rows[1:, 1] = (tvals >> 16).astype(np.uint16)
+    rows[1:, 2] = np.minimum(
+        np.maximum(tables.mask_len, 0), 0xFFFF
+    ).astype(np.uint16)
+    rows[1:, 3:] = rules_flat
+    try:
+        object.__setattr__(tables, "_joined_tidx_cache", rows)
+    except (AttributeError, TypeError):
+        pass
+    return rows
+
+
+def _joined_tidx_patch_rows(
+    tables: CompiledTables, dirty: np.ndarray, rules_flat=None
+):
+    """(pos, rows) scatter payload for the per-tidx joined matrix at
+    the dirty dense rows — the ONE place the patch-side joined row
+    format [tidx+1 lo, tidx+1 hi, mask_len, packed rules] is spelled
+    out (joined_by_tidx builds the full matrix with the same layout;
+    patch_ctrie, pallas_walk.patch_cwalk_joined and the host-cache
+    seeding all scatter through here).  Returns None for wide rule
+    tables."""
+    if rules_flat is None:
+        rules_flat = _packed_rules_flat(tables)
+    if rules_flat.dtype != np.uint16:
+        return None
+    dirty = dirty[(dirty >= 0) & (dirty < rules_flat.shape[0])]
+    pos = dirty + 1
+    rows = np.zeros((len(pos), 3 + rules_flat.shape[1]), np.uint16)
+    rows[:, 0] = (pos & 0xFFFF).astype(np.uint16)
+    rows[:, 1] = (pos >> 16).astype(np.uint16)
+    rows[:, 2] = np.minimum(
+        np.maximum(np.asarray(tables.mask_len)[dirty], 0), 0xFFFF
+    ).astype(np.uint16)
+    rows[:, 3:] = rules_flat[dirty]
+    return pos, rows
+
+
+def _seed_ctrie_caches_forward(
+    old: CompiledTables, new: CompiledTables, dirty: np.ndarray
+) -> None:
+    """Carry the compressed-layout host caches from ``old`` to ``new``
+    across a RULES-ONLY edit (caller guarantees the trie is untouched):
+    the packed-rules cache is patched at the dirty rows, the
+    structural transforms (_poptrie_cache/_cpoptrie_cache/
+    _depth_lut_cache — they read trie levels and targets, never rules)
+    are shared by reference, and the per-tidx joined cache is patched
+    in place.  Without this every 1-key ctrie edit repacks the full
+    rules tensor and rebuilds the joined matrix — seconds of host work
+    at the 10M tier for a kilobyte-sized device scatter.  Best-effort:
+    any mismatch leaves a cache unset and the slow rebuild runs."""
+    if old.rules.shape != new.rules.shape:
+        return
+    try:
+        old_packed = getattr(old, "_packed_rules_cache", None)
+        if old_packed is not None and getattr(
+            new, "_packed_rules_cache", None
+        ) is None:
+            if len(dirty) == 0:
+                # nothing changed: the arrays are immutable once handed
+                # out — share by reference
+                new_packed = old_packed
+            elif old_packed.dtype == np.uint16:
+                sub = pack_rules_u16(new.rules[dirty])
+                if sub is None:
+                    return  # edit introduced wide values: full path
+                new_packed = old_packed.copy()
+                new_packed[dirty] = sub.reshape(len(dirty), -1)
+            else:
+                new_packed = old_packed.copy()
+                new_packed[dirty] = new.rules[dirty].reshape(len(dirty), -1)
+            object.__setattr__(new, "_packed_rules_cache", new_packed)
+        for name in ("_poptrie_cache", "_cpoptrie_cache",
+                     "_depth_lut_cache"):
+            c = getattr(old, name, None)
+            if c is not None and getattr(new, name, None) is None:
+                object.__setattr__(new, name, c)
+        jt = getattr(old, "_joined_tidx_cache", None)
+        if jt is not None and getattr(
+            new, "_joined_tidx_cache", None
+        ) is None:
+            if isinstance(jt, str) or len(dirty) == 0:
+                object.__setattr__(new, "_joined_tidx_cache", jt)
+            else:
+                pr = _joined_tidx_patch_rows(new, dirty)
+                if pr is not None:
+                    pos, rows = pr
+                    if len(pos) and rows.shape[1] == jt.shape[1] and (
+                        int(pos.max()) < jt.shape[0]
+                    ):
+                        jn = jt.copy()
+                        jn[pos] = rows
+                        object.__setattr__(new, "_joined_tidx_cache", jn)
+    except (AttributeError, TypeError, ValueError, IndexError):
+        return
+
+
+def hint_trie_unchanged(hint) -> bool:
+    """True when the dirty hint proves the edit was rules-only (no trie
+    level rows touched) — the condition for cache seeding, the joined
+    fast path, and for a no-hint patch retry to behave differently from
+    the hinted attempt."""
+    return hint is not None and all(
+        len(h) == 0 for h in hint.get("levels", [np.zeros(1)])
+    )
+
+
+def seed_ctrie_caches_forward(
+    old: CompiledTables, new: CompiledTables, hint
+) -> None:
+    """Backend-facing wrapper: seed the compressed-layout host caches
+    when the dirty hint proves the trie untouched.  Must run BEFORE
+    any eligibility probe touches ``new`` — joined_by_tidx and
+    check_wire_ruleids memoize on first touch, so seeding after the
+    fact is too late."""
+    if not hint_trie_unchanged(hint):
+        return
+    dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+    dirty = dirty[(dirty >= 0) & (dirty < new.rules.shape[0])]
+    _seed_ctrie_caches_forward(old, new, dirty)
+
+
+def device_ctrie(
+    tables: CompiledTables, device=None, pad: bool = False
+) -> Optional[Tuple[CTrieTables, int]]:
+    """Upload the compressed-poptrie layout; returns (CTrieTables,
+    d_max) or None when the layout cannot serve this table (wide int32
+    rules).  ``pad=True`` buckets the node/target/joined row counts so
+    small structural edits can diff-scatter (patch_ctrie) instead of
+    re-uploading; padding rows are all-zero and unreachable (bitmaps 0,
+    tidx+1 bounds)."""
+    joined = joined_by_tidx(tables)
+    if joined is None:
+        return None
+    l0, nodes, targets, d_max = build_cpoptrie(tables)
+    root_lut = np.asarray(tables.root_lut, np.int32)
+    if pad:
+        nodes = _pad_rows(nodes, _row_bucket(nodes.shape[0]))
+        targets = _pad_rows(targets, _row_bucket(targets.shape[0]))
+        joined = _pad_rows(joined, _row_bucket(joined.shape[0]))
+        root_lut = _pad_rows(root_lut, _row_bucket(root_lut.shape[0]))
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+    return CTrieTables(
+        l0=put(l0),
+        nodes=put(nodes),
+        targets=put(targets),
+        joined=put(joined),
+        root_lut=put(root_lut),
+    ), d_max
+
+
+def _ctrie_host_layout(tables: CompiledTables):
+    """Unpadded host arrays in device_ctrie order (the patch diff
+    source), or None for wide tables."""
+    joined = joined_by_tidx(tables)
+    if joined is None:
+        return None
+    l0, nodes, targets, d_max = build_cpoptrie(tables)
+    return (l0, nodes, targets, joined,
+            np.asarray(tables.root_lut, np.int32)), d_max
+
+
+def patch_ctrie(
+    cdev: CTrieTables,
+    old: CompiledTables,
+    new: CompiledTables,
+    device=None,
+    hint=None,
+) -> Optional[Tuple[CTrieTables, int]]:
+    """Incremental device update of the compressed layout.
+
+    Rules-only edits (dirty hint proves the trie untouched) scatter
+    exactly the dirty tidx rows of the per-target joined matrix —
+    kilobytes, positions are dirty_tidx + 1 by construction.  Structural
+    edits diff the old/new host cpoptrie arrays row-wise (same
+    _patch_array machinery as the poptrie path).  Returns
+    (patched, rows_changed) or None when the layout shifted beyond the
+    row buckets (caller re-uploads)."""
+    if hint_trie_unchanged(hint):
+        dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+        dirty = dirty[(dirty >= 0) & (dirty < new.rules.shape[0])]
+        # seed the host caches FIRST so the payload below patches the
+        # carried packed-rules cache instead of repacking the full
+        # tensor (the level walk's _seed_caches_forward contract)
+        _seed_ctrie_caches_forward(old, new, dirty)
+        pr = _joined_tidx_patch_rows(new, dirty)
+        if pr is None:
+            return None
+        pos, rows = pr
+        if len(pos) == 0:
+            return cdev, 0
+        if int(pos.max()) >= cdev.joined.shape[0]:
+            return None
+        if rows.shape[1] != cdev.joined.shape[1]:
+            return None
+        joined = _capped_scatter(cdev.joined, pos, rows, device)
+        if joined is None:
+            return None
+        return cdev._replace(joined=joined), len(pos)
+    o = _ctrie_host_layout(old)
+    nw = _ctrie_host_layout(new)
+    if o is None or nw is None:
+        return None
+    (o_arrs, _od), (n_arrs, _nd) = o, nw
+    if _od != _nd:
+        return None  # static unroll depth changed: re-jit + re-upload
+    out = []
+    total = 0
+    for dl, ol, nl in zip(cdev, o_arrs, n_arrs):
+        if dl.shape[0] % 65536 == 0 and ol.shape[1:] == (2,):
+            # l0 is not bucket-shaped; diff it with an exact-shape check
+            if ol.shape != nl.shape or dl.shape != ol.shape:
+                return None
+            changed = np.nonzero((ol != nl).any(axis=1))[0]
+            if len(changed) == 0:
+                out.append(dl)
+                continue
+            if len(changed) > max(dl.shape[0] // 4, 1):
+                return None
+            patched = _capped_scatter(dl, changed, nl[changed], device)
+            if patched is None:
+                return None
+            out.append(patched)
+            total += len(changed)
+            continue
+        p = _patch_array(dl, ol, nl, device)
+        if p is None:
+            return None
+        out.append(p[0])
+        total += p[1]
+    return CTrieTables(*out), total
+
+
+def extract_ip_bits(ip_words: jax.Array, pos: jax.Array, n: jax.Array):
+    """(B,) values of the ``n`` bits at absolute bit offset ``pos``
+    (both dynamic per lane, n <= 32, window spans <= 2 words) of the
+    128-bit address (4 big-endian u32 words, bit 0 = MSB of word 0).
+    Pure u32 VPU math — the word pick is selects, not a gather (a
+    take_along_axis here lowers to a per-lane gather per step, measured
+    ~10x slower in the cpoptrie prototype)."""
+    w = jnp.clip(pos >> 5, 0, 4).astype(jnp.int32)
+    zeros = jnp.zeros_like(ip_words[:, 0])
+
+    def pick(widx):
+        out = zeros
+        for k in range(4):
+            out = jnp.where(widx == k, ip_words[:, k], out)
+        return out
+
+    lo = pick(w).astype(jnp.uint32)
+    hi = pick(w + 1).astype(jnp.uint32)
+    off = (pos & 31).astype(jnp.uint32)
+    n = n.astype(jnp.uint32)
+    hi_part = jnp.where(off == 0, jnp.uint32(0), hi >> (jnp.uint32(32) - off))
+    top32 = (lo << off) | hi_part
+    return jnp.where(n == 0, jnp.uint32(0), top32 >> (jnp.uint32(32) - n))
+
+
+def ctrie_walk_rows(
+    cdev: CTrieTables, batch: DeviceBatch, d_max: int
+) -> jax.Array:
+    """The compressed walk: DIR-16 root gather, then ``d_max`` steps over
+    the ONE merged node array — each step checks the node's skip chain
+    (path-compressed bits must match the address), consumes its 8-bit
+    stride, and rank-indexes the contiguous children.  Returns the
+    (B, 3 + R*5) per-tidx joined rows (row 0 / dead lanes all-zero ->
+    UNDEF), bit-identical in verdict semantics to trie_walk_joined."""
+    l0, nodes, targets, joined, root_lut = cdev
+    lut_size = root_lut.shape[0]
+    if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
+    root = jnp.where(
+        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
+    )
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    e0 = root * 65536 + nib0
+    in0 = (e0 >= 0) & (e0 < l0.shape[0])
+    rows0 = jnp.take(l0, e0, axis=0, mode="clip")
+    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)  # tidx+1
+    alive = in0 & (rows0[:, 0] > 0)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    pos = jnp.full_like(node, 16)
+    cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    widx8 = jnp.arange(8, dtype=jnp.int32)[None, :]
+    win = jnp.zeros_like(node)  # flat target position (0 = sentinel)
+
+    for _step in range(d_max):
+        in_n = (node >= 0) & (node < nodes.shape[0])
+        alive = alive & in_n
+        r = jnp.take(nodes, node, axis=0, mode="clip")
+        skip_len = r[:, 2].astype(jnp.int32)
+        skip_ok = jnp.where(
+            skip_len > 0,
+            extract_ip_bits(batch.ip_words, pos, skip_len) == r[:, 3],
+            True,
+        )
+        alive = alive & skip_ok
+        pos = pos + skip_len
+        nib = extract_ip_bits(
+            batch.ip_words, pos, jnp.full_like(pos, 8)
+        ).astype(jnp.int32)
+        pos = pos + 8
+        w = (nib >> 5)[:, None]
+        below = (np.uint32(1) << (nib & 31).astype(jnp.uint32)) - 1
+        cb = r[:, 4:12]
+        tb = r[:, 12:20]
+        pc_cb = _popcount32(cb)
+        pc_tb = _popcount32(tb)
+        prefix = jnp.sum(jnp.where(widx8 < w, pc_cb, 0), axis=1)
+        tprefix = jnp.sum(jnp.where(widx8 < w, pc_tb, 0), axis=1)
+        cw = jnp.sum(jnp.where(widx8 == w, cb, 0), axis=1)
+        tw = jnp.sum(jnp.where(widx8 == w, tb, 0), axis=1)
+        bit = (nib & 31).astype(jnp.uint32)
+        ok_t = alive & (((tw >> bit) & 1) > 0) & (pos <= cap_bits)
+        win = jnp.where(
+            ok_t,
+            (r[:, 1] + tprefix + _popcount32(tw & below)).astype(jnp.int32),
+            win,
+        )
+        alive = alive & (((cw >> bit) & 1) > 0)
+        node = jnp.where(
+            alive,
+            (r[:, 0] + prefix + _popcount32(cw & below)).astype(jnp.int32),
+            0,
+        )
+
+    in_w = (win >= 0) & (win < targets.shape[0])
+    tval = jnp.where(in_w, jnp.take(targets, jnp.clip(win, 0), mode="clip"), 0)
+    sel = jnp.where(tval > 0, tval, best0)  # tidx+1 (0 = no match)
+    in_j = (sel > 0) & (sel < joined.shape[0])
+    rows = jnp.take(
+        joined, jnp.clip(sel, 0, joined.shape[0] - 1), axis=0, mode="clip"
+    )
+    return jnp.where(in_j[:, None], rows, 0)
+
+
+def _ctrie_result_and_score(cdev: CTrieTables, batch: DeviceBatch, d_max: int):
+    rows = ctrie_walk_rows(cdev, batch, d_max)
+    matched = (
+        rows[:, 0].astype(jnp.int32) | (rows[:, 1].astype(jnp.int32) << 16)
+    ) > 0
+    score = jnp.where(matched, rows[:, 2].astype(jnp.int32) + 1, 0)
+    return rule_scan(joined_rule_rows(rows), batch), score
+
+
+def classify_ctrie(
+    cdev: CTrieTables, batch: DeviceBatch, *, d_max: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full forward pass via the compressed walk; verdict-identical to
+    classify(use_trie=True) on the same tables."""
+    raw, _score = _ctrie_result_and_score(cdev, batch, d_max)
+    return finalize(raw, batch)
+
+
+def classify_ctrie_with_overlay(
+    cdev: CTrieTables,
+    overlay: DeviceTables,
+    batch: DeviceBatch,
+    *,
+    d_max: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compressed-walk classify combined with the dense overlay
+    side-table (same longest-prefix combine as classify_with_overlay)."""
+    raw_m, score_m = _ctrie_result_and_score(cdev, batch, d_max)
+    raw_o, score_o = _raw_result_and_score(overlay, batch, use_trie=False)
+    result = jnp.where(score_o > score_m, raw_o, raw_m)
+    return finalize(result, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_ctrie(d_max: int):
+    return jax.jit(functools.partial(classify_ctrie, d_max=d_max))
+
+
+def classify_ctrie_wire(
+    cdev: CTrieTables, wire: jax.Array, *, d_max: int
+) -> Tuple[jax.Array, jax.Array]:
+    res, _xdp, stats = classify_ctrie(cdev, unpack_wire(wire), d_max=d_max)
+    return res.astype(jnp.uint16), stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_ctrie_wire_fused(d_max: int):
+    def f(cdev: CTrieTables, wire: jax.Array) -> jax.Array:
+        return fuse_wire_outputs(*classify_ctrie_wire(cdev, wire, d_max=d_max))
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_ctrie_wire_overlay_fused(d_max: int):
+    def f(cdev: CTrieTables, overlay: DeviceTables, wire: jax.Array):
+        res, _xdp, stats = classify_ctrie_with_overlay(
+            cdev, overlay, unpack_wire(wire), d_max=d_max
+        )
+        return fuse_wire_outputs(res.astype(jnp.uint16), stats)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_ctrie_wire8_fused(d_max: int, overlay: bool):
+    """wire8 (8 B/packet) launch over the compressed layout: same
+    res16-only packed D2H contract as jitted_classify_wire8_fused.  The
+    compressed walk needs no v4 depth truncation — the per-lane cap_bits
+    gate bounds v4 descent inside the one merged node array."""
+    if overlay:
+        def f(cdev, ov, wire, ifmap):
+            res, _x, _s = classify_ctrie_with_overlay(
+                cdev, ov, unpack_wire8(wire, ifmap), d_max=d_max
+            )
+            return _pack_res16(res.astype(jnp.uint16))
+    else:
+        def f(cdev, wire, ifmap):
+            res, _x, _s = classify_ctrie(
+                cdev, unpack_wire8(wire, ifmap), d_max=d_max
+            )
+            return _pack_res16(res.astype(jnp.uint16))
+
+    return jax.jit(f)
+
+
+def warm_ctrie_patch_scatters(cdev: CTrieTables, device=None) -> None:
+    """Pre-compile the compressed layout's patch scatters (the
+    warm_patch_scatters analogue): nodes/targets/joined/root_lut are the
+    bucket-padded patchable arrays; l0 patches through its own
+    exact-shape diff, which shares the same capped executables."""
+    warm_scatters(
+        (cdev.nodes, cdev.targets, cdev.joined, cdev.root_lut, cdev.l0),
+        device,
+    )
 
 
 def _seed_caches_forward(
@@ -620,8 +1335,13 @@ def device_tables(
         field) and upcast on device.
     The resident DeviceTables is bit-identical to a direct upload — the
     patch path diffs against it with no knowledge of how it traveled."""
+    from ..compiler import record_build_phase
+
+    _t0 = time.perf_counter()
     (key_words, mask_words, mask_len, rules, trie_levels, trie_targets,
      root_lut, joined) = _host_device_layout(tables, pad)
+    record_build_phase(tables, "upload/host-layout", time.perf_counter() - _t0)
+    _t0 = time.perf_counter()
     put = lambda a: jax.device_put(jnp.asarray(a), device)
 
     # -- trie levels: sparse scatter below the density limit (the DIR-16
@@ -658,6 +1378,7 @@ def device_tables(
         root_lut=put(root_lut),
         num_entries=put(np.int32(tables.num_entries)),
     )
+    record_build_phase(tables, "upload/device-put", time.perf_counter() - _t0)
     if pad:
         # same permanent contract the patch path enforces: a padded
         # upload IS the layout every later patch diffs against
@@ -890,9 +1611,7 @@ def patch_device_tables(
     # the dirty hint proves it (its level lists track slot-space repush
     # writes), so the poptrie transform AND the per-level diffs are
     # skipped entirely and the resident level arrays carry over.
-    trie_unchanged = hint is not None and all(
-        len(h) == 0 for h in hint.get("levels", [np.zeros(1)])
-    )
+    trie_unchanged = hint_trie_unchanged(hint)
     if trie_unchanged:
         # Seed the NEW generation's host caches from the old one BEFORE
         # any layout call: without this, every patched generation rebuilt

@@ -78,10 +78,13 @@ from ..constants import (
 )
 from .jaxpath import (
     DeviceBatch,
+    _crange_concat,
+    build_cpoptrie,
     build_depth_lut,
     build_poptrie,
     finalize,
     fuse_wire_outputs,
+    joined_by_tidx,
     joined_layout,
     unpack_wire,
 )
@@ -133,16 +136,6 @@ class WalkTables(NamedTuple):
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
-
-
-def _range_concat(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
-    """Vectorized concatenate of [s, s+c) ranges (int64)."""
-    total = int(counts.sum())
-    if total == 0:
-        return np.zeros(0, np.int64)
-    ends = np.cumsum(counts)
-    offs = np.repeat(starts - np.concatenate([[0], ends[:-1]]), counts)
-    return offs + np.arange(total, dtype=np.int64)
 
 
 def _split_level_rows(rows: np.ndarray) -> np.ndarray:
@@ -233,9 +226,9 @@ def _extract_deep_tail(l0, deep_levels, joined_u16, lut, min_depth):
         ccount = _popcount_np(cb_words).sum(axis=1)
         tcount = _popcount_np(tb_words).sum(axis=1)
         # children of kept nodes (whole contiguous ranges) survive
-        keep_next = _range_concat(sub[:, 0], ccount)
+        keep_next = _crange_concat(sub[:, 0], ccount)
         # target ranges of kept nodes stay reachable positions
-        tr = _range_concat(sub[:, 1], tcount)
+        tr = _crange_concat(sub[:, 1], tcount)
         keep_pos[tr[(tr >= 0) & (tr < n_pos)]] = True
         # renumber: kept nodes in old order; child_base = exclusive
         # cumsum of kept children counts (ranges are disjoint + ordered)
@@ -727,3 +720,384 @@ def jitted_classify_walk_wire_fused(interpret: bool, block_b: int = BLOCK_B):
 def default_interpret() -> bool:
     """Interpret mode everywhere except real TPU backends."""
     return jax.default_backend() != "tpu"
+
+
+# --- fused COMPRESSED walk (skip-node descent over the merged cpoptrie) -----
+#
+# The compressed layout (jaxpath.build_cpoptrie) merges every deep level
+# into one node array with path-compressed skip nodes, so the deep
+# descent is d_max steps (5-7 on the 1M adversarial tables vs 14
+# levels) over ONE VMEM-resident byte-plane matrix.  Each step must
+# track a DYNAMIC per-lane bit position (skips advance lanes unevenly),
+# so the nibble extraction is select-based in-kernel math over the 4 ip
+# words — the same formulation the XLA walk uses (extract_ip_bits).
+#
+# Tail mode is POSITIONS-only: the kernel emits the winning flat target
+# position; the rules tail is one XLA targets resolve + one fat-row
+# gather from the per-tidx joined matrix in HBM (no duplication, so the
+# matrix is exactly T+1 rows) feeding the shared ordered scan.  A fused
+# in-kernel tail would need the (T+1)-row joined planes VMEM-resident —
+# the wrong trade at the 1M/10M tiers this layout exists for.
+
+CNODE_ROW_BYTES = 80  # 20 u32: bases, skip, 8+8 bitmap words
+
+
+class CWalkTables(NamedTuple):
+    """Fused compressed-walk device operands.  ``d_max`` travels in the
+    builder meta / the jitted-factory cache key (static unroll)."""
+
+    l0: jax.Array         # (n0*65536, 2) int32 (extraction-remapped)
+    root_lut: jax.Array   # (max_if+1,) int32
+    nodes: jax.Array      # (N_pad, 128) int8 biased byte planes
+    targets: jax.Array    # (1 + n_tgt,) int32 tidx+1 values
+    joined: jax.Array     # (T+1, 3+R*5) uint16 per-tidx rows (HBM)
+
+
+def _split_cnode_rows(rows: np.ndarray) -> np.ndarray:
+    """(n, 20) u32 skip-node rows -> (n_pad, 128) int8 biased byte
+    planes (80 LE bytes used)."""
+    n = rows.shape[0]
+    n_pad = _round_up(max(n, 1), 128)
+    raw = np.zeros((n_pad, LEVEL_ROW_PAD), np.uint8)
+    if n:
+        raw[:n, :CNODE_ROW_BYTES] = np.ascontiguousarray(
+            rows.astype("<u4")
+        ).view(np.uint8).reshape(n, CNODE_ROW_BYTES)
+    return (raw.astype(np.int16) - 128).astype(np.int8)
+
+
+def _extract_cwalk_tail(l0, nodes, targets, lut, min_depth):
+    """Deep-class extraction on the MERGED node array: keep the subtree
+    closure of root slots whose depth-LUT requirement exceeds
+    ``min_depth``.  Children of kept nodes are whole contiguous ranges
+    and consecutive in the BFS numbering, so compaction is one
+    cumsum-renumber; target ranges compact the same way.  Unkept l0
+    slots zero out (mis-steered packets deterministically read UNDEF).
+    Returns (l0_new, nodes_new, targets_new, d_max_new)."""
+    N = nodes.shape[0]
+    keep = np.zeros(N, bool)
+    c0 = l0[:, 0].astype(np.int64)
+    slot_keep = lut > min_depth
+    slot_idx = np.nonzero(slot_keep)[0]
+    roots = c0[slot_idx]
+    roots = roots[roots > 0] - 1
+    frontier = np.unique(roots[roots < N])
+    cb = nodes[:, 0].astype(np.int64)
+    cc = _popcount_np(nodes[:, 4:12].astype(np.uint32)).sum(axis=1)
+    tb = nodes[:, 1].astype(np.int64)
+    tc = _popcount_np(nodes[:, 12:20].astype(np.uint32)).sum(axis=1)
+    d_max = 0
+    while len(frontier):
+        d_max += 1
+        keep[frontier] = True
+        nxt = _crange_concat(cb[frontier], cc[frontier])
+        nxt = nxt[(nxt >= 0) & (nxt < N)]
+        frontier = nxt  # BFS ranges are disjoint: no re-visit possible
+    kept = np.nonzero(keep)[0]
+    node_map = np.cumsum(keep) - 1  # old id -> new id (valid where kept)
+    # target compaction: kept nodes' ranges, plus the position-0 sentinel
+    n_t = targets.shape[0]
+    keep_t = np.zeros(n_t, bool)
+    keep_t[0] = True
+    tr = _crange_concat(tb[kept], tc[kept])
+    keep_t[tr[(tr >= 0) & (tr < n_t)]] = True
+    t_map = np.cumsum(keep_t) - 1
+    nodes_new = nodes[kept].copy() if len(kept) else np.zeros(
+        (1, 20), np.uint32
+    )
+    if len(kept):
+        nodes_new[:, 0] = np.where(
+            cc[kept] > 0,
+            node_map[np.clip(cb[kept], 0, N - 1)],
+            0,
+        ).astype(np.uint32)
+        nodes_new[:, 1] = t_map[np.clip(tb[kept], 0, n_t - 1)].astype(
+            np.uint32
+        )
+    targets_new = targets[keep_t]
+    l0_new = np.zeros_like(l0)
+    if len(slot_idx):
+        ch = c0[slot_idx]
+        ok = (ch > 0) & (ch <= N)
+        mapped = np.where(
+            ok & keep[np.clip(ch - 1, 0, N - 1)],
+            node_map[np.clip(ch - 1, 0, N - 1)] + 1,
+            0,
+        )
+        l0_new[slot_idx, 0] = mapped.astype(np.int32)
+        l0_new[slot_idx, 1] = l0[slot_idx, 1]
+    return l0_new, nodes_new, targets_new, d_max
+
+
+def build_cwalk_tables_meta(
+    tables: CompiledTables,
+    min_depth: Optional[int] = None,
+    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+    device=None,
+):
+    """Host transform CompiledTables -> (CWalkTables, meta) for the
+    fused compressed walk, or None when the layout cannot serve this
+    table (wide int32 rules, VMEM budget exceeded even after path
+    compression) — callers fall back to the XLA compressed walk, then
+    to the level walk (never a refusal, never a wrong verdict).
+
+    ``min_depth`` enables deep-class extraction exactly like
+    build_walk_tables_meta; the depth LUT is in LEVEL terms, which is
+    conservative for the compressed structure (compression only shrinks
+    the step count under a root slot, never grows it)."""
+    joined = joined_by_tidx(tables)
+    if joined is None:
+        return None
+    l0, nodes, targets, d_max = build_cpoptrie(tables)
+    l0 = np.asarray(l0, np.int32)
+    if min_depth is not None and min_depth >= 0:
+        lut = build_depth_lut(tables)
+        l0, nodes, targets, d_max = _extract_cwalk_tail(
+            l0, nodes, targets, lut, min_depth
+        )
+    node_bytes = _split_cnode_rows(nodes)
+    # resident: node planes; transient: the (Bb, N_pad) int8 one-hot
+    vmem = node_bytes.size + BLOCK_B * max(node_bytes.shape[0], 1)
+    if vmem > vmem_budget:
+        return None
+    put = lambda a: jax.device_put(jnp.asarray(a), device)
+    wt = CWalkTables(
+        l0=put(l0),
+        root_lut=put(np.asarray(tables.root_lut, np.int32)),
+        nodes=put(node_bytes),
+        targets=put(np.asarray(targets, np.int32)),
+        joined=put(joined),
+    )
+    meta = {
+        "min_depth": min_depth,
+        "d_max": int(d_max),
+        "vmem_bytes": int(vmem),
+        "tail": "positions",
+        "tidx_sorted": np.unique(targets[targets > 0] - 1),
+    }
+    return wt, meta
+
+
+def patch_cwalk_joined(
+    wt: CWalkTables, meta, tables: CompiledTables, dirty_tidx, device=None
+) -> Optional[CWalkTables]:
+    """RULES-ONLY incremental update of the per-tidx joined matrix:
+    positions are dirty_tidx + 1 by construction (no position map
+    needed — the tidx indexing is the whole point), through the shared
+    capped scatter.  Returns the patched CWalkTables or None when the
+    packed layout changed (caller rebuilds)."""
+    from .jaxpath import _capped_scatter, _joined_tidx_patch_rows
+
+    dirty = np.unique(np.asarray(dirty_tidx, np.int64))
+    pr = _joined_tidx_patch_rows(tables, dirty)
+    if pr is None:
+        return None
+    pos, rows = pr
+    if len(pos) == 0:
+        return wt
+    if int(pos.max()) >= wt.joined.shape[0]:
+        return None
+    if rows.shape[1] != wt.joined.shape[1]:
+        return None
+    joined = _capped_scatter(wt.joined, pos, rows, device)
+    return None if joined is None else wt._replace(joined=joined)
+
+
+def warm_cwalk_patch_scatters(wt: CWalkTables, device=None) -> None:
+    """warm_walk_patch_scatters for the compressed walk: the per-tidx
+    joined matrix is its only patchable plane (trie edits rebuild)."""
+    from .jaxpath import warm_scatters
+
+    warm_scatters((wt.joined,), device)
+
+
+def _make_cwalk_kernel(d_max: int):
+    def kernel(meta_ref, words_ref, nodes_ref, out_ref):
+        Bb = meta_ref.shape[0]
+        node = meta_ref[:, 0:1]            # -1 = dead lane
+        alive = meta_ref[:, 1:2]           # {0, 1}
+        kind = meta_ref[:, 3:4]
+        cap = jnp.where(kind == KIND_IPV4, 32, 128)
+        node = jnp.where(alive > 0, node, -1)
+        pos = jnp.full((Bb, 1), 16, jnp.int32)
+        win = jnp.zeros((Bb, 1), jnp.int32)
+        zeros = jnp.zeros((Bb, 1), jnp.int32)
+
+        def extract(p, n):
+            """n bits at dynamic bit offset p of the 128-bit address
+            (select-based word pick; logical shifts on int32 lanes)."""
+            w = jax.lax.shift_right_logical(p, 5)
+            lo = zeros
+            hi = zeros
+            for k in range(4):
+                wc = words_ref[:, k : k + 1]
+                lo = jnp.where(w == k, wc, lo)
+                hi = jnp.where(w + 1 == k, wc, hi)
+            off = p & 31
+            hi_part = jnp.where(
+                off == 0, 0, jax.lax.shift_right_logical(hi, 32 - off)
+            )
+            top32 = jax.lax.shift_left(lo, off) | hi_part
+            return jnp.where(
+                n == 0, 0, jax.lax.shift_right_logical(top32, 32 - n)
+            )
+
+        dn = (((1,), (0,)), ((), ()))
+        n_nodes = nodes_ref.shape[0]
+        for _step in range(d_max):
+            iota_n = jax.lax.broadcasted_iota(jnp.int32, (Bb, n_nodes), 1)
+            onehot = (iota_n == node).astype(jnp.int8)
+            live = node >= 0
+            rowb = jax.lax.dot_general(
+                onehot, nodes_ref[:, :], dn, preferred_element_type=jnp.int32
+            ) + jnp.where(live, 128, 0)
+
+            def u32(c, _r=rowb):
+                return (
+                    _r[:, c : c + 1]
+                    | (_r[:, c + 1 : c + 2] << 8)
+                    | (_r[:, c + 2 : c + 3] << 16)
+                    | (_r[:, c + 3 : c + 4] << 24)
+                )
+
+            child_base = u32(0)
+            target_base = u32(4)
+            skip_len = u32(8)
+            skip_bits = u32(12)
+            skip_ok = jnp.where(
+                skip_len > 0, extract(pos, skip_len) == skip_bits, True
+            )
+            live = live & skip_ok
+            pos = pos + skip_len
+            nib = extract(pos, jnp.full((Bb, 1), 8, jnp.int32))
+            pos = pos + 8
+            w = nib >> 5
+            bit = nib & 31
+            below = jnp.left_shift(1, bit) - 1
+            prefix = zeros
+            tprefix = zeros
+            cw = zeros
+            tw = zeros
+            for j in range(8):
+                cb_j = u32(16 + 4 * j)
+                tb_j = u32(48 + 4 * j)
+                prefix = prefix + jnp.where(w > j, _pc32(cb_j), 0)
+                tprefix = tprefix + jnp.where(w > j, _pc32(tb_j), 0)
+                cw = jnp.where(w == j, cb_j, cw)
+                tw = jnp.where(w == j, tb_j, tw)
+            tbit = jax.lax.shift_right_logical(tw, bit) & 1
+            ok_t = live & (tbit > 0) & (cap >= pos)
+            win = jnp.where(
+                ok_t, target_base + tprefix + _pc32(tw & below), win
+            )
+            cbit = jax.lax.shift_right_logical(cw, bit) & 1
+            node = jnp.where(
+                live & (cbit > 0),
+                child_base + prefix + _pc32(cw & below),
+                -1,
+            )
+
+        out_ref[:, 0:1] = zeros
+        out_ref[:, 1:2] = win
+
+    return kernel
+
+
+def _cwalk_scan(
+    meta: jax.Array, words: jax.Array, wt: CWalkTables, d_max: int,
+    interpret: bool, block_b: int,
+) -> jax.Array:
+    B = meta.shape[0]
+    full = lambda a: pl.BlockSpec(a.shape, lambda i: (0, 0))
+    return pl.pallas_call(
+        _make_cwalk_kernel(d_max),
+        out_shape=jax.ShapeDtypeStruct((B, 2), jnp.int32),
+        grid=(B // block_b,),
+        in_specs=[
+            pl.BlockSpec((block_b, 8), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, 4), lambda i: (i, 0)),
+            full(wt.nodes),
+        ],
+        out_specs=pl.BlockSpec((block_b, 2), lambda i: (i, 0)),
+        interpret=interpret,
+    )(meta, words, wt.nodes)
+
+
+def classify_cwalk(
+    wt: CWalkTables, batch: DeviceBatch, *, d_max: int,
+    interpret: bool = False, block_b: int = BLOCK_B,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full forward pass via the fused compressed walk; identical to
+    jaxpath.classify_ctrie for every packet the walk tables cover (all
+    packets when built with min_depth=None; the deep steering class
+    under extraction)."""
+    from .jaxpath import joined_rule_rows, rule_scan
+
+    B = batch.kind.shape[0]
+    node, alive, best0 = _root_stage(wt.l0, wt.root_lut, batch)
+    meta = jnp.stack(
+        [
+            node, alive, best0, batch.kind,
+            jnp.zeros_like(node), jnp.zeros_like(node),
+            jnp.zeros_like(node), jnp.zeros_like(node),
+        ],
+        axis=1,
+    ).astype(jnp.int32)
+    words = batch.ip_words.astype(jnp.int32)
+    Bp = _round_up(max(B, 1), block_b)
+    if Bp != B:
+        pad = Bp - B
+        pad_meta = jnp.zeros((pad, 8), jnp.int32)
+        pad_meta = pad_meta.at[:, 0].set(-1).at[:, 3].set(KIND_OTHER)
+        meta = jnp.concatenate([meta, pad_meta], axis=0)
+        words = jnp.concatenate([words, jnp.zeros((pad, 4), jnp.int32)], axis=0)
+    out = _cwalk_scan(meta, words, wt, d_max, interpret, block_b)[:B]
+    win = out[:, 1]
+    n_t = wt.targets.shape[0]
+    in_w = (win >= 0) & (win < n_t)
+    tval = jnp.where(
+        in_w, jnp.take(wt.targets, jnp.clip(win, 0), mode="clip"), 0
+    )
+    sel = jnp.where(tval > 0, tval, best0)  # tidx+1
+    P = wt.joined.shape[0]
+    in_j = (sel > 0) & (sel < P)
+    rows = jnp.take(
+        wt.joined, jnp.clip(sel, 0, P - 1), axis=0, mode="clip"
+    )
+    rows = jnp.where(in_j[:, None], rows, 0)
+    raw = rule_scan(joined_rule_rows(rows), batch)
+    return finalize(raw, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_cwalk(d_max: int, interpret: bool,
+                          block_b: int = BLOCK_B):
+    return jax.jit(
+        functools.partial(
+            classify_cwalk, d_max=d_max, interpret=interpret, block_b=block_b
+        )
+    )
+
+
+def classify_cwalk_wire(
+    wt: CWalkTables, wire: jax.Array, *, d_max: int,
+    interpret: bool = False, block_b: int = BLOCK_B,
+) -> Tuple[jax.Array, jax.Array]:
+    res, _xdp, stats = classify_cwalk(
+        wt, unpack_wire(wire), d_max=d_max, interpret=interpret,
+        block_b=block_b,
+    )
+    return res.astype(jnp.uint16), stats
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_cwalk_wire_fused(d_max: int, interpret: bool,
+                                     block_b: int = BLOCK_B):
+    def f(wt: CWalkTables, wire: jax.Array) -> jax.Array:
+        return fuse_wire_outputs(
+            *classify_cwalk_wire(
+                wt, wire, d_max=d_max, interpret=interpret, block_b=block_b
+            )
+        )
+
+    return jax.jit(f)

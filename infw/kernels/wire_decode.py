@@ -47,6 +47,8 @@ from .jaxpath import (
     DeviceTables,
     _pack_res16,
     classify,
+    classify_ctrie,
+    classify_ctrie_with_overlay,
     classify_with_overlay,
     v4_trie_depth,
 )
@@ -291,5 +293,36 @@ def jitted_classify_delta_fused(
     else:
         def f(tables, payload, dict_vals, ifmap):
             return classify_delta(tables, payload, dict_vals, ifmap, **kw)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_delta_ctrie_fused(
+    overlay: bool, d_max: int, n: int, dict_mode: int, fixed_w: int,
+    use_pallas: bool = False, interpret: bool = False,
+):
+    """Delta decode + COMPRESSED-layout classify in one program: the
+    backend's ctrie path rides the same ~4-6 B/packet wire as the level
+    walk.  No v4 depth truncation — the compressed walk's per-lane
+    cap_bits gate bounds v4 descent."""
+    kw = dict(n=n, dict_mode=dict_mode, fixed_w=fixed_w,
+              use_pallas=use_pallas, interpret=interpret)
+
+    def decode(payload, dict_vals, ifmap):
+        return decode_delta(payload, dict_vals, ifmap, **kw)
+
+    if overlay:
+        def f(cdev, ov, payload, dict_vals, ifmap):
+            res, _x, _s = classify_ctrie_with_overlay(
+                cdev, ov, decode(payload, dict_vals, ifmap), d_max=d_max
+            )
+            return _pack_res16(res.astype(jnp.uint16))
+    else:
+        def f(cdev, payload, dict_vals, ifmap):
+            res, _x, _s = classify_ctrie(
+                cdev, decode(payload, dict_vals, ifmap), d_max=d_max
+            )
+            return _pack_res16(res.astype(jnp.uint16))
 
     return jax.jit(f)

@@ -25,7 +25,10 @@ def test_registry_covers_the_dispatch_surface():
     # the static gate)
     assert {"classify/xla-dense", "classify/xla-trie",
             "classify-wire/xla-trie-fused", "wire-decode/delta-fused",
-            "classify/pallas-dense", "classify/pallas-walk"} <= names
+            "classify/pallas-dense", "classify/pallas-walk",
+            "classify-wire/xla-ctrie-fused",
+            "classify-wire/xla-ctrie-overlay-fused",
+            "classify/pallas-cwalk"} <= names
 
 
 def test_builders_return_stable_jitted_objects():

@@ -366,3 +366,243 @@ def test_backend_walk_survives_nonintersecting_rule_patch():
             ref = oracle.classify(clf.tables, sub)
             np.testing.assert_array_equal(np.asarray(res), ref.results)
     clf.close()
+
+
+# --- ISSUE-6: compressed (skip-node) walk ----------------------------------
+
+
+def _ctrie_setup(seed=3, n_entries=2500, n_packets=1024, v6_fraction=0.7,
+                 width=4):
+    rng = np.random.default_rng(seed)
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=width, group_size=6,
+        v6_fraction=v6_fraction,
+    )
+    batch = testing.random_batch_fast(rng, tables, n_packets=n_packets)
+    return tables, batch
+
+
+def test_cpoptrie_has_skip_nodes_and_shrinks_depth():
+    """The clean /48-heavy distribution is chain-dominated: path
+    compression must produce real skip nodes and a d_max strictly below
+    the per-level walk depth."""
+    rng = np.random.default_rng(11)
+    tables = testing.clean_tables_scale(rng, 20_000)
+    _l0, nodes, _targets, d_max = jaxpath.build_cpoptrie(tables)
+    assert d_max < len(tables.trie_levels), (
+        f"no level compression: d_max {d_max} vs "
+        f"{len(tables.trie_levels)} levels"
+    )
+    assert int(nodes[:, 2].max()) > 0, "no skip nodes in a chain-heavy trie"
+
+
+def test_ctrie_xla_matches_trie_and_oracle():
+    """XLA compressed walk == the per-level trie classify == the CPU
+    oracle on a deep v6-heavy mix (results, xdp, stats)."""
+    tables, batch = _ctrie_setup()
+    cdev, d_max = jaxpath.device_ctrie(tables)
+    db = jaxpath.device_batch(batch)
+    res, xdp, stats = jaxpath.jitted_classify_ctrie(d_max)(cdev, db)
+    res2, xdp2, stats2 = _xla_results(tables, batch)
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(res2))
+    np.testing.assert_array_equal(np.asarray(xdp), np.asarray(xdp2))
+    np.testing.assert_array_equal(np.asarray(stats), np.asarray(stats2))
+    ref = oracle.classify(tables, batch.slice(0, 600))
+    np.testing.assert_array_equal(np.asarray(res)[:600], ref.results)
+
+
+def test_cwalk_fused_matches_ctrie_everywhere():
+    """The fused Pallas skip-node kernel (full coverage, min_depth=None)
+    must be bit-identical to the XLA compressed walk — including
+    malformed lanes, v4 cap truncation and root-level (best0) hits."""
+    tables, batch = _ctrie_setup(seed=9, n_entries=1500, n_packets=384)
+    built = pallas_walk.build_cwalk_tables_meta(
+        tables, vmem_budget=256 << 20
+    )
+    assert built is not None
+    wt, meta = built
+    res, xdp, stats = pallas_walk.jitted_classify_cwalk(
+        meta["d_max"], True
+    )(wt, jaxpath.device_batch(batch))
+    cdev, d_max = jaxpath.device_ctrie(tables)
+    res2, xdp2, stats2 = jaxpath.jitted_classify_ctrie(d_max)(
+        cdev, jaxpath.device_batch(batch)
+    )
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(res2))
+    np.testing.assert_array_equal(np.asarray(xdp), np.asarray(xdp2))
+    np.testing.assert_array_equal(np.asarray(stats), np.asarray(stats2))
+
+
+def test_cwalk_extraction_deep_class_matches_oracle():
+    """Extracted compressed walk: every full-depth-class packet must
+    classify identically to the oracle through the skip-node descent."""
+    tables, batch = _ctrie_setup(seed=21, n_entries=4000, v6_fraction=0.8)
+    classes = jaxpath.tune_depth_classes(tables)
+    assert len(classes) >= 2
+    thr = classes[-2]
+    built = pallas_walk.build_cwalk_tables_meta(
+        tables, min_depth=thr, vmem_budget=256 << 20
+    )
+    assert built is not None
+    wt, meta = built
+    lut = jaxpath.build_depth_lut(tables)
+    idx6 = np.nonzero(np.asarray(batch.kind) == KIND_IPV6)[0]
+    deep = [
+        idx for d, idx in jaxpath.depth_group_indices(
+            np.asarray(tables.root_lut, np.int64), lut, classes,
+            batch.ifindex, batch.ip_words, idx6,
+        ) if d is None
+    ]
+    assert deep and len(deep[0]), "no full-depth packets in the mix"
+    sub = batch.take(deep[0])
+    res, xdp, _stats = pallas_walk.jitted_classify_cwalk(
+        meta["d_max"], True
+    )(wt, jaxpath.device_batch(sub))
+    ref = oracle.HashLpmOracle(tables).classify(sub)
+    np.testing.assert_array_equal(np.asarray(res), ref.results)
+    np.testing.assert_array_equal(np.asarray(xdp), ref.xdp)
+
+
+def test_patch_cwalk_joined_matches_rebuild():
+    """A rules-only edit patched into the resident cwalk joined matrix
+    must equal a cold rebuild of the new tables."""
+    from infw.compiler import IncrementalTables
+
+    tables, _batch = _ctrie_setup(seed=5, n_entries=800)
+    it = IncrementalTables.from_content(dict(tables.content), rule_width=4)
+    snap = it.snapshot()
+    it.clear_dirty()  # device baseline established (hints valid from here)
+    built = pallas_walk.build_cwalk_tables_meta(snap, vmem_budget=256 << 20)
+    assert built is not None
+    wt, meta = built
+    key = list(it.content)[17]
+    rows = np.asarray(it.content[key]).copy()
+    rows[1, 6] = 1 if rows[1, 6] == 2 else 2
+    it.apply({key: rows})
+    hint = it.peek_dirty()
+    dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+    assert len(dirty)
+    snap2 = it.snapshot()
+    patched = pallas_walk.patch_cwalk_joined(wt, meta, snap2, dirty)
+    assert patched is not None
+    rebuilt = pallas_walk.build_cwalk_tables_meta(
+        snap2, vmem_budget=256 << 20
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(patched.joined), np.asarray(rebuilt.joined)
+    )
+    for name in ("l0", "root_lut", "nodes", "targets"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(patched, name)),
+            np.asarray(getattr(rebuilt, name)),
+            err_msg=name,
+        )
+
+
+def test_ctrie_rules_patch_seeds_host_caches():
+    """A rules-only ctrie edit must carry the host caches forward: the
+    structural transforms are shared by reference, the packed-rules and
+    per-tidx joined caches are patched at the dirty rows — and both
+    patched caches are bit-identical to a cache-stripped rebuild.
+    Without the seeding every 1-key edit repacks the full rules tensor
+    (seconds of host work at the 10M tier for a kilobyte scatter)."""
+    from infw.analysis.statecheck import _cold_clone
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import IncrementalTables
+
+    tables, _batch = _ctrie_setup(seed=11, n_entries=600)
+    it = IncrementalTables.from_content(dict(tables.content), rule_width=4)
+    snap = it.snapshot()
+    it.clear_dirty()  # device baseline established
+    clf = TpuClassifier(force_path="ctrie", interpret=True)
+    try:
+        clf.load_tables(snap)
+        it.clear_dirty()
+        assert clf.active_path == "ctrie"
+        old = clf._tables
+        assert getattr(old, "_cpoptrie_cache", None) is not None
+        key = list(it.content)[7]
+        rows = np.asarray(it.content[key]).copy()
+        rows[1, 6] = 1 if rows[1, 6] == 2 else 2
+        it.apply({key: rows})
+        snap2 = it.snapshot()
+        clf.load_tables(snap2, dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+        mode, _rows = clf._last_load
+        assert mode == "patch", mode
+        new = clf._tables
+        # structural transforms shared by reference (they never read
+        # rules, and the hint proved the trie untouched)
+        assert getattr(new, "_cpoptrie_cache", None) is (
+            getattr(old, "_cpoptrie_cache", None)
+        )
+        assert getattr(new, "_poptrie_cache", None) is (
+            getattr(old, "_poptrie_cache", None)
+        )
+        # patched caches equal a clean (cache-stripped) rebuild
+        jt = getattr(new, "_joined_tidx_cache", None)
+        assert jt is not None and not isinstance(jt, str)
+        np.testing.assert_array_equal(
+            jt, jaxpath.joined_by_tidx(_cold_clone(snap2))
+        )
+        pk = getattr(new, "_packed_rules_cache", None)
+        assert pk is not None
+        np.testing.assert_array_equal(
+            pk, jaxpath._packed_rules_flat(_cold_clone(snap2))
+        )
+    finally:
+        clf.close()
+
+
+def test_ctrie_skip_defect_injection_diverges():
+    """The cskip defect (zeroed skip_bits) must actually flip verdicts
+    on a chain-heavy table — the acceptance gate's substrate is real."""
+    rng = np.random.default_rng(13)
+    tables = testing.clean_tables_scale(rng, 5_000)
+    batch = testing.random_batch_fast(rng, tables, n_packets=1024)
+    db = jaxpath.device_batch(batch)
+    cdev, d_max = jaxpath.device_ctrie(tables)
+    res_ok, _x, _s = jaxpath.jitted_classify_ctrie(d_max)(cdev, db)
+    jaxpath._INJECT_CSKIP_BUG = True
+    try:
+        cdev_bad, d_bad = jaxpath.device_ctrie(tables)
+        res_bad, _x2, _s2 = jaxpath.jitted_classify_ctrie(d_bad)(
+            cdev_bad, db
+        )
+    finally:
+        jaxpath._INJECT_CSKIP_BUG = False
+    assert not np.array_equal(np.asarray(res_ok), np.asarray(res_bad)), (
+        "zeroing skip_bits changed nothing — the defect injection is dead"
+    )
+
+
+def test_backend_ctrie_fused_dispatch_matches_xla():
+    """Production dispatch on the compressed path: steered packed
+    classify through TpuClassifier(force_path='ctrie', fused_deep=True)
+    must match the plain XLA trie classify on every packet."""
+    tables, batch = _ctrie_setup(seed=29, n_entries=1000, n_packets=512,
+                                 v6_fraction=0.6)
+    clf = TpuClassifier(force_path="ctrie", interpret=True, fused_deep=True)
+    try:
+        clf.load_tables(tables)
+        assert clf.active_path == "ctrie"
+        assert clf._active[5] is not None, "fused cwalk did not build"
+        res_ref = np.asarray(_xla_results(tables, batch)[0])
+        results = np.zeros(len(batch), np.uint32)
+        kinds = np.asarray(batch.kind)
+        v6 = np.nonzero(kinds == KIND_IPV6)[0]
+        jobs = [(None, np.nonzero(kinds != KIND_IPV6)[0])]
+        jobs += [
+            (d, i) for d, i in clf.v6_depth_groups(
+                batch.ifindex, batch.ip_words, v6
+            ) if len(i)
+        ]
+        for depth, idx in jobs:
+            wire, v4o = batch.pack_wire_subset(np.asarray(idx, np.int64))
+            out = clf.classify_async_packed(
+                wire, v4o, apply_stats=False, depth=depth
+            ).result()
+            results[idx] = out.results
+        np.testing.assert_array_equal(results, res_ref)
+    finally:
+        clf.close()

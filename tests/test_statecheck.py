@@ -367,3 +367,179 @@ def test_statecheck_1m_tier():
     ]
     failure = statecheck.run_ops(tables.content, ops, cfg, seed=13)
     assert failure is None, failure
+
+
+# --- ISSUE-6: compressed (ctrie) layout configs -----------------------------
+
+
+@pytest.fixture
+def inject_cskip_bug():
+    jaxpath._INJECT_CSKIP_BUG = True
+    try:
+        yield
+    finally:
+        jaxpath._INJECT_CSKIP_BUG = False
+
+
+@pytest.mark.parametrize("config,n_ops", [
+    ("ctrie", 3), ("ctrie-overlay", 3),
+])
+def test_equivalence_ctrie(config, n_ops):
+    """The full EditOp alphabet over the compressed layout: every
+    incremental edit's resident (CTrieTables, d_max) must equal a cold
+    device_ctrie rebuild bit-for-bit and classify like the oracle."""
+    rep = statecheck.run_config(
+        config, seed=4, n_ops=n_ops, shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+def test_equivalence_ctrie_fused():
+    """The fused compressed (skip-node Pallas) walk config — this
+    config's first sweep caught a real bug in the walk carry-forward
+    (the per-tidx joined matrix is FULL, so no rules edit may skip the
+    patch; the level walk's intersection shortcut does not transfer)."""
+    rep = statecheck.run_config(
+        "ctrie-fused", seed=0, n_ops=2, witness_b=96,
+        shrink_on_failure=False,
+    )
+    assert rep["ok"], rep["failure"]
+
+
+def _clean_ctrie():
+    rng = np.random.default_rng(41)
+    tables = testing.random_tables(rng, n_entries=60, width=4,
+                                   v6_fraction=0.5)
+    return jaxpath.device_ctrie(tables, pad=True)[0]
+
+
+def test_check_ctrie_tables_clean():
+    assert statecheck.check_ctrie_tables(_clean_ctrie()) == []
+
+
+def test_check_ctrie_tables_flags_corruption():
+    """Each contract class trips on a targeted corruption: skip bounds,
+    child range, target bound, joined self-index, sentinel."""
+    import jax.numpy as jnp
+
+    cdev = _clean_ctrie()
+    nodes = np.asarray(cdev.nodes).copy()
+    nodes[0, 2] = 48  # skip_len > CPOP_MAX_SKIP
+    viols = statecheck.check_ctrie_tables(
+        cdev._replace(nodes=jnp.asarray(nodes))
+    )
+    assert any("CPOP_MAX_SKIP" in v for v in viols), viols
+
+    nodes = np.asarray(cdev.nodes).copy()
+    nodes[0, 0] = 2**31 - 1
+    nodes[0, 4] = 0xFFFFFFFF  # child range shoots past the node array
+    viols = statecheck.check_ctrie_tables(
+        cdev._replace(nodes=jnp.asarray(nodes))
+    )
+    assert any("child range" in v for v in viols), viols
+
+    joined = np.asarray(cdev.joined).copy()
+    if joined.shape[0] > 2:
+        joined[2, 0] = 9999  # self-index broken
+        viols = statecheck.check_ctrie_tables(
+            cdev._replace(joined=jnp.asarray(joined))
+        )
+        assert any("self-index" in v for v in viols), viols
+
+    joined = np.asarray(cdev.joined).copy()
+    joined[0, 3] = 7  # UNDEF sentinel must stay all-zero
+    viols = statecheck.check_ctrie_tables(
+        cdev._replace(joined=jnp.asarray(joined))
+    )
+    assert any("sentinel" in v for v in viols), viols
+
+
+def test_injected_cskip_defect_caught(inject_cskip_bug):
+    """The skip-node acceptance: under the zeroed-skip-bits defect the
+    resident AND cold-rebuilt device state share the bug, so the raw
+    compare stays green and the catch MUST come from classify
+    divergence vs the CPU oracle — proving the equivalence engine's
+    oracle half covers the skip-node path.  (The <= 3-op shrunk-repro
+    bound runs in `make state-check`'s cskip acceptance; shrinking is
+    skipped here to keep the tier-1 budget.)"""
+    rep = statecheck.run_config(
+        "ctrie", seed=0, n_ops=2, shrink_on_failure=False
+    )
+    assert not rep["ok"], "cskip defect not caught"
+    assert rep["failure"]["phase"] in ("classify", "stats"), rep["failure"]
+
+
+def test_backend_ctrie_invariant_hook_blocks_corruption():
+    """INFW_CHECK_INVARIANTS routes the compressed layout through
+    check_ctrie_tables at the install boundary."""
+    from infw.backend.tpu import TpuClassifier
+
+    rng = np.random.default_rng(53)
+    tables = testing.random_tables(rng, n_entries=50, width=4,
+                                   v6_fraction=0.5)
+    clf = TpuClassifier(force_path="ctrie", interpret=True,
+                        check_invariants=True)
+    try:
+        clf.load_tables(tables)  # clean install passes
+        assert clf.active_path == "ctrie"
+        viols = statecheck.check_ctrie_tables(clf._active[1][0])
+        assert viols == []
+    finally:
+        clf.close()
+
+
+# --- 10M-scale tier ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_statecheck_ctrie_scale_tier():
+    """The 10M-scale tier (ISSUE 6): clean_columns_fast generation ->
+    vectorized cold build -> compressed production dispatch -> one
+    1-key rules patch, with the patched resident state proven
+    bit-identical to a cold device_ctrie rebuild and witness verdicts
+    proven against the hash oracle.  INFW_SCALE_TEST_ENTRIES overrides
+    the entry count (default 10M; needs ~50 GB RSS — set 2000000 on
+    smaller hosts)."""
+    import os
+    import time
+
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import IncrementalTables
+    from infw import oracle
+
+    n = int(os.environ.get("INFW_SCALE_TEST_ENTRIES", 10_000_000))
+    rng = np.random.default_rng(61)
+    cols = testing.clean_columns_fast(rng, n)
+    t0 = time.perf_counter()
+    it = IncrementalTables.from_columns(cols, rule_width=4)
+    snap = it.snapshot()
+    t_build = time.perf_counter() - t0
+    assert t_build < 120.0, f"cold build took {t_build:.0f}s at {n} entries"
+    clf = TpuClassifier(force_path="ctrie", interpret=True)
+    try:
+        clf.load_tables(snap)
+        it.clear_dirty()
+        assert clf.active_path == "ctrie"
+        key = LpmKey(int(cols.prefix_len[7]), int(cols.ifindex[7]),
+                     cols.ip[7].tobytes())
+        rows = np.asarray(it.content[key]).copy()
+        rows[1, 6] = 1 if rows[1, 6] == 2 else 2
+        it.apply({key: rows})
+        snap2 = it.snapshot()
+        clf.load_tables(snap2, dirty_hint=it.peek_dirty())
+        it.clear_dirty()
+        assert clf._last_load[0] == "patch", clf._last_load
+        cdev, d_max = clf._active[1]
+        assert statecheck.check_ctrie_tables(cdev) == []
+        clone = statecheck._cold_clone(snap2)
+        fresh = jaxpath.device_ctrie(clone, clf._device, pad=True)
+        assert fresh is not None and fresh[1] == d_max
+        m = statecheck._first_mismatch(cdev, fresh[0])
+        assert m is None, m
+        batch = testing.random_batch_fast(rng, snap2, n_packets=2048)
+        out = clf.classify(batch, apply_stats=False)
+        ref = oracle.HashLpmOracle(snap2).classify(batch)
+        np.testing.assert_array_equal(out.results, ref.results)
+        np.testing.assert_array_equal(out.xdp, ref.xdp)
+    finally:
+        clf.close()

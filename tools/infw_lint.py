@@ -24,10 +24,13 @@ Subcommands:
           bit-identical to a cold rebuild and classify-equivalent to
           the CPU oracle.  On failure the case shrinks to a minimal
           paste-able reproducer (infw.analysis.shrink).
-          ``--inject-defect`` re-introduces the PR-4 joined-placeholder
-          bucket-padding bug (jaxpath._INJECT_JOINED_PAD_BUG) and
-          verifies the checker catches it with a <= 3-op shrunk repro —
-          exit 0 means CAUGHT.
+          ``--inject-defect [joined-pad|cskip]`` re-introduces a known
+          bug — ``joined-pad`` (default) the PR-4 joined-placeholder
+          bucket-padding bug (jaxpath._INJECT_JOINED_PAD_BUG);
+          ``cskip`` a zeroed skip_bits word in the compressed layout's
+          skip-node path (jaxpath._INJECT_CSKIP_BUG), caught by oracle
+          divergence on the ctrie config — and verifies the checker
+          catches it with a <= 3-op shrunk repro — exit 0 means CAUGHT.
 
 Exit status: 1 when any error-severity finding exists (or, with
 ``--strict``, any warning too); 0 otherwise.  ``--json`` prints one
@@ -294,34 +297,46 @@ def cmd_jax(args) -> int:
 #: the overlay routing, the wide-ruleId u32 path and the joined-gate-
 #: tripped placeholder regime.  dense/fused/mesh run in the pytest suite
 #: (tests/test_statecheck.py) — selectable here via --configs.
-DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined")
+DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
+                         "ctrie-overlay")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
-    """The injected-defect acceptance: re-introduce the PR-4 joined-
-    placeholder bucket-padding bug and prove the checker catches it with
-    a shrunk reproducer of <= 3 ops.  Exit 0 = caught."""
+    """The injected-defect acceptance: re-introduce a known bug and
+    prove the checker catches it with a shrunk reproducer of <= 3 ops.
+    Exit 0 = caught.  ``joined-pad`` runs the PR-4 joined-placeholder
+    bucket-padding bug on the 'nojoined' config (the placeholder layout
+    regime); ``cskip`` zeroes the compressed layout's skip_bits words on
+    the 'ctrie' config — the resident AND cold-rebuilt device state
+    share the defect, so the catch is oracle divergence, proving the
+    classify-equivalence half covers the skip-node path."""
     from infw.analysis import statecheck
     from infw.kernels import jaxpath
 
+    defect = args.inject_defect
+    config = "ctrie" if defect == "cskip" else "nojoined"
+    flag = (
+        "_INJECT_CSKIP_BUG" if defect == "cskip"
+        else "_INJECT_JOINED_PAD_BUG"
+    )
     if args.configs:
-        print("note: --inject-defect always runs the 'nojoined' config "
-              "(the only one in the placeholder layout regime); "
+        print(f"note: --inject-defect {defect} always runs the "
+              f"{config!r} config (the defect's layout regime); "
               "--configs ignored", file=sys.stderr)
-    jaxpath._INJECT_JOINED_PAD_BUG = True
+    setattr(jaxpath, flag, True)
     try:
         report = statecheck.run_config(
-            "nojoined", seed=args.seed, n_ops=args.ops,
+            config, seed=args.seed, n_ops=args.ops,
             backend=args.backend, witness_b=args.witness,
             max_shrink_runs=32,
         )
     finally:
-        jaxpath._INJECT_JOINED_PAD_BUG = False
+        setattr(jaxpath, flag, False)
     problems = []
     if report["ok"]:
         problems.append(
-            "injected joined-placeholder defect NOT caught by the "
-            "equivalence engine"
+            f"injected {defect} defect NOT caught by the equivalence "
+            "engine"
         )
     else:
         shrunk = report.get("shrunk") or {}
@@ -464,10 +479,15 @@ def main(argv=None) -> int:
                               "pool)")
     p_state.add_argument("--witness", type=int, metavar="B",
                          help="witness batch size override")
-    p_state.add_argument("--inject-defect", action="store_true",
-                         help="re-introduce the PR-4 joined-placeholder "
-                              "bucket-padding bug and verify the checker "
-                              "catches it (exit 0 = caught)")
+    p_state.add_argument("--inject-defect", nargs="?",
+                         const="joined-pad", default=None,
+                         choices=("joined-pad", "cskip"),
+                         help="re-introduce a known bug — joined-pad "
+                              "(default): the PR-4 joined-placeholder "
+                              "bucket-padding bug; cskip: zeroed "
+                              "skip_bits in the compressed skip-node "
+                              "path — and verify the checker catches it "
+                              "(exit 0 = caught)")
     p_state.set_defaults(fn=cmd_state)
 
     args = ap.parse_args(argv)
