@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench bench-checked build-bench native entry-check \
-	dryrun-multichip mesh-check spill-read wire-check lint static-check \
-	state-check clean
+.PHONY: test test-fast bench bench-checked build-bench slo-bench native \
+	entry-check dryrun-multichip mesh-check spill-read wire-check lint \
+	static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -111,10 +111,20 @@ static-check: lint
 build-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --build-bench
 
+# The SLO serving tier (bench.bench_slo) standalone at a smoke load
+# off-TPU: open-loop Poisson arrivals through the deadline-aware
+# continuous microbatching scheduler (infw.scheduler), p50/p99/p999
+# above link floor at 3 offered loads, deadline-miss rate, achieved
+# batch sizes, and the fixed-ingest_chunk A/B — gated on the scheduled
+# path's p99-above-floor beating the baseline (INFW_SLO_P99_MAX_RATIO,
+# default 0.9x; verdicts are oracle-checked inside the tier).
+slo-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --slo-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench bench
+bench-checked: static-check build-bench slo-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -1569,6 +1569,280 @@ def bench_wire_latency(tables, batch, on_tpu):
         )
 
 
+# --- SLO serving tier: deadline-aware continuous microbatching -------------
+
+
+def _slo_floor():
+    """The tunnel's bare sync round-trip (noop kernel) — the link floor
+    every reported SLO latency is measured above, same control as the
+    wire-latency tier."""
+    noop = jax.jit(lambda x: x + 1)
+    floors = []
+    for i in range(8):
+        x = np.array([i], np.uint32)
+        t0 = time.perf_counter()
+        np.asarray(noop(x))
+        floors.append(time.perf_counter() - t0)
+    return sorted(floors)[len(floors) // 2]
+
+
+def bench_slo(rng, on_tpu):
+    """ISSUE-7 SLO tier: open-loop p50/p99/p999 verdict latency above
+    link floor at three fixed offered loads, deadline-miss rate, the
+    achieved batch-size distribution, and an A/B against the
+    fixed-ingest_chunk dispatch the scheduler replaced — all in one
+    record.
+
+    Methodology (benchruns/README):
+    - OPEN loop: arrivals follow a seeded Poisson schedule at the
+      offered load regardless of how the pipeline keeps up; per-packet
+      latency is completion minus SCHEDULED arrival, so backlog the
+      scheduler causes is measured, not silently excluded (the
+      closed-loop coordinated-omission failure).
+    - loads are fractions (0.2 / 0.5 / 0.9) of the measured pipeline
+      capacity at the max ladder batch, so the tier exercises the
+      coalescing regime on every host class; the absolute pkts/s is
+      emitted alongside.
+    - the deadline budget is link floor + 20 ms (TPU tunnel) / 50 ms
+      (CPU smoke): a dispatch cannot beat the floor, so the budget is
+      what the SCHEDULER adds above it.
+
+    Returns {sched_p99_ms, baseline_p99_ms, miss_rate} at the mid load
+    for the slo-bench regression gate."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.scheduler import (
+        ContinuousScheduler,
+        DeadlinePolicy,
+        FixedChunkPolicy,
+        ServiceModel,
+        batch_ladder,
+        prewarm_ladder,
+    )
+    from infw.daemon import DEFAULT_INGEST_CHUNK
+
+    floor = _slo_floor()
+    log(f"slo: link sync floor {floor*1e3:.3f} ms")
+    deadline_s = floor + (0.020 if on_tpu else 0.050)
+    max_batch = 4096 if on_tpu else 512
+
+    t0 = time.perf_counter()
+    tables = testing.random_tables_fast(
+        rng, n_entries=100_000 if on_tpu else 2_000, width=8,
+        ifindexes=(2, 3, 4),
+    )
+    clf = TpuClassifier(force_path="trie", wire_codec="wire8")
+    clf.load_tables(tables)
+    log(f"slo: table build+load {time.perf_counter()-t0:.1f}s "
+        f"({tables.num_entries} entries, trie path, wire8)")
+
+    # startup ladder pre-warm: every shape the scheduler can emit is
+    # compiled (and first-dispatched) HERE; the recompile lint in
+    # tests/test_scheduler.py asserts the serving path stays compile-free
+    service = ServiceModel()
+    t0 = time.perf_counter()
+    n_warm = prewarm_ladder(clf, batch_ladder(max_batch),
+                            include_depth_classes=False, service=service)
+    log(f"slo: ladder prewarm {n_warm} dispatches in "
+        f"{time.perf_counter()-t0:.1f}s; seeded service estimates "
+        + ", ".join(f"{b}:{v*1e3:.1f}ms"
+                    for b, v in sorted(service.snapshot().items())))
+
+    # Measured pipeline capacity, calibrated by an intentionally
+    # OVERLOADED probe serve on real mixed-family traffic: the achieved
+    # (not offered) throughput of the full loop — subset pack, family
+    # split, dispatch, drain-thread materialize — is the sustainable
+    # rate; single-batch timings over-estimate it badly (they miss the
+    # reduced pipeline overlap under trickled arrivals).  The offered
+    # loads are fixed fractions of this, with the absolute pkts/s
+    # emitted alongside so records stay comparable.
+    pipe_depth = 4
+    n_cal = 16 * max_batch
+    calib = testing.random_batch_fast(rng, tables, n_packets=n_cal)
+    policy0 = DeadlinePolicy(deadline_s, max_batch, service=service)
+    # pass 1 (all-at-zero): warms every remaining dispatch shape and
+    # bounds the saturated rate (includes any residual first-dispatch
+    # cost, so it LOW-balls — pass 2 corrects)
+    t0 = time.perf_counter()
+    ContinuousScheduler(clf, policy0, pipeline_depth=pipe_depth).serve(
+        calib, np.zeros(n_cal)
+    )
+    r0 = n_cal / max(time.perf_counter() - t0, 1e-6)
+    # pass 2: Poisson arrivals at 3x the pass-1 rate = guaranteed
+    # sustained overload; ACHIEVED throughput is the capacity
+    offs_cal = testing.poisson_arrivals(
+        np.random.default_rng(999), 3.0 * r0, n_cal
+    )
+    t0 = time.perf_counter()
+    ContinuousScheduler(
+        clf, DeadlinePolicy(deadline_s, max_batch, service=service),
+        pipeline_depth=pipe_depth,
+    ).serve(calib, offs_cal)
+    cap_pps = n_cal / max(time.perf_counter() - t0, 1e-6)
+    log(f"slo: calibrated capacity {cap_pps/1e3:.1f}K pkts/s "
+        f"(saturated probe {r0/1e3:.1f}K, overloaded-Poisson achieved "
+        f"{cap_pps/1e3:.1f}K over {n_cal} packets)")
+    loads = [("low", 0.2), ("mid", 0.5), ("high", 0.9)]
+    mid_out = {}
+
+    def run_serve(policy, batch, offs, label):
+        sched = ContinuousScheduler(clf, policy, pipeline_depth=pipe_depth)
+        res = sched.serve(batch, offs)
+        # bit-identity witness vs the CPU oracle through the scheduled
+        # path (seeded subset; the full-batch check lives in the tests)
+        wit = min(2000, len(batch))
+        ref = oracle.classify(tables, batch.slice(0, wit)).results
+        if not (res.results[:wit] == ref).all():
+            raise RuntimeError(f"slo[{label}]: verdict mismatch vs oracle")
+        return res
+
+    for name, frac in loads:
+        rate = max(frac * cap_pps, 500.0)
+        n = int(min(max(rate * 2.0, 4_000), 200_000 if on_tpu else 40_000))
+        batch = testing.random_batch_fast(rng, tables, n_packets=n)
+        offs = testing.poisson_arrivals(
+            np.random.default_rng(1000 + int(frac * 10)), rate, n
+        )
+        policy = DeadlinePolicy(deadline_s, max_batch, service=service)
+        res = run_serve(policy, batch, offs, name)
+        above = np.maximum(res.latency_s - floor, 0.0) * 1e3
+        p50, p99, p999 = np.percentile(above, [50, 99, 99.9])
+        st = res.stats.snapshot()
+        miss_rate = st["misses"] / max(st["completed"], 1)
+        bs = res.batch_sizes
+        log(f"slo[{name}]: offered {rate:.0f} pkts/s n={n} "
+            f"p50/p99/p999 above floor {p50:.2f}/{p99:.2f}/{p999:.2f} ms "
+            f"miss {100*miss_rate:.2f}% "
+            f"batches n={len(bs)} mean={bs.mean():.0f} "
+            f"p50={np.percentile(bs, 50):.0f} max={bs.max()}; "
+            f"hist {sorted(st['batch_hist'].items())}")
+        emit(f"SLO offered load ({name}, {frac:.0%} of measured capacity)",
+             rate, "packets/s", vs_baseline=0.0)
+        for pname, val in (("p50", p50), ("p99", p99), ("p999", p999)):
+            emit(
+                f"SLO {pname} verdict latency above link floor @{name} "
+                f"offered load (open-loop Poisson, deadline-aware "
+                "microbatching)",
+                val, "ms", vs_baseline=0.0,
+            )
+        emit(
+            f"SLO deadline-miss rate @{name} offered load "
+            f"(budget = link floor + {(deadline_s-floor)*1e3:.0f} ms)",
+            100.0 * miss_rate, "percent", vs_baseline=0.0,
+        )
+        emit(
+            f"SLO achieved batch size, mean @{name} offered load",
+            float(bs.mean()), "packets", vs_baseline=0.0,
+        )
+        if name == "mid":
+            mid_out.update(rate=rate, n=n, sched_p99_ms=float(p99),
+                           miss_rate=float(miss_rate))
+
+    # A/B at the mid load, same record: the fixed-ingest_chunk dispatch
+    # the scheduler replaced (wait for a full chunk, flush at end of
+    # stream).  The chunk is the daemon's historical default, capped at
+    # half the run so the baseline dispatches at least twice instead of
+    # degenerating to one end-of-stream flush.
+    rate, n = mid_out["rate"], mid_out["n"]
+    batch = testing.random_batch_fast(rng, tables, n_packets=n)
+    offs = testing.poisson_arrivals(np.random.default_rng(1005), rate, n)
+    base_chunk = min(DEFAULT_INGEST_CHUNK, max(n // 2, 32))
+    res = run_serve(FixedChunkPolicy(base_chunk), batch, offs, "baseline")
+    above = np.maximum(res.latency_s - floor, 0.0) * 1e3
+    b50, b99 = np.percentile(above, [50, 99])
+    log(f"slo[baseline]: fixed chunk={base_chunk} p50/p99 above floor "
+        f"{b50:.2f}/{b99:.2f} ms vs scheduled p99 "
+        f"{mid_out['sched_p99_ms']:.2f} ms")
+    emit(
+        "SLO p99 verdict latency above link floor @mid offered load, "
+        "fixed-ingest_chunk baseline (the pre-scheduler dispatch, A/B "
+        "same record)",
+        b99, "ms", vs_baseline=0.0,
+    )
+    emit(
+        "SLO scheduled-vs-fixed-chunk p99 improvement @mid offered load",
+        b99 / max(mid_out["sched_p99_ms"], 1e-3), "x",
+        vs_baseline=round(b99 / max(mid_out["sched_p99_ms"], 1e-3), 3),
+    )
+    mid_out["baseline_p99_ms"] = float(b99)
+
+    # burst arrival shape at the mid load (the adversarial case for a
+    # coalescing scheduler: a whole burst lands on one admission)
+    try:
+        offs_b = testing.burst_arrivals(
+            np.random.default_rng(1006), rate, n,
+            burst=min(256, max_batch),
+        )
+        res_b = run_serve(
+            DeadlinePolicy(deadline_s, max_batch, service=service),
+            batch, offs_b, "burst",
+        )
+        pb99 = float(np.percentile(
+            np.maximum(res_b.latency_s - floor, 0.0) * 1e3, 99
+        ))
+        emit(
+            "SLO p99 verdict latency above link floor @mid offered "
+            "load, burst arrivals (256-packet bursts, same mean rate)",
+            pb99, "ms", vs_baseline=0.0,
+        )
+    except Exception as e:
+        log(f"slo burst line FAILED: {e}")
+
+    # the batch=32 pinned-input regression (ISSUE-7 satellite): after
+    # the ladder prewarm the small-batch wire shape must serve at the
+    # batch=64/128 level — measured here the same way the wire-latency
+    # tier measures it, against THIS classifier's serving path
+    try:
+        small = {}
+        for bs_i in (32, 64, 128):
+            sub = testing.random_batch_fast(rng, tables, n_packets=bs_i)
+            lats = []
+            for i in range(10):
+                wire, v4o = sub.pack_wire_subset(
+                    np.arange(bs_i, dtype=np.int64)
+                )
+                wire = wire.copy()
+                wire[:, -1] ^= np.uint32(i + 1)  # defeat memoization
+                t0 = time.perf_counter()
+                clf.classify_prepared(
+                    clf.prepare_packed(wire, v4o), apply_stats=False
+                ).result()
+                lats.append(time.perf_counter() - t0)
+            small[bs_i] = sorted(lats)[len(lats) // 2]
+            emit(
+                f"SLO serving-path p50 latency above link floor "
+                f"@batch={bs_i} (post-prewarm)",
+                max(small[bs_i] - floor, 0.0) * 1e3, "ms",
+                vs_baseline=0.0,
+            )
+        log("slo small-batch: " + ", ".join(
+            f"{k}: {v*1e3:.2f}ms" for k, v in small.items()))
+    except Exception as e:
+        log(f"slo small-batch lines FAILED: {e}")
+    return mid_out
+
+
+def slo_bench_main() -> int:
+    """``make slo-bench``: the SLO tier standalone at a smoke load
+    (off-TPU CI) with a p99 regression gate — the scheduled path's
+    p99-above-floor at the mid offered load must beat the
+    fixed-ingest_chunk baseline by at least 1/INFW_SLO_P99_MAX_RATIO
+    (default: scheduled <= 0.9x baseline).  Bit-identity vs the CPU
+    oracle is asserted inside the tier; any mismatch raises."""
+    ratio_max = float(os.environ.get("INFW_SLO_P99_MAX_RATIO", "0.9"))
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_slo(rng, on_tpu)
+    emit_compact_record()
+    sched, base = rec["sched_p99_ms"], rec["baseline_p99_ms"]
+    if not sched <= ratio_max * base:
+        log(f"slo-bench FAIL: scheduled p99 {sched:.2f} ms not <= "
+            f"{ratio_max} x baseline {base:.2f} ms")
+        return 1
+    log(f"slo-bench OK: scheduled p99 {sched:.2f} ms vs baseline "
+        f"{base:.2f} ms (gate {ratio_max}x)")
+    return 0
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -1845,6 +2119,14 @@ def main():
         bench_device_latency(tables, batch, on_tpu)
     except Exception as e:
         log(f"device latency FAILED: {e}")
+    try:
+        # ISSUE-7 SLO serving tier: open-loop p50/p99/p999 above link
+        # floor at 3 offered loads + deadline-miss rate + batch-size
+        # distribution + fixed-chunk A/B (also standalone as
+        # `bench.py --slo-bench`, `make slo-bench`, with a p99 gate)
+        bench_slo(rng, on_tpu)
+    except Exception as e:
+        log(f"slo tier FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
     # contiguous block, then ONE compact single-line JSON holding the
@@ -1865,4 +2147,6 @@ def main():
 if __name__ == "__main__":
     if "--build-bench" in sys.argv:
         sys.exit(build_bench_main())
+    if "--slo-bench" in sys.argv:
+        sys.exit(slo_bench_main())
     sys.exit(main())

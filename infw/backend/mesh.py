@@ -143,6 +143,13 @@ class MeshTpuClassifier(TpuClassifier):
     def mesh(self) -> Mesh:
         return self._mesh
 
+    @property
+    def data_shards(self) -> int:
+        """Width of the "data" axis one dispatched batch spreads over —
+        the scheduler's per-chip admission budget multiplies by this
+        (a spilled batch costs each chip only batch/data_shards rows)."""
+        return self._data_shards
+
     # -- rule loading -------------------------------------------------------
 
     def load_tables(self, tables: CompiledTables, dirty_hint=None,

@@ -602,6 +602,25 @@ class TpuClassifier:
             )
         ]
 
+    def serving_shape_classes(self):
+        """The depth-steering classes of the CURRENT table generation as
+        ``(class_or_None, generation)`` pairs (the ``depth`` argument of
+        prepare_packed), full-depth class last — what the scheduler's
+        ladder pre-warm must cover so no steering-specialized jit
+        compiles on the serving path.  Empty when steering is off
+        (dense / wide-ruleId paths)."""
+        with self._lock:
+            steer = self._depth_steer
+        if steer is None:
+            return []
+        classes, gen = steer[2], steer[3]
+        return [(int(d), gen) for d in classes] + [(None, gen)]
+
+    #: data-axis width of one dispatched wire batch — 1 on a single
+    #: chip; MeshTpuClassifier overrides with its "data" shard count.
+    #: The scheduler multiplies its per-chip admission budget by this.
+    data_shards = 1
+
     def classify_async_packed(
         self, wire_np: np.ndarray, v4_only: bool, apply_stats: bool = True,
         depth=None,

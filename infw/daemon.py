@@ -34,6 +34,7 @@ import os
 import signal
 import struct
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -306,6 +307,8 @@ class Daemon:
         h2d_overlap: bool = True,
         h2d_stage_depth: int = DEFAULT_H2D_STAGE_DEPTH,
         mesh: Optional[str] = None,
+        deadline_us: Optional[float] = None,
+        max_batch: Optional[int] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -318,6 +321,30 @@ class Daemon:
         self.max_tick_packets = max(1, int(max_tick_packets))
         self.h2d_overlap = bool(h2d_overlap)
         self.h2d_stage_depth = max(1, int(h2d_stage_depth))
+        # Deadline-aware continuous microbatching (infw.scheduler): with
+        # --deadline-us set, ingest jobs are sized by the LARGEST ladder
+        # batch whose measured service time still fits the per-packet
+        # deadline budget (admit-by-deadline) instead of the fixed
+        # ingest_chunk; the batch-size ladder is pre-warmed at table
+        # load so shape-driven jit recompiles never land on the serving
+        # path, and scheduler observability (queue depth, batch-size
+        # histogram, deadline misses, per-format wire bytes) exports on
+        # /metrics with misses also emitted on the obs event ring.
+        self.max_batch = max(1, int(max_batch)) if max_batch else self.ingest_chunk
+        self.sched_stats = None
+        self._sched_policy = None
+        self._prewarmed_gen = None
+        if deadline_us is not None:
+            from .scheduler import DeadlinePolicy, SchedulerStats
+
+            self.sched_stats = SchedulerStats()
+            self._sched_policy = DeadlinePolicy(
+                deadline_s=float(deadline_us) * 1e-6,
+                max_admit=self.max_batch,
+            )
+            # ping-pong staging is the point of the serving loop: keep at
+            # least one prepared batch ahead of the in-flight one
+            self.h2d_stage_depth = max(2, self.h2d_stage_depth)
         self.registry = registry if registry is not None else default_registry
 
         self.nodestates_dir = os.path.join(state_dir, "nodestates")
@@ -390,6 +417,18 @@ class Daemon:
         # deny-event loss/queue totals on /metrics (events.go:79-82's
         # LostSamples, exported instead of only logged)
         self.metrics_registry.register_counters(self.ring)
+        # per-format H2D wire accounting (TpuClassifier.wire_stats) as
+        # counters; the getter indirection survives table reloads and the
+        # CPU backend (no wire_stats) renders nothing.  Registry holds
+        # providers weakly, so keep the strong ref here.
+        from .scheduler import WireStatsCounters
+
+        self._wire_counters = WireStatsCounters(
+            lambda: self.syncer.classifier
+        )
+        self.metrics_registry.register_counters(self._wire_counters)
+        if self.sched_stats is not None:
+            self.metrics_registry.register_counters(self.sched_stats)
         self.debug_buffer = DebugLookupBuffer()
 
         self._stop = threading.Event()
@@ -527,6 +566,34 @@ class Daemon:
             return 0
         processed = 0
 
+        # Deadline scheduling (infw.scheduler, --deadline-us): job sizes
+        # come from the policy's service-time model — the largest ladder
+        # batch that still meets the per-packet deadline budget — scaled
+        # by the classifier's data-parallel width (a mesh spreads one
+        # admission over its "data" shards, so the per-chip budget
+        # multiplies; a single-chip pool serves it unchanged).  Without
+        # the knob the historical fixed ingest_chunk applies.
+        # (getattr: the bench's Daemon.__new__ ingest harness constructs
+        # no scheduler state.)
+        policy = getattr(self, "_sched_policy", None)
+        sched_stats = getattr(self, "sched_stats", None)
+        if policy is not None:
+            from .scheduler import data_parallel_width, ladder_floor
+
+            self._maybe_prewarm_ladder(clf)
+            width = data_parallel_width(clf)
+            # quantize the cap to a pre-warmed ladder step: with a
+            # non-pow2 mesh width, service_cap * width can land between
+            # steps, and a chunk-capped pad would otherwise emit a shape
+            # the prewarm never compiled
+            chunk = ladder_floor(
+                policy.service_cap() * width, self.max_batch * width
+            )
+            min_bucket_exp = 5  # the ladder starts at 32 (pre-warmed)
+        else:
+            chunk = self.ingest_chunk
+            min_bucket_exp = 6
+
         def finalize(fctx) -> None:
             """Write verdicts, consume the file, then apply stats and
             emit events — strictly AFTER the source file is removed: a
@@ -598,12 +665,23 @@ class Daemon:
                     log.error("could not remove bad ingest file %s: %s",
                               fn, re)
                 continue
+            # Arrival timestamp for the deadline accounting: the file's
+            # DROP time (mtime — write_frames_file's os.replace stamps
+            # it), mapped into the monotonic domain by age, so time the
+            # file spent queued in the ingest dir behind a busy tick (or
+            # the ladder prewarm) counts against the deadline — never
+            # the parse or dispatch time (the coordinated-omission rule).
+            try:
+                age = max(0.0, time.time() - os.path.getmtime(path))
+            except OSError:
+                age = 0.0
             n = len(batch)
             fctx = {
                 "fn": fn, "path": path, "frames": fb, "batch": batch,
                 "results": np.zeros(n, np.uint32),
                 "xdp": np.full(n, 2, np.int32),
                 "remaining": 0, "failed": False,
+                "t_arrival": time.monotonic() - age,
             }
             if n == 0:
                 try:
@@ -658,12 +736,12 @@ class Daemon:
                     g = np.nonzero((kinds == KIND_IPV6) == want_v6)[0]
                 pos = 0
                 while pos < len(g):
-                    take = g[pos : pos + (self.ingest_chunk - cur_n)]
+                    take = g[pos : pos + (chunk - cur_n)]
                     cur.append((fctx, take))
                     fctx["remaining"] += 1
                     cur_n += len(take)
                     pos += len(take)
-                    if cur_n >= self.ingest_chunk:
+                    if cur_n >= chunk:
                         jobs.append({"segments": cur, "retry": False,
                                      "depth": depth})
                         cur, cur_n = [], 0
@@ -682,10 +760,11 @@ class Daemon:
             jit-compiling a fresh shape mid-tick.  Padding rows are
             KIND_OTHER (always PASS, no stats — and per-file statistics
             come from the host-side verdicts anyway, so inert padding is
-            free)."""
-            if n >= self.ingest_chunk:
+            free).  Scheduler mode starts the ladder at 32 (every step
+            pre-warmed); the legacy floor stays 64."""
+            if n >= chunk:
                 return n
-            return min(1 << max(6, (n - 1).bit_length()), self.ingest_chunk)
+            return min(1 << max(min_bucket_exp, (n - 1).bit_length()), chunk)
 
         # Double-buffered ingestion: ``prepare`` does the HOST half of a
         # dispatch (segment gather + wire pack + codec encode) and — on
@@ -804,6 +883,42 @@ class Daemon:
                     log.error("ingest classify failed for %s: %s", f["fn"], err)
                 seg_done(f)
 
+        def note_sched_drain(job, t_done: float) -> None:
+            """Scheduler accounting at job completion: feed the observed
+            launch->materialize latency into the service model (the
+            admit-by-deadline sizing input for the NEXT jobs), count
+            per-packet deadline misses from each file's ARRIVAL time,
+            and emit a DeadlineMissRecord on the obs event ring."""
+            n = sum(len(idx) for _f, idx in job["segments"])
+            t_launch = job.get("t_launch")
+            if n and t_launch is not None:
+                from .scheduler import ladder_bucket
+
+                # bucket by the tick's admission cap (chunk), not the
+                # per-chip max_admit: mesh jobs dispatch at width-scaled
+                # shapes and must feed the estimate for THAT bucket
+                policy.service.observe(
+                    ladder_bucket(n, chunk), t_done - t_launch
+                )
+            n_miss, worst = 0, 0.0
+            for f, idx in job["segments"]:
+                lat = t_done - f["t_arrival"]
+                worst = max(worst, lat)
+                if lat > policy.deadline_s:
+                    n_miss += len(idx)
+            if sched_stats is not None:
+                sched_stats.note_complete(n, n_miss)
+                sched_stats.set_queue_depth(
+                    max(0, sched_stats.queue_depth - n)
+                )
+            if n_miss:
+                from .obs.events import DeadlineMissRecord
+
+                self.ring.push(DeadlineMissRecord(
+                    n_miss=n_miss, batch=n, worst_us=worst * 1e6,
+                    deadline_us=policy.deadline_s * 1e6,
+                ))
+
         def drain_one() -> None:
             job, pending = inflight.popleft()
             try:
@@ -811,6 +926,11 @@ class Daemon:
             except Exception as e:
                 job_failed(job, e)
                 return
+            if policy is not None:
+                try:
+                    note_sched_drain(job, time.monotonic())
+                except Exception as e:
+                    log.error("scheduler accounting failed: %s", e)
             off = 0
             for f, idx in job["segments"]:
                 k = len(idx)
@@ -840,10 +960,13 @@ class Daemon:
                 if prep is not None:
                     staged.append((job, prep))
 
+        if sched_stats is not None:
+            sched_stats.set_queue_depth(total)
         while jobs or staged or inflight:
             stage_more()
             while staged and len(inflight) < self.pipeline_depth:
                 job, prep = staged.popleft()
+                job["t_launch"] = time.monotonic()
                 try:
                     pending = launch(job, prep)
                 except Exception as e:
@@ -851,12 +974,47 @@ class Daemon:
                     continue
                 if pending is not None:
                     inflight.append((job, pending))
+                    if sched_stats is not None:
+                        n_job = sum(len(i) for _f, i in job["segments"])
+                        from .scheduler import ladder_bucket
+
+                        sched_stats.note_admit(
+                            n_job, ladder_bucket(n_job, chunk)
+                        )
                 # top up staging as the window drains so the lookahead
                 # never collapses mid-burst
                 stage_more()
             if inflight:
                 drain_one()
         return processed
+
+    def _maybe_prewarm_ladder(self, clf) -> None:
+        """Pre-warm every batch-size ladder shape against the CURRENT
+        table generation, once per generation: shape-driven jit
+        specialization (and a tunneled deployment's per-executable
+        first-dispatch cost) lands here, never inside a serving-path
+        latency budget.  Covers batch=32 (the BENCH_r05 small-batch
+        anomaly shape) and every depth-steering class."""
+        gen = (id(clf), id(clf.tables), getattr(clf, "_depth_gen", 0))
+        if gen == self._prewarmed_gen:
+            return
+        from .scheduler import (
+            batch_ladder, data_parallel_width, prewarm_ladder,
+        )
+
+        try:
+            # the ladder extends to max_batch * data shards: a mesh
+            # classifier's tick jobs span the whole pool (chunk =
+            # service_cap * width), so those shapes must be warm too —
+            # the compile-free timing pass also seeds the admission
+            # policy's service model, so the first tick's job sizing is
+            # measured, not the cold-model default
+            ladder = batch_ladder(self.max_batch * data_parallel_width(clf))
+            prewarm_ladder(clf, ladder,
+                           service=self._sched_policy.service)
+        except Exception as e:
+            log.error("ladder prewarm failed: %s", e)
+        self._prewarmed_gen = gen
 
     # -- HTTP endpoints ------------------------------------------------------
 
@@ -1023,6 +1181,26 @@ def main(argv: Optional[List[str]] = None) -> int:
              "margin line measures against",
     )
     p.add_argument(
+        "--deadline-us", type=float,
+        default=os.environ.get("INFW_DEADLINE_US") or None,
+        help="per-packet verdict deadline budget in microseconds: enables "
+             "the deadline-aware continuous microbatching scheduler "
+             "(infw.scheduler) — ingest jobs coalesce to the largest "
+             "batch whose measured service time still meets the budget "
+             "(admit-by-deadline, not the fixed --ingest-chunk), the "
+             "batch-size ladder is pre-warmed at table load, and "
+             "scheduler observability lands on /metrics and the event "
+             "ring.  CLI beats INFW_DEADLINE_US",
+    )
+    p.add_argument(
+        "--max-batch", type=int,
+        default=os.environ.get("INFW_MAX_BATCH") or None,
+        help="scheduler admission cap per chip (default: --ingest-chunk); "
+             "on a --mesh pool one admission spreads over the data axis, "
+             "so the effective cap multiplies by the data shards.  CLI "
+             "beats INFW_MAX_BATCH",
+    )
+    p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
         help="unixgram socket to ship deny-event lines to (the events "
@@ -1045,6 +1223,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"invalid INFW_WIRE_CODEC {args.wire_codec!r} "
             "(expected auto|wire8|delta)"
         )
+
+    # Scheduler knobs share the launch-time validation posture: a
+    # non-positive deadline or batch cap (flag OR env-derived) must fail
+    # the launch with a usage error, not raise inside the serving loop.
+    if args.deadline_us is not None and not args.deadline_us > 0:
+        p.error(f"--deadline-us must be positive, got {args.deadline_us}")
+    if args.max_batch is not None and args.max_batch < 1:
+        p.error(f"--max-batch must be >= 1, got {args.max_batch}")
 
     # Same launch-time validation posture as the wire codec: a bad
     # INFW_MESH (or --mesh) must fail here with a usage error, not raise
@@ -1092,6 +1278,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         else (True if args.compressed else None),
         h2d_overlap=not args.no_h2d_overlap,
         mesh=args.mesh,
+        deadline_us=args.deadline_us,
+        max_batch=args.max_batch,
     )
     stop = threading.Event()
 

@@ -240,6 +240,19 @@ def _build_pallas_walk(b: int):
     return fn, (_fixture_walk_tables(), _fixture_device_batch(b))
 
 
+def _build_pallas_dense_wire(b: int):
+    """The dense path's WIRE-fused serving dispatch (backend/tpu.py
+    _launch_wire, path == "dense") — the shape the deadline scheduler's
+    ladder pre-warm exercises on dense tables; previously only the
+    non-wire dense kernel was registered."""
+    from . import pallas_dense
+
+    pt = _fixture_pallas_tables()
+    block_b = pallas_dense.choose_block_b(pt.mdt.shape[1])
+    fn = pallas_dense.jitted_classify_pallas_wire_fused(True, block_b)
+    return fn, (pt, _fixture_wire(b))
+
+
 # -- compressed (ctrie/cwalk) fixtures/builders ------------------------------
 
 
@@ -420,6 +433,10 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify/pallas-dense", "pallas", _build_pallas_dense
+        ),
+        KernelEntrypoint(
+            "classify-wire/pallas-dense-fused", "pallas",
+            _build_pallas_dense_wire,
         ),
         KernelEntrypoint(
             "classify/pallas-walk", "pallas", _build_pallas_walk

@@ -160,6 +160,29 @@ class AnalysisEventRecord:
         ]
 
 
+@dataclass
+class DeadlineMissRecord:
+    """One scheduler dispatch whose packets blew their verdict deadline
+    budget (infw.scheduler): operators see SLO misses in the same
+    stream as deny events, with the ring's usual overflow accounting.
+    One record per missing BATCH, not per packet — the miss COUNTER on
+    /metrics carries the per-packet totals, the event carries the
+    shape of the miss (how large the batch was, how late its worst
+    packet landed)."""
+
+    n_miss: int        # packets over deadline in this dispatch
+    batch: int         # admitted (unpadded) batch size
+    worst_us: float    # worst completion latency in the batch
+    deadline_us: float
+
+    def lines(self) -> List[str]:
+        return [
+            f"scheduler deadline-miss: {self.n_miss}/{self.batch} packets "
+            f"over {self.deadline_us:.0f}us budget "
+            f"(worst {self.worst_us:.0f}us)"
+        ]
+
+
 def emit_analysis_findings(ring: "EventRing", findings) -> int:
     """Push analyzer findings (infw.analysis.rules.Finding) into the
     ring as AnalysisEventRecords; returns how many were queued (the
@@ -430,13 +453,15 @@ class EventsLogger:
             if isinstance(rec, BatchDenyRecord):
                 n += self._drain_batch(rec)
                 continue
-            if isinstance(rec, AnalysisEventRecord):
-                for line in rec.lines():
+            if isinstance(rec, EventRecord):
+                name = self._iface_names.get(rec.hdr.if_id, "?")
+                for line in decode_event_lines(rec, name):
                     self._sink(line)
                 n += 1
                 continue
-            name = self._iface_names.get(rec.hdr.if_id, "?")
-            for line in decode_event_lines(rec, name):
+            # line-record types (AnalysisEventRecord, DeadlineMissRecord,
+            # future structured events): render their own lines
+            for line in rec.lines():
                 self._sink(line)
             n += 1
         return n

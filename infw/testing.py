@@ -516,6 +516,46 @@ def random_batch_fast(
     )
 
 
+# --- arrival processes (open-loop load generation) --------------------------
+#
+# The SLO serving tier (infw.scheduler, bench_slo, tools/loadgen.py)
+# measures tail latency OPEN-LOOP: packet i is declared to arrive at a
+# scheduled offset regardless of how the system is keeping up, and its
+# latency is measured from that schedule — the coordinated-omission-safe
+# methodology (a closed-loop generator that waits for completions before
+# sending more silently excludes exactly the queueing it caused).
+
+
+def poisson_arrivals(
+    rng: np.random.Generator, rate_pps: float, n: int
+) -> np.ndarray:
+    """(n,) float64 cumulative arrival offsets (seconds) of a Poisson
+    process at ``rate_pps`` — exponential inter-arrivals, deterministic
+    per (seeded rng, rate, n)."""
+    if rate_pps <= 0:
+        raise ValueError(f"rate must be positive, got {rate_pps}")
+    gaps = rng.exponential(1.0 / float(rate_pps), int(n))
+    return np.cumsum(gaps)
+
+
+def burst_arrivals(
+    rng: np.random.Generator, rate_pps: float, n: int, burst: int = 64
+) -> np.ndarray:
+    """(n,) float64 arrival offsets of a bursty process at the SAME mean
+    rate as the Poisson generator: packets arrive in back-to-back groups
+    of ``burst`` with exponentially distributed gaps BETWEEN bursts
+    (mean burst/rate) — the adversarial arrival shape for a coalescing
+    scheduler (a whole burst lands on one admission decision)."""
+    if rate_pps <= 0:
+        raise ValueError(f"rate must be positive, got {rate_pps}")
+    burst = max(1, int(burst))
+    n = int(n)
+    n_bursts = -(-n // burst)
+    gaps = rng.exponential(burst / float(rate_pps), n_bursts)
+    starts = np.cumsum(gaps)
+    return np.repeat(starts, burst)[:n]
+
+
 def stats_dict_from_array(stats4: np.ndarray) -> Dict[int, List[int]]:
     """(MAX_TARGETS, 4) int64 -> {ruleId: [ap, ab, dp, db]} with zero rows
     dropped, for comparison against the oracle's dict."""

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Open-loop Poisson/burst load generator for the daemon's ingest dir.
+
+Drives a RUNNING daemon the way the SLO tier (bench_slo) drives the
+in-process scheduler: packets are scheduled by an arrival process
+(``infw.testing.poisson_arrivals`` / ``burst_arrivals``) at a fixed
+offered load, grouped into frames files of ``--file-packets`` packets,
+and each file is dropped into ``<state-dir>/ingest/`` at its FIRST
+packet's scheduled arrival time.
+
+Open-loop discipline (the coordinated-omission rule): the drop schedule
+is computed up front against one anchor timestamp and each write sleeps
+until its ABSOLUTE scheduled time — never "write, then sleep the
+interval" — so a slow consumer (or a slow writer) makes the generator
+fall visibly behind schedule (reported at exit) instead of silently
+stretching the offered load.  A closed-loop generator that paces off its
+own completions would hide exactly the queueing a latency SLO exists to
+measure.
+
+The packet mix is synthetic (uniform random v4/v6 addresses and
+protocols — deny rate depends on the loaded ruleset); determinism per
+``--seed`` covers addresses, ports AND arrival times, so two runs offer
+byte-identical streams on identical schedules.
+
+Usage:
+    python tools/loadgen.py --out <state-dir>/ingest --rate 100000 \\
+        --n 1000000 [--burst 256] [--file-packets 4096] [--seed 7] \\
+        [--ifindex 10] [--v6-fraction 0.3] [--dry-run]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from _common import setup_repo_path
+
+setup_repo_path()
+
+from infw import testing  # noqa: E402
+from infw.daemon import write_frames_file_v2  # noqa: E402
+from infw.obs.pcap import FramesBuf, build_frames_bulk  # noqa: E402
+
+
+def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
+                ifindex: int):
+    """Uniform synthetic packet columns (no table bias — loadgen does
+    not know the daemon's ruleset) -> the build_frames_bulk inputs."""
+    kind = np.where(rng.random(n) < v6_fraction, 2, 1).astype(np.int32)
+    ip = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    ip[kind == 1, 4:] = 0
+    ip_words = np.ascontiguousarray(ip).view(">u4").astype(np.uint32)
+    ip_words = ip_words.reshape(n, 4)
+    proto = np.asarray([6, 17, 132, 1, 58], np.int32)[
+        rng.integers(0, 5, n)
+    ]
+    dst_port = rng.integers(0, 65536, n).astype(np.int32)
+    icmp_type = rng.integers(0, 256, n).astype(np.int32)
+    icmp_code = rng.integers(0, 3, n).astype(np.int32)
+    fb = build_frames_bulk(kind, ip_words, proto, dst_port, icmp_type,
+                           icmp_code)
+    fb.ifindex = np.full(n, int(ifindex), np.uint32)
+    return fb
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="infw-loadgen", description=__doc__)
+    p.add_argument("--out", required=True,
+                   help="ingest directory of the target daemon")
+    p.add_argument("--rate", type=float, required=True,
+                   help="offered load, packets/second")
+    p.add_argument("--n", type=int, required=True, help="total packets")
+    p.add_argument("--burst", type=int, default=0,
+                   help="burst size: >0 switches the arrival process "
+                        "from Poisson to back-to-back bursts at the "
+                        "same mean rate (testing.burst_arrivals)")
+    p.add_argument("--file-packets", type=int, default=4096,
+                   help="packets per dropped frames file")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--ifindex", type=int, default=10)
+    p.add_argument("--v6-fraction", type=float, default=0.3)
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the schedule summary without writing or "
+                        "sleeping")
+    args = p.parse_args(argv)
+    if args.rate <= 0 or args.n <= 0 or args.file_packets <= 0:
+        p.error("--rate, --n and --file-packets must be positive")
+
+    rng = np.random.default_rng(args.seed)
+    if args.burst > 0:
+        offs = testing.burst_arrivals(rng, args.rate, args.n,
+                                      burst=args.burst)
+    else:
+        offs = testing.poisson_arrivals(rng, args.rate, args.n)
+    fb = synth_batch(rng, args.n, args.v6_fraction, args.ifindex)
+
+    fp = int(args.file_packets)
+    n_files = -(-args.n // fp)
+    # each file drops at its FIRST packet's scheduled arrival; the
+    # sidecar manifest records per-packet offsets so a measuring
+    # consumer can reconstruct scheduled arrival times
+    file_starts = offs[::fp][:n_files]
+    summary = {
+        "n": int(args.n), "rate_pps": float(args.rate),
+        "process": f"burst:{args.burst}" if args.burst > 0 else "poisson",
+        "files": int(n_files), "file_packets": fp,
+        "duration_s": float(offs[-1]), "seed": int(args.seed),
+    }
+    print(json.dumps(summary), flush=True)
+    if args.dry_run:
+        return 0
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "loadgen-manifest.json"), "w") as f:
+        json.dump({**summary,
+                   "file_start_offsets_s": [float(x) for x in file_starts]},
+                  f)
+    t0 = time.monotonic()
+    worst_lag = 0.0
+    for i in range(n_files):
+        target = t0 + float(file_starts[i])
+        lag = time.monotonic() - target
+        if lag < 0:
+            time.sleep(-lag)
+        else:
+            worst_lag = max(worst_lag, lag)
+        # slice this file's window out of the contiguous frames buffer
+        # (three array slices, no per-frame Python)
+        lo = i * fp
+        hi = min(lo + fp, args.n)
+        start = int(fb.offsets[lo])
+        end = int(fb.offsets[hi]) if hi < len(fb) else len(fb.buf)
+        sub = FramesBuf.from_lengths(
+            np.asarray(fb.buf[start:end]),
+            np.asarray(fb.lengths[lo:hi]),
+            np.asarray(fb.ifindex[lo:hi]),
+        )
+        write_frames_file_v2(
+            os.path.join(args.out, f"load{i:06d}.frames"), sub
+        )
+    done = time.monotonic() - t0
+    print(json.dumps({
+        "offered_duration_s": float(offs[-1]),
+        "actual_duration_s": done,
+        "worst_schedule_lag_s": worst_lag,
+        "fell_behind": worst_lag > 0.01,
+    }), flush=True)
+    if worst_lag > 0.01:
+        print("loadgen: WARNING fell behind its open-loop schedule by "
+              f"{worst_lag*1e3:.1f} ms — offered load was lower than "
+              "requested; measured latencies must use the manifest's "
+              "scheduled offsets, not file mtimes", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
