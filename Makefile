@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench bench-checked build-bench slo-bench native \
-	entry-check dryrun-multichip mesh-check spill-read wire-check lint \
-	static-check state-check clean
+.PHONY: test test-fast bench bench-checked build-bench slo-bench \
+	churn-bench native entry-check dryrun-multichip mesh-check \
+	spill-read wire-check lint static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -69,6 +69,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --strict
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cskip
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -121,10 +122,21 @@ build-bench:
 slo-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --slo-bench
 
+# The update-storm churn tier (bench.bench_churn) standalone at a smoke
+# load off-TPU: folded 64-edit transaction vs the sequential
+# one-edit-one-generation path (amortized per-edit A/B, gated on
+# INFW_CHURN_SPEEDUP_MIN, default 5x), plus sustained edits/s under a
+# fixed offered classify load with p99 edit-visible latency and a
+# classify-throughput retention gate (INFW_CHURN_RETENTION_MIN, default
+# 0.9).  The statecheck multi-op transaction equivalence (txn configs)
+# runs inside the gate BEFORE any record is published.
+churn-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --churn-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

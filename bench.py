@@ -64,6 +64,7 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1843,6 +1844,295 @@ def slo_bench_main() -> int:
     return 0
 
 
+# --- update-storm churn tier: batched multi-edit patch transactions --------
+
+
+def bench_churn(rng, on_tpu):
+    """ISSUE-9 churn tier: sustained rule edits concurrent with
+    classification, on both trie-path layouts (the per-level poptrie
+    walk and the compressed ctrie).
+
+    Lines per layout, all in one record:
+    - amortized per-edit device latency of a folded 64-edit transaction
+      (ONE updater apply + ONE load_tables: one H2D staging pass, one
+      fused scatter launch) vs the sequential one-edit-one-generation
+      path — same-record A/B, INTERLEAVED rounds min-vs-min so ambient
+      host load cannot skew the ratio (the build-bench discipline);
+    - sustained edits/s actually flushed while serving a FIXED offered
+      classify load (open loop, Poisson arrivals), p99 edit-visible
+      latency (enqueue -> flush completion, the bounded-staleness
+      metric), and classify-throughput retention vs an idle (no-churn)
+      run of the same offered load.
+
+    Returns {<layout>: {speedup, retention, p99_visible_ms, ...}} for
+    the churn-bench regression gate."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import IncrementalTables
+    from infw.scheduler import (
+        ContinuousScheduler,
+        DeadlinePolicy,
+        ServiceModel,
+        batch_ladder,
+        prewarm_ladder,
+    )
+    from infw.txn import EditOp, TxnApplier, TxnBatcher, TxnStats
+
+    n_entries = 1_000_000 if on_tpu else 4_000
+    width = 4
+    batch_b = 64
+    rounds = 2
+    max_batch = 1024 if on_tpu else 256
+    tier = (f"{n_entries/1e6:.0f}M" if n_entries >= 1_000_000
+            else f"{n_entries // 1000}K")
+    out = {}
+    for layout in ("trie", "ctrie"):
+        t0 = time.perf_counter()
+        tables = testing.random_tables_fast(
+            rng, n_entries=n_entries, width=width, ifindexes=(2, 3, 4),
+        )
+        it = IncrementalTables.from_content(tables.content,
+                                            rule_width=width)
+        clf = TpuClassifier(force_path=layout, wire_codec="wire8")
+        clf.load_tables(it.snapshot())
+        it.clear_dirty()
+        if clf.active_path != layout:
+            log(f"churn[{layout}]: layout declined (serving "
+                f"{clf.active_path}); skipping")
+            clf.close()
+            continue
+        log(f"churn[{layout}]: table build+load "
+            f"{time.perf_counter()-t0:.1f}s ({n_entries} entries)")
+        txn_stats = TxnStats()
+        applier = TxnApplier(clf, it, stats=txn_stats)
+        keys = list(it.content)
+        edit_rng = np.random.default_rng(4242)
+
+        def mk_edits(n, keys=keys, edit_rng=edit_rng):
+            # rules-only edits on live keys: the churn hot path (adds/
+            # deletes ride the same fold; their structural cost is
+            # measured by the incremental-update tier)
+            picks = edit_rng.choice(len(keys), size=n, replace=False)
+            return [
+                EditOp("rules_edit", keys[int(i)],
+                       testing.random_rules(edit_rng, width))
+                for i in picks
+            ]
+
+        # -- A/B: folded transaction vs sequential, interleaved ----------
+        applier.apply(mk_edits(1))  # warm both paths' first-edit cost
+        seq_best = txn_best = float("inf")
+        for _r in range(rounds):
+            edits = mk_edits(batch_b)
+            t0 = time.perf_counter()
+            for e in edits:
+                rep = applier.apply([e], reason="manual")
+                assert rep.mode == "patch", (
+                    "sequential edit fell off the patch path"
+                )
+            seq_best = min(seq_best,
+                           (time.perf_counter() - t0) / batch_b)
+            edits = mk_edits(batch_b)
+            t0 = time.perf_counter()
+            rep = applier.apply(edits, reason="manual")
+            assert rep.mode == "patch", (
+                "folded transaction fell off the patch path"
+            )
+            txn_best = min(txn_best,
+                           (time.perf_counter() - t0) / batch_b)
+        speedup = seq_best / max(txn_best, 1e-9)
+        log(f"churn[{layout}]: per-edit seq {seq_best*1e3:.2f} ms vs "
+            f"txn@{batch_b} {txn_best*1e3:.2f} ms -> {speedup:.1f}x")
+        emit(
+            f"churn amortized per-edit device latency @{tier} "
+            f"({layout}, folded txn batch={batch_b}: one fused patch "
+            "generation)",
+            txn_best * 1e3, "ms", vs_baseline=round(speedup, 2),
+        )
+        emit(
+            f"churn per-edit device latency @{tier} ({layout}, "
+            "sequential one-edit-one-generation baseline, A/B same "
+            "record)",
+            seq_best * 1e3, "ms", vs_baseline=0.0,
+        )
+
+        # -- sustained churn under a fixed offered classify load ---------
+        service = ServiceModel()
+        prewarm_ladder(clf, batch_ladder(max_batch),
+                       include_depth_classes=False, service=service)
+        n_pkts = 32_000 if on_tpu else 8_000
+        probe = testing.random_batch_fast(rng, it.snapshot(), n_pkts)
+        t0 = time.perf_counter()
+        ContinuousScheduler(
+            clf, DeadlinePolicy(0.5, max_batch, service=service),
+            pipeline_depth=4,
+        ).serve(probe, np.zeros(n_pkts))
+        r0 = n_pkts / max(time.perf_counter() - t0, 1e-6)
+        offered = max(0.3 * r0, 500.0)
+        n = int(min(max(offered * 2.0, 4_000), 100_000))
+        batch = testing.random_batch_fast(rng, it.snapshot(), n)
+        offs = testing.poisson_arrivals(
+            np.random.default_rng(77), offered, n
+        )
+
+        def run_serve(with_churn: bool):
+            policy = DeadlinePolicy(0.5, max_batch, service=service)
+            visible: list = []
+            stop = threading.Event()
+            churner = None
+            batcher = None
+            flushed = [0]
+            if with_churn:
+                batcher = TxnBatcher(
+                    staleness_s=0.002, max_ops=batch_b
+                )
+
+                def flush(items, reason):
+                    applier.apply(
+                        [op for op, _ts in items], reason=reason,
+                        enqueue_ts=[ts for _op, ts in items],
+                    )
+                    t_done = time.monotonic()
+                    visible.extend(t_done - ts for _op, ts in items)
+                    flushed[0] += len(items)
+
+                edit_rate = 2000.0 if on_tpu else 400.0
+
+                def churn_loop():
+                    # open loop: edits queue on their absolute schedule
+                    t_anchor = time.monotonic()
+                    i = 0
+                    while not stop.is_set():
+                        target = t_anchor + i / edit_rate
+                        dt = target - time.monotonic()
+                        if dt > 0:
+                            stop.wait(min(dt, 0.05))
+                            continue
+                        for e in mk_edits(8):
+                            batcher.queue(e)
+                        i += 8
+
+                churner = threading.Thread(
+                    target=churn_loop, daemon=True
+                )
+                churner.start()
+                sched = ContinuousScheduler(
+                    clf, policy, pipeline_depth=4,
+                    txn_batcher=batcher, txn_flush=flush,
+                )
+            else:
+                sched = ContinuousScheduler(clf, policy, pipeline_depth=4)
+            t0 = time.perf_counter()
+            res = sched.serve(batch, offs)
+            elapsed = time.perf_counter() - t0
+            # snapshot the IN-WINDOW accounting before draining
+            # leftovers: the end-of-stream flush keeps the device state
+            # and staleness histogram complete, but its edits landed
+            # outside the timed window and must not inflate the
+            # published edits/s or skew the p99 with teardown time
+            n_flushed_in_window = flushed[0]
+            visible_in_window = list(visible)
+            if churner is not None:
+                stop.set()
+                churner.join()
+                leftovers = batcher.drain()
+                if leftovers:
+                    flush(leftovers, "eof")
+            return res, elapsed, visible_in_window, n_flushed_in_window
+
+        _res_i, idle_s, _v, _f = run_serve(False)
+        res_c, churn_s, visible, n_flushed = run_serve(True)
+        idle_pps = n / idle_s
+        churn_pps = n / churn_s
+        retention = churn_pps / max(idle_pps, 1e-9)
+        eps = n_flushed / max(churn_s, 1e-9)
+        p99_vis = (
+            float(np.percentile(np.asarray(visible) * 1e3, 99))
+            if visible else 0.0
+        )
+        st = txn_stats.snapshot()
+        log(f"churn[{layout}]: offered {offered:.0f} pps, idle "
+            f"{idle_pps:.0f} pps vs churn {churn_pps:.0f} pps "
+            f"(retention {100*retention:.1f}%), {eps:.0f} edits/s "
+            f"flushed, p99 edit-visible {p99_vis:.1f} ms, txn stats "
+            f"{st['txns']} txns / {st['ops']} ops / "
+            f"{st['escalations']} escalations")
+        emit(
+            f"churn sustained edit rate @{tier} ({layout}, flushed "
+            "while serving the fixed offered classify load)",
+            eps, "edits/s", vs_baseline=0.0,
+        )
+        emit(
+            f"churn p99 edit-visible latency @{tier} ({layout}, "
+            "enqueue -> flush completion, 2 ms staleness budget)",
+            p99_vis, "ms", vs_baseline=0.0,
+        )
+        emit(
+            f"churn classify-throughput retention @{tier} ({layout}, "
+            f"achieved at fixed offered load vs idle baseline, "
+            f"offered {offered:.0f} pkts/s)",
+            100.0 * retention, "percent",
+            vs_baseline=round(retention, 3),
+        )
+        out[layout] = {
+            "speedup": float(speedup),
+            "seq_ms": float(seq_best * 1e3),
+            "txn_ms": float(txn_best * 1e3),
+            "retention": float(retention),
+            "p99_visible_ms": p99_vis,
+            "edits_per_s": float(eps),
+        }
+        clf.close()
+    return out
+
+
+def churn_bench_main() -> int:
+    """``make churn-bench``: the churn tier standalone (CPU smoke off
+    TPU) with the regression gates — the folded transaction's amortized
+    per-edit cost must beat the sequential path by
+    INFW_CHURN_SPEEDUP_MIN (default 5x, the ISSUE-9 acceptance) and
+    classify-throughput retention under churn must stay above
+    INFW_CHURN_RETENTION_MIN (default 0.9).  The statecheck multi-op
+    equivalence (txn config: cold-rebuild bit-identity + per-op-ground-
+    truth oracle parity) runs FIRST and gates record publication."""
+    speedup_min = float(os.environ.get("INFW_CHURN_SPEEDUP_MIN", "5.0"))
+    retention_min = float(os.environ.get("INFW_CHURN_RETENTION_MIN", "0.9"))
+    from infw.analysis import statecheck
+
+    for cfg in ("txn", "txn-ctrie"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        if not rep["ok"]:
+            log(f"churn-bench FAIL: statecheck {cfg} not green before "
+                f"record publication: {rep['failure']}")
+            return 1
+        log(f"churn-bench: statecheck {cfg} green "
+            f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_churn(rng, on_tpu)
+    emit_compact_record()
+    if not rec:
+        log("churn-bench FAIL: no layout produced a record")
+        return 1
+    rc = 0
+    for layout, r in rec.items():
+        if not r["speedup"] >= speedup_min:
+            log(f"churn-bench FAIL[{layout}]: txn speedup "
+                f"{r['speedup']:.2f}x < gate {speedup_min}x")
+            rc = 1
+        if not r["retention"] >= retention_min:
+            log(f"churn-bench FAIL[{layout}]: classify retention "
+                f"{r['retention']:.3f} < gate {retention_min}")
+            rc = 1
+    if rc == 0:
+        log("churn-bench OK: " + ", ".join(
+            f"{la}: {r['speedup']:.1f}x speedup, "
+            f"{100*r['retention']:.1f}% retention"
+            for la, r in rec.items()
+        ))
+    return rc
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -2127,6 +2417,15 @@ def main():
         bench_slo(rng, on_tpu)
     except Exception as e:
         log(f"slo tier FAILED: {e}")
+    try:
+        # ISSUE-9 update-storm churn tier: folded-txn-vs-sequential
+        # per-edit A/B + sustained edits/s under fixed offered classify
+        # load + p99 edit-visible latency + throughput retention (also
+        # standalone as `bench.py --churn-bench`, `make churn-bench`,
+        # with speedup/retention gates)
+        bench_churn(rng, on_tpu)
+    except Exception as e:
+        log(f"churn tier FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
     # contiguous block, then ONE compact single-line JSON holding the
@@ -2149,4 +2448,6 @@ if __name__ == "__main__":
         sys.exit(build_bench_main())
     if "--slo-bench" in sys.argv:
         sys.exit(slo_bench_main())
+    if "--churn-bench" in sys.argv:
+        sys.exit(churn_bench_main())
     sys.exit(main())

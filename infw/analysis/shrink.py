@@ -113,9 +113,16 @@ def shrink_case(
     """Deterministically shrink a failing case.  Phases, in order:
 
     1. truncate after the failing step (free — no re-run);
-    2. greedy op removal, last-to-first, to a fixpoint;
+    2. chunked op removal (ddmin: halving window sizes down to
+       singles) — a long transaction-heavy sequence drops whole spans
+       per re-run instead of one op at a time, so the budget reaches
+       the minimal pair even from a 16+-op case;
     3. chunked base-table removal (halving chunk sizes, ddmin-style);
-    4. witness-batch halving.
+    4. witness-batch halving;
+    5. a final op-removal re-pass — base/witness shrinking can unlock
+       removals that failed in phase 2 (an op only "needed" to seed a
+       witness hit that the smaller witness no longer requires), and
+       the re-runs are cheap now that the case is small.
 
     Every kept candidate must still fail (any phase/step counts as "still
     failing" — a shrink that morphs a classify divergence into a contract
@@ -130,18 +137,30 @@ def shrink_case(
 
     ops = _truncate(list(ops), failure)
 
-    # -- phase 2: greedy op removal -----------------------------------------
-    changed = True
-    while changed and len(ops) > 1:
-        changed = False
-        for i in reversed(range(len(ops))):
-            cand = ops[:i] + ops[i + 1:]
-            f2 = rerun(base, cand, witness_b)
-            if f2 is not None:
-                ops = _truncate(cand, f2)
-                failure = f2
-                changed = True
+    def shrink_ops() -> None:
+        nonlocal ops, failure
+        chunk = max(len(ops) // 2, 1)
+        while len(ops) > 1 and budget.left > 0:
+            removed = False
+            i = 0
+            while i < len(ops) and budget.left > 0:
+                cand = ops[:i] + ops[i + chunk:]
+                if len(cand) == len(ops):
+                    break
+                f2 = rerun(base, cand, witness_b)
+                if f2 is not None:
+                    ops = _truncate(cand, f2)
+                    failure = f2
+                    removed = True
+                    # stay at i: the window now holds different ops
+                else:
+                    i += chunk
+            if chunk == 1 and not removed:
                 break
+            chunk = max(chunk // 2, 1)
+
+    # -- phase 2: chunked op removal (ddmin) --------------------------------
+    shrink_ops()
 
     # -- phase 3: base-table shrink -----------------------------------------
     keys = sorted(
@@ -174,6 +193,10 @@ def shrink_case(
         wb //= 2
         ops = _truncate(ops, f2)
         failure = f2
+
+    # -- phase 5: final op-removal re-pass ----------------------------------
+    witness_b = wb
+    shrink_ops()
 
     return Repro(
         config=cfg, base=base, ops=ops, witness_b=wb, failure=failure,

@@ -33,11 +33,22 @@ This module proves the state transitions instead of spot-checking them:
   reduces the op sequence, the base table and the witness batch to a
   minimal reproducer and prints it as a paste-able test case.
 
+Transaction configs (``txn``/``txn-overlay``/``txn-ctrie``) extend the
+engine to batched multi-edit flushes: single-key ops buffer at
+``txn_flush`` boundaries and apply as ONE folded transaction through
+the production fold (``infw.txn.fold_ops``), with the oracle checking
+against per-op ground truth — so a fold bug that corrupts the updater
+(and therefore both the resident state and its cold rebuild) still
+diverges at the witness batch, and the shrinker minimizes over
+transaction boundaries like any other op.
+
 CLI: ``tools/infw_lint.py state`` (``--json/--strict/--seed/--ops``);
 ``make state-check`` is the repo gate, including the injected-defect
-acceptance (``--inject-defect`` re-introduces the PR-4 bug behind
-``jaxpath._INJECT_JOINED_PAD_BUG`` and proves the checker catches it
-with a shrunk reproducer).
+acceptances (``--inject-defect`` re-introduces the PR-4 bug behind
+``jaxpath._INJECT_JOINED_PAD_BUG``; ``--inject-defect fold`` drops
+delete-then-readd pairs in the transaction fold behind
+``txn._INJECT_FOLD_BUG`` — each must be caught with a shrunk
+reproducer).
 """
 from __future__ import annotations
 
@@ -83,6 +94,16 @@ EDIT_KINDS = (
     "full_replace",   # rebuild the updater from current content
 )
 
+#: explicit transaction-boundary record (txn-mode configs only): the
+#: driver buffers single-key ops and applies them as ONE folded
+#: transaction (infw.txn.fold_ops) at each boundary — checks run only
+#: at settled (flushed) states, because un-flushed ops are intentionally
+#: not yet visible on device (bounded staleness).  Not part of
+#: EDIT_KINDS: the generator inserts boundaries on top of the sampled
+#: alphabet, and the shrinker minimizes over them like any other op
+#: (dropping a boundary merges two transactions).
+TXN_FLUSH = "txn_flush"
+
 
 @dataclass
 class EditOp:
@@ -99,8 +120,8 @@ class EditOp:
     items: Tuple[Tuple[LpmKey, np.ndarray], ...] = ()
 
     def describe(self) -> str:
-        if self.kind == "full_replace":
-            return "full_replace"
+        if self.kind in ("full_replace", TXN_FLUSH):
+            return self.kind
         if self.kind == "overlay_spill":
             return f"overlay_spill(+{len(self.items)} keys)"
         k = self.key
@@ -166,6 +187,13 @@ class StateConfig:
     wide: bool = False              # seed one wide ruleId (u32 results path)
     wide_edit_p: float = 0.0        # P(a rules_edit introduces a wide ruleId)
     witness_b: int = 192
+    #: > 0 = transaction mode: single-key ops buffer and apply as ONE
+    #: folded transaction (infw.txn.fold_ops) at txn_flush boundaries,
+    #: inserted by the generator with mean transaction size ``txn``;
+    #: the oracle compares against per-op ground truth, so a fold bug
+    #: (op semantics lost in the coalesce) diverges even when the
+    #: resident state and the cold rebuild share it
+    txn: int = 0
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -195,6 +223,16 @@ CONFIGS: Dict[str, StateConfig] = {
         StateConfig("ctrie-overlay", force_path="ctrie", overlay=True),
         StateConfig("ctrie-fused", n_entries=56, v6_fraction=0.85,
                     force_path="ctrie", fused_deep=True, steered=True),
+        # batched multi-edit transactions (ISSUE-9): single-key ops fold
+        # through infw.txn.fold_ops and land as ONE device generation
+        # per txn_flush boundary; the generator additionally samples
+        # delete-then-readd pairs (the fold's annihilation/supersession
+        # edge) and the oracle checks against per-op ground truth.  The
+        # fold injected-defect acceptance (infw_lint state
+        # --inject-defect fold) runs the plain "txn" config.
+        StateConfig("txn", steered=True, txn=3),
+        StateConfig("txn-overlay", overlay=True, txn=3),
+        StateConfig("txn-ctrie", force_path="ctrie", steered=True, txn=3),
     )
 }
 
@@ -293,13 +331,39 @@ def generate_ops(
     keys: List[LpmKey] = list(base_content)
     idents = {k.masked_identity() for k in keys}
     key_rules = {k: np.asarray(v) for k, v in base_content.items()}
+    #: keys deleted earlier in the sequence, available for the txn-mode
+    #: delete-then-readd sample — the fold's supersession edge (and the
+    #: substrate of the injected fold defect)
+    deleted: List[LpmKey] = []
     ops: List[EditOp] = []
+
+    def maybe_boundary() -> None:
+        if config.txn and rng.random() < 1.0 / max(config.txn, 1):
+            ops.append(EditOp(kind=TXN_FLUSH))
+
     for _ in range(n_ops):
         kind = str(rng.choice(kinds, p=probs))
         if kind in ("rules_edit", "order_change", "key_delete") and not keys:
             kind = "key_add"
+        if (
+            config.txn and kind in ("key_add", "cidr_add")
+            and deleted and rng.random() < 0.5
+        ):
+            # re-add a previously deleted identity with fresh rules:
+            # within one transaction this folds delete+readd into an
+            # upsert — exactly the edge the fold defect corrupts
+            k = deleted.pop(int(rng.integers(0, len(deleted))))
+            if k.masked_identity() not in idents:
+                r = _sample_rules(config, rng)
+                idents.add(k.masked_identity())
+                keys.append(k)
+                key_rules[k] = r
+                ops.append(EditOp(kind=kind, key=k, rules=r))
+                maybe_boundary()
+                continue
         if kind == "full_replace":
             ops.append(EditOp(kind="full_replace"))
+            maybe_boundary()
             continue
         if kind == "overlay_spill":
             items = []
@@ -311,6 +375,7 @@ def generate_ops(
                 key_rules[k] = r
                 items.append((k, r))
             ops.append(EditOp(kind="overlay_spill", items=tuple(items)))
+            maybe_boundary()
             continue
         if kind in ("key_add", "cidr_add"):
             k = _sample_key(config, rng, idents)
@@ -319,6 +384,7 @@ def generate_ops(
             keys.append(k)
             key_rules[k] = r
             ops.append(EditOp(kind=kind, key=k, rules=r))
+            maybe_boundary()
             continue
         i = int(rng.integers(0, len(keys)))
         k = keys[i]
@@ -326,7 +392,9 @@ def generate_ops(
             keys.pop(i)
             idents.discard(k.masked_identity())
             key_rules.pop(k, None)
+            deleted.append(k)
             ops.append(EditOp(kind="key_delete", key=k))
+            maybe_boundary()
             continue
         if kind == "order_change":
             r = _permuted_rules(rng, key_rules.get(k, np.zeros((config.width, 7))))
@@ -337,6 +405,7 @@ def generate_ops(
             r = _sample_rules(config, rng)
         key_rules[k] = r
         ops.append(EditOp(kind=kind, key=k, rules=r))
+        maybe_boundary()
     return ops
 
 
@@ -821,6 +890,18 @@ class _Driver:
         )
         self.overlay: Dict[LpmKey, np.ndarray] = {}
         self._ov_memo: Optional[CompiledTables] = None
+        #: txn-mode buffer: single-key ops accumulate here and apply as
+        #: ONE folded transaction at each txn_flush boundary
+        self.pending: List[EditOp] = []
+        #: per-op ground truth (masked identity -> (key, rules)),
+        #: independent of any folding: the classify oracle compares
+        #: against THIS, so a fold bug that corrupts the updater — and
+        #: therefore both the resident device state and its cold
+        #: rebuild — still diverges at the witness batch
+        self.model: Dict[tuple, Tuple[LpmKey, np.ndarray]] = {
+            k.masked_identity(): (k, np.asarray(v))
+            for k, v in base_content.items()
+        }
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 
@@ -901,7 +982,73 @@ class _Driver:
             )
         self._load()
 
-    def apply(self, op: EditOp) -> None:
+    def apply(self, op: EditOp) -> bool:
+        """Apply one op; returns True when the device state is SETTLED
+        (reflects every op so far — checks may run), False when the op
+        was buffered into a pending transaction (txn-mode bounded
+        staleness: un-flushed ops are intentionally not yet visible)."""
+        self._model_update(op)
+        if self.config.txn:
+            if op.kind == TXN_FLUSH:
+                self.flush_pending()
+                return True
+            if op.kind in ("overlay_spill", "full_replace"):
+                # driver-level ops settle the world: flush the pending
+                # transaction first, then run them standalone
+                self.flush_pending()
+                self._apply_one(op)
+                return True
+            self.pending.append(op)
+            return False
+        if op.kind != TXN_FLUSH:  # boundary records no-op outside txn mode
+            self._apply_one(op)
+        return True
+
+    def _model_update(self, op: EditOp) -> None:
+        if op.kind in (TXN_FLUSH, "full_replace"):
+            return
+        if op.kind == "overlay_spill":
+            for k, r in op.items:
+                self.model[k.masked_identity()] = (k, np.asarray(r))
+            return
+        ident = op.key.masked_identity()
+        if op.kind == "key_delete":
+            self.model.pop(ident, None)
+        else:
+            self.model[ident] = (op.key, np.asarray(op.rules))
+
+    def flush_pending(self) -> None:
+        """Apply the buffered ops as ONE folded transaction through the
+        production fold (infw.txn.fold_ops) and the driver's syncer-
+        mirrored routing — one batched updater apply, one device load
+        (the update-storm flush, distilled)."""
+        ops, self.pending = self.pending, []
+        if not ops:
+            return
+        from ..txn import fold_ops, route_folded
+
+        cfg = self.config
+        existing = set(self.updater._ident_to_t) | {
+            k.masked_identity() for k in self.overlay
+        }
+        folded = fold_ops(ops, existing)
+        # the PRODUCTION routing, verbatim (txn.route_folded is what the
+        # syncer and TxnApplier call): the checker must exercise the
+        # exact overlay/spill logic that serves, not a mirror of it
+        overlay_ok = cfg.overlay and getattr(
+            self.clf, "supports_overlay", False
+        )
+        ups, dels, ov_dirty = route_folded(
+            folded, self.overlay, overlay_ok, cfg.overlay_cap
+        )
+        if ov_dirty:
+            self._ov_memo = None
+        if ups or dels:
+            self._apply_main(ups, dels)
+        else:
+            self._load()
+
+    def _apply_one(self, op: EditOp) -> None:
         cfg = self.config
         if op.kind == "full_replace":
             content = dict(self.updater.content)
@@ -1069,9 +1216,14 @@ class _Driver:
                 return Failure(step, "walk",
                                "patched fused-walk tables diverged from "
                                "the cold rebuild", m)
-        # -- classify equivalence vs the CPU oracle over the merged spec --
-        merged = dict(self.updater.content)
-        merged.update(self.overlay)
+        # -- classify equivalence vs the CPU oracle over the PER-OP
+        # ground truth (self.model, maintained op by op, never folded):
+        # for the plain configs this equals updater.content + overlay;
+        # for txn configs it is deliberately independent, so a fold bug
+        # that corrupts the updater — and therefore both the resident
+        # device state and its cold rebuild — still diverges here (the
+        # cskip pattern: the catch comes from oracle divergence)
+        merged = {k: r for (k, r) in self.model.values()}
         model = compile_tables_from_content(
             merged, rule_width=self.config.width
         )
@@ -1123,7 +1275,14 @@ def run_ops(
     """Run one op sequence through the equivalence engine; returns the
     first Failure, or None when every prefix checks out.  ``config`` is
     a CONFIGS name or a StateConfig; reproducers emitted by the shrinker
-    call exactly this function."""
+    call exactly this function.
+
+    Transaction configs (cfg.txn > 0) check every SETTLED state instead
+    of every prefix: single-key ops buffer until a txn_flush boundary
+    (or a driver-level op, or end of sequence) applies them as one
+    folded transaction — un-flushed ops are intentionally not yet
+    visible (bounded staleness), so checking mid-transaction would
+    report the staleness the design permits, not a bug."""
     cfg = CONFIGS[config] if isinstance(config, str) else config
     wb = witness_b or cfg.witness_b
     try:
@@ -1139,14 +1298,32 @@ def run_ops(
             return f
         for i, op in enumerate(ops):
             try:
-                drv.apply(op)
+                settled = drv.apply(op)
                 if cfg.fused_deep:
                     _drain_walk_rebuilds()
             except Exception as e:
                 return Failure(i, "load-error",
                                f"{op.describe()} raised "
                                f"{type(e).__name__}: {e}")
+            if not settled:
+                continue
             f = drv.check(i)
+            if f is not None:
+                return f
+        if drv.pending:
+            # implicit end-of-sequence flush: a transaction in flight
+            # when the sequence ends must still settle and check (also
+            # what lets the shrinker drop trailing txn_flush records)
+            last = len(ops) - 1
+            try:
+                drv.flush_pending()
+                if cfg.fused_deep:
+                    _drain_walk_rebuilds()
+            except Exception as e:
+                return Failure(last, "load-error",
+                               f"final txn flush raised "
+                               f"{type(e).__name__}: {e}")
+            f = drv.check(last)
             if f is not None:
                 return f
         return None

@@ -26,7 +26,14 @@ replicated overlay combine.  Rule loading on those configurations keeps
 the single-chip incremental contract: a 1-key rules edit diff-scatter
 patches the mesh-resident arrays (the small patch rows broadcast to
 every chip — kilobytes), and a structural CIDR add ships as the
-broadcast overlay side-table, the main trie untouched.
+broadcast overlay side-table, the main trie untouched.  A folded edit
+TRANSACTION (infw.txn) rides the same machinery: the replicated
+NamedSharding stands in for the device in the fused transaction scatter
+(jaxpath.txn_scatter), so one flush broadcasts its merged dirty-row
+payload to every chip in one staging pass + one launch — the
+update-storm path needs no mesh-specific code.  The rules-sharded
+configurations re-place per load as always, so a transaction flush
+against them costs one re-place, not a broadcast patch.
 
 The rules-sharded configurations rebuild their per-shard partition on
 every load (the round-robin entry split renumbers shard membership on

@@ -159,7 +159,17 @@ class TpuClassifier:
         ``dirty_hint`` (IncrementalTables.peek_dirty()) accelerates the
         incremental device patch: with it, the patch scatters exactly the
         hinted rows with NO full-table host diff — a 1-key edit costs a
-        couple of small transfers regardless of table size.
+        couple of small transfers regardless of table size.  The hint is
+        also how a FOLDED edit transaction (infw.txn) lands: N coalesced
+        edits arrive as one merged dirty-row set, one H2D staging pass
+        and one fused scatter launch (jaxpath.txn_scatter, pre-warmed
+        across the dirty-row ladder at full-load time), so per-edit
+        device cost amortizes toward O(dirty rows).  A transaction that
+        exceeds the capped-scatter budget or forces trie renumbering
+        past the row buckets escalates to the full rebuild below — the
+        OLD generation keeps serving until the swap (the double-buffer
+        contract), so classification never stalls on an oversized
+        flush.
 
         ``overlay`` is a SMALL dense side-table of structurally-new keys
         (CIDR adds since the main table's last full build): it uploads in

@@ -309,6 +309,8 @@ class Daemon:
         mesh: Optional[str] = None,
         deadline_us: Optional[float] = None,
         max_batch: Optional[int] = None,
+        patch_staleness_us: Optional[float] = None,
+        patch_max_ops: Optional[int] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -346,12 +348,44 @@ class Daemon:
             # least one prepared batch ahead of the in-flight one
             self.h2d_stage_depth = max(2, self.h2d_stage_depth)
         self.registry = registry if registry is not None else default_registry
+        # Update-storm edit batching (infw.txn): rule edits dropped into
+        # <state-dir>/edits/ queue in a TxnBatcher and flush as ONE
+        # folded patch transaction when (a) the oldest edit exceeds the
+        # staleness deadline (--patch-staleness-us/INFW_PATCH_STALENESS_US)
+        # or (b) the batch threshold (--patch-max-ops) trips — checked
+        # between classify admissions inside the ingest tick AND on the
+        # idle file loop, so edits never stall classification and
+        # verdict staleness stays bounded.  Counters + the staleness
+        # histogram export on /metrics; each flush emits a
+        # PatchTxnRecord on the obs event ring.
+        from .txn import (
+            DEFAULT_MAX_OPS,
+            DEFAULT_STALENESS_US,
+            TxnBatcher,
+            TxnStats,
+        )
+
+        self.patch_staleness_us = float(
+            patch_staleness_us if patch_staleness_us is not None
+            else DEFAULT_STALENESS_US
+        )
+        self.patch_max_ops = int(patch_max_ops or DEFAULT_MAX_OPS)
+        self.txn_stats = TxnStats()
+        self.txn_batcher = TxnBatcher(
+            staleness_s=self.patch_staleness_us * 1e-6,
+            max_ops=self.patch_max_ops,
+        )
+        # at most one flush in flight, on its own thread (see
+        # _maybe_flush_edits); only the file loop mutates this
+        self._edit_flush_thread = None
 
         self.nodestates_dir = os.path.join(state_dir, "nodestates")
         self.ingest_dir = os.path.join(state_dir, "ingest")
+        self.edits_dir = os.path.join(state_dir, "edits")
         self.out_dir = os.path.join(state_dir, "out")
         self.events_path = os.path.join(state_dir, "events.log")
-        for d in (self.nodestates_dir, self.ingest_dir, self.out_dir):
+        for d in (self.nodestates_dir, self.ingest_dir, self.edits_dir,
+                  self.out_dir):
             os.makedirs(d, exist_ok=True)
 
         if backend == "tpu":
@@ -429,6 +463,9 @@ class Daemon:
         self.metrics_registry.register_counters(self._wire_counters)
         if self.sched_stats is not None:
             self.metrics_registry.register_counters(self.sched_stats)
+        # patch-transaction counters + staleness histogram
+        # (ingressnodefirewall_node_patch_txn_*)
+        self.metrics_registry.register_counters(self.txn_stats)
         self.debug_buffer = DebugLookupBuffer()
 
         self._stop = threading.Event()
@@ -535,6 +572,94 @@ class Daemon:
                     self.syncer.sync_interface_ingress_rules({}, True)
                 except (SyncError, CompileError, InterfaceError) as e:
                     log.error("delete sync failed for %s: %s", fn, e)
+
+    # -- rule-edit files (the update-storm control plane) --------------------
+
+    def scan_edits_once(self) -> int:
+        """Queue every edit file in <state-dir>/edits/ into the
+        transaction batcher (infw.txn edit-file protocol: one JSON doc
+        of single-key ops per file, written tmp+rename by
+        tools/churngen.py or any control plane).  Files are consumed in
+        sorted order; a deterministically bad file is removed and
+        logged, never wedging the scan.  Returns ops queued."""
+        from .txn import read_edit_file
+
+        n = 0
+        for fn in sorted(os.listdir(self.edits_dir)):
+            path = os.path.join(self.edits_dir, fn)
+            if fn.endswith(".tmp") or not os.path.isfile(path):
+                continue
+            if fn.endswith("-manifest.json"):
+                continue  # churngen's schedule sidecar, not an edit file
+            try:
+                ops = read_edit_file(path)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                log.error("bad edit file %s: %s", fn, e)
+                try:
+                    os.remove(path)
+                except OSError as re:
+                    log.error("could not remove bad edit file %s: %s",
+                              fn, re)
+                continue
+            self.txn_batcher.queue_many(ops)
+            n += len(ops)
+            try:
+                os.remove(path)
+            except OSError as e:
+                log.error("could not remove edit file %s: %s", fn, e)
+        return n
+
+    def _maybe_flush_edits(self, force: bool = False) -> bool:
+        """Start a flush of the queued edit transaction when the
+        bounded-staleness policy trips (or ``force``): ONE folded patch
+        generation through the syncer, counters + staleness histogram
+        into TxnStats, a PatchTxnRecord on the obs ring.  The flush runs
+        on its OWN thread (at most one in flight — later edits keep
+        coalescing toward the next transaction), so neither the ingest
+        tick's admissions nor the idle loop ever wait on it; in
+        particular an escalated columnar rebuild, which can take
+        seconds at the 1M tier, overlaps classification instead of
+        starving it (the scheduler-path slot contract, daemon half).
+        Returns True when a flush was started."""
+        batcher = getattr(self, "txn_batcher", None)
+        if batcher is None or len(batcher) == 0:
+            return False
+        t = getattr(self, "_edit_flush_thread", None)
+        if t is not None and t.is_alive():
+            return False  # one flush in flight; edits keep coalescing
+        reason = "manual" if force else batcher.should_flush()
+        if reason is None:
+            return False
+        if self.syncer.classifier is None or self.syncer.classifier.tables is None:
+            # no dataplane yet: keep queuing — the staleness clock keeps
+            # running, so the first sync is followed by a flush
+            return False
+        items = batcher.drain()
+        if not items:
+            return False
+
+        def work() -> None:
+            try:
+                self.syncer.apply_edit_transaction(
+                    [op for op, _ts in items], reason=reason,
+                    enqueue_ts=[ts for _op, ts in items],
+                    stats=self.txn_stats, ring=self.ring,
+                )
+            except Exception as e:
+                # a deterministically bad transaction must not re-queue
+                # forever; drop it with a loud log (the model checker
+                # and edit-file validation make this the rare path)
+                log.error(
+                    "edit transaction flush failed (%d ops dropped): %s",
+                    len(items), e,
+                )
+
+        th = threading.Thread(
+            target=work, name="infw-edit-flush", daemon=True
+        )
+        self._edit_flush_thread = th
+        th.start()
+        return True
 
     # -- ingest --------------------------------------------------------------
     #
@@ -962,7 +1087,17 @@ class Daemon:
 
         if sched_stats is not None:
             sched_stats.set_queue_depth(total)
+        edits_ok = hasattr(self, "txn_batcher")  # bench harness: __new__
         while jobs or staged or inflight:
+            # apply/classify interleaving: a tripped edit-transaction
+            # flush lands BETWEEN admissions — in-flight classifies keep
+            # running on the generation they were dispatched against,
+            # and the next launched job picks up the patched tables
+            if edits_ok:
+                try:
+                    self._maybe_flush_edits()
+                except Exception as e:
+                    log.error("edit flush error: %s", e)
             stage_more()
             while staged and len(inflight) < self.pipeline_depth:
                 job, prep = staged.popleft()
@@ -1077,6 +1212,11 @@ class Daemon:
                 self.scan_nodestates_once()
             except Exception as e:  # keep the loop alive
                 log.error("nodestate scan error: %s", e)
+            try:
+                self.scan_edits_once()
+                self._maybe_flush_edits()
+            except Exception as e:
+                log.error("edit scan error: %s", e)
             try:
                 self.process_ingest_once()
             except Exception as e:
@@ -1201,6 +1341,24 @@ def main(argv: Optional[List[str]] = None) -> int:
              "beats INFW_MAX_BATCH",
     )
     p.add_argument(
+        "--patch-staleness-us", type=float,
+        default=os.environ.get("INFW_PATCH_STALENESS_US") or None,
+        help="bounded verdict staleness for batched rule edits "
+             "(infw.txn): edits dropped into <state-dir>/edits/ "
+             "coalesce into ONE folded patch transaction and flush "
+             "when the oldest queued edit exceeds this budget (or "
+             "--patch-max-ops trips) — between classify admissions, "
+             "never stalling them.  Default 2000us.  CLI beats "
+             "INFW_PATCH_STALENESS_US",
+    )
+    p.add_argument(
+        "--patch-max-ops", type=int,
+        default=os.environ.get("INFW_PATCH_MAX_OPS") or None,
+        help="batch-size flush threshold for queued rule edits "
+             "(default 1024): a queue this deep flushes regardless of "
+             "staleness.  CLI beats INFW_PATCH_MAX_OPS",
+    )
+    p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
         help="unixgram socket to ship deny-event lines to (the events "
@@ -1231,6 +1389,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error(f"--deadline-us must be positive, got {args.deadline_us}")
     if args.max_batch is not None and args.max_batch < 1:
         p.error(f"--max-batch must be >= 1, got {args.max_batch}")
+    if args.patch_staleness_us is not None and not args.patch_staleness_us > 0:
+        p.error(
+            f"--patch-staleness-us must be positive, got "
+            f"{args.patch_staleness_us}"
+        )
+    if args.patch_max_ops is not None and args.patch_max_ops < 1:
+        p.error(f"--patch-max-ops must be >= 1, got {args.patch_max_ops}")
 
     # Same launch-time validation posture as the wire codec: a bad
     # INFW_MESH (or --mesh) must fail here with a usage error, not raise
@@ -1280,6 +1445,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh=args.mesh,
         deadline_us=args.deadline_us,
         max_batch=args.max_batch,
+        patch_staleness_us=args.patch_staleness_us,
+        patch_max_ops=args.patch_max_ops,
     )
     stop = threading.Event()
 

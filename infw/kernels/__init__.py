@@ -305,6 +305,100 @@ def _build_pallas_cwalk(b: int):
     return fn, (wt, _fixture_device_batch(b))
 
 
+# -- transaction patch (update-storm flush) fixtures/builders ----------------
+#
+# The batched multi-edit patch path (jaxpath.txn_scatter /
+# patch_device_tables hint mode, patch_ctrie rules-only): a flushed edit
+# transaction lands as ONE fused dense-group scatter plus the joined
+# capped scatter.  Registered so the strict jax audit (transfer guard,
+# recompile lint, VMEM estimate) covers the executables the update-storm
+# dataplane launches per flush.
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_padded_tables():
+    from . import jaxpath
+
+    return jaxpath.device_tables(_fixture_tables(True), pad=True)
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_txn_payload(b: int):
+    """Device-resident fused-transaction payload over the dense group:
+    a ``min(b, budget)``-row dirty set padded to its capped shape —
+    exactly what a flushed b-edit rules-only transaction scatters."""
+    import jax
+
+    from . import jaxpath
+
+    dev = _fixture_padded_tables()
+    arrays = (dev.key_words, dev.mask_words, dev.mask_len, dev.rules)
+    nb = arrays[0].shape[0]
+    k = max(1, min(int(b), nb // 4))
+    idxs = []
+    rows = []
+    for a in arrays:
+        pay = jaxpath._capped_payload(
+            np.zeros(k, np.int64),
+            np.zeros((k,) + tuple(a.shape[1:]), a.dtype),
+            nb,
+        )
+        if pay is None:
+            raise EntrypointUnavailable(
+                f"txn payload of {k} rows exceeds the capped budget "
+                f"(nb={nb})"
+            )
+        idxs.append(jax.device_put(pay[0]))
+        rows.append(jax.device_put(pay[1]))
+    return arrays, tuple(idxs), tuple(rows)
+
+
+def _build_txn_scatter_dense(b: int):
+    from . import jaxpath
+
+    arrays, idxs, rows = _fixture_txn_payload(b)
+    fn = jaxpath.jitted_txn_scatter(len(arrays))
+    return fn, (arrays, idxs, rows)
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_ctrie_padded():
+    from . import jaxpath
+
+    r = jaxpath.device_ctrie(_fixture_tables(True), pad=True)
+    if r is None:
+        raise EntrypointUnavailable(
+            "compressed layout ineligible for the canonical fixture"
+        )
+    return r
+
+
+def _build_ctrie_joined_scatter(b: int):
+    """The compressed layout's rules-only transaction flush: the
+    per-tidx joined matrix capped scatter (patch_ctrie hot path)."""
+    import jax
+
+    from . import jaxpath
+
+    cdev, _d = _fixture_ctrie_padded()
+    nb = cdev.joined.shape[0]
+    k = max(1, min(int(b), nb // 4))
+    pay = jaxpath._capped_payload(
+        np.zeros(k, np.int64),
+        np.zeros((k, cdev.joined.shape[1]), np.uint16),
+        nb,
+    )
+    if pay is None:
+        raise EntrypointUnavailable(
+            f"joined payload of {k} rows exceeds the capped budget "
+            f"(nb={nb})"
+        )
+    fn = jaxpath._scatter_rows_jit()
+    return fn, (
+        cdev.joined, jax.device_put(pay[0]), jax.device_put(pay[1])
+    )
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -450,6 +544,12 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify/pallas-cwalk", "pallas", _build_pallas_cwalk
+        ),
+        KernelEntrypoint(
+            "patch/txn-scatter-dense", "xla", _build_txn_scatter_dense
+        ),
+        KernelEntrypoint(
+            "patch/ctrie-joined-scatter", "xla", _build_ctrie_joined_scatter
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

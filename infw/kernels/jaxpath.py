@@ -734,7 +734,7 @@ def _seed_ctrie_caches_forward(
                 new_packed[dirty] = new.rules[dirty].reshape(len(dirty), -1)
             object.__setattr__(new, "_packed_rules_cache", new_packed)
         for name in ("_poptrie_cache", "_cpoptrie_cache",
-                     "_depth_lut_cache"):
+                     "_depth_lut_cache", "_depth_classes_cache"):
             c = getattr(old, name, None)
             if c is not None and getattr(new, name, None) is None:
                 object.__setattr__(new, name, c)
@@ -867,31 +867,39 @@ def patch_ctrie(
     (o_arrs, _od), (n_arrs, _nd) = o, nw
     if _od != _nd:
         return None  # static unroll depth changed: re-jit + re-upload
-    out = []
-    total = 0
+    # Transaction discipline, ctrie structural half: compute every
+    # array's host diff first, then stage all payloads' H2D copies in
+    # one pass, then launch the warmed scatters — unlike the level-walk
+    # path there is no per-array re-upload fallback (the merged layout's
+    # arrays are interdependent), so any oversized/bucket-shifted delta
+    # fails the whole patch and the caller re-uploads.
+    payloads = []
     for dl, ol, nl in zip(cdev, o_arrs, n_arrs):
         if dl.shape[0] % 65536 == 0 and ol.shape[1:] == (2,):
             # l0 is not bucket-shaped; diff it with an exact-shape check
             if ol.shape != nl.shape or dl.shape != ol.shape:
                 return None
             changed = np.nonzero((ol != nl).any(axis=1))[0]
-            if len(changed) == 0:
-                out.append(dl)
-                continue
             if len(changed) > max(dl.shape[0] // 4, 1):
                 return None
-            patched = _capped_scatter(dl, changed, nl[changed], device)
-            if patched is None:
-                return None
-            out.append(patched)
-            total += len(changed)
+            payloads.append((changed, nl[changed]))
             continue
-        p = _patch_array(dl, ol, nl, device)
-        if p is None:
+        pay = _patch_diff_payload(dl, ol, nl)
+        if pay is None:
             return None
-        out.append(p[0])
-        total += p[1]
-    return CTrieTables(*out), total
+        payloads.append(pay)
+    staged = []
+    total = 0
+    for dl, (pos, vals) in zip(cdev, payloads):
+        if len(pos) == 0:
+            staged.append(lambda dl=dl: dl)
+            continue
+        th = _stage_capped(dl, pos, vals, device)
+        if th is None:
+            return None
+        staged.append(th)
+        total += len(pos)
+    return CTrieTables(*(th() for th in staged)), total
 
 
 def extract_ip_bits(ip_words: jax.Array, pos: jax.Array, n: jax.Array):
@@ -1086,10 +1094,12 @@ def warm_ctrie_patch_scatters(cdev: CTrieTables, device=None) -> None:
     """Pre-compile the compressed layout's patch scatters (the
     warm_patch_scatters analogue): nodes/targets/joined/root_lut are the
     bucket-padded patchable arrays; l0 patches through its own
-    exact-shape diff, which shares the same capped executables."""
+    exact-shape diff, which shares the same capped executables.  The
+    dirty-row ladder (scatter_cap_ladder) keeps multi-edit transaction
+    flushes compile-free up to TXN_WARM_MAX_ROWS dirty rows."""
     warm_scatters(
         (cdev.nodes, cdev.targets, cdev.joined, cdev.root_lut, cdev.l0),
-        device,
+        device, max_rows=TXN_WARM_MAX_ROWS,
     )
 
 
@@ -1131,9 +1141,14 @@ def _seed_caches_forward(
         object.__setattr__(new, "_packed_rules_cache", new_packed)
         # trie untouched: the poptrie transform is identical — share it
         object.__setattr__(new, "_poptrie_cache", pop)
-        dlut = getattr(old, "_depth_lut_cache", None)
-        if dlut is not None:
-            object.__setattr__(new, "_depth_lut_cache", dlut)
+        # ...and so are the depth-steering caches (they read trie
+        # levels, never rules): without this every rules-only flush in
+        # an update storm re-derived the LUT + class thresholds per
+        # generation — O(root slots) host work per transaction
+        for name in ("_depth_lut_cache", "_depth_classes_cache"):
+            c = getattr(old, name, None)
+            if c is not None:
+                object.__setattr__(new, name, c)
         built = getattr(old, "_joined_cache", None)
         if built is not None and built != "none":
             joined_old, l0j, sorted_t, order = built
@@ -1396,15 +1411,17 @@ def _scatter_rows_jit():
     return jax.jit(lambda a, idx, rows: a.at[idx].set(rows))
 
 
-def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0):
-    """Scatter-patch one bucket-padded device array from the host diff of
-    its UNPADDED old/new sources (no padded copies are materialized —
-    np.full of multi-GB pad layouts was 20+s per patch).  Appended rows
-    scatter their new values; rows the table shrank away from reset to
-    the pad fill, keeping the device state bit-identical to a fresh
-    ``pad=True`` upload.  Returns (patched_or_original_array,
-    rows_changed) or None when the dtype/trailing dims/row bucket changed
-    (caller re-uploads)."""
+def _patch_diff_payload(dev_arr, old_np: np.ndarray, new_np: np.ndarray,
+                        fill=0):
+    """The host-diff half of _patch_array: validate the bucket/dtype
+    contract and compute the (pos, rows) scatter payload (possibly
+    empty) from the UNPADDED old/new sources — no padded copies are
+    materialized (np.full of multi-GB pad layouts was 20+s per patch).
+    Appended rows scatter their new values; rows the table shrank away
+    from reset to the pad fill, keeping the device state bit-identical
+    to a fresh ``pad=True`` upload.  Returns (pos, rows) or None when
+    the dtype/trailing dims/row bucket changed or the delta exceeds the
+    capped-scatter budget (caller re-uploads)."""
     if old_np.dtype != new_np.dtype or old_np.shape[1:] != new_np.shape[1:]:
         return None
     nb = dev_arr.shape[0]
@@ -1441,24 +1458,30 @@ def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0
         )
     idx = np.concatenate(parts_idx)
     rows = np.concatenate(parts_rows)
-    k = len(idx)
-    if k == 0:
-        return dev_arr, 0
-    if k > nb // 4:
+    if len(idx) > nb // 4:
         # Large delta: a bucketed scatter would ship close to the full
         # array AND pay the device-side copy — the full upload wins.
         return None
-    # Pad the scatter to a capped size by repeating the last row —
-    # duplicate indices with identical values are a deterministic no-op —
-    # so the jit cache stays bounded and warmable (see _scatter_cap).
-    cap = _scatter_cap(k, nb)
-    pidx = np.empty(cap, np.int64)
-    pidx[:k] = idx
-    pidx[k:] = idx[-1]
-    prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
-    prows[:k] = rows
-    prows[k:] = rows[-1]
-    return _scatter(dev_arr, pidx, prows, device), k
+    return idx, rows
+
+
+def _patch_array(dev_arr, old_np: np.ndarray, new_np: np.ndarray, device, fill=0):
+    """Scatter-patch one bucket-padded device array from the host diff
+    of its UNPADDED old/new sources (payload via _patch_diff_payload,
+    launch via the shared capped executable).  Returns
+    (patched_or_original_array, rows_changed) or None when the
+    dtype/trailing dims/row bucket changed or the delta is oversized
+    (caller re-uploads)."""
+    pay = _patch_diff_payload(dev_arr, old_np, new_np, fill=fill)
+    if pay is None:
+        return None
+    idx, rows = pay
+    if len(idx) == 0:
+        return dev_arr, 0
+    patched = _capped_scatter(dev_arr, idx, rows, device)
+    if patched is None:
+        return None
+    return patched, len(idx)
 
 
 #: every patch of <= this many rows shares ONE scatter executable per
@@ -1484,17 +1507,14 @@ def _scatter(dev_arr, pidx: np.ndarray, prows: np.ndarray, device):
     )
 
 
-def _capped_scatter(dev_arr, pos: np.ndarray, rows: np.ndarray, device):
-    """Scatter ``rows`` at ``pos`` into ``dev_arr`` through the shared
-    capped executable (see _scatter_cap): every small patch of one array
-    shape reuses ONE warmed scatter compile.  Returns the patched array,
-    or None when the delta is too large to win over a re-upload/rebuild
-    (callers fall back).  Shared by the joined-row patch and the fused
-    walk's byte-plane patch (pallas_walk.patch_walk_joined)."""
-    nb = dev_arr.shape[0]
+def _capped_payload(pos: np.ndarray, rows: np.ndarray, nb: int):
+    """Pad a (pos, rows) scatter payload to its shared capped size
+    (_scatter_cap) by repeating the last row — duplicate indices with
+    identical values are a deterministic no-op — so every small patch of
+    one array shape reuses one warmed executable.  Returns
+    (pidx, prows) or None when the delta exceeds the capped-scatter
+    budget (callers escalate to a re-upload/rebuild)."""
     k = len(pos)
-    if k == 0:
-        return dev_arr
     if k > nb // 4:
         return None
     cap = _scatter_cap(k, nb)
@@ -1504,7 +1524,160 @@ def _capped_scatter(dev_arr, pos: np.ndarray, rows: np.ndarray, device):
     prows = np.empty((cap,) + rows.shape[1:], rows.dtype)
     prows[:k] = rows
     prows[k:] = rows[-1]
-    return _scatter(dev_arr, pidx, prows, device)
+    return pidx, prows
+
+
+def _capped_scatter(dev_arr, pos: np.ndarray, rows: np.ndarray, device):
+    """Scatter ``rows`` at ``pos`` into ``dev_arr`` through the shared
+    capped executable (see _scatter_cap): every small patch of one array
+    shape reuses ONE warmed scatter compile.  Returns the patched array,
+    or None when the delta is too large to win over a re-upload/rebuild
+    (callers fall back).  Shared by the joined-row patch and the fused
+    walk's byte-plane patch (pallas_walk.patch_walk_joined)."""
+    k = len(pos)
+    if k == 0:
+        return dev_arr
+    pay = _capped_payload(pos, rows, dev_arr.shape[0])
+    if pay is None:
+        return None
+    return _scatter(dev_arr, pay[0], pay[1], device)
+
+
+def _stage_capped(dev_arr, pos: np.ndarray, rows: np.ndarray, device):
+    """The two-phase form of _capped_scatter: pad the payload and START
+    its H2D copies now (jax.device_put is async), returning a zero-arg
+    thunk that launches the warmed scatter.  A transaction patch stages
+    EVERY tensor family's payload first — one H2D staging pass whose
+    transfers overlap each other and whatever the device is running —
+    then launches.  None when the payload exceeds the capped budget."""
+    if len(pos) == 0:
+        return lambda: dev_arr
+    pay = _capped_payload(pos, rows, dev_arr.shape[0])
+    if pay is None:
+        return None
+    didx = jax.device_put(pay[0], device)
+    drows = jax.device_put(pay[1], device)
+    return lambda: _scatter_rows_jit()(dev_arr, didx, drows)
+
+
+# --- fused transaction scatter ----------------------------------------------
+#
+# A flushed edit transaction produces one merged dirty-row set per
+# tensor family; the hot (rules-only) flush updates the whole dense
+# group + the joined plane in ONE fused executable below, and every
+# payload is pre-padded to its capped size so the executable cache stays
+# bounded across transaction sizes (the dirty-row-count ladder prewarm
+# in warm_txn_scatters keeps the serving path compile-free).
+
+#: dirty-row-count prewarm bound: caps for transactions of up to this
+#: many dirty rows are compiled at load time (larger transactions are
+#: close to the nb//4 budget where the patch falls back to a re-upload
+#: anyway)
+TXN_WARM_MAX_ROWS = 512
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_txn_scatter(n: int):
+    """ONE fused executable scattering ``n`` (array, idx, rows) payloads
+    in a single dispatch — the transaction patch launch.  NOT donated
+    for the same double-buffer reason as _scatter_rows_jit: in-flight
+    classifies finish on the old generation's handles."""
+    def f(arrays, idxs, rows):
+        return tuple(a.at[i].set(r) for a, i, r in zip(arrays, idxs, rows))
+
+    return jax.jit(f)
+
+
+def txn_scatter(entries, device):
+    """Fused multi-array transaction scatter: ``entries`` is a sequence
+    of ``(dev_arr, pos, rows)`` — one merged dirty-row payload per
+    tensor family.  Every payload's H2D copy is staged before any
+    launch (one staging pass), then ALL arrays update in one
+    jitted_txn_scatter dispatch.  Zero-row payloads pass their array
+    through untouched (and stay out of the launch — an identity scatter
+    would still pay a device-side full-array copy).  Returns the list
+    of patched arrays in entry order, or None when any payload exceeds
+    the capped-scatter budget (the caller escalates to a full
+    re-upload/rebuild)."""
+    payloads = []
+    for dev_arr, pos, rows in entries:
+        if len(pos) == 0:
+            payloads.append(None)
+            continue
+        pay = _capped_payload(pos, rows, dev_arr.shape[0])
+        if pay is None:
+            return None
+        payloads.append(pay)
+    live = [i for i, p in enumerate(payloads) if p is not None]
+    out = [a for a, _pos, _rows in entries]
+    if not live:
+        return out
+    # ONE staging pass: every payload's async copy is in flight before
+    # the fused launch below
+    staged = [
+        (
+            entries[i][0],
+            jax.device_put(payloads[i][0], device),
+            jax.device_put(payloads[i][1], device),
+        )
+        for i in live
+    ]
+    patched = jitted_txn_scatter(len(staged))(
+        tuple(a for a, _i, _r in staged),
+        tuple(i for _a, i, _r in staged),
+        tuple(r for _a, _i, r in staged),
+    )
+    for j, i in enumerate(live):
+        out[i] = patched[j]
+    return out
+
+
+def scatter_cap_ladder(nb: int, max_rows: int = TXN_WARM_MAX_ROWS):
+    """The distinct dirty-row counts whose capped payloads exercise
+    every executable shape a 1..max_rows-row patch of an nb-row array
+    can emit — the dirty-row-count prewarm ladder (one representative k
+    per distinct _scatter_cap)."""
+    hi = min(max_rows, nb // 4)
+    ks = []
+    k = 1
+    while k <= hi:
+        ks.append(k)
+        k = _scatter_cap(k, nb) + 1
+    return ks
+
+
+def warm_txn_scatters(dev: "DeviceTables", device=None,
+                      max_rows: int = TXN_WARM_MAX_ROWS) -> None:
+    """Pre-compile the fused transaction executable (jitted_txn_scatter)
+    for the rules-only flush combo — the dense group plus, when active,
+    the joined plane — across the dirty-row-count cap ladder, so a
+    flushed edit transaction of any size up to ``max_rows`` launches
+    compile-free.  Mixed-cap combos (families whose dirty counts land in
+    different >256-row buckets) compile once on first use; uniform
+    combos — every transaction below _PATCH_CAP rows, i.e. the churn
+    regime — are fully covered here.  Same discard-the-result contract
+    as warm_patch_scatters: the resident arrays are never mutated."""
+    arrays = [dev.key_words, dev.mask_words, dev.mask_len, dev.rules]
+    nb = arrays[0].shape[0]
+    if nb <= 1 or nb != _row_bucket(nb):
+        return
+    if dev.joined.shape[0] > 1:
+        arrays.append(dev.joined)
+    for k in scatter_cap_ladder(nb, max_rows):
+        txn_scatter(
+            [
+                (
+                    a,
+                    np.zeros(min(k, max(a.shape[0] // 4, 1)), np.int64),
+                    np.zeros(
+                        (min(k, max(a.shape[0] // 4, 1)),) + a.shape[1:],
+                        a.dtype,
+                    ),
+                )
+                for a in arrays
+            ],
+            device,
+        )
 
 
 def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
@@ -1513,47 +1686,56 @@ def warm_patch_scatters(dev: DeviceTables, device=None) -> None:
     compile (~10s measured at the 1M tier).  The executable cache is
     keyed on abstract shapes/dtypes, and every <= _PATCH_CAP-row patch
     uses the SAME capped scatter shape (_scatter_cap), so one warm per
-    array shape covers all small edits.  Each warm scatters against the
-    RESIDENT array — _scatter is non-donating, so the live table is
-    never mutated (XLA materializes copy-then-scatter) and the discarded
-    result is the only transient allocation; scattering into a separate
-    zeros scratch would double the transient HBM right after a full load,
-    when the double-buffer contract may still hold the previous
-    generation live."""
+    array shape covers all small edits; the dirty-row-count ladder
+    (scatter_cap_ladder) extends the coverage to multi-edit transaction
+    flushes up to TXN_WARM_MAX_ROWS dirty rows, and warm_txn_scatters
+    covers the FUSED rules-only combo the transaction patch launches.
+    Each warm scatters against the RESIDENT array — _scatter is
+    non-donating, so the live table is never mutated (XLA materializes
+    copy-then-scatter) and the discarded result is the only transient
+    allocation; scattering into a separate zeros scratch would double
+    the transient HBM right after a full load, when the double-buffer
+    contract may still hold the previous generation live."""
     warm_scatters(
         (dev.key_words, dev.mask_words, dev.mask_len, dev.rules,
          *dev.trie_levels, dev.trie_targets, dev.joined, dev.root_lut),
-        device,
+        device, max_rows=TXN_WARM_MAX_ROWS,
     )
+    warm_txn_scatters(dev, device)
 
 
-def warm_scatters(arrays, device=None) -> None:
+def warm_scatters(arrays, device=None, max_rows: int = 1) -> None:
     """Pre-compile the capped scatter executable for each distinct
     (shape, dtype) among ``arrays`` (the shared body of
     warm_patch_scatters, also used for the fused walk's patchable joined
-    planes).  Arrays with <= 1 rows are skipped: a non-bucket resident
-    (the (1, 1) placeholders) is never patchable by contract."""
+    planes), across the dirty-row-count cap ladder up to ``max_rows``
+    (default 1 = the single-edit cap only).  Arrays with <= 1 rows are
+    skipped: a non-bucket resident (the (1, 1) placeholders) is never
+    patchable by contract."""
     seen = set()
     for arr in arrays:
-        key = (tuple(arr.shape), str(arr.dtype))
-        if arr.shape[0] <= 1 or key in seen:
-            continue
-        seen.add(key)
-        cap = _scatter_cap(1, arr.shape[0])
-        pidx = np.zeros(cap, np.int64)
-        # index 0 rewritten with... whatever value row 0 holds is NOT
-        # needed: the scatter result is discarded, so writing zeros into
-        # the COPY is harmless — the resident buffer is untouched.
-        prows = np.zeros((cap,) + arr.shape[1:], arr.dtype)
-        _scatter(arr, pidx, prows, device)
+        for k in scatter_cap_ladder(arr.shape[0], max(max_rows, 1)):
+            cap = _scatter_cap(k, arr.shape[0])
+            key = (cap, tuple(arr.shape), str(arr.dtype))
+            if arr.shape[0] <= 1 or key in seen:
+                continue
+            seen.add(key)
+            pidx = np.zeros(cap, np.int64)
+            # index 0 rewritten with... whatever value row 0 holds is NOT
+            # needed: the scatter result is discarded, so writing zeros
+            # into the COPY is harmless — the resident buffer is
+            # untouched.
+            prows = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+            _scatter(arr, pidx, prows, device)
 
 
-def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
-    """Hint-mode patch: scatter ``new_np[rows]`` without any host diff.
-    ``rows`` must be a SUPERSET of the rows whose values changed (the
-    compiler's dirty tracking guarantees this); unchanged hinted rows
-    rewrite their identical value.  Returns (array, k) or None when the
-    bucket/dtype no longer matches or the hint is too large to win."""
+def _patch_rows_payload(dev_arr, new_np: np.ndarray, rows: np.ndarray):
+    """Hint-mode payload: ``new_np[rows]`` with no host diff.  ``rows``
+    must be a SUPERSET of the rows whose values changed (the compiler's
+    dirty tracking guarantees this); unchanged hinted rows rewrite their
+    identical value.  Returns (pos, row values) — possibly empty — or
+    None when the bucket/dtype no longer matches or the hint is too
+    large to win."""
     nb = dev_arr.shape[0]
     if nb != _row_bucket(nb):
         return None  # non-bucket resident (placeholder): never patchable
@@ -1564,16 +1746,26 @@ def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
     ):
         return None
     rows = rows[rows < new_np.shape[0]]
-    k = len(rows)
-    if k == 0:
-        return dev_arr, 0
-    if k > nb // 4:
+    if len(rows) > nb // 4:
         return None
-    cap = _scatter_cap(k, nb)
-    pidx = np.empty(cap, np.int64)
-    pidx[:k] = rows
-    pidx[k:] = rows[-1]
-    return _scatter(dev_arr, pidx, new_np[pidx], device), k
+    return rows, new_np[rows]
+
+
+def _patch_array_rows(dev_arr, new_np: np.ndarray, rows: np.ndarray, device):
+    """Hint-mode patch: scatter ``new_np[rows]`` without any host diff
+    (payload via _patch_rows_payload, launch via the shared capped
+    executable).  Returns (array, k) or None when the bucket/dtype no
+    longer matches or the hint is too large to win."""
+    pay = _patch_rows_payload(dev_arr, new_np, rows)
+    if pay is None:
+        return None
+    pos, vals = pay
+    if len(pos) == 0:
+        return dev_arr, 0
+    patched = _capped_scatter(dev_arr, pos, vals, device)
+    if patched is None:
+        return None
+    return patched, len(pos)
 
 
 def patch_device_tables(
@@ -1633,26 +1825,24 @@ def patch_device_tables(
     )
     total = 0
 
-    dense = []
-    for dl, ol, nl, fill in zip(
-        (dev.key_words, dev.mask_words, dev.mask_len, dev.rules),
-        o[:4],
-        nw[:4],
-        (0, 0, -1, 0),
-    ):
-        if hint is not None:
-            p = _patch_array_rows(dl, nl, hint["dense"], device)
-        else:
-            p = _patch_array(dl, ol, nl, device, fill=fill)
-        if p is None:
-            return None
-        dense.append(p[0])
-        total += p[1]
-    if trie_unchanged:
-        levels = list(dev.trie_levels)
-        trie_targets = dev.trie_targets
-        joined = dev.joined
-        if dev.joined.shape[0] > 1:
+    fused_joined = None  # resident joined patched by the fused launch
+    if hint is not None:
+        # Transaction fast path (the update-storm flush): ONE merged
+        # dirty-row payload per dense array — plus the joined plane on
+        # rules-only flushes — staged in one H2D pass and launched as
+        # ONE fused scatter executable (jitted_txn_scatter, pre-warmed
+        # across the dirty-row ladder by warm_txn_scatters), so a
+        # 64-edit folded transaction costs one dispatch, not 5 x 64.
+        entries = []
+        for dl, nl in zip(
+            (dev.key_words, dev.mask_words, dev.mask_len, dev.rules),
+            nw[:4],
+        ):
+            pay = _patch_rows_payload(dl, nl, hint["dense"])
+            if pay is None:
+                return None
+            entries.append((dl,) + pay)
+        if trie_unchanged and dev.joined.shape[0] > 1:
             # the joined array carries RULE BYTES, so a rules-only edit
             # must patch its rows too (positions from the old
             # generation's cached map; trie unchanged = positions valid)
@@ -1661,60 +1851,99 @@ def patch_device_tables(
             if pr is None:
                 return None
             pos, rows = pr
-            k = len(pos)
-            if k:
-                nb = dev.joined.shape[0]
+            if len(pos):
                 if (
                     rows.dtype != dev.joined.dtype
                     or rows.shape[1:] != tuple(dev.joined.shape[1:])
-                    or int(pos.max()) >= nb
+                    or int(pos.max()) >= dev.joined.shape[0]
                 ):
                     return None
-                joined = _capped_scatter(dev.joined, pos, rows, device)
-                if joined is None:
-                    return None
-                total += k
+            entries.append((dev.joined, pos, rows))
+        patched = txn_scatter(entries, device)
+        if patched is None:
+            return None
+        dense = patched[:4]
+        total += sum(len(e[1]) for e in entries)
+        if len(entries) > 4:
+            fused_joined = patched[4]
     else:
-        levels = []
+        dense = []
+        for dl, ol, nl, fill in zip(
+            (dev.key_words, dev.mask_words, dev.mask_len, dev.rules),
+            o[:4],
+            nw[:4],
+            (0, 0, -1, 0),
+        ):
+            p = _patch_array(dl, ol, nl, device, fill=fill)
+            if p is None:
+                return None
+            dense.append(p[0])
+            total += p[1]
+    if trie_unchanged:
+        levels = list(dev.trie_levels)
+        trie_targets = dev.trie_targets
+        joined = fused_joined if fused_joined is not None else dev.joined
+    else:
+        # Structural flush: compute every family's host diff FIRST, then
+        # start every payload's (and fallback re-upload's) H2D copy in
+        # one staging pass — the transfers overlap each other and
+        # whatever the device is running — then launch the per-family
+        # warmed scatters.  A family whose bucket changed (or whose
+        # delta is oversized) re-uploads just itself.
+        specs = []  # (tag, dev_arr, new host array, payload | None)
         for dl, ol, nl in zip(dev.trie_levels, o[4], nw[4]):
-            p = _patch_array(dl, ol, nl, device)
-            if p is None:
-                # this level's bucket changed (or the delta is too
-                # large): re-upload just this level
-                levels.append(put(nl))
-                total += len(nl)
-            else:
-                levels.append(p[0])
-                total += p[1]
-        p = _patch_array(dev.trie_targets, o[5], nw[5], device)
-        if p is None:
-            trie_targets = put(nw[5])
-            total += len(nw[5])
-        else:
-            trie_targets, k = p
-            total += k
+            specs.append(("level", dl, nl, _patch_diff_payload(dl, ol, nl)))
+        specs.append((
+            "targets", dev.trie_targets, nw[5],
+            _patch_diff_payload(dev.trie_targets, o[5], nw[5]),
+        ))
         if nw[7].shape[0] <= 1:
-            # Inactive joined row ((1, 1) placeholder or single-sentinel
-            # layout): it must keep its exact single-row shape — classify
-            # selects the joined walk on joined.shape[0] > 1, so a
-            # bucket-padded put() here would flip a non-joined table
-            # into walking a zero/garbage-width rules tail (and
-            # _patch_array always refuses it: _row_bucket(1) == 8 != 1).
-            # assert_patched_tables below enforces this as a permanent
-            # contract at the mutation site.
-            if _inject_joined_pad_bug():
-                joined = put(nw[7])  # the PR-4 defect, re-introduced
-            else:
-                joined = jax.device_put(jnp.asarray(nw[7]), device)
-            total += 0 if dev.joined.shape[0] <= 1 else 1
+            specs.append(("joined-inactive", dev.joined, nw[7], None))
         else:
-            p = _patch_array(dev.joined, o[7], nw[7], device)
-            if p is None:
-                joined = put(nw[7])
-                total += len(nw[7])
+            specs.append((
+                "joined", dev.joined, nw[7],
+                _patch_diff_payload(dev.joined, o[7], nw[7]),
+            ))
+        staged = []  # ("ready", array, rows) | ("launch", thunk, rows)
+        for tag, dl, nl, pay in specs:
+            if tag == "joined-inactive":
+                # Inactive joined row ((1, 1) placeholder or single-
+                # sentinel layout): it must keep its exact single-row
+                # shape — classify selects the joined walk on
+                # joined.shape[0] > 1, so a bucket-padded put() here
+                # would flip a non-joined table into walking a
+                # zero/garbage-width rules tail (and the payload helpers
+                # always refuse it: _row_bucket(1) == 8 != 1).
+                # assert_patched_tables below enforces this as a
+                # permanent contract at the mutation site.
+                if _inject_joined_pad_bug():
+                    arr = put(nl)  # the PR-4 defect, re-introduced
+                else:
+                    arr = jax.device_put(jnp.asarray(nl), device)
+                staged.append(
+                    ("ready", arr, 0 if dev.joined.shape[0] <= 1 else 1)
+                )
+                continue
+            if pay is None:
+                staged.append(("ready", put(nl), len(nl)))
+                continue
+            pos, vals = pay
+            if len(pos) == 0:
+                staged.append(("ready", dl, 0))
+                continue
+            th = _stage_capped(dl, pos, vals, device)
+            if th is None:
+                staged.append(("ready", put(nl), len(nl)))
             else:
-                joined, k = p
-                total += k
+                staged.append(("launch", th, len(pos)))
+        outs = []
+        for mode, x, k in staged:
+            outs.append(x if mode == "ready" else x())
+            total += k
+        n_lv = len(dev.trie_levels)
+        levels = outs[:n_lv]
+        trie_targets = outs[n_lv]
+        joined = outs[n_lv + 1]
     p = _patch_array(dev.root_lut, o[6], nw[6], device)
     if p is None:
         root_lut = put(nw[6])

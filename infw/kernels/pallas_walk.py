@@ -434,12 +434,14 @@ def patch_walk_joined(
 def warm_walk_patch_scatters(wt: WalkTables, device=None) -> None:
     """Pre-compile the capped scatter executables for the resident walk's
     patchable joined planes (the fused-path half of
-    jaxpath.warm_patch_scatters): one warm per (shape, dtype), so the
-    first rules-only edit against a fresh fused walk ships in
-    milliseconds instead of paying a scatter-jit compile."""
-    from .jaxpath import warm_scatters
+    jaxpath.warm_patch_scatters): one warm per (shape, dtype) per
+    dirty-row cap ladder step, so the first rules-only edit — single-key
+    or a multi-edit transaction flush up to TXN_WARM_MAX_ROWS dirty
+    rows — ships without paying a scatter-jit compile."""
+    from .jaxpath import TXN_WARM_MAX_ROWS, warm_scatters
 
-    warm_scatters((wt.joined, wt.joined_u16), device)
+    warm_scatters((wt.joined, wt.joined_u16), device,
+                  max_rows=TXN_WARM_MAX_ROWS)
 
 
 # --- XLA pre-stage: the DIR-16 root gather -------------------------------
@@ -905,10 +907,12 @@ def patch_cwalk_joined(
 
 def warm_cwalk_patch_scatters(wt: CWalkTables, device=None) -> None:
     """warm_walk_patch_scatters for the compressed walk: the per-tidx
-    joined matrix is its only patchable plane (trie edits rebuild)."""
-    from .jaxpath import warm_scatters
+    joined matrix is its only patchable plane (trie edits rebuild).
+    Ladder-warmed so transaction flushes of up to TXN_WARM_MAX_ROWS
+    dirty rows stay compile-free."""
+    from .jaxpath import TXN_WARM_MAX_ROWS, warm_scatters
 
-    warm_scatters((wt.joined,), device)
+    warm_scatters((wt.joined,), device, max_rows=TXN_WARM_MAX_ROWS)
 
 
 def _make_cwalk_kernel(d_max: int):

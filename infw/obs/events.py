@@ -183,6 +183,32 @@ class DeadlineMissRecord:
         ]
 
 
+@dataclass
+class PatchTxnRecord:
+    """One flushed multi-edit patch transaction (infw.txn): how many
+    ops coalesced, how many folded away (superseded/annihilated), the
+    merged dirty-row count the device patch shipped, why the flush
+    tripped (deadline | batch | manual | eof), and whether the
+    transaction escalated to the columnar rebuild path.  Counters and
+    the staleness histogram live on /metrics (TxnStats); the event
+    carries the SHAPE of each flush in the same stream as deny events."""
+
+    ops: int
+    folded: int
+    dirty_rows: int
+    reason: str
+    escalated: bool
+    staleness_us: float = 0.0
+
+    def lines(self) -> List[str]:
+        esc = ", ESCALATED to rebuild" if self.escalated else ""
+        return [
+            f"patch-txn: {self.ops} op(s) ({self.folded} folded) -> "
+            f"{self.dirty_rows} dirty row(s), flush={self.reason}, "
+            f"worst staleness {self.staleness_us:.0f}us{esc}"
+        ]
+
+
 def emit_analysis_findings(ring: "EventRing", findings) -> int:
     """Push analyzer findings (infw.analysis.rules.Finding) into the
     ring as AnalysisEventRecords; returns how many were queued (the

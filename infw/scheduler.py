@@ -30,6 +30,12 @@ for offloaded packet processing).  This module turns that into policy:
   MeshTpuClassifier, which shards it over the ``"data"`` axis; on a
   single-chip pool (no spill target) the oversized admission is split
   into per-chip-budget jobs instead — degrade, never refuse.
+- **update-storm interleaving** (``ContinuousScheduler(txn_batcher=...,
+  txn_flush=...)``): queued rule edits (infw.txn) flush under their
+  bounded-staleness policy WHILE serving — a tripped flush runs on its
+  own thread occupying ONE pipeline slot instead of stalling
+  admissions, and in-flight classifies finish on the table generation
+  they were dispatched against (the double-buffer swap contract).
 
 Observability: ``SchedulerStats`` exports queue depth, the achieved
 batch-size histogram, deadline-miss and spill counters through the
@@ -453,10 +459,23 @@ class ContinuousScheduler:
         ring=None,
         stats: Optional[SchedulerStats] = None,
         clock: Callable[[], float] = time.monotonic,
+        txn_batcher=None,
+        txn_flush: Optional[Callable] = None,
     ) -> None:
         self.clf = clf
         self.policy = policy
         self.spill_clf = spill_clf
+        #: update-storm interleaving (infw.txn): when a TxnBatcher and a
+        #: flush callable ``txn_flush(items, reason)`` (items =
+        #: TxnBatcher.drain()'s (op, enqueue_ts) pairs) are given, the
+        #: serve loop checks the batcher's bounded-staleness policy each
+        #: iteration and runs a tripped flush on its own thread while it
+        #: OCCUPIES A PIPELINE SLOT — admissions keep flowing (classify
+        #: dispatches continue against the old generation until the
+        #: swap), but the pipeline never overcommits device work while
+        #: a table patch is in flight.
+        self.txn_batcher = txn_batcher
+        self.txn_flush = txn_flush
         #: per-chip admission budget: a coalesced batch beyond it spills
         #: to the mesh target (sharded over "data") or, with no spill
         #: target, splits into per-budget jobs on the primary
@@ -647,6 +666,46 @@ class ContinuousScheduler:
                     outstanding[0] += 1
                     cv.notify_all()
 
+        flush_busy = [False]
+
+        def maybe_flush_txn(now: float) -> None:
+            """Bounded-staleness edit flush, interleaved with serving:
+            when the batcher's deadline/batch threshold trips, the flush
+            runs on its own thread while holding ONE pipeline slot — the
+            admission loop keeps coalescing and dispatching (in-flight
+            classifies finish on the old generation; the swap is a
+            reference assignment), but device work never overcommits
+            while the patch is in flight."""
+            if (
+                self.txn_batcher is None or self.txn_flush is None
+                or flush_busy[0]
+            ):
+                return
+            reason = self.txn_batcher.should_flush(now)
+            if reason is None:
+                return
+            items = self.txn_batcher.drain()
+            if not items:
+                return
+            flush_busy[0] = True
+            with cv:
+                outstanding[0] += 1  # the flush occupies a pipeline slot
+
+            def run_flush() -> None:
+                try:
+                    self.txn_flush(items, reason)
+                except BaseException as e:  # surfaced by serve() at exit
+                    errs.append(e)
+                finally:
+                    with cv:
+                        outstanding[0] -= 1
+                        cv.notify_all()
+                    flush_busy[0] = False
+
+            threading.Thread(
+                target=run_flush, name="infw-txn-flush", daemon=True
+            ).start()
+
         drainers = [
             threading.Thread(
                 target=drain_loop, name=f"infw-sched-drain-{i}", daemon=True
@@ -658,6 +717,7 @@ class ContinuousScheduler:
         try:
             while True:
                 now = self._clock()
+                maybe_flush_txn(now)
                 while pos < n and arrive[order[pos]] <= now:
                     p = int(order[pos])
                     queue.append((p, arrive[p]))
@@ -682,7 +742,8 @@ class ContinuousScheduler:
                     continue
                 launch_ready()
                 # wait for the next event: an arrival, the policy's
-                # re-decision point, or a completion (cv notify)
+                # re-decision point, the edit batcher's staleness
+                # deadline, or a completion (cv notify)
                 now2 = self._clock()
                 next_arrival = (
                     arrive[order[pos]] - now2 if pos < n else float("inf")
@@ -691,6 +752,17 @@ class ContinuousScheduler:
                     next_arrival,
                     dec.wait_s if dec.wait_s is not None else float("inf"),
                 )
+                if (
+                    self.txn_batcher is not None and not flush_busy[0]
+                    and len(self.txn_batcher)
+                ):
+                    # the staleness budget bounds the sleep too — a 2 ms
+                    # deadline must not ride the 50 ms poll cap through
+                    # an arrival gap
+                    wait = min(wait, max(
+                        self.txn_batcher.staleness_s
+                        - self.txn_batcher.oldest_age(now2), 0.0,
+                    ))
                 with cv:
                     cv.wait(min(wait, 0.05) if wait > 0 else 0.001)
         finally:

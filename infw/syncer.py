@@ -222,6 +222,157 @@ class DataplaneSyncer:
                 if self._stats_poller is not None and self._classifier is not None:
                     self._stats_poller.start_poll(self._classifier)
 
+    def apply_edit_transaction(self, ops, reason: str = "manual",
+                               enqueue_ts=None, stats=None, ring=None):
+        """Apply one batched edit transaction (infw.txn fold semantics)
+        as ONE device patch generation — the update-storm counterpart of
+        ``sync_interface_ingress_rules``: where a sync reconciles a full
+        desired state, this folds N queued single-key edits
+        (``infw.txn.EditOp``) into their net effect and lands them with
+        one ``IncrementalTables.apply``, one ``load_tables`` (one H2D
+        staging pass + one fused scatter launch) and the same overlay /
+        journal / checkpoint discipline as the sync path.  The old
+        generation serves until the swap; a transaction the updater
+        cannot absorb escalates to the columnar rebuild.
+
+        Requires a live dataplane (a prior sync created the classifier
+        and updater).  ``enqueue_ts``/``stats``/``ring`` feed the
+        per-op staleness histogram, TxnStats counters and the
+        PatchTxnRecord obs event."""
+        from . import txn as txn_mod
+
+        with self._lock:
+            if self._classifier is None or self._updater is None:
+                raise SyncError(
+                    "no dataplane to edit (sync rules before queuing edits)"
+                )
+            t0 = time.monotonic()
+            if self._stats_poller is not None:
+                self._stats_poller.stop_poll()
+            try:
+                report = self._apply_edit_txn_locked(ops, reason, txn_mod)
+            finally:
+                if self._stats_poller is not None and self._classifier is not None:
+                    self._stats_poller.start_poll(self._classifier)
+            report.apply_s = time.monotonic() - t0
+            staleness = []
+            if enqueue_ts:
+                staleness = [max(0.0, t0 - ts) for ts in enqueue_ts]
+                report.worst_staleness_s = max(staleness, default=0.0)
+            if stats is not None:
+                stats.note_flush(
+                    report.n_ops, report.n_folded, report.dirty_rows,
+                    reason, report.escalated, staleness_s=staleness,
+                )
+            if ring is not None:
+                from .obs.events import PatchTxnRecord
+
+                ring.push(PatchTxnRecord(
+                    ops=report.n_ops, folded=report.n_folded,
+                    dirty_rows=report.dirty_rows, reason=reason,
+                    escalated=report.escalated,
+                    staleness_us=report.worst_staleness_s * 1e6,
+                ))
+            return report
+
+    def _apply_edit_txn_locked(self, ops, reason, txn_mod):
+        """The routing half, under the lock: fold, route (overlay vs
+        main vs escalation, mirroring _load_ingress_node_firewall_rules),
+        one updater apply, one device load, journal + checkpoint."""
+        ov_idents_before = {k.masked_identity() for k in self._overlay}
+        existing = set(self._updater._ident_to_t) | ov_idents_before
+        folded = txn_mod.fold_ops(ops, existing)
+        # same post-delete size gate as the sync path: a shrunken main
+        # table may land on the dense path, which cannot honor overlays
+        # (folded.deletes over-counts by the overlay's own deletes —
+        # conservative toward merging, never wrong)
+        overlay_ok = (
+            getattr(self._classifier, "supports_overlay", False)
+            and len(self._updater._ident_to_t) - len(folded.deletes)
+            > self.OVERLAY_MIN_MAIN
+        )
+        ups, deletes, ov_dirty = txn_mod.route_folded(
+            folded, self._overlay, overlay_ok, self.OVERLAY_CAP
+        )
+        if ov_dirty:
+            self._overlay_compiled = None
+        escalated = False
+        try:
+            if ups and not self._updater.fits(ups):
+                raise CompileError("trie depth exceeded; rebuild")
+            self._updater.apply(ups, deletes)
+            if self._updater.maybe_compact():
+                log.info("txn flush: compacted table, tombstones reclaimed")
+                escalated = True
+        except CompileError:
+            # columnar-rebuild escalation: fresh updater absorbs the
+            # overlay too; the OLD generation keeps serving until the
+            # load below swaps
+            content = dict(self._updater.content)
+            del_idents = {k.masked_identity() for k in deletes}
+            content = {
+                k: v for k, v in content.items()
+                if k.masked_identity() not in del_idents
+            }
+            content.update(ups)
+            content.update(self._overlay)
+            self._overlay = {}
+            self._overlay_compiled = None
+            self._updater = IncrementalTables.from_content(
+                content, rule_width=self._updater.rule_width
+            )
+            escalated = True
+        # journal records reflect the folded net effect regardless of
+        # routing, so restart replay reconstructs everything (same
+        # discipline as the sync path's desired diff)
+        journal_ups = dict(ups)
+        journal_ups.update(
+            {k: r for k, (r, _kind) in folded.new_keys.items()}
+        )
+        journal_ups.update(
+            {k: r for k, r in folded.upserts.items()
+             if k.masked_identity() in ov_idents_before}
+        )
+        journal_dels = list(folded.deletes)
+        if journal_ups or journal_dels:
+            self._pending_deltas.append((journal_ups, journal_dels))
+        tables = self._updater.snapshot()
+        if os.environ.get("INFW_CHECK_INVARIANTS", "") not in (
+            "", "0", "false", "no"
+        ):
+            self._check_overlay_contract()
+        width = self._updater.rule_width
+        if getattr(self._classifier, "supports_overlay", False):
+            self._classifier.load_tables(
+                tables, dirty_hint=self._updater.peek_dirty(),
+                overlay=self._compile_overlay(width),
+            )
+        else:
+            if self._overlay:
+                raise SyncError("overlay routed to a non-overlay backend")
+            self._classifier.load_tables(
+                tables, dirty_hint=self._updater.peek_dirty()
+            )
+        self._updater.clear_dirty()
+        self._save_overlay()
+        self._content = dict(self._updater.content)
+        self._content.update(self._overlay)
+        if escalated or not self._journal_pending():
+            self._save_checkpoint(tables)
+        mode, dirty_rows = getattr(
+            self._classifier, "_last_load", ("full", 0)
+        )
+        log.info(
+            "edit txn (%s): %d op(s), %d folded, mode=%s, %d dirty "
+            "row(s)%s", reason, folded.n_ops, folded.n_folded, mode,
+            dirty_rows, ", escalated" if escalated else "",
+        )
+        return txn_mod.TxnReport(
+            n_ops=folded.n_ops, n_folded=folded.n_folded,
+            dirty_rows=int(dirty_rows), mode=mode, reason=reason,
+            escalated=escalated,
+        )
+
     @property
     def classifier(self) -> Optional[Classifier]:
         return self._classifier

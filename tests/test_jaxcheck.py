@@ -28,7 +28,9 @@ def test_registry_covers_the_dispatch_surface():
             "classify/pallas-dense", "classify/pallas-walk",
             "classify-wire/xla-ctrie-fused",
             "classify-wire/xla-ctrie-overlay-fused",
-            "classify/pallas-cwalk"} <= names
+            "classify/pallas-cwalk",
+            "patch/txn-scatter-dense",
+            "patch/ctrie-joined-scatter"} <= names
 
 
 def test_builders_return_stable_jitted_objects():

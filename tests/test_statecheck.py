@@ -543,3 +543,68 @@ def test_statecheck_ctrie_scale_tier():
         np.testing.assert_array_equal(out.xdp, ref.xdp)
     finally:
         clf.close()
+
+
+# --- ISSUE-9: batched multi-edit transaction configs ------------------------
+
+
+def test_equivalence_txn():
+    """Transaction mode: single-key ops buffer at txn_flush boundaries
+    and apply as ONE folded flush (infw.txn.fold_ops) — every settled
+    state must be bit-identical to a cold rebuild and oracle-exact
+    against the per-op ground truth.  (The longer-horizon sweep incl.
+    the compressed layout runs in `make state-check`; the tier-1 run
+    keeps one fast config.)"""
+    rep = statecheck.run_config(
+        "txn", seed=4, n_ops=3, shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+@pytest.mark.slow
+def test_equivalence_txn_ctrie():
+    rep = statecheck.run_config(
+        "txn-ctrie", seed=4, n_ops=4, shrink_on_failure=False
+    )
+    assert rep["ok"], rep["failure"]
+
+
+def test_txn_generator_emits_boundaries():
+    _, ops = statecheck.build_case("txn", seed=3, n_ops=40)
+    kinds = [op.kind for op in ops]
+    assert statecheck.TXN_FLUSH in kinds
+    # boundary records round-trip through the repro printer like any op
+    b = next(op for op in ops if op.kind == statecheck.TXN_FLUSH)
+    env = {"statecheck": statecheck, "LpmKey": LpmKey, "np": np}
+    assert eval(b.code(), env).kind == statecheck.TXN_FLUSH
+
+
+def test_txn_fold_defect_caught_by_ground_truth_oracle():
+    """The minimal fold-defect case: delete + readd of a live key in
+    ONE transaction.  With infw.txn._INJECT_FOLD_BUG the pair folds to
+    a no-op — the updater, the resident device state AND the cold
+    rebuild all keep the stale rules, so raw bit-identity cannot catch
+    it; the per-op ground-truth oracle must (the cskip pattern)."""
+    from infw import txn as txn_mod
+
+    full, _ = statecheck.build_case("txn", seed=0, n_ops=0)
+    keys = sorted(full, key=lambda k: (k.ingress_ifindex, k.ip_data))
+    base = {k: full[k] for k in keys[:8]}  # small = fast compiles
+    k = keys[0]
+    rows = np.asarray(base[k]).copy()
+    pop = np.nonzero(rows[:, 0])[0]
+    assert len(pop), "fixture key has no populated rule row"
+    rows[pop[0], 6] = 1 if rows[pop[0], 6] == 2 else 2  # flip the action
+    ops = [
+        statecheck.EditOp(kind="key_delete", key=k),
+        statecheck.EditOp(kind="key_add", key=k, rules=rows),
+    ]
+    assert statecheck.run_ops(base, ops, "txn", seed=0,
+                              witness_b=64) is None
+    txn_mod._INJECT_FOLD_BUG = True
+    try:
+        f = statecheck.run_ops(base, ops, "txn", seed=0, witness_b=64)
+    finally:
+        txn_mod._INJECT_FOLD_BUG = False
+    assert f is not None, "injected fold defect not caught"
+    assert f.phase in ("classify", "stats"), f

@@ -1,0 +1,648 @@
+"""Update-storm transaction tests (infw.txn + the ISSUE-9 wiring).
+
+Covers: net-effect fold semantics (supersession, annihilation,
+delete-then-readd, overlay eligibility, the injected fold defect);
+TxnBatcher bounded-staleness policy; TxnApplier end-to-end (one folded
+patch generation, oracle parity, rebuild escalation, overlay overflow
+spill); the zero-recompile contract across transaction sizes
+1/8/64/512; flush racing a generation swap (double-buffer contract);
+the mesh-replicated transaction broadcast; the scheduler's
+flush-occupies-a-pipeline-slot interleaving; the daemon's edits-dir
+protocol (incl. scheduler mode) and the churngen determinism contract.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw import txn as txn_mod
+from infw.compiler import (
+    IncrementalTables,
+    LpmKey,
+    compile_tables_from_content,
+)
+from infw.constants import IPPROTO_TCP
+from infw.kernels import jaxpath
+from infw.txn import (
+    EditOp,
+    FoldedTxn,
+    TxnApplier,
+    TxnBatcher,
+    TxnStats,
+    fold_ops,
+    op_from_json,
+    op_to_json,
+    read_edit_file,
+    write_edit_file,
+)
+
+
+def _key(a, b=0, c=0, mask=24, ifx=2):
+    return LpmKey(mask + 32, ifx, bytes([10, a, b, c]) + bytes(12))
+
+
+def _rules(port, action=2, width=4):
+    rows = np.zeros((width, 7), np.int32)
+    rows[1] = [1, IPPROTO_TCP, port, 0, 0, 0, action]
+    return rows
+
+
+def _content(n, width=4):
+    return {
+        _key(i // 256, i % 256): _rules(80 + (i % 1000), width=width)
+        for i in range(n)
+    }
+
+
+# --- fold semantics ----------------------------------------------------------
+
+
+def test_fold_supersession_last_writer_wins():
+    k = _key(1)
+    ops = [
+        EditOp("rules_edit", k, _rules(80)),
+        EditOp("rules_edit", k, _rules(81)),
+        EditOp("order_change", k, _rules(82)),
+    ]
+    f = fold_ops(ops, {k.masked_identity()})
+    assert f.n_ops == 3 and f.n_folded == 2
+    assert list(f.upserts) == [k]
+    assert int(f.upserts[k][1, 2]) == 82
+    assert not f.deletes and not f.new_keys
+
+
+def test_fold_add_then_delete_annihilates():
+    k = _key(2)
+    ops = [EditOp("cidr_add", k, _rules(80)), EditOp("key_delete", k)]
+    f = fold_ops(ops, set())
+    assert f.n_ops == 2 and f.n_effects == 0 and f.n_folded == 2
+
+
+def test_fold_delete_of_live_key_ships():
+    k = _key(3)
+    f = fold_ops([EditOp("key_delete", k)], {k.masked_identity()})
+    assert f.deletes == [k] and not f.upserts
+
+
+def test_fold_delete_then_readd_is_upsert():
+    """The supersession edge the injected defect corrupts: a live key
+    deleted and re-added in one transaction folds to an in-place upsert
+    of the re-add's rules (content-identical to sequential
+    application)."""
+    k = _key(4)
+    ops = [EditOp("key_delete", k), EditOp("key_add", k, _rules(443))]
+    f = fold_ops(ops, {k.masked_identity()})
+    assert not f.deletes and list(f.upserts) == [k]
+    assert int(f.upserts[k][1, 2]) == 443
+
+
+def test_fold_new_key_kind_marks_overlay_eligibility():
+    ka, kc = _key(5), _key(6)
+    f = fold_ops(
+        [EditOp("key_add", ka, _rules(1)), EditOp("cidr_add", kc, _rules(2))],
+        set(),
+    )
+    assert f.new_keys[ka][1] == "key_add"
+    assert f.new_keys[kc][1] == "cidr_add"
+
+
+def test_fold_injected_defect_drops_readd():
+    k = _key(7)
+    ops = [EditOp("key_delete", k), EditOp("key_add", k, _rules(443))]
+    txn_mod._INJECT_FOLD_BUG = True
+    try:
+        f = fold_ops(ops, {k.masked_identity()})
+    finally:
+        txn_mod._INJECT_FOLD_BUG = False
+    # the buggy fold loses BOTH ops: the stale pre-delete rules survive
+    assert f.n_effects == 0
+    assert isinstance(f, FoldedTxn)
+
+
+# --- batcher policy ----------------------------------------------------------
+
+
+def test_batcher_deadline_and_batch_thresholds():
+    now = [0.0]
+    b = TxnBatcher(staleness_s=0.010, max_ops=4, clock=lambda: now[0])
+    assert b.should_flush() is None
+    b.queue(EditOp("rules_edit", _key(1), _rules(1)))
+    assert b.should_flush() is None  # fresh and small: keep coalescing
+    now[0] = 0.005
+    assert b.should_flush() is None
+    now[0] = 0.011
+    assert b.should_flush() == "deadline"
+    items = b.drain()
+    assert len(items) == 1 and items[0][1] == 0.0
+    assert b.should_flush() is None and len(b) == 0
+    for i in range(4):
+        b.queue(EditOp("rules_edit", _key(1), _rules(i)))
+    assert b.should_flush() == "batch"  # batch beats deadline ordering
+
+
+def test_batcher_validation():
+    with pytest.raises(ValueError):
+        TxnBatcher(staleness_s=0)
+    with pytest.raises(ValueError):
+        TxnBatcher(max_ops=0)
+
+
+def test_txn_stats_counters_and_staleness_hist():
+    s = TxnStats()
+    s.note_flush(10, 4, 12, "deadline", False,
+                 staleness_s=[50e-6, 5e-3, 2.0])
+    s.note_flush(3, 0, 3, "batch", True)
+    vals = s.counter_values()
+    assert vals["patch_txn_total"] == 2
+    assert vals["patch_txn_ops_total"] == 13
+    assert vals["patch_txn_ops_folded_total"] == 4
+    assert vals["patch_txn_dirty_rows_total"] == 15
+    assert vals["patch_txn_escalations_total"] == 1
+    assert vals["patch_txn_flush_deadline_total"] == 1
+    assert vals["patch_txn_flush_batch_total"] == 1
+    assert vals["patch_txn_staleness_us_bucket_le_100"] == 1
+    assert vals["patch_txn_staleness_us_bucket_le_10000"] == 1
+    assert vals["patch_txn_staleness_us_bucket_inf"] == 1
+
+
+# --- edit-file protocol ------------------------------------------------------
+
+
+def test_edit_file_round_trip(tmp_path):
+    ops = [
+        EditOp("rules_edit", _key(1), _rules(80)),
+        EditOp("key_delete", _key(2)),
+        EditOp("cidr_add", _key(3, mask=20), _rules(443, action=1)),
+    ]
+    path = str(tmp_path / "e.json")
+    write_edit_file(path, ops)
+    got = read_edit_file(path)
+    assert len(got) == 3
+    for a, b in zip(ops, got):
+        assert a.kind == b.kind and a.key == b.key
+        if a.rules is None:
+            assert b.rules is None
+        else:
+            np.testing.assert_array_equal(a.rules, b.rules)
+    # json forms are canonical too
+    assert [op_to_json(o) for o in ops] == [op_to_json(o) for o in got]
+    assert op_from_json(op_to_json(ops[0])).key == ops[0].key
+
+
+def test_editop_validation():
+    with pytest.raises(ValueError):
+        EditOp("rules_edit", _key(1))  # rules required
+    with pytest.raises(ValueError):
+        EditOp("bogus", _key(1), _rules(1))
+
+
+# --- the apply half ----------------------------------------------------------
+
+
+def _mk_applier(n=60, force_path="trie", **kw):
+    from infw.backend.tpu import TpuClassifier
+
+    content = _content(n)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path=force_path)
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    return TxnApplier(clf, it, **kw), content
+
+
+def _truth(applier):
+    merged = dict(applier.updater.content)
+    merged.update(applier.overlay)
+    return merged
+
+
+def _assert_oracle_parity(applier, seed=11, b=256):
+    tables = compile_tables_from_content(_truth(applier), rule_width=4)
+    batch = testing.random_batch(np.random.default_rng(seed), tables, b)
+    ref = oracle.classify(tables, batch)
+    out = applier.clf.classify(batch, apply_stats=False)
+    np.testing.assert_array_equal(out.results, ref.results)
+
+
+@pytest.mark.parametrize("force_path", ["trie", "ctrie"])
+def test_applier_mixed_txn_one_patch_generation(force_path):
+    """A mixed folded transaction (edits + delete + delete-then-readd)
+    lands as ONE generation, on the patch path for rules-only content,
+    and serves oracle-exact verdicts."""
+    stats = TxnStats()
+    applier, content = _mk_applier(force_path=force_path, stats=stats)
+    keys = sorted(content, key=lambda k: k.ip_data)
+    ops = [
+        EditOp("rules_edit", keys[0], _rules(8080)),
+        EditOp("rules_edit", keys[0], _rules(8081)),   # supersedes
+        EditOp("key_delete", keys[1]),
+        EditOp("key_add", keys[1], _rules(9090)),      # folds to upsert
+        EditOp("rules_edit", keys[2], _rules(7070)),
+    ]
+    rep = applier.apply(ops, reason="batch")
+    assert rep.n_ops == 5 and rep.n_folded == 2
+    assert rep.mode == "patch" and not rep.escalated
+    assert rep.dirty_rows > 0
+    assert int(np.asarray(applier.updater.content[keys[1]])[1, 2]) == 9090
+    _assert_oracle_parity(applier)
+    assert stats.counter_values()["patch_txn_total"] == 1
+
+
+def test_applier_structural_txn_and_escalation():
+    """Adds/deletes ride the same flush; a mask the trie cannot absorb
+    escalates to the columnar rebuild with the report saying so."""
+    applier, content = _mk_applier()
+    keys = sorted(content, key=lambda k: k.ip_data)
+    rep = applier.apply([
+        EditOp("key_add", _key(200, mask=24), _rules(1)),
+        EditOp("key_delete", keys[0]),
+    ])
+    assert not rep.escalated
+    _assert_oracle_parity(applier, seed=12)
+    # a v6 /128 forces trie levels the /24-deep instance lacks
+    deep = LpmKey(128 + 32, 2, bytes(range(16)))
+    rep = applier.apply([EditOp("key_add", deep, _rules(2))])
+    assert rep.escalated and rep.mode == "full"
+    _assert_oracle_parity(applier, seed=13)
+
+
+def test_applier_overlay_overflow_mid_txn_spills_to_main():
+    """cidr_adds route to the overlay while it has room; the overflow
+    mid-transaction spills the WHOLE overlay into the main table (one
+    structural merge), never refuses."""
+    applier, _content_ = _mk_applier(
+        n=60, overlay_cap=4, overlay_min_main=10
+    )
+    adds = [
+        EditOp("cidr_add", _key(100 + i, mask=26), _rules(1000 + i))
+        for i in range(4)
+    ]
+    rep = applier.apply(adds)
+    assert len(applier.overlay) == 4 and rep.mode == "patch"
+    _assert_oracle_parity(applier, seed=14)
+    more = [
+        EditOp("cidr_add", _key(120 + i, mask=26), _rules(2000 + i))
+        for i in range(3)
+    ]
+    rep = applier.apply(more)
+    # overflow: everything merged structurally, overlay empty
+    assert applier.overlay == {}
+    idents = set(applier.updater._ident_to_t)
+    for op in adds + more:
+        assert op.key.masked_identity() in idents
+    _assert_oracle_parity(applier, seed=15)
+
+
+def test_applier_mesh_replicated_broadcast():
+    """One transaction flush against the replicated mesh classifier:
+    the fused patch broadcasts through the NamedSharding placement, the
+    load stays on the patch path, verdicts stay oracle-exact."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device pool")
+    from infw.backend.mesh import MeshTpuClassifier
+
+    content = _content(60)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = MeshTpuClassifier(
+        data_shards=4, rules_shards=1, interpret=True, force_path="trie"
+    )
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    applier = TxnApplier(clf, it)
+    keys = sorted(content, key=lambda k: k.ip_data)
+    rep = applier.apply([
+        EditOp("rules_edit", keys[i], _rules(6000 + i)) for i in range(8)
+    ])
+    assert rep.mode == "patch" and rep.dirty_rows > 0
+    _assert_oracle_parity(applier, seed=16)
+    clf.close()
+
+
+# --- zero-recompile contract across transaction sizes ------------------------
+
+
+def _txn_scatter_cache_sizes():
+    return (
+        jaxpath._scatter_rows_jit()._cache_size()
+        + jaxpath.jitted_txn_scatter(4)._cache_size()
+        + jaxpath.jitted_txn_scatter(5)._cache_size()
+    )
+
+
+def test_txn_patch_zero_scatter_compiles_across_sizes():
+    """The dirty-row-count ladder prewarm (warm_txn_scatters +
+    warm_scatters max_rows) must cover every executable shape a
+    rules-only transaction of 1..512 edits can launch: after the load's
+    warm, flushes at sizes 1/8/64/512 compile NOTHING."""
+    from infw.backend.tpu import TpuClassifier
+
+    content = _content(2500)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    applier = TxnApplier(clf, it)
+    keys = sorted(content, key=lambda k: k.ip_data)
+    size0 = _txn_scatter_cache_sizes()
+    pos = 0
+    for txn_size in (1, 8, 64, 512):
+        ops = [
+            EditOp("rules_edit", keys[pos + i], _rules(3000 + i))
+            for i in range(txn_size)
+        ]
+        pos += txn_size
+        rep = applier.apply(ops)
+        assert rep.mode == "patch", (
+            f"txn of {txn_size} fell off the patch path"
+        )
+    grew = _txn_scatter_cache_sizes() - size0
+    assert grew == 0, (
+        f"{grew} scatter executable(s) compiled across transaction "
+        "sizes 1/8/64/512 — the dirty-row ladder prewarm missed a shape"
+    )
+    _assert_oracle_parity(applier, seed=17, b=512)
+
+
+# --- flush racing a generation swap ------------------------------------------
+
+
+def test_flush_racing_generation_swap_double_buffers():
+    """A plan prepared against generation A must classify with A's
+    verdicts even when a transaction flush installs generation B before
+    the launch — and the next dispatch must see B (the double-buffer
+    swap contract under churn)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.constants import ALLOW, DENY
+    from infw.packets import PacketBatch
+
+    k = _key(1)
+    content = dict(_content(60))
+    content[k] = _rules(80, action=DENY)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    applier = TxnApplier(clf, it)
+
+    batch = PacketBatch(
+        kind=np.array([1], np.int32),
+        l4_ok=np.array([1], np.int32),
+        ifindex=np.array([2], np.int32),
+        ip_words=np.array(
+            [[(10 << 24) | (1 << 16) | 7, 0, 0, 0]], np.uint32
+        ),
+        proto=np.array([IPPROTO_TCP], np.int32),
+        dst_port=np.array([80], np.int32),
+        icmp_type=np.array([0], np.int32),
+        icmp_code=np.array([0], np.int32),
+        pkt_len=np.array([64], np.int32),
+    )
+    wire, v4o = batch.pack_wire_subset(np.asarray([0], np.int64))
+    plan = clf.prepare_packed(wire, v4o)          # staged against gen A
+    rep = applier.apply(
+        [EditOp("rules_edit", k, _rules(80, action=ALLOW))]
+    )
+    assert rep.mode == "patch"
+    out_a = clf.classify_prepared(plan, apply_stats=False).result()
+    assert int(out_a.results[0]) & 0xFF == DENY, (
+        "in-flight plan must finish on the generation it was staged "
+        "against"
+    )
+    out_b = clf.classify(batch, apply_stats=False)
+    assert int(out_b.results[0]) & 0xFF == ALLOW, (
+        "post-flush dispatch must see the new generation"
+    )
+    clf.close()
+
+
+# --- scheduler interleaving --------------------------------------------------
+
+
+def test_scheduler_flush_occupies_pipeline_slot():
+    """A tripped bounded-staleness flush runs DURING serving, holding
+    one pipeline slot: the serve completes, the flush lands exactly
+    once, and verdicts stay oracle-exact (the edit touches a key the
+    witness stream never matches)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.scheduler import ContinuousScheduler, DeadlinePolicy
+
+    content = _content(60)
+    tables = compile_tables_from_content(content, rule_width=4)
+    it = IncrementalTables.from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(it.snapshot())
+    it.clear_dirty()
+    applier = TxnApplier(clf, it)
+    batcher = TxnBatcher(staleness_s=1e-4, max_ops=64)
+    flushes = []
+
+    def flush(items, reason):
+        applier.apply([op for op, _ts in items], reason=reason,
+                      enqueue_ts=[ts for _op, ts in items])
+        flushes.append((len(items), reason))
+
+    keys = sorted(content, key=lambda k: k.ip_data)
+    for i in range(6):
+        batcher.queue(EditOp("rules_edit", keys[i], _rules(5000 + i)))
+    batch = testing.random_batch(np.random.default_rng(5), tables, 192)
+    ref = oracle.classify(tables, batch)
+    sched = ContinuousScheduler(
+        clf, DeadlinePolicy(0.5, 64), pipeline_depth=2,
+        txn_batcher=batcher, txn_flush=flush,
+    )
+    res = sched.serve(batch, np.zeros(192))
+    assert flushes and sum(n for n, _r in flushes) == 6
+    assert flushes[0][1] in ("deadline", "batch")
+    # the witness stream predates the edit keys' port space: verdicts
+    # must match the pre-edit oracle bit-exactly
+    np.testing.assert_array_equal(res.results, ref.results)
+    assert len(batcher) == 0
+    clf.close()
+
+
+def test_scheduler_flush_error_surfaces():
+    from infw.backend.tpu import TpuClassifier
+    from infw.scheduler import ContinuousScheduler, DeadlinePolicy
+
+    content = _content(40)
+    tables = compile_tables_from_content(content, rule_width=4)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(tables)
+    batcher = TxnBatcher(staleness_s=1e-4, max_ops=4)
+    batcher.queue(EditOp("rules_edit", _key(1), _rules(1)))
+
+    def bad_flush(items, reason):
+        raise RuntimeError("flush exploded")
+
+    sched = ContinuousScheduler(
+        clf, DeadlinePolicy(0.5, 64), pipeline_depth=2,
+        txn_batcher=batcher, txn_flush=bad_flush,
+    )
+    batch = testing.random_batch(np.random.default_rng(6), tables, 64)
+    with pytest.raises(RuntimeError, match="flush exploded"):
+        sched.serve(batch, np.zeros(64))
+    clf.close()
+
+
+# --- daemon edits-dir protocol ----------------------------------------------
+
+
+def _drop_json(path, doc):
+    with open(path + ".tmp", "w") as f:
+        json.dump(doc, f)
+    os.replace(path + ".tmp", path)
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _mk_daemon(tmp_path, **kw):
+    from infw.daemon import Daemon
+    from infw.interfaces import Interface, InterfaceRegistry
+
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    d = Daemon(
+        state_dir=str(tmp_path / "state"), node_name="n0",
+        namespace="ns", backend="cpu", poll_period_s=0.05,
+        registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=0.02, **kw,
+    )
+    d.start()
+    return d
+
+
+def _sync_daemon_rules(d):
+    from test_daemon import node_state
+
+    ns_doc = node_state(name="n0", namespace="ns").to_dict()
+    _drop_json(os.path.join(d.nodestates_dir, "n0.json"), ns_doc)
+    assert _wait(lambda: d.syncer.classifier is not None
+                 and d.syncer.classifier.tables is not None)
+
+
+@pytest.mark.parametrize("sched_mode", [False, True])
+def test_daemon_edits_dir_applies_transaction(tmp_path, sched_mode):
+    """Edit files dropped into <state-dir>/edits/ coalesce and flush as
+    one folded transaction between admissions: the edited verdict goes
+    live, txn counters land on /metrics, and the PatchTxnRecord line
+    reaches the event log.  sched_mode runs the same protocol under the
+    deadline scheduler's tick (edits applied between admissions)."""
+    import urllib.request
+
+    from infw.daemon import write_frames_file
+    from infw.obs.pcap import build_frame
+
+    kw = dict(patch_staleness_us=200.0)
+    if sched_mode:
+        kw.update(deadline_us=50000.0, max_batch=256)
+    d = _mk_daemon(tmp_path, **kw)
+    try:
+        _sync_daemon_rules(d)
+        content = d.syncer.get_classifier_map_content_for_test()
+        (key, rows), = [
+            (k, v) for k, v in content.items()
+            if k.ip_data[:1] == bytes([10])
+        ]
+        new_rows = np.asarray(rows, np.int32).copy()
+        new_rows[1, 2] = 81  # deny :81 instead of :80
+        op = EditOp("rules_edit", key, new_rows)
+        write_edit_file(
+            os.path.join(d.edits_dir, "e0.json"), [op]
+        )
+        assert _wait(lambda: d.txn_stats.counter_values()[
+            "patch_txn_total"] >= 1)
+        # the edited rule is live: :81 now denies, :80 passes
+        frames = [
+            build_frame("10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999, 81),
+            build_frame("10.1.2.3", "203.0.113.1", IPPROTO_TCP, 999, 80),
+        ]
+        write_frames_file(
+            os.path.join(d.ingest_dir, "t1.frames"), frames, 10
+        )
+        vp = os.path.join(d.out_dir, "t1.frames.verdicts.json")
+        assert _wait(lambda: os.path.exists(vp))
+        with open(vp) as f:
+            summary = json.load(f)
+        assert summary["drop"] == 1 and summary["pass"] == 1
+        port = d.actual_metrics_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            text = r.read().decode()
+        assert "patch_txn_total" in text
+        assert "patch_txn_staleness_us_bucket" in text
+        assert _wait(lambda: "patch-txn:" in open(d.events_path).read())
+    finally:
+        d.stop()
+
+
+def test_daemon_bad_edit_file_consumed(tmp_path):
+    d = _mk_daemon(tmp_path)
+    try:
+        _sync_daemon_rules(d)
+        bad = os.path.join(d.edits_dir, "bad.json")
+        with open(bad, "w") as f:
+            f.write("{not json")
+        good_op = None
+        content = d.syncer.get_classifier_map_content_for_test()
+        k = next(iter(content))
+        good_op = EditOp("rules_edit", k, _rules(82))
+        write_edit_file(os.path.join(d.edits_dir, "good.json"), [good_op])
+        # the bad file is consumed and the good one applied
+        assert _wait(lambda: not os.path.exists(bad))
+        assert _wait(lambda: d.txn_stats.counter_values()[
+            "patch_txn_total"] >= 1)
+    finally:
+        d.stop()
+
+
+# --- churngen ----------------------------------------------------------------
+
+
+def test_churngen_deterministic(tmp_path):
+    """Same seed -> byte-identical edit files (the open-loop generator
+    contract), parseable by the daemon-side reader."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = []
+    for name in ("a", "b"):
+        out = str(tmp_path / name)
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "churngen.py"),
+             "--out", out, "--rate", "1000000", "--n", "48",
+             "--entries", "40", "--file-ops", "16", "--seed", "3"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.join(repo, "tools"),
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(out)
+    files_a = sorted(
+        f for f in os.listdir(outs[0])
+        if f.startswith("churn") and not f.endswith("-manifest.json")
+    )
+    files_b = sorted(
+        f for f in os.listdir(outs[1])
+        if f.startswith("churn") and not f.endswith("-manifest.json")
+    )
+    assert files_a == files_b and len(files_a) == 3
+    for fn in files_a:
+        a = open(os.path.join(outs[0], fn), "rb").read()
+        b = open(os.path.join(outs[1], fn), "rb").read()
+        assert a == b
+        ops = read_edit_file(os.path.join(outs[0], fn))
+        assert len(ops) == 16
+        assert all(op.kind in txn_mod.TXN_EDIT_KINDS for op in ops)

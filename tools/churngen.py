@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Open-loop rule-edit (churn) generator for the daemon's edits dir.
+
+The control-plane twin of tools/loadgen.py: where loadgen offers an
+open-loop PACKET stream into ``<state-dir>/ingest/``, churngen offers an
+open-loop EDIT stream into ``<state-dir>/edits/`` — BGP-style rule churn
+at a fixed offered rate, for driving the update-storm dataplane
+(``--patch-staleness-us`` batching, ``bench_churn``'s methodology)
+against a live daemon.
+
+Edits are sampled against the SAME seeded table the daemon is expected
+to be serving (``--entries``/``--table-seed`` regenerate
+``infw.testing.random_tables_fast`` deterministically, the bench-tier
+substrate), so rules_edit/key_delete ops hit live identities and
+cidr_add ops are genuinely structural.  The op mix is
+rules-edit-dominated like a real control plane (defaults: 70% rules
+edits, 15% CIDR adds, 10% deletes, 5% delete-then-readd pairs — the
+fold's supersession edge).
+
+Open-loop discipline (the coordinated-omission rule, verbatim from
+loadgen): the drop schedule is computed up front against one anchor
+timestamp and each write sleeps until its ABSOLUTE scheduled time, so a
+slow consumer makes the generator fall visibly behind (reported at
+exit) instead of silently stretching the offered churn rate.
+Determinism per ``--seed`` covers keys, rules AND arrival times.
+
+Usage:
+    python tools/churngen.py --out <state-dir>/edits --rate 2000 \\
+        --n 10000 [--entries 2000] [--table-seed 2024] \\
+        [--file-ops 64] [--seed 7] [--dry-run]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from _common import setup_repo_path
+
+setup_repo_path()
+
+from infw import testing  # noqa: E402
+from infw.compiler import LpmKey  # noqa: E402
+from infw.txn import EditOp, write_edit_file  # noqa: E402
+
+#: op mix: (kind, probability); "readd" expands to a delete+re-add pair
+OP_MIX = (
+    ("rules_edit", 0.70),
+    ("cidr_add", 0.15),
+    ("key_delete", 0.10),
+    ("readd", 0.05),
+)
+
+
+def generate_ops(rng: np.random.Generator, n: int, tables, width: int):
+    """Seeded open-loop edit stream over the live key population: keys
+    leave on delete and return on (re)add, so sustained churn never
+    edits a dead identity."""
+    keys = list(tables.content)
+    live = list(keys)
+    idents = {k.masked_identity() for k in live}
+    deleted: list = []
+    kinds = [k for k, _p in OP_MIX]
+    probs = np.array([p for _k, p in OP_MIX])
+    probs /= probs.sum()
+    ops = []
+    serial = 0
+    while len(ops) < n:
+        kind = str(rng.choice(kinds, p=probs))
+        if kind in ("rules_edit", "key_delete") and not live:
+            kind = "cidr_add"
+        if kind == "readd" and not deleted:
+            kind = "key_delete" if live else "cidr_add"
+        if kind == "rules_edit":
+            k = live[int(rng.integers(0, len(live)))]
+            ops.append(EditOp("rules_edit", k, testing.random_rules(rng, width)))
+        elif kind == "key_delete":
+            i = int(rng.integers(0, len(live)))
+            k = live.pop(i)
+            idents.discard(k.masked_identity())
+            deleted.append(k)
+            ops.append(EditOp("key_delete", k))
+        elif kind == "readd":
+            k = deleted.pop(int(rng.integers(0, len(deleted))))
+            if k.masked_identity() in idents:
+                continue
+            idents.add(k.masked_identity())
+            live.append(k)
+            ops.append(EditOp("key_add", k, testing.random_rules(rng, width)))
+        else:  # cidr_add: a fresh structural identity
+            serial += 1
+            k = LpmKey(
+                prefix_len=56,
+                ingress_ifindex=2,
+                ip_data=bytes([
+                    198, 18, (serial >> 8) & 0xFF, serial & 0xFF
+                ]) + bytes(12),
+            )
+            if k.masked_identity() in idents:
+                continue
+            idents.add(k.masked_identity())
+            live.append(k)
+            ops.append(EditOp("cidr_add", k, testing.random_rules(rng, width)))
+    return ops
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="infw-churngen", description=__doc__)
+    p.add_argument("--out", required=True,
+                   help="edits directory of the target daemon")
+    p.add_argument("--rate", type=float, required=True,
+                   help="offered churn, edits/second")
+    p.add_argument("--n", type=int, required=True, help="total edits")
+    p.add_argument("--entries", type=int, default=2000,
+                   help="entry count of the seeded table the daemon "
+                        "serves (edits target its keys)")
+    p.add_argument("--table-seed", type=int, default=2024,
+                   help="seed of the served table "
+                        "(testing.random_tables_fast)")
+    p.add_argument("--width", type=int, default=8)
+    p.add_argument("--file-ops", type=int, default=64,
+                   help="ops per dropped edit file")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the schedule summary without writing or "
+                        "sleeping")
+    args = p.parse_args(argv)
+    if args.rate <= 0 or args.n <= 0 or args.file_ops <= 0:
+        p.error("--rate, --n and --file-ops must be positive")
+
+    tables = testing.random_tables_fast(
+        np.random.default_rng(args.table_seed), n_entries=args.entries,
+        width=args.width, ifindexes=(2, 3, 4),
+    )
+    rng = np.random.default_rng(args.seed)
+    offs = testing.poisson_arrivals(rng, args.rate, args.n)
+    ops = generate_ops(rng, args.n, tables, args.width)
+
+    fo = int(args.file_ops)
+    n_files = -(-args.n // fo)
+    file_starts = offs[::fo][:n_files]
+    summary = {
+        "n": int(args.n), "rate_eps": float(args.rate),
+        "files": int(n_files), "file_ops": fo,
+        "duration_s": float(offs[-1]), "seed": int(args.seed),
+        "entries": int(args.entries), "table_seed": int(args.table_seed),
+    }
+    print(json.dumps(summary), flush=True)
+    if args.dry_run:
+        return 0
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "churngen-manifest.json"), "w") as f:
+        json.dump({**summary,
+                   "file_start_offsets_s": [float(x) for x in file_starts]},
+                  f)
+    t0 = time.monotonic()
+    worst_lag = 0.0
+    for i in range(n_files):
+        target = t0 + float(file_starts[i])
+        lag = time.monotonic() - target
+        if lag < 0:
+            time.sleep(-lag)
+        else:
+            worst_lag = max(worst_lag, lag)
+        write_edit_file(
+            os.path.join(args.out, f"churn{i:06d}.json"),
+            ops[i * fo: (i + 1) * fo],
+        )
+    done = time.monotonic() - t0
+    print(json.dumps({
+        "offered_duration_s": float(offs[-1]),
+        "actual_duration_s": done,
+        "worst_schedule_lag_s": worst_lag,
+        "fell_behind": worst_lag > 0.01,
+    }), flush=True)
+    if worst_lag > 0.01:
+        print("churngen: WARNING fell behind its open-loop schedule by "
+              f"{worst_lag*1e3:.1f} ms — offered churn was lower than "
+              "requested; measured edit-visible latencies must use the "
+              "manifest's scheduled offsets", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

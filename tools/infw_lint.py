@@ -298,40 +298,52 @@ def cmd_jax(args) -> int:
 #: tripped placeholder regime.  dense/fused/mesh run in the pytest suite
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
-                         "ctrie-overlay")
+                         "ctrie-overlay", "txn", "txn-ctrie")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
     """The injected-defect acceptance: re-introduce a known bug and
-    prove the checker catches it with a shrunk reproducer of <= 3 ops.
-    Exit 0 = caught.  ``joined-pad`` runs the PR-4 joined-placeholder
-    bucket-padding bug on the 'nojoined' config (the placeholder layout
-    regime); ``cskip`` zeroes the compressed layout's skip_bits words on
-    the 'ctrie' config — the resident AND cold-rebuilt device state
-    share the defect, so the catch is oracle divergence, proving the
-    classify-equivalence half covers the skip-node path."""
+    prove the checker catches it with a shrunk reproducer within the
+    per-defect op bound.  Exit 0 = caught.  ``joined-pad`` runs the
+    PR-4 joined-placeholder bucket-padding bug on the 'nojoined' config
+    (the placeholder layout regime); ``cskip`` zeroes the compressed
+    layout's skip_bits words on the 'ctrie' config — the resident AND
+    cold-rebuilt device state share the defect, so the catch is oracle
+    divergence, proving the classify-equivalence half covers the
+    skip-node path; ``fold`` drops delete-then-readd pairs in the
+    transaction fold (infw.txn) on the 'txn' config — the corrupted
+    fold feeds updater, resident state AND cold rebuild alike, so the
+    catch again MUST be per-op-ground-truth oracle divergence, shrunk
+    to a <= 2-op (delete, readd) reproducer."""
+    from infw import txn as txn_mod
     from infw.analysis import statecheck
     from infw.kernels import jaxpath
 
     defect = args.inject_defect
-    config = "ctrie" if defect == "cskip" else "nojoined"
-    flag = (
-        "_INJECT_CSKIP_BUG" if defect == "cskip"
-        else "_INJECT_JOINED_PAD_BUG"
-    )
+    mod, flag, config, bound = {
+        "joined-pad": (jaxpath, "_INJECT_JOINED_PAD_BUG", "nojoined", 3),
+        "cskip": (jaxpath, "_INJECT_CSKIP_BUG", "ctrie", 3),
+        "fold": (txn_mod, "_INJECT_FOLD_BUG", "txn", 2),
+    }[defect]
+    # the fold defect only fires on a delete-then-readd landing in one
+    # transaction; give the seeded generator a horizon that reliably
+    # produces one (seed 0 hits by op 5 at 12 ops) and the shrinker
+    # budget to reduce it back down to the (delete, readd) pair
+    n_ops = max(args.ops, 12) if defect == "fold" else args.ops
+    shrink_runs = 64 if defect == "fold" else 32
     if args.configs:
         print(f"note: --inject-defect {defect} always runs the "
               f"{config!r} config (the defect's layout regime); "
               "--configs ignored", file=sys.stderr)
-    setattr(jaxpath, flag, True)
+    setattr(mod, flag, True)
     try:
         report = statecheck.run_config(
-            config, seed=args.seed, n_ops=args.ops,
+            config, seed=args.seed, n_ops=n_ops,
             backend=args.backend, witness_b=args.witness,
-            max_shrink_runs=32,
+            max_shrink_runs=shrink_runs,
         )
     finally:
-        setattr(jaxpath, flag, False)
+        setattr(mod, flag, False)
     problems = []
     if report["ok"]:
         problems.append(
@@ -341,9 +353,9 @@ def _run_inject_defect(args, as_json: bool) -> int:
     else:
         shrunk = report.get("shrunk") or {}
         n = shrunk.get("ops", 10**9)
-        if n > 3:
+        if n > bound:
             problems.append(
-                f"shrunk reproducer has {n} ops (acceptance bound: 3)"
+                f"shrunk reproducer has {n} ops (acceptance bound: {bound})"
             )
     report["problems"] = problems
     report["caught"] = not problems
@@ -481,13 +493,15 @@ def main(argv=None) -> int:
                          help="witness batch size override")
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
-                         choices=("joined-pad", "cskip"),
+                         choices=("joined-pad", "cskip", "fold"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "
                               "skip_bits in the compressed skip-node "
-                              "path — and verify the checker catches it "
-                              "(exit 0 = caught)")
+                              "path; fold: delete-then-readd pairs "
+                              "dropped by the transaction fold "
+                              "(infw.txn) — and verify the checker "
+                              "catches it (exit 0 = caught)")
     p_state.set_defaults(fn=cmd_state)
 
     args = ap.parse_args(argv)
