@@ -70,6 +70,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cskip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -133,10 +134,22 @@ slo-bench:
 churn-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --churn-bench
 
+# The multi-tenant arena tier (bench.bench_tenant) standalone at smoke
+# scale off-TPU: pre-staged tenant hot-swap (page-table row flip) vs
+# the full re-upload A/B (interleaved min-vs-min, gated on
+# INFW_SWAP_SPEEDUP_MIN, default 10x — the ISSUE-10 acceptance), plus
+# mixed-tenant batch vs sequential per-tenant dispatch at 64 tenants
+# and the arena-vs-N-padded-tables HBM footprint line.  Mixed-batch
+# verdicts are oracle-checked bit-exact inside the tier, and the
+# statecheck arena equivalence configs run BEFORE any record is
+# published.
+tenant-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tenant-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

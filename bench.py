@@ -2133,6 +2133,216 @@ def churn_bench_main() -> int:
     return rc
 
 
+# --- multi-tenant paged arena tier (ISSUE-10) -------------------------------
+
+
+def bench_tenant(rng, on_tpu):
+    """Multi-tenant arena tier (``make tenant-bench``, folded into
+    bench-checked):
+
+    - **tenant hot-swap vs full re-upload** (the acceptance line): the
+      page-table row flip activating a PRE-STAGED slab on a warm arena
+      vs the single-tenant classifier's full table upload of the same
+      ruleset, measured INTERLEAVED min-vs-min (benchruns rules: both
+      sides see the same ambient load) at 1M entries on TPU (20K CPU
+      smoke);
+    - **mixed-tenant batch vs sequential per-tenant dispatch** at 64
+      (and 512 on TPU) tenants: one tenant-column batch through the
+      arena dispatch vs one dispatch per tenant on the same arena;
+    - **arena HBM footprint vs N independent padded tables**;
+    - every line gated on mixed-batch bit-identity vs the per-tenant
+      CPU oracles through the production wire dispatch.
+
+    Returns the record dict for the tenant-bench gate
+    (INFW_SWAP_SPEEDUP_MIN)."""
+    from infw import oracle as oracle_mod, packets as packets_mod
+    from infw.backend.tpu import ArenaClassifier
+
+    out = {}
+
+    # -- swap A/B at scale --------------------------------------------------
+    n_swap = 1_000_000 if on_tpu else 200_000
+    big = testing.clean_tables_fast(rng, n_entries=n_swap, width=4)
+    big2 = testing.clean_tables_fast(
+        np.random.default_rng(4242), n_entries=n_swap, width=4
+    )
+    spec = jaxpath.arena_spec_for(
+        "ctrie", (big, big2), pages=4, max_tenants=8
+    )
+    alloc = jaxpath.ArenaAllocator(spec)
+    alloc.load_tenant(0, big)
+    # pre-stage the standby slabs once; the measured swap is the
+    # ACTIVATION (page-table row flip) — the serving-path cost
+    pg_a = alloc.stage(big2)
+    pg_b = alloc.page_of(0)
+
+    def flip_once(i):
+        t0 = time.perf_counter()
+        alloc.activate(0, pg_a if i % 2 == 0 else pg_b)
+        jax.block_until_ready(alloc.arena.page_table)
+        return time.perf_counter() - t0
+
+    def upload_once(i):
+        t = big2 if i % 2 == 0 else big
+        t0 = time.perf_counter()
+        dev = jaxpath.device_ctrie(t, pad=True)
+        jax.block_until_ready(dev[0].nodes)
+        return time.perf_counter() - t0
+
+    flip_s, upload_s = float("inf"), float("inf")
+    flip_once(0)  # warm both executables off the clock
+    upload_once(0)
+    for i in range(1, 4):  # interleaved min-vs-min
+        flip_s = min(flip_s, flip_once(i))
+        upload_s = min(upload_s, upload_once(i))
+    speedup = upload_s / max(flip_s, 1e-9)
+    log(f"tenant swap @{n_swap} entries: flip {flip_s*1e6:.0f} us vs "
+        f"full re-upload {upload_s*1e3:.1f} ms ({speedup:.0f}x)")
+    emit(f"tenant hot-swap page-flip @{n_swap} entries", flip_s * 1e3, "ms",
+         vs_baseline=0.0)
+    emit(f"tenant full re-upload @{n_swap} entries", upload_s * 1e3, "ms",
+         vs_baseline=0.0)
+    emit("tenant swap speedup vs re-upload", speedup, "x", vs_baseline=0.0)
+    out["swap_speedup"] = float(speedup)
+    del alloc
+
+    # -- mixed-tenant batch vs sequential per-tenant dispatch ---------------
+    for n_tenants in (64, 512) if on_tpu else (64,):
+        per_entries = 64
+        tabs = [
+            testing.random_tables_fast(
+                np.random.default_rng(9000 + t), n_entries=per_entries,
+                width=4, v6_fraction=0.3, ifindexes=(2, 3),
+            )
+            for t in range(n_tenants)
+        ]
+        spec = jaxpath.arena_spec_for(
+            "ctrie", tabs, pages=n_tenants + 2,
+            max_tenants=n_tenants + 1,
+        )
+        clf = ArenaClassifier(spec, fused_deep=False)
+        for t, tab in enumerate(tabs):
+            clf.load_tenant(t, tab)
+        per_b = 4096 // n_tenants if on_tpu else 16
+        parts, tags, refs = [], [], []
+        for t, tab in enumerate(tabs):
+            b = testing.random_batch_fast(
+                np.random.default_rng(100 + t), tab, n_packets=per_b
+            )
+            parts.append(b)
+            tags.append(np.full(per_b, t, np.int32))
+            refs.append(oracle_mod.classify(tab, b))
+        batch = packets_mod.concat(parts)
+        tenant = np.concatenate(tags)
+        wire = batch.pack_wire()
+        B = len(batch)
+
+        # oracle bit-identity gate BEFORE any timing line
+        got = clf.classify_async_packed_tenant(
+            wire, tenant, apply_stats=False
+        ).result()
+        want = np.concatenate([r.results for r in refs])
+        if not np.array_equal(got.results, want):
+            raise RuntimeError(
+                f"tenant-bench oracle mismatch at {n_tenants} tenants: "
+                f"{int((got.results != want).sum())}/{B} verdicts"
+            )
+        log(f"tenant mixed-batch oracle bit-identity OK "
+            f"({n_tenants} tenants, {B} packets)")
+
+        reps = 8 if on_tpu else 3
+
+        def mixed_once():
+            t0 = time.perf_counter()
+            clf.classify_async_packed_tenant(
+                wire, tenant, apply_stats=False
+            ).result()
+            return time.perf_counter() - t0
+
+        sub_wires = []
+        for t in range(n_tenants):
+            idx = np.nonzero(tenant == t)[0]
+            w, _v4 = batch.pack_wire_subset(idx.astype(np.int64))
+            sub_wires.append((w, np.full(len(idx), t, np.int32)))
+
+        def seq_once():
+            t0 = time.perf_counter()
+            pend = [
+                clf.classify_async_packed_tenant(w, tg, apply_stats=False)
+                for w, tg in sub_wires
+            ]
+            for p in pend:
+                p.result()
+            return time.perf_counter() - t0
+
+        mixed_s, seq_s = float("inf"), float("inf")
+        mixed_once()
+        seq_once()  # warm
+        for _ in range(reps):  # interleaved
+            mixed_s = min(mixed_s, mixed_once())
+            seq_s = min(seq_s, seq_once())
+        log(f"mixed-tenant batch @{n_tenants} tenants: "
+            f"{B/mixed_s/1e6:.2f} M pkts/s vs sequential "
+            f"{B/seq_s/1e6:.2f} M pkts/s "
+            f"({seq_s/mixed_s:.1f}x)")
+        emit(f"mixed-tenant classify @{n_tenants} tenants", B / mixed_s,
+             "packets/s", vs_baseline=0.0)
+        emit(f"sequential per-tenant classify @{n_tenants} tenants",
+             B / seq_s, "packets/s", vs_baseline=0.0)
+        out[f"mixed_vs_seq_{n_tenants}"] = float(seq_s / mixed_s)
+
+        # -- HBM footprint vs N independent padded tables -------------------
+        pool_b = clf.allocator.pool_bytes()
+        one = jaxpath.device_ctrie(tabs[0], pad=True)
+        table_b = sum(int(np.asarray(a).nbytes) for a in one[0])
+        ratio = (n_tenants * table_b) / max(pool_b, 1)
+        log(f"arena footprint @{n_tenants} tenants: pool "
+            f"{pool_b/1e6:.1f} MB vs {n_tenants} padded tables "
+            f"{n_tenants*table_b/1e6:.1f} MB ({ratio:.2f}x)")
+        emit(f"arena HBM pool @{n_tenants} tenants", pool_b / 1e6, "MB",
+             vs_baseline=0.0)
+        emit(f"{n_tenants} independent padded tables",
+             n_tenants * table_b / 1e6, "MB", vs_baseline=0.0)
+        out[f"footprint_ratio_{n_tenants}"] = float(ratio)
+        clf.close()
+    return out
+
+
+def tenant_bench_main() -> int:
+    """``make tenant-bench``: the multi-tenant arena tier standalone
+    (CPU smoke off TPU) with the regression gates — the pre-staged
+    hot-swap (page-table flip) must beat the full re-upload by
+    INFW_SWAP_SPEEDUP_MIN (default 10x, the ISSUE-10 acceptance).  The
+    statecheck arena equivalence configs run FIRST and gate record
+    publication, mirroring the churn-bench discipline."""
+    speedup_min = float(os.environ.get("INFW_SWAP_SPEEDUP_MIN", "10.0"))
+    from infw.analysis import statecheck
+
+    for cfg in ("arena", "arena-ctrie"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        if not rep["ok"]:
+            log(f"tenant-bench FAIL: statecheck {cfg} not green before "
+                f"record publication: {rep['failure']}")
+            return 1
+        log(f"tenant-bench: statecheck {cfg} green "
+            f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_tenant(rng, on_tpu)
+    emit_compact_record()
+    rc = 0
+    if not rec.get("swap_speedup", 0.0) >= speedup_min:
+        log(f"tenant-bench FAIL: swap speedup "
+            f"{rec.get('swap_speedup', 0):.1f}x < gate {speedup_min}x")
+        rc = 1
+    if rc == 0:
+        log("tenant-bench OK: " + ", ".join(
+            f"{k}={v:.2f}" for k, v in sorted(rec.items())
+        ))
+    return rc
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -2426,6 +2636,15 @@ def main():
         bench_churn(rng, on_tpu)
     except Exception as e:
         log(f"churn tier FAILED: {e}")
+    try:
+        # ISSUE-10 multi-tenant arena tier: pre-staged hot-swap
+        # (page-table flip) vs full re-upload A/B, mixed-tenant batch
+        # vs sequential per-tenant dispatch, arena HBM footprint vs N
+        # padded tables (also standalone as `bench.py --tenant-bench`,
+        # `make tenant-bench`, with the swap-speedup gate)
+        bench_tenant(rng, on_tpu)
+    except Exception as e:
+        log(f"tenant tier FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
     # contiguous block, then ONE compact single-line JSON holding the
@@ -2450,4 +2669,6 @@ if __name__ == "__main__":
         sys.exit(slo_bench_main())
     if "--churn-bench" in sys.argv:
         sys.exit(churn_bench_main())
+    if "--tenant-bench" in sys.argv:
+        sys.exit(tenant_bench_main())
     sys.exit(main())

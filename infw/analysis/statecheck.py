@@ -94,6 +94,16 @@ EDIT_KINDS = (
     "full_replace",   # rebuild the updater from current content
 )
 
+#: tenant lifecycle ops (multi-tenant paged-arena configs only): the
+#: ARENA alphabet extends the single-key kinds (each tagged with a
+#: ``tenant``) with create / hot-swap / destroy — tenant_swap is the
+#: page-table-flip path the pageflip injected-defect acceptance covers.
+TENANT_KINDS = (
+    "tenant_create",   # new tenant, items = initial content
+    "tenant_swap",     # full ruleset replacement by page-table flip
+    "tenant_destroy",  # page freed, tenant lanes -> UNDEF
+)
+
 #: explicit transaction-boundary record (txn-mode configs only): the
 #: driver buffers single-key ops and applies them as ONE folded
 #: transaction (infw.txn.fold_ops) at each boundary — checks run only
@@ -118,14 +128,21 @@ class EditOp:
     key: Optional[LpmKey] = None
     rules: Optional[np.ndarray] = None
     items: Tuple[Tuple[LpmKey, np.ndarray], ...] = ()
+    #: arena configs: which tenant this op targets (single-key ops),
+    #: creates/swaps/destroys (tenant ops).  Ignored by the
+    #: single-tenant driver, so plain-config repros stay unchanged.
+    tenant: int = 0
 
     def describe(self) -> str:
+        tag = f"@t{self.tenant}" if self.tenant else ""
         if self.kind in ("full_replace", TXN_FLUSH):
-            return self.kind
+            return self.kind + tag
+        if self.kind in TENANT_KINDS:
+            return f"{self.kind}(t{self.tenant}, {len(self.items)} keys)"
         if self.kind == "overlay_spill":
-            return f"overlay_spill(+{len(self.items)} keys)"
+            return f"overlay_spill(+{len(self.items)} keys){tag}"
         k = self.key
-        return (f"{self.kind}({k.ingress_ifindex}:"
+        return (f"{self.kind}{tag}({k.ingress_ifindex}:"
                 f"{k.ip_data.hex()[:12]}../{k.mask_len})")
 
     def code(self) -> str:
@@ -140,6 +157,8 @@ class EditOp:
                 f"({_key_code(k)}, {_rules_code(r)})" for k, r in self.items
             )
             parts.append(f"items=({items},)")
+        if self.tenant:
+            parts.append(f"tenant={self.tenant}")
         return f"statecheck.EditOp({', '.join(parts)})"
 
 
@@ -194,6 +213,15 @@ class StateConfig:
     #: (op semantics lost in the coalesce) diverges even when the
     #: resident state and the cold rebuild share it
     txn: int = 0
+    #: "" = single-tenant (the plain driver); "dense"/"ctrie" = the
+    #: multi-tenant paged arena of that family: the base content
+    #: partitions into ``tenants`` initial tenants, ops carry tenant
+    #: tags + the TENANT_KINDS lifecycle, and every settled check runs
+    #: the mixed-tenant witness against PER-TENANT oracles through the
+    #: production arena dispatch (cross-tenant isolation falls out:
+    #: an edit leaking across slabs diverges some OTHER tenant's lanes)
+    arena: str = ""
+    tenants: int = 3
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -233,6 +261,18 @@ CONFIGS: Dict[str, StateConfig] = {
         StateConfig("txn", steered=True, txn=3),
         StateConfig("txn-overlay", overlay=True, txn=3),
         StateConfig("txn-ctrie", force_path="ctrie", steered=True, txn=3),
+        # multi-tenant paged arena (ISSUE-10): the tenant alphabet
+        # (create / per-tenant edits / hot-swap / destroy) over the
+        # dense and compressed-trie slab families, checked by host-vs-
+        # device pool bit-identity, per-slab cold-rebuild equivalence
+        # and the mixed-tenant witness vs per-tenant oracles.  The
+        # pageflip injected-defect acceptance (infw_lint state
+        # --inject-defect pageflip) runs "arena-ctrie" under the
+        # stale-page-table-row bug.
+        StateConfig("arena", arena="dense", n_entries=30, width=4,
+                    force_path=None, witness_b=144),
+        StateConfig("arena-ctrie", arena="ctrie", n_entries=36, width=4,
+                    force_path="ctrie", witness_b=144),
     )
 }
 
@@ -1285,6 +1325,11 @@ def run_ops(
     report the staleness the design permits, not a bug."""
     cfg = CONFIGS[config] if isinstance(config, str) else config
     wb = witness_b or cfg.witness_b
+    if cfg.arena:
+        return _run_arena_ops(
+            base_content, list(ops), cfg, witness_b=wb, backend=backend,
+            mesh_shards=mesh_shards, seed=seed,
+        )
     try:
         drv = _Driver(base_content, cfg, backend, wb, seed,
                       mesh_shards=mesh_shards)
@@ -1339,7 +1384,10 @@ def build_case(
     cfg = CONFIGS[config] if isinstance(config, str) else config
     rng = np.random.default_rng([_CASE_SALT, seed])
     base = make_content(cfg, rng)
-    ops = generate_ops(rng, cfg, base, n_ops)
+    if cfg.arena:
+        ops = generate_arena_ops(rng, cfg, base, n_ops)
+    else:
+        ops = generate_ops(rng, cfg, base, n_ops)
     return base, ops
 
 
@@ -1381,3 +1429,411 @@ def run_config(
                 "repro": repro.code(),
             }
     return out
+
+
+# --- multi-tenant paged arena (ISSUE-10) ------------------------------------
+
+
+def partition_tenants(
+    base_content: Dict[LpmKey, np.ndarray], n_tenants: int
+) -> Dict[int, Dict[LpmKey, np.ndarray]]:
+    """Deterministic round-robin partition of a flat base table into
+    initial tenants (sorted key order), so the shrinker's base-chunk
+    removal works on the SAME flat dict as every other config."""
+    keys = sorted(
+        base_content,
+        key=lambda k: (k.ingress_ifindex, k.prefix_len, k.ip_data),
+    )
+    out: Dict[int, Dict[LpmKey, np.ndarray]] = {
+        t: {} for t in range(max(n_tenants, 1))
+    }
+    for i, k in enumerate(keys):
+        out[i % max(n_tenants, 1)][k] = base_content[k]
+    return {t: c for t, c in out.items() if c}
+
+
+def generate_arena_ops(
+    rng, config: StateConfig, base_content: Dict[LpmKey, np.ndarray],
+    n_ops: int,
+) -> List[EditOp]:
+    """Seeded op sequence over the ARENA alphabet: per-tenant single-key
+    ops plus the tenant lifecycle (create with fresh content, hot-swap
+    to fresh content — the page-flip path — and destroy)."""
+    tenants = partition_tenants(base_content, config.tenants)
+    key_rules = {t: dict(c) for t, c in tenants.items()}
+    idents = {
+        t: {k.masked_identity() for k in c} for t, c in key_rules.items()
+    }
+    all_idents = set()
+    for s in idents.values():
+        all_idents |= s
+    next_tid = max(key_rules, default=-1) + 1
+    kinds = ("key_add", "cidr_add", "key_delete", "rules_edit",
+             "order_change", "tenant_create", "tenant_swap",
+             "tenant_destroy")
+    probs = np.array([0.16, 0.08, 0.12, 0.2, 0.06, 0.12, 0.18, 0.08])
+    probs /= probs.sum()
+    ops: List[EditOp] = []
+
+    def fresh_content(lo: int, hi: int):
+        items = []
+        for _ in range(int(rng.integers(lo, hi))):
+            k = _sample_key(config, rng, all_idents)
+            all_idents.add(k.masked_identity())
+            items.append((k, _sample_rules(config, rng)))
+        return tuple(items)
+
+    for _ in range(n_ops):
+        kind = str(rng.choice(kinds, p=probs))
+        live = sorted(key_rules)
+        if not live and kind != "tenant_create":
+            kind = "tenant_create"
+        if kind == "tenant_create":
+            t = next_tid
+            next_tid += 1
+            items = fresh_content(2, 6)
+            key_rules[t] = {k: r for k, r in items}
+            idents[t] = {k.masked_identity() for k, _ in items}
+            ops.append(EditOp(kind="tenant_create", tenant=t, items=items))
+            continue
+        t = int(live[int(rng.integers(0, len(live)))])
+        if kind == "tenant_swap":
+            items = fresh_content(2, 6)
+            key_rules[t] = {k: r for k, r in items}
+            idents[t] = {k.masked_identity() for k, _ in items}
+            ops.append(EditOp(kind="tenant_swap", tenant=t, items=items))
+            continue
+        if kind == "tenant_destroy":
+            if len(live) <= 1:
+                continue  # keep at least one tenant classifying
+            key_rules.pop(t)
+            idents.pop(t)
+            ops.append(EditOp(kind="tenant_destroy", tenant=t))
+            continue
+        keys = list(key_rules[t])
+        if kind in ("key_delete", "rules_edit", "order_change") and not keys:
+            kind = "key_add"
+        if kind in ("key_add", "cidr_add"):
+            k = _sample_key(config, rng, all_idents)
+            all_idents.add(k.masked_identity())
+            r = _sample_rules(config, rng)
+            key_rules[t][k] = r
+            idents[t].add(k.masked_identity())
+            ops.append(EditOp(kind=kind, key=k, rules=r, tenant=t))
+            continue
+        k = keys[int(rng.integers(0, len(keys)))]
+        if kind == "key_delete":
+            key_rules[t].pop(k)
+            idents[t].discard(k.masked_identity())
+            ops.append(EditOp(kind="key_delete", key=k, tenant=t))
+            continue
+        if kind == "order_change":
+            r = _permuted_rules(rng, key_rules[t][k])
+            if r is None:
+                r = _sample_rules(config, rng)
+                kind = "rules_edit"
+        else:
+            r = _sample_rules(config, rng)
+        key_rules[t][k] = r
+        ops.append(EditOp(kind=kind, key=k, rules=r, tenant=t))
+    return ops
+
+
+def check_arena(alloc) -> List[str]:
+    """Invariant contract over a live ArenaAllocator: the device pools
+    must be bit-identical to the host mirrors (every mutation flows
+    through both), the page table must agree with the host tenant map,
+    and the free/occupied page partition must be exact."""
+    viols: List[str] = []
+    with alloc._lock:
+        dev = alloc._dev
+        host = dict(alloc._host)
+        tenant_page = dict(alloc._tenant_page)
+        free = list(alloc._free)
+    for name, harr in host.items():
+        darr = np.asarray(getattr(dev, name))
+        if darr.shape != harr.shape or darr.dtype != harr.dtype:
+            viols.append(
+                f"{name}: device {darr.shape} {darr.dtype} vs host mirror "
+                f"{harr.shape} {harr.dtype}"
+            )
+            continue
+        if not np.array_equal(darr, harr):
+            rows = np.nonzero(
+                (darr.reshape(darr.shape[0], -1)
+                 != harr.reshape(darr.shape[0], -1)).any(axis=1)
+            )[0]
+            viols.append(
+                f"{name}: {len(rows)} device row(s) diverge from the host "
+                f"mirror, first at row {int(rows[0])}"
+            )
+    pt = host["page_table"]
+    for t, p in tenant_page.items():
+        if not (0 <= t < len(pt)) or pt[t] != p:
+            viols.append(
+                f"page_table[{t}] = "
+                f"{pt[t] if 0 <= t < len(pt) else '??'} but the tenant "
+                f"map says page {p}"
+            )
+    mapped = set(tenant_page.values())
+    if mapped & set(free):
+        viols.append(f"pages both free and mapped: {sorted(mapped & set(free))}")
+    if len(mapped) != len(tenant_page):
+        viols.append("two tenants share one page")
+    live_rows = set(np.nonzero(pt >= 0)[0].tolist())
+    if live_rows != set(tenant_page):
+        viols.append(
+            f"page_table rows {sorted(live_rows)} != tenant map "
+            f"{sorted(tenant_page)}"
+        )
+    return viols
+
+
+def _arena_spec_for_case(
+    cfg: StateConfig, base_content, n_ops: int
+):
+    """Deterministic arena geometry for a statecheck case: bounds
+    derived from the base size and op horizon so no legitimate op
+    sequence can hit ArenaCapacityError (which would read as a false
+    failure).  Depth bound 18 = the deepest level count a /128 v6 key
+    can force (path compression only shrinks it)."""
+    ent = len(base_content) + 6 * n_ops + 8
+    return jaxpath.make_arena_spec(
+        cfg.arena,
+        pages=max(cfg.tenants + n_ops + 2, 4),
+        max_tenants=cfg.tenants + n_ops + 2,
+        entries=ent,
+        rule_slots=cfg.width,
+        lut_rows=8,
+        root_nodes=4,  # null root + one per live ifindex (2, 3) + slack
+        node_rows=20 * ent,
+        target_rows=12 * ent,
+        d_max=18,
+    )
+
+
+class _ArenaDriver:
+    """Drives the PRODUCTION tenant machinery (syncer.TenantRegistry
+    over backend ArenaClassifier / MeshArenaClassifier) through the
+    arena op alphabet, keeping per-tenant per-op ground truth for the
+    oracle half."""
+
+    def __init__(self, base_content, cfg: StateConfig, backend: str,
+                 witness_b: int, seed: int, n_ops: int, mesh_shards=None):
+        from ..syncer import TenantRegistry
+
+        self.cfg = cfg
+        self.witness_b = witness_b
+        self.seed = seed
+        self.spec = _arena_spec_for_case(cfg, base_content, n_ops)
+        if backend == "mesh":
+            from ..backend.mesh import MeshArenaClassifier
+
+            self.clf = MeshArenaClassifier(
+                self.spec, data_shards=mesh_shards or 4
+            )
+        else:
+            from ..backend.tpu import ArenaClassifier
+
+            self.clf = ArenaClassifier(
+                self.spec, interpret=True, fused_deep=cfg.fused_deep
+            )
+        self.reg = TenantRegistry(self.clf, rule_width=cfg.width)
+        #: per-tenant per-op ground truth {op_tenant: {ident: (key, rules)}}
+        self.model: Dict[int, Dict[tuple, Tuple[LpmKey, np.ndarray]]] = {}
+        try:
+            for t, content in partition_tenants(
+                dict(base_content), cfg.tenants
+            ).items():
+                self.reg.create_tenant(str(t), content)
+                self.model[t] = {
+                    k.masked_identity(): (k, np.asarray(v))
+                    for k, v in content.items()
+                }
+        except Exception:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        try:
+            self.clf.close()
+        except Exception:
+            pass
+
+    def apply(self, op: EditOp) -> None:
+        # Ops referencing tenants the (possibly shrunk) sequence never
+        # created degrade gracefully — swap-of-unknown creates, destroy/
+        # edit-of-unknown no-op against an empty auto-created tenant —
+        # so every shrinker candidate fails ONLY on a real divergence,
+        # never on registry bookkeeping.
+        t = op.tenant
+        name = str(t)
+        known = name in self.reg.tenant_ids_by_name()
+        if op.kind in ("tenant_create", "tenant_swap"):
+            content = {k: r for k, r in op.items}
+            if known:
+                self.reg.swap_tenant(name, content)
+            else:
+                self.reg.create_tenant(name, content)
+            self.model[t] = {
+                k.masked_identity(): (k, np.asarray(r)) for k, r in op.items
+            }
+            return
+        if op.kind == "tenant_destroy":
+            if known:
+                self.reg.destroy_tenant(name)
+            self.model.pop(t, None)
+            return
+        if not known:
+            self.reg.create_tenant(name, {})
+            self.model.setdefault(t, {})
+        if op.kind == "key_delete":
+            self.reg.update_tenant(name, {}, [op.key])
+            self.model[t].pop(op.key.masked_identity(), None)
+            return
+        # key_add / cidr_add / rules_edit / order_change: per-tenant upsert
+        self.reg.update_tenant(name, {op.key: op.rules}, [])
+        self.model[t][op.key.masked_identity()] = (
+            op.key, np.asarray(op.rules)
+        )
+
+    def check(self, step: int) -> Optional[Failure]:
+        from .. import oracle, testing
+
+        alloc = self.clf.allocator
+        viols = check_arena(alloc)
+        if viols:
+            return Failure(step, "invariant",
+                           f"{len(viols)} arena contract violation(s)",
+                           "\n".join(viols))
+        name_ids = self.reg.tenant_ids_by_name()
+        spec = alloc.spec
+        # -- per-slab cold-rebuild equivalence: the resident slab rows
+        # must be bit-identical to a fresh bake of a cache-stripped
+        # clone of the tenant's snapshot at the same page ---------------
+        dev = alloc.arena
+        names = (("key_words", "mask_words", "mask_len", "rules")
+                 if spec.family == "dense"
+                 else ("l0", "nodes", "targets", "joined", "root_lut"))
+        rows_per = dict(zip(names, alloc._slab_rows()))
+        for t_name, tid in sorted(name_ids.items()):
+            page = alloc.page_of(tid)
+            if page is None:
+                return Failure(step, "raw",
+                               f"tenant {t_name!r} registered but has no "
+                               "slab page")
+            with self.reg._lock:
+                upd = self.reg._updaters[tid]
+            clone = _cold_clone(upd.snapshot())
+            try:
+                if spec.family == "dense":
+                    slab = jaxpath._dense_slab_arrays(spec, clone)
+                else:
+                    slab = jaxpath._ctrie_slab_arrays(spec, page, clone)
+            except jaxpath.ArenaCapacityError as e:
+                return Failure(step, "raw",
+                               f"cold rebuild of tenant {t_name!r} no "
+                               f"longer fits its slab: {e}")
+            for arr_name, want in zip(names, slab):
+                rows = rows_per[arr_name]
+                got = np.asarray(getattr(dev, arr_name))[
+                    page * rows : (page + 1) * rows
+                ]
+                if not np.array_equal(got, np.asarray(want)):
+                    bad = np.nonzero(
+                        (got.reshape(rows, -1)
+                         != np.asarray(want).reshape(rows, -1)).any(axis=1)
+                    )[0]
+                    return Failure(
+                        step, "raw",
+                        f"tenant {t_name!r} slab {arr_name} diverged from "
+                        "the cold per-slab rebuild",
+                        f"{len(bad)} row(s), first at slab row "
+                        f"{int(bad[0])} (page {page})",
+                    )
+        # -- mixed-tenant witness vs per-tenant CPU oracles through the
+        # production arena dispatch -------------------------------------
+        live = sorted(self.model)
+        live = [t for t in live if str(t) in name_ids]
+        if not live:
+            return None
+        rng = np.random.default_rng(
+            [_WITNESS_SALT, self.seed, step + 1, 77]
+        )
+        per = max(self.witness_b // len(live), 8)
+        parts, tags, refs = [], [], []
+        from ..compiler import compile_tables_from_content as _ctc
+        from .. import packets as packets_mod
+
+        for t in live:
+            merged = {k: r for (k, r) in self.model[t].values()}
+            model_tab = _ctc(merged, rule_width=self.cfg.width)
+            b = testing.random_batch(rng, model_tab, per)
+            parts.append(b)
+            tags.append(np.full(per, name_ids[str(t)], np.int32))
+            refs.append(oracle.classify(model_tab, b))
+        batch = packets_mod.concat(parts)
+        tenant = np.concatenate(tags)
+        out = self.clf.classify_async_packed_tenant(
+            batch.pack_wire(), tenant, apply_stats=False
+        ).result()
+        want_res = np.concatenate([r.results for r in refs])
+        want_xdp = np.concatenate([r.xdp for r in refs])
+        if not np.array_equal(out.results, want_res):
+            bad = np.nonzero(out.results != want_res)[0]
+            i = int(bad[0])
+            return Failure(
+                step, "classify",
+                f"{len(bad)}/{len(tenant)} mixed-tenant witness verdict(s) "
+                "diverge from the per-tenant CPU oracle",
+                f"first at packet {i} (tenant id {int(tenant[i])}): got "
+                f"{int(out.results[i]):#x}, oracle {int(want_res[i]):#x}",
+            )
+        if not np.array_equal(out.xdp, want_xdp):
+            bad = np.nonzero(out.xdp != want_xdp)[0]
+            return Failure(step, "classify",
+                           f"{len(bad)} mixed-tenant XDP verdict(s) diverge",
+                           f"first at packet {int(bad[0])}")
+        # statistics: the fused output's stats must equal the SUM of the
+        # per-tenant oracle stats (ruleId space is shared)
+        want_stats: Dict[int, List[int]] = {}
+        for r in refs:
+            for rid, vals in r.stats.items():
+                acc = want_stats.setdefault(rid, [0, 0, 0, 0])
+                for j in range(4):
+                    acc[j] += vals[j]
+        from ..testing import stats_dict_from_array
+
+        if stats_dict_from_array(out.stats_delta) != want_stats:
+            return Failure(step, "stats",
+                           "mixed-tenant witness statistics diverge from "
+                           "the summed per-tenant oracle stats")
+        return None
+
+
+def _run_arena_ops(
+    base_content, ops: Sequence[EditOp], cfg: StateConfig, *,
+    witness_b: int, backend: str, mesh_shards, seed: int,
+) -> Optional[Failure]:
+    try:
+        drv = _ArenaDriver(base_content, cfg, backend, witness_b, seed,
+                           n_ops=len(ops), mesh_shards=mesh_shards)
+    except Exception as e:
+        return Failure(-1, "load-error", f"{type(e).__name__}: {e}")
+    try:
+        f = drv.check(-1)
+        if f is not None:
+            return f
+        for i, op in enumerate(ops):
+            try:
+                drv.apply(op)
+            except Exception as e:
+                return Failure(i, "load-error",
+                               f"{op.describe()} raised "
+                               f"{type(e).__name__}: {e}")
+            f = drv.check(i)
+            if f is not None:
+                return f
+        return None
+    finally:
+        drv.close()

@@ -63,7 +63,7 @@ from ..constants import KIND_OTHER
 from ..kernels import jaxpath
 from ..packets import PacketBatch, narrow_wire, wire8
 from ..parallel import mesh as meshmod
-from .base import ClassifyOutput, PendingClassify
+from .base import ClassifyOutput, PendingClassify, StatsAccumulator
 from .tpu import TpuClassifier
 
 log = logging.getLogger("infw.backend.mesh")
@@ -416,3 +416,125 @@ class MeshTpuClassifier(TpuClassifier):
             )
 
         return PendingClassify(materialize)
+
+
+class MeshArenaClassifier:
+    """Multi-tenant paged arena spanning a ("data", "rules") mesh: the
+    slab pools are placed ONCE with the per-family partition rules
+    (parallel.mesh.ARENA_PARTITION_RULES — pages in whole-slab blocks
+    over "rules", page table replicated), tenant lifecycle mutations
+    broadcast through the replicated scatter path, and mixed-tenant
+    wire batches shard over "data".  Dispatch reuses the SAME jitted
+    arena classify factories as the single chip — the pool placement
+    engages GSPMD, so there is no mesh-specific kernel to keep in
+    parity."""
+
+    supports_overlay = False  # per-tenant overlays: single-chip only v1
+    data_shards = 1
+
+    def __init__(self, spec, mesh=None, data_shards=None,
+                 rules_shards: int = 1, interpret: bool = True) -> None:
+        from ..kernels import jaxpath as _jp
+
+        if mesh is None:
+            n = (data_shards or 2) * rules_shards
+            mesh = meshmod.make_mesh(n, rules_shards=rules_shards)
+        self._mesh = mesh
+        self.data_shards = mesh.shape["data"]
+        self._interpret = interpret
+        self._alloc = _jp.ArenaAllocator(
+            spec,
+            device=meshmod.arena_replicated(mesh),
+            shardings=meshmod.arena_shardings(mesh, spec.family, spec.pages),
+        )
+        self._stats = StatsAccumulator()
+        self._closed = False
+
+    @property
+    def allocator(self):
+        return self._alloc
+
+    @property
+    def spec(self):
+        return self._alloc.spec
+
+    def load_tenant(self, tenant: int, tables: CompiledTables,
+                    hint=None) -> str:
+        return self._alloc.load_tenant(tenant, tables, hint=hint)
+
+    def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
+        self._alloc.swap_tenant(tenant, tables)
+
+    def destroy_tenant(self, tenant: int) -> None:
+        self._alloc.destroy_tenant(tenant)
+
+    def tenant_counters(self) -> dict:
+        return self._alloc.counter_values()
+
+    def classify_async_packed_tenant(
+        self, wire_np: np.ndarray, tenant_np: np.ndarray,
+        apply_stats: bool = True,
+    ) -> PendingClassify:
+        """Mixed-tenant mesh dispatch: wire + tenant column sharded
+        over "data" (padded to a whole number of shard rows with
+        dead lanes), pools as placed — one fused output buffer."""
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        spec = self._alloc.spec
+        n = wire_np.shape[0]
+        kind = (wire_np[:, 0] & 3).astype(np.int32)
+        data = self.data_shards
+        # pad to 2*data rows so the u16 result-pair packing never
+        # straddles shards (the MeshTpuClassifier contract)
+        pad = (-n) % (2 * data)
+        if pad:
+            wire_np = np.concatenate(
+                [wire_np,
+                 np.full((pad, wire_np.shape[1]), KIND_OTHER, np.uint32)],
+                axis=0,
+            )
+            tenant_np = np.concatenate(
+                [tenant_np, np.full(pad, -1, tenant_np.dtype)]
+            )
+        ds = meshmod.arena_data_sharding(self._mesh)
+        wire = jax.device_put(wire_np, ds)
+        tenant = jax.device_put(
+            np.ascontiguousarray(tenant_np, np.int32),
+            NamedSharding(self._mesh, P("data")),
+        )
+        d_max = spec.d_max if spec.family == "ctrie" else 0
+        fused = jaxpath.jitted_classify_arena_wire_fused(
+            spec.family, spec.pages, d_max
+        )(self._alloc.arena, wire, tenant)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def materialize() -> ClassifyOutput:
+            res16, stats = jaxpath.split_wire_outputs(
+                np.asarray(fused), n + pad
+            )
+            res16 = res16[:n]
+            stats_delta = jaxpath.merge_stats_host(stats)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def classify_tenants(self, batch: PacketBatch, tenant_np: np.ndarray,
+                         apply_stats: bool = True) -> ClassifyOutput:
+        return self.classify_async_packed_tenant(
+            batch.pack_wire(), tenant_np, apply_stats=apply_stats
+        ).result()
+
+    @property
+    def stats(self) -> StatsAccumulator:
+        return self._stats
+
+    def close(self) -> None:
+        self._closed = True

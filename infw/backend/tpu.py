@@ -30,7 +30,7 @@ import jax
 import numpy as np
 
 from ..compiler import CompiledTables
-from ..constants import KIND_IPV6
+from ..constants import ALLOW, DENY, KIND_IPV6
 from ..kernels import jaxpath, pallas_dense, pallas_walk, wire_decode
 from ..packets import PacketBatch, encode_delta_wire, narrow_wire, wire8
 from .base import ClassifyOutput, PendingClassify, StatsAccumulator
@@ -1007,3 +1007,362 @@ class TpuClassifier:
             self._active = None
             self._tables = None
             self._closed = True
+
+
+class ArenaClassifier:
+    """Multi-tenant paged-arena classifier (ISSUE-10): thousands of
+    tenant rulesets resident in ONE pool per layout family, classify
+    batches carrying MIXED-tenant traffic steered per packet by the
+    device tenant -> page table, and tenant activation/hot-swap as a
+    page-table row flip instead of a re-upload.
+
+    Serves the packed-wire contract with a tenant column:
+    ``classify_async_packed_tenant(wire_np, tenant_np)`` (and the
+    ``classify_tenants`` batch convenience).  The wire rides the
+    wire/narrow formats; the sub-8B codecs are per-chunk sequential
+    transforms that would interleave tenants' sort orders, so the arena
+    path keeps the 16-28B formats (same degrade-never-refuse posture as
+    the mesh's delta fallback).
+
+    Per-tenant edits reuse the incremental patch machinery PER SLAB
+    (rules-only hints scatter exactly the dirty rows at the slab base);
+    every executable is jit-cache-keyed on the POOL geometry only, so
+    tenant create/swap/patch/destroy never recompiles on a warm arena
+    (test-pinned)."""
+
+    #: the syncer/registry may route structurally-new tenant keys into a
+    #: per-tenant dense overlay side-pool (overlay_spec)
+    supports_overlay = True
+    data_shards = 1
+
+    def __init__(
+        self,
+        spec: "jaxpath.ArenaSpec",
+        device=None,
+        overlay_spec: "Optional[jaxpath.ArenaSpec]" = None,
+        interpret: Optional[bool] = None,
+        fused_deep: Optional[bool] = None,
+        check_invariants: Optional[bool] = None,
+    ) -> None:
+        self._device = device if device is not None else jax.devices()[0]
+        self._interpret = (
+            interpret if interpret is not None
+            else pallas_dense.default_interpret()
+        )
+        if fused_deep is None:
+            env = os.environ.get("INFW_FUSED_DEEP", "")
+            if env:
+                fused_deep = env not in ("0", "false", "no")
+        self._fused_deep = (
+            bool(fused_deep) if fused_deep is not None
+            else not self._interpret
+        ) and spec.family == "ctrie"
+        if check_invariants is None:
+            env = os.environ.get("INFW_CHECK_INVARIANTS", "")
+            check_invariants = env not in ("", "0", "false", "no")
+        self._check_invariants = bool(check_invariants)
+        self._alloc = jaxpath.ArenaAllocator(spec, self._device)
+        if overlay_spec is not None and overlay_spec.family != "dense":
+            raise ValueError("the overlay side-pool must be dense-family")
+        self._ov_alloc = (
+            jaxpath.ArenaAllocator(overlay_spec, self._device)
+            if overlay_spec is not None else None
+        )
+        self._lock = threading.Lock()
+        self._stats = StatsAccumulator()
+        self._wire_counts = {}
+        # per-tenant verdict accounting {tid: [packets, allow, deny]}
+        self._tenant_counts = {}
+        # paged Pallas walk planes, rebuilt when the node pool moves
+        self._planes = None
+        self._planes_gen = -1
+        self._closed = False
+        if self._fused_deep:
+            self._refresh_planes()
+
+    # -- tenant lifecycle (allocator proxies + invariant hooks) -------------
+
+    @property
+    def allocator(self) -> "jaxpath.ArenaAllocator":
+        return self._alloc
+
+    @property
+    def overlay_allocator(self):
+        return self._ov_alloc
+
+    @property
+    def spec(self) -> "jaxpath.ArenaSpec":
+        return self._alloc.spec
+
+    def load_tenant(self, tenant: int, tables: CompiledTables,
+                    hint=None) -> str:
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        had_page = self._alloc.page_of(tenant) is not None
+        rules_only = had_page and jaxpath.hint_trie_unchanged(hint)
+        if not self._fused_deep or rules_only:
+            # rules-only edits never touch the node pool, so the planes
+            # need no refresh ordering; without fused planes there is
+            # nothing to pair
+            path = self._alloc.load_tenant(tenant, tables, hint=hint)
+            self._after_mutation()
+            return path
+        # fused planes live: a structural install must not let a
+        # classify pair the NEW page table with stale planes — route
+        # through stage (free page bake) -> plane refresh -> flip, the
+        # same ordering the swap path guarantees
+        try:
+            page = self._alloc.stage(tables)
+        except jaxpath.ArenaCapacityError:
+            # no free page for staging: in-place rewrite with an
+            # immediate refresh — a narrow stale window only on a full
+            # pool (keep >= 1 free page when serving the fused walk)
+            path = self._alloc.load_tenant(tenant, tables, hint=hint)
+            self._after_mutation()
+            return path
+        self._refresh_planes()
+        self._alloc.activate(tenant, page, tables)
+        self._after_mutation()
+        return "rewrite" if had_page else "assign"
+
+    def load_tenant_overlay(self, tenant: int,
+                            overlay: Optional[CompiledTables]) -> None:
+        """Install/clear one tenant's dense overlay side-slab."""
+        if self._ov_alloc is None:
+            raise RuntimeError("arena built without an overlay side-pool")
+        if overlay is None or overlay.num_entries == 0:
+            if self._ov_alloc.page_of(tenant) is not None:
+                self._ov_alloc.destroy_tenant(tenant)
+        else:
+            self._ov_alloc.load_tenant(tenant, overlay)
+
+    def stage_tenant(self, tables: CompiledTables) -> int:
+        page = self._alloc.stage(tables)
+        # planes refresh at STAGE time, before any flip can land: a
+        # classify that pairs new planes with the OLD page table is
+        # safe (untouched pages' plane rows are unchanged; staged pages
+        # are unreachable until their flip), while old-planes/new-table
+        # would walk stale nodes — so the refresh must strictly precede
+        # the activation
+        if self._fused_deep:
+            self._refresh_planes()
+        return page
+
+    def activate_tenant(self, tenant: int, page: int,
+                        tables: Optional[CompiledTables] = None) -> None:
+        if self._fused_deep:
+            self._refresh_planes()  # cover externally-staged writes
+        self._alloc.activate(tenant, page, tables)
+        self._after_mutation()
+
+    def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
+        page = self.stage_tenant(tables)
+        self._alloc.activate(tenant, page, tables)
+        self._after_mutation()
+
+    def destroy_tenant(self, tenant: int) -> None:
+        self._alloc.destroy_tenant(tenant)
+        if self._ov_alloc is not None and (
+            self._ov_alloc.page_of(tenant) is not None
+        ):
+            self._ov_alloc.destroy_tenant(tenant)
+        # destroy mutates the page table / free list too — the
+        # invariant hook must cover it like every other boundary
+        self._after_mutation()
+
+    def compact(self) -> int:
+        if self._fused_deep:
+            # slab moves flip pages one by one inside the allocator —
+            # no safe plane pairing exists mid-compaction, so drop to
+            # the (always-correct) XLA arena walk for its duration and
+            # rebuild the planes after (compaction is rare)
+            with self._lock:
+                self._planes = None
+        moved = self._alloc.compact()
+        self._after_mutation()
+        return moved
+
+    def _after_mutation(self) -> None:
+        if self._fused_deep:
+            self._refresh_planes()
+        if self._check_invariants:
+            from ..analysis import statecheck  # lazy: no import cycle
+
+            viols = statecheck.check_arena(self._alloc)
+            if viols:
+                raise statecheck.InvariantViolation(
+                    "arena invariant contract violated at the slab "
+                    "boundary:\n  " + "\n  ".join(viols)
+                )
+
+    def _refresh_planes(self) -> None:
+        """Bring the paged-walk byte planes up to the node pool: a full
+        build only on first touch; afterwards ONLY the written slabs'
+        plane rows re-derive and scatter (SN is 128-row aligned, so a
+        slab maps 1:1 onto its plane rows) — O(slab) per mutation, not
+        O(pool), keeping the hot-swap path flip-sized."""
+        gen, pages, rows = self._alloc.consume_dirty_node_pages()
+        with self._lock:
+            if gen == self._planes_gen and self._planes is not None:
+                return
+            planes = self._planes
+            if planes is None or not pages:
+                nodes = self._alloc.host_nodes()
+                planes = (
+                    None if nodes is None
+                    else pallas_walk.build_arena_cwalk_planes(
+                        nodes, device=self._device
+                    )
+                )
+            else:
+                sn = self._alloc.spec.node_rows
+                for p in pages:
+                    slab_planes = pallas_walk._split_cnode_rows(rows[p])
+                    patched = jaxpath._capped_scatter(
+                        planes,
+                        p * sn + np.arange(sn, dtype=np.int64),
+                        slab_planes[:sn],
+                        self._device,
+                    )
+                    if patched is None:  # oversized delta: full rebuild
+                        nodes = self._alloc.host_nodes()
+                        patched = pallas_walk.build_arena_cwalk_planes(
+                            nodes, device=self._device
+                        )
+                        planes = patched
+                        break
+                    planes = patched
+            self._planes = planes
+            self._planes_gen = gen
+
+    # -- classify ------------------------------------------------------------
+
+    def tenant_ids(self):
+        return self._alloc.tenants()
+
+    def classify_async_packed_tenant(
+        self, wire_np: np.ndarray, tenant_np: np.ndarray,
+        apply_stats: bool = True,
+    ) -> PendingClassify:
+        """The mixed-tenant packed-wire dispatch: one batch, each
+        packet steered to its tenant's slab in-kernel.  ``tenant_np``
+        is (B,) int — ids outside the registry classify to UNDEF."""
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        spec = self._alloc.spec
+        n = wire_np.shape[0]
+        kind = (wire_np[:, 0] & 3).astype(np.int32)
+        if wire_np.shape[1] in (4, 7):
+            nw = narrow_wire(wire_np)
+            if nw is not None:
+                wire_np = nw
+        put = lambda a: jax.device_put(a, self._device)
+        wire = put(wire_np)
+        tenant = put(np.ascontiguousarray(tenant_np, np.int32))
+        self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
+        # read order matters for the fused path: the ARENA snapshot
+        # first, planes after — planes refresh strictly BEFORE flips
+        # (stage_tenant), so planes are always at least as new as the
+        # page table we pair them with (new-planes/old-table is safe;
+        # the reverse would walk stale nodes)
+        arena = self._alloc.arena
+        ov = None if self._ov_alloc is None else self._ov_alloc.arena
+        ov_busy = ov is not None and self._ov_alloc.tenants()
+        d_max = spec.d_max if spec.family == "ctrie" else 0
+        if (
+            self._fused_deep and self._planes is not None and not ov_busy
+        ):
+            fused = pallas_walk.jitted_classify_arena_cwalk_wire_fused(
+                spec.pages, d_max, self._interpret
+            )(arena, self._planes, wire, tenant)
+        elif ov_busy:
+            fused = jaxpath.jitted_classify_arena_wire_fused(
+                spec.family, spec.pages, d_max, self._ov_alloc.spec.pages
+            )(arena, ov, wire, tenant)
+        else:
+            fused = jaxpath.jitted_classify_arena_wire_fused(
+                spec.family, spec.pages, d_max
+            )(arena, wire, tenant)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def materialize() -> ClassifyOutput:
+            res16, stats = jaxpath.split_wire_outputs(np.asarray(fused), n)
+            stats_delta = jaxpath.merge_stats_host(stats)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            self._note_tenants(tenant_np, results)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def classify_tenants(
+        self, batch: PacketBatch, tenant_np: np.ndarray,
+        apply_stats: bool = True,
+    ) -> ClassifyOutput:
+        """Batch-object convenience over the packed-tenant dispatch."""
+        return self.classify_async_packed_tenant(
+            batch.pack_wire(), tenant_np, apply_stats=apply_stats
+        ).result()
+
+    def _note_wire(self, fmt: str, n: int, nbytes: int) -> None:
+        with self._lock:
+            c = self._wire_counts.setdefault(fmt, [0, 0])
+            c[0] += n
+            c[1] += nbytes
+
+    def wire_stats(self):
+        with self._lock:
+            return {k: tuple(v) for k, v in self._wire_counts.items()}
+
+    def _note_tenants(self, tenant_np, results) -> None:
+        """Per-tenant packets/allow/deny accounting (the tenant_*
+        observability satellite): three vectorized bincount passes over
+        the batch — this runs on every classify materialize, so a
+        per-tenant Python loop would serialize O(tenants x B) work
+        under the lock at exactly the mixed-batch scale the arena
+        serves."""
+        t = np.asarray(tenant_np, np.int64)
+        ok = (t >= 0) & (t < self._alloc.spec.max_tenants)
+        t = t[ok]
+        if len(t) == 0:
+            return
+        act = (np.asarray(results)[ok]) & 0xFF
+        n = int(t.max()) + 1
+        pkts = np.bincount(t, minlength=n)
+        allow = np.bincount(t[act == ALLOW], minlength=n)
+        deny = np.bincount(t[act == DENY], minlength=n)
+        with self._lock:
+            for tid in np.nonzero(pkts)[0]:
+                c = self._tenant_counts.setdefault(int(tid), [0, 0, 0])
+                c[0] += int(pkts[tid])
+                c[1] += int(allow[tid])
+                c[2] += int(deny[tid])
+
+    def tenant_counters(self) -> dict:
+        """tenant_* counters for /metrics: allocator slab/swap gauges
+        plus per-tenant packet/verdict totals."""
+        out = dict(self._alloc.counter_values())
+        if self._ov_alloc is not None:
+            for k, v in self._ov_alloc.counter_values().items():
+                out[f"{k}_overlay"] = v
+        with self._lock:
+            for tid, (pk, al, dn) in sorted(self._tenant_counts.items()):
+                out[f"tenant_{tid}_packets_total"] = pk
+                out[f"tenant_{tid}_allow_total"] = al
+                out[f"tenant_{tid}_deny_total"] = dn
+        return out
+
+    # -- accessors / lifecycle ----------------------------------------------
+
+    @property
+    def stats(self) -> StatsAccumulator:
+        return self._stats
+
+    def close(self) -> None:
+        self._closed = True

@@ -311,6 +311,7 @@ class Daemon:
         max_batch: Optional[int] = None,
         patch_staleness_us: Optional[float] = None,
         patch_max_ops: Optional[int] = None,
+        tenants: Optional[int] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -384,8 +385,19 @@ class Daemon:
         self.edits_dir = os.path.join(state_dir, "edits")
         self.out_dir = os.path.join(state_dir, "out")
         self.events_path = os.path.join(state_dir, "events.log")
-        for d in (self.nodestates_dir, self.ingest_dir, self.edits_dir,
-                  self.out_dir):
+        # Multi-tenant paged arena mode (--tenants/INFW_TENANTS): each
+        # tenant is a slab in one preallocated pool, created lazily when
+        # <state-dir>/tenants/<name>/edits/ first appears; per-tenant
+        # edit files apply through the SAME folded-transaction codec as
+        # the single-tenant edits dir, landing as per-slab row scatters.
+        self.tenants_max = max(0, int(tenants or 0))
+        self.tenants_dir = os.path.join(state_dir, "tenants")
+        self.tenant_registry = None
+        dirs = [self.nodestates_dir, self.ingest_dir, self.edits_dir,
+                self.out_dir]
+        if self.tenants_max:
+            dirs.append(self.tenants_dir)
+        for d in dirs:
             os.makedirs(d, exist_ok=True)
 
         if backend == "tpu":
@@ -466,6 +478,15 @@ class Daemon:
         # patch-transaction counters + staleness histogram
         # (ingressnodefirewall_node_patch_txn_*)
         self.metrics_registry.register_counters(self.txn_stats)
+        if self.tenants_max:
+            self.tenant_registry = self._build_tenant_registry()
+            # tenant_* counters (active/free slabs, swaps, flips,
+            # compactions, per-tenant packets/verdicts) on /metrics
+            self.metrics_registry.register_counters(self.tenant_registry)
+        # tenant names whose create failed deterministically (e.g. the
+        # pool is smaller than the dirs an operator made): logged once,
+        # then skipped — not retried every idle-loop pass forever
+        self._tenant_create_failed: set = set()
         self.debug_buffer = DebugLookupBuffer()
 
         self._stop = threading.Event()
@@ -607,6 +628,82 @@ class Daemon:
                 os.remove(path)
             except OSError as e:
                 log.error("could not remove edit file %s: %s", fn, e)
+        return n
+
+    def _build_tenant_registry(self):
+        """The multi-tenant arena control plane: one preallocated pool
+        sized from --tenants and the INFW_TENANT_SLAB_ENTRIES /
+        INFW_TENANT_RULE_SLOTS geometry knobs, with a staging page so
+        every full-ruleset tenant update is a hot swap (page-table row
+        flip), never a serving-path re-upload."""
+        from .backend.tpu import ArenaClassifier
+        from .kernels import jaxpath
+        from .syncer import TenantRegistry
+
+        entries = int(os.environ.get("INFW_TENANT_SLAB_ENTRIES") or 1024)
+        slots = int(os.environ.get("INFW_TENANT_RULE_SLOTS") or 16)
+        spec = jaxpath.make_arena_spec(
+            "ctrie",
+            pages=max(self.tenants_max + 2, 4),
+            max_tenants=self.tenants_max,
+            entries=entries,
+            rule_slots=slots,
+            lut_rows=64,
+            root_nodes=4,
+            node_rows=4 * entries,
+            target_rows=8 * entries,
+            d_max=18,
+        )
+        clf = ArenaClassifier(spec)
+        return TenantRegistry(clf, rule_width=slots, event_ring=self.ring)
+
+    def scan_tenant_edits_once(self) -> int:
+        """Apply every per-tenant edit file under
+        <state-dir>/tenants/<name>/edits/ (same JSON edit-file codec as
+        the single-tenant dir) as ONE folded transaction per file
+        through the tenant registry.  A tenant is created (empty) the
+        first time its directory appears; bad files are consumed and
+        logged like the single-tenant scan.  Returns ops applied."""
+        if self.tenant_registry is None:
+            return 0
+        from .txn import read_edit_file
+
+        n = 0
+        try:
+            names = sorted(os.listdir(self.tenants_dir))
+        except OSError:
+            return 0
+        for name in names:
+            edits = os.path.join(self.tenants_dir, name, "edits")
+            if not os.path.isdir(edits):
+                continue
+            if name not in self.tenant_registry.tenant_ids_by_name():
+                if name in self._tenant_create_failed:
+                    continue
+                try:
+                    self.tenant_registry.create_tenant(name, {})
+                except Exception as e:
+                    log.error(
+                        "could not create tenant %r (will not retry; "
+                        "its edit files are left in place): %s", name, e,
+                    )
+                    self._tenant_create_failed.add(name)
+                    continue
+            for fn in sorted(os.listdir(edits)):
+                path = os.path.join(edits, fn)
+                if fn.endswith(".tmp") or not os.path.isfile(path):
+                    continue
+                try:
+                    ops = read_edit_file(path)
+                    self.tenant_registry.apply_edit_transaction(name, ops)
+                    n += len(ops)
+                except Exception as e:
+                    log.error("bad tenant edit file %s/%s: %s", name, fn, e)
+                try:
+                    os.remove(path)
+                except OSError as e:
+                    log.error("could not remove tenant edit file %s: %s",
+                              fn, e)
         return n
 
     def _maybe_flush_edits(self, force: bool = False) -> bool:
@@ -1218,6 +1315,10 @@ class Daemon:
             except Exception as e:
                 log.error("edit scan error: %s", e)
             try:
+                self.scan_tenant_edits_once()
+            except Exception as e:
+                log.error("tenant edit scan error: %s", e)
+            try:
                 self.process_ingest_once()
             except Exception as e:
                 log.error("ingest error: %s", e)
@@ -1321,6 +1422,18 @@ def main(argv: Optional[List[str]] = None) -> int:
              "margin line measures against",
     )
     p.add_argument(
+        "--tenants", type=int,
+        default=os.environ.get("INFW_TENANTS") or None,
+        help="enable the multi-tenant paged table arena with this many "
+             "tenant ids: one preallocated slab pool per layout family, "
+             "tenants created lazily from <state-dir>/tenants/<name>/"
+             "edits/ (same edit-file codec as the single-tenant dir), "
+             "ruleset activation by page-table flip, tenant_* counters "
+             "on /metrics.  Slab geometry via INFW_TENANT_SLAB_ENTRIES "
+             "(default 1024) and INFW_TENANT_RULE_SLOTS (default 16).  "
+             "CLI beats INFW_TENANTS",
+    )
+    p.add_argument(
         "--deadline-us", type=float,
         default=os.environ.get("INFW_DEADLINE_US") or None,
         help="per-packet verdict deadline budget in microseconds: enables "
@@ -1396,6 +1509,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     if args.patch_max_ops is not None and args.patch_max_ops < 1:
         p.error(f"--patch-max-ops must be >= 1, got {args.patch_max_ops}")
+    if args.tenants is not None and int(args.tenants) < 1:
+        p.error(f"--tenants must be >= 1, got {args.tenants}")
 
     # Same launch-time validation posture as the wire codec: a bad
     # INFW_MESH (or --mesh) must fail here with a usage error, not raise
@@ -1447,6 +1562,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_batch=args.max_batch,
         patch_staleness_us=args.patch_staleness_us,
         patch_max_ops=args.patch_max_ops,
+        tenants=int(args.tenants) if args.tenants else None,
     )
     stop = threading.Event()
 

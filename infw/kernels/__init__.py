@@ -399,6 +399,75 @@ def _build_ctrie_joined_scatter(b: int):
     )
 
 
+# -- multi-tenant paged arena fixtures/builders (ISSUE-10) -------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_arena(family: str):
+    """A 4-page arena holding the two canonical fixture tables as
+    tenants 0/1 — the mixed-tenant audit substrate."""
+    from .. import testing
+    from . import jaxpath
+
+    rng = np.random.default_rng(31)
+    t0 = _fixture_tables(False)
+    t1 = testing.random_tables_fast(
+        rng, n_entries=48, width=4, v6_fraction=0.6, ifindexes=(2, 3)
+    )
+    spec = jaxpath.arena_spec_for(
+        family, (t0, t1), pages=4, max_tenants=8
+    )
+    alloc = jaxpath.ArenaAllocator(spec)
+    alloc.load_tenant(0, t0)
+    alloc.load_tenant(1, t1)
+    return alloc
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_arena_wire(b: int):
+    """(wire_dev, tenant_dev): the canonical batch round-robined over
+    the two fixture tenants."""
+    import jax
+
+    tenant = (np.arange(b) % 2).astype(np.int32)
+    return _fixture_wire(b), jax.device_put(tenant)
+
+
+def _build_arena_wire(family: str):
+    def build(b: int):
+        from . import jaxpath
+
+        alloc = _fixture_arena(family)
+        spec = alloc.spec
+        d_max = spec.d_max if family == "ctrie" else 0
+        fn = jaxpath.jitted_classify_arena_wire_fused(
+            family, spec.pages, d_max
+        )
+        wire, tenant = _fixture_arena_wire(b)
+        return fn, (alloc.arena, wire, tenant)
+
+    return build
+
+
+def _build_pallas_arena_walk(b: int):
+    import jax
+
+    from . import pallas_walk
+
+    alloc = _fixture_arena("ctrie")
+    spec = alloc.spec
+    planes = pallas_walk.build_arena_cwalk_planes(alloc.host_nodes())
+    if planes is None:
+        raise EntrypointUnavailable(
+            "arena node pool exceeds the paged-walk VMEM budget"
+        )
+    fn = pallas_walk.jitted_classify_arena_cwalk_wire_fused(
+        spec.pages, spec.d_max, pallas_walk.default_interpret()
+    )
+    wire, tenant = _fixture_arena_wire(b)
+    return fn, (alloc.arena, planes, wire, tenant)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -505,6 +574,49 @@ def _build_mesh_walk(b: int):
     return fn, (dev, _fixture_mesh_wire(b, 1))
 
 
+@functools.lru_cache(maxsize=None)
+def _fixture_mesh_arena():
+    """The fixture arena placed on a ("data", "rules") mesh with the
+    per-family partition rules — pages in whole-slab blocks over
+    "rules", page table replicated (parallel.mesh.ARENA_PARTITION_
+    RULES, declared once per slab family)."""
+    import jax
+
+    from ..parallel import mesh as meshmod
+    from . import jaxpath
+
+    mesh = _fixture_mesh(2)
+    t0 = _fixture_tables(False)
+    spec = jaxpath.arena_spec_for("ctrie", (t0,), pages=4, max_tenants=8)
+    alloc = jaxpath.ArenaAllocator(
+        spec,
+        device=meshmod.arena_replicated(mesh),
+        shardings=meshmod.arena_shardings(mesh, "ctrie", spec.pages),
+    )
+    alloc.load_tenant(0, t0)
+    alloc.load_tenant(1, t0)
+    return mesh, alloc
+
+
+def _build_mesh_arena_trie(b: int):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import jaxpath
+
+    mesh, alloc = _fixture_mesh_arena()
+    spec = alloc.spec
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        "ctrie", spec.pages, spec.d_max
+    )
+    wire = _fixture_mesh_wire(b, 2)
+    tenant = jax.device_put(
+        (np.arange(b) % 2).astype(np.int32),
+        NamedSharding(mesh, P("data")),
+    )
+    return fn, (alloc.arena, wire, tenant)
+
+
 def kernel_entrypoints() -> List[KernelEntrypoint]:
     """The registered jitted hot-path entrypoints, in dispatch order of
     the TPU backend (backend/tpu.py _launch_wire and friends), then the
@@ -552,6 +664,15 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
             "patch/ctrie-joined-scatter", "xla", _build_ctrie_joined_scatter
         ),
         KernelEntrypoint(
+            "classify-wire/arena-dense", "xla", _build_arena_wire("dense")
+        ),
+        KernelEntrypoint(
+            "classify-wire/arena-trie", "xla", _build_arena_wire("ctrie")
+        ),
+        KernelEntrypoint(
+            "classify/pallas-arena-walk", "pallas", _build_pallas_arena_walk
+        ),
+        KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",
             _build_mesh_sharded_dense,
         ),
@@ -561,5 +682,8 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify-mesh/walk-wire", "pallas", _build_mesh_walk
+        ),
+        KernelEntrypoint(
+            "classify-mesh/arena-trie-wire", "xla", _build_mesh_arena_trie
         ),
     ]

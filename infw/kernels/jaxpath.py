@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import NamedTuple, Optional, Tuple
 
@@ -927,28 +928,21 @@ def extract_ip_bits(ip_words: jax.Array, pos: jax.Array, n: jax.Array):
     return jnp.where(n == 0, jnp.uint32(0), top32 >> (jnp.uint32(32) - n))
 
 
-def ctrie_walk_rows(
-    cdev: CTrieTables, batch: DeviceBatch, d_max: int
+def _ctrie_descend(
+    nodes: jax.Array, batch: DeviceBatch, node: jax.Array,
+    alive: jax.Array, d_max: int,
 ) -> jax.Array:
-    """The compressed walk: DIR-16 root gather, then ``d_max`` steps over
-    the ONE merged node array — each step checks the node's skip chain
-    (path-compressed bits must match the address), consumes its 8-bit
+    """The shared skip-node descent body: ``d_max`` steps over ONE
+    merged node array from a caller-resolved entry (node id + alive
+    mask) — each step checks the node's skip chain, consumes its 8-bit
     stride, and rank-indexes the contiguous children.  Returns the
-    (B, 3 + R*5) per-tidx joined rows (row 0 / dead lanes all-zero ->
-    UNDEF), bit-identical in verdict semantics to trie_walk_joined."""
-    l0, nodes, targets, joined, root_lut = cdev
-    lut_size = root_lut.shape[0]
-    if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
-    root = jnp.where(
-        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
-    )
-    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
-    e0 = root * 65536 + nib0
-    in0 = (e0 >= 0) & (e0 < l0.shape[0])
-    rows0 = jnp.take(l0, e0, axis=0, mode="clip")
-    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)  # tidx+1
-    alive = in0 & (rows0[:, 0] > 0)
-    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    winning flat target position per lane (0 = sentinel / no hit).
+
+    The single-table walk (ctrie_walk_rows) and the multi-tenant paged
+    arena walk (arena_ctrie_rows) run EXACTLY this loop: arena slabs
+    bake page-global node/target ids at slab-write time, so paging is
+    entirely an entry-steering concern and the descent stays one code
+    path."""
     pos = jnp.full_like(node, 16)
     cap_bits = jnp.where(batch.kind == KIND_IPV4, 32, 128)
     widx8 = jnp.arange(8, dtype=jnp.int32)[None, :]
@@ -993,6 +987,31 @@ def ctrie_walk_rows(
             (r[:, 0] + prefix + _popcount32(cw & below)).astype(jnp.int32),
             0,
         )
+    return win
+
+
+def ctrie_walk_rows(
+    cdev: CTrieTables, batch: DeviceBatch, d_max: int
+) -> jax.Array:
+    """The compressed walk: DIR-16 root gather, then the shared
+    skip-node descent (_ctrie_descend) over the ONE merged node array.
+    Returns the (B, 3 + R*5) per-tidx joined rows (row 0 / dead lanes
+    all-zero -> UNDEF), bit-identical in verdict semantics to
+    trie_walk_joined."""
+    l0, nodes, targets, joined, root_lut = cdev
+    lut_size = root_lut.shape[0]
+    if_ok = (batch.ifindex >= 0) & (batch.ifindex < lut_size)
+    root = jnp.where(
+        if_ok, jnp.take(root_lut, jnp.clip(batch.ifindex, 0, lut_size - 1)), 0
+    )
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    e0 = root * 65536 + nib0
+    in0 = (e0 >= 0) & (e0 < l0.shape[0])
+    rows0 = jnp.take(l0, e0, axis=0, mode="clip")
+    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)  # tidx+1
+    alive = in0 & (rows0[:, 0] > 0)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    win = _ctrie_descend(nodes, batch, node, alive, d_max)
 
     in_w = (win >= 0) & (win < targets.shape[0])
     tval = jnp.where(in_w, jnp.take(targets, jnp.clip(win, 0), mode="clip"), 0)
@@ -2904,3 +2923,976 @@ def merge_stats_host(stats: np.ndarray) -> np.ndarray:
     out[:, 2] = s[:, 3]
     out[:, 3] = s[:, 4] * 256 + s[:, 5]
     return out
+
+
+# === multi-tenant paged table arena ==========================================
+#
+# The capacity-scaling layer (ISSUE-10): thousands of tenant rulesets
+# share ONE preallocated HBM pool per layout family instead of one
+# DeviceTables instance each.  Every family pool is divided into
+# fixed-size SLABS (pages); a tenant's compiled table is baked into its
+# slab with PAGE-GLOBAL indices (child/target pointers, joined
+# positions, root ids all offset by the slab base at write time), so
+# the classify kernels index one flat pool and the per-packet tenant
+# column steers only the ENTRY — the same way ingress_ifindex steers
+# the LPM root today.  Consequences:
+#
+# - one classify batch carries mixed-tenant traffic (the tenant column
+#   picks each packet's slab base through the device-resident
+#   tenant -> page table);
+# - tenant activation / hot-swap is a page-table ROW FLIP (one 1-row
+#   scatter, pre-warmed like the txn ladder) instead of a full table
+#   re-upload;
+# - the incremental patch machinery applies PER SLAB unchanged: a
+#   rules-only tenant edit is the usual joined/dense row scatter with
+#   positions offset by the slab base, through the same capped/fused
+#   executables (_capped_scatter / txn_scatter) the single-table path
+#   warms.
+#
+# Two families: "dense" (compare-all slabs — also the overlay side-pool)
+# and "ctrie" (the path/level-compressed poptrie, whose ONE merged node
+# array is what makes slab paging natural: the descent loop
+# (_ctrie_descend) is shared verbatim with the single-table walk).
+
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_PAGEFLIP_BUG env var), ArenaAllocator.activate skips the
+#: device page-table row flip after a tenant swap — the host-side
+#: registry believes the swap landed while the device keeps serving the
+#: STALE slab.  The statecheck acceptance gate (tools/infw_lint.py
+#: state --inject-defect pageflip) proves the model checker catches
+#: this via oracle divergence with a shrunk reproducer.  Never set in
+#: production.
+_INJECT_PAGEFLIP_BUG = False
+
+
+def _inject_pageflip_bug() -> bool:
+    if _INJECT_PAGEFLIP_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_PAGEFLIP_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
+class ArenaCapacityError(ValueError):
+    """A tenant table does not fit the arena's slab geometry (entries,
+    node rows, trie depth, rule width, lut span) or the pool is out of
+    free pages.  Callers either re-size the arena (a new pool
+    generation) or refuse the tenant — never silently truncate."""
+
+
+class ArenaSpec(NamedTuple):
+    """Geometry of one paged arena (a layout family's pool).  All row
+    counts are PER SLAB; device pools are ``pages`` slabs, flat along
+    rows.  Constructed via make_arena_spec (which buckets/validates) —
+    the raw constructor is for tests."""
+
+    family: str        # "dense" | "ctrie"
+    pages: int
+    max_tenants: int
+    entries: int       # dense-entry capacity per slab (T)
+    rule_slots: int    # packed rules per entry (row width = rule_slots*5)
+    lut_rows: int      # root_lut rows per slab (max ifindex + 1 bound)
+    root_nodes: int    # ctrie DIR-16 root nodes per slab (R0)
+    node_rows: int     # ctrie merged skip-node rows per slab (SN)
+    target_rows: int   # ctrie flat target rows per slab (ST)
+    d_max: int         # static descent unroll bound (pool-wide)
+
+    @property
+    def joined_rows(self) -> int:
+        """Per-slab joined rows: tidx+1 indexing plus the slab's own
+        zero sentinel row."""
+        return self.entries + 1
+
+    @property
+    def l0_rows(self) -> int:
+        return self.root_nodes * 65536
+
+
+def make_arena_spec(
+    family: str,
+    pages: int,
+    max_tenants: int,
+    entries: int,
+    rule_slots: int,
+    lut_rows: int = 8,
+    root_nodes: int = 1,
+    node_rows: int = 128,
+    target_rows: int = 64,
+    d_max: int = 6,
+) -> ArenaSpec:
+    """Normalize + validate an arena geometry: row counts bucket to the
+    shared scatter-ladder shapes (node rows additionally to 128-row
+    tiles for the Pallas byte planes), and the pool must satisfy the
+    capped-scatter budget (a full-slab write is <= pool/4 rows, i.e.
+    pages >= 4) and the int32 DIR-16 indexing bound."""
+    if family not in ("dense", "ctrie"):
+        raise ValueError(f"unknown arena family {family!r}")
+    if pages < 4:
+        raise ValueError(
+            f"arena needs >= 4 pages (full-slab writes ride the capped "
+            f"scatter budget of pool/4 rows); got {pages}"
+        )
+    if max_tenants < 1 or entries < 1 or rule_slots < 1:
+        raise ValueError("max_tenants, entries and rule_slots must be >= 1")
+    entries = _row_bucket(entries)
+    lut_rows = _row_bucket(lut_rows)
+    target_rows = _row_bucket(target_rows)
+    node_rows = -(-max(node_rows, 128) // 128) * 128
+    if family == "ctrie" and pages * root_nodes * 65536 > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"arena l0 pool {pages}x{root_nodes} root nodes exceeds int32 "
+            "DIR-16 indexing"
+        )
+    return ArenaSpec(
+        family=family, pages=pages, max_tenants=max_tenants,
+        entries=entries, rule_slots=rule_slots, lut_rows=lut_rows,
+        root_nodes=root_nodes, node_rows=node_rows,
+        target_rows=target_rows, d_max=d_max,
+    )
+
+
+def arena_spec_for(
+    family: str,
+    tables_iter,
+    pages: int,
+    max_tenants: int,
+    headroom: float = 1.0,
+    d_max: Optional[int] = None,
+) -> ArenaSpec:
+    """Size an ArenaSpec from sample tenant tables: take per-family
+    maxima over the samples, scaled by ``headroom``, then bucket via
+    make_arena_spec.  The samples must be u16-packable (the arena's
+    resident rule layout)."""
+    ent = 1
+    rs = 1
+    lut = 1
+    r0 = 1
+    nn = 1
+    tt = 1
+    dm = 1
+    for t in tables_iter:
+        rules = _packed_rules_flat(t)
+        if rules.dtype != np.uint16:
+            raise ArenaCapacityError(
+                "arena slabs hold u16-packed rules; a sample table has "
+                "wide int32 values"
+            )
+        ent = max(ent, t.rules.shape[0])
+        rs = max(rs, rules.shape[1] // 5)
+        lut = max(lut, np.asarray(t.root_lut).shape[0])
+        if family == "ctrie":
+            l0, nodes, targets, d = build_cpoptrie(t)
+            r0 = max(r0, l0.shape[0] // 65536)
+            nn = max(nn, nodes.shape[0])
+            tt = max(tt, targets.shape[0])
+            dm = max(dm, d)
+    h = lambda x: int(-(-x * headroom // 1))
+    return make_arena_spec(
+        family, pages, max_tenants,
+        entries=h(ent), rule_slots=rs, lut_rows=h(lut), root_nodes=r0,
+        node_rows=h(nn), target_rows=h(tt),
+        d_max=d_max if d_max is not None else dm,
+    )
+
+
+class DenseArena(NamedTuple):
+    """Dense-family device pool: ``pages`` compare-all slabs flat along
+    rows, plus the tenant -> page table.  Unassigned rows carry the
+    mask_len == -1 sentinel (inert exactly like single-table padding);
+    page_table rows are -1 for absent tenants."""
+
+    key_words: jax.Array   # (P*S, 5) uint32
+    mask_words: jax.Array  # (P*S, 5) uint32
+    mask_len: jax.Array    # (P*S,) int32
+    rules: jax.Array       # (P*S, R*5) uint16
+    page_table: jax.Array  # (max_tenants,) int32
+
+
+class CtrieArena(NamedTuple):
+    """Ctrie-family device pool: per-slab compressed-poptrie layouts
+    with PAGE-GLOBAL indices baked at slab-write time (node ids, target
+    positions, joined positions, root ids), so the shared descent
+    (_ctrie_descend) and the tail gathers run on the flat pools
+    untouched.  Pool row 0 of ``targets``/``joined`` doubles as the
+    global sentinel (page 0's slab sentinel — all slabs keep their
+    local row 0 zero)."""
+
+    l0: jax.Array          # (P*R0*65536, 2) int32
+    nodes: jax.Array       # (P*SN, 20) uint32
+    targets: jax.Array     # (P*ST,) int32 global joined positions
+    joined: jax.Array      # (P*(S+1), 3+R*5) uint16
+    root_lut: jax.Array    # (P*SL,) int32 global root ids
+    page_table: jax.Array  # (max_tenants,) int32
+
+
+# -- slab baking (host) ------------------------------------------------------
+
+
+def _dense_slab_arrays(spec: ArenaSpec, tables: CompiledTables):
+    """Full-slab host arrays for the dense family (page-offset-free:
+    dense slabs carry no cross-row indices).  Raises ArenaCapacityError
+    when the table exceeds the slab geometry."""
+    kw, mw, ml, rules, _lv, _tg, _lut, _j = _host_device_layout(
+        tables, pad=False, with_trie=False
+    )
+    S = spec.entries
+    if kw.shape[0] > S:
+        raise ArenaCapacityError(
+            f"tenant has {kw.shape[0]} entries > slab capacity {S}"
+        )
+    if rules.dtype != np.uint16:
+        raise ArenaCapacityError("arena slabs hold u16-packed rules")
+    if rules.shape[1] != spec.rule_slots * 5:
+        raise ArenaCapacityError(
+            f"rule row width {rules.shape[1]} != slab width "
+            f"{spec.rule_slots * 5} (compile tenants with rule_width="
+            f"{spec.rule_slots})"
+        )
+    return (
+        _pad_rows(kw, S),
+        _pad_rows(mw, S),
+        _pad_rows(ml, S, fill=-1),
+        _pad_rows(rules, S),
+    )
+
+
+def _ctrie_slab_arrays(spec: ArenaSpec, page: int, tables: CompiledTables):
+    """Full-slab host arrays for the ctrie family with the page's
+    GLOBAL offsets baked in: node ids += page*SN, target positions +=
+    page*ST, joined positions += page*SJ, root ids += page*R0.  Raises
+    ArenaCapacityError when any per-slab bound is exceeded."""
+    host = _ctrie_host_layout(tables)
+    if host is None:
+        raise ArenaCapacityError(
+            "tenant table is not ctrie-eligible (wide int32 rules)"
+        )
+    (l0, nodes, targets, joined, root_lut), d_max = host
+    if d_max > spec.d_max:
+        raise ArenaCapacityError(
+            f"tenant trie depth d_max={d_max} > arena unroll bound "
+            f"{spec.d_max}"
+        )
+    n0 = l0.shape[0] // 65536
+    if n0 > spec.root_nodes:
+        raise ArenaCapacityError(
+            f"{n0} root nodes > slab bound {spec.root_nodes}"
+        )
+    if nodes.shape[0] > spec.node_rows:
+        raise ArenaCapacityError(
+            f"{nodes.shape[0]} skip nodes > slab bound {spec.node_rows}"
+        )
+    if targets.shape[0] > spec.target_rows:
+        raise ArenaCapacityError(
+            f"{targets.shape[0]} targets > slab bound {spec.target_rows}"
+        )
+    if joined.shape[0] > spec.joined_rows:
+        raise ArenaCapacityError(
+            f"{joined.shape[0]} joined rows > slab bound "
+            f"{spec.joined_rows}"
+        )
+    if joined.shape[1] != 3 + spec.rule_slots * 5:
+        raise ArenaCapacityError(
+            f"joined row width {joined.shape[1]} != slab width "
+            f"{3 + spec.rule_slots * 5}"
+        )
+    if root_lut.shape[0] > spec.lut_rows:
+        raise ArenaCapacityError(
+            f"root_lut spans {root_lut.shape[0]} ifindexes > slab bound "
+            f"{spec.lut_rows}"
+        )
+    nb = page * spec.node_rows
+    tb = page * spec.target_rows
+    jb = page * spec.joined_rows
+    rb = page * spec.root_nodes
+
+    l0b = np.zeros((spec.l0_rows, 2), np.int32)
+    src = l0.copy()
+    src[:, 0] = np.where(src[:, 0] > 0, src[:, 0] + nb, 0)
+    src[:, 1] = np.where(src[:, 1] > 0, src[:, 1] + jb, 0)
+    l0b[: src.shape[0]] = src
+
+    nodesb = np.zeros((spec.node_rows, 20), np.uint32)
+    nsrc = nodes.astype(np.uint32, copy=True)
+    nsrc[:, 0] += np.uint32(nb)
+    nsrc[:, 1] += np.uint32(tb)
+    nodesb[: nsrc.shape[0]] = nsrc
+
+    tgtb = np.zeros(spec.target_rows, np.int32)
+    tsrc = targets.astype(np.int32, copy=True)
+    tgtb[: tsrc.shape[0]] = np.where(tsrc > 0, tsrc + jb, 0)
+
+    joinb = np.zeros((spec.joined_rows, joined.shape[1]), np.uint16)
+    joinb[: joined.shape[0]] = joined
+
+    lutb = np.full(spec.lut_rows, rb, np.int32)
+    lutb[: root_lut.shape[0]] = root_lut.astype(np.int64) + rb
+
+    return l0b, nodesb, tgtb, joinb, lutb
+
+
+# -- arena classify kernels --------------------------------------------------
+
+
+def _arena_pages(page_table: jax.Array, tenant: jax.Array) -> jax.Array:
+    """(B,) page index per packet from the device page table; -1 for
+    out-of-range tenant ids and absent tenants (their lanes classify to
+    UNDEF — the deterministic no-table verdict, never a read from
+    another tenant's slab)."""
+    mt = page_table.shape[0]
+    t_ok = (tenant >= 0) & (tenant < mt)
+    pg = jnp.take(
+        page_table, jnp.clip(tenant, 0, mt - 1), mode="clip"
+    ).astype(jnp.int32)
+    return jnp.where(t_ok, pg, -1)
+
+
+def arena_dense_result_and_score(
+    arena: DenseArena, batch: DeviceBatch, tenant: jax.Array, *, pages: int
+) -> Tuple[jax.Array, jax.Array]:
+    """(raw scan result, LPM score) over the dense pool: each packet
+    compares against ITS OWN slab's rows (a (B, S)-shaped gather-
+    compare — same arithmetic as lpm_dense_scores, slab-local).  Also
+    the overlay side of the arena combine."""
+    S = arena.mask_len.shape[0] // pages
+    pg = _arena_pages(arena.page_table, tenant)
+    valid = pg >= 0
+    base = jnp.clip(pg, 0) * S
+    ridx = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    kw = jnp.take(arena.key_words, ridx, axis=0, mode="clip")   # (B,S,5)
+    mw = jnp.take(arena.mask_words, ridx, axis=0, mode="clip")
+    ml = jnp.take(arena.mask_len, ridx, axis=0, mode="clip")    # (B,S)
+    pkt = packet_key_words(batch)
+    diff = (pkt[:, None, :] ^ kw) & mw
+    match = jnp.all(diff == 0, axis=-1)
+    cap = jnp.where(batch.kind == KIND_IPV4, 32, 128)
+    ok = valid[:, None] & match & (ml >= 0) & (ml <= cap[:, None])
+    score_all = jnp.where(ok, ml + 1, 0)
+    loc = jnp.argmax(score_all, axis=1).astype(jnp.int32)
+    score = jnp.max(score_all, axis=1)
+    rows = jnp.take(arena.rules, base + loc, axis=0, mode="clip")
+    rows = jnp.where((score > 0)[:, None], rows, 0)
+    rows = rows.reshape(rows.shape[0], -1, 5)
+    return rule_scan(rows, batch), score
+
+
+def classify_arena_dense(
+    arena: DenseArena, batch: DeviceBatch, tenant: jax.Array, *, pages: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    raw, _score = arena_dense_result_and_score(
+        arena, batch, tenant, pages=pages
+    )
+    return finalize(raw, batch)
+
+
+def _arena_ctrie_entry(
+    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *, pages: int
+):
+    """Tenant-steered entry of the paged compressed walk: tenant ->
+    page (device page table) -> slab root_lut row -> GLOBAL root node
+    -> DIR-16 slot.  Returns (node, alive, best0) in pool-global terms
+    — everything past here is the shared _ctrie_descend."""
+    SL = ca.root_lut.shape[0] // pages
+    R0 = ca.l0.shape[0] // (pages * 65536)
+    pg = _arena_pages(ca.page_table, tenant)
+    valid = pg >= 0
+    pg0 = jnp.clip(pg, 0)
+    if_ok = (batch.ifindex >= 0) & (batch.ifindex < SL)
+    lidx = pg0 * SL + jnp.clip(batch.ifindex, 0, SL - 1)
+    # out-of-lut ifindexes resolve to the page's OWN null root (the
+    # single-table if_ok -> root 0 semantics, slab-local)
+    root = jnp.where(
+        if_ok, jnp.take(ca.root_lut, lidx, mode="clip"), pg0 * R0
+    ).astype(jnp.int32)
+    nib0 = (batch.ip_words[:, 0] >> np.uint32(16)).astype(jnp.int32)
+    e0 = root * 65536 + nib0
+    in0 = valid & (e0 >= 0) & (e0 < ca.l0.shape[0])
+    rows0 = jnp.take(ca.l0, e0, axis=0, mode="clip")
+    best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)
+    alive = in0 & (rows0[:, 0] > 0)
+    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    return node, alive, best0
+
+
+def arena_ctrie_rows(
+    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, d_max: int,
+) -> jax.Array:
+    """(B, 3 + R*5) joined rows from the paged compressed walk —
+    per-tenant verdicts bit-identical to ctrie_walk_rows over that
+    tenant's standalone CTrieTables."""
+    node, alive, best0 = _arena_ctrie_entry(ca, batch, tenant, pages=pages)
+    win = _ctrie_descend(ca.nodes, batch, node, alive, d_max)
+    in_w = (win >= 0) & (win < ca.targets.shape[0])
+    tval = jnp.where(
+        in_w, jnp.take(ca.targets, jnp.clip(win, 0), mode="clip"), 0
+    )
+    sel = jnp.where(tval > 0, tval, best0)  # global joined position
+    in_j = (sel > 0) & (sel < ca.joined.shape[0])
+    rows = jnp.take(
+        ca.joined, jnp.clip(sel, 0, ca.joined.shape[0] - 1), axis=0,
+        mode="clip",
+    )
+    return jnp.where(in_j[:, None], rows, 0)
+
+
+def arena_ctrie_result_and_score(
+    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, d_max: int,
+) -> Tuple[jax.Array, jax.Array]:
+    rows = arena_ctrie_rows(ca, batch, tenant, pages=pages, d_max=d_max)
+    matched = (
+        rows[:, 0].astype(jnp.int32) | (rows[:, 1].astype(jnp.int32) << 16)
+    ) > 0
+    score = jnp.where(matched, rows[:, 2].astype(jnp.int32) + 1, 0)
+    return rule_scan(joined_rule_rows(rows), batch), score
+
+
+def classify_arena_ctrie(
+    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, d_max: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    raw, _s = arena_ctrie_result_and_score(
+        ca, batch, tenant, pages=pages, d_max=d_max
+    )
+    return finalize(raw, batch)
+
+
+def classify_arena_with_overlay(
+    main, overlay: DenseArena, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, ov_pages: int, d_max: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Arena classify with the per-tenant dense overlay side-pool: the
+    longest-prefix combine of classify_with_overlay, both sides
+    tenant-steered.  ``main`` is a CtrieArena (d_max > 0) or a
+    DenseArena."""
+    if isinstance(main, CtrieArena):
+        raw_m, score_m = arena_ctrie_result_and_score(
+            main, batch, tenant, pages=pages, d_max=d_max
+        )
+    else:
+        raw_m, score_m = arena_dense_result_and_score(
+            main, batch, tenant, pages=pages
+        )
+    raw_o, score_o = arena_dense_result_and_score(
+        overlay, batch, tenant, pages=ov_pages
+    )
+    result = jnp.where(score_o > score_m, raw_o, raw_m)
+    return finalize(result, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_arena_wire_fused(
+    family: str, pages: int, d_max: int = 0, ov_pages: int = 0
+):
+    """The arena wire launch: (arena[, overlay], wire, tenant) ->
+    fused (res16, stats) single-buffer output — the production
+    mixed-tenant dispatch.  Cache keyed on the pool geometry statics
+    (family, pages, d_max, overlay pages), which are FIXED per arena:
+    tenant count, swaps and patches never re-specialize."""
+    if family == "dense":
+        if ov_pages:
+            def f(arena, ov, wire, tenant):
+                res, _x, stats = classify_arena_with_overlay(
+                    arena, ov, unpack_wire(wire), tenant,
+                    pages=pages, ov_pages=ov_pages,
+                )
+                return fuse_wire_outputs(res.astype(jnp.uint16), stats)
+        else:
+            def f(arena, wire, tenant):
+                res, _x, stats = classify_arena_dense(
+                    arena, unpack_wire(wire), tenant, pages=pages
+                )
+                return fuse_wire_outputs(res.astype(jnp.uint16), stats)
+    elif family == "ctrie":
+        if ov_pages:
+            def f(arena, ov, wire, tenant):
+                res, _x, stats = classify_arena_with_overlay(
+                    arena, ov, unpack_wire(wire), tenant,
+                    pages=pages, ov_pages=ov_pages, d_max=d_max,
+                )
+                return fuse_wire_outputs(res.astype(jnp.uint16), stats)
+        else:
+            def f(arena, wire, tenant):
+                res, _x, stats = classify_arena_ctrie(
+                    arena, unpack_wire(wire), tenant,
+                    pages=pages, d_max=d_max,
+                )
+                return fuse_wire_outputs(res.astype(jnp.uint16), stats)
+    else:
+        raise ValueError(f"unknown arena family {family!r}")
+    return jax.jit(f)
+
+
+# -- the allocator -----------------------------------------------------------
+
+
+class ArenaAllocator:
+    """Host-side slab allocator over one family pool: page alloc/free,
+    full-slab bakes, per-slab incremental patches, page-table flips and
+    compaction — every device mutation through the SAME warmed capped/
+    fused scatter executables as the single-table patch path, so a
+    warm arena performs zero jit compiles across tenant create / swap /
+    patch / destroy (test-pinned by the recompile-lint suite).
+
+    Thread-safety: all mutating entry points take the internal lock;
+    ``arena`` snapshots the current device tuple (classify dispatches
+    finish on the tuple they captured — the double-buffer contract,
+    per-row granular here because a page-table flip only redirects
+    lanes of the flipped tenant)."""
+
+    def __init__(self, spec: ArenaSpec, device=None, shardings=None):
+        """``device`` is a jax device OR a Sharding (scatter payloads
+        and flips are placed with it — on a mesh, pass the REPLICATED
+        sharding); ``shardings`` optionally overrides the initial
+        placement PER POOL ARRAY name (the mesh backend passes the
+        slab-family partition rules here, pages sharded along the
+        "rules" axis)."""
+        self.spec = spec
+        self._device = device
+        self._shardings = shardings or {}
+        self._lock = threading.Lock()
+        P = spec.pages
+        if spec.family == "dense":
+            S = P * spec.entries
+            host = {
+                "key_words": np.zeros((S, 5), np.uint32),
+                "mask_words": np.zeros((S, 5), np.uint32),
+                "mask_len": np.full(S, -1, np.int32),
+                "rules": np.zeros((S, spec.rule_slots * 5), np.uint16),
+            }
+        else:
+            host = {
+                "l0": np.zeros((P * spec.l0_rows, 2), np.int32),
+                "nodes": np.zeros((P * spec.node_rows, 20), np.uint32),
+                "targets": np.zeros(P * spec.target_rows, np.int32),
+                "joined": np.zeros(
+                    (P * spec.joined_rows, 3 + spec.rule_slots * 5),
+                    np.uint16,
+                ),
+                "root_lut": np.zeros(P * spec.lut_rows, np.int32),
+            }
+        host["page_table"] = np.full(spec.max_tenants, -1, np.int32)
+        self._host = host
+        dev = {
+            k: jax.device_put(
+                jnp.asarray(v), self._shardings.get(k, device)
+            )
+            for k, v in host.items()
+        }
+        if spec.family == "dense":
+            self._dev = DenseArena(**dev)
+        else:
+            self._dev = CtrieArena(**dev)
+        self._free = list(range(P))
+        self._tenant_page: dict = {}
+        self._tenant_tables: dict = {}
+        self.counters = {
+            "assigns": 0, "patches": 0, "swaps": 0, "flips": 0,
+            "destroys": 0, "compactions": 0, "slab_writes": 0,
+        }
+        #: bumps on every structural slab write — consumers that derive
+        #: secondary layouts from the node pool (the paged Pallas walk's
+        #: byte planes) rebuild when this moves; rules-only patches
+        #: never touch it
+        self.node_gen = 0
+        #: pages whose node slab changed since the last
+        #: consume_dirty_node_pages() — lets plane consumers re-derive
+        #: ONLY the written slabs' rows instead of the whole pool
+        self._dirty_node_pages: set = set()
+        self._warm()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def arena(self):
+        """Snapshot of the current device pool tuple."""
+        with self._lock:
+            return self._dev
+
+    @property
+    def family(self) -> str:
+        return self.spec.family
+
+    def page_of(self, tenant: int):
+        with self._lock:
+            return self._tenant_page.get(tenant)
+
+    def tables_of(self, tenant: int):
+        with self._lock:
+            return self._tenant_tables.get(tenant)
+
+    def tenants(self):
+        with self._lock:
+            return sorted(self._tenant_page)
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def pool_bytes(self) -> int:
+        """Resident HBM footprint of the pools (the denominator of the
+        arena-vs-N-tables bench line)."""
+        with self._lock:
+            return sum(int(np.asarray(a).nbytes) for a in self._dev)
+
+    def host_nodes(self) -> Optional[np.ndarray]:
+        """Host mirror of the merged skip-node pool (ctrie family) —
+        the paged Pallas walk derives its byte planes from this; pair
+        reads with ``node_gen`` to know when to rebuild."""
+        with self._lock:
+            arr = self._host.get("nodes")
+            return None if arr is None else arr.copy()
+
+    def consume_dirty_node_pages(self):
+        """(node_gen, pages, node-slab host rows per page) of every
+        slab whose node rows changed since the last call — the
+        incremental feed for plane consumers (a full-pool re-derive on
+        every tenant mutation would put O(pool) work on the O(1) swap
+        path)."""
+        with self._lock:
+            pages = sorted(self._dirty_node_pages)
+            self._dirty_node_pages = set()
+            sn = self.spec.node_rows
+            rows = {
+                p: self._host["nodes"][p * sn : (p + 1) * sn].copy()
+                for p in pages
+            } if "nodes" in self._host else {}
+            return self.node_gen, pages, rows
+
+    def counter_values(self) -> dict:
+        """tenant_* counters for /metrics (the obs satellite): gauges
+        for slab occupancy plus monotonic mutation counts."""
+        with self._lock:
+            out = {
+                "tenant_active_slabs": len(self._tenant_page),
+                "tenant_free_slabs": len(self._free),
+            }
+            for k, v in self.counters.items():
+                out[f"tenant_{k}_total"] = v
+            return out
+
+    # -- device write plumbing ----------------------------------------------
+
+    def _warm(self) -> None:
+        """Pre-compile every scatter shape the allocator can emit: the
+        small-edit cap ladder per pool array, the FULL-SLAB row counts
+        (tenant create/swap/compact), the fused multi-family slab
+        combo, and the 1-row page-table flip — so a warm arena's whole
+        tenant lifecycle is compile-free."""
+        dev = self._dev
+        arrays = [a for a in dev[:-1]]
+        warm_scatters(arrays, self._device, max_rows=TXN_WARM_MAX_ROWS)
+        # the page-table flip executable (1-row direct scatter)
+        _scatter(dev.page_table, np.zeros(1, np.int64),
+                 np.zeros(1, np.int32), self._device)
+        # full-slab writes: one fused txn_scatter over every family
+        # array at its slab row count
+        entries = []
+        for arr, rows in zip(arrays, self._slab_rows()):
+            entries.append((
+                arr, np.zeros(rows, np.int64),
+                np.zeros((rows,) + tuple(arr.shape[1:]), arr.dtype),
+            ))
+        txn_scatter(entries, self._device)
+        # rules-only patch combo (ladder) for the hint fast path
+        patchable = [self._patch_arrays(dev)]
+        for group in patchable:
+            nb = group[0].shape[0]
+            for k in scatter_cap_ladder(nb, TXN_WARM_MAX_ROWS):
+                txn_scatter(
+                    [
+                        (
+                            a,
+                            np.zeros(min(k, max(a.shape[0] // 4, 1)), np.int64),
+                            np.zeros(
+                                (min(k, max(a.shape[0] // 4, 1)),)
+                                + tuple(a.shape[1:]),
+                                a.dtype,
+                            ),
+                        )
+                        for a in group
+                    ],
+                    self._device,
+                )
+
+    def _slab_rows(self):
+        s = self.spec
+        if s.family == "dense":
+            return (s.entries, s.entries, s.entries, s.entries)
+        return (s.l0_rows, s.node_rows, s.target_rows, s.joined_rows,
+                s.lut_rows)
+
+    def _patch_arrays(self, dev):
+        """The arrays a rules-only tenant edit scatters (the hint fast
+        path): the dense group, or the ctrie joined plane."""
+        if self.spec.family == "dense":
+            return (dev.key_words, dev.mask_words, dev.mask_len, dev.rules)
+        return (dev.joined,)
+
+    def _write_slab(self, page: int, slab_arrays) -> None:
+        """Bake one tenant's full slab into the pools: ONE fused
+        txn_scatter across every family array (whole slab rows, so a
+        reused page carries no stale bytes).  Mirrors update first —
+        they are the diff/bench/equivalence source of truth."""
+        names = (
+            ("key_words", "mask_words", "mask_len", "rules")
+            if self.spec.family == "dense"
+            else ("l0", "nodes", "targets", "joined", "root_lut")
+        )
+        entries = []
+        for name, rows, arr in zip(names, self._slab_rows(), slab_arrays):
+            base = page * rows
+            self._host[name][base : base + rows] = arr
+            entries.append((
+                getattr(self._dev, name),
+                base + np.arange(rows, dtype=np.int64),
+                arr,
+            ))
+        patched = txn_scatter(entries, self._device)
+        if patched is None:  # pages >= 4 makes this unreachable
+            raise ArenaCapacityError("slab write exceeded the scatter budget")
+        self._dev = self._dev._replace(**dict(zip(names, patched)))
+        self.counters["slab_writes"] += 1
+        self.node_gen += 1
+        self._dirty_node_pages.add(page)
+
+    def _flip(self, tenant: int, page: int, _inject: bool = False) -> None:
+        """The page-table row flip — the O(1) activation that replaces
+        a full re-upload.  Injected defect (pageflip, activate-only):
+        the device row keeps its STALE value while the host mirror
+        moves on — the arena keeps serving the OLD slab after a swap."""
+        self._host["page_table"][tenant] = page
+        if _inject:
+            self.counters["flips"] += 1
+            return
+        # direct 1-row scatter, NOT the capped helper: the flip is
+        # always exactly one row and must not ride the nb//4 delta
+        # budget (a tiny page table would refuse its own flip)
+        pt = _scatter(
+            self._dev.page_table,
+            np.array([tenant], np.int64),
+            np.array([page], np.int32),
+            self._device,
+        )
+        self._dev = self._dev._replace(page_table=pt)
+        self.counters["flips"] += 1
+
+    def _bake(self, page: int, tables: CompiledTables):
+        if self.spec.family == "dense":
+            return _dense_slab_arrays(self.spec, tables)
+        return _ctrie_slab_arrays(self.spec, page, tables)
+
+    # -- tenant lifecycle ----------------------------------------------------
+
+    def _alloc_page(self) -> int:
+        if not self._free:
+            raise ArenaCapacityError(
+                f"arena out of pages ({self.spec.pages} total, "
+                f"{len(self._tenant_page)} tenants resident)"
+            )
+        return self._free.pop(0)
+
+    def _check_tenant(self, tenant: int) -> None:
+        if not (0 <= tenant < self.spec.max_tenants):
+            raise ArenaCapacityError(
+                f"tenant id {tenant} outside [0, {self.spec.max_tenants})"
+            )
+
+    def load_tenant(self, tenant: int, tables: CompiledTables,
+                    hint=None) -> str:
+        """Install/refresh one tenant's table.  Returns the device path
+        taken: "patch" (rules-only row scatter into the resident slab),
+        "rewrite" (in-place full slab bake — structural edit, no page
+        change), or "assign" (fresh page + page-table flip)."""
+        self._check_tenant(tenant)
+        with self._lock:
+            page = self._tenant_page.get(tenant)
+            old = self._tenant_tables.get(tenant)
+            if page is not None and old is not None and hint is not None:
+                if self._try_patch(tenant, page, old, tables, hint):
+                    self._tenant_tables[tenant] = tables
+                    self.counters["patches"] += 1
+                    return "patch"
+            if page is not None:
+                self._write_slab(page, self._bake(page, tables))
+                self._tenant_tables[tenant] = tables
+                self.counters["assigns"] += 1
+                return "rewrite"
+            page = self._alloc_page()
+            try:
+                self._write_slab(page, self._bake(page, tables))
+            except Exception:
+                self._free.insert(0, page)  # never leak the page
+                raise
+            self._tenant_page[tenant] = page
+            self._tenant_tables[tenant] = tables
+            self._flip(tenant, page)
+            self.counters["assigns"] += 1
+            return "assign"
+
+    def _try_patch(self, tenant, page, old, new, hint) -> bool:
+        """Rules-only per-slab patch (the Map.Update analogue inside
+        one slab): hinted dense rows / dirty joined tidx rows scatter
+        at slab-base-offset positions through the shared fused
+        executable.  False -> caller falls back to the slab rewrite."""
+        if not hint_trie_unchanged(hint):
+            return False
+        dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+        dirty = dirty[(dirty >= 0) & (dirty < new.rules.shape[0])]
+        if self.spec.family == "dense":
+            kw, mw, ml, rules, _lv, _tg, _lut, _j = _host_device_layout(
+                new, pad=False, with_trie=False
+            )
+            if rules.dtype != np.uint16 or (
+                rules.shape[1] != self.spec.rule_slots * 5
+                or kw.shape[0] > self.spec.entries
+            ):
+                return False
+            base = page * self.spec.entries
+            rows = dirty[dirty < kw.shape[0]]
+            entries = []
+            for name, src in zip(
+                ("key_words", "mask_words", "mask_len", "rules"),
+                (kw, mw, ml, rules),
+            ):
+                vals = src[rows]
+                self._host[name][base + rows] = vals
+                entries.append((getattr(self._dev, name), base + rows, vals))
+            patched = txn_scatter(entries, self._device)
+            if patched is None:
+                return False
+            self._dev = self._dev._replace(
+                **dict(zip(("key_words", "mask_words", "mask_len", "rules"),
+                           patched))
+            )
+            return True
+        # ctrie family: seed caches forward, then scatter the dirty
+        # joined rows at the slab base
+        _seed_ctrie_caches_forward(old, new, dirty)
+        pr = _joined_tidx_patch_rows(new, dirty)
+        if pr is None:
+            return False
+        pos, rows = pr
+        if len(pos) and (
+            int(pos.max()) >= self.spec.joined_rows
+            or rows.shape[1] != self._dev.joined.shape[1]
+        ):
+            return False
+        if len(pos) == 0:
+            return True
+        gpos = page * self.spec.joined_rows + pos
+        self._host["joined"][gpos] = rows
+        joined = _capped_scatter(self._dev.joined, gpos, rows, self._device)
+        if joined is None:
+            return False
+        self._dev = self._dev._replace(joined=joined)
+        return True
+
+    def stage(self, tables: CompiledTables) -> int:
+        """Bake a table into a FREE page without activating it — the
+        pre-warm half of a hot swap.  Returns the staged page id
+        (reserved until activate/release)."""
+        with self._lock:
+            page = self._alloc_page()
+            try:
+                self._write_slab(page, self._bake(page, tables))
+            except Exception:
+                self._free.insert(0, page)
+                raise
+            return page
+
+    def release(self, page: int) -> None:
+        """Return a staged-but-never-activated page to the free list."""
+        with self._lock:
+            if page not in self._free and page not in self._tenant_page.values():
+                self._free.append(page)
+
+    def activate(self, tenant: int, page: int,
+                 tables: Optional[CompiledTables] = None) -> None:
+        """Hot-swap: flip the tenant's page-table row to a staged page
+        (O(1) scatter) and free the previous slab.  THE measured swap
+        path of bench_tenant."""
+        self._check_tenant(tenant)
+        with self._lock:
+            owner = next(
+                (t for t, p in self._tenant_page.items()
+                 if p == page and t != tenant), None,
+            )
+            if owner is not None:
+                raise ArenaCapacityError(
+                    f"page {page} is live for tenant {owner}"
+                )
+            # a re-activated page may sit on the free list (the
+            # ping-pong standby pattern frees the previous page on each
+            # flip): claim it back so no page is ever both free and
+            # mapped (the check_arena invariant)
+            if page in self._free:
+                self._free.remove(page)
+            old_page = self._tenant_page.get(tenant)
+            self._tenant_page[tenant] = page
+            if tables is not None:
+                self._tenant_tables[tenant] = tables
+            else:
+                # the previous table no longer describes the slab now
+                # serving; a stale record would let compact() rebake the
+                # PRE-swap ruleset — drop it (compaction then leaves
+                # this tenant in place until the next recorded load)
+                self._tenant_tables.pop(tenant, None)
+            # the injected pageflip defect fires ONLY on the swap of an
+            # already-resident tenant — the exact transition the
+            # statecheck acceptance gate must prove is covered
+            self._flip(
+                tenant, page,
+                _inject=_inject_pageflip_bug() and old_page is not None,
+            )
+            if (
+                old_page is not None and old_page != page
+                and old_page not in self._free
+            ):
+                self._free.append(old_page)
+            self.counters["swaps"] += 1
+
+    def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
+        """stage + activate in one call (the non-prestaged swap)."""
+        page = self.stage(tables)
+        self.activate(tenant, page, tables)
+
+    def destroy_tenant(self, tenant: int) -> None:
+        self._check_tenant(tenant)
+        with self._lock:
+            page = self._tenant_page.pop(tenant, None)
+            self._tenant_tables.pop(tenant, None)
+            self._flip(tenant, -1)
+            if page is not None:
+                self._free.append(page)
+            self.counters["destroys"] += 1
+
+    def compact(self) -> int:
+        """Repack live slabs into the lowest-numbered pages (slab
+        rewrite + flip per moved tenant) so a long create/destroy churn
+        leaves the occupied region contiguous.  Returns tenants moved."""
+        moved = 0
+        with self._lock:
+            # only tenants with a recorded table can move (a tables-less
+            # activate dropped its record — the slab cannot be rebaked)
+            order = sorted(
+                ((t, p) for t, p in self._tenant_page.items()
+                 if t in self._tenant_tables),
+                key=lambda kv: kv[1],
+            )
+            all_pages = sorted(
+                self._free + [p for _t, p in order]
+            )
+            for (tenant, page), target in zip(order, all_pages):
+                if target == page:
+                    continue
+                tables = self._tenant_tables[tenant]
+                self._free.remove(target)
+                self._write_slab(target, self._bake(target, tables))
+                self._tenant_page[tenant] = target
+                self._flip(tenant, target)
+                self._free.append(page)
+                moved += 1
+            self._free.sort()
+            if moved:
+                self.counters["compactions"] += 1
+        return moved

@@ -1008,9 +1008,16 @@ def _make_cwalk_kernel(d_max: int):
 
 
 def _cwalk_scan(
-    meta: jax.Array, words: jax.Array, wt: CWalkTables, d_max: int,
+    meta: jax.Array, words: jax.Array, nodes, d_max: int,
     interpret: bool, block_b: int,
 ) -> jax.Array:
+    """The fused skip-node descent grid pass over ONE merged int8
+    byte-plane node array — shared by the single-table compressed walk
+    (CWalkTables.nodes) and the multi-tenant paged arena walk (the
+    whole node POOL's planes): slab paging bakes page-global node ids
+    at write time, so the kernel body is page-agnostic."""
+    if hasattr(nodes, "nodes"):  # CWalkTables convenience
+        nodes = nodes.nodes
     B = meta.shape[0]
     full = lambda a: pl.BlockSpec(a.shape, lambda i: (0, 0))
     return pl.pallas_call(
@@ -1020,11 +1027,11 @@ def _cwalk_scan(
         in_specs=[
             pl.BlockSpec((block_b, 8), lambda i: (i, 0)),
             pl.BlockSpec((block_b, 4), lambda i: (i, 0)),
-            full(wt.nodes),
+            full(nodes),
         ],
         out_specs=pl.BlockSpec((block_b, 2), lambda i: (i, 0)),
         interpret=interpret,
-    )(meta, words, wt.nodes)
+    )(meta, words, nodes)
 
 
 def classify_cwalk(
@@ -1103,5 +1110,111 @@ def jitted_classify_cwalk_wire_fused(d_max: int, interpret: bool,
                 wt, wire, d_max=d_max, interpret=interpret, block_b=block_b
             )
         )
+
+    return jax.jit(f)
+
+
+# --- paged arena walk (multi-tenant, ISSUE-10) ------------------------------
+#
+# The paged compressed walk: the arena's merged skip-node POOL becomes
+# the kernel's one VMEM-resident byte-plane array (slab writes bake
+# page-global ids, so _make_cwalk_kernel runs unmodified), and the
+# tenant-steered entry (jaxpath._arena_ctrie_entry) replaces the
+# single-table _root_stage.  The rules tail gathers the POOLED per-tidx
+# joined matrix from HBM by global position — no leaf-push duplication,
+# no per-tenant specialization, one executable for the whole arena.
+
+
+def arena_cwalk_vmem_bytes(node_pool_rows: int,
+                           block_b: int = BLOCK_B) -> int:
+    """Resident + transient VMEM estimate of the paged walk: the int8
+    node planes plus the (block_b, N_pad) one-hot operand — the same
+    accounting build_cwalk_tables_meta gates on."""
+    n_pad = _round_up(max(node_pool_rows, 1), 128)
+    return n_pad * LEVEL_ROW_PAD + block_b * n_pad
+
+
+def build_arena_cwalk_planes(
+    nodes_pool: np.ndarray,
+    vmem_budget: int = DEFAULT_VMEM_BUDGET,
+    device=None,
+):
+    """(P*SN, 20) u32 pool -> (P*SN, 128) int8 biased byte planes for
+    the fused paged walk, or None when the pool exceeds the VMEM budget
+    (callers serve from the XLA arena walk — the usual fallback
+    contract).  SN is a multiple of 128 by ArenaSpec construction, so
+    plane rows map 1:1 to pool rows and a slab rewrite can re-derive
+    exactly its own rows."""
+    if arena_cwalk_vmem_bytes(nodes_pool.shape[0]) > vmem_budget:
+        return None
+    return jax.device_put(
+        jnp.asarray(_split_cnode_rows(np.asarray(nodes_pool, np.uint32))),
+        device,
+    )
+
+
+def classify_arena_cwalk(
+    ca, planes: jax.Array, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, d_max: int, interpret: bool = False,
+    block_b: int = BLOCK_B,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mixed-tenant forward pass via the fused paged walk; verdicts
+    bit-identical to jaxpath.classify_arena_ctrie on the same arena."""
+    from .jaxpath import (
+        _arena_ctrie_entry, joined_rule_rows, rule_scan,
+    )
+
+    B = batch.kind.shape[0]
+    node, alive, best0 = _arena_ctrie_entry(ca, batch, tenant, pages=pages)
+    node = jnp.where(alive, node, -1)
+    meta = jnp.stack(
+        [
+            node, alive.astype(jnp.int32), best0, batch.kind,
+            jnp.zeros_like(node), jnp.zeros_like(node),
+            jnp.zeros_like(node), jnp.zeros_like(node),
+        ],
+        axis=1,
+    ).astype(jnp.int32)
+    words = batch.ip_words.astype(jnp.int32)
+    Bp = _round_up(max(B, 1), block_b)
+    if Bp != B:
+        pad = Bp - B
+        pad_meta = jnp.zeros((pad, 8), jnp.int32)
+        pad_meta = pad_meta.at[:, 0].set(-1).at[:, 3].set(KIND_OTHER)
+        meta = jnp.concatenate([meta, pad_meta], axis=0)
+        words = jnp.concatenate(
+            [words, jnp.zeros((pad, 4), jnp.int32)], axis=0
+        )
+    out = _cwalk_scan(meta, words, planes, d_max, interpret, block_b)[:B]
+    win = out[:, 1]
+    n_t = ca.targets.shape[0]
+    in_w = (win >= 0) & (win < n_t)
+    tval = jnp.where(
+        in_w, jnp.take(ca.targets, jnp.clip(win, 0), mode="clip"), 0
+    )
+    sel = jnp.where(tval > 0, tval, best0)  # global joined position
+    P = ca.joined.shape[0]
+    in_j = (sel > 0) & (sel < P)
+    rows = jnp.take(
+        ca.joined, jnp.clip(sel, 0, P - 1), axis=0, mode="clip"
+    )
+    rows = jnp.where(in_j[:, None], rows, 0)
+    raw = rule_scan(joined_rule_rows(rows), batch)
+    return finalize(raw, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_classify_arena_cwalk_wire_fused(
+    pages: int, d_max: int, interpret: bool, block_b: int = BLOCK_B
+):
+    """The paged-walk wire launch: (arena, planes, wire, tenant) ->
+    fused (res16, stats) — keyed on the pool geometry statics only, so
+    tenant lifecycle never re-specializes."""
+    def f(ca, planes, wire, tenant):
+        res, _x, stats = classify_arena_cwalk(
+            ca, planes, unpack_wire(wire), tenant,
+            pages=pages, d_max=d_max, interpret=interpret, block_b=block_b,
+        )
+        return fuse_wire_outputs(res.astype(jnp.uint16), stats)
 
     return jax.jit(f)

@@ -534,3 +534,29 @@ class EventsLogger:
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
             self.drain_once()
+
+
+@dataclass
+class TenantSwapRecord:
+    """One tenant lifecycle transition on the multi-tenant paged arena
+    (infw.syncer.TenantRegistry): create / hot-swap / destroy, with the
+    two halves of a swap timed separately — slab staging (background,
+    pre-warmable) vs the page-table row flip (the O(1) activation the
+    arena exists for).  Counters (active slabs, swaps, compactions,
+    per-tenant packets/verdicts) live on /metrics; the event carries
+    the SHAPE of each transition in the same stream as deny events."""
+
+    tenant: str
+    tenant_id: int
+    page: int
+    entries: int
+    kind: str          # "create" | "swap" | "destroy" | "patch"
+    stage_us: float = 0.0
+    flip_us: float = 0.0
+
+    def lines(self) -> List[str]:
+        return [
+            f"tenant-{self.kind}: {self.tenant!r} (id {self.tenant_id}) "
+            f"page {self.page}, {self.entries} entries, "
+            f"stage {self.stage_us:.0f}us + flip {self.flip_us:.0f}us"
+        ]

@@ -494,10 +494,31 @@ class ContinuousScheduler:
     # -- dispatch plumbing ---------------------------------------------------
 
     def _dispatch(self, clf, batch: PacketBatch, idx: np.ndarray,
-                  bucket: int):
+                  bucket: int, tenant_of: Optional[np.ndarray] = None):
         """One admitted job through the production path: fused subset
         pack + ladder padding + (prepare_packed | classify_async_packed |
-        classify_async), matching the daemon's prepare/launch halves."""
+        classify_async), matching the daemon's prepare/launch halves.
+
+        ``tenant_of`` (tenant-tagged admissions, the multi-tenant arena
+        path): per-packet tenant ids aligned with ``batch`` — when the
+        classifier serves the arena contract, ONE admitted job carries
+        mixed-tenant traffic and the tenant column steers each packet's
+        slab in-kernel; padding lanes get tenant -1 (UNDEF)."""
+        if tenant_of is not None and hasattr(
+            clf, "classify_async_packed_tenant"
+        ):
+            sub = np.ascontiguousarray(idx, np.int64)
+            wire, _v4 = batch.pack_wire_subset(sub)
+            tags = np.ascontiguousarray(tenant_of[sub], np.int32)
+            pad = bucket - wire.shape[0]
+            if pad > 0:
+                padrows = np.zeros((pad, wire.shape[1]), np.uint32)
+                padrows[:, 0] = KIND_OTHER
+                wire = np.concatenate([wire, padrows])
+                tags = np.concatenate([tags, np.full(pad, -1, np.int32)])
+            return lambda: clf.classify_async_packed_tenant(
+                wire, tags, apply_stats=False
+            )
         supports = getattr(clf, "supports_packed", None)
         if supports is not None and supports():
             wire, v4_only = batch.pack_wire_subset(
@@ -534,13 +555,35 @@ class ContinuousScheduler:
     # -- the loop ------------------------------------------------------------
 
     def serve(self, batch: PacketBatch, arrival_offsets_s: np.ndarray,
-              anchor: Optional[float] = None) -> ServeResult:
+              anchor: Optional[float] = None,
+              tenant_of: Optional[np.ndarray] = None) -> ServeResult:
         """Classify ``batch`` as an open-loop arrival stream: packet i
         becomes eligible at ``anchor + arrival_offsets_s[i]`` (anchor
         defaults to now).  Blocks until every packet's verdict is
         host-resident; per-packet latency is completion minus SCHEDULED
-        arrival (coordinated-omission-safe)."""
+        arrival (coordinated-omission-safe).  ``tenant_of`` tags each
+        packet with its tenant id for arena-backed classifiers — one
+        admission then dispatches ONE mixed-tenant batch instead of a
+        per-tenant job fan-out."""
         n = len(batch)
+        if tenant_of is not None:
+            tenant_of = np.ascontiguousarray(tenant_of, np.int32)
+            if tenant_of.shape != (n,):
+                raise ValueError(
+                    f"tenant_of shape {tenant_of.shape} != ({n},)"
+                )
+            # refusing beats silently classifying every tenant against
+            # one table: a non-arena backend would drop the tags on the
+            # floor and break cross-tenant isolation with no signal
+            for target, label in ((self.clf, "classifier"),
+                                  (self.spill_clf, "spill classifier")):
+                if target is not None and not hasattr(
+                    target, "classify_async_packed_tenant"
+                ):
+                    raise ValueError(
+                        f"tenant_of given but the {label} does not serve "
+                        "the tenant contract (classify_async_packed_tenant)"
+                    )
         offs = np.asarray(arrival_offsets_s, np.float64)
         if offs.shape != (n,):
             raise ValueError(
@@ -646,7 +689,8 @@ class ContinuousScheduler:
             bucket = ladder_bucket(len(idx), max(cap, len(idx)))
             self.stats.note_admit(len(idx), bucket, spilled=spilled)
             batch_sizes.append(len(idx))
-            thunk = self._dispatch(target, batch, idx, bucket)
+            thunk = self._dispatch(target, batch, idx, bucket,
+                                   tenant_of=tenant_of)
             # the bucket travels with the job: the drain thread must
             # feed the service observation to the bucket the job was
             # DISPATCHED at, not a recomputation that forgets spill

@@ -43,6 +43,7 @@ from . import interfaces as interfaces_mod
 from .backend.base import Classifier
 from .compiler import (
     CompiledTables,
+    CompileError,
     IncrementalTables,
     LpmKey,
     build_table_content,
@@ -58,6 +59,23 @@ log = logging.getLogger("infw.syncer")
 # XDP_EBUSY retry policy (ebpfsyncer.go:28-30,193-207).
 XDP_EBUSY_MAX_RETRIES = 10
 XDP_EBUSY_RETRY_INTERVAL_S = 0.1
+
+
+def merge_rebuild_content(content, ups, dels, extra=None):
+    """The columnar-rebuild escalation's content merge — live content
+    minus the deleted masked identities, plus the upserts (plus an
+    optional absorbed side dict, e.g. the overlay).  ONE shared recipe
+    for the single-tenant flush path and the tenant registry, so the
+    escalation semantics cannot drift between them."""
+    del_idents = {k.masked_identity() for k in dels}
+    out = {
+        k: v for k, v in dict(content).items()
+        if k.masked_identity() not in del_idents
+    }
+    out.update(ups)
+    if extra:
+        out.update(extra)
+    return out
 
 
 class SyncError(RuntimeError):
@@ -308,14 +326,9 @@ class DataplaneSyncer:
             # columnar-rebuild escalation: fresh updater absorbs the
             # overlay too; the OLD generation keeps serving until the
             # load below swaps
-            content = dict(self._updater.content)
-            del_idents = {k.masked_identity() for k in deletes}
-            content = {
-                k: v for k, v in content.items()
-                if k.masked_identity() not in del_idents
-            }
-            content.update(ups)
-            content.update(self._overlay)
+            content = merge_rebuild_content(
+                self._updater.content, ups, deletes, extra=self._overlay
+            )
             self._overlay = {}
             self._overlay_compiled = None
             self._updater = IncrementalTables.from_content(
@@ -1032,3 +1045,259 @@ def reset_singleton_for_test() -> None:
     global _singleton
     with _singleton_lock:
         _singleton = None
+
+
+class TenantError(SyncError):
+    """Tenant registry misuse: unknown name, duplicate create, or a
+    table the arena geometry cannot hold."""
+
+
+class TenantRegistry:
+    """Multi-tenant control plane over an arena-backed classifier
+    (backend.tpu.ArenaClassifier / backend.mesh.MeshArenaClassifier):
+    names tenants, owns one IncrementalTables per tenant (the same
+    per-key incremental compile state the single-tenant syncer keeps),
+    and drives the tenant lifecycle —
+
+    - ``create_tenant``: compile + slab assign + page-table flip;
+    - ``update_tenant`` / ``apply_edit_transaction``: per-tenant
+      incremental edits through the SAME fold + dirty-hint machinery as
+      the single-tenant path (infw.txn.fold_ops), landing as per-slab
+      row scatters;
+    - ``swap_tenant``: full ruleset replacement as stage (background
+      slab bake into a free page) + activate (the O(1) page-table row
+      flip) — the re-upload killer the bench_tenant tier measures;
+    - ``destroy_tenant``: row flip to -1 + page free.
+
+    Every transition emits a TenantSwapRecord on the obs event ring
+    (when given one) and the tenant_* counters surface through
+    ``counter_values`` for /metrics."""
+
+    def __init__(self, classifier, rule_width: int,
+                 event_ring=None) -> None:
+        self._clf = classifier
+        self._rule_width = rule_width
+        self._ring = event_ring
+        self._lock = threading.Lock()
+        #: serializes whole lifecycle operations per registry: the
+        #: per-tenant IncrementalTables is not thread-safe, and an
+        #: update racing a swap's updater replacement could scatter a
+        #: stale snapshot over the freshly swapped slab — lifecycle ops
+        #: are control-plane-rate, so one coarse lock is the honest
+        #: contract (classify never takes it)
+        self._op_lock = threading.RLock()
+        self._names: Dict[str, int] = {}
+        self._updaters: Dict[int, IncrementalTables] = {}
+        #: creates in flight: name -> reserved id.  The name/id become
+        #: visible in _names/_updaters only once the compile + slab
+        #: load SUCCEEDS, so concurrent edits on a half-created tenant
+        #: get a clean TenantError("unknown"), never a None updater.
+        self._creating: Dict[str, int] = {}
+        self._next_id = 0
+        self._max = classifier.spec.max_tenants
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def classifier(self):
+        return self._clf
+
+    def tenant_id(self, name: str) -> int:
+        with self._lock:
+            if name not in self._names:
+                raise TenantError(f"unknown tenant {name!r}")
+            return self._names[name]
+
+    def tenant_names(self):
+        with self._lock:
+            return sorted(self._names)
+
+    def tenant_ids_by_name(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._names)
+
+    def counter_values(self) -> Dict[str, int]:
+        out = {"tenant_registered": len(self._names)}
+        getter = getattr(self._clf, "tenant_counters", None)
+        if getter is not None:
+            out.update(getter())
+        return out
+
+    def _emit(self, record) -> None:
+        if self._ring is not None:
+            try:
+                self._ring.push(record)
+            except Exception:
+                pass
+
+    def _alloc_id(self) -> int:
+        busy = set(self._updaters) | set(self._creating.values())
+        for _ in range(self._max):
+            tid = self._next_id % self._max
+            self._next_id += 1
+            if tid not in busy:
+                return tid
+        raise TenantError(f"tenant registry full ({self._max} ids)")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def create_tenant(self, name: str, content: Dict[LpmKey, np.ndarray]) -> int:
+        from .obs.events import TenantSwapRecord
+
+        with self._op_lock:
+            return self._create_tenant_locked(name, content)
+
+    def _create_tenant_locked(self, name, content) -> int:
+        from .obs.events import TenantSwapRecord
+
+        with self._lock:
+            if name in self._names or name in self._creating:
+                raise TenantError(f"tenant {name!r} already exists")
+            tid = self._alloc_id()
+            self._creating[name] = tid
+        try:
+            upd = IncrementalTables.from_content(
+                dict(content), rule_width=self._rule_width
+            )
+            snap = upd.snapshot()
+            t0 = time.perf_counter()
+            self._clf.load_tenant(tid, snap)
+            dt = (time.perf_counter() - t0) * 1e6
+            upd.start_dirty_tracking()
+        except Exception:
+            with self._lock:
+                self._creating.pop(name, None)
+            raise
+        with self._lock:
+            self._creating.pop(name, None)
+            self._names[name] = tid
+            self._updaters[tid] = upd
+        self._emit(TenantSwapRecord(
+            tenant=name, tenant_id=tid,
+            page=self._clf.allocator.page_of(tid) or 0,
+            entries=snap.num_entries, kind="create", stage_us=dt,
+        ))
+        return tid
+
+    def update_tenant(self, name: str,
+                      ups: Dict[LpmKey, np.ndarray], dels) -> str:
+        """Incremental per-tenant edit: one updater apply + one per-slab
+        device patch (dirty-hinted).  Escalates to a rebuild exactly
+        like the single-tenant syncer (CompileError / capacity)."""
+        with self._op_lock:
+            tid = self.tenant_id(name)
+            with self._lock:
+                upd = self._updaters[tid]
+            try:
+                if ups and not upd.fits(ups):
+                    raise CompileError("trie depth exceeded; rebuild")
+                upd.apply(ups, list(dels))
+                upd.maybe_compact()
+            except CompileError:
+                # the SAME escalation recipe as the single-tenant flush
+                # path (merge_rebuild_content) — no drift between them
+                upd = IncrementalTables.from_content(
+                    merge_rebuild_content(upd.content, ups, dels),
+                    rule_width=self._rule_width,
+                )
+                with self._lock:
+                    self._updaters[tid] = upd
+            hint = upd.peek_dirty()
+            snap = upd.snapshot()
+            path = self._clf.load_tenant(tid, snap, hint=hint)
+            upd.clear_dirty()
+            return path
+
+    def apply_edit_transaction(self, name: str, ops) -> str:
+        """Fold + apply a batched edit transaction for one tenant
+        through the production fold (infw.txn.fold_ops) — N ops, one
+        slab patch.  Overlay routing is disabled on the arena v1 (the
+        per-tenant dense side-pool is driven explicitly), so every
+        folded effect lands in the tenant's main slab."""
+        from .txn import fold_ops, route_folded
+
+        with self._op_lock:
+            return self._apply_edit_transaction_locked(
+                name, ops, fold_ops, route_folded
+            )
+
+    def _apply_edit_transaction_locked(self, name, ops, fold_ops,
+                                       route_folded) -> str:
+        tid = self.tenant_id(name)
+        with self._lock:
+            upd = self._updaters[tid]
+        folded = fold_ops(ops, set(upd._ident_to_t))
+        no_overlay: Dict[LpmKey, np.ndarray] = {}
+        ups, dels, _dirty = route_folded(folded, no_overlay, False, 0)
+        if not ups and not dels:
+            return "noop"
+        return self.update_tenant(name, ups, dels)
+
+    def swap_tenant(self, name: str,
+                    content: Dict[LpmKey, np.ndarray]) -> None:
+        """Full ruleset replacement by page-table flip: bake the new
+        slab into a free page (stage), then activate — O(1) on the
+        serving path regardless of table size."""
+        from .obs.events import TenantSwapRecord
+
+        with self._op_lock:
+            return self._swap_tenant_locked(name, content)
+
+    def _swap_tenant_locked(self, name, content) -> None:
+        from .obs.events import TenantSwapRecord
+
+        tid = self.tenant_id(name)
+        upd = IncrementalTables.from_content(
+            dict(content), rule_width=self._rule_width
+        )
+        snap = upd.snapshot()
+        t0 = time.perf_counter()
+        if hasattr(self._clf, "stage_tenant"):
+            page = self._clf.stage_tenant(snap)
+            t1 = time.perf_counter()
+            self._clf.activate_tenant(tid, page, snap)
+        else:
+            page = -1
+            t1 = time.perf_counter()
+            self._clf.swap_tenant(tid, snap)
+        t2 = time.perf_counter()
+        upd.start_dirty_tracking()
+        with self._lock:
+            self._updaters[tid] = upd
+        self._emit(TenantSwapRecord(
+            tenant=name, tenant_id=tid,
+            page=self._clf.allocator.page_of(tid) if page < 0 else page,
+            entries=snap.num_entries, kind="swap",
+            stage_us=(t1 - t0) * 1e6, flip_us=(t2 - t1) * 1e6,
+        ))
+
+    def destroy_tenant(self, name: str) -> None:
+        from .obs.events import TenantSwapRecord
+
+        with self._op_lock:
+            tid = self.tenant_id(name)
+            self._clf.destroy_tenant(tid)
+            self._destroy_finish(name, tid)
+
+    def _destroy_finish(self, name: str, tid: int) -> None:
+        from .obs.events import TenantSwapRecord
+        with self._lock:
+            self._names.pop(name, None)
+            self._updaters.pop(tid, None)
+        self._emit(TenantSwapRecord(
+            tenant=name, tenant_id=tid, page=-1, entries=0, kind="destroy",
+        ))
+
+    # -- dataplane passthrough ----------------------------------------------
+
+    def classify_mixed(self, batch, tenant_names_or_ids,
+                       apply_stats: bool = True):
+        """Mixed-tenant classify: per-packet tenant tags by name (str)
+        or id (int) — one batch, one dispatch."""
+        tags = np.asarray([
+            self._names.get(t, -1) if isinstance(t, str) else int(t)
+            for t in tenant_names_or_ids
+        ], np.int32)
+        return self._clf.classify_tenants(
+            batch, tags, apply_stats=apply_stats
+        )

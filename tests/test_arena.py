@@ -1,0 +1,708 @@
+"""Multi-tenant paged table arena (ISSUE-10).
+
+Covers the arena core (slab baking, page-table steering, allocator
+lifecycle), mixed-tenant classify bit-identity vs per-tenant CPU
+oracles through the production wire dispatch (XLA dense + ctrie, the
+paged Pallas walk, single-chip and mesh), the per-slab incremental
+patch path, the zero-recompile warm-arena contract across tenant
+counts and lifecycle ops, the 8-iface mixed-ifindex regression (old
+path semantics preserved bit-identically when the interfaces run AS
+tenants), the tenant registry / scheduler / daemon integration, and
+the statecheck arena configs incl. the pageflip injected defect.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from infw import oracle, packets, testing
+from infw.backend.tpu import ArenaClassifier, TpuClassifier
+from infw.compiler import IncrementalTables, compile_tables_from_content
+from infw.kernels import jaxpath, pallas_walk
+
+import jax
+
+
+def _tenants(n, entries=24, v6=0.4, seed0=100, width=4):
+    return {
+        t: testing.random_tables(
+            np.random.default_rng(seed0 + t), n_entries=entries,
+            width=width, v6_fraction=v6,
+        )
+        for t in range(n)
+    }
+
+
+def _mixed(tabs, per=40, seed=7):
+    parts, tags, want = [], [], []
+    for t, tab in sorted(tabs.items()):
+        b = testing.random_batch(np.random.default_rng(seed + t), tab, per)
+        parts.append(b)
+        tags.append(np.full(per, t, np.int32))
+        want.append(oracle.classify(tab, b).results)
+    return packets.concat(parts), np.concatenate(tags), np.concatenate(want)
+
+
+def _classify_arena(alloc, wire, tenant, n, kind):
+    spec = alloc.spec
+    d_max = spec.d_max if spec.family == "ctrie" else 0
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        spec.family, spec.pages, d_max
+    )
+    fused = fn(alloc.arena, jax.device_put(wire), jax.device_put(tenant))
+    res16, stats = jaxpath.split_wire_outputs(np.asarray(fused), n)
+    results, xdp = jaxpath.host_finalize_wire(res16, kind)
+    return results, xdp, stats
+
+
+# --- spec / geometry ---------------------------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="4 pages"):
+        jaxpath.make_arena_spec("dense", 2, 4, 16, 4)
+    with pytest.raises(ValueError, match="family"):
+        jaxpath.make_arena_spec("trie", 4, 4, 16, 4)
+    s = jaxpath.make_arena_spec("ctrie", 4, 8, 17, 4, node_rows=130)
+    assert s.entries == 32          # row bucket
+    assert s.node_rows == 256       # 128-row tiles
+    assert s.joined_rows == s.entries + 1
+
+
+def test_capacity_errors():
+    tabs = _tenants(1)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=4,
+                                  max_tenants=2)
+    al = jaxpath.ArenaAllocator(spec)
+    big = testing.random_tables(
+        np.random.default_rng(0), n_entries=4 * spec.entries, width=4
+    )
+    with pytest.raises(jaxpath.ArenaCapacityError):
+        al.load_tenant(0, big)
+    with pytest.raises(jaxpath.ArenaCapacityError):
+        al.load_tenant(99, tabs[0])  # tenant id out of range
+    al.load_tenant(0, tabs[0])
+    al.load_tenant(1, tabs[0])
+    # pages 4 but only 2 tenant ids; exhaust pages via staging
+    al.stage(tabs[0])
+    al.stage(tabs[0])
+    with pytest.raises(jaxpath.ArenaCapacityError, match="out of pages"):
+        al.stage(tabs[0])
+
+
+# --- mixed-tenant classify bit-identity -------------------------------------
+
+
+@pytest.mark.parametrize("family", ["dense", "ctrie"])
+def test_mixed_tenant_oracle_identity(family):
+    tabs = _tenants(5)
+    spec = jaxpath.arena_spec_for(family, tabs.values(), pages=8,
+                                  max_tenants=16)
+    al = jaxpath.ArenaAllocator(spec)
+    for t, tab in tabs.items():
+        assert al.load_tenant(t, tab) == "assign"
+    batch, tenant, want = _mixed(tabs)
+    results, xdp, _ = _classify_arena(
+        al, batch.pack_wire(), tenant, len(batch), np.asarray(batch.kind)
+    )
+    np.testing.assert_array_equal(results, want)
+    # unknown / absent tenant ids classify to UNDEF, never leak a slab
+    weird = np.array([99, -1, 7, 1000], np.int32)
+    r2, _x, _s = _classify_arena(
+        al, batch.pack_wire()[:4], weird, 4, np.asarray(batch.kind[:4])
+    )
+    assert (r2 == 0).all()
+
+
+def test_swap_destroy_compact():
+    tabs = _tenants(4)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=8,
+                                  max_tenants=8)
+    al = jaxpath.ArenaAllocator(spec)
+    for t, tab in tabs.items():
+        al.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs)
+    new1 = testing.random_tables(np.random.default_rng(77), n_entries=20,
+                                 width=4, v6_fraction=0.4)
+    page = al.stage(new1)
+    al.activate(1, page, new1)
+    per = len(batch) // 4
+    want2 = want.copy()
+    want2[per:2 * per] = oracle.classify(
+        new1, batch.slice(per, 2 * per)
+    ).results
+    results, _x, _s = _classify_arena(
+        al, batch.pack_wire(), tenant, len(batch), np.asarray(batch.kind)
+    )
+    np.testing.assert_array_equal(results, want2)
+    al.destroy_tenant(0)
+    results, _x, _s = _classify_arena(
+        al, batch.pack_wire(), tenant, len(batch), np.asarray(batch.kind)
+    )
+    assert (results[:per] == 0).all()
+    np.testing.assert_array_equal(results[per:], want2[per:])
+    # compaction repacks low pages; verdicts unchanged
+    moved = al.compact()
+    assert moved >= 1
+    results, _x, _s = _classify_arena(
+        al, batch.pack_wire(), tenant, len(batch), np.asarray(batch.kind)
+    )
+    np.testing.assert_array_equal(results[per:], want2[per:])
+    from infw.analysis.statecheck import check_arena
+
+    assert check_arena(al) == []
+
+
+def test_activate_free_list_consistency():
+    """Review regression: ping-pong re-activation between two pages
+    (the bench A/B and the standby-page pattern) must never leave a
+    page both free and mapped or duplicate free-list entries, and an
+    activate with no tables record must not let compact() rebake the
+    PRE-swap ruleset."""
+    from infw.analysis.statecheck import check_arena
+
+    tabs = _tenants(2)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=4,
+                                  max_tenants=4)
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, tabs[0])
+    pg_a = al.stage(tabs[1])
+    pg_b = al.page_of(0)
+    for i in range(5):  # ping-pong: claim back the freed page each flip
+        al.activate(0, pg_a if i % 2 == 0 else pg_b)
+        assert check_arena(al) == []
+        assert sorted(al._free) == sorted(set(al._free))
+    # odd flip count: tenant 0 ends on pg_a, the tabs[1] slab
+    # activating a page live for ANOTHER tenant must refuse
+    al.load_tenant(1, tabs[1])
+    with pytest.raises(jaxpath.ArenaCapacityError, match="live for tenant"):
+        al.activate(0, al.page_of(1))
+    # tables-less activate drops the stale record: compact leaves the
+    # tenant in place instead of rebaking the old ruleset
+    assert al.tables_of(0) is None
+    before = np.asarray(al.arena.page_table).copy()
+    al.compact()
+    assert check_arena(al) == []
+    b = testing.random_batch(np.random.default_rng(3), tabs[1], 48)
+    results, _x, _s = _classify_arena(
+        al, b.pack_wire(), np.zeros(48, np.int32), 48, np.asarray(b.kind)
+    )
+    np.testing.assert_array_equal(
+        results, oracle.classify(tabs[1], b).results
+    )
+
+
+def test_registry_concurrent_edit_during_create():
+    """Review regression: an edit racing a create must get a clean
+    TenantError (the name publishes only after the load succeeds),
+    never a None updater."""
+    from infw.syncer import TenantError, TenantRegistry
+
+    tabs = _tenants(1)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=4,
+                                  max_tenants=4)
+    reg = TenantRegistry(
+        ArenaClassifier(spec, interpret=True, fused_deep=False),
+        rule_width=4,
+    )
+    reg._creating["x"] = 0  # a create in flight
+    with pytest.raises(TenantError, match="unknown"):
+        reg.update_tenant("x", {}, [])
+    with pytest.raises(TenantError, match="exists"):
+        reg.create_tenant("x", {})
+    del reg._creating["x"]
+    reg.create_tenant("x", dict(tabs[0].content))
+    assert reg.tenant_id("x") == 0
+
+
+# --- per-slab incremental patch ---------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["dense", "ctrie"])
+def test_rules_only_patch_per_slab(family):
+    tab = testing.random_tables(np.random.default_rng(5), n_entries=24,
+                                width=4, v6_fraction=0.4)
+    upd = IncrementalTables.from_content(dict(tab.content), rule_width=4)
+    snap0 = upd.snapshot()
+    spec = jaxpath.arena_spec_for(family, [snap0], pages=4, max_tenants=4)
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, snap0)
+    upd.start_dirty_tracking()
+    k = sorted(snap0.content, key=lambda k: (k.ingress_ifindex, k.ip_data))[0]
+    r = np.asarray(snap0.content[k]).copy()
+    r[1] = [1, 6, 80, 0, 0, 0, 2]
+    upd.apply({k: r}, [])
+    hint = upd.peek_dirty()
+    snap1 = upd.snapshot()
+    assert al.load_tenant(0, snap1, hint=hint) == "patch"
+    # patched pool bit-identical to a fresh bake of the new snapshot
+    al2 = jaxpath.ArenaAllocator(spec)
+    al2.load_tenant(0, snap1)
+    for name in al._host:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(al.arena, name)),
+            np.asarray(getattr(al2.arena, name)),
+            err_msg=name,
+        )
+    b = testing.random_batch(np.random.default_rng(1), snap1, 64)
+    results, _x, _s = _classify_arena(
+        al, b.pack_wire(), np.zeros(64, np.int32), 64, np.asarray(b.kind)
+    )
+    np.testing.assert_array_equal(
+        results, oracle.classify(snap1, b).results
+    )
+
+
+# --- paged Pallas walk -------------------------------------------------------
+
+
+def test_pallas_arena_walk_bit_identity():
+    tabs = _tenants(4, v6=0.6)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=8,
+                                  max_tenants=8)
+    al = jaxpath.ArenaAllocator(spec)
+    for t, tab in tabs.items():
+        al.load_tenant(t, tab)
+    planes = pallas_walk.build_arena_cwalk_planes(al.host_nodes())
+    assert planes is not None
+    batch, tenant, want = _mixed(tabs)
+    fn = pallas_walk.jitted_classify_arena_cwalk_wire_fused(
+        spec.pages, spec.d_max, True
+    )
+    fused = fn(al.arena, planes, jax.device_put(batch.pack_wire()),
+               jax.device_put(tenant))
+    res16, _stats = jaxpath.split_wire_outputs(np.asarray(fused), len(batch))
+    results, _xdp = jaxpath.host_finalize_wire(
+        res16, np.asarray(batch.kind)
+    )
+    np.testing.assert_array_equal(results, want)
+
+
+def test_fused_planes_track_swaps_incrementally():
+    """Review regression: with the fused paged walk on, a tenant swap
+    must (a) refresh ONLY the written slab's plane rows (not O(pool)),
+    (b) refresh BEFORE the page-table flip, and the post-swap classify
+    must serve the NEW ruleset through the Pallas path."""
+    tabs = _tenants(3, v6=0.6)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=8,
+                                  max_tenants=8)
+    clf = ArenaClassifier(spec, interpret=True, fused_deep=True)
+    for t, tab in tabs.items():
+        clf.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs)
+    np.testing.assert_array_equal(
+        clf.classify_tenants(batch, tenant).results, want
+    )
+    planes_before = clf._planes
+    new1 = testing.random_tables(np.random.default_rng(88), n_entries=20,
+                                 width=4, v6_fraction=0.6)
+    clf.swap_tenant(1, new1)
+    # incremental path: a fresh planes array was scattered, not rebuilt
+    # from a zeroed pool (same shape, different object)
+    assert clf._planes is not planes_before
+    assert clf._planes.shape == planes_before.shape
+    per = len(batch) // 3
+    want2 = want.copy()
+    want2[per:2 * per] = oracle.classify(
+        new1, batch.slice(per, 2 * per)
+    ).results
+    np.testing.assert_array_equal(
+        clf.classify_tenants(batch, tenant).results, want2
+    )
+    # planes must also be bit-identical to a cold full-pool build
+    cold = pallas_walk.build_arena_cwalk_planes(clf.allocator.host_nodes())
+    np.testing.assert_array_equal(
+        np.asarray(clf._planes), np.asarray(cold)
+    )
+    clf.close()
+
+
+def test_scheduler_refuses_tenant_tags_on_plain_backend():
+    from infw.scheduler import ContinuousScheduler, FixedChunkPolicy
+
+    tab = _tenants(1)[0]
+    clf = TpuClassifier(interpret=True, fused_deep=False)
+    clf.load_tables(tab)
+    sched = ContinuousScheduler(clf, FixedChunkPolicy(16))
+    b = testing.random_batch(np.random.default_rng(0), tab, 16)
+    with pytest.raises(ValueError, match="tenant contract"):
+        sched.serve(b, np.zeros(16), tenant_of=np.zeros(16, np.int32))
+    clf.close()
+
+
+def test_pallas_arena_vmem_gate():
+    assert pallas_walk.build_arena_cwalk_planes(
+        np.zeros((1 << 20, 20), np.uint32), vmem_budget=1 << 20
+    ) is None
+
+
+# --- ArenaClassifier (production dispatch) ----------------------------------
+
+
+def test_arena_classifier_fused_and_overlay():
+    tabs = _tenants(3, v6=0.5)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=8,
+                                  max_tenants=8)
+    ov_spec = jaxpath.make_arena_spec("dense", 4, 8, 16, 4)
+    clf = ArenaClassifier(spec, overlay_spec=ov_spec, interpret=True,
+                          fused_deep=True)
+    for t, tab in tabs.items():
+        clf.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs)
+    out = clf.classify_tenants(batch, tenant)
+    np.testing.assert_array_equal(out.results, want)
+    # per-tenant overlay: tenant 0 gains a longer-prefix key via the
+    # dense side-pool; combine must pick it by prefix length
+    k0 = sorted(tabs[0].content, key=lambda k: -k.prefix_len)
+    merged = dict(tabs[0].content)
+    ov_rng = np.random.default_rng(3)
+    ov_tab = testing.random_tables(ov_rng, n_entries=4, width=4,
+                                   v6_fraction=0.0)
+    ov_content = {
+        k: v for k, v in ov_tab.content.items()
+        if k.masked_identity() not in
+        {kk.masked_identity() for kk in merged}
+    }
+    assert ov_content
+    clf.load_tenant_overlay(0, compile_tables_from_content(
+        ov_content, rule_width=4))
+    merged.update(ov_content)
+    combined = compile_tables_from_content(merged, rule_width=4)
+    b0 = testing.random_batch(np.random.default_rng(11), combined, 96)
+    out = clf.classify_tenants(b0, np.zeros(96, np.int32))
+    np.testing.assert_array_equal(
+        out.results, oracle.classify(combined, b0).results
+    )
+    # clearing the overlay restores the base table
+    clf.load_tenant_overlay(0, None)
+    out = clf.classify_tenants(b0, np.zeros(96, np.int32))
+    np.testing.assert_array_equal(
+        out.results, oracle.classify(tabs[0], b0).results
+    )
+    counters = clf.tenant_counters()
+    assert counters["tenant_active_slabs"] == 3
+    assert counters["tenant_0_packets_total"] > 0
+    # allow/deny orientation pinned against the oracle (review
+    # regression: the two were swapped): result action byte 2 = ALLOW,
+    # 1 = DENY
+    act = oracle.classify(tabs[0], b0).results & 0xFF
+    assert counters["tenant_0_allow_total"] >= int((act == 2).sum())
+    assert counters["tenant_0_deny_total"] >= int((act == 1).sum())
+    total_pk = counters["tenant_0_packets_total"]
+    assert counters["tenant_0_allow_total"] + counters[
+        "tenant_0_deny_total"
+    ] <= total_pk
+
+
+# --- zero-recompile warm-arena contract -------------------------------------
+
+
+def test_zero_recompiles_across_tenant_counts_and_lifecycle():
+    """The recompile lint (the scheduler/test_statecheck _cache_size
+    pattern): on a warm arena, growing the ACTIVE tenant count through
+    1/8/64 (dense additionally 512), hot-swapping, patching and
+    classifying must compile NOTHING new — every executable is keyed on
+    pool geometry, and the allocator warm ladder covers every scatter
+    shape the lifecycle can emit."""
+    # dense family at 512 tenants (slabs are small); ctrie at 64
+    cases = [("dense", 512 + 2, (1, 8, 64, 512)),
+             ("ctrie", 64 + 2, (1, 8, 64))]
+    for family, pages, counts in cases:
+        mk = lambda t: testing.random_tables(
+            np.random.default_rng(50 + (t % 2)), n_entries=12, width=4,
+            v6_fraction=0.3,
+        )
+        tabs = {0: mk(0), 1: mk(1)}
+        spec = jaxpath.arena_spec_for(
+            family, tabs.values(), pages=pages, max_tenants=pages,
+            headroom=2.0,
+        )
+        al = jaxpath.ArenaAllocator(spec)
+        d_max = spec.d_max if family == "ctrie" else 0
+        fn = jaxpath.jitted_classify_arena_wire_fused(
+            family, spec.pages, d_max
+        )
+        al.load_tenant(0, mk(0))
+        b = testing.random_batch(np.random.default_rng(1), tabs[0], 64)
+        wire = jax.device_put(b.pack_wire())
+
+        def classify(n_live):
+            tenant = jax.device_put(
+                (np.arange(64) % max(n_live, 1)).astype(np.int32)
+            )
+            np.asarray(fn(al.arena, wire, tenant))
+
+        classify(1)  # the one allowed compile of the classify factory
+        scatter0 = jaxpath._scatter_rows_jit()._cache_size()
+        fn0 = fn._cache_size()
+        loaded = 1
+        for n_live in counts:
+            while loaded < n_live:
+                al.load_tenant(loaded, mk(loaded))
+                loaded += 1
+            classify(n_live)
+        # lifecycle on the warm arena: swap, patch, destroy, classify
+        upd = IncrementalTables.from_content(
+            dict(mk(0).content), rule_width=4
+        )
+        al.swap_tenant(0, upd.snapshot())
+        upd.start_dirty_tracking()
+        k = list(upd.content)[0]
+        r = np.asarray(upd.content[k]).copy()
+        r[1] = [1, 6, 81, 0, 0, 0, 1]
+        upd.apply({k: r}, [])
+        hint = upd.peek_dirty()
+        assert al.load_tenant(0, upd.snapshot(), hint=hint) == "patch"
+        al.destroy_tenant(counts[-1] - 1)
+        classify(counts[-1] - 1)
+        assert fn._cache_size() == fn0, family
+        grew = jaxpath._scatter_rows_jit()._cache_size() - scatter0
+        assert grew == 0, (
+            f"{family}: {grew} scatter executable(s) compiled on the "
+            "warm arena lifecycle"
+        )
+
+
+# --- 8-iface mixed-ifindex regression (bugfix sweep) ------------------------
+
+
+def test_8iface_mixed_ifindex_as_tenants():
+    """The pre-arena multi-interface posture (BENCH_r04's 8-iface
+    mixed-ifindex path: ONE table keyed by ifindex) must be exactly
+    reproducible AS tenants on the arena — one tenant per interface,
+    each packet tagged with its interface's tenant — bit-identical
+    verdicts to the single-table mixed-ifindex classify."""
+    rng = np.random.default_rng(42)
+    ifaces = list(range(2, 10))
+    per_if = {}
+    content = {}
+    for i in ifaces:
+        t = testing.random_tables(
+            np.random.default_rng(1000 + i), n_entries=12, width=4,
+            v6_fraction=0.3, ifindexes=(i,),
+        )
+        per_if[i] = t
+        content.update(t.content)
+    combined = compile_tables_from_content(content, rule_width=4)
+    old_clf = TpuClassifier(interpret=True, force_path="trie",
+                            fused_deep=False)
+    old_clf.load_tables(combined)
+    spec = jaxpath.arena_spec_for("ctrie", per_if.values(), pages=12,
+                                  max_tenants=16)
+    al = jaxpath.ArenaAllocator(spec)
+    for j, i in enumerate(ifaces):
+        al.load_tenant(j, per_if[i])
+    parts = []
+    for i in ifaces:
+        parts.append(
+            testing.random_batch(np.random.default_rng(7 + i), per_if[i], 24)
+        )
+    batch = packets.concat(parts)
+    # the tenant column is DERIVED from each packet's ifindex — exactly
+    # how the old one-table mixed-ifindex path routes (random batches
+    # include noise packets on other interfaces; those must land in the
+    # interface-owning tenant's slab, or nowhere for unknown ifindexes)
+    ifx = np.asarray(batch.ifindex, np.int64)
+    tenant = np.where(
+        (ifx >= 2) & (ifx < 2 + len(ifaces)), ifx - 2, -1
+    ).astype(np.int32)
+    want = old_clf.classify(batch, apply_stats=False).results
+    results, xdp, _ = _classify_arena(
+        al, batch.pack_wire(), tenant, len(batch), np.asarray(batch.kind)
+    )
+    np.testing.assert_array_equal(results, want)
+    old_clf.close()
+
+
+# --- mesh ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rules_shards", [1, 2])
+def test_mesh_arena_parity(rules_shards):
+    from infw.backend.mesh import MeshArenaClassifier
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    tabs = _tenants(4)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=8,
+                                  max_tenants=8)
+    clf = MeshArenaClassifier(
+        spec, data_shards=4 // rules_shards, rules_shards=rules_shards
+    )
+    for t, tab in tabs.items():
+        clf.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs)
+    out = clf.classify_tenants(batch, tenant)
+    np.testing.assert_array_equal(out.results, want)
+    # hot swap through the replicated scatter path
+    new0 = testing.random_tables(np.random.default_rng(55), n_entries=16,
+                                 width=4, v6_fraction=0.3)
+    clf.swap_tenant(0, new0)
+    per = len(batch) // 4
+    want2 = want.copy()
+    want2[:per] = oracle.classify(new0, batch.slice(0, per)).results
+    out = clf.classify_tenants(batch, tenant)
+    np.testing.assert_array_equal(out.results, want2)
+    clf.close()
+
+
+def test_mesh_mixed_batch_64_tenants():
+    """The ISSUE-10 acceptance shape on the mesh: ONE mixed-tenant
+    classify batch over >= 64 tenants, bit-identical to the per-tenant
+    CPU oracles through the production mesh wire dispatch (dense
+    family keeps the 64-page pool cheap on the virtual CPU mesh)."""
+    from infw.backend.mesh import MeshArenaClassifier
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    tabs = _tenants(64, entries=8, v6=0.25)
+    spec = jaxpath.arena_spec_for("dense", tabs.values(), pages=66,
+                                  max_tenants=66)
+    clf = MeshArenaClassifier(spec, data_shards=4, rules_shards=2)
+    for t, tab in tabs.items():
+        clf.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs, per=8)
+    out = clf.classify_tenants(batch, tenant)
+    np.testing.assert_array_equal(out.results, want)
+    clf.close()
+
+
+# --- registry / scheduler / daemon integration ------------------------------
+
+
+def test_tenant_registry_lifecycle_and_events():
+    from infw.obs.events import EventRing, TenantSwapRecord
+    from infw.syncer import TenantError, TenantRegistry
+    from infw.txn import EditOp as TxnOp
+
+    tabs = _tenants(2)
+    ring = EventRing(capacity=64)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=6,
+                                  max_tenants=8)
+    reg = TenantRegistry(
+        ArenaClassifier(spec, interpret=True, fused_deep=False),
+        rule_width=4, event_ring=ring,
+    )
+    for t, tab in tabs.items():
+        reg.create_tenant(f"t{t}", dict(tab.content))
+    with pytest.raises(TenantError):
+        reg.create_tenant("t0", {})
+    with pytest.raises(TenantError):
+        reg.tenant_id("nope")
+    # folded per-tenant transaction through the production fold
+    k = sorted(tabs[0].content, key=lambda k: (k.ingress_ifindex,
+                                               k.ip_data))[0]
+    r = np.asarray(tabs[0].content[k]).copy()
+    r[1] = [1, 17, 53, 0, 0, 0, 2]
+    assert reg.apply_edit_transaction(
+        "t0", [TxnOp(kind="key_delete", key=k),
+               TxnOp(kind="key_add", key=k, rules=r)]
+    ) in ("patch", "rewrite")
+    snap = reg._updaters[reg.tenant_id("t0")].snapshot()
+    b = testing.random_batch(np.random.default_rng(9), snap, 64)
+    out = reg.classify_mixed(b, ["t0"] * 64)
+    np.testing.assert_array_equal(
+        out.results, oracle.classify(snap, b).results
+    )
+    reg.swap_tenant("t1", dict(tabs[0].content))
+    reg.destroy_tenant("t1")
+    kinds = [rec.kind for rec in ring.pop_all()
+             if isinstance(rec, TenantSwapRecord)]
+    assert kinds == ["create", "create", "swap", "destroy"]
+    c = reg.counter_values()
+    assert c["tenant_registered"] == 1
+    assert c["tenant_swaps_total"] == 1
+
+
+def test_scheduler_tenant_tagged_admissions():
+    from infw.scheduler import ContinuousScheduler, FixedChunkPolicy
+
+    tabs = _tenants(3)
+    spec = jaxpath.arena_spec_for("ctrie", tabs.values(), pages=6,
+                                  max_tenants=8)
+    clf = ArenaClassifier(spec, interpret=True, fused_deep=False)
+    for t, tab in tabs.items():
+        clf.load_tenant(t, tab)
+    batch, tenant, want = _mixed(tabs, per=32)
+    sched = ContinuousScheduler(clf, FixedChunkPolicy(48))
+    res = sched.serve(
+        batch, np.zeros(len(batch)), tenant_of=tenant
+    )
+    np.testing.assert_array_equal(res.results, want)
+    assert sched.stats.counter_values()[
+        "scheduler_admitted_packets_total"
+    ] == len(batch)
+    clf.close()
+
+
+def test_daemon_tenant_mode(tmp_path):
+    from infw.compiler import build_key
+    from infw.daemon import Daemon
+    from infw.packets import make_batch
+    from infw.txn import EditOp as TxnOp, write_edit_file
+
+    d = str(tmp_path)
+    dm = Daemon(state_dir=d, node_name="n1", tenants=4)
+    edits = os.path.join(d, "tenants", "acme", "edits")
+    os.makedirs(edits, exist_ok=True)
+    rules = np.zeros((16, 7), np.int32)
+    rules[1] = [1, 6, 443, 0, 0, 0, 2]
+    write_edit_file(
+        os.path.join(edits, "e1.json"),
+        [TxnOp(kind="key_add", key=build_key(2, "10.1.0.0/16"),
+               rules=rules)],
+    )
+    # a bad file is consumed, never wedging the scan
+    with open(os.path.join(edits, "bad.json"), "w") as f:
+        f.write("{not json")
+    assert dm.scan_tenant_edits_once() == 1
+    assert os.listdir(edits) == []
+    assert dm.tenant_registry.tenant_names() == ["acme"]
+    b = make_batch(src=["10.1.2.3", "10.2.0.1"], proto=[6, 6],
+                   ifindex=[2, 2], dst_port=[443, 443])
+    out = dm.tenant_registry.classify_mixed(b, ["acme", "acme"])
+    assert out.results.tolist() == [0x102, 0]  # deny rule 1; no match
+    text = dm.metrics_registry.render_text()
+    assert "tenant_active_slabs" in text
+
+
+def test_daemon_tenants_flag_validation(capsys):
+    from infw.daemon import main as daemon_main
+
+    with pytest.raises(SystemExit):
+        daemon_main(["--state-dir", "/tmp/x-infw-t", "--tenants", "0"])
+
+
+# --- statecheck arena configs + pageflip defect -----------------------------
+
+
+def test_statecheck_arena_configs():
+    from infw.analysis import statecheck
+
+    for cfg in ("arena", "arena-ctrie"):
+        rep = statecheck.run_config(cfg, seed=1, n_ops=5,
+                                    shrink_on_failure=False)
+        assert rep["ok"], rep
+
+
+def test_pageflip_defect_caught_and_shrunk():
+    from infw.analysis import statecheck
+    from infw.analysis.shrink import shrink_case
+
+    base, ops = statecheck.build_case("arena-ctrie", 0, 8)
+    assert any(op.kind == "tenant_swap" for op in ops)
+    jaxpath._INJECT_PAGEFLIP_BUG = True
+    try:
+        failure = statecheck.run_ops(base, ops, "arena-ctrie", seed=0)
+        assert failure is not None, "pageflip defect not caught"
+        repro = shrink_case(
+            base, list(ops), "arena-ctrie", failure,
+            witness_b=64, backend="tpu", seed=0, max_runs=24,
+        )
+        assert len(repro.ops) <= 3, repro.code()
+        assert "tenant_swap" in repro.code()
+    finally:
+        jaxpath._INJECT_PAGEFLIP_BUG = False
+    # clean run of the SAME case must pass (the defect flag is the only
+    # difference)
+    assert statecheck.run_ops(base, ops, "arena-ctrie", seed=0) is None

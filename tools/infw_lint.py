@@ -298,7 +298,8 @@ def cmd_jax(args) -> int:
 #: tripped placeholder regime.  dense/fused/mesh run in the pytest suite
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
-                         "ctrie-overlay", "txn", "txn-ctrie")
+                         "ctrie-overlay", "txn", "txn-ctrie", "arena",
+                         "arena-ctrie")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -324,6 +325,11 @@ def _run_inject_defect(args, as_json: bool) -> int:
         "joined-pad": (jaxpath, "_INJECT_JOINED_PAD_BUG", "nojoined", 3),
         "cskip": (jaxpath, "_INJECT_CSKIP_BUG", "ctrie", 3),
         "fold": (txn_mod, "_INJECT_FOLD_BUG", "txn", 2),
+        # stale page-table row after a tenant hot-swap (the arena's
+        # O(1) activation silently not landing on device): caught by
+        # the arena invariant/oracle layers, shrunk to the one
+        # tenant_swap op
+        "pageflip": (jaxpath, "_INJECT_PAGEFLIP_BUG", "arena-ctrie", 3),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
@@ -493,7 +499,7 @@ def main(argv=None) -> int:
                          help="witness batch size override")
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
-                         choices=("joined-pad", "cskip", "fold"),
+                         choices=("joined-pad", "cskip", "fold", "pageflip"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "
