@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
-	churn-bench native entry-check dryrun-multichip mesh-check \
-	spill-read wire-check lint static-check state-check clean
+	churn-bench flow-bench native entry-check dryrun-multichip \
+	mesh-check spill-read wire-check lint static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -61,7 +61,13 @@ lint:
 #      rebuild share the defect, so the catch must come from oracle
 #      divergence — proving the classify-equivalence half covers the
 #      skip-node path;
-#   3. the strict jax audit must FAIL on a deliberately injected
+#   3. --inject-defect flowstale drops the flow tier's generation-bump
+#      invalidation (infw.flow._INJECT_FLOW_STALE_BUG): a rule edit
+#      then leaves the exact-match flow cache serving the PRE-edit
+#      verdict — device state, host model and cold rebuild all agree,
+#      so the catch must be oracle divergence on the flow-path witness,
+#      shrunk to a (flow_traffic, rules_edit) pair;
+#   4. the strict jax audit must FAIL on a deliberately injected
 #      implicit host->device transfer (and pass without it — the plain
 #      strict audit runs in entry-check/static-check).
 # Must be green before any bench record is published (benchruns/README).
@@ -71,6 +77,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cskip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -146,10 +153,21 @@ churn-bench:
 tenant-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --tenant-bench
 
+# The stateful flow tier (bench.bench_flow) standalone at smoke scale
+# off-TPU: classify throughput at the 0/50/90/99% established-flow
+# ladder (flow tier vs the stateless baseline, interleaved, verdicts
+# oracle-gated bit-exact per rung), the eviction-storm line (flow table
+# much smaller than the flow population), and the zero-recompile warm
+# flow lifecycle — gated on the 90%-point speedup
+# (INFW_FLOW_SPEEDUP_MIN, default 1.15x).  The statecheck flow configs
+# run inside the gate BEFORE any record is published.
+flow-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --flow-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -2343,6 +2343,196 @@ def tenant_bench_main() -> int:
     return rc
 
 
+def bench_flow(rng, on_tpu):
+    """Stateful flow tier (``make flow-bench``, folded into
+    bench-checked):
+
+    - **hit-rate ladder**: classify throughput at 0/50/90/99%
+      established-flow traffic (testing.flow_trace_batch, chunk-aware)
+      through the flow-enabled classifier vs the stateless baseline on
+      the SAME tables — interleaved min-vs-min, each measured flow pass
+      from a cold table (FlowTier.reset) so the pass itself carries the
+      rung's real insert + hit mix; measured hit rate reported beside
+      each nominal rung (the TCP SYN -> EST handshake gate costs one
+      extra miss per TCP flow — what counts as a hit is a serve-eligible
+      established entry, see benchruns/README.md);
+    - **eviction-storm line**: the 90% trace against a flow table ~8x
+      smaller than the flow population — constant LRU displacement;
+    - **oracle gate**: every rung's verdicts checked bit-identical
+      against the stateless path before any timing line;
+    - **zero-recompile pin**: the probe/insert executable caches must
+      not grow across the measured passes (warm lifecycle contract).
+
+    Returns the record dict for the flow-bench gate
+    (INFW_FLOW_SPEEDUP_MIN at the 90% point)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+
+    out = {}
+    # a v6-heavy wide-rule table: the deep-walk regime the flow tier
+    # targets (stateless cost ~ trie depth x rule width; the probe is
+    # table-size-independent).  Shallow/cheap tables are the honest
+    # floor — the 0% rung reports the tier's overhead there.
+    n_entries = 200_000 if on_tpu else 50_000
+    n = 262_144 if on_tpu else 65_536
+    chunk = 4096
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.8,
+        ifindexes=(2, 3),
+    )
+    # sized to the worst-case flow population of the ladder (the 0%%
+    # rung is all-fresh): capacity pressure is the STORM line's job
+    fcfg = FlowConfig.make(entries=1 << 17 if on_tpu else 1 << 16)
+    clf = TpuClassifier(flow_table=fcfg)
+    base = TpuClassifier()
+    clf.load_tables(tables)
+    base.load_tables(tables)
+    clf.warm_flow_ladder([chunk])
+
+    def run_pass(c, batch, check_against=None):
+        n_div = 0
+        outs = []
+        for lo in range(0, len(batch), chunk):
+            outs.append(c.classify(batch.slice(lo, lo + chunk),
+                                   apply_stats=False))
+        if check_against is not None:
+            for o, want in zip(outs, check_against):
+                n_div += int(np.sum(o.results != want.results))
+        return outs, n_div
+
+    reps = 5 if on_tpu else 3
+    for ef in (0.0, 0.5, 0.9, 0.99):
+        batch, meta = testing.flow_trace_batch(
+            np.random.default_rng(7700 + int(ef * 100)), tables, n, ef,
+            chunk_packets=chunk,
+        )
+        # oracle bit-identity gate BEFORE any timing line: a full flow
+        # pass (cold -> warm, hits engaged) vs the stateless path
+        clf.flow.reset()
+        want, _ = run_pass(base, batch)
+        _, n_div = run_pass(clf, batch, check_against=want)
+        if n_div:
+            raise RuntimeError(
+                f"flow-bench oracle mismatch at ef={ef}: {n_div}/{n} "
+                "verdicts diverge from the stateless path"
+            )
+        # recompile pin: the measured passes below must be compile-free
+        probe_fn = jaxpath.jitted_flow_probe(fcfg.entries, fcfg.ways)
+        insert_fn = jaxpath.jitted_flow_insert(fcfg.entries, fcfg.ways)
+        cache0 = probe_fn._cache_size() + insert_fn._cache_size()
+
+        def flow_pass():
+            clf.flow.reset()
+            s0 = clf.flow.stats.values()
+            t0 = time.perf_counter()
+            run_pass(clf, batch)
+            dt = time.perf_counter() - t0
+            s1 = clf.flow.stats.values()
+            return dt, (s1["hits"] - s0["hits"]) / n
+
+        def base_pass():
+            t0 = time.perf_counter()
+            run_pass(base, batch)
+            return time.perf_counter() - t0
+
+        flow_s, base_s, hit_rate = float("inf"), float("inf"), 0.0
+        flow_pass()  # warm off the clock
+        base_pass()
+        for _ in range(reps):  # interleaved min-vs-min
+            dt, hr = flow_pass()
+            if dt < flow_s:
+                flow_s, hit_rate = dt, hr
+            base_s = min(base_s, base_pass())
+        grew = (probe_fn._cache_size() + insert_fn._cache_size()) - cache0
+        if grew:
+            raise RuntimeError(
+                f"flow-bench recompile on the warm lifecycle at ef={ef}: "
+                f"probe/insert cache grew by {grew}"
+            )
+        speedup = base_s / max(flow_s, 1e-9)
+        pct = int(ef * 100)
+        log(f"flow ladder ef={pct}%: {n/flow_s/1e6:.2f} M pkts/s flow "
+            f"(measured hit rate {hit_rate:.3f}, {meta['n_flows']} flows) "
+            f"vs {n/base_s/1e6:.2f} M pkts/s stateless "
+            f"({speedup:.2f}x)")
+        emit(f"flow-tier classify @{pct}% established", n / flow_s,
+             "packets/s", vs_baseline=0.0)
+        emit(f"stateless classify @{pct}% established baseline",
+             n / base_s, "packets/s", vs_baseline=0.0)
+        emit(f"flow-tier speedup @{pct}% established", speedup, "x",
+             vs_baseline=0.0)
+        out[f"speedup_{pct}"] = float(speedup)
+        out[f"hit_rate_{pct}"] = float(hit_rate)
+    clf.close()
+
+    # -- eviction storm: flow table ~8x smaller than the population ---------
+    batch, meta = testing.flow_trace_batch(
+        np.random.default_rng(7790), tables, n, 0.9, chunk_packets=chunk
+    )
+    small = FlowConfig.make(entries=max(meta["n_flows"] // 8, 64))
+    sclf = TpuClassifier(flow_table=small)
+    sclf.load_tables(tables)
+    sclf.warm_flow_ladder([chunk])
+    want, _ = run_pass(base, batch)
+    _, n_div = run_pass(sclf, batch, check_against=want)
+    if n_div:
+        raise RuntimeError(
+            f"flow-bench oracle mismatch under eviction storm: {n_div}"
+        )
+    sclf.flow.reset()
+    t0 = time.perf_counter()
+    run_pass(sclf, batch)
+    storm_s = time.perf_counter() - t0
+    v = sclf.flow.stats.values()
+    log(f"flow eviction storm ({small.capacity} slots, "
+        f"{meta['n_flows']} flows): {n/storm_s/1e6:.2f} M pkts/s, "
+        f"{v['evictions']} evictions, hit rate {v['hits']/(2*n):.3f}")
+    emit("flow-tier classify under eviction storm", n / storm_s,
+         "packets/s", vs_baseline=0.0)
+    emit("flow eviction-storm displacements", float(v["evictions"]),
+         "evictions", vs_baseline=0.0)
+    out["storm_evictions"] = float(v["evictions"])
+    sclf.close()
+    base.close()
+    return out
+
+
+def flow_bench_main() -> int:
+    """``make flow-bench``: the stateful flow tier standalone (CPU smoke
+    off TPU) with the regression gate — flow-tier classify at the 90%
+    established-flow point must beat the stateless baseline by
+    INFW_FLOW_SPEEDUP_MIN (default 1.15x; the verdict-bit-identity
+    oracle gate and the zero-recompile pin run inside the tier).  The
+    statecheck flow equivalence configs run FIRST and gate record
+    publication, mirroring the churn/tenant-bench discipline."""
+    speedup_min = float(os.environ.get("INFW_FLOW_SPEEDUP_MIN", "1.15"))
+    from infw.analysis import statecheck
+
+    for cfg in ("flow", "flow-ctrie"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        if not rep["ok"]:
+            log(f"flow-bench FAIL: statecheck {cfg} not green before "
+                f"record publication: {rep['failure']}")
+            return 1
+        log(f"flow-bench: statecheck {cfg} green "
+            f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_flow(rng, on_tpu)
+    emit_compact_record()
+    rc = 0
+    if not rec.get("speedup_90", 0.0) >= speedup_min:
+        log(f"flow-bench FAIL: 90%-point speedup "
+            f"{rec.get('speedup_90', 0):.2f}x < gate {speedup_min}x")
+        rc = 1
+    if rc == 0:
+        log("flow-bench OK: " + ", ".join(
+            f"{k}={v:.3f}" for k, v in sorted(rec.items())
+        ))
+    return rc
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -2645,6 +2835,15 @@ def main():
         bench_tenant(rng, on_tpu)
     except Exception as e:
         log(f"tenant tier FAILED: {e}")
+    try:
+        # ISSUE-11 stateful flow tier: classify throughput at the
+        # 0/50/90/99% established-flow ladder vs the stateless
+        # baseline, eviction-storm line, oracle + zero-recompile gated
+        # (also standalone as `bench.py --flow-bench`, `make
+        # flow-bench`, with the 90%-point speedup gate)
+        bench_flow(rng, on_tpu)
+    except Exception as e:
+        log(f"flow tier FAILED: {e}")
 
     # Truncation-proof record: every tier's metric line again in one
     # contiguous block, then ONE compact single-line JSON holding the
@@ -2671,4 +2870,6 @@ if __name__ == "__main__":
         sys.exit(churn_bench_main())
     if "--tenant-bench" in sys.argv:
         sys.exit(tenant_bench_main())
+    if "--flow-bench" in sys.argv:
+        sys.exit(flow_bench_main())
     sys.exit(main())

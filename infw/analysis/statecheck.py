@@ -104,6 +104,19 @@ TENANT_KINDS = (
     "tenant_destroy",  # page freed, tenant lanes -> UNDEF
 )
 
+#: stateful flow tier ops (flow configs only): ``flow_traffic`` drives
+#: one seeded packet batch TWICE through the production flow-tier
+#: classify (pass 1 populates — insert; pass 2 serves — hit), checking
+#: both passes against the CPU oracle over the per-op ground truth, so
+#: a stale cached verdict after any table edit diverges immediately;
+#: ``flow_age`` runs the epoch-based age/evict sweep.  Batches derive
+#: from the BASE content tables (not the evolving model), so one
+#: flow_seed always replays byte-identical packets — the substrate the
+#: flowstale injected-defect acceptance shrinks on.  Insert/evict paths
+#: are additionally pinned by the device-vs-HostFlowModel bit-identity
+#: compare at every settled check.
+FLOW_KINDS = ("flow_traffic", "flow_age")
+
 #: explicit transaction-boundary record (txn-mode configs only): the
 #: driver buffers single-key ops and applies them as ONE folded
 #: transaction (infw.txn.fold_ops) at each boundary — checks run only
@@ -132,9 +145,19 @@ class EditOp:
     #: creates/swaps/destroys (tenant ops).  Ignored by the
     #: single-tenant driver, so plain-config repros stay unchanged.
     tenant: int = 0
+    #: flow configs: the seeded witness-stream id of a flow_traffic op
+    #: (identical seeds replay byte-identical packet batches) and its
+    #: packet count.  Zero for every other kind, so non-flow repros
+    #: print unchanged.
+    flow_seed: int = 0
+    count: int = 0
 
     def describe(self) -> str:
         tag = f"@t{self.tenant}" if self.tenant else ""
+        if self.kind == "flow_traffic":
+            return f"flow_traffic(seed={self.flow_seed}, n={self.count})"
+        if self.kind == "flow_age":
+            return "flow_age"
         if self.kind in ("full_replace", TXN_FLUSH):
             return self.kind + tag
         if self.kind in TENANT_KINDS:
@@ -159,6 +182,10 @@ class EditOp:
             parts.append(f"items=({items},)")
         if self.tenant:
             parts.append(f"tenant={self.tenant}")
+        if self.flow_seed:
+            parts.append(f"flow_seed={self.flow_seed}")
+        if self.count:
+            parts.append(f"count={self.count}")
         return f"statecheck.EditOp({', '.join(parts)})"
 
 
@@ -222,6 +249,11 @@ class StateConfig:
     #: an edit leaking across slabs diverges some OTHER tenant's lanes)
     arena: str = ""
     tenants: int = 3
+    #: > 0 = stateful flow tier enabled with this many slab entries:
+    #: the op alphabet extends with FLOW_KINDS, the classifier runs
+    #: with flow_table + the shadow HostFlowModel, and every settled
+    #: check adds the device-vs-model flow-column bit-identity pass
+    flow: int = 0
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -273,6 +305,22 @@ CONFIGS: Dict[str, StateConfig] = {
                     force_path=None, witness_b=144),
         StateConfig("arena-ctrie", arena="ctrie", n_entries=36, width=4,
                     force_path="ctrie", witness_b=144),
+        # stateful flow tier (ISSUE-11): the FLOW_KINDS alphabet over
+        # the edit state machine — flow hits must stay bit-identical to
+        # the stateless path across inserts, evictions (the tiny table
+        # forces LRU pressure), aging, and the generation-bump
+        # invalidation every table edit applies.  The flowstale
+        # injected-defect acceptance (infw_lint state --inject-defect
+        # flowstale) runs "flow" under the dropped-invalidation bug.
+        # capacity 4096 > the op-horizon insert volume (~160 witness
+        # inserts per settled check): traffic-stream entries must
+        # SURVIVE across intervening edits or the staleness surface
+        # (and the flowstale acceptance) is never exercised; way
+        # conflicts + the flow_age ops still drive evictions, which
+        # the device-vs-model compare pins at every occupancy
+        StateConfig("flow", flow=4096, witness_b=160),
+        StateConfig("flow-ctrie", force_path="ctrie", flow=4096,
+                    witness_b=160),
     )
 }
 
@@ -382,6 +430,22 @@ def generate_ops(
             ops.append(EditOp(kind=TXN_FLUSH))
 
     for _ in range(n_ops):
+        if config.flow:
+            r = rng.random()
+            if r < 0.40:
+                # a SMALL seed pool on purpose: repeated seeds replay
+                # byte-identical batches, so cached verdicts from an
+                # earlier traffic op get re-served after intervening
+                # edits — exactly the staleness surface under check
+                ops.append(EditOp(
+                    kind="flow_traffic",
+                    flow_seed=int(rng.integers(1, 3)),
+                    count=64,
+                ))
+                continue
+            if r < 0.48:
+                ops.append(EditOp(kind="flow_age"))
+                continue
         kind = str(rng.choice(kinds, p=probs))
         if kind in ("rules_edit", "order_change", "key_delete") and not keys:
             kind = "key_add"
@@ -942,6 +1006,16 @@ class _Driver:
             k.masked_identity(): (k, np.asarray(v))
             for k, v in base_content.items()
         }
+        flow_kw = {}
+        if config.flow:
+            from ..flow import FlowConfig
+
+            # a deliberately TINY table so the op horizon exercises LRU
+            # eviction, plus the shadow model for the bit-identity pass
+            flow_kw = {
+                "flow_table": FlowConfig.make(entries=config.flow),
+                "flow_track_model": True,
+            }
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 
@@ -949,14 +1023,27 @@ class _Driver:
             self.clf = MeshTpuClassifier(
                 data_shards=data, rules_shards=1, interpret=True,
                 force_path=config.force_path, fused_deep=config.fused_deep,
+                **flow_kw,
             )
         else:
             from ..backend.tpu import TpuClassifier
 
             self.clf = TpuClassifier(
                 interpret=True, force_path=config.force_path,
-                fused_deep=config.fused_deep,
+                fused_deep=config.fused_deep, **flow_kw,
             )
+        #: flow configs: traffic batches derive from the BASE content
+        #: tables (never the evolving model), so one flow_seed replays
+        #: byte-identical packets across the whole sequence — cached
+        #: verdicts from an earlier traffic op get re-probed after
+        #: intervening edits; the oracle side always uses the CURRENT
+        #: ground truth, so a stale serve diverges
+        self._flow_base = (
+            compile_tables_from_content(
+                dict(base_content), rule_width=config.width
+            ) if config.flow else None
+        )
+        self._flow_failure: Optional[Failure] = None
         self.snapshot: Optional[CompiledTables] = None
         try:
             self._load()
@@ -1028,6 +1115,9 @@ class _Driver:
         was buffered into a pending transaction (txn-mode bounded
         staleness: un-flushed ops are intentionally not yet visible)."""
         self._model_update(op)
+        if op.kind in FLOW_KINDS:
+            self._apply_flow(op)
+            return True
         if self.config.txn:
             if op.kind == TXN_FLUSH:
                 self.flush_pending()
@@ -1045,7 +1135,7 @@ class _Driver:
         return True
 
     def _model_update(self, op: EditOp) -> None:
-        if op.kind in (TXN_FLUSH, "full_replace"):
+        if op.kind in (TXN_FLUSH, "full_replace") or op.kind in FLOW_KINDS:
             return
         if op.kind == "overlay_spill":
             for k, r in op.items:
@@ -1146,6 +1236,108 @@ class _Driver:
             self._apply_main(ups, [])
             return
         self._apply_main({op.key: op.rules}, [])
+
+    def _flow_batch(self, op: EditOp):
+        """The seeded witness stream of one flow_traffic op: packets
+        biased at the BASE tables' keys, with a deterministic TCP-flags
+        mix (mid-stream ACKs dominate so TCP flows establish; a tail of
+        pure SYNs / FINs / RSTs exercises the NEW/FIN/teardown arcs)."""
+        from .. import testing
+
+        rng = np.random.default_rng(
+            [_WITNESS_SALT, self.seed, 0x51, op.flow_seed]
+        )
+        batch = testing.random_batch(
+            rng, self._flow_base, max(op.count, 8)
+        )
+        r = rng.random(len(batch))
+        flags = np.full(len(batch), jaxpath.TCP_ACK, np.int32)
+        flags[r < 0.15] = jaxpath.TCP_SYN
+        flags[r >= 0.93] = jaxpath.TCP_FIN | jaxpath.TCP_ACK
+        flags[r >= 0.98] = jaxpath.TCP_RST
+        batch.tcp_flags = flags
+        return batch
+
+    def _apply_flow(self, op: EditOp) -> None:
+        """Drive the production flow path: flow_traffic classifies its
+        seeded batch TWICE (populate, then serve) with both passes
+        checked against the CPU oracle over the per-op ground truth —
+        THE place a stale cached verdict surfaces; flow_age runs the
+        epoch sweep (horizon 0: everything not touched this epoch)."""
+        from .. import oracle
+
+        if self._flow_failure is not None:
+            return
+        if op.kind == "flow_age":
+            # a few ops' worth of probe epochs: genuinely idle streams
+            # reclaim, recently-replayed ones survive — horizon 0 would
+            # wipe the table and erase the staleness surface the
+            # flowstale acceptance must find
+            self.clf.flow_age_tick(horizon=24)
+            return
+        batch = self._flow_batch(op)
+        merged = {k: r for (k, r) in self.model.values()}
+        model = compile_tables_from_content(
+            merged, rule_width=self.config.width
+        )
+        ref = oracle.classify(model, batch)
+        from ..testing import stats_dict_from_array
+
+        for pass_i in range(2):
+            out = self.clf.classify(batch, apply_stats=False)
+            if not np.array_equal(out.results, ref.results):
+                bad = np.nonzero(out.results != ref.results)[0]
+                i = int(bad[0])
+                self._flow_failure = Failure(
+                    -1, "flow-classify",
+                    f"{len(bad)}/{len(batch)} flow_traffic verdict(s) "
+                    f"diverge from the CPU oracle on pass {pass_i + 1} "
+                    f"(seed {op.flow_seed})",
+                    f"first at packet {i}: got {int(out.results[i]):#x}, "
+                    f"oracle {int(ref.results[i]):#x}",
+                )
+                return
+            if stats_dict_from_array(out.stats_delta) != ref.stats:
+                self._flow_failure = Failure(
+                    -1, "flow-stats",
+                    f"flow_traffic statistics diverge on pass "
+                    f"{pass_i + 1} (seed {op.flow_seed})",
+                )
+                return
+
+    def _check_flow(self, step: int) -> Optional[Failure]:
+        """Device flow columns vs the shadow HostFlowModel, bit for
+        bit — every probe/insert/age the production path dispatched was
+        mirrored, so any divergence is a kernel/model semantics drift
+        (or a dropped device write)."""
+        if self._flow_failure is not None:
+            f = self._flow_failure
+            return Failure(step, f.phase, f.message, f.detail)
+        tier = getattr(self.clf, "flow", None)
+        if tier is None or tier.model is None:
+            return None
+        cols = tier.flow_columns()
+        mcols = tier.model.columns()
+        for name, dev_arr in cols.items():
+            want = mcols[name]
+            if not np.array_equal(dev_arr, want):
+                rows = np.nonzero(
+                    np.asarray(dev_arr).reshape(dev_arr.shape[0], -1)
+                    != np.asarray(want).reshape(want.shape[0], -1)
+                )[0]
+                return Failure(
+                    step, "flow-model",
+                    f"device flow column {name!r} diverged from the "
+                    f"host model ({len(np.unique(rows))} row(s))",
+                    f"first at slot {int(rows[0])}",
+                )
+        with tier._lock:
+            if not np.array_equal(tier._gens_host, tier.model.gens):
+                return Failure(
+                    step, "flow-model",
+                    "flow generation vector diverged from the host model",
+                )
+        return None
 
     # -- checks --------------------------------------------------------------
 
@@ -1299,7 +1491,7 @@ class _Driver:
                            "witness statistics diverge from the oracle",
                            f"got {stats_dict_from_array(stats)}, "
                            f"want {ref.stats}")
-        return None
+        return self._check_flow(step)
 
 
 def run_ops(

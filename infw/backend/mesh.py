@@ -146,6 +146,21 @@ class MeshTpuClassifier(TpuClassifier):
         #: one (the syncer merges instead)
         self.supports_overlay = self._rules_shards == 1
 
+    def _make_flow_tier(self, cfg, track_model: bool = False):
+        """Place the flow-tier columns by the declared partition rules
+        (parallel.mesh.FLOW_PARTITION_RULES): flow rows shard over
+        "rules" when the capacity divides the axis, the steering state
+        replicates, and the probe/insert dispatches run under the same
+        jitted factories as the single chip — GSPMD, no mesh-specific
+        flow kernel."""
+        from ..flow import FlowTier
+
+        return FlowTier(
+            cfg, device=self._replicated,
+            shardings=meshmod.flow_shardings(self._mesh, cfg.capacity),
+            track_model=track_model,
+        )
+
     @property
     def mesh(self) -> Mesh:
         return self._mesh
@@ -224,6 +239,10 @@ class MeshTpuClassifier(TpuClassifier):
                 steer_parts + (self._depth_gen,)
                 if steer_parts is not None else None
             )
+        if self._flow is not None:
+            # the sharded load path bypasses super().load_tables — the
+            # flow invalidation chokepoint must still fire here
+            self._flow.bump_generation(0)
 
     # -- dispatch -----------------------------------------------------------
 

@@ -61,6 +61,8 @@ class TpuClassifier:
         decode_pallas: Optional[bool] = None,
         check_invariants: Optional[bool] = None,
         compressed: Optional[bool] = None,
+        flow_table=None,
+        flow_track_model: bool = False,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -127,6 +129,26 @@ class TpuClassifier:
                 compressed = env not in ("0", "false", "no")
         self._compressed = bool(compressed) or force_path == "ctrie"
         self._lock = threading.Lock()
+        # Stateful flow tier (infw.flow, the --flow-table knob): a
+        # device-resident exact-match verdict cache probed before the
+        # LPM + rule scan; hits serve the cached verdict in the probe
+        # dispatch and only the (compacted) misses fall through to the
+        # stateless classify below.  Precedence mirrors the other knobs:
+        # constructor arg (FlowConfig or an entry count) > the
+        # INFW_FLOW_TABLE env (entry count) > off.
+        if flow_table is None:
+            env = os.environ.get("INFW_FLOW_TABLE", "")
+            if env and env not in ("0", "false", "no"):
+                flow_table = int(env)
+        self._flow = None
+        if flow_table is not None and flow_table is not False:
+            from ..flow import FlowConfig, FlowTier
+
+            if not isinstance(flow_table, FlowConfig):
+                flow_table = FlowConfig.make(entries=int(flow_table))
+            self._flow = self._make_flow_tier(
+                flow_table, track_model=flow_track_model
+            )
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
         # bench reads this to put bytes/packet in the replay record
@@ -399,8 +421,43 @@ class TpuClassifier:
                 steer_parts + (self._depth_gen,)
                 if steer_parts is not None else None
             )
+        if self._flow is not None:
+            # THE invalidation chokepoint: every table mutation — the
+            # incremental patch, a folded txn flush, a full rebuild, an
+            # overlay change — flows through load_tables, so one
+            # generation bump here guarantees no resident flow entry
+            # can serve a verdict computed against superseded tables.
+            self._flow.bump_generation(0)
         if defer_walk:
             self._spawn_walk_rebuild(tables, steer_parts[2], path == "ctrie")
+
+    def _make_flow_tier(self, cfg, track_model: bool = False):
+        """Flow-tier factory (the mesh subclass overrides to place the
+        flow columns by the declared partition rules)."""
+        from ..flow import FlowTier
+
+        return FlowTier(cfg, device=self._device, track_model=track_model)
+
+    @property
+    def flow(self):
+        """The FlowTier when the stateful flow tier is enabled."""
+        return self._flow
+
+    def flow_counters(self):
+        """flow_* counters + occupancy gauge for /metrics (empty when
+        the tier is off)."""
+        return {} if self._flow is None else self._flow.counter_values()
+
+    def flow_age_tick(self, horizon=None) -> int:
+        """Run one epoch-based age sweep (the daemon's idle-loop
+        maintenance); returns entries reclaimed."""
+        return 0 if self._flow is None else self._flow.age(horizon)
+
+    def warm_flow_ladder(self, ladder) -> int:
+        """Pre-compile the probe/insert executables across the batch
+        ladder (called by scheduler.prewarm_ladder), so the warm flow
+        lifecycle performs zero jit compiles on the serving path."""
+        return 0 if self._flow is None else self._flow.warm(ladder)
 
     def _run_invariant_check(self, dev, ov_dev) -> None:
         """Opt-in deep invariant pass (INFW_CHECK_INVARIANTS=1 /
@@ -578,6 +635,16 @@ class TpuClassifier:
         v4_only = not bool((kind == KIND_IPV6).any())
         compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
         wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
+        if self._flow is not None:
+            # flow tier first: the probe serves established flows and
+            # only misses fall through to the stateless dispatch
+            return self.classify_prepared(
+                self.prepare_packed(
+                    wire_np, v4_only,
+                    tcp_flags=getattr(batch, "tcp_flags", None),
+                ),
+                apply_stats=apply_stats,
+            )
         return self._dispatch_wire(
             path, dev, block_b, wire_np, v4_only, kind, apply_stats,
             ov_dev=ov_dev,
@@ -633,7 +700,7 @@ class TpuClassifier:
 
     def classify_async_packed(
         self, wire_np: np.ndarray, v4_only: bool, apply_stats: bool = True,
-        depth=None,
+        depth=None, tcp_flags: Optional[np.ndarray] = None,
     ) -> PendingClassify:
         # ``depth`` is the (class, generation) pair from v6_depth_groups;
         # a generation mismatch (table swapped since grouping) falls back
@@ -644,11 +711,13 @@ class TpuClassifier:
         is True for the current table generation; kind is recovered from
         wire w0 for the host-side XDP rebuild."""
         return self.classify_prepared(
-            self.prepare_packed(wire_np, v4_only, depth=depth),
+            self.prepare_packed(wire_np, v4_only, depth=depth,
+                                tcp_flags=tcp_flags),
             apply_stats=apply_stats,
         )
 
-    def prepare_packed(self, wire_np: np.ndarray, v4_only: bool, depth=None):
+    def prepare_packed(self, wire_np: np.ndarray, v4_only: bool, depth=None,
+                       tcp_flags: Optional[np.ndarray] = None):
         """First half of classify_async_packed: choose the wire format
         (delta / wire8 / narrow / full per the codec knob and chunk
         eligibility) and START the H2D copy of the chosen payload,
@@ -658,6 +727,27 @@ class TpuClassifier:
         compute; the plan snapshots the table generation at prepare
         time — in-flight plans finish on the tables they were staged
         against (the double-buffer swap contract)."""
+        flow_probe = None
+        if self._flow is not None and wire_np.shape[1] in (4, 7):
+            # Flow tier engaged: dispatch the fused probe NOW (its H2D
+            # + kernel overlap other in-flight work).  The probe MUST
+            # run BEFORE the stateless snapshot below: it captures the
+            # flow generation vector, and a concurrent load_tables
+            # between the two capture points can then only make the
+            # stamped generation OLDER than the tables that compute the
+            # miss verdicts — those inserts are stale on arrival
+            # (safe).  The reverse order would stamp old-table verdicts
+            # with the NEW generation and serve them as live (the
+            # flowstale bug, raced into existence).
+            with self._lock:
+                probe_ok = self._active is not None and not self._active[3]
+            if probe_ok:
+                fused, ctx = self._flow.probe(wire_np, tflags_np=tcp_flags)
+                try:
+                    fused.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+                flow_probe = (fused, ctx)
         with self._lock:
             if self._active is None:
                 raise RuntimeError("no rule tables loaded")
@@ -681,6 +771,15 @@ class TpuClassifier:
                 # (its extraction threshold came from the same class
                 # list this grouping used — the gen token proves it)
                 use_walk = walk_dev
+        if flow_probe is not None:
+            fused, ctx = flow_probe
+            return {
+                "flow": True, "fused": fused, "ctx": ctx,
+                "wire_np": wire_np, "tcp_flags": tcp_flags,
+                "path": path, "dev": dev, "block_b": block_b,
+                "ov_dev": ov_dev, "depth": d, "walk_dev": use_walk,
+                "v4_only": v4_only, "kind": kind, "n": wire_np.shape[0],
+            }
         return self._plan_wire(
             path, dev, block_b, wire_np, v4_only, kind,
             ov_dev=ov_dev, depth=d, walk_dev=use_walk,
@@ -688,7 +787,82 @@ class TpuClassifier:
 
     def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
         """Second half: launch the classify on a prepare_packed plan."""
+        if plan.get("flow"):
+            return self._launch_flow(plan, apply_stats)
         return self._launch_wire(plan, apply_stats)
+
+    def _launch_flow(self, plan, apply_stats: bool) -> PendingClassify:
+        """Complete a flow-tier plan: decode the probe's fused buffer,
+        serve the hit lanes from the cache, fall the compacted misses
+        through the stateless dispatch (same snapshot), merge, and
+        batch-insert the fresh verdicts.  Verdict bit-identity with the
+        stateless path is the invariant: the key covers every
+        verdict-relevant field and a hit requires a live generation, so
+        hit lanes return exactly what the LPM+scan would."""
+        from .. import flow as flow_mod
+
+        tier = self._flow
+        n = plan["n"]
+        wire_np = plan["wire_np"]
+        tcp_flags = plan["tcp_flags"]
+        kind = plan["kind"]
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16, hitmask, hits, stale = jaxpath.split_flow_probe_outputs(
+                np.asarray(plan["fused"]), n
+            )
+            tier.stats.add(hits=hits, misses=n - hits,
+                           stale_rejects=stale)
+            res16 = res16.copy()
+            # hit-lane statistics derive host-side from res16 + the
+            # pkt_len column of the 4/7-word wire (the wire8 readback
+            # contract) — the probe ships no stats tensor
+            pl = (
+                ((wire_np[:, 1] >> 16) & 0xFFFF)
+                | ((wire_np[:, 0] >> 27) << 16)
+            ).astype(np.int64)
+            stats_delta = stats_from_results(res16.astype(np.uint32), pl)
+            miss = np.nonzero(~hitmask)[0]
+            if len(miss):
+                m = len(miss)
+                bucket = flow_mod.flow_miss_bucket(m)
+                miss_wire = wire_np[miss]
+                if bucket > m:
+                    pad = np.zeros(
+                        (bucket - m, miss_wire.shape[1]), np.uint32
+                    )
+                    pad[:, 0] = 3  # KIND_OTHER: PASS, no stats
+                    miss_wire = np.concatenate([miss_wire, pad])
+                sub_kind = (miss_wire[:, 0] & 3).astype(np.int32)
+                out = self._launch_wire(
+                    self._plan_wire(
+                        plan["path"], plan["dev"], plan["block_b"],
+                        miss_wire, plan["v4_only"], sub_kind,
+                        ov_dev=plan["ov_dev"], depth=plan["depth"],
+                        walk_dev=plan["walk_dev"],
+                    ),
+                    apply_stats=False,
+                ).result()
+                res16[miss] = (out.results[:m] & 0xFFFF).astype(np.uint16)
+                stats_delta += out.stats_delta
+                verdicts = np.zeros(miss_wire.shape[0], np.uint32)
+                verdicts[:m] = out.results[:m] & 0xFFFF
+                mflags = None
+                if tcp_flags is not None:
+                    mflags = np.zeros(miss_wire.shape[0], np.int32)
+                    mflags[:m] = np.asarray(tcp_flags, np.int32)[miss]
+                tier.insert(plan["ctx"], miss_wire, verdicts,
+                            tflags_np=mflags)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
 
     def _note_wire(self, fmt: str, n: int, nbytes: int) -> None:
         with self._lock:
@@ -1043,6 +1217,8 @@ class ArenaClassifier:
         interpret: Optional[bool] = None,
         fused_deep: Optional[bool] = None,
         check_invariants: Optional[bool] = None,
+        flow_table=None,
+        flow_track_model: bool = False,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._interpret = (
@@ -1076,6 +1252,29 @@ class ArenaClassifier:
         # paged Pallas walk planes, rebuilt when the node pool moves
         self._planes = None
         self._planes_gen = -1
+        # Per-tenant flow slabs (infw.flow): one flow slab per ARENA
+        # page, steered by the same tenant -> page mapping that steers
+        # classification; the flow key embeds the tenant id, so slab
+        # reuse across tenants can never serve a foreign verdict.
+        if flow_table is None:
+            env = os.environ.get("INFW_FLOW_TABLE", "")
+            if env and env not in ("0", "false", "no"):
+                flow_table = int(env)
+        self._flow = None
+        if flow_table is not None and flow_table is not False:
+            from ..flow import FlowConfig, FlowTier
+
+            if isinstance(flow_table, FlowConfig):
+                fcfg = flow_table._replace(
+                    pages=spec.pages, max_tenants=spec.max_tenants
+                )
+            else:
+                fcfg = FlowConfig.make(
+                    entries=int(flow_table), pages=spec.pages,
+                    max_tenants=spec.max_tenants,
+                )
+            self._flow = FlowTier(fcfg, device=self._device,
+                                  track_model=flow_track_model)
         self._closed = False
         if self._fused_deep:
             self._refresh_planes()
@@ -1106,6 +1305,7 @@ class ArenaClassifier:
             # nothing to pair
             path = self._alloc.load_tenant(tenant, tables, hint=hint)
             self._after_mutation()
+            self._flow_note(tenant)
             return path
         # fused planes live: a structural install must not let a
         # classify pair the NEW page table with stale planes — route
@@ -1119,10 +1319,12 @@ class ArenaClassifier:
             # pool (keep >= 1 free page when serving the fused walk)
             path = self._alloc.load_tenant(tenant, tables, hint=hint)
             self._after_mutation()
+            self._flow_note(tenant)
             return path
         self._refresh_planes()
         self._alloc.activate(tenant, page, tables)
         self._after_mutation()
+        self._flow_note(tenant)
         return "rewrite" if had_page else "assign"
 
     def load_tenant_overlay(self, tenant: int,
@@ -1154,11 +1356,13 @@ class ArenaClassifier:
             self._refresh_planes()  # cover externally-staged writes
         self._alloc.activate(tenant, page, tables)
         self._after_mutation()
+        self._flow_note(tenant)
 
     def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
         page = self.stage_tenant(tables)
         self._alloc.activate(tenant, page, tables)
         self._after_mutation()
+        self._flow_note(tenant)
 
     def destroy_tenant(self, tenant: int) -> None:
         self._alloc.destroy_tenant(tenant)
@@ -1169,6 +1373,7 @@ class ArenaClassifier:
         # destroy mutates the page table / free list too — the
         # invariant hook must cover it like every other boundary
         self._after_mutation()
+        self._flow_note(tenant)
 
     def compact(self) -> int:
         if self._fused_deep:
@@ -1180,6 +1385,12 @@ class ArenaClassifier:
                 self._planes = None
         moved = self._alloc.compact()
         self._after_mutation()
+        if moved and self._flow is not None:
+            # slab moves re-steer every moved tenant's flow slab; the
+            # pool-wide bump is the conservative invalidation
+            for t in self._alloc.tenants():
+                self._flow.set_page(t, self._alloc.page_of(t))
+            self._flow.bump_all_generations()
         return moved
 
     def _after_mutation(self) -> None:
@@ -1235,6 +1446,29 @@ class ArenaClassifier:
             self._planes = planes
             self._planes_gen = gen
 
+    def _flow_note(self, tenant: int) -> None:
+        """Per-tenant flow bookkeeping after a lifecycle mutation:
+        re-steer the tenant's flow slab to its (possibly new) arena
+        page and invalidate its resident flow verdicts."""
+        if self._flow is None:
+            return
+        page = self._alloc.page_of(tenant)
+        self._flow.set_page(tenant, -1 if page is None else page)
+        self._flow.bump_generation(tenant)
+
+    @property
+    def flow(self):
+        return self._flow
+
+    def flow_counters(self):
+        return {} if self._flow is None else self._flow.counter_values()
+
+    def flow_age_tick(self, horizon=None) -> int:
+        return 0 if self._flow is None else self._flow.age(horizon)
+
+    def warm_flow_ladder(self, ladder) -> int:
+        return 0 if self._flow is None else self._flow.warm(ladder)
+
     # -- classify ------------------------------------------------------------
 
     def tenant_ids(self):
@@ -1242,11 +1476,98 @@ class ArenaClassifier:
 
     def classify_async_packed_tenant(
         self, wire_np: np.ndarray, tenant_np: np.ndarray,
-        apply_stats: bool = True,
+        apply_stats: bool = True, tcp_flags: Optional[np.ndarray] = None,
     ) -> PendingClassify:
         """The mixed-tenant packed-wire dispatch: one batch, each
         packet steered to its tenant's slab in-kernel.  ``tenant_np``
-        is (B,) int — ids outside the registry classify to UNDEF."""
+        is (B,) int — ids outside the registry classify to UNDEF.
+        With the flow tier enabled, established flows serve from their
+        tenant's flow slab and only misses walk the arena."""
+        if self._flow is not None and wire_np.shape[1] in (4, 7):
+            return self._classify_flow_tenant(
+                wire_np, tenant_np, apply_stats, tcp_flags
+            )
+        return self._classify_stateless_tenant(
+            wire_np, tenant_np, apply_stats
+        )
+
+    def _classify_flow_tenant(
+        self, wire_np, tenant_np, apply_stats, tcp_flags
+    ) -> PendingClassify:
+        from .. import flow as flow_mod
+
+        if self._closed:
+            raise RuntimeError("classifier is closed")
+        tier = self._flow
+        n = wire_np.shape[0]
+        kind = (wire_np[:, 0] & 3).astype(np.int32)
+        tenant_np = np.ascontiguousarray(tenant_np, np.int32)
+        fused, ctx = tier.probe(wire_np, tenant_np=tenant_np,
+                                tflags_np=tcp_flags)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16, hitmask, hits, stale = jaxpath.split_flow_probe_outputs(
+                np.asarray(fused), n
+            )
+            tier.stats.add(hits=hits, misses=n - hits,
+                           stale_rejects=stale)
+            res16 = res16.copy()
+            pl = (
+                ((wire_np[:, 1] >> 16) & 0xFFFF)
+                | ((wire_np[:, 0] >> 27) << 16)
+            ).astype(np.int64)
+            stats_delta = stats_from_results(res16.astype(np.uint32), pl)
+            miss = np.nonzero(~hitmask)[0]
+            if len(miss):
+                m = len(miss)
+                bucket = flow_mod.flow_miss_bucket(m)
+                miss_wire = wire_np[miss]
+                miss_tenant = tenant_np[miss]
+                if bucket > m:
+                    pad = np.zeros(
+                        (bucket - m, miss_wire.shape[1]), np.uint32
+                    )
+                    pad[:, 0] = 3
+                    miss_wire = np.concatenate([miss_wire, pad])
+                    miss_tenant = np.concatenate(
+                        [miss_tenant, np.full(bucket - m, -1, np.int32)]
+                    )
+                out = self._classify_stateless_tenant(
+                    miss_wire, miss_tenant, apply_stats=False,
+                    note_tenants=False,
+                ).result()
+                res16[miss] = (out.results[:m] & 0xFFFF).astype(np.uint16)
+                stats_delta += out.stats_delta
+                verdicts = np.zeros(miss_wire.shape[0], np.uint32)
+                verdicts[:m] = out.results[:m] & 0xFFFF
+                mflags = None
+                if tcp_flags is not None:
+                    mflags = np.zeros(miss_wire.shape[0], np.int32)
+                    mflags[:m] = np.asarray(tcp_flags, np.int32)[miss]
+                tier.insert(ctx, miss_wire, verdicts,
+                            tenant_np=miss_tenant, tflags_np=mflags)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            self._note_tenants(tenant_np, results)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    def _classify_stateless_tenant(
+        self, wire_np: np.ndarray, tenant_np: np.ndarray,
+        apply_stats: bool = True, note_tenants: bool = True,
+    ) -> PendingClassify:
+        """The stateless arena dispatch (the pre-flow classify path and
+        the flow tier's miss fall-through)."""
         if self._closed:
             raise RuntimeError("classifier is closed")
         spec = self._alloc.spec
@@ -1294,7 +1615,8 @@ class ArenaClassifier:
             if apply_stats:
                 self._stats.add(stats_delta)
             results, xdp = jaxpath.host_finalize_wire(res16, kind)
-            self._note_tenants(tenant_np, results)
+            if note_tenants:
+                self._note_tenants(tenant_np, results)
             return ClassifyOutput(
                 results=results, xdp=xdp, stats_delta=stats_delta
             )

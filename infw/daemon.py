@@ -228,7 +228,8 @@ def stats_from_results(results: np.ndarray, pkt_len: np.ndarray) -> np.ndarray:
 def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             wire_codec: Optional[str] = None,
                             mesh: Optional[str] = None,
-                            compressed: Optional[bool] = None):
+                            compressed: Optional[bool] = None,
+                            flow_table=None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -243,6 +244,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
     from .backend import classifier_class
 
     if backend == "cpu":
+        if flow_table is not None:
+            log.warning(
+                "--flow-table is a device-backend feature; the cpu "
+                "reference classifier serves stateless"
+            )
         return classifier_class("cpu")
     if backend == "tpu":
         import functools
@@ -254,6 +260,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             kw["wire_codec"] = wire_codec
         if compressed is not None:
             kw["compressed"] = compressed
+        if flow_table is not None:
+            # the stateful flow tier (infw.flow): a FlowConfig built at
+            # launch (validated there) rides into every classifier
+            # generation the syncer constructs
+            kw["flow_table"] = flow_table
         if mesh:
             from .backend.mesh import resolve_mesh_spec
 
@@ -277,6 +288,32 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             return classifier_class("tpu")
         return functools.partial(classifier_class("tpu"), **kw)
     raise ValueError(f"unknown backend {backend!r} (expected tpu|cpu)")
+
+
+class _FlowCounters:
+    """flow_* counters + occupancy gauge as a /metrics provider: the
+    getter indirection survives classifier reloads (the WireStatsCounters
+    pattern); a classifier without a flow tier renders nothing.
+    ``prefix`` disambiguates independent tiers — the registry SUMS
+    same-named counters, so the tenant arena's flow tier must not share
+    the single-tenant tier's series."""
+
+    def __init__(self, clf_getter, prefix: str = "") -> None:
+        self._get = clf_getter
+        self._prefix = prefix
+
+    def counter_values(self):
+        clf = self._get()
+        fc = getattr(clf, "flow_counters", None)
+        if clf is None or fc is None:
+            return {}
+        try:
+            vals = fc()
+        except Exception:
+            return {}
+        if not self._prefix:
+            return vals
+        return {f"{self._prefix}{k}": v for k, v in vals.items()}
 
 
 # --- daemon ------------------------------------------------------------------
@@ -312,6 +349,7 @@ class Daemon:
         patch_staleness_us: Optional[float] = None,
         patch_max_ops: Optional[int] = None,
         tenants: Optional[int] = None,
+        flow_table=None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -324,6 +362,14 @@ class Daemon:
         self.max_tick_packets = max(1, int(max_tick_packets))
         self.h2d_overlap = bool(h2d_overlap)
         self.h2d_stage_depth = max(1, int(h2d_stage_depth))
+        # Stateful flow tier (--flow-table / INFW_FLOW_TABLE): an exact-
+        # match verdict cache in front of the LPM+scan (infw.flow); the
+        # daemon owns its observability (flow_* counters on /metrics,
+        # FlowEvictRecords on the event ring) and the idle-loop age
+        # sweep.  flow_table is a validated FlowConfig or None.
+        self.flow_table = flow_table
+        self._flow_attached: set = set()
+        self._flow_age_last = 0.0
         # Deadline-aware continuous microbatching (infw.scheduler): with
         # --deadline-us set, ingest jobs are sized by the LARGEST ladder
         # batch whose measured service time still fits the per-packet
@@ -416,6 +462,7 @@ class Daemon:
             classifier_factory=make_classifier_factory(
                 backend, fused_deep=fused_deep, wire_codec=wire_codec,
                 mesh=mesh, compressed=compressed,
+                flow_table=flow_table if backend != "cpu" else None,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -478,6 +525,13 @@ class Daemon:
         # patch-transaction counters + staleness histogram
         # (ingressnodefirewall_node_patch_txn_*)
         self.metrics_registry.register_counters(self.txn_stats)
+        if self.flow_table is not None and backend != "cpu":
+            # flow_* counters + occupancy gauge; the getter indirection
+            # survives table reloads exactly like the wire counters
+            self._flow_counters = _FlowCounters(
+                lambda: self.syncer.classifier
+            )
+            self.metrics_registry.register_counters(self._flow_counters)
         if self.tenants_max:
             self.tenant_registry = self._build_tenant_registry()
             # tenant_* counters (active/free slabs, swaps, flips,
@@ -654,7 +708,18 @@ class Daemon:
             target_rows=8 * entries,
             d_max=18,
         )
-        clf = ArenaClassifier(spec)
+        clf = ArenaClassifier(spec, flow_table=self.flow_table)
+        if self.flow_table is not None:
+            self._attach_flow_events(clf)
+            # registry holds providers weakly — keep the strong ref;
+            # prefixed so the arena tier's series never sums into the
+            # single-tenant flow_* series
+            self._tenant_flow_counters = _FlowCounters(
+                lambda: clf, prefix="tenant_"
+            )
+            self.metrics_registry.register_counters(
+                self._tenant_flow_counters
+            )
         return TenantRegistry(clf, rule_width=slots, event_ring=self.ring)
 
     def scan_tenant_edits_once(self) -> int:
@@ -1322,6 +1387,46 @@ class Daemon:
                 self.process_ingest_once()
             except Exception as e:
                 log.error("ingest error: %s", e)
+            try:
+                self._flow_maintenance()
+            except Exception as e:
+                log.error("flow maintenance error: %s", e)
+
+    def _attach_flow_events(self, clf) -> None:
+        """Wire a classifier's flow tier to the obs event ring (once
+        per tier): eviction storms surface as FlowEvictRecords next to
+        the deny events."""
+        tier = getattr(clf, "flow", None)
+        if tier is None or id(tier) in self._flow_attached:
+            return
+        from .obs.events import FlowEvictRecord
+
+        tier.on_evict = lambda ev, ins, ep: self.ring.push(
+            FlowEvictRecord(evicted=int(ev), inserted=int(ins),
+                            epoch=int(ep))
+        )
+        self._flow_attached.add(id(tier))
+
+    def _flow_maintenance(self) -> None:
+        """Idle-loop flow upkeep: attach eviction events to any new
+        classifier generation and run the epoch-based age sweep every
+        few seconds (stale entries never serve regardless — the sweep
+        just returns their slots ahead of LRU pressure)."""
+        if self.flow_table is None:
+            return
+        now = time.monotonic()
+        for clf in (self.syncer.classifier,
+                    self.tenant_registry.classifier
+                    if self.tenant_registry is not None else None):
+            if clf is None:
+                continue
+            self._attach_flow_events(clf)
+            if now - self._flow_age_last >= 5.0:
+                age = getattr(clf, "flow_age_tick", None)
+                if age is not None:
+                    age()
+        if now - self._flow_age_last >= 5.0:
+            self._flow_age_last = now
 
     def stop(self) -> None:
         """SIGTERM path: stop polling/serving, detach the dataplane but
@@ -1434,6 +1539,19 @@ def main(argv: Optional[List[str]] = None) -> int:
              "CLI beats INFW_TENANTS",
     )
     p.add_argument(
+        "--flow-table", type=int,
+        default=os.environ.get("INFW_FLOW_TABLE") or None,
+        help="enable the stateful flow tier with this many entries per "
+             "flow slab (bucketed to a power of two): a device-resident "
+             "exact-match verdict cache probed before the LPM + rule "
+             "scan — established flows serve their cached verdict and "
+             "only misses pay classification; rule patches / tenant "
+             "swaps invalidate by generation bump.  Capacity knobs: "
+             "INFW_FLOW_WAYS (set associativity, default 4) and "
+             "INFW_FLOW_MAX_AGE (hit freshness horizon in probe epochs)."
+             "  CLI beats INFW_FLOW_TABLE",
+    )
+    p.add_argument(
         "--deadline-us", type=float,
         default=os.environ.get("INFW_DEADLINE_US") or None,
         help="per-packet verdict deadline budget in microseconds: enables "
@@ -1511,6 +1629,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error(f"--patch-max-ops must be >= 1, got {args.patch_max_ops}")
     if args.tenants is not None and int(args.tenants) < 1:
         p.error(f"--tenants must be >= 1, got {args.tenants}")
+    # Flow-tier knobs share the launch-time validation posture: a bad
+    # entry count / way count / age horizon (flag OR env-derived) must
+    # fail the launch with a usage error, not raise inside the sync loop
+    # and leave an empty PASS-everything dataplane.
+    flow_cfg = None
+    if args.flow_table is not None and str(args.flow_table) not in (
+        "0", "", "false", "no"
+    ):
+        if int(args.flow_table) < 1:
+            p.error(f"--flow-table must be >= 1, got {args.flow_table}")
+        from .flow import FlowConfig
+
+        try:
+            flow_cfg = FlowConfig.make(
+                entries=int(args.flow_table),
+                ways=int(os.environ.get("INFW_FLOW_WAYS") or 4),
+                max_age=int(os.environ.get("INFW_FLOW_MAX_AGE")
+                            or FlowConfig().max_age),
+            )
+        except ValueError as e:
+            p.error(str(e))
 
     # Same launch-time validation posture as the wire codec: a bad
     # INFW_MESH (or --mesh) must fail here with a usage error, not raise
@@ -1563,6 +1702,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         patch_staleness_us=args.patch_staleness_us,
         patch_max_ops=args.patch_max_ops,
         tenants=int(args.tenants) if args.tenants else None,
+        flow_table=flow_cfg,
     )
     stop = threading.Event()
 

@@ -468,6 +468,61 @@ def _build_pallas_arena_walk(b: int):
     return fn, (alloc.arena, planes, wire, tenant)
 
 
+# -- stateful flow tier fixtures/builders (ISSUE-11) -------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture_flow():
+    """A small single-slab flow tier primed with one canonical batch,
+    so the probe entrypoint traces over a partially-occupied table."""
+    from ..flow import FlowConfig, FlowTier
+
+    tier = FlowTier(FlowConfig.make(entries=512))
+    wire = np.asarray(_fixture_batch(128).pack_wire())
+    _fused, ctx = tier.probe(wire)
+    tier.insert(ctx, wire, np.zeros(128, np.uint16))
+    return tier
+
+
+def _build_flow_probe(b: int):
+    """The fused flow-probe serving dispatch (jaxpath.jitted_flow_probe
+    through backend/tpu.py _launch_flow): cached-verdict serve + in-
+    kernel counter/TCP-state updates in one launch."""
+    import jax
+
+    from . import jaxpath
+
+    tier = _fixture_flow()
+    cfg = tier.config
+    fn = jaxpath.jitted_flow_probe(cfg.entries, cfg.ways)
+    with tier._lock:
+        flow, gens, pages = tier._flow, tier._gens_dev, tier._pages_dev
+    wire = _fixture_wire(b)
+    zeros = jax.device_put(np.zeros(b, np.int32))
+    epoch = jax.device_put(np.int32(tier.epoch + 1))
+    return fn, (flow, gens, pages, wire, zeros, zeros, epoch,
+                tier._max_age_dev)
+
+
+def _build_flow_insert(b: int):
+    """The flow batch-insert scatter (jaxpath.jitted_flow_insert): miss
+    verdicts land in one deduplicated multi-column scatter dispatch."""
+    import jax
+
+    from . import jaxpath
+
+    tier = _fixture_flow()
+    cfg = tier.config
+    fn = jaxpath.jitted_flow_insert(cfg.entries, cfg.ways)
+    with tier._lock:
+        flow, gens, pages = tier._flow, tier._gens_dev, tier._pages_dev
+    wire = _fixture_wire(b)
+    zeros = jax.device_put(np.zeros(b, np.int32))
+    verdicts = jax.device_put(np.zeros(b, np.uint32))
+    epoch = jax.device_put(np.int32(tier.epoch + 1))
+    return fn, (flow, gens, pages, wire, zeros, zeros, verdicts, epoch)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -671,6 +726,12 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify/pallas-arena-walk", "pallas", _build_pallas_arena_walk
+        ),
+        KernelEntrypoint(
+            "classify-wire/flow-probe", "xla", _build_flow_probe
+        ),
+        KernelEntrypoint(
+            "patch/flow-insert", "xla", _build_flow_insert
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

@@ -3896,3 +3896,387 @@ class ArenaAllocator:
             if moved:
                 self.counters["compactions"] += 1
         return moved
+
+
+# === stateful flow tier (device-resident connection tracking) ================
+#
+# The exact-match verdict cache in front of the LPM + rule scan (ISSUE-11,
+# the SDN flow-table pattern): a W-way set-associative hash table in fixed
+# -shape JAX tensors, keyed by the FULL set of verdict-relevant packet
+# fields (tenant, ifindex, source IP words, proto, dst_port, icmp
+# type/code, kind, l4_ok), so a hit can serve the cached res16 verdict
+# with bit-identical semantics to the stateless path — the invariant the
+# flow statecheck configs and bench_flow gate on.  Layout is columnar
+# (one tensor per field, C = pages * slab_entries rows) with per-tenant
+# SLABS: the per-packet tenant column steers the slot range exactly the
+# way the arena page table steers classification, and the key embeds the
+# tenant id so a paging bug can never serve one tenant's verdict to
+# another (isolation is key-level, not just slab-level).
+#
+# Mutations are all deterministic scatter forms (add / max / min / set at
+# per-slot-unique winner lanes), so the numpy host model
+# (infw.flow.HostFlowModel) replays them bit-exactly — the model-checker
+# compares device columns against the model after every settled op.
+#
+# Invalidation is GENERATIONAL: every entry records the per-tenant
+# ruleset generation at insert time and a hit requires it to still match
+# ``gens[tenant]`` — a patch transaction, tenant swap or full reload
+# bumps the generation (backend/tpu.py load_tables / tenant lifecycle)
+# and every resident flow verdict of that tenant goes stale at once,
+# with no O(table) flush on the mutation path.
+
+#: TCP flag bits of the optional per-packet flags column (PacketBatch
+#: .tcp_flags); 0 (the default when the ingest source carries no flags)
+#: degrades the TCP model to established-on-first-packet.
+TCP_FIN = 0x01
+TCP_SYN = 0x02
+TCP_RST = 0x04
+TCP_ACK = 0x10
+
+#: flow entry states (the TCP-state bitmap column): EMPTY slots are
+#: free; NEW = TCP flow that has only shown a pure SYN (tracked but NOT
+#: serve-eligible — SYN floods never graduate into the fast path); EST
+#: and FIN short-circuit classification.
+FLOW_EMPTY = 0
+FLOW_NEW = 1
+FLOW_EST = 2
+FLOW_FIN = 3
+
+FLOW_KEY_WORDS = 8
+
+
+class FlowTable(NamedTuple):
+    """Device-resident flow columns (C = pages * slab_entries rows).
+    Mutable state is packed into THREE narrow matrices so the probe's
+    in-kernel updates are 3 scatter ops, not 6 — scatter op count is
+    what the probe's cost scales with."""
+
+    keys: jax.Array  # (C, 8) uint32 [tenant, ifindex, ip0..3, m0, m1]
+    vg: jax.Array    # (C, 2) int32 [cached res16 verdict, tenant gen]
+    se: jax.Array    # (C, 2) int32 [FLOW_* state, last-seen epoch]
+    cnt: jax.Array   # (C, 3) int32 [pkts, sum(len>>8), sum(len&0xFF)]
+
+
+def flow_key_words(batch: DeviceBatch, tenant: jax.Array) -> jax.Array:
+    """(B, 8) uint32 exact-match key covering every field the verdict
+    depends on (pkt_len only feeds statistics, never the verdict)."""
+    m0 = (
+        (batch.proto.astype(jnp.uint32) & 0xFF)
+        | ((batch.dst_port.astype(jnp.uint32) & 0xFFFF) << 8)
+        | ((batch.kind.astype(jnp.uint32) & 3) << 24)
+        | ((batch.l4_ok.astype(jnp.uint32) & 1) << 26)
+    )
+    m1 = (batch.icmp_type.astype(jnp.uint32) & 0xFF) | (
+        (batch.icmp_code.astype(jnp.uint32) & 0xFF) << 8
+    )
+    return jnp.stack(
+        [
+            tenant.astype(jnp.uint32),
+            batch.ifindex.astype(jnp.uint32),
+            batch.ip_words[:, 0].astype(jnp.uint32),
+            batch.ip_words[:, 1].astype(jnp.uint32),
+            batch.ip_words[:, 2].astype(jnp.uint32),
+            batch.ip_words[:, 3].astype(jnp.uint32),
+            m0,
+            m1,
+        ],
+        axis=1,
+    )
+
+
+def _flow_hash(keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """FNV-1a over the 8 key words -> (h1, h2) uint32; h2 is forced odd
+    so the double-hash probe sequence visits distinct slots in a pow2
+    slab.  Pure wrapping u32 arithmetic — the numpy model computes the
+    identical values."""
+    h = jnp.full(keys.shape[:1], 0x811C9DC5, jnp.uint32)
+    for w in range(FLOW_KEY_WORDS):
+        h = (h ^ keys[:, w].astype(jnp.uint32)) * jnp.uint32(0x01000193)
+    return h, (h >> 16) | jnp.uint32(1)
+
+
+def _flow_slots(
+    keys: jax.Array, page: jax.Array, *, slab_entries: int, ways: int
+) -> jax.Array:
+    """(B, W) int32 global candidate slot ids (page-slab-local double
+    hashing); ``slab_entries`` must be a power of two."""
+    h1, h2 = _flow_hash(keys)
+    w = jnp.arange(ways, dtype=jnp.uint32)[None, :]
+    local = (h1[:, None] + w * h2[:, None]) & jnp.uint32(slab_entries - 1)
+    return (
+        jnp.clip(page, 0)[:, None] * slab_entries + local.astype(jnp.int32)
+    )
+
+
+def _pack_bits32(mask: jax.Array) -> jax.Array:
+    """(B,) bool -> (ceil(B/32),) int32 LSB-first bitmap words."""
+    b = mask.shape[0]
+    nw = -(-b // 32)
+    m = jnp.zeros(nw * 32, jnp.uint32).at[: b].set(mask.astype(jnp.uint32))
+    words = jnp.sum(
+        m.reshape(nw, 32) << jnp.arange(32, dtype=jnp.uint32)[None, :],
+        axis=1, dtype=jnp.uint32,
+    )
+    return jax.lax.bitcast_convert_type(words, jnp.int32)
+
+
+def unpack_bits32_host(words: np.ndarray, b: int) -> np.ndarray:
+    """Host inverse of _pack_bits32 -> (b,) bool."""
+    u = np.asarray(words).view(np.uint32)
+    bits = (u[:, None] >> np.arange(32, dtype=np.uint32)[None, :]) & 1
+    return bits.reshape(-1)[:b].astype(bool)
+
+
+def _flow_probe_core(
+    flow: FlowTable, gens: jax.Array, page_table: jax.Array,
+    batch: DeviceBatch, tenant: jax.Array, tflags: jax.Array,
+    epoch_now: jax.Array, max_age: jax.Array,
+    *, slab_entries: int, ways: int,
+):
+    """The shared probe body -> (fused output, updated mutable columns).
+
+    A hit requires: eligible lane (real IP, l4 parsed, tenant mapped to
+    a flow slab), exact 8-word key match, serve-eligible state (>= EST),
+    matching tenant generation, and a last-seen epoch within ``max_age``
+    of ``epoch_now``.  Hits update per-flow counters/epoch in-kernel and
+    apply the RST/FIN teardown transitions; a key match failing ONLY the
+    generation check counts as a stale reject (the invalidation metric).
+    Per-ruleId statistics for the served lanes derive HOST-side from the
+    returned res16 + pkt_len (the wire8 readback contract), so the probe
+    ships no stats tensor."""
+    C = flow.se.shape[0]
+    page = _arena_pages(page_table, tenant)
+    keyw = flow_key_words(batch, tenant)
+    is_ip = (batch.kind == KIND_IPV4) | (batch.kind == KIND_IPV6)
+    elig = is_ip & (batch.l4_ok != 0) & (page >= 0)
+    cand = _flow_slots(keyw, page, slab_entries=slab_entries, ways=ways)
+    ek = jnp.take(flow.keys, cand, axis=0, mode="clip")     # (B, W, 8)
+    ese = jnp.take(flow.se, cand, axis=0, mode="clip")      # (B, W, 2)
+    evg = jnp.take(flow.vg, cand, axis=0, mode="clip")
+    match = jnp.all(ek == keyw[:, None, :], axis=2) & elig[:, None]
+    live = ese[:, :, 0] >= FLOW_EST
+    mygen = jnp.take(gens, jnp.clip(tenant, 0, gens.shape[0] - 1),
+                     mode="clip")
+    gen_ok = evg[:, :, 1] == mygen[:, None]
+    fresh = (epoch_now - ese[:, :, 1]) <= max_age
+    hit_w = match & live & gen_ok & fresh
+    stale_w = match & live & fresh & ~gen_ok
+    W = ways
+    widx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    first = jnp.min(jnp.where(hit_w, widx, W), axis=1)
+    hit = first < W
+    sel = jnp.sum(jnp.where(widx == first[:, None], cand, 0), axis=1)
+    stale = jnp.any(stale_w, axis=1) & ~hit
+    slot = jnp.where(hit, sel, C)  # C = dropped by scatter mode="drop"
+
+    served = jnp.where(
+        hit,
+        jnp.sum(jnp.where(widx == first[:, None], evg[:, :, 0], 0), axis=1),
+        0,
+    ).astype(jnp.uint32)
+
+    ln = batch.pkt_len
+    cnt = flow.cnt.at[slot].add(
+        jnp.stack(
+            [jnp.ones_like(ln), (ln >> 8) & 0xFFFFFF, ln & 0xFF], axis=1
+        ),
+        mode="drop",
+    )
+    is_tcp = batch.proto == IPPROTO_TCP
+    fin = is_tcp & ((tflags & TCP_FIN) != 0)
+    rst = is_tcp & ((tflags & TCP_RST) != 0)
+    # ONE max-scatter carries both the FIN half-close transition and the
+    # last-seen refresh (epoch_now >= any stored epoch by monotonicity);
+    # one min-scatter applies RST teardown
+    big = jnp.int32(np.iinfo(np.int32).max)
+    se = flow.se.at[slot].max(
+        jnp.stack(
+            [
+                jnp.where(hit & fin, FLOW_FIN, -1).astype(jnp.int32),
+                jnp.broadcast_to(epoch_now, slot.shape).astype(jnp.int32),
+            ],
+            axis=1,
+        ),
+        mode="drop",
+    )
+    se = se.at[jnp.where(hit & rst, slot, C)].min(
+        jnp.stack(
+            [jnp.full_like(slot, FLOW_EMPTY), jnp.full_like(slot, big)],
+            axis=1,
+        ),
+        mode="drop",
+    )
+    fused = jnp.concatenate([
+        _pack_res16(served.astype(jnp.uint16)),
+        _pack_bits32(hit),
+        jnp.stack([
+            jnp.sum(hit.astype(jnp.int32)),
+            jnp.sum(stale.astype(jnp.int32)),
+        ]),
+    ])
+    return fused, flow._replace(se=se, cnt=cnt)
+
+
+def split_flow_probe_outputs(
+    arr: np.ndarray, b: int
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Host inverse of the probe's fused buffer -> (res16[b], hit mask
+    (b,) bool, hits, stale)."""
+    nw = (b + 1) // 2
+    res16 = unpack_res16_host(arr[:nw], b)
+    nh = -(-b // 32)
+    hit = unpack_bits32_host(arr[nw : nw + nh], b)
+    hits, stale = int(arr[nw + nh]), int(arr[nw + nh + 1])
+    return res16, hit, hits, stale
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_flow_probe(slab_entries: int, ways: int):
+    """The fused flow-probe dispatch: serve cached verdicts + update
+    per-flow state in ONE launch.  Cache keyed on the pool geometry
+    statics only — batch shape, occupancy, tenant count and generation
+    churn never re-specialize (the zero-recompile flow lifecycle)."""
+    def f(flow, gens, page_table, wire, tenant, tflags, epoch_now, max_age):
+        return _flow_probe_core(
+            flow, gens, page_table, unpack_wire(wire), tenant, tflags,
+            epoch_now, max_age, slab_entries=slab_entries, ways=ways,
+        )
+
+    return jax.jit(f)
+
+
+def _flow_insert_core(
+    flow: FlowTable, gens: jax.Array, page_table: jax.Array,
+    batch: DeviceBatch, tenant: jax.Array, tflags: jax.Array,
+    verdict16: jax.Array, epoch_now: jax.Array,
+    *, slab_entries: int, ways: int,
+):
+    """Batch insert of miss-lane verdicts -> (updated FlowTable, (4,)
+    int32 [inserts, evictions, promotes, 0]).
+
+    Way choice per lane: an existing slot holding the SAME key (any
+    state/generation — re-insert refreshes verdict+generation), else the
+    first EMPTY way, else the way with the OLDEST last-seen epoch (LRU
+    eviction, counted when it overwrites a different live key).  One
+    WINNER lane per slot (the last eligible lane in batch order) does
+    the .set() writes, so duplicate-slot scatters stay deterministic;
+    per-flow counters initialize from segment sums over ALL eligible
+    lanes that chose the slot."""
+    C = flow.se.shape[0]
+    page = _arena_pages(page_table, tenant)
+    keyw = flow_key_words(batch, tenant)
+    is_ip = (batch.kind == KIND_IPV4) | (batch.kind == KIND_IPV6)
+    is_tcp = batch.proto == IPPROTO_TCP
+    syn = is_tcp & ((tflags & TCP_SYN) != 0)
+    ack = is_tcp & ((tflags & TCP_ACK) != 0)
+    fin = is_tcp & ((tflags & TCP_FIN) != 0)
+    rst = is_tcp & ((tflags & TCP_RST) != 0)
+    elig = is_ip & (batch.l4_ok != 0) & (page >= 0) & ~rst
+    cand = _flow_slots(keyw, page, slab_entries=slab_entries, ways=ways)
+    ek = jnp.take(flow.keys, cand, axis=0, mode="clip")
+    ese = jnp.take(flow.se, cand, axis=0, mode="clip")
+    est = ese[:, :, 0]
+    eep = ese[:, :, 1]
+    match_w = jnp.all(ek == keyw[:, None, :], axis=2) & (est > 0)
+    empty_w = est == 0
+    W = ways
+    widx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    m_first = jnp.min(jnp.where(match_w, widx, W), axis=1)
+    e_first = jnp.min(jnp.where(empty_w, widx, W), axis=1)
+    oldest = jnp.argmin(eep, axis=1).astype(jnp.int32)
+    way = jnp.where(
+        m_first < W, m_first, jnp.where(e_first < W, e_first, oldest)
+    )
+    slot = jnp.sum(jnp.where(widx == way[:, None], cand, 0), axis=1)
+    matched = m_first < W
+    old_state = jnp.sum(jnp.where(widx == way[:, None], est, 0), axis=1)
+
+    # last eligible lane per slot wins the .set() writes
+    lane = jnp.arange(slot.shape[0], dtype=jnp.int32)
+    idx_e = jnp.where(elig, slot, C)
+    winner = jnp.full(C + 1, -1, jnp.int32).at[idx_e].max(lane, mode="drop")
+    win = elig & (
+        jnp.take(winner, jnp.clip(slot, 0, C), mode="clip") == lane
+    )
+    idx_w = jnp.where(win, slot, C)
+
+    # per-slot batch contributions (counter seeds) over ALL eligible lanes
+    ln = batch.pkt_len
+    seeds = jnp.zeros((C, 3), jnp.int32).at[idx_e].add(
+        jnp.stack(
+            [jnp.ones_like(ln), (ln >> 8) & 0xFFFFFF, ln & 0xFF], axis=1
+        ),
+        mode="drop",
+    )
+
+    state_val = jnp.where(
+        fin, FLOW_FIN,
+        jnp.where(is_tcp & syn & ~ack, FLOW_NEW, FLOW_EST),
+    ).astype(jnp.int32)
+    mygen = jnp.take(gens, jnp.clip(tenant, 0, gens.shape[0] - 1),
+                     mode="clip")
+    keys = flow.keys.at[idx_w].set(keyw, mode="drop")
+    vg = flow.vg.at[idx_w].set(
+        jnp.stack(
+            [(verdict16.astype(jnp.int32)) & 0xFFFF, mygen], axis=1
+        ),
+        mode="drop",
+    )
+    se = flow.se.at[idx_w].set(
+        jnp.stack(
+            [state_val,
+             jnp.broadcast_to(epoch_now, slot.shape).astype(jnp.int32)],
+            axis=1,
+        ),
+        mode="drop",
+    )
+    cnt = flow.cnt.at[idx_w].set(
+        jnp.take(seeds, jnp.clip(slot, 0, C - 1), axis=0, mode="clip"),
+        mode="drop",
+    )
+
+    evict = win & ~matched & (old_state > 0)
+    promote = win & matched & (old_state == FLOW_NEW) & (
+        state_val == FLOW_EST
+    )
+    counts = jnp.stack([
+        jnp.sum(win.astype(jnp.int32)),
+        jnp.sum(evict.astype(jnp.int32)),
+        jnp.sum(promote.astype(jnp.int32)),
+        jnp.int32(0),
+    ])
+    return FlowTable(keys=keys, vg=vg, se=se, cnt=cnt), counts
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_flow_insert(slab_entries: int, ways: int):
+    def f(flow, gens, page_table, wire, tenant, tflags, verdict16,
+          epoch_now):
+        return _flow_insert_core(
+            flow, gens, page_table, unpack_wire(wire), tenant, tflags,
+            verdict16, epoch_now, slab_entries=slab_entries, ways=ways,
+        )
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_flow_age():
+    """Epoch-based age sweep over the (state, epoch) matrix: entries
+    last seen strictly before ``cutoff`` free their slot.  Returns
+    (new se, aged count)."""
+    def f(se, cutoff):
+        expire = (se[:, 0] > 0) & (se[:, 1] < cutoff)
+        return (
+            jnp.where(expire[:, None], jnp.stack(
+                [jnp.zeros_like(se[:, 0]), se[:, 1]], axis=1
+            ), se),
+            jnp.sum(expire.astype(jnp.int32)),
+        )
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_flow_occupancy():
+    return jax.jit(lambda se: jnp.sum((se[:, 0] > 0).astype(jnp.int32)))

@@ -560,3 +560,24 @@ class TenantSwapRecord:
             f"page {self.page}, {self.entries} entries, "
             f"stage {self.stage_us:.0f}us + flip {self.flip_us:.0f}us"
         ]
+
+
+@dataclass
+class FlowEvictRecord:
+    """One flow-tier insert dispatch that displaced live flows (LRU
+    eviction under capacity pressure, infw.flow).  Counter totals
+    (hits/misses/inserts/evictions/invalidations + the occupancy gauge)
+    live on /metrics as flow_*; the event stream carries the SHAPE of
+    eviction pressure — when it spiked and how hard — next to the deny
+    events, sampled per dispatch rather than per flow (the per-packet
+    firehose rule)."""
+
+    evicted: int
+    inserted: int
+    epoch: int
+
+    def lines(self) -> List[str]:
+        return [
+            f"flow-evict: {self.evicted} flow(s) displaced by "
+            f"{self.inserted} insert(s) at epoch {self.epoch}"
+        ]

@@ -44,6 +44,12 @@ class PacketBatch:
     icmp_type: np.ndarray  # (B,) int32
     icmp_code: np.ndarray  # (B,) int32
     pkt_len: np.ndarray    # (B,) int32
+    #: optional (B,) int32 TCP flag bits (jaxpath.TCP_*) consumed by the
+    #: stateful flow tier's SYN/EST/FIN/RST state machine; None (sources
+    #: that carry no flags) degrades the TCP model to established-on-
+    #: first-packet.  Never crosses the classify wire formats — the
+    #: verdict does not depend on it.
+    tcp_flags: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return int(self.kind.shape[0])
@@ -60,7 +66,11 @@ class PacketBatch:
                     "kind l4_ok ifindex ip_words proto dst_port "
                     "icmp_type icmp_code pkt_len".split()
                 )
-            }
+            },
+            tcp_flags=(
+                None if self.tcp_flags is None
+                else self.tcp_flags[start:stop]
+            ),
         )
 
     def take(self, idx: np.ndarray) -> "PacketBatch":
@@ -73,7 +83,10 @@ class PacketBatch:
                     "kind l4_ok ifindex ip_words proto dst_port "
                     "icmp_type icmp_code pkt_len".split()
                 )
-            }
+            },
+            tcp_flags=(
+                None if self.tcp_flags is None else self.tcp_flags[idx]
+            ),
         )
 
     def pack_wire(self) -> np.ndarray:
@@ -208,6 +221,10 @@ class PacketBatch:
             icmp_type=np.pad(self.icmp_type, (0, pad)),
             icmp_code=np.pad(self.icmp_code, (0, pad)),
             pkt_len=np.pad(self.pkt_len, (0, pad)),
+            tcp_flags=(
+                None if self.tcp_flags is None
+                else np.pad(self.tcp_flags, (0, pad))
+            ),
         )
 
 
@@ -254,6 +271,13 @@ def make_batch(
 
 
 def concat(batches: List[PacketBatch]) -> PacketBatch:
+    flags = None
+    if any(b.tcp_flags is not None for b in batches):
+        flags = np.concatenate([
+            b.tcp_flags if b.tcp_flags is not None
+            else np.zeros(len(b), np.int32)
+            for b in batches
+        ])
     return PacketBatch(
         **{
             f: np.concatenate([getattr(b, f) for b in batches])
@@ -261,7 +285,8 @@ def concat(batches: List[PacketBatch]) -> PacketBatch:
                 "kind l4_ok ifindex ip_words proto dst_port "
                 "icmp_type icmp_code pkt_len".split()
             )
-        }
+        },
+        tcp_flags=flags,
     )
 
 

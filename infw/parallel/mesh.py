@@ -731,5 +731,38 @@ def arena_replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# --- stateful flow tier (ISSUE-11) ------------------------------------------
+#
+# The flow slab family's partition rules, declared once like the arena
+# pools: flow columns row-shard over "rules" when the row count divides
+# the axis (capacity scales with it; the probe/insert gathers and
+# scatters engage GSPMD under the SAME jitted factories the single chip
+# uses), while the small per-tenant steering state (generation vector,
+# flow page table) replicates like the arena page table.
+
+FLOW_PARTITION_RULES = {
+    # the FlowTable columns (jaxpath.FlowTable: keys / vg / se / cnt)
+    "keys": P("rules", None),
+    "vg": P("rules", None),
+    "se": P("rules", None),
+    "cnt": P("rules", None),
+    # per-tenant steering state: replicated like the arena page table
+    "gens": P(),
+    "page_table": P(),
+    "max_age": P(),
+}
+
+
+def flow_shardings(mesh: Mesh, capacity: int):
+    """Per-column NamedShardings for a flow tier on ``mesh``: rows over
+    "rules" when the capacity divides the axis, else fully replicated —
+    the degrade-never-refuse posture of the arena placement."""
+    rules = mesh.shape["rules"]
+    specs = FLOW_PARTITION_RULES
+    if rules > 1 and capacity % rules != 0:
+        specs = {k: P() for k in specs}
+    return {k: NamedSharding(mesh, s) for k, s in specs.items()}
+
+
 def arena_data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data", None))

@@ -397,6 +397,18 @@ def prewarm_ladder(clf, ladder, include_depth_classes: bool = True,
                 except Exception as e:  # degrade, never refuse
                     log.debug("prewarm skip @%d w%d v4=%s depth=%s: %s",
                               bs, width, v4_only, depth, e)
+    warm_flow = getattr(clf, "warm_flow_ladder", None)
+    if warm_flow is not None:
+        # stateful flow tier: pre-compile the probe/insert executables
+        # for every ladder shape too, so the warm flow lifecycle (probe,
+        # miss fall-through, batch insert, age) is compile-free on the
+        # serving path — the same zero-recompile contract as the
+        # classify ladder above (also covers the pow2 miss buckets,
+        # which are a subset of the ladder shapes).
+        try:
+            n_done += int(warm_flow([int(b) for b in ladder]) or 0)
+        except Exception as e:  # degrade, never refuse
+            log.debug("flow prewarm skipped: %s", e)
     if service is not None:
         # seed the admission policy's service model with a COMPILE-FREE
         # timing sample per ladder step (the shapes are warm now), so

@@ -563,3 +563,111 @@ def stats_dict_from_array(stats4: np.ndarray) -> Dict[int, List[int]]:
     for rid in np.nonzero(stats4.any(axis=1))[0]:
         out[int(rid)] = [int(x) for x in stats4[rid]]
     return out
+
+
+# --- flow-locality traffic (the stateful flow tier's workload) ---------------
+
+
+def flow_locality_fids(
+    rng: np.random.Generator, n: int, established_fraction: float,
+    chunk_packets: int = 1024,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """The chunk-aware flow-id assignment under flow_trace_batch (and
+    tools/loadgen.py's established-fraction mode): returns (fid, fresh,
+    n_flows) where ``fresh`` marks first occurrences and repeats only
+    reference flows born in EARLIER chunks — so a verdict cache that
+    inserts at chunk boundaries sees exactly ~established_fraction hits
+    per steady-state chunk (chunk 0 is the all-fresh warmup)."""
+    n = int(n)
+    e = float(established_fraction)
+    if not 0.0 <= e < 1.0:
+        raise ValueError(
+            f"established_fraction must be in [0, 1), got {e}"
+        )
+    cp = max(int(chunk_packets), 1)
+    chunk = np.arange(n) // cp
+    chunk_starts = np.arange(0, n, cp)
+    fresh = (rng.random(n) >= e) | (chunk == 0)
+    seen = np.cumsum(fresh)            # flows born through packet i
+    # flows born BEFORE each packet's chunk (the repeat-eligible pool)
+    born_before = np.concatenate(
+        [[0], seen[chunk_starts[1:] - 1]]
+    )[chunk]
+    fresh = fresh | (born_before == 0)
+    seen = np.cumsum(fresh)
+    born_before = np.concatenate(
+        [[0], seen[chunk_starts[1:] - 1]]
+    )[chunk]
+    pick = rng.random(n)
+    fid = np.where(
+        fresh, seen - 1,
+        (pick * np.maximum(born_before, 1)).astype(np.int64),
+    ).astype(np.int64)
+    return fid, fresh, int(seen[-1])
+
+
+def flow_trace_batch(
+    rng: np.random.Generator,
+    tables: CompiledTables,
+    n_packets: int,
+    established_fraction: float,
+    chunk_packets: int = 1024,
+    fin_fraction: float = 0.05,
+) -> Tuple[PacketBatch, Dict[str, int]]:
+    """Seeded packet stream with controlled flow locality — the workload
+    of the flow tier's hit-rate ladder (bench_flow, tools/loadgen.py).
+
+    ``established_fraction`` (e) is the per-chunk fraction of packets
+    that repeat a flow born in an EARLIER chunk of ``chunk_packets``
+    packets — chunk-aware on purpose: a verdict cache inserts a chunk's
+    fresh flows only after that chunk's dispatch, so intra-chunk repeats
+    of newborn flows can never hit and would silently dilute the ladder.
+    Chunk 0 is the all-fresh warmup; every later chunk carries exactly
+    ~e established traffic (TCP flows whose first packet is a pure SYN
+    pay one extra miss each — the NEW -> EST handshake gate; the bench
+    reports measured hit rates next to the nominal rungs).
+
+    Flow definitions draw from random_batch_fast over ``tables`` (hit-
+    biased addresses/rules), repaired to classification-eligible lanes
+    (real IP kinds, l4_ok=1) so the locality knob is exact.  TCP flags:
+    SYN on a TCP flow's first packet, ACK mid-stream, FIN|ACK on its
+    last packet for ``fin_fraction`` of flows.  pkt_len varies per
+    packet (it feeds statistics, never the flow key).  Byte-
+    deterministic per (seeded rng, arguments).
+
+    Returns (batch, meta) with meta = {"n_flows", "repeats"}."""
+    n = int(n_packets)
+    fid, fresh, n_flows = flow_locality_fids(
+        rng, n, established_fraction, chunk_packets
+    )
+    pool = random_batch_fast(rng, tables, n_flows)
+    # repair to eligible lanes: the locality knob must be exact
+    kind = np.asarray(pool.kind)
+    kind = np.where((kind == 1) | (kind == 2), kind, 1).astype(np.int32)
+    v4 = kind == 1
+    ipw = np.asarray(pool.ip_words).copy()
+    ipw[v4, 1:] = 0
+    batch = PacketBatch(
+        kind=kind[fid],
+        l4_ok=np.ones(n, np.int32),
+        ifindex=np.asarray(pool.ifindex)[fid],
+        ip_words=ipw[fid],
+        proto=np.asarray(pool.proto)[fid],
+        dst_port=np.asarray(pool.dst_port)[fid],
+        icmp_type=np.asarray(pool.icmp_type)[fid],
+        icmp_code=np.asarray(pool.icmp_code)[fid],
+        pkt_len=rng.integers(60, 1500, n).astype(np.int32),
+    )
+    # TCP state arcs: SYN opens, ACK carries, FIN|ACK closes (sampled)
+    from .kernels.jaxpath import TCP_ACK, TCP_FIN, TCP_SYN
+
+    is_tcp = batch.proto == 6
+    flags = np.where(is_tcp, TCP_ACK, 0).astype(np.int32)
+    flags[fresh & is_tcp] = TCP_SYN
+    last = np.zeros(n_flows, np.int64)
+    np.maximum.at(last, fid, np.arange(n, dtype=np.int64))
+    closing = last[rng.random(n_flows) < fin_fraction]
+    closing = closing[is_tcp[closing]]
+    flags[closing] = TCP_FIN | TCP_ACK
+    batch.tcp_flags = flags
+    return batch, {"n_flows": n_flows, "repeats": int(n - n_flows)}

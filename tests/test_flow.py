@@ -1,0 +1,540 @@
+"""Stateful flow tier (ISSUE-11): device-resident connection tracking
+with an exact-match fast path.
+
+Covers the kernel/model bit-identity (every probe/insert/age mutation
+vs the numpy HostFlowModel), verdict bit-identity of the flow-enabled
+classifier vs the stateless path and the CPU oracle (hits engaged, all
+ladder rungs, single-chip + mesh + arena), the TCP state machine
+(SYN-gated establishment, FIN half-close, RST teardown), epoch aging
+and LRU eviction, generation-bump invalidation on incremental patches /
+folded txn flushes / tenant swaps (no stale verdict ever served),
+cross-tenant flow isolation (key-level, survives slab reuse), the
+zero-recompile warm flow lifecycle, scheduler/daemon integration, and
+the statecheck flow configs incl. the flowstale injected defect.
+"""
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.backend.tpu import ArenaClassifier, TpuClassifier
+from infw.compiler import IncrementalTables
+from infw.flow import FlowConfig
+from infw.kernels import jaxpath
+
+
+def _tables(seed=3, n=256, width=4, v6=0.4):
+    return testing.random_tables_fast(
+        np.random.default_rng(seed), n_entries=n, width=width,
+        v6_fraction=v6, ifindexes=(2, 3),
+    )
+
+
+def _pair(tabs, entries=2048, track_model=False, **kw):
+    clf = TpuClassifier(
+        interpret=True, flow_table=FlowConfig.make(entries=entries),
+        flow_track_model=track_model, **kw,
+    )
+    base = TpuClassifier(interpret=True, **kw)
+    clf.load_tables(tabs)
+    base.load_tables(tabs)
+    return clf, base
+
+
+def _assert_model_parity(tier):
+    cols = tier.flow_columns()
+    want = tier.model.columns()
+    for k, dev in cols.items():
+        assert np.array_equal(dev, want[k]), (
+            f"device flow column {k!r} diverged from the host model "
+            f"({int(np.sum(np.asarray(dev).reshape(dev.shape[0], -1) != want[k].reshape(dev.shape[0], -1)))} cells)"
+        )
+
+
+# --- config validation -------------------------------------------------------
+
+
+def test_flow_config_validation():
+    cfg = FlowConfig.make(entries=1000)
+    assert cfg.entries == 1024  # pow2 bucketing
+    assert cfg.capacity == 1024
+    with pytest.raises(ValueError):
+        FlowConfig.make(entries=0)
+    with pytest.raises(ValueError):
+        FlowConfig.make(ways=9)
+    with pytest.raises(ValueError):
+        FlowConfig.make(max_age=0)
+
+
+# --- bit-identity: flow path vs stateless vs oracle --------------------------
+
+
+def test_flow_hits_bit_identical_to_stateless():
+    tabs = _tables()
+    clf, base = _pair(tabs, track_model=True)
+    batch = testing.random_batch_fast(np.random.default_rng(5), tabs, 384)
+    ref = oracle.HashLpmOracle(tabs).classify(batch)
+    for i in range(3):
+        out = clf.classify(batch, apply_stats=False)
+        want = base.classify(batch, apply_stats=False)
+        assert np.array_equal(out.results, want.results), f"pass {i}"
+        assert np.array_equal(out.results, ref.results), f"pass {i}"
+        assert np.array_equal(out.xdp, ref.xdp)
+        assert np.array_equal(out.stats_delta, want.stats_delta), (
+            f"pass {i}: statistics diverge"
+        )
+    v = clf.flow.stats.values()
+    assert v["hits"] > 300, "second/third passes must serve from the cache"
+    assert v["inserts"] > 0
+    _assert_model_parity(clf.flow)
+
+
+def test_flow_ladder_bit_identity():
+    tabs = _tables(n=512)
+    for ef in (0.0, 0.5, 0.9):
+        clf, base = _pair(tabs, entries=8192)
+        batch, meta = testing.flow_trace_batch(
+            np.random.default_rng(40 + int(ef * 10)), tabs, 4096, ef,
+            chunk_packets=512,
+        )
+        for lo in range(0, len(batch), 512):
+            out = clf.classify(batch.slice(lo, lo + 512),
+                               apply_stats=False)
+            want = base.classify(batch.slice(lo, lo + 512),
+                                 apply_stats=False)
+            assert np.array_equal(out.results, want.results), f"ef={ef}"
+        hits = clf.flow.stats.values()["hits"]
+        if ef >= 0.5:
+            assert hits > 0.5 * ef * len(batch), (
+                f"ef={ef}: hit rate collapsed ({hits}/{len(batch)})"
+            )
+        clf.close()
+        base.close()
+
+
+def test_flow_model_parity_under_churn():
+    """Eviction pressure: a tiny table under a large flow population —
+    every LRU displacement must mirror bit-exactly in the host model."""
+    tabs = _tables()
+    clf, base = _pair(tabs, entries=64, track_model=True)
+    for seed in range(4):
+        batch = testing.random_batch_fast(
+            np.random.default_rng(100 + seed), tabs, 512
+        )
+        out = clf.classify(batch, apply_stats=False)
+        want = base.classify(batch, apply_stats=False)
+        assert np.array_equal(out.results, want.results)
+    assert clf.flow.stats.values()["evictions"] > 0, (
+        "a 64-slot table under 2K flows must evict"
+    )
+    _assert_model_parity(clf.flow)
+
+
+# --- TCP state machine -------------------------------------------------------
+
+
+def _one_flow_batch(tabs, flags):
+    """len(flags) copies of one TCP packet, one flag word per copy."""
+    batch = testing.random_batch_fast(np.random.default_rng(9), tabs, 1)
+    batch.kind[:] = 1
+    batch.l4_ok[:] = 1
+    batch.proto[:] = 6
+    batch.ip_words[:, 1:] = 0
+    b = batch.take(np.zeros(len(flags), np.int64))
+    b.tcp_flags = np.asarray(flags, np.int32)
+    return b
+
+
+def test_tcp_syn_not_established():
+    """A pure-SYN stream never graduates into the fast path (SYN floods
+    stay on the stateless tier); the first non-SYN packet promotes."""
+    tabs = _tables()
+    clf, base = _pair(tabs, track_model=True)
+    syn = _one_flow_batch(tabs, [jaxpath.TCP_SYN] * 4)
+    for _ in range(3):
+        out = clf.classify(syn, apply_stats=False)
+        want = base.classify(syn, apply_stats=False)
+        assert np.array_equal(out.results, want.results)
+    assert clf.flow.stats.values()["hits"] == 0, (
+        "pure SYNs must never serve from the cache"
+    )
+    m = clf.flow.model
+    assert (m.se[:, 0] == jaxpath.FLOW_NEW).sum() == 1
+    # first ACK packet: still a miss (NEW is not serve-eligible), but
+    # promotes the entry to EST...
+    ack = _one_flow_batch(tabs, [jaxpath.TCP_ACK])
+    clf.classify(ack, apply_stats=False)
+    assert clf.flow.stats.values()["promotes"] == 1
+    assert (m.se[:, 0] == jaxpath.FLOW_EST).sum() == 1
+    # ...and the next packet serves
+    clf.classify(ack, apply_stats=False)
+    assert clf.flow.stats.values()["hits"] == 1
+    _assert_model_parity(clf.flow)
+
+
+def test_tcp_fin_and_rst_transitions():
+    tabs = _tables()
+    clf, base = _pair(tabs, track_model=True)
+    m = clf.flow.model
+    est = _one_flow_batch(tabs, [jaxpath.TCP_ACK] * 2)
+    clf.classify(est, apply_stats=False)  # insert (EST via dedup winner)
+    assert (m.se[:, 0] == jaxpath.FLOW_EST).sum() == 1
+    fin = _one_flow_batch(tabs, [jaxpath.TCP_FIN | jaxpath.TCP_ACK])
+    out = clf.classify(fin, apply_stats=False)
+    want = base.classify(fin, apply_stats=False)
+    assert np.array_equal(out.results, want.results)
+    assert clf.flow.stats.values()["hits"] == 1, "FIN still serves"
+    assert (m.se[:, 0] == jaxpath.FLOW_FIN).sum() == 1
+    rst = _one_flow_batch(tabs, [jaxpath.TCP_RST])
+    out = clf.classify(rst, apply_stats=False)
+    want = base.classify(rst, apply_stats=False)
+    assert np.array_equal(out.results, want.results)
+    assert m.occupancy() == 0, "RST tears the entry down"
+    _assert_model_parity(clf.flow)
+
+
+# --- aging -------------------------------------------------------------------
+
+
+def test_flow_aging_reclaims_and_max_age_gates():
+    tabs = _tables()
+    clf = TpuClassifier(
+        interpret=True,
+        flow_table=FlowConfig.make(entries=2048, max_age=2),
+        flow_track_model=True,
+    )
+    base = TpuClassifier(interpret=True)
+    clf.load_tables(tabs)
+    base.load_tables(tabs)
+    batch = testing.random_batch_fast(np.random.default_rng(5), tabs, 128)
+    clf.classify(batch, apply_stats=False)
+    h0 = clf.flow.stats.values()["hits"]
+    # 3 probe epochs of unrelated traffic age the entries past max_age=2
+    other = testing.random_batch_fast(np.random.default_rng(77), tabs, 64)
+    for _ in range(3):
+        clf.classify(other, apply_stats=False)
+    h_before = clf.flow.stats.values()["hits"]
+    assert h_before > h0, "the unrelated stream must hit its own repeats"
+    # the original batch's entries are now 3 epochs old (> max_age=2):
+    # the explicit sweep reclaims exactly them, and the re-classify
+    # below serves nothing stale (it re-misses and re-inserts)
+    aged = clf.flow_age_tick(horizon=2)
+    assert aged > 0, "epoch-expired entries must be reclaimed"
+    out = clf.classify(batch, apply_stats=False)
+    want = base.classify(batch, apply_stats=False)
+    assert np.array_equal(out.results, want.results)
+    assert clf.flow.stats.values()["hits"] == h_before, (
+        "expired entries must not serve"
+    )
+    _assert_model_parity(clf.flow)
+
+
+# --- invalidation ------------------------------------------------------------
+
+
+def test_invalidation_on_incremental_patch():
+    """A rules-only edit through load_tables (the patch path) must bump
+    the generation: the flow tier re-misses and serves the NEW verdict,
+    bit-identical to the stateless path."""
+    base_content = dict(_tables().content)
+    upd = IncrementalTables.from_content(base_content, rule_width=4)
+    clf, base = _pair(upd.snapshot(), track_model=True)
+    batch = testing.random_batch_fast(
+        np.random.default_rng(5), clf.tables, 256
+    )
+    for _ in range(2):
+        clf.classify(batch, apply_stats=False)
+    assert clf.flow.stats.values()["hits"] > 0
+    # edit EVERY key's rules (order-preserving rid permutation keeps the
+    # table patchable) so cached verdicts are broadly stale
+    ups = {}
+    for k, rules in list(base_content.items()):
+        r = np.asarray(rules).copy()
+        r[:, 6] = np.where(r[:, 0] != 0, 3 - r[:, 6], r[:, 6])  # flip act
+        ups[k] = r
+    upd.apply(ups, [])
+    snap = upd.snapshot()
+    hint = upd.peek_dirty()
+    clf.load_tables(snap, dirty_hint=hint)
+    base.load_tables(snap, dirty_hint=hint)
+    inv0 = clf.flow.stats.values()["invalidations"]
+    assert inv0 >= 2  # initial load + the patch
+    out = clf.classify(batch, apply_stats=False)
+    want = base.classify(batch, apply_stats=False)
+    ref = oracle.classify(snap, batch)
+    assert np.array_equal(out.results, want.results)
+    assert np.array_equal(out.results, ref.results), (
+        "stale flow verdict served after an incremental patch"
+    )
+    assert clf.flow.stats.values()["stale_rejects"] > 0, (
+        "the probe must have rejected generation-stale entries"
+    )
+    _assert_model_parity(clf.flow)
+
+
+def test_invalidation_on_txn_flush():
+    """The folded patch-transaction path (syncer/txn integration): a
+    flushed multi-edit transaction lands through load_tables and must
+    invalidate affected flow verdicts."""
+    from infw.txn import fold_ops, route_folded
+    from infw.analysis.statecheck import EditOp
+
+    base_content = dict(_tables().content)
+    upd = IncrementalTables.from_content(base_content, rule_width=4)
+    clf, base = _pair(upd.snapshot(), track_model=True)
+    batch = testing.random_batch_fast(
+        np.random.default_rng(5), clf.tables, 256
+    )
+    for _ in range(2):
+        clf.classify(batch, apply_stats=False)
+    ops = []
+    for k, rules in list(base_content.items())[:8]:
+        r = np.asarray(rules).copy()
+        r[:, 6] = np.where(r[:, 0] != 0, 3 - r[:, 6], r[:, 6])
+        ops.append(EditOp(kind="rules_edit", key=k, rules=r))
+    folded = fold_ops(ops, set(upd._ident_to_t))
+    ups, dels, _dirty = route_folded(folded, {}, False, 0)
+    upd.apply(ups, dels)
+    snap = upd.snapshot()
+    hint = upd.peek_dirty()
+    clf.load_tables(snap, dirty_hint=hint)
+    base.load_tables(snap, dirty_hint=hint)
+    out = clf.classify(batch, apply_stats=False)
+    ref = oracle.classify(snap, batch)
+    assert np.array_equal(out.results, ref.results), (
+        "stale flow verdict served after a folded txn flush"
+    )
+    _assert_model_parity(clf.flow)
+
+
+# --- multi-tenant (arena) ----------------------------------------------------
+
+
+def _arena_pair(tabs_by_tenant, flow_entries=1024, spec_samples=()):
+    spec = jaxpath.arena_spec_for(
+        "ctrie", tuple(tabs_by_tenant.values()) + tuple(spec_samples),
+        pages=6, max_tenants=8,
+    )
+    clf = ArenaClassifier(
+        spec, interpret=True, fused_deep=False,
+        flow_table=FlowConfig.make(entries=flow_entries),
+        flow_track_model=True,
+    )
+    base = ArenaClassifier(spec, interpret=True, fused_deep=False)
+    for t, tab in tabs_by_tenant.items():
+        clf.load_tenant(t, tab)
+        base.load_tenant(t, tab)
+    return clf, base
+
+
+def test_cross_tenant_flow_isolation():
+    """Tenant A's cached verdict must NEVER serve tenant B's identical
+    5-tuple: the same packets tagged per tenant classify against each
+    tenant's own ruleset, bit-identical to per-tenant oracles, with
+    flow hits engaged on both."""
+    tabs = {
+        0: testing.random_tables(np.random.default_rng(1), n_entries=24,
+                                 width=4, v6_fraction=0.3),
+        1: testing.random_tables(np.random.default_rng(2), n_entries=24,
+                                 width=4, v6_fraction=0.3),
+    }
+    clf, base = _arena_pair(tabs)
+    # the SAME packet columns for both tenants: only the tenant tag
+    # (and therefore the ruleset) differs
+    b = testing.random_batch(np.random.default_rng(7), tabs[0], 96)
+    from infw import packets as packets_mod
+
+    both = packets_mod.concat([b, b])
+    tenant = np.concatenate(
+        [np.zeros(96, np.int32), np.ones(96, np.int32)]
+    )
+    refs = [oracle.classify(tabs[0], b), oracle.classify(tabs[1], b)]
+    want = np.concatenate([r.results for r in refs])
+    for i in range(3):
+        out = clf.classify_tenants(both, tenant, apply_stats=False)
+        assert np.array_equal(out.results, want), (
+            f"pass {i}: cross-tenant leak "
+            f"({int(np.sum(out.results != want))} verdicts)"
+        )
+    assert clf.flow.stats.values()["hits"] > 150, "hits must engage"
+    # the two tenants' rulesets differ, so at least some packet must
+    # verdict differently — the isolation assertion has teeth
+    assert not np.array_equal(refs[0].results, refs[1].results)
+    _assert_model_parity(clf.flow)
+
+
+def test_invalidation_on_tenant_swap():
+    tabs = {
+        0: testing.random_tables(np.random.default_rng(1), n_entries=24,
+                                 width=4, v6_fraction=0.3),
+        1: testing.random_tables(np.random.default_rng(2), n_entries=24,
+                                 width=4, v6_fraction=0.3),
+    }
+    new_tab = testing.random_tables(np.random.default_rng(9),
+                                    n_entries=24, width=4,
+                                    v6_fraction=0.3)
+    clf, _base = _arena_pair(tabs, spec_samples=(new_tab,))
+    b = testing.random_batch(np.random.default_rng(7), tabs[0], 96)
+    t0 = np.zeros(96, np.int32)
+    for _ in range(2):
+        clf.classify_tenants(b, t0, apply_stats=False)
+    assert clf.flow.stats.values()["hits"] > 0
+    # hot-swap tenant 0 to a different ruleset (page-table flip)
+    clf.swap_tenant(0, new_tab)
+    out = clf.classify_tenants(b, t0, apply_stats=False)
+    ref = oracle.classify(new_tab, b)
+    assert np.array_equal(out.results, ref.results), (
+        "stale flow verdict served across a tenant swap"
+    )
+    # destroy: lanes go UNDEF, never a cached verdict
+    clf.destroy_tenant(0)
+    out = clf.classify_tenants(b, t0, apply_stats=False)
+    assert int(out.results.max()) == 0
+    _assert_model_parity(clf.flow)
+
+
+# --- zero-recompile warm lifecycle -------------------------------------------
+
+
+def test_zero_recompile_warm_flow_lifecycle():
+    """After the ladder warm, the whole flow lifecycle — probe across
+    batch sizes and occupancies, insert, age, invalidation — compiles
+    NOTHING (the _cache_size recompile lint)."""
+    tabs = _tables()
+    cfg = FlowConfig.make(entries=2048)
+    clf = TpuClassifier(interpret=True, flow_table=cfg)
+    base = TpuClassifier(interpret=True)
+    clf.load_tables(tabs)
+    base.load_tables(tabs)
+    ladder = [64, 128, 256, 512]
+    clf.warm_flow_ladder(ladder)
+    # warm the stateless fall-through + merged path once per shape
+    for b in ladder:
+        batch = testing.random_batch_fast(np.random.default_rng(b), tabs, b)
+        clf.classify(batch.pad_to(b), apply_stats=False)
+        base.classify(batch.pad_to(b), apply_stats=False)
+    clf.flow_age_tick()
+    probe = jaxpath.jitted_flow_probe(cfg.entries, cfg.ways)
+    ins = jaxpath.jitted_flow_insert(cfg.entries, cfg.ways)
+    age = jaxpath.jitted_flow_age()
+    size0 = (probe._cache_size() + ins._cache_size() + age._cache_size())
+    # the measured lifecycle: mixed batch sizes, rising occupancy,
+    # repeats (hits), an age sweep and a patch-free reload
+    for seed, b in ((1, 512), (2, 256), (3, 512), (4, 64), (5, 128)):
+        batch = testing.random_batch_fast(
+            np.random.default_rng(seed), tabs, b
+        )
+        for _ in range(2):
+            out = clf.classify(batch.pad_to(b), apply_stats=False)
+            want = base.classify(batch.pad_to(b), apply_stats=False)
+            assert np.array_equal(out.results, want.results)
+    clf.flow_age_tick()
+    grew = (probe._cache_size() + ins._cache_size() + age._cache_size()
+            ) - size0
+    assert grew == 0, (
+        f"warm flow lifecycle recompiled: probe/insert/age cache grew "
+        f"by {grew}"
+    )
+
+
+# --- scheduler / daemon integration ------------------------------------------
+
+
+def test_scheduler_prewarm_covers_flow():
+    from infw.scheduler import prewarm_ladder
+
+    tabs = _tables()
+    clf, _ = _pair(tabs, entries=1024)
+    n = prewarm_ladder(clf, [32, 64], include_depth_classes=False)
+    assert n > 0
+    cfg = clf.flow.config
+    probe = jaxpath.jitted_flow_probe(cfg.entries, cfg.ways)
+    assert probe._cache_size() >= 2  # both wire widths warmed
+
+
+def test_flow_counters_and_evict_events():
+    tabs = _tables()
+    clf, base = _pair(tabs, entries=64)  # tiny: force evictions
+    events = []
+    clf.flow.on_evict = lambda ev, ins, ep: events.append((ev, ins, ep))
+    for seed in range(3):
+        batch = testing.random_batch_fast(
+            np.random.default_rng(200 + seed), tabs, 512
+        )
+        out = clf.classify(batch, apply_stats=False)
+        want = base.classify(batch, apply_stats=False)
+        assert np.array_equal(out.results, want.results)
+    counters = clf.flow_counters()
+    assert counters["flow_evictions_total"] > 0
+    assert counters["flow_occupancy"] > 0
+    assert counters["flow_capacity"] == 64
+    assert events, "eviction events must fire under displacement"
+    assert all(ev > 0 for ev, _i, _e in events)
+
+
+def test_daemon_flow_flag_validation():
+    from infw.daemon import main as daemon_main
+
+    with pytest.raises(SystemExit) as e:
+        daemon_main(["--state-dir", "/tmp/x", "--node-name", "n",
+                     "--flow-table", "-5"])
+    assert e.value.code == 2
+
+
+def test_flow_evict_record_renders():
+    from infw.obs.events import FlowEvictRecord
+
+    rec = FlowEvictRecord(evicted=3, inserted=17, epoch=42)
+    (line,) = rec.lines()
+    assert "3 flow(s) displaced" in line and "epoch 42" in line
+
+
+# --- statecheck configs ------------------------------------------------------
+
+
+def test_statecheck_flow_config_clean():
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("flow", seed=1, n_ops=6,
+                                shrink_on_failure=False)
+    assert rep["ok"], rep.get("failure")
+
+
+def test_statecheck_flowstale_defect_caught():
+    import infw.flow as flow_mod
+    from infw.analysis import statecheck
+
+    base, ops = statecheck.build_case("flow", 0, 12)
+    flow_mod._INJECT_FLOW_STALE_BUG = True
+    try:
+        failure = statecheck.run_ops(base, ops, "flow", seed=0)
+    finally:
+        flow_mod._INJECT_FLOW_STALE_BUG = False
+    assert failure is not None, (
+        "dropped flow invalidation must be caught by the flow configs"
+    )
+    assert failure.phase in ("classify", "flow-classify", "flow-model")
+
+
+# --- mesh --------------------------------------------------------------------
+
+
+def test_mesh_flow_parity():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a multi-device pool")
+    from infw.backend.mesh import MeshTpuClassifier
+
+    tabs = _tables(n=128)
+    clf = MeshTpuClassifier(
+        data_shards=2, rules_shards=2, interpret=True,
+        flow_table=FlowConfig.make(entries=512),
+    )
+    clf.load_tables(tabs)
+    batch = testing.random_batch_fast(np.random.default_rng(5), tabs, 256)
+    ref = oracle.HashLpmOracle(tabs).classify(batch)
+    for i in range(2):
+        out = clf.classify(batch, apply_stats=False)
+        assert np.array_equal(out.results, ref.results), f"pass {i}"
+    assert clf.flow.stats.values()["hits"] > 0
+    clf.close()

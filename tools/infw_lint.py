@@ -299,7 +299,7 @@ def cmd_jax(args) -> int:
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
-                         "arena-ctrie")
+                         "arena-ctrie", "flow", "flow-ctrie")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -316,7 +316,7 @@ def _run_inject_defect(args, as_json: bool) -> int:
     fold feeds updater, resident state AND cold rebuild alike, so the
     catch again MUST be per-op-ground-truth oracle divergence, shrunk
     to a <= 2-op (delete, readd) reproducer."""
-    from infw import txn as txn_mod
+    from infw import flow as flow_mod, txn as txn_mod
     from infw.analysis import statecheck
     from infw.kernels import jaxpath
 
@@ -330,13 +330,25 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # the arena invariant/oracle layers, shrunk to the one
         # tenant_swap op
         "pageflip": (jaxpath, "_INJECT_PAGEFLIP_BUG", "arena-ctrie", 3),
+        # dropped flow invalidation: a rule edit's generation bump is
+        # silently skipped (infw.flow.bump_generation no-ops), so the
+        # flow tier keeps serving the PRE-edit cached verdict.  Device
+        # state, host model and cold rebuild all agree (the bump never
+        # ran anywhere), so the catch MUST be oracle divergence on a
+        # replayed traffic stream after an edit — shrinking to
+        # (flow_traffic, edit, flow_traffic) plus slack
+        "flowstale": (flow_mod, "_INJECT_FLOW_STALE_BUG", "flow", 4),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
     # produces one (seed 0 hits by op 5 at 12 ops) and the shrinker
     # budget to reduce it back down to the (delete, readd) pair
-    n_ops = max(args.ops, 12) if defect == "fold" else args.ops
-    shrink_runs = 64 if defect == "fold" else 32
+    # fold/flowstale defects need a multi-op pattern to fire (delete-
+    # then-readd in one txn; traffic-edit-traffic on one seed): give the
+    # generator a horizon that reliably produces one and the shrinker
+    # the budget to reduce it
+    n_ops = max(args.ops, 12) if defect in ("fold", "flowstale") else args.ops
+    shrink_runs = 64 if defect in ("fold", "flowstale") else 32
     if args.configs:
         print(f"note: --inject-defect {defect} always runs the "
               f"{config!r} config (the defect's layout regime); "
@@ -499,7 +511,8 @@ def main(argv=None) -> int:
                          help="witness batch size override")
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
-                         choices=("joined-pad", "cskip", "fold", "pageflip"),
+                         choices=("joined-pad", "cskip", "fold", "pageflip",
+                                  "flowstale"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

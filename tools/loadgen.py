@@ -47,24 +47,42 @@ from infw.obs.pcap import FramesBuf, build_frames_bulk  # noqa: E402
 
 
 def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
-                ifindex: int):
+                ifindex: int, established_fraction: float = 0.0,
+                file_packets: int = 4096):
     """Uniform synthetic packet columns (no table bias — loadgen does
-    not know the daemon's ruleset) -> the build_frames_bulk inputs."""
-    kind = np.where(rng.random(n) < v6_fraction, 2, 1).astype(np.int32)
-    ip = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    not know the daemon's ruleset) -> the build_frames_bulk inputs.
+
+    ``established_fraction`` > 0 switches on flow locality: the columns
+    draw from a flow pool via the chunk-aware assignment
+    (infw.testing.flow_locality_fids, chunked at ``file_packets`` so
+    one dropped frames file is the cache's insert granularity) — the
+    hit-rate-ladder workload for a daemon running --flow-table.  Byte-
+    deterministic per (seed, arguments): two runs offer identical
+    streams."""
+    if established_fraction > 0.0:
+        fid, _fresh, n_flows = testing.flow_locality_fids(
+            rng, n, established_fraction, chunk_packets=file_packets
+        )
+    else:
+        fid = np.arange(n)
+        n_flows = n
+    kind = np.where(
+        rng.random(n_flows) < v6_fraction, 2, 1
+    ).astype(np.int32)
+    ip = rng.integers(0, 256, (n_flows, 16), dtype=np.uint8)
     ip[kind == 1, 4:] = 0
     ip_words = np.ascontiguousarray(ip).view(">u4").astype(np.uint32)
-    ip_words = ip_words.reshape(n, 4)
+    ip_words = ip_words.reshape(n_flows, 4)
     proto = np.asarray([6, 17, 132, 1, 58], np.int32)[
-        rng.integers(0, 5, n)
+        rng.integers(0, 5, n_flows)
     ]
-    dst_port = rng.integers(0, 65536, n).astype(np.int32)
-    icmp_type = rng.integers(0, 256, n).astype(np.int32)
-    icmp_code = rng.integers(0, 3, n).astype(np.int32)
-    fb = build_frames_bulk(kind, ip_words, proto, dst_port, icmp_type,
-                           icmp_code)
+    dst_port = rng.integers(0, 65536, n_flows).astype(np.int32)
+    icmp_type = rng.integers(0, 256, n_flows).astype(np.int32)
+    icmp_code = rng.integers(0, 3, n_flows).astype(np.int32)
+    fb = build_frames_bulk(kind[fid], ip_words[fid], proto[fid],
+                           dst_port[fid], icmp_type[fid], icmp_code[fid])
     fb.ifindex = np.full(n, int(ifindex), np.uint32)
-    return fb
+    return fb, n_flows
 
 
 def main(argv=None) -> int:
@@ -83,12 +101,42 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--ifindex", type=int, default=10)
     p.add_argument("--v6-fraction", type=float, default=0.3)
+    p.add_argument("--established-fraction", type=float, default=0.0,
+                   help="flow locality: fraction of packets repeating a "
+                        "flow from an earlier frames file (chunk-aware, "
+                        "infw.testing.flow_locality_fids) — drive a "
+                        "--flow-table daemon at a controlled hit rate")
+    p.add_argument("--established-ladder", action="store_true",
+                   help="emit the 0/50/90/99%% established-flow ladder: "
+                        "four sub-directories <out>/ef00|ef50|ef90|ef99, "
+                        "each a full manifest-disciplined drop schedule "
+                        "at its rung's flow locality (byte-deterministic "
+                        "per --seed)")
     p.add_argument("--dry-run", action="store_true",
                    help="print the schedule summary without writing or "
                         "sleeping")
     args = p.parse_args(argv)
     if args.rate <= 0 or args.n <= 0 or args.file_packets <= 0:
         p.error("--rate, --n and --file-packets must be positive")
+    if not 0.0 <= args.established_fraction < 1.0:
+        p.error("--established-fraction must be in [0, 1)")
+
+    if args.established_ladder:
+        # the hit-rate ladder: one full run per rung, each into its own
+        # sub-directory with its own manifest (the measuring consumer
+        # points a --flow-table daemon at one rung at a time)
+        rc = 0
+        base = list(argv) if argv is not None else sys.argv[1:]
+        base = [a for i, a in enumerate(base)
+                if a != "--established-ladder"
+                and not (a == "--out" or (i > 0 and base[i - 1] == "--out"))
+                and not a.startswith("--established-fraction")
+                and not (i > 0 and base[i - 1] == "--established-fraction")]
+        for ef in (0.0, 0.5, 0.9, 0.99):
+            sub = os.path.join(args.out, f"ef{int(ef * 100):02d}")
+            rc |= main(base + ["--out", sub,
+                               "--established-fraction", str(ef)])
+        return rc
 
     rng = np.random.default_rng(args.seed)
     if args.burst > 0:
@@ -96,7 +144,9 @@ def main(argv=None) -> int:
                                       burst=args.burst)
     else:
         offs = testing.poisson_arrivals(rng, args.rate, args.n)
-    fb = synth_batch(rng, args.n, args.v6_fraction, args.ifindex)
+    fb, n_flows = synth_batch(rng, args.n, args.v6_fraction, args.ifindex,
+                              established_fraction=args.established_fraction,
+                              file_packets=args.file_packets)
 
     fp = int(args.file_packets)
     n_files = -(-args.n // fp)
@@ -109,6 +159,8 @@ def main(argv=None) -> int:
         "process": f"burst:{args.burst}" if args.burst > 0 else "poisson",
         "files": int(n_files), "file_packets": fp,
         "duration_s": float(offs[-1]), "seed": int(args.seed),
+        "established_fraction": float(args.established_fraction),
+        "n_flows": int(n_flows),
     }
     print(json.dumps(summary), flush=True)
     if args.dry_run:
