@@ -5,8 +5,9 @@
 PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
-	churn-bench flow-bench native entry-check dryrun-multichip \
-	mesh-check spill-read wire-check lint static-check state-check clean
+	churn-bench flow-bench resident-bench native entry-check \
+	dryrun-multichip mesh-check spill-read wire-check lint \
+	static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -78,6 +79,19 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
+	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
+		--inject-donation-defect --entries defect/undonated-buffer \
+		>/dev/null 2>&1; rc=$$?; \
+	if [ $$rc -eq 1 ]; then \
+		echo "donation-lint injection caught"; \
+	elif [ $$rc -eq 0 ]; then \
+		echo "state-check FAIL: injected undonated buffer NOT caught"; \
+		exit 1; \
+	else \
+		echo "state-check FAIL: donation audit exited $$rc (want 1 = caught)"; \
+		exit 1; \
+	fi
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -164,10 +178,23 @@ tenant-bench:
 flow-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --flow-bench
 
+# The zero-copy resident serving tier (bench.bench_resident) standalone
+# at smoke scale off-TPU: per-admission p50 latency of the ONE-fused-
+# program donated-buffer loop vs the probe-then-classify multi-dispatch
+# plan at batch 32/128 (interleaved min-vs-min, same trace, both flow
+# tiers reset per pass), gated on the batch-32 speedup
+# (INFW_RESIDENT_SPEEDUP_MIN, default 3x — the ISSUE-12 acceptance),
+# with verdict bit-identity to the CPU oracle AND the multi-dispatch
+# path gated in-tier, plus a warmed 1000-dispatch steady-state run that
+# asserts ZERO resident-pool allocations and ZERO recompiles.  The
+# statecheck resident config runs FIRST and gates record publication.
+resident-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --resident-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -1553,6 +1553,25 @@ def bench_wire_latency(tables, batch, on_tpu):
         )
         log("wire latency: recompile lint OK — all ladder shapes served "
             "from the pre-warmed jit cache")
+    # BENCH_r05 anomaly sentinel (ISSUE-12 satellite): the round-5
+    # record read 11.77 ms pinned-input p50 @batch=32 beside 0.25 ms
+    # @batch=128 — diagnosed as a MEASUREMENT ARTIFACT, not a rung-32
+    # dataplane bug: the ladder's first-measured shape paid its jit
+    # specialization plus the tunnel's per-executable first-dispatch
+    # cost inside the timed loop (batch 32 ran first), which the
+    # full-ladder pre-warm above now moves off the clock and the
+    # recompile assert pins.  A small-batch pinned p50 dwarfing the
+    # large-batch one is therefore always suspect — flag a recurrence
+    # loudly in the record instead of letting it read as a real floor
+    # (tests/test_resident.py pins the compile-free pinned sweep).
+    if len(pinned_small) >= 2:
+        small = dict(pinned_small)
+        if 32 in small and small[32] > 8 * max(small[max(small)], 1e-9):
+            log(f"WARNING: pinned-input p50 @batch=32 "
+                f"({small[32]*1e3:.3f} ms) is >8x the @batch="
+                f"{max(small)} line — the BENCH_r05 anomaly shape; "
+                "suspect a first-dispatch cost inside the timed loop, "
+                "not the dataplane")
     emit(
         f"p50 verdict latency, wire path (batch={best[0]}, 1000-CIDR dense; "
         f"tunnel sync floor {floor*1e3:.1f} ms)",
@@ -2533,6 +2552,233 @@ def flow_bench_main() -> int:
     return rc
 
 
+# --- resident serving loop: donated buffers, one fused program -------------
+
+
+def bench_resident(rng, on_tpu):
+    """ISSUE-12 resident tier (``make resident-bench``, folded into
+    bench-checked): per-admission p50 latency of the ONE-fused-program
+    donated-buffer serving loop vs the probe-then-classify
+    multi-dispatch plan it replaces (prepare_packed/classify_prepared
+    with the flow tier), at the batch-32 anomaly rung and batch 128.
+
+    Methodology (benchruns/README):
+    - RING-RECORD discipline: chunks are pre-packed wire records (the
+      producer's job — tools/loadgen.py --ring packs into the mapped
+      slot), so the measured loop is dispatch + materialize only, the
+      dataplane-attributable path;
+    - interleaved min-vs-min: alternating passes over the SAME 90%%-
+      established trace, each pass from a cold flow table (reset), so
+      ambient load cannot skew the ratio and each pass carries the
+      rung's real insert + hit mix;
+    - dataplane-attributable: reported p50s subtract the in-record link
+      floor (noop round-trip) — a dispatch cannot beat the link;
+    - ORACLE GATE before any timing line: resident verdicts + stats
+      bit-identical to the CPU oracle AND to the multi-dispatch path on
+      the same chunks;
+    - ZERO-ALLOC + ZERO-RECOMPILE gate: a warmed 1000-dispatch steady-
+      state run must leave the resident pool's allocation counter and
+      the fused executable cache exactly where the prewarm left them
+      (ResidentPool.steady_allocs() == 0, _cache_size flat).
+
+    Returns the record dict for the resident-bench gate
+    (INFW_RESIDENT_SPEEDUP_MIN at batch 32)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.scheduler import prewarm_ladder
+
+    out = {}
+    floor = _slo_floor()
+    log(f"resident: link sync floor {floor*1e3:.3f} ms")
+    n_entries = 100_000 if on_tpu else 20_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.5,
+        ifindexes=(2, 3),
+    )
+    # a production-scale connection table (the bench_flow on-TPU size):
+    # the multi-dispatch plan's undonated probe/insert launches copy
+    # O(table) column bytes per admission, the donated loop rewrites
+    # them in place — the gap this tier exists to measure
+    fcfg = FlowConfig.make(entries=1 << 17)
+    res = TpuClassifier(force_path="trie", flow_table=fcfg, resident=True)
+    multi = TpuClassifier(force_path="trie",
+                          flow_table=FlowConfig.make(entries=1 << 17))
+    res.load_tables(tables)
+    multi.load_tables(tables)
+    t0 = time.perf_counter()
+    ladder = (32, 64, 128)
+    prewarm_ladder(res, ladder)
+    prewarm_ladder(multi, ladder)
+    log(f"resident: ladder prewarm in {time.perf_counter()-t0:.1f}s; "
+        f"pool after warm: {res.resident_counters()}")
+
+    reps = 5 if on_tpu else 3
+    for bs in (32, 128):
+        batch, meta = testing.flow_trace_batch(
+            np.random.default_rng(8800 + bs), tables, bs * 100, 0.9,
+            chunk_packets=bs,
+        )
+        tflags = np.asarray(batch.tcp_flags, np.int32)
+        chunks = []
+        for lo in range(0, len(batch), bs):
+            sub = np.arange(lo, lo + bs, dtype=np.int64)
+            w, v4 = batch.pack_wire_subset(sub)
+            chunks.append((w, v4, np.ascontiguousarray(tflags[sub])))
+
+        # oracle + multi-dispatch bit-identity gate BEFORE any timing
+        # line: one full cold->warm pass on each path, every chunk's
+        # verdicts AND statistics compared
+        ref = oracle.classify(tables, batch)
+        res.flow.reset()
+        multi.flow.reset()
+        n_div = 0
+        off = 0
+        for w, v4, tf in chunks:
+            o = res.classify_prepared(
+                res.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+            om = multi.classify_prepared(
+                multi.prepare_packed(w, v4, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            want = ref.results[off : off + len(w)]
+            n_div += int((o.results != want).sum())
+            n_div += int((o.results != om.results).sum())
+            n_div += int((o.stats_delta != om.stats_delta).sum())
+            off += len(w)
+        if n_div:
+            raise RuntimeError(
+                f"resident-bench oracle mismatch @batch={bs}: {n_div} "
+                "divergences vs CPU oracle / multi-dispatch path"
+            )
+
+        def run_pass(clf):
+            clf.flow.reset()
+            lats = []
+            for w, v4, tf in chunks:
+                t0 = time.perf_counter()
+                clf.classify_prepared(
+                    clf.prepare_packed(w, v4, tcp_flags=tf),
+                    apply_stats=False,
+                ).result()
+                lats.append(time.perf_counter() - t0)
+            return np.asarray(lats[5:])
+
+        res.mark_resident_warm()
+        best = {"multi": 1e9, "res": 1e9}
+        for _ in range(reps):  # interleaved min-vs-min
+            best["multi"] = min(best["multi"],
+                                float(np.percentile(run_pass(multi), 50)))
+            best["res"] = min(best["res"],
+                              float(np.percentile(run_pass(res), 50)))
+        above = {k: max(v - floor, 0.0) for k, v in best.items()}
+        speedup = above["multi"] / max(above["res"], 1e-9)
+        log(f"resident @batch={bs}: fused {best['res']*1e3:.3f} ms "
+            f"({above['res']*1e3:.3f} above floor) vs multi-dispatch "
+            f"{best['multi']*1e3:.3f} ms ({above['multi']*1e3:.3f}) "
+            f"-> {speedup:.2f}x; measured hit rate ~0.9 nominal "
+            f"({meta['n_flows']} flows)")
+        emit(
+            f"resident fused-serving p50 above link floor @batch={bs} "
+            "(one device program per admission, donated buffers)",
+            above["res"] * 1e3, "ms", vs_baseline=0.0,
+        )
+        emit(
+            f"multi-dispatch flow-path p50 above link floor @batch={bs} "
+            "(probe-then-classify plan, A/B same record)",
+            above["multi"] * 1e3, "ms", vs_baseline=0.0,
+        )
+        emit(f"resident serving speedup @batch={bs}", speedup, "x",
+             vs_baseline=0.0)
+        out[f"speedup_{bs}"] = float(speedup)
+        out[f"res_p50_ms_{bs}"] = float(above["res"] * 1e3)
+        out[f"multi_p50_ms_{bs}"] = float(above["multi"] * 1e3)
+
+    # -- zero-alloc / zero-recompile steady state ---------------------------
+    # 1000 warmed dispatches at the batch-32 rung: the pool allocation
+    # counter and the fused executable cache must not move (what
+    # "zero-alloc steady state" MEANS — see benchruns/README)
+    bs = 32
+    batch, _meta = testing.flow_trace_batch(
+        np.random.default_rng(8899), tables, bs * 50, 0.9,
+        chunk_packets=bs,
+    )
+    tflags = np.asarray(batch.tcp_flags, np.int32)
+    chunks = []
+    for lo in range(0, len(batch), bs):
+        sub = np.arange(lo, lo + bs, dtype=np.int64)
+        w, v4 = batch.pack_wire_subset(sub)
+        chunks.append((w, v4, np.ascontiguousarray(tflags[sub])))
+    res.mark_resident_warm()
+    fn = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False
+    )
+    fn4 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", True, None, 0, False
+    )
+    cache0 = fn._cache_size() + fn4._cache_size()
+    n_disp = 0
+    while n_disp < 1000:
+        for w, v4, tf in chunks:
+            res.classify_prepared(
+                res.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+            n_disp += 1
+            if n_disp >= 1000:
+                break
+    grew = (fn._cache_size() + fn4._cache_size()) - cache0
+    allocs = res.resident.steady_allocs()
+    if grew or allocs:
+        raise RuntimeError(
+            f"resident steady state not zero-cost: {grew} recompile(s), "
+            f"{allocs} pool allocation(s) across {n_disp} warmed "
+            "dispatches"
+        )
+    log(f"resident steady state: {n_disp} dispatches, 0 recompiles, "
+        f"0 pool allocations (counters: {res.resident_counters()})")
+    emit("resident steady-state pool allocations per 1000 dispatches",
+         float(allocs), "allocations", vs_baseline=0.0)
+    out["steady_allocs"] = float(allocs)
+    out["steady_recompiles"] = float(grew)
+    res.close()
+    multi.close()
+    return out
+
+
+def resident_bench_main() -> int:
+    """``make resident-bench``: the resident serving tier standalone
+    (CPU smoke off TPU) with the regression gate — the fused
+    donated-buffer loop must beat the multi-dispatch flow plan at
+    batch 32 by INFW_RESIDENT_SPEEDUP_MIN (default 3x, the ISSUE-12
+    acceptance), with the oracle/multi bit-identity and the
+    zero-alloc/zero-recompile steady-state gates enforced inside the
+    tier.  The statecheck resident config runs FIRST and gates record
+    publication, mirroring the flow/churn/tenant-bench discipline."""
+    speedup_min = float(os.environ.get("INFW_RESIDENT_SPEEDUP_MIN", "3"))
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("resident", seed=0, n_ops=6,
+                                shrink_on_failure=False)
+    if not rep["ok"]:
+        log(f"resident-bench FAIL: statecheck resident not green before "
+            f"record publication: {rep['failure']}")
+        return 1
+    log(f"resident-bench: statecheck resident green "
+        f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_resident(rng, on_tpu)
+    emit_compact_record()
+    if not rec.get("speedup_32", 0.0) >= speedup_min:
+        log(f"resident-bench FAIL: batch-32 speedup "
+            f"{rec.get('speedup_32', 0):.2f}x < gate {speedup_min}x")
+        return 1
+    log("resident-bench OK: " + ", ".join(
+        f"{k}={v:.3f}" for k, v in sorted(rec.items())
+    ))
+    return 0
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -2872,4 +3118,6 @@ if __name__ == "__main__":
         sys.exit(tenant_bench_main())
     if "--flow-bench" in sys.argv:
         sys.exit(flow_bench_main())
+    if "--resident-bench" in sys.argv:
+        sys.exit(resident_bench_main())
     sys.exit(main())

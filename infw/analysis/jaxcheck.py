@@ -22,6 +22,14 @@ asserts, without a TPU:
   host<->device round trip inside the jitted hot path (an uncommitted
   numpy operand, a host fallback) fails the strict audit, including the
   mesh entrypoints on the 8-virtual-device pool;
+- **donation honored**: an entrypoint that declares donated operands
+  (``KernelEntrypoint.donate`` — the resident serving loop's aliased
+  flow columns/epoch) must compile to a program whose
+  ``input_output_alias`` map actually aliases every declared donated
+  array leaf; a donated buffer XLA silently copies (un-donates) means
+  the "zero-alloc steady state" the resident loop advertises is
+  fiction, and the audit fails.  A resident-loop entrypoint that
+  declares NO donated operands fails too (the registry-level rule);
 - **VMEM budget**: for each ``pallas_call``, the resident block-spec
   bytes (double-buffered for grid-blocked operands) must fit the
   documented per-core budget (``pallas_walk.DEFAULT_VMEM_BUDGET`` with
@@ -310,10 +318,129 @@ def audit_entry(
                     ),
                 ))
 
+    rep.findings.extend(_donation_lint(ep, ladder))
     if execute:
         rep.findings.extend(_recompile_lint(ep, ladder))
         rep.findings.extend(_transfer_lint(ep, ladder))
     return rep
+
+
+def _count_donated_leaves(args, donate) -> int:
+    import jax
+
+    n = 0
+    for i in donate:
+        if i < len(args):
+            n += len(jax.tree.leaves(args[i]))
+    return n
+
+
+def _alias_map_entries(compiled_text: str) -> int:
+    """Number of aliased parameters in a compiled HLO module header's
+    ``input_output_alias={ {out}: (param, {idx}, kind), ... }`` map —
+    each entry carries one ``}: (`` marker (the map nests braces, so a
+    span regex can't stop at the first close)."""
+    import re
+
+    i = compiled_text.find("input_output_alias={")
+    if i < 0:
+        return 0
+    # ``}: (`` appears once per map entry and nowhere else on the
+    # module header line (entry_computation_layout uses ``->(``)
+    return len(re.findall(r"\}:\s*\(", compiled_text[i:]))
+
+
+def _donation_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
+    """Compile the first ladder shape and verify the declared donated
+    operands survived into the program's input_output_alias map — a
+    declared-but-unaliased donation means XLA silently copies a buffer
+    the serving loop believes it rewrites in place (jax also warns
+    'Some donated buffers were not usable' at dispatch; this lint fails
+    CI without needing a warning filter).  Also enforces the
+    registry-level rule that every resident-loop entrypoint declares
+    its donated operands."""
+    from ..kernels import EntrypointUnavailable
+
+    out: List[AuditFinding] = []
+    donate = tuple(getattr(ep, "donate", ()) or ())
+    if "resident" in ep.name and not donate:
+        out.append(AuditFinding(
+            entry=ep.name,
+            check="donation",
+            severity="error",
+            message=(
+                "resident-loop entrypoint declares no donated operands "
+                "(KernelEntrypoint.donate) — the zero-alloc serving "
+                "contract is unverifiable"
+            ),
+        ))
+    if not donate:
+        return out
+    try:
+        fn, args = ep.build(int(ladder[0]))
+    except EntrypointUnavailable:
+        return out  # already reported by the trace pass
+    except Exception as e:
+        out.append(AuditFinding(
+            entry=ep.name, check="donation", severity="info",
+            message=f"build failed for donation lint: {e}",
+        ))
+        return out
+    want = _count_donated_leaves(args, donate)
+    try:
+        text = fn.lower(*args).compile().as_text()
+    except Exception as e:
+        out.append(AuditFinding(
+            entry=ep.name, check="donation", severity="info",
+            message=f"compile/as_text unavailable for donation lint: {e}",
+        ))
+        return out
+    got = _alias_map_entries(text.splitlines()[0] if text else "")
+    if got < want:
+        out.append(AuditFinding(
+            entry=ep.name,
+            check="donation",
+            severity="error",
+            message=(
+                f"{want - got} of {want} declared donated buffer(s) "
+                "were silently copied (not in the compiled program's "
+                "input_output_alias map) — the donated pool is "
+                "reallocating on every dispatch"
+            ),
+            detail=(text.splitlines()[0][:400] if text else ""),
+        ))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _donation_defect_jit():
+    import jax
+    import jax.numpy as jnp
+
+    # the output can never alias the donated operand (different dtype
+    # and size), so XLA must drop the donation — the acceptance fixture
+    # the donation lint has to fail on
+    return jax.jit(lambda x: (x.astype(jnp.int8))[:1], donate_argnums=(0,))
+
+
+def donation_defect_entrypoint():
+    """A deliberately defective donating entrypoint: the declared
+    donated operand cannot alias any output, so the compiled program
+    silently copies it — ``tools/infw_lint.py jax
+    --inject-donation-defect`` must then exit nonzero (the donation-lint
+    acceptance, wired into ``make state-check``)."""
+    import jax
+    import numpy as np
+
+    from ..kernels import KernelEntrypoint
+
+    def build(b: int):
+        return _donation_defect_jit(), (
+            jax.device_put(np.zeros(int(b), np.int32)),
+        )
+
+    return KernelEntrypoint("defect/undonated-buffer", "xla", build,
+                            donate=(0,))
 
 
 def _transfer_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
@@ -328,10 +455,16 @@ def _transfer_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
     from ..kernels import EntrypointUnavailable
 
     out: List[AuditFinding] = []
+    donates = bool(getattr(ep, "donate", ()) or ())
     for b in dict.fromkeys(int(x) for x in ladder):
         try:
             fn, args = ep.build(b)
             jax.block_until_ready(fn(*args))  # warm OUTSIDE the guard
+            if donates:
+                # donation consumed the warm run's operands; rebuild
+                # fresh ones (their device_put is the explicitly scoped
+                # staging half, so it happens before the guard)
+                fn, args = ep.build(b)
         except EntrypointUnavailable:
             continue  # already reported by the trace pass
         except Exception:
@@ -429,17 +562,22 @@ def audit_all(
     vmem_budget: Optional[int] = None,
     execute: bool = True,
     include_transfer_defect: bool = False,
+    include_donation_defect: bool = False,
 ) -> List[EntryReport]:
     """Audit every registered entrypoint (or the named subset).
 
     ``include_transfer_defect`` appends the deliberately defective
     host-operand entrypoint — the audit must then FAIL (the injected
-    acceptance of the transfer lint)."""
+    acceptance of the transfer lint).  ``include_donation_defect``
+    appends the declared-but-unaliasable donation entrypoint — the
+    donation lint's acceptance, same contract."""
     from ..kernels import kernel_entrypoints
 
     eps = list(kernel_entrypoints())
     if include_transfer_defect:
         eps.append(transfer_defect_entrypoint())
+    if include_donation_defect:
+        eps.append(donation_defect_entrypoint())
     reports = []
     for ep in eps:
         if names and ep.name not in names:

@@ -254,6 +254,14 @@ class StateConfig:
     #: with flow_table + the shadow HostFlowModel, and every settled
     #: check adds the device-vs-model flow-column bit-identity pass
     flow: int = 0
+    #: resident serving loop (ISSUE-12, requires flow > 0): classify
+    #: dispatches ride the donated-buffer fused step
+    #: (jaxpath.jitted_resident_step) instead of the multi-dispatch
+    #: probe-then-classify plan — the same oracle + flow-model checks
+    #: then pin the fused path, and the residentstale injected defect
+    #: (a dropped table-generation refresh on the resident pool) must
+    #: be caught by oracle divergence
+    resident: bool = False
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -321,6 +329,16 @@ CONFIGS: Dict[str, StateConfig] = {
         StateConfig("flow", flow=4096, witness_b=160),
         StateConfig("flow-ctrie", force_path="ctrie", flow=4096,
                     witness_b=160),
+        # zero-copy resident serving loop (ISSUE-12): the same flow op
+        # alphabet driven through the ONE-fused-program-per-admission
+        # dispatch (donated flow columns + epoch, in-program miss
+        # insert) — every settled check runs the witness through the
+        # fused step AND compares the donated device columns against
+        # the host model, so a fused-path semantics drift, a donation
+        # aliasing bug, or a stale captured table operand (the
+        # residentstale injected-defect acceptance, infw_lint state
+        # --inject-defect residentstale) all surface here
+        StateConfig("resident", flow=4096, witness_b=160, resident=True),
     )
 }
 
@@ -1016,6 +1034,8 @@ class _Driver:
                 "flow_table": FlowConfig.make(entries=config.flow),
                 "flow_track_model": True,
             }
+            if config.resident:
+                flow_kw["resident"] = True
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 

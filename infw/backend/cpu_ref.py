@@ -40,7 +40,7 @@ def _build_library() -> str:
     return _LIB
 
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 
 def load_library() -> ctypes.CDLL:
@@ -77,6 +77,10 @@ def load_library() -> ctypes.CDLL:
                 ctypes.c_int64, i64p,
                 i32p, i32p, i32p, u32p, i32p, i32p, i32p, i32p, i32p,
                 u32p, ctypes.c_int32,
+            ]
+            lib.infw_encode_delta.restype = ctypes.c_int64
+            lib.infw_encode_delta.argtypes = [
+                ctypes.c_int64, u32p, u8p, u32p, i32p, i64p, i32p,
             ]
             assert lib.infw_abi_version() == _ABI_VERSION
             _lib = lib

@@ -11,6 +11,7 @@
 //
 // Built as a shared library; driven through ctypes (see cpu_ref.py).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -341,6 +342,133 @@ int32_t infw_pack_wire_subset(
   return (compact ? 1 : 0) | (any_v6 ? 0 : 2);
 }
 
-int32_t infw_abi_version() { return 3; }
+// Delta+varint wire encode (the packets.encode_delta_wire hot half):
+// stable sort by IP word, ifindex/meta15 dictionaries, LEB128 or
+// fixed-stride section C — BYTE-IDENTICAL to the NumPy reference
+// (differentially tested), one pass in C++ instead of argsort + five
+// vectorized sweeps.  The caller keeps the qualification gate
+// (max_bytes_per_pkt) and the crc, which need the returned length.
+//
+// Returns the payload length, or -1 when the chunk does not qualify
+// (>15 distinct ifindexes, >256 distinct meta15 values, n < 1).
+// meta out: [dict_len, dict_mode, fixed_w].
+int64_t infw_encode_delta(
+    int64_t n,
+    const uint32_t* w,    // (n, 4) row-major v4-compact wire
+    uint8_t* payload,     // caller cap: n + 2n + 5n bytes
+    uint32_t* dict_vals,  // cap 256
+    int32_t* ifmap,       // 16, padded with -1
+    int64_t* perm,        // n
+    int32_t* meta) {      // [dict_len, dict_mode, fixed_w]
+  if (n < 1) return -1;
+  // ifindex dictionary: sorted unique (np.unique), <= 15 entries
+  std::vector<uint32_t> ifs(n);
+  for (int64_t i = 0; i < n; ++i) ifs[i] = w[i * 4 + 2];
+  std::vector<uint32_t> if_uniq(ifs);
+  std::sort(if_uniq.begin(), if_uniq.end());
+  if_uniq.erase(std::unique(if_uniq.begin(), if_uniq.end()), if_uniq.end());
+  if (if_uniq.size() > 15) return -1;
+  for (int i = 0; i < 16; ++i)
+    ifmap[i] = i < static_cast<int>(if_uniq.size())
+                   ? static_cast<int32_t>(if_uniq[i])
+                   : -1;
+  // meta15 = (w0 & 0x7FF) | (ifdict << 11); dictionary sorted unique
+  std::vector<uint32_t> meta15(n);
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t ifd = static_cast<uint32_t>(
+        std::lower_bound(if_uniq.begin(), if_uniq.end(), ifs[i]) -
+        if_uniq.begin());
+    meta15[i] = (w[i * 4] & 0x7FFu) | (ifd << 11);
+  }
+  std::vector<uint32_t> dict(meta15);
+  std::sort(dict.begin(), dict.end());
+  dict.erase(std::unique(dict.begin(), dict.end()), dict.end());
+  if (dict.size() > 256) return -1;
+  const int dict_len = static_cast<int>(dict.size());
+  const int dict_mode = dict_len == 1 ? 0 : (dict_len <= 16 ? 1 : 2);
+  for (int i = 0; i < dict_len; ++i) dict_vals[i] = dict[i];
+  // stable argsort by IP word (w3)
+  for (int64_t i = 0; i < n; ++i) perm[i] = i;
+  std::stable_sort(perm, perm + n, [&](int64_t a, int64_t b) {
+    return w[a * 4 + 3] < w[b * 4 + 3];
+  });
+  // deltas in sorted order (non-negative by construction)
+  std::vector<uint64_t> deltas(n);
+  uint64_t prev = 0;
+  uint64_t dmax = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t ip = w[perm[i] * 4 + 3];
+    deltas[i] = i == 0 ? ip : ip - prev;
+    prev = ip;
+    if (deltas[i] > dmax) dmax = deltas[i];
+  }
+  // varint length first (the fixed-stride plan competes on total bytes)
+  int64_t var_len = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = deltas[i];
+    do {
+      ++var_len;
+      v >>= 7;
+    } while (v);
+  }
+  int fixed_w = 0;
+  for (int cand : {1, 2, 4}) {
+    if (dmax < (1ull << (8 * cand)) && n * cand <= var_len) {
+      fixed_w = cand;
+      break;
+    }
+  }
+  // sections: A (meta dictionary indexes), B (l4 words le16), C (ips)
+  const int64_t n_a =
+      dict_mode == 0 ? 0 : (dict_mode == 1 ? (n + 1) / 2 : n);
+  const int64_t off_b = n_a;
+  const int64_t off_c = n_a + 2 * n;
+  const int64_t total = off_c + (fixed_w ? n * fixed_w : var_len);
+  std::memset(payload, 0, static_cast<size_t>(off_c));
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = perm[i];
+    const uint32_t midx = static_cast<uint32_t>(
+        std::lower_bound(dict.begin(), dict.end(), meta15[r]) -
+        dict.begin());
+    if (dict_mode == 1) {
+      payload[i / 2] |= static_cast<uint8_t>((i & 1) ? (midx << 4) : midx);
+    } else if (dict_mode == 2) {
+      payload[i] = static_cast<uint8_t>(midx);
+    }
+    const uint32_t w0 = w[r * 4], w1 = w[r * 4 + 1];
+    const uint32_t proto = (w0 >> 3) & 0xFF;
+    const bool is_icmp = proto == 1 || proto == 58;
+    const uint32_t l4 = is_icmp
+                            ? ((((w0 >> 11) & 0xFF) << 8) | ((w0 >> 19) & 0xFF))
+                            : (w1 & 0xFFFF);
+    payload[off_b + 2 * i] = static_cast<uint8_t>(l4 & 0xFF);
+    payload[off_b + 2 * i + 1] = static_cast<uint8_t>((l4 >> 8) & 0xFF);
+  }
+  uint8_t* c = payload + off_c;
+  if (fixed_w) {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t v = deltas[i];
+      for (int k = 0; k < fixed_w; ++k) {
+        *c++ = static_cast<uint8_t>(v & 0xFF);
+        v >>= 8;
+      }
+    }
+  } else {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t v = deltas[i];
+      do {
+        const uint8_t b = static_cast<uint8_t>(v & 0x7F);
+        v >>= 7;
+        *c++ = v ? (b | 0x80) : b;
+      } while (v);
+    }
+  }
+  meta[0] = dict_len;
+  meta[1] = dict_mode;
+  meta[2] = fixed_w;
+  return total;
+}
+
+int32_t infw_abi_version() { return 4; }
 
 }  // extern "C"

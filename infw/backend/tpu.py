@@ -63,6 +63,7 @@ class TpuClassifier:
         compressed: Optional[bool] = None,
         flow_table=None,
         flow_track_model: bool = False,
+        resident: Optional[bool] = None,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -140,6 +141,28 @@ class TpuClassifier:
             env = os.environ.get("INFW_FLOW_TABLE", "")
             if env and env not in ("0", "false", "no"):
                 flow_table = int(env)
+        # Zero-copy resident serving loop (--resident / INFW_RESIDENT,
+        # ISSUE-12): one fused device program per admission (decode +
+        # flow probe + stateless classify + merge + stats + miss
+        # insert) over donated/aliased buffers, replacing the
+        # probe-then-classify multi-dispatch plan.  The fused step IS
+        # the flow tier's serving form, so resident implies a flow
+        # table (a default one when none was configured).  Precedence
+        # mirrors the other knobs: constructor arg > INFW_RESIDENT env
+        # > off.
+        if resident is None:
+            env = os.environ.get("INFW_RESIDENT", "")
+            if env:
+                resident = env not in ("0", "false", "no")
+        self._resident = None
+        if resident:
+            from ..resident import ResidentPool
+
+            if flow_table is None or flow_table is False:
+                from ..flow import FlowConfig
+
+                flow_table = FlowConfig.make()
+            self._resident = ResidentPool(device=self._device)
         self._flow = None
         if flow_table is not None and flow_table is not False:
             from ..flow import FlowConfig, FlowTier
@@ -727,6 +750,10 @@ class TpuClassifier:
         compute; the plan snapshots the table generation at prepare
         time — in-flight plans finish on the tables they were staged
         against (the double-buffer swap contract)."""
+        if self._resident is not None and self._flow is not None:
+            plan = self._plan_resident(wire_np, v4_only, depth, tcp_flags)
+            if plan is not None:
+                return plan
         flow_probe = None
         if self._flow is not None and wire_np.shape[1] in (4, 7):
             # Flow tier engaged: dispatch the fused probe NOW (its H2D
@@ -787,9 +814,130 @@ class TpuClassifier:
 
     def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
         """Second half: launch the classify on a prepare_packed plan."""
+        if plan.get("resident"):
+            return self._launch_resident(plan, apply_stats)
         if plan.get("flow"):
             return self._launch_flow(plan, apply_stats)
         return self._launch_wire(plan, apply_stats)
+
+    # -- resident serving loop (ISSUE-12) ------------------------------------
+
+    def _plan_resident(self, wire_np, v4_only, depth, tcp_flags):
+        """Plan + DISPATCH one admission through the resident fused
+        step (jaxpath.jitted_resident_step): unlike the multi-dispatch
+        plan there is no separate launch half — the whole admission is
+        one device program, already in flight when this returns; the
+        plan only carries what the materialize needs.  Returns None
+        when this admission cannot ride the resident path (wide
+        ruleIds, unsupported wire width) — the caller falls back to the
+        probe-then-classify plan, degrade never refuse."""
+        if wire_np.shape[1] not in (4, 7):
+            return None
+        tier = self._flow
+        pool = self._resident
+        # generation-ordering contract: capture the flow-generation
+        # snapshot BEFORE the table snapshot (see resident_gens_snapshot)
+        gens_snap = tier.resident_gens_snapshot()
+        ctx = pool.context(self)
+        if ctx is None:
+            pool.note("fallbacks")
+            return None
+        d = None
+        if depth is not None and ctx.path == "trie":
+            dclass, gen = depth
+            with self._lock:
+                cur_gen = self._depth_steer[3] if self._depth_steer else -1
+            if dclass is not None and gen == cur_gen:
+                d = int(dclass)
+        n = wire_np.shape[0]
+        kind = (wire_np[:, 0] & 3).astype(np.int32)
+        fn = jaxpath.jitted_resident_step(
+            tier.config.entries, tier.config.ways, ctx.path,
+            bool(v4_only) and ctx.path == "trie", d, ctx.d_max,
+            ctx.ov_dev is not None,
+        )
+        tables_args = (
+            (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
+            else (ctx.tdev,)
+        )
+        wire_dev = pool.stage_wire(self, wire_np)
+        fused, epoch = tier.resident_dispatch(
+            fn, tables_args, wire_dev, n, wire_np=wire_np,
+            tflags_np=tcp_flags, gens_snap=gens_snap,
+            alloc_note=pool.note_alloc,
+        )
+        pool.note("dispatches")
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
+        return {"resident": True, "fused": fused, "n": n, "kind": kind,
+                "epoch": epoch,
+                "pkt_len": self._wire4_pkt_len(wire_np)}
+
+    def _launch_resident(self, plan, apply_stats: bool) -> PendingClassify:
+        """Materialize half of the resident plan: ONE ~100 B fused
+        readback carries the merged verdicts, the hit bitmap and the
+        flow counters; statistics derive host-side from the verdicts +
+        the pkt_len column that never crossed the link (the wire8
+        readback contract) — verdict- and stats-bit-identical to the
+        multi-dispatch flow plan (the statecheck resident config and
+        the bench_resident oracle gate pin this)."""
+        tier = self._flow
+        n, kind, epoch = plan["n"], plan["kind"], plan["epoch"]
+        pkt_len = plan["pkt_len"]
+
+        def materialize() -> ClassifyOutput:
+            from ..daemon import stats_from_results  # lazy: no import cycle
+
+            res16, _hit, hits, stale, counts = (
+                jaxpath.split_resident_outputs(np.asarray(plan["fused"]), n)
+            )
+            inserts, evictions, promotes = counts
+            tier.stats.add(
+                hits=hits, misses=n - hits, stale_rejects=stale,
+                inserts=inserts, evictions=evictions, promotes=promotes,
+            )
+            tier.resident_note_materialized(epoch)
+            if evictions and tier.on_evict is not None:
+                try:
+                    tier.on_evict(evictions, inserts, epoch)
+                except Exception:
+                    pass
+            results, xdp = jaxpath.host_finalize_wire(res16, kind)
+            stats_delta = stats_from_results(results, pkt_len)
+            if apply_stats:
+                self._stats.add(stats_delta)
+            return ClassifyOutput(
+                results=results, xdp=xdp, stats_delta=stats_delta
+            )
+
+        return PendingClassify(materialize)
+
+    @property
+    def resident(self):
+        """The ResidentPool when the resident serving loop is enabled."""
+        return self._resident
+
+    def resident_counters(self):
+        """resident_* pool gauges for /metrics (empty when off)."""
+        return {} if self._resident is None else (
+            self._resident.counter_values()
+        )
+
+    def mark_resident_warm(self) -> None:
+        """Freeze the pool's prewarm allocation baseline (called by
+        scheduler.prewarm_ladder after the ladder warm): any pool
+        allocation after this is a serving-path allocation — the
+        zero-alloc steady-state gate."""
+        if self._resident is not None:
+            if self._flow is not None:
+                # the classic probe/insert warm bumped the host epoch
+                # past the donated device chain; re-sync it now so the
+                # first serving dispatch rides the chain, not a re-seed
+                self._flow.resident_seed_epoch()
+            self._resident.mark_warm()
 
     def _launch_flow(self, plan, apply_stats: bool) -> PendingClassify:
         """Complete a flow-tier plan: decode the probe's fused buffer,

@@ -229,7 +229,8 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             wire_codec: Optional[str] = None,
                             mesh: Optional[str] = None,
                             compressed: Optional[bool] = None,
-                            flow_table=None):
+                            flow_table=None,
+                            resident: Optional[bool] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -249,6 +250,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                 "--flow-table is a device-backend feature; the cpu "
                 "reference classifier serves stateless"
             )
+        if resident:
+            log.warning(
+                "--resident is a device-backend feature; the cpu "
+                "reference classifier serves the multi-dispatch path"
+            )
         return classifier_class("cpu")
     if backend == "tpu":
         import functools
@@ -260,6 +266,12 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             kw["wire_codec"] = wire_codec
         if compressed is not None:
             kw["compressed"] = compressed
+        if resident:
+            # zero-copy resident serving loop (ISSUE-12): one fused
+            # donated-buffer device program per admission; implies a
+            # flow tier (the classifier synthesizes a default table
+            # when none was configured)
+            kw["resident"] = True
         if flow_table is not None:
             # the stateful flow tier (infw.flow): a FlowConfig built at
             # launch (validated there) rides into every classifier
@@ -316,6 +328,44 @@ class _FlowCounters:
         return {f"{self._prefix}{k}": v for k, v in vals.items()}
 
 
+def _batch_from_wire(wire: np.ndarray, tcp_flags=None) -> PacketBatch:
+    """Rebuild a PacketBatch from a packed 4/7-word wire record (the
+    ring ingest's fallback for backends without the packed-wire
+    contract) — the host twin of kernels' unpack_wire."""
+    from .flow import host_unpack_wire
+
+    f = host_unpack_wire(np.asarray(wire, np.uint32))
+    return PacketBatch(
+        kind=f["kind"], l4_ok=f["l4_ok"], ifindex=f["ifindex"],
+        ip_words=f["ip_words"], proto=f["proto"],
+        dst_port=f["dst_port"], icmp_type=f["icmp_type"],
+        icmp_code=f["icmp_code"], pkt_len=f["pkt_len"],
+        tcp_flags=(
+            None if tcp_flags is None
+            else np.asarray(tcp_flags, np.int32).copy()
+        ),
+    )
+
+
+class _ResidentCounters:
+    """resident_* pool gauges as a /metrics provider (the
+    _FlowCounters getter-indirection pattern: survives classifier
+    reloads; a classifier without a resident pool renders nothing)."""
+
+    def __init__(self, clf_getter) -> None:
+        self._get = clf_getter
+
+    def counter_values(self):
+        clf = self._get()
+        rc = getattr(clf, "resident_counters", None)
+        if clf is None or rc is None:
+            return {}
+        try:
+            return rc()
+        except Exception:
+            return {}
+
+
 # --- daemon ------------------------------------------------------------------
 
 class Daemon:
@@ -350,6 +400,8 @@ class Daemon:
         patch_max_ops: Optional[int] = None,
         tenants: Optional[int] = None,
         flow_table=None,
+        resident: bool = False,
+        ring: Optional[str] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -370,6 +422,26 @@ class Daemon:
         self.flow_table = flow_table
         self._flow_attached: set = set()
         self._flow_age_last = 0.0
+        # Zero-copy resident serving (--resident / INFW_RESIDENT,
+        # ISSUE-12): the syncer's classifiers run the donated-buffer
+        # fused serving loop; resident_* pool gauges export on /metrics.
+        self.resident = bool(resident)
+        # Persistent pinned host ingest ring (--ring / INFW_RING): a
+        # preallocated shared-memory SPSC ring producers write packed
+        # wire records into IN PLACE — the ingest loop admits by ring
+        # cursor (no per-chunk file syscalls, no per-chunk numpy
+        # reallocation on the hot path); the popped slot views double
+        # as the H2D staging buffers and are released only after the
+        # dispatch that read them materialized.
+        self.ingest_ring = None
+        self._ring_inflight: deque = deque()
+        if ring:
+            from .ring import IngestRing
+
+            self.ingest_ring = IngestRing.create(
+                ring, slots=max(8, 2 * self.pipeline_depth + 4),
+                slot_packets=max(self.max_tick_packets, 4096),
+            )
         # Deadline-aware continuous microbatching (infw.scheduler): with
         # --deadline-us set, ingest jobs are sized by the LARGEST ladder
         # batch whose measured service time still fits the per-packet
@@ -463,6 +535,7 @@ class Daemon:
                 backend, fused_deep=fused_deep, wire_codec=wire_codec,
                 mesh=mesh, compressed=compressed,
                 flow_table=flow_table if backend != "cpu" else None,
+                resident=self.resident if backend != "cpu" else None,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -525,13 +598,26 @@ class Daemon:
         # patch-transaction counters + staleness histogram
         # (ingressnodefirewall_node_patch_txn_*)
         self.metrics_registry.register_counters(self.txn_stats)
-        if self.flow_table is not None and backend != "cpu":
+        if (self.flow_table is not None or self.resident) and backend != "cpu":
             # flow_* counters + occupancy gauge; the getter indirection
             # survives table reloads exactly like the wire counters
+            # (resident mode implies a flow tier, so its counters export
+            # here too)
             self._flow_counters = _FlowCounters(
                 lambda: self.syncer.classifier
             )
             self.metrics_registry.register_counters(self._flow_counters)
+        if self.resident and backend != "cpu":
+            # resident_* pool gauges (dispatches, context reuses,
+            # fallbacks, steady-state allocation counter) — the
+            # observability half of the zero-alloc contract
+            self._resident_counters = _ResidentCounters(
+                lambda: self.syncer.classifier
+            )
+            self.metrics_registry.register_counters(self._resident_counters)
+        if self.ingest_ring is not None:
+            # ring_* cursor/backpressure gauges
+            self.metrics_registry.register_counters(self.ingest_ring)
         if self.tenants_max:
             self.tenant_registry = self._build_tenant_registry()
             # tenant_* counters (active/free slabs, swaps, flips,
@@ -1285,6 +1371,72 @@ class Daemon:
                 drain_one()
         return processed
 
+    # -- ring ingest (persistent pinned host ring, ISSUE-12) -----------------
+
+    def process_ring_once(self, budget: Optional[int] = None) -> int:
+        """Drain committed ring records through the packed dispatch:
+        admission by ring cursor — the popped slot views ARE the H2D
+        staging buffers (zero-copy on the CPU backend), and each slot is
+        released back to the producer only after the dispatch that read
+        it materialized, so the producer can never overwrite a record
+        mid-copy.  Up to ``pipeline_depth`` dispatches stay in flight
+        (the same double-buffer discipline as the file ingest).  Returns
+        packets processed."""
+        ring = self.ingest_ring
+        if ring is None:
+            return 0
+        clf = self.syncer.classifier
+        if clf is None:
+            return 0
+        supports = getattr(clf, "supports_packed", None)
+        packed = supports is not None and supports()
+        if packed and getattr(clf, "active_path", None) is None:
+            return 0
+        if self._sched_policy is not None and packed:
+            self._maybe_prewarm_ladder(clf)
+        budget = self.max_tick_packets if budget is None else int(budget)
+        processed = 0
+        inflight = self._ring_inflight
+        while processed < budget:
+            chunk = ring.pop(timeout=0.0)
+            if chunk is None:
+                break
+            try:
+                if packed:
+                    plan = clf.prepare_packed(
+                        chunk.wire, chunk.v4_only,
+                        tcp_flags=chunk.tcp_flags,
+                    )
+                    pending = clf.classify_prepared(plan, apply_stats=True)
+                else:
+                    # non-packed backend (the cpu reference): rebuild
+                    # the batch from the wire record — slower, but the
+                    # ring must drain on every backend
+                    pending = clf.classify_async(
+                        _batch_from_wire(chunk.wire, chunk.tcp_flags),
+                        apply_stats=True,
+                    )
+            except Exception as e:
+                log.error("ring ingest dispatch failed: %s", e)
+                chunk.release()
+                continue
+            inflight.append((chunk, pending))
+            processed += chunk.wire.shape[0]
+            while len(inflight) > self.pipeline_depth:
+                self._ring_drain_one()
+        while inflight:
+            self._ring_drain_one()
+        return processed
+
+    def _ring_drain_one(self) -> None:
+        chunk, pending = self._ring_inflight.popleft()
+        try:
+            pending.result()
+        except Exception as e:
+            log.error("ring ingest classify failed: %s", e)
+        finally:
+            chunk.release()
+
     def _maybe_prewarm_ladder(self, clf) -> None:
         """Pre-warm every batch-size ladder shape against the CURRENT
         table generation, once per generation: shape-driven jit
@@ -1384,6 +1536,10 @@ class Daemon:
             except Exception as e:
                 log.error("tenant edit scan error: %s", e)
             try:
+                self.process_ring_once()
+            except Exception as e:
+                log.error("ring ingest error: %s", e)
+            try:
                 self.process_ingest_once()
             except Exception as e:
                 log.error("ingest error: %s", e)
@@ -1442,6 +1598,10 @@ class Daemon:
         self._event_file.close()
         if self._events_socket_sink is not None:
             self._events_socket_sink.close()
+        if self.ingest_ring is not None:
+            while self._ring_inflight:
+                self._ring_drain_one()
+            self.ingest_ring.close()
 
     @property
     def actual_metrics_port(self) -> int:
@@ -1590,6 +1750,28 @@ def main(argv: Optional[List[str]] = None) -> int:
              "staleness.  CLI beats INFW_PATCH_MAX_OPS",
     )
     p.add_argument(
+        "--resident", action="store_true",
+        default=os.environ.get("INFW_RESIDENT", "")
+        not in ("", "0", "false", "no"),
+        help="zero-copy resident serving loop (tpu backend): one fused "
+             "device program per admission (wire decode + flow probe + "
+             "classify + stats + flow insert) over donated/aliased "
+             "device buffers — zero steady-state pool allocations, "
+             "resident_* gauges on /metrics.  Implies a flow table (a "
+             "default one is synthesized when --flow-table is absent).  "
+             "CLI beats INFW_RESIDENT",
+    )
+    p.add_argument(
+        "--ring",
+        default=os.environ.get("INFW_RING") or None,
+        help="persistent pinned host ingest ring: path of a "
+             "shared-memory ring file the daemon CREATES and consumes "
+             "(producers attach with tools/loadgen.py --ring PATH).  "
+             "Producers write packed wire records in place; the ingest "
+             "loop admits by ring cursor — no per-chunk file syscalls.  "
+             "CLI beats INFW_RING",
+    )
+    p.add_argument(
         "--events-socket",
         default=os.environ.get("INFW_EVENTS_SOCKET", ""),
         help="unixgram socket to ship deny-event lines to (the events "
@@ -1651,6 +1833,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             p.error(str(e))
 
+    # Resident/ring knobs share the launch-time validation posture.
+    if args.resident and args.backend == "cpu":
+        p.error("--resident requires the tpu backend (the cpu reference "
+                "classifier has no device-resident serving loop)")
+    if args.ring:
+        ring_dir = os.path.dirname(os.path.abspath(args.ring)) or "."
+        if not os.path.isdir(ring_dir):
+            p.error(f"--ring directory does not exist: {ring_dir}")
+
     # Same launch-time validation posture as the wire codec: a bad
     # INFW_MESH (or --mesh) must fail here with a usage error, not raise
     # inside the sync loop and leave an empty PASS-everything dataplane.
@@ -1703,6 +1894,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         patch_max_ops=args.patch_max_ops,
         tenants=int(args.tenants) if args.tenants else None,
         flow_table=flow_cfg,
+        resident=args.resident,
+        ring=args.ring,
     )
     stop = threading.Event()
 

@@ -341,10 +341,13 @@ class HostFlowModel:
         return res16, hit, int(hit.sum()), int(stale.sum())
 
     def insert(self, wire, tenant, tflags, verdict16, epoch_now: int,
-               gens: Optional[np.ndarray] = None):
+               gens: Optional[np.ndarray] = None,
+               lane_ok: Optional[np.ndarray] = None):
         """Mirror of jaxpath._flow_insert_core -> (inserts, evictions,
         promotes).  ``gens`` overrides the generation stamp source (the
-        tier passes its probe-time snapshot)."""
+        tier passes its probe-time snapshot); ``lane_ok`` mirrors the
+        resident fused step's in-program miss mask (the host-compaction
+        equivalent — same eligible lanes, same order)."""
         cfg = self.config
         f, tenant, tflags, page, keyw, is_ip, cand = self._lanes(
             wire, tenant, tflags
@@ -357,6 +360,8 @@ class HostFlowModel:
         fin = is_tcp & ((tflags & TCP_FIN) != 0)
         rst = is_tcp & ((tflags & TCP_RST) != 0)
         elig = is_ip & (f["l4_ok"] != 0) & (page >= 0) & ~rst
+        if lane_ok is not None:
+            elig = elig & np.asarray(lane_ok, bool)
         ek = self.keys[cand]
         ese = self.se[cand]
         est = ese[:, :, 0]
@@ -473,6 +478,19 @@ class FlowTier:
         # per-(B,) cached inert tenant/flags device columns so the
         # common no-tenant/no-flags dispatch re-uploads nothing
         self._zeros_cache: Dict[int, tuple] = {}
+        # Resident-serving epoch chain (ISSUE-12): the fused resident
+        # step increments the epoch ON DEVICE and returns the aliased
+        # buffer, so steady-state dispatches upload nothing for it;
+        # _epoch_dev_val mirrors the device value so an interleaved
+        # classic probe (which bumps only the host counter) forces one
+        # re-seed instead of serving a torn epoch.
+        self._epoch_dev = None
+        self._epoch_dev_val = -1
+        #: ordered pending host-model mirrors of resident dispatches
+        #: (track_model only): the fused step's probe+insert must replay
+        #: into the model in DEVICE order, and the insert half needs the
+        #: merged verdicts — only host-resident at materialize time
+        self._mirror_q: list = []
         self.model = HostFlowModel(config) if track_model else None
 
     # -- generation / paging -------------------------------------------------
@@ -645,6 +663,117 @@ class FlowTier:
                 pass
         return inserts, evictions, promotes
 
+    # -- resident serving (donated-buffer fused step, ISSUE-12) --------------
+
+    def resident_gens_snapshot(self):
+        """(gens_dev, gens_host copy) captured under the lock — the
+        resident plan takes this BEFORE reading the table snapshot, so a
+        concurrent load_tables between the two capture points can only
+        make the stamped generation OLDER than the tables that compute
+        the verdicts (inserts then stale on arrival — safe; the reverse
+        order would stamp old-table verdicts as live)."""
+        with self._lock:
+            return self._gens_dev, self._gens_host.copy()
+
+    def resident_dispatch(self, fn, tables_args, wire_dev, b: int,
+                          wire_np: Optional[np.ndarray] = None,
+                          tenant_np: Optional[np.ndarray] = None,
+                          tflags_np: Optional[np.ndarray] = None,
+                          gens_snap=None, alloc_note=None):
+        """Run one fused resident step and chain the donated buffers:
+        ``fn(flow, gens, pages, epoch, *tables_args, wire, tenant,
+        tflags, max_age) -> (new flow, new epoch, fused)``.  The updated
+        columns and epoch REPLACE the resident state under the lock (the
+        inputs are consumed by donation), so consecutive dispatches form
+        one device-ordered chain.  Returns (fused device buffer, epoch).
+
+        ``alloc_note`` (the ResidentPool counter hook) is called once
+        per fresh device allocation this dispatch performs beyond the
+        wire staging — zero on the warmed steady state, which the bench
+        gate asserts."""
+        zt, zf = None, None
+        if tenant_np is None or tflags_np is None:
+            if b not in self._zeros_cache and alloc_note is not None:
+                alloc_note("zeros")
+            zt, zf = self._zeros(b)
+        tenant = (
+            zt if tenant_np is None
+            else self._put(np.ascontiguousarray(tenant_np, np.int32))
+        )
+        tflags = (
+            zf if tflags_np is None
+            else self._put(np.ascontiguousarray(tflags_np, np.int32))
+        )
+        with self._lock:
+            self._epoch += 1
+            epoch = self._epoch
+            if self._epoch_dev is not None and self._epoch_dev_val == epoch - 1:
+                epoch_dev = self._epoch_dev  # donated chain: no upload
+            else:
+                # first dispatch, or a classic probe bumped the host
+                # counter since: re-seed the device scalar once
+                epoch_dev = self._put(np.int32(epoch - 1))
+                if alloc_note is not None:
+                    alloc_note("epoch")
+            gens_dev = self._gens_dev if gens_snap is None else gens_snap[0]
+            pages_dev = self._pages_dev
+            new_flow, new_epoch, fused = fn(
+                self._flow, gens_dev, pages_dev, epoch_dev, *tables_args,
+                wire_dev, tenant, tflags, self._max_age_dev,
+            )
+            self._flow = new_flow
+            self._epoch_dev = new_epoch
+            self._epoch_dev_val = epoch
+            if self.model is not None:
+                gens_host = (
+                    self._gens_host.copy() if gens_snap is None
+                    else gens_snap[1]
+                )
+                self._mirror_q.append((
+                    epoch, np.asarray(wire_np, np.uint32).copy(),
+                    None if tenant_np is None else np.asarray(
+                        tenant_np, np.int32).copy(),
+                    None if tflags_np is None else np.asarray(
+                        tflags_np, np.int32).copy(),
+                    fused, gens_host,
+                ))
+        return fused, epoch
+
+    def resident_seed_epoch(self) -> None:
+        """Re-sync the device epoch chain to the host counter (one tiny
+        upload).  Called at warm-mark time: the classic probe/insert
+        warm bumps only the host epoch, so without this the FIRST
+        serving dispatch would pay the re-seed — a pool allocation the
+        zero-alloc steady-state gate would (rightly) flag."""
+        with self._lock:
+            if self._epoch_dev_val != self._epoch:
+                self._epoch_dev = self._put(np.int32(self._epoch))
+                self._epoch_dev_val = self._epoch
+
+    def resident_note_materialized(self, epoch: int) -> None:
+        """Replay pending host-model mirrors up to ``epoch`` in device
+        order (track_model only).  The fused step's insert half needs
+        the merged verdicts, which are host-resident only once the
+        dispatch materializes — draining in epoch order keeps the model
+        correct even when results are read out of dispatch order."""
+        if self.model is None:
+            return
+        from .kernels import jaxpath
+
+        with self._lock:
+            while self._mirror_q and self._mirror_q[0][0] <= epoch:
+                ep, wire_np, tenant_np, tflags_np, fused, gens_host = (
+                    self._mirror_q.pop(0)
+                )
+                res16, hit, _h, _s, _c = jaxpath.split_resident_outputs(
+                    np.asarray(fused), wire_np.shape[0]
+                )
+                self.model.probe(wire_np, tenant_np, tflags_np, ep)
+                self.model.insert(
+                    wire_np, tenant_np, tflags_np, res16, ep,
+                    gens=gens_host, lane_ok=~hit,
+                )
+
     def age(self, horizon: Optional[int] = None) -> int:
         """Free every entry last seen more than ``horizon`` epochs ago
         (default: the configured max_age) — the explicit reclamation
@@ -689,9 +818,14 @@ class FlowTier:
     def occupancy(self) -> int:
         from .kernels import jaxpath
 
+        # dispatch INSIDE the lock (like age): under the resident loop
+        # the columns are DONATED per admission — a snapshot taken off
+        # the lock could be deleted by a concurrent dispatch before the
+        # occupancy program reads it ("Array has been deleted")
         with self._lock:
-            flow = self._flow
-        return int(np.asarray(jaxpath.jitted_flow_occupancy()(flow.se)))
+            return int(np.asarray(
+                jaxpath.jitted_flow_occupancy()(self._flow.se)
+            ))
 
     @property
     def epoch(self) -> int:
@@ -700,12 +834,14 @@ class FlowTier:
 
     def flow_columns(self) -> Dict[str, np.ndarray]:
         """Host copies of the device columns (the model-checker compare
-        side)."""
+        side).  Materialized INSIDE the lock: the resident loop donates
+        these buffers per admission, so an off-lock snapshot could be
+        deleted by a concurrent dispatch mid-read."""
         with self._lock:
             flow = self._flow
-        return {
-            k: np.asarray(getattr(flow, k)) for k in flow._fields
-        }
+            return {
+                k: np.asarray(getattr(flow, k)) for k in flow._fields
+            }
 
     def counter_values(self) -> Dict[str, int]:
         """flow_* counters + occupancy gauge for /metrics."""

@@ -38,11 +38,22 @@ class KernelEntrypoint(NamedTuple):
 
     ``build(batch_size)`` returns ``(jitted_fn, args)`` ready to trace or
     call; building twice at the same size must return the SAME jitted
-    object (the factory-identity half of the recompile lint)."""
+    object (the factory-identity half of the recompile lint).
+
+    ``donate`` declares the entrypoint's donated operand positions
+    (jax.jit donate_argnums) — the contract the jaxcheck donation lint
+    verifies against the compiled program's input_output_alias map:
+    every declared donated array leaf must actually alias an output, or
+    XLA is silently copying a buffer the serving loop believes it
+    reuses in place.  Donating entrypoints consume their operands, so
+    the executing lints rebuild args per run.  Every resident-loop
+    entrypoint MUST declare its donated operands (registry-level rule,
+    also lint-enforced)."""
 
     name: str
     kind: str  # "xla" | "pallas"
     build: Callable[[int], Tuple[Callable, tuple]]
+    donate: Tuple[int, ...] = ()
 
 
 # -- canonical fixtures ------------------------------------------------------
@@ -523,6 +534,89 @@ def _build_flow_insert(b: int):
     return fn, (flow, gens, pages, wire, zeros, zeros, verdicts, epoch)
 
 
+# -- resident serving loop fixtures/builders (ISSUE-12) ----------------------
+#
+# The donated-buffer fused step (jaxpath.jitted_resident_step): wire
+# decode + flow probe + stateless classify + merge + stats + miss insert
+# in ONE program, flow columns + epoch donated.  Builders return FRESH
+# donated operands on every call — execution consumes them (the
+# executing lints rebuild per run, keyed off the declared donate tuple).
+
+
+def _resident_operands(b: int):
+    """Fresh flow columns + steering scalars for one resident trace."""
+    import jax
+
+    from ..flow import FlowConfig
+    from . import jaxpath
+
+    cfg = FlowConfig.make(entries=512)
+    C = cfg.capacity
+    flow = jaxpath.FlowTable(
+        keys=jax.device_put(np.zeros((C, 8), np.uint32)),
+        vg=jax.device_put(np.zeros((C, 2), np.int32)),
+        se=jax.device_put(np.zeros((C, 2), np.int32)),
+        cnt=jax.device_put(np.zeros((C, 3), np.int32)),
+    )
+    gens = jax.device_put(np.zeros(1, np.int32))
+    pages = jax.device_put(np.zeros(1, np.int32))
+    epoch = jax.device_put(np.int32(0))
+    max_age = jax.device_put(np.int32(cfg.max_age))
+    zeros = jax.device_put(np.zeros(b, np.int32))
+    return cfg, flow, gens, pages, epoch, max_age, zeros
+
+
+def _build_resident_fused(b: int):
+    """The resident fused serving step over the mixed 7-word wire."""
+    from . import jaxpath
+
+    cfg, flow, gens, pages, epoch, max_age, zeros = _resident_operands(b)
+    fn = jaxpath.jitted_resident_step(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False
+    )
+    return fn, (flow, gens, pages, epoch, _fixture_device_tables(True),
+                _fixture_wire(b), zeros, zeros, max_age)
+
+
+def _build_resident_ring_fused(b: int):
+    """The resident step fed from an ingest-ring slot: the v4-compact
+    4-word record is packed IN PLACE into a mapped ring slot and the
+    H2D staging device_put reads straight out of the mapping — the
+    exact producer->consumer->device path of the --ring daemon mode."""
+    import tempfile
+
+    import jax
+
+    from ..ring import IngestRing
+    from . import jaxpath
+
+    batch = _fixture_batch(b)
+    idx = np.nonzero(np.asarray(batch.kind) != 2)[0]
+    if len(idx) == 0:
+        raise EntrypointUnavailable("canonical corpus has no v4 packets")
+    v4 = batch.take(idx)
+    v4.ip_words[:, 1:] = 0
+    wire_np = v4.pack_wire_v4()
+    n = wire_np.shape[0]
+    with tempfile.TemporaryDirectory() as d:
+        ring = IngestRing.create(f"{d}/audit.ring", slots=2,
+                                 slot_packets=max(n, 8))
+        wv, _fl, token = ring.reserve(n, 4)
+        np.copyto(wv, wire_np)
+        ring.commit(token, v4_only=True)
+        chunk = ring.pop(timeout=1.0)
+        wire = jax.device_put(np.ascontiguousarray(chunk.wire, np.uint32))
+        chunk.release()
+        ring.close()
+    cfg, flow, gens, pages, epoch, max_age, _z = _resident_operands(b)
+    zeros = jax.device_put(np.zeros(n, np.int32))
+    fn = jaxpath.jitted_resident_step(
+        cfg.entries, cfg.ways, "trie", True, None, 0, False
+    )
+    return fn, (flow, gens, pages, epoch, _fixture_device_tables(True),
+                wire, zeros, zeros, max_age)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -732,6 +826,14 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "patch/flow-insert", "xla", _build_flow_insert
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-fused", "xla", _build_resident_fused,
+            donate=(0, 3),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-ring-fused", "xla",
+            _build_resident_ring_fused, donate=(0, 3),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

@@ -2789,24 +2789,16 @@ def rule_scan(rows: jax.Array, batch: DeviceBatch) -> jax.Array:
     return result.astype(jnp.uint32)
 
 
-def finalize(result: jax.Array, batch: DeviceBatch) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Ethertype/kind dispatch and stats (kernel.c:412-457, 361-400).
-
-    Returns (results, xdp, stats) where stats is (MAX_TARGETS, STATS_COLS)
-    int32 per-batch sums."""
+def result_stats(result: jax.Array, batch: DeviceBatch) -> jax.Array:
+    """(MAX_TARGETS, STATS_COLS) int32 per-batch statistics from PACKED
+    results (kernel.c:361-400: allow/deny only, ruleId < MAX_TARGETS) —
+    the stats half of finalize, exposed so the resident fused step can
+    derive statistics from the MERGED flow-hit/stateless verdict vector
+    on device (the in-program twin of daemon.stats_from_results; the
+    host merge is jaxpath.merge_stats_host either way)."""
     is_ip = (batch.kind == KIND_IPV4) | (batch.kind == KIND_IPV6)
-    looked_up = is_ip & (batch.l4_ok != 0)
-    result = jnp.where(looked_up, result, 0).astype(jnp.uint32)
-
     action = (result & 0xFF).astype(jnp.int32)
     rule_id = ((result >> 8) & 0xFFFFFF).astype(jnp.int32)
-
-    xdp = jnp.where(
-        batch.kind == KIND_MALFORMED,
-        XDP_DROP,
-        jnp.where(is_ip & (action == DENY), XDP_DROP, XDP_PASS),
-    ).astype(jnp.int32)
-
     allow = (action == ALLOW) & is_ip
     deny = (action == DENY) & is_ip
     recorded = (allow | deny) & (rule_id < MAX_TARGETS)
@@ -2818,7 +2810,27 @@ def finalize(result: jax.Array, batch: DeviceBatch) -> Tuple[jax.Array, jax.Arra
     d = deny.astype(jnp.int32)
     data = jnp.stack([a, a * hi, a * lo, d, d * hi, d * lo], axis=1)  # (B,6)
     stats = jax.ops.segment_sum(data, sid, num_segments=MAX_TARGETS + 1)[:MAX_TARGETS]
-    return result, xdp, stats.astype(jnp.int32)
+    return stats.astype(jnp.int32)
+
+
+def finalize(result: jax.Array, batch: DeviceBatch) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Ethertype/kind dispatch and stats (kernel.c:412-457, 361-400).
+
+    Returns (results, xdp, stats) where stats is (MAX_TARGETS, STATS_COLS)
+    int32 per-batch sums."""
+    is_ip = (batch.kind == KIND_IPV4) | (batch.kind == KIND_IPV6)
+    looked_up = is_ip & (batch.l4_ok != 0)
+    result = jnp.where(looked_up, result, 0).astype(jnp.uint32)
+
+    action = (result & 0xFF).astype(jnp.int32)
+
+    xdp = jnp.where(
+        batch.kind == KIND_MALFORMED,
+        XDP_DROP,
+        jnp.where(is_ip & (action == DENY), XDP_DROP, XDP_PASS),
+    ).astype(jnp.int32)
+
+    return result, xdp, result_stats(result, batch)
 
 
 def gather_rule_rows(rules: jax.Array, tidx: jax.Array) -> jax.Array:
@@ -4027,13 +4039,18 @@ def unpack_bits32_host(words: np.ndarray, b: int) -> np.ndarray:
     return bits.reshape(-1)[:b].astype(bool)
 
 
-def _flow_probe_core(
+def _flow_probe_parts(
     flow: FlowTable, gens: jax.Array, page_table: jax.Array,
     batch: DeviceBatch, tenant: jax.Array, tflags: jax.Array,
     epoch_now: jax.Array, max_age: jax.Array,
     *, slab_entries: int, ways: int,
 ):
-    """The shared probe body -> (fused output, updated mutable columns).
+    """The shared probe body -> (served u32 verdicts, hit mask, stale
+    mask, updated mutable columns) — un-fused so the resident serving
+    step (jitted_resident_step) can compose the probe with the stateless
+    classify and the miss insert inside ONE device program; the classic
+    probe dispatch (_flow_probe_core) packs these parts into its fused
+    readback buffer bit-identically.
 
     A hit requires: eligible lane (real IP, l4 parsed, tenant mapped to
     a flow slab), exact 8-word key match, serve-eligible state (>= EST),
@@ -4106,6 +4123,21 @@ def _flow_probe_core(
         ),
         mode="drop",
     )
+    return served, hit, stale, flow._replace(se=se, cnt=cnt)
+
+
+def _flow_probe_core(
+    flow: FlowTable, gens: jax.Array, page_table: jax.Array,
+    batch: DeviceBatch, tenant: jax.Array, tflags: jax.Array,
+    epoch_now: jax.Array, max_age: jax.Array,
+    *, slab_entries: int, ways: int,
+):
+    """The classic probe dispatch: _flow_probe_parts packed into the
+    fused readback buffer -> (fused output, updated mutable columns)."""
+    served, hit, stale, updated = _flow_probe_parts(
+        flow, gens, page_table, batch, tenant, tflags, epoch_now,
+        max_age, slab_entries=slab_entries, ways=ways,
+    )
     fused = jnp.concatenate([
         _pack_res16(served.astype(jnp.uint16)),
         _pack_bits32(hit),
@@ -4114,7 +4146,7 @@ def _flow_probe_core(
             jnp.sum(stale.astype(jnp.int32)),
         ]),
     ])
-    return fused, flow._replace(se=se, cnt=cnt)
+    return fused, updated
 
 
 def split_flow_probe_outputs(
@@ -4149,7 +4181,7 @@ def _flow_insert_core(
     flow: FlowTable, gens: jax.Array, page_table: jax.Array,
     batch: DeviceBatch, tenant: jax.Array, tflags: jax.Array,
     verdict16: jax.Array, epoch_now: jax.Array,
-    *, slab_entries: int, ways: int,
+    *, slab_entries: int, ways: int, lane_ok: Optional[jax.Array] = None,
 ):
     """Batch insert of miss-lane verdicts -> (updated FlowTable, (4,)
     int32 [inserts, evictions, promotes, 0]).
@@ -4161,7 +4193,15 @@ def _flow_insert_core(
     WINNER lane per slot (the last eligible lane in batch order) does
     the .set() writes, so duplicate-slot scatters stay deterministic;
     per-flow counters initialize from segment sums over ALL eligible
-    lanes that chose the slot."""
+    lanes that chose the slot.
+
+    ``lane_ok`` (the resident fused step) restricts eligibility to a
+    caller-provided lane mask — the in-program form of the host-side
+    miss compaction: the classic multi-dispatch path compacts the miss
+    lanes into a pow2 bucket before this kernel sees them, the fused
+    step instead masks the hit lanes out.  Eligible-lane identity and
+    relative order are the same either way, so winner selection and the
+    counter segment sums stay bit-identical."""
     C = flow.se.shape[0]
     page = _arena_pages(page_table, tenant)
     keyw = flow_key_words(batch, tenant)
@@ -4172,6 +4212,8 @@ def _flow_insert_core(
     fin = is_tcp & ((tflags & TCP_FIN) != 0)
     rst = is_tcp & ((tflags & TCP_RST) != 0)
     elig = is_ip & (batch.l4_ok != 0) & (page >= 0) & ~rst
+    if lane_ok is not None:
+        elig = elig & lane_ok
     cand = _flow_slots(keyw, page, slab_entries=slab_entries, ways=ways)
     ek = jnp.take(flow.keys, cand, axis=0, mode="clip")
     ese = jnp.take(flow.se, cand, axis=0, mode="clip")
@@ -4280,3 +4322,148 @@ def jitted_flow_age():
 @functools.lru_cache(maxsize=None)
 def jitted_flow_occupancy():
     return jax.jit(lambda se: jnp.sum((se[:, 0] > 0).astype(jnp.int32)))
+
+
+# === resident serving step (zero-copy donated-buffer loop, ISSUE-12) =========
+#
+# ONE fused device program per admission: wire decode + flow probe +
+# stateless classify + verdict merge + device stats + miss insert — the
+# in-program composition of the probe-then-classify multi-dispatch plan
+# (backend/tpu.py _launch_flow), which pays three launches and two
+# blocking host round-trips per admission.  The mutable flow columns and
+# the epoch scalar are DONATED (jax.jit donate_argnums input-output
+# aliasing): XLA writes the updated columns back into the very buffers
+# the previous dispatch produced, so the steady-state loop performs zero
+# flow-state device allocations and the epoch never crosses the link —
+# the program increments it on device and hands the aliased buffer to
+# the next dispatch.
+#
+# Bit-identity contract (gated by statecheck's `resident` config, the
+# bench_resident oracle gate and tests/test_resident.py): the merged
+# verdict vector, the statistics and the post-dispatch flow columns are
+# bit-identical to what the multi-dispatch plan produces for the same
+# wire chunk — the probe/insert bodies are the SAME functions
+# (_flow_probe_parts / _flow_insert_core), the stateless classify is the
+# same forward pass over every lane (the hit lanes' results fall out of
+# the merge instead of being skipped by host compaction), and the insert
+# masks hit lanes via lane_ok instead of host-compacting the misses
+# (same eligible-lane set and order -> same winner scatters).
+
+
+def _resident_step_core(
+    flow: FlowTable, gens: jax.Array, page_table: jax.Array,
+    epoch: jax.Array, tdev, wire: jax.Array, tenant: jax.Array,
+    tflags: jax.Array, max_age: jax.Array, ov=None,
+    *, slab_entries: int, ways: int, path: str, v4_only: bool,
+    depth: Optional[int], d_max: int,
+):
+    batch = unpack_wire(wire)
+    e1 = (epoch + jnp.int32(1)).astype(jnp.int32)
+    served, hit, stale, flow1 = _flow_probe_parts(
+        flow, gens, page_table, batch, tenant, tflags, e1, max_age,
+        slab_entries=slab_entries, ways=ways,
+    )
+    # stateless classify of EVERY lane against the SAME table snapshot:
+    # the hit lanes' stateless results are discarded by the merge below
+    # (at the small-batch rungs the extra lanes are far cheaper than a
+    # second launch + host compaction round-trip)
+    if path == "ctrie":
+        if ov is not None:
+            res, _x, _s = classify_ctrie_with_overlay(
+                tdev, ov, batch, d_max=d_max
+            )
+        else:
+            res, _x, _s = classify_ctrie(tdev, batch, d_max=d_max)
+    else:
+        t = tdev
+        use_trie = path == "trie"
+        if use_trie and v4_only:
+            t = t._replace(
+                trie_levels=t.trie_levels[: v4_trie_depth(len(t.trie_levels))]
+            )
+        elif use_trie and depth is not None:
+            t = t._replace(trie_levels=t.trie_levels[: 1 + depth])
+        if ov is not None:
+            res, _x, _s = classify_with_overlay(t, ov, batch,
+                                                use_trie=use_trie)
+        else:
+            res, _x, _s = classify(t, batch, use_trie=use_trie)
+    # the wire contract (check_wire_ruleids at plan time) guarantees the
+    # stateless result fits 16 bits, exactly like the fused wire path
+    merged = jnp.where(hit, served, res & 0xFFFF).astype(jnp.uint32)
+    flow2, counts = _flow_insert_core(
+        flow1, gens, page_table, batch, tenant, tflags, merged, e1,
+        slab_entries=slab_entries, ways=ways, lane_ok=~hit,
+    )
+    # res16-only readback (the wire8 contract): per-ruleId statistics
+    # derive HOST-side from the merged verdicts + the pkt_len column
+    # that never left the host — shipping the (1024, 6) stats tensor
+    # would cost ~24 KB per admission, dwarfing the ~100 B the resident
+    # loop actually needs back
+    fused = jnp.concatenate([
+        _pack_res16(merged.astype(jnp.uint16)),
+        _pack_bits32(hit),
+        jnp.stack([
+            jnp.sum(hit.astype(jnp.int32)),
+            jnp.sum(stale.astype(jnp.int32)),
+        ]),
+        counts,
+    ])
+    return flow2, e1, fused
+
+
+def split_resident_outputs(arr: np.ndarray, b: int):
+    """Host inverse of the resident step's fused buffer -> (res16[b],
+    hit mask, hits, stale, (inserts, evictions, promotes)).  ~100 B per
+    admission — statistics derive host-side (the wire8 contract)."""
+    nw = (b + 1) // 2
+    nh = -(-b // 32)
+    res16 = unpack_res16_host(arr[:nw], b)
+    hit = unpack_bits32_host(arr[nw : nw + nh], b)
+    hits, stale = int(arr[nw + nh]), int(arr[nw + nh + 1])
+    counts = tuple(int(x) for x in arr[nw + nh + 2 : nw + nh + 5])
+    return res16, hit, hits, stale, counts
+
+
+#: donated operand positions of the resident step — the flow column
+#: pytree and the device epoch scalar; declared here so the entrypoint
+#: registry and the jaxcheck donation lint share one source of truth
+RESIDENT_DONATE_ARGNUMS = (0, 3)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_resident_step(
+    slab_entries: int, ways: int, path: str, v4_only: bool = False,
+    depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
+):
+    """The resident fused executable, cache-keyed on (flow geometry,
+    layout path, wire format specialization) — batch shape and the trie
+    level count specialize through jit's shape/pytree keying, so a
+    warmed ladder serves every admission with zero recompiles (the same
+    contract as every other serving factory, test-pinned).
+
+    Signature: f(flow, gens, page_table, epoch, tables[, overlay], wire,
+    tenant, tflags, max_age) -> (new flow columns, new epoch, fused
+    readback).  ``flow`` and ``epoch`` are DONATED: the returned columns
+    and epoch alias the input buffers in place (XLA input_output_alias;
+    the jaxcheck donation lint fails if a donated buffer is silently
+    copied), so the caller must treat the inputs as consumed and chain
+    the returned arrays into the next dispatch."""
+    kw = dict(slab_entries=slab_entries, ways=ways, path=path,
+              v4_only=v4_only, depth=depth, d_max=d_max)
+    if overlay:
+        def f(flow, gens, page_table, epoch, tdev, ov, wire, tenant,
+              tflags, max_age):
+            return _resident_step_core(
+                flow, gens, page_table, epoch, tdev, wire, tenant,
+                tflags, max_age, ov=ov, **kw,
+            )
+    else:
+        def f(flow, gens, page_table, epoch, tdev, wire, tenant,
+              tflags, max_age):
+            return _resident_step_core(
+                flow, gens, page_table, epoch, tdev, wire, tenant,
+                tflags, max_age, **kw,
+            )
+
+    return jax.jit(f, donate_argnums=RESIDENT_DONATE_ARGNUMS)

@@ -488,6 +488,49 @@ def _delta_crc(payload: np.ndarray, dict_vals: np.ndarray,
     return _zlib.crc32(np.ascontiguousarray(ifmap, "<i4").tobytes(), crc)
 
 
+#: sticky gate: once the native library fails to load/bind, stop
+#: retrying per chunk (the pack-subset pattern)
+_native_delta_unavailable = False
+
+
+def _encode_delta_native(
+    w: np.ndarray, max_bytes_per_pkt: Optional[float]
+) -> Optional[DeltaWire]:
+    """Native (C++) single-pass delta encode — byte-identical to the
+    NumPy reference below (differentially tested); raises on library
+    unavailability so the caller can fall back, returns None on the
+    same non-qualification conditions."""
+    import ctypes
+
+    from .backend.cpu_ref import load_library
+
+    lib = load_library()
+    n = w.shape[0]
+    wc = np.ascontiguousarray(w, np.uint32)
+    payload = np.empty(8 * n, np.uint8)
+    dict_vals = np.empty(256, np.uint32)
+    ifmap = np.empty(16, np.int32)
+    perm = np.empty(n, np.int64)
+    meta = np.zeros(3, np.int32)
+    p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+    total = lib.infw_encode_delta(
+        n, p(wc, ctypes.c_uint32), p(payload, ctypes.c_uint8),
+        p(dict_vals, ctypes.c_uint32), p(ifmap, ctypes.c_int32),
+        p(perm, ctypes.c_int64), p(meta, ctypes.c_int32),
+    )
+    if total < 0:
+        return None
+    payload = payload[:total].copy()
+    if max_bytes_per_pkt is not None and len(payload) >= max_bytes_per_pkt * n:
+        return None
+    dict_vals = dict_vals[: int(meta[0])].copy()
+    return DeltaWire(
+        payload=payload, dict_vals=dict_vals, ifmap=ifmap, perm=perm,
+        n=n, dict_mode=int(meta[1]), fixed_w=int(meta[2]),
+        crc=_delta_crc(payload, dict_vals, ifmap),
+    )
+
+
 def encode_delta_wire(
     w: np.ndarray, max_bytes_per_pkt: Optional[float] = None
 ) -> Optional[DeltaWire]:
@@ -496,9 +539,22 @@ def encode_delta_wire(
     values, n == 0) or — with ``max_bytes_per_pkt`` set (the auto-codec
     gate) — when the compressed payload would not beat that budget.
     Qualification mirrors wire8: pkt_len never ships (host statistics),
-    ifindex travels as a 4-bit dictionary."""
+    ifindex travels as a 4-bit dictionary.
+
+    Dispatches to the native C++ single-pass encoder when available
+    (ISSUE-12 part 4: host packing is the residual cost of the
+    non-resident delta path once dispatch is one fused program — the
+    sort + five vectorized sweeps below collapse into one pass); the
+    NumPy body is the differentially-tested reference fallback."""
     if w.shape[1] != 4 or w.shape[0] == 0:
         return None
+    global _native_delta_unavailable
+    if not _native_delta_unavailable:
+        try:
+            return _encode_delta_native(w, max_bytes_per_pkt)
+        except (OSError, ImportError, AttributeError, AssertionError,
+                _subprocess.SubprocessError):
+            _native_delta_unavailable = True
     n = w.shape[0]
     w0 = w[:, 0]
     d = _ifindex_dict(w[:, 2])

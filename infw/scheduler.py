@@ -409,6 +409,19 @@ def prewarm_ladder(clf, ladder, include_depth_classes: bool = True,
             n_done += int(warm_flow([int(b) for b in ladder]) or 0)
         except Exception as e:  # degrade, never refuse
             log.debug("flow prewarm skipped: %s", e)
+    mark_resident = getattr(clf, "mark_resident_warm", None)
+    if mark_resident is not None:
+        # resident-pool-aware prewarm (ISSUE-12): the ladder loop above
+        # already compiled every resident fused program and allocated
+        # the per-rung pool state (zero columns, epoch seed, table
+        # context) through the production dispatch; freeze the pool's
+        # allocation baseline HERE so any later pool allocation is, by
+        # definition, a serving-path allocation — the zero-alloc
+        # steady-state gate bench_resident asserts
+        try:
+            mark_resident()
+        except Exception as e:  # degrade, never refuse
+            log.debug("resident warm mark skipped: %s", e)
     if service is not None:
         # seed the admission policy's service model with a COMPILE-FREE
         # timing sample per ladder step (the shapes are warm now), so

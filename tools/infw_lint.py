@@ -264,6 +264,9 @@ def cmd_jax(args) -> int:
         vmem_budget=args.vmem_budget,
         execute=not args.no_execute,
         include_transfer_defect=args.inject_transfer_defect,
+        include_donation_defect=getattr(
+            args, "inject_donation_defect", False
+        ),
     )
     summary = jaxcheck.summarize(reports)
     if args.json:
@@ -299,7 +302,7 @@ def cmd_jax(args) -> int:
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
-                         "arena-ctrie", "flow", "flow-ctrie")
+                         "arena-ctrie", "flow", "flow-ctrie", "resident")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -316,7 +319,7 @@ def _run_inject_defect(args, as_json: bool) -> int:
     fold feeds updater, resident state AND cold rebuild alike, so the
     catch again MUST be per-op-ground-truth oracle divergence, shrunk
     to a <= 2-op (delete, readd) reproducer."""
-    from infw import flow as flow_mod, txn as txn_mod
+    from infw import flow as flow_mod, resident as resident_mod, txn as txn_mod
     from infw.analysis import statecheck
     from infw.kernels import jaxpath
 
@@ -338,6 +341,15 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # replayed traffic stream after an edit — shrinking to
         # (flow_traffic, edit, flow_traffic) plus slack
         "flowstale": (flow_mod, "_INJECT_FLOW_STALE_BUG", "flow", 4),
+        # stale donated serving loop: the resident pool's table-
+        # generation staleness check is dropped (infw.resident), so
+        # after a rule patch the fused donated program keeps
+        # classifying against the PRE-patch captured table operands —
+        # caught by oracle divergence on the resident config's witness
+        # (the very next settled check after any edit), shrinking to a
+        # single edit op
+        "residentstale": (resident_mod, "_INJECT_RESIDENT_STALE_BUG",
+                          "resident", 3),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
@@ -488,6 +500,10 @@ def main(argv=None) -> int:
     p_jax.add_argument("--inject-transfer-defect", action="store_true",
                        help="append a deliberately defective host-operand "
                             "entrypoint (the audit must then fail)")
+    p_jax.add_argument("--inject-donation-defect", action="store_true",
+                       help="append a declared-donation entrypoint whose "
+                            "buffer XLA cannot alias (the donation lint "
+                            "must then fail)")
     p_jax.set_defaults(fn=cmd_jax)
 
     p_state = sub.add_parser("state", help="patch-path model checker")
@@ -512,7 +528,7 @@ def main(argv=None) -> int:
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
-                                  "flowstale"),
+                                  "flowstale", "residentstale"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

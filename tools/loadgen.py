@@ -46,19 +46,18 @@ from infw.daemon import write_frames_file_v2  # noqa: E402
 from infw.obs.pcap import FramesBuf, build_frames_bulk  # noqa: E402
 
 
-def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
-                ifindex: int, established_fraction: float = 0.0,
-                file_packets: int = 4096):
+def synth_columns(rng: np.random.Generator, n: int, v6_fraction: float,
+                  established_fraction: float = 0.0,
+                  file_packets: int = 4096):
     """Uniform synthetic packet columns (no table bias — loadgen does
-    not know the daemon's ruleset) -> the build_frames_bulk inputs.
+    not know the daemon's ruleset), flow-pool expanded.
 
     ``established_fraction`` > 0 switches on flow locality: the columns
     draw from a flow pool via the chunk-aware assignment
     (infw.testing.flow_locality_fids, chunked at ``file_packets`` so
-    one dropped frames file is the cache's insert granularity) — the
-    hit-rate-ladder workload for a daemon running --flow-table.  Byte-
-    deterministic per (seed, arguments): two runs offer identical
-    streams."""
+    one dropped frames file / ring record is the cache's insert
+    granularity) — the hit-rate-ladder workload for a daemon running
+    --flow-table.  Byte-deterministic per (seed, arguments)."""
     if established_fraction > 0.0:
         fid, _fresh, n_flows = testing.flow_locality_fids(
             rng, n, established_fraction, chunk_packets=file_packets
@@ -79,16 +78,117 @@ def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
     dst_port = rng.integers(0, 65536, n_flows).astype(np.int32)
     icmp_type = rng.integers(0, 256, n_flows).astype(np.int32)
     icmp_code = rng.integers(0, 3, n_flows).astype(np.int32)
-    fb = build_frames_bulk(kind[fid], ip_words[fid], proto[fid],
-                           dst_port[fid], icmp_type[fid], icmp_code[fid])
+    return {
+        "kind": kind[fid], "ip_words": ip_words[fid], "proto": proto[fid],
+        "dst_port": dst_port[fid], "icmp_type": icmp_type[fid],
+        "icmp_code": icmp_code[fid],
+    }, n_flows
+
+
+def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
+                ifindex: int, established_fraction: float = 0.0,
+                file_packets: int = 4096):
+    """Synthetic columns -> frames buffer (the file-drop producer)."""
+    c, n_flows = synth_columns(rng, n, v6_fraction,
+                               established_fraction, file_packets)
+    fb = build_frames_bulk(c["kind"], c["ip_words"], c["proto"],
+                           c["dst_port"], c["icmp_type"], c["icmp_code"])
     fb.ifindex = np.full(n, int(ifindex), np.uint32)
     return fb, n_flows
 
 
+def synth_wire_batch(rng: np.random.Generator, n: int, v6_fraction: float,
+                     ifindex: int, established_fraction: float = 0.0,
+                     file_packets: int = 4096):
+    """Synthetic columns -> PacketBatch (the --ring producer: packed
+    wire records, no frames round-trip).  pkt_len is synthesized
+    deterministically; every synthetic proto is l4-parseable."""
+    from infw.packets import PacketBatch
+
+    c, n_flows = synth_columns(rng, n, v6_fraction,
+                               established_fraction, file_packets)
+    return PacketBatch(
+        kind=c["kind"],
+        l4_ok=np.ones(n, np.int32),
+        ifindex=np.full(n, int(ifindex), np.int32),
+        ip_words=np.ascontiguousarray(c["ip_words"], np.uint32),
+        proto=c["proto"],
+        dst_port=c["dst_port"],
+        icmp_type=c["icmp_type"],
+        icmp_code=c["icmp_code"],
+        pkt_len=rng.integers(60, 1500, n).astype(np.int32),
+    ), n_flows
+
+
+def _ring_main(args, rng, offs) -> int:
+    """Ring producer: one packed-wire record per --file-packets window,
+    written IN PLACE into the daemon's shared-memory ingest ring at its
+    first packet's scheduled arrival time (open-loop; a full ring blocks
+    and the stall is reported as schedule lag, never silently absorbed
+    into a stretched offered load)."""
+    from infw.ring import IngestRing
+
+    batch, n_flows = synth_wire_batch(
+        rng, args.n, args.v6_fraction, args.ifindex,
+        established_fraction=args.established_fraction,
+        file_packets=args.file_packets,
+    )
+    fp = int(args.file_packets)
+    n_rec = -(-args.n // fp)
+    rec_starts = offs[::fp][:n_rec]
+    summary = {
+        "n": int(args.n), "rate_pps": float(args.rate),
+        "process": f"burst:{args.burst}" if args.burst > 0 else "poisson",
+        "mode": "ring", "records": int(n_rec), "file_packets": fp,
+        "duration_s": float(offs[-1]), "seed": int(args.seed),
+        "established_fraction": float(args.established_fraction),
+        "n_flows": int(n_flows),
+    }
+    print(json.dumps(summary), flush=True)
+    if args.dry_run:
+        return 0
+    ring = IngestRing.attach(args.ring)
+    t0 = time.monotonic()
+    worst_lag = 0.0
+    for i in range(n_rec):
+        target = t0 + float(rec_starts[i])
+        lag = time.monotonic() - target
+        if lag < 0:
+            time.sleep(-lag)
+        else:
+            worst_lag = max(worst_lag, lag)
+        lo, hi = i * fp, min((i + 1) * fp, args.n)
+        # fused subset pack straight from the SoA columns, then one
+        # in-place copy into the reserved (mapped) slot — the producer
+        # allocates nothing per record beyond the pack scratch
+        wire, v4_only = batch.pack_wire_subset(
+            np.arange(lo, hi, dtype=np.int64)
+        )
+        wv, _fl, token = ring.reserve(wire.shape[0], wire.shape[1],
+                                      timeout=30.0)
+        np.copyto(wv, wire)
+        ring.commit(token, v4_only=v4_only)
+    done = time.monotonic() - t0
+    print(json.dumps({
+        "offered_duration_s": float(offs[-1]),
+        "actual_duration_s": done,
+        "worst_schedule_lag_s": worst_lag,
+        "fell_behind": worst_lag > 0.01,
+        **{k: int(v) for k, v in ring.counter_values().items()},
+    }), flush=True)
+    if worst_lag > 0.01:
+        print("loadgen: WARNING fell behind its open-loop schedule by "
+              f"{worst_lag*1e3:.1f} ms (ring backpressure or a slow "
+              "producer) — offered load was lower than requested",
+              file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="infw-loadgen", description=__doc__)
-    p.add_argument("--out", required=True,
-                   help="ingest directory of the target daemon")
+    p.add_argument("--out", default=None,
+                   help="ingest directory of the target daemon "
+                        "(file-drop mode; exactly one of --out/--ring)")
     p.add_argument("--rate", type=float, required=True,
                    help="offered load, packets/second")
     p.add_argument("--n", type=int, required=True, help="total packets")
@@ -112,6 +212,17 @@ def main(argv=None) -> int:
                         "each a full manifest-disciplined drop schedule "
                         "at its rung's flow locality (byte-deterministic "
                         "per --seed)")
+    p.add_argument("--ring", default=None,
+                   help="RING PRODUCER MODE: instead of dropping frames "
+                        "files, attach to a daemon's shared-memory "
+                        "ingest ring (--ring on the daemon side, which "
+                        "creates it) and write one PACKED WIRE record "
+                        "per --file-packets window IN PLACE at its "
+                        "scheduled time — no per-chunk file syscalls, "
+                        "no per-chunk buffer allocation; a full ring "
+                        "blocks (backpressure) and counts as schedule "
+                        "lag.  Record format: see README 'Resident "
+                        "serving'")
     p.add_argument("--dry-run", action="store_true",
                    help="print the schedule summary without writing or "
                         "sleeping")
@@ -120,6 +231,12 @@ def main(argv=None) -> int:
         p.error("--rate, --n and --file-packets must be positive")
     if not 0.0 <= args.established_fraction < 1.0:
         p.error("--established-fraction must be in [0, 1)")
+    if (args.out is None) == (args.ring is None):
+        p.error("exactly one of --out (file drops) or --ring (ring "
+                "producer) is required")
+    if args.ring and args.established_ladder:
+        p.error("--established-ladder emits file-drop sub-runs; use "
+                "--established-fraction with --ring")
 
     if args.established_ladder:
         # the hit-rate ladder: one full run per rung, each into its own
@@ -144,6 +261,8 @@ def main(argv=None) -> int:
                                       burst=args.burst)
     else:
         offs = testing.poisson_arrivals(rng, args.rate, args.n)
+    if args.ring:
+        return _ring_main(args, rng, offs)
     fb, n_flows = synth_batch(rng, args.n, args.v6_fraction, args.ifindex,
                               established_fraction=args.established_fraction,
                               file_packets=args.file_packets)
