@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
-	churn-bench flow-bench resident-bench native entry-check \
-	dryrun-multichip mesh-check spill-read wire-check lint \
+	churn-bench flow-bench resident-bench telemetry-bench native \
+	entry-check dryrun-multichip mesh-check spill-read wire-check lint \
 	static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
@@ -80,6 +80,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect sketchsat
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-donation-defect --entries defect/undonated-buffer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -191,10 +192,27 @@ flow-bench:
 resident-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --resident-bench
 
+# The device-resident telemetry tier (bench.bench_telemetry) standalone
+# at smoke scale off-TPU: served classify-throughput retention with the
+# in-kernel sketches on vs off at a FIXED OFFERED LOAD (70% of the
+# sketches-off capacity, calibrated in-record — telemetry must fit the
+# serving headroom; gated at INFW_TELEMETRY_RETENTION_MIN, default
+# 0.95, with the raw full-speed dispatch A/B reported ungated beside
+# it), a warmed zero-recompile/zero-alloc steady-state run with
+# sketches on (the resident-bench discipline), attack-detection
+# latency on synflood/denystorm traces (drained summaries must surface
+# the planted attacker), and a live in-process --telemetry --trace
+# daemon leg whose /metrics must serve the per-stage span histograms
+# and whose event log the per-tenant heavy-hitter summaries.  Verdicts
+# and sketch tensors are oracle-gated bit-exact in-tier, and the
+# statecheck telemetry config runs FIRST and gates record publication.
+telemetry-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --telemetry-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -2779,6 +2779,422 @@ def resident_bench_main() -> int:
     return 0
 
 
+def bench_telemetry(rng, on_tpu):
+    """ISSUE-13 telemetry tier (``make telemetry-bench``, folded into
+    bench-checked): the device-resident telemetry plane measured three
+    ways on seeded attack traces (testing.attack_trace_batch):
+
+    - RETENTION (the churn-bench discipline): served classify
+      throughput at a FIXED OFFERED LOAD — 70%% of the sketches-off
+      capacity, calibrated in-record — with sketches on vs off on the
+      resident serving loop, interleaved min-vs-min, gated at
+      INFW_TELEMETRY_RETENTION_MIN (default 0.95).  Telemetry must fit
+      inside the serving headroom at the operating point; a plane whose
+      cost pushed the dataplane past capacity fails the gate.  The RAW
+      full-speed dispatch A/B (resident fused, and the multi-dispatch
+      path's extra follow-on launch) is reported beside it as ungated
+      reference lines — on this 2-core CPU smoke the in-program
+      scatters cost ~10-20%% of the fused step, a share that shrinks to
+      noise on parallel device hardware but is priced honestly here;
+    - ORACLE GATE before any timing line: verdicts with telemetry on
+      bit-identical to the off path AND the CPU oracle, and the device
+      sketch tensors bit-identical to the HostSketchModel on a tracked
+      twin over the same chunks;
+    - DETECTION LATENCY: drains run per chunk from the attack's first
+      chunk; reported as chunks/packets until the drained summary
+      surfaces the planted attacker (top-talker for synflood/portscan,
+      deny-storm flag for denystorm);
+    - LIVE DAEMON: an in-process --telemetry --trace daemon ingests the
+      synflood trace; /metrics must serve the per-stage span histograms
+      and the events log the per-tenant heavy-hitter summaries.
+
+    Returns the record dict for the telemetry-bench gate."""
+    import json as json_mod
+    import urllib.request
+
+    from infw.backend.tpu import TpuClassifier
+    from infw.kernels.sketch import SketchSpec
+    from infw.scheduler import prewarm_ladder
+
+    out = {}
+    n_entries = 100_000 if on_tpu else 20_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.4,
+        ifindexes=(2, 3),
+    )
+    spec = SketchSpec.make()  # the production default geometry
+    bs = 256
+    trace, meta = testing.attack_trace_batch(
+        np.random.default_rng(1300), tables, bs * 80, mode="synflood",
+        chunk_packets=bs,
+    )
+    tflags = np.asarray(trace.tcp_flags, np.int32)
+    chunks = []
+    for lo in range(0, len(trace), bs):
+        sub = np.arange(lo, lo + bs, dtype=np.int64)
+        w, v4 = trace.pack_wire_subset(sub)
+        chunks.append((w, v4, np.ascontiguousarray(tflags[sub])))
+
+    from infw.flow import FlowConfig
+
+    fcfg = FlowConfig.make(entries=1 << 14)
+    clf_on = TpuClassifier(force_path="trie", flow_table=fcfg,
+                           resident=True, telemetry=spec)
+    clf_off = TpuClassifier(force_path="trie",
+                            flow_table=FlowConfig.make(entries=1 << 14),
+                            resident=True)
+    clf_con = TpuClassifier(force_path="trie", telemetry=spec)
+    clf_coff = TpuClassifier(force_path="trie")
+    for c in (clf_on, clf_off, clf_con, clf_coff):
+        c.load_tables(tables)
+        prewarm_ladder(c, (bs,))
+
+    # -- oracle + model bit-identity gate BEFORE any timing line -----------
+    ref = oracle.classify(tables, trace)
+    clf_chk = TpuClassifier(force_path="trie", telemetry=spec,
+                            telemetry_track_model=True)
+    clf_chk.load_tables(tables)
+    n_div = 0
+    off = 0
+    for w, v4, tf in chunks:
+        o_on = clf_on.classify_prepared(
+            clf_on.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+        ).result()
+        o_off = clf_off.classify_prepared(
+            clf_off.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+        ).result()
+        clf_chk.classify_prepared(
+            clf_chk.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+        ).result()
+        want = ref.results[off : off + len(w)]
+        n_div += int((o_on.results != want).sum())
+        n_div += int((o_on.results != o_off.results).sum())
+        off += len(w)
+    cols = clf_chk.telemetry.columns()
+    mcols = clf_chk.telemetry.model.columns()
+    for name in cols:
+        if not np.array_equal(cols[name], mcols[name]):
+            raise RuntimeError(
+                f"telemetry-bench sketch oracle mismatch: tensor "
+                f"{name!r} diverged from the host model"
+            )
+    if n_div:
+        raise RuntimeError(
+            f"telemetry-bench verdict mismatch: {n_div} divergences "
+            "(telemetry-on vs off vs CPU oracle)"
+        )
+    log(f"telemetry: oracle gate clean ({len(chunks)} chunks, sketch "
+        "tensors bit-identical to the host model)")
+
+    # -- retention A/B (interleaved min-vs-min) -----------------------------
+    def run_pass(clf):
+        if clf.flow is not None:
+            clf.flow.reset()
+        t0 = time.perf_counter()
+        for w, v4, tf in chunks:
+            clf.classify_prepared(
+                clf.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+        return time.perf_counter() - t0
+
+    clf_on.mark_resident_warm()
+    clf_off.mark_resident_warm()
+    reps = 5 if on_tpu else 3
+    best = {"on": 1e9, "off": 1e9, "con": 1e9, "coff": 1e9}
+    for _ in range(reps):
+        best["off"] = min(best["off"], run_pass(clf_off))
+        best["on"] = min(best["on"], run_pass(clf_on))
+        best["coff"] = min(best["coff"], run_pass(clf_coff))
+        best["con"] = min(best["con"], run_pass(clf_con))
+    raw_ab = best["off"] / max(best["on"], 1e-12)
+    raw_ab_classic = best["coff"] / max(best["con"], 1e-12)
+    log(f"telemetry: RAW full-speed A/B — resident fused sketches-on "
+        f"{best['on']*1e3:.1f} ms vs off {best['off']*1e3:.1f} ms over "
+        f"{len(trace)} pkts ({raw_ab:.3f}); multi-dispatch follow-on "
+        f"launch {raw_ab_classic:.3f} (both ungated reference lines)")
+    emit("raw full-speed dispatch A/B with telemetry sketches on "
+         "(resident fused serving loop, ungated reference)",
+         raw_ab, "ratio", vs_baseline=0.0)
+    emit("multi-dispatch telemetry A/B (one follow-on launch per "
+         "admission, ungated reference)",
+         raw_ab_classic, "ratio", vs_baseline=0.0)
+    out["raw_ab"] = float(raw_ab)
+    out["raw_ab_classic"] = float(raw_ab_classic)
+
+    # the GATED line: served throughput at a fixed offered load (70% of
+    # the sketches-off capacity) — telemetry must fit the headroom at
+    # the operating point.  Open-loop pacing: each admission waits for
+    # its ABSOLUTE scheduled time (never "dispatch then sleep"), so a
+    # side that cannot keep up visibly overruns the schedule instead of
+    # silently stretching the offered load.
+    cap_off = len(trace) / best["off"]
+    offered = 0.7 * cap_off
+    sched = np.arange(len(chunks)) * (bs / offered)
+    sched_end = len(trace) / offered
+
+    def run_offered(clf):
+        clf.flow.reset()
+        t0 = time.perf_counter()
+        for (w, v4, tf), s in zip(chunks, sched):
+            now = time.perf_counter() - t0
+            if now < s:
+                time.sleep(s - now)
+            clf.classify_prepared(
+                clf.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+        return max(time.perf_counter() - t0, sched_end)
+
+    best_o = {"on": 1e9, "off": 1e9}
+    for _ in range(reps):
+        best_o["off"] = min(best_o["off"], run_offered(clf_off))
+        best_o["on"] = min(best_o["on"], run_offered(clf_on))
+    ach_on = len(trace) / best_o["on"]
+    ach_off = len(trace) / best_o["off"]
+    retention = ach_on / max(ach_off, 1e-12)
+    log(f"telemetry: served throughput at {offered/1e3:.1f} K pkt/s "
+        f"offered (70% of sketches-off capacity {cap_off/1e3:.1f} K): "
+        f"on {ach_on/1e3:.1f} K vs off {ach_off/1e3:.1f} K -> retention "
+        f"{retention:.3f}")
+    emit("classify throughput retention with telemetry sketches on "
+         "(fixed offered load at 70% of sketches-off capacity, "
+         "resident serving loop, synflood trace)",
+         retention, "ratio", vs_baseline=0.0)
+    out["retention"] = float(retention)
+
+    # -- zero-recompile / zero-alloc steady state (telemetry ON) ------------
+    # the resident-bench discipline, with the telemetry plane enabled:
+    # a warmed run must leave the fused telemetry executable's cache and
+    # the resident pool's allocation counter exactly where the prewarm
+    # left them — telemetry must be compile-free and alloc-free on the
+    # steady serving path (the decimated drain is the only exception,
+    # and it reuses its buffers via the donated clear)
+    clf_on.mark_resident_warm()
+    fn_t = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False,
+        sketch=spec,
+    )
+    fn_t4 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", True, None, 0, False,
+        sketch=spec,
+    )
+    cache0 = fn_t._cache_size() + fn_t4._cache_size()
+    n_disp = 0
+    while n_disp < 300:
+        for w, v4, tf in chunks:
+            clf_on.classify_prepared(
+                clf_on.prepare_packed(w, v4, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            n_disp += 1
+            if n_disp >= 300:
+                break
+    grew = (fn_t._cache_size() + fn_t4._cache_size()) - cache0
+    allocs = clf_on.resident.steady_allocs()
+    if grew or allocs:
+        raise RuntimeError(
+            f"telemetry steady state not zero-cost: {grew} recompile(s), "
+            f"{allocs} pool allocation(s) across {n_disp} warmed "
+            "dispatches with sketches on"
+        )
+    log(f"telemetry steady state: {n_disp} fused dispatches with "
+        "sketches on, 0 recompiles, 0 pool allocations")
+    emit("telemetry-on steady-state recompiles + pool allocations per "
+         "300 warmed dispatches", float(grew + allocs), "events",
+         vs_baseline=0.0)
+    out["steady"] = float(grew + allocs)
+
+    # -- detection latency (per-chunk drains from the attack start) ---------
+    atk_srcs = {
+        ".".join(str(b) for b in int(s[0]).to_bytes(4, "big"))
+        for s, _k in meta["attackers"]
+    }
+    for mode in ("synflood", "denystorm"):
+        dtrace, dmeta = testing.attack_trace_batch(
+            np.random.default_rng(1400), tables, bs * 40, mode=mode,
+            chunk_packets=bs,
+        )
+        dflags = np.asarray(dtrace.tcp_flags, np.int32)
+        det = TpuClassifier(force_path="trie", telemetry=spec)
+        det.load_tables(tables)
+        tier = det.telemetry
+        tier.min_packets = 32
+        # per-window flag thresholds sit below the trace's nominal
+        # attack fraction (0.4): the flags fire on the attack windows
+        # and stay off on the pre-onset ones
+        tier.syn_flood_frac = 0.3
+        tier.deny_storm_frac = 0.3
+        start_chunk = dmeta["start"] // bs
+        srcs = {
+            ".".join(str(b) for b in int(s[0]).to_bytes(4, "big"))
+            if k == 1 else "v6"
+            for s, k in dmeta["attackers"]
+        }
+        detected_at = None
+        for ci in range(0, len(dtrace) // bs):
+            sub = np.arange(ci * bs, (ci + 1) * bs, dtype=np.int64)
+            w, v4 = dtrace.pack_wire_subset(sub)
+            det.classify_prepared(
+                det.prepare_packed(
+                    w, v4, tcp_flags=np.ascontiguousarray(dflags[sub])
+                ),
+                apply_stats=False,
+            ).result()
+            if ci < start_chunk:
+                continue
+            rec = tier.drain(force=True)[0]
+            hit = any(h["src"] in srcs for h in rec.top)
+            if mode == "synflood":
+                hit = hit and any(t["syn_flood"] for t in rec.tenants)
+            if mode == "denystorm":
+                hit = hit and any(t["deny_storm"] for t in rec.tenants)
+            if hit:
+                detected_at = ci - start_chunk + 1
+                break
+        if detected_at is None:
+            raise RuntimeError(
+                f"telemetry-bench: {mode} attacker never surfaced in "
+                "the drained summaries"
+            )
+        log(f"telemetry: {mode} detected after {detected_at} "
+            f"post-onset admission(s) ({detected_at * bs} packets)")
+        emit(f"attack detection latency ({mode}, drain-per-admission)",
+             float(detected_at), "admissions", vs_baseline=0.0)
+        out[f"detect_{mode}_admissions"] = float(detected_at)
+        det.close()
+
+    # -- live daemon leg: span histograms + heavy hitters from /metrics ----
+    import tempfile
+
+    from infw.daemon import Daemon, write_frames_file_v2
+    from infw.interfaces import Interface, InterfaceRegistry
+    from infw.obs.pcap import build_frames_bulk
+    from infw.spec import (
+        ACTION_DENY,
+        IngressNodeFirewallNodeState,
+        IngressNodeFirewallNodeStateSpec,
+        IngressNodeFirewallProtoRule,
+        IngressNodeFirewallProtocolRule,
+        IngressNodeFirewallRules,
+        IngressNodeProtocolConfig,
+        ObjectMeta,
+        PROTOCOL_TYPE_TCP,
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        reg = InterfaceRegistry()
+        reg.add(Interface(name="dummy0", index=10))
+        d = Daemon(
+            state_dir=os.path.join(td, "state"), node_name="bench",
+            backend="tpu", registry=reg, metrics_port=0, health_port=0,
+            file_poll_interval_s=0.02, telemetry=spec, telemetry_drain=512,
+            trace=True, trace_slow_us=1.0,
+        )
+        d.start()
+        ns = IngressNodeFirewallNodeState(
+            metadata=ObjectMeta(name="bench",
+                                namespace="ingress-node-firewall-system"),
+            spec=IngressNodeFirewallNodeStateSpec(interface_ingress_rules={
+                "dummy0": [IngressNodeFirewallRules(
+                    source_cidrs=["0.0.0.0/0"],
+                    rules=[IngressNodeFirewallProtocolRule(
+                        order=1,
+                        protocol_config=IngressNodeProtocolConfig(
+                            protocol=PROTOCOL_TYPE_TCP,
+                            tcp=IngressNodeFirewallProtoRule(ports=443),
+                        ),
+                        action=ACTION_DENY,
+                    )],
+                )],
+            }),
+        )
+        p = os.path.join(d.nodestates_dir, "bench.json")
+        with open(p + ".tmp", "w") as f:
+            json_mod.dump(ns.to_dict(), f)
+        os.replace(p + ".tmp", p)
+        deadline = time.time() + 60
+        while time.time() < deadline and d.syncer.classifier is None:
+            time.sleep(0.05)
+        if d.syncer.classifier is None:
+            raise RuntimeError("telemetry-bench daemon never synced rules")
+        fb = build_frames_bulk(
+            trace.kind, np.asarray(trace.ip_words, np.uint32),
+            trace.proto, trace.dst_port, trace.icmp_type, trace.icmp_code,
+        )
+        fb.ifindex = np.full(len(trace), 10, np.uint32)
+        write_frames_file_v2(os.path.join(d.ingest_dir, "atk.frames"), fb)
+        done = os.path.join(d.out_dir, "atk.frames.verdicts.json")
+        deadline = time.time() + 120
+        while time.time() < deadline and not os.path.exists(done):
+            time.sleep(0.05)
+        if not os.path.exists(done):
+            raise RuntimeError("telemetry-bench daemon never drained "
+                               "the attack trace")
+        tier = d.syncer.classifier.telemetry
+        tier.drain(force=True)
+        d.events_logger.drain_once()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{d.actual_metrics_port}/metrics", timeout=5
+        ).read().decode()
+        with open(d.events_path) as f:
+            ev = f.read()
+        d.stop()
+        if "ingressnodefirewall_node_span_us_bucket" not in body:
+            raise RuntimeError("telemetry-bench: /metrics served no "
+                               "span histograms from the live daemon")
+        if "telemetry_updates_total" not in body:
+            raise RuntimeError("telemetry-bench: /metrics served no "
+                               "telemetry counters")
+        top_lines = [ln for ln in ev.splitlines() if "top-talker" in ln]
+        if not any(src in ln for ln in top_lines for src in atk_srcs):
+            raise RuntimeError(
+                "telemetry-bench: live daemon summaries never surfaced "
+                f"the planted attacker(s) {sorted(atk_srcs)}; got "
+                f"{top_lines[:4]}"
+            )
+        log(f"telemetry: live daemon served span histograms + "
+            f"{len(top_lines)} heavy-hitter line(s); attacker surfaced")
+        out["daemon_leg"] = 1.0
+    emit("live-daemon telemetry leg (span histograms + heavy hitters)",
+         1.0, "ok", vs_baseline=0.0)
+    for c in (clf_on, clf_off, clf_con, clf_coff, clf_chk):
+        c.close()
+    return out
+
+
+def telemetry_bench_main() -> int:
+    """``make telemetry-bench``: the telemetry tier standalone (CPU
+    smoke off TPU) with the regression gates — classify retention with
+    sketches on must stay >= INFW_TELEMETRY_RETENTION_MIN (default
+    0.95), every detection leg must surface its planted attacker, and
+    the statecheck telemetry config runs FIRST and gates record
+    publication (the flow/churn/tenant/resident-bench discipline)."""
+    retention_min = float(
+        os.environ.get("INFW_TELEMETRY_RETENTION_MIN", "0.95")
+    )
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("telemetry", seed=0, n_ops=8,
+                                shrink_on_failure=False)
+    if not rep["ok"]:
+        log(f"telemetry-bench FAIL: statecheck telemetry not green "
+            f"before record publication: {rep['failure']}")
+        return 1
+    log(f"telemetry-bench: statecheck telemetry green "
+        f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_telemetry(rng, on_tpu)
+    emit_compact_record()
+    if not rec.get("retention", 0.0) >= retention_min:
+        log(f"telemetry-bench FAIL: retention "
+            f"{rec.get('retention', 0):.3f} < gate {retention_min}")
+        return 1
+    log("telemetry-bench OK: " + ", ".join(
+        f"{k}={v:.3f}" for k, v in sorted(rec.items())
+    ))
+    return 0
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -3120,4 +3536,6 @@ if __name__ == "__main__":
         sys.exit(flow_bench_main())
     if "--resident-bench" in sys.argv:
         sys.exit(resident_bench_main())
+    if "--telemetry-bench" in sys.argv:
+        sys.exit(telemetry_bench_main())
     sys.exit(main())

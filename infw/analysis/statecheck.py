@@ -117,6 +117,17 @@ TENANT_KINDS = (
 #: compare at every settled check.
 FLOW_KINDS = ("flow_traffic", "flow_age")
 
+#: telemetry-plane ops (telemetry configs only, ISSUE-13):
+#: ``sketch_traffic`` drives one seeded packet batch through the
+#: production classify dispatch with the telemetry tier engaged — every
+#: count-min / top-K / tenant-counter scatter the device performs is
+#: mirrored bit-exactly by the HostSketchModel, and the settled check
+#: compares every tensor; ``sketch_drain`` runs the decimated drain
+#: (snapshot + donated zero-reset + summary record), whose seq stamps
+#: must stay gap-free.  Batches reuse the flow_traffic substrate
+#: (flow_seed/count fields), so shrunk repros print unchanged.
+TELEMETRY_KINDS = ("sketch_traffic", "sketch_drain")
+
 #: explicit transaction-boundary record (txn-mode configs only): the
 #: driver buffers single-key ops and applies them as ONE folded
 #: transaction (infw.txn.fold_ops) at each boundary — checks run only
@@ -154,10 +165,10 @@ class EditOp:
 
     def describe(self) -> str:
         tag = f"@t{self.tenant}" if self.tenant else ""
-        if self.kind == "flow_traffic":
-            return f"flow_traffic(seed={self.flow_seed}, n={self.count})"
-        if self.kind == "flow_age":
-            return "flow_age"
+        if self.kind in ("flow_traffic", "sketch_traffic"):
+            return f"{self.kind}(seed={self.flow_seed}, n={self.count})"
+        if self.kind in ("flow_age", "sketch_drain"):
+            return self.kind
         if self.kind in ("full_replace", TXN_FLUSH):
             return self.kind + tag
         if self.kind in TENANT_KINDS:
@@ -262,6 +273,17 @@ class StateConfig:
     #: (a dropped table-generation refresh on the resident pool) must
     #: be caught by oracle divergence
     resident: bool = False
+    #: > 0 = telemetry plane enabled with this count-min width
+    #: (ISSUE-13): the op alphabet extends with TELEMETRY_KINDS, the
+    #: classifier runs with a (deliberately tiny) SketchSpec + the
+    #: shadow HostSketchModel, and every settled check adds the
+    #: device-vs-model sketch-tensor bit-identity pass
+    telemetry: int = 0
+    #: count-min saturation clamp of the telemetry config — small on
+    #: purpose, so the clamp engages within an op or two and the
+    #: sketchsat injected defect (device clamp dropped) diverges
+    #: immediately
+    telemetry_sat: int = 9
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -339,6 +361,23 @@ CONFIGS: Dict[str, StateConfig] = {
         # residentstale injected-defect acceptance, infw_lint state
         # --inject-defect residentstale) all surface here
         StateConfig("resident", flow=4096, witness_b=160, resident=True),
+        # device-resident telemetry plane (ISSUE-13): the TELEMETRY_
+        # KINDS alphabet over the edit state machine — every count-min /
+        # top-K / tenant-counter scatter the production dispatch
+        # performs (sketch updates ride classify, including the settled
+        # checks' own witness batches) must leave the device tensors
+        # bit-identical to the HostSketchModel, across traffic,
+        # saturation (tiny sat), heavy-hitter eviction churn (tiny
+        # top-K), edits and drains.  The sketchsat injected-defect
+        # acceptance (infw_lint state --inject-defect sketchsat) runs
+        # this config under the dropped-saturation-clamp bug.
+        StateConfig("telemetry", telemetry=64, steered=True,
+                    witness_b=160),
+        # the same alphabet with the tier riding the resident fused
+        # step (donated sketch operand chained through the one-program
+        # dispatch) — a fused-path telemetry drift diverges here
+        StateConfig("telemetry-resident", telemetry=64, flow=4096,
+                    resident=True, witness_b=160),
     )
 }
 
@@ -463,6 +502,22 @@ def generate_ops(
                 continue
             if r < 0.48:
                 ops.append(EditOp(kind="flow_age"))
+                continue
+        if config.telemetry:
+            r = rng.random()
+            if r < 0.35:
+                # repeated seeds matter here too: replayed batches push
+                # the same count-min buckets toward the (tiny) sat
+                # clamp and re-probe the same heavy-hitter slots —
+                # the surfaces the sketchsat acceptance shrinks on
+                ops.append(EditOp(
+                    kind="sketch_traffic",
+                    flow_seed=int(rng.integers(1, 4)),
+                    count=64,
+                ))
+                continue
+            if r < 0.45:
+                ops.append(EditOp(kind="sketch_drain"))
                 continue
         kind = str(rng.choice(kinds, p=probs))
         if kind in ("rules_edit", "order_change", "key_delete") and not keys:
@@ -1036,6 +1091,22 @@ class _Driver:
             }
             if config.resident:
                 flow_kw["resident"] = True
+        if config.telemetry:
+            from ..kernels.sketch import SketchSpec
+
+            if backend == "mesh":
+                raise ValueError(
+                    "telemetry configs are single-chip (the sketch "
+                    "tensors are not mesh-placed yet)"
+                )
+            # deliberately TINY geometry: the op horizon must reach the
+            # saturation clamp (small sat) and churn the heavy-hitter
+            # table (small top-K), or neither surface is checked
+            flow_kw["telemetry"] = SketchSpec.make(
+                depth=3, width=config.telemetry, topk=16, ways=2,
+                sat=config.telemetry_sat, max_tenants=1,
+            )
+            flow_kw["telemetry_track_model"] = True
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 
@@ -1061,7 +1132,7 @@ class _Driver:
         self._flow_base = (
             compile_tables_from_content(
                 dict(base_content), rule_width=config.width
-            ) if config.flow else None
+            ) if (config.flow or config.telemetry) else None
         )
         self._flow_failure: Optional[Failure] = None
         self.snapshot: Optional[CompiledTables] = None
@@ -1138,6 +1209,9 @@ class _Driver:
         if op.kind in FLOW_KINDS:
             self._apply_flow(op)
             return True
+        if op.kind in TELEMETRY_KINDS:
+            self._apply_telemetry(op)
+            return True
         if self.config.txn:
             if op.kind == TXN_FLUSH:
                 self.flush_pending()
@@ -1155,7 +1229,11 @@ class _Driver:
         return True
 
     def _model_update(self, op: EditOp) -> None:
-        if op.kind in (TXN_FLUSH, "full_replace") or op.kind in FLOW_KINDS:
+        if (
+            op.kind in (TXN_FLUSH, "full_replace")
+            or op.kind in FLOW_KINDS
+            or op.kind in TELEMETRY_KINDS
+        ):
             return
         if op.kind == "overlay_spill":
             for k, r in op.items:
@@ -1324,6 +1402,57 @@ class _Driver:
                     f"{pass_i + 1} (seed {op.flow_seed})",
                 )
                 return
+
+    def _apply_telemetry(self, op: EditOp) -> None:
+        """Drive the production telemetry plane: sketch_traffic
+        classifies its seeded batch through the production dispatch
+        (the sketch update rides the same admission — fused in-program
+        on the resident config, one follow-on launch otherwise);
+        sketch_drain runs the decimated drain, checking that the seq
+        stamp advanced exactly once and the device tensors zeroed."""
+        tier = getattr(self.clf, "telemetry", None)
+        if tier is None:
+            return
+        if op.kind == "sketch_drain":
+            seq0 = tier.drain_seq
+            recs = tier.drain(force=True)
+            if len(recs) != 1 or tier.drain_seq != seq0 + 1:
+                self._flow_failure = Failure(
+                    -1, "telemetry-drain",
+                    f"drain emitted {len(recs)} record(s), seq "
+                    f"{seq0} -> {tier.drain_seq} (want exactly one)",
+                )
+            return
+        batch = self._flow_batch(op)
+        self._classify(batch)
+
+    def _check_telemetry(self, step: int) -> Optional[Failure]:
+        """Device sketch tensors vs the shadow HostSketchModel, bit for
+        bit — every count-min add (and its saturation clamp), top-K
+        refresh/replace and tenant-counter scatter the production
+        dispatch performed was mirrored, so any divergence is a
+        kernel/model semantics drift (the sketchsat acceptance's catch
+        surface)."""
+        tier = getattr(self.clf, "telemetry", None)
+        if tier is None or tier.model is None:
+            return None
+        cols = tier.columns()
+        mcols = tier.model.columns()
+        for name, dev_arr in cols.items():
+            want = mcols[name]
+            if not np.array_equal(dev_arr, want):
+                flat_d = np.asarray(dev_arr).reshape(-1)
+                flat_w = np.asarray(want).reshape(-1)
+                bad = np.nonzero(flat_d != flat_w)[0]
+                i = int(bad[0])
+                return Failure(
+                    step, "telemetry-model",
+                    f"device sketch tensor {name!r} diverged from the "
+                    f"host model ({len(bad)} cell(s))",
+                    f"first at flat index {i}: device "
+                    f"{int(flat_d[i])}, model {int(flat_w[i])}",
+                )
+        return None
 
     def _check_flow(self, step: int) -> Optional[Failure]:
         """Device flow columns vs the shadow HostFlowModel, bit for
@@ -1511,7 +1640,10 @@ class _Driver:
                            "witness statistics diverge from the oracle",
                            f"got {stats_dict_from_array(stats)}, "
                            f"want {ref.stats}")
-        return self._check_flow(step)
+        f = self._check_flow(step)
+        if f is not None:
+            return f
+        return self._check_telemetry(step)
 
 
 def run_ops(

@@ -64,6 +64,8 @@ class TpuClassifier:
         flow_table=None,
         flow_track_model: bool = False,
         resident: Optional[bool] = None,
+        telemetry=None,
+        telemetry_track_model: bool = False,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -171,6 +173,35 @@ class TpuClassifier:
                 flow_table = FlowConfig.make(entries=int(flow_table))
             self._flow = self._make_flow_tier(
                 flow_table, track_model=flow_track_model
+            )
+        # Device-resident telemetry plane (ISSUE-13, --telemetry /
+        # INFW_TELEMETRY): count-min + top-K heavy-hitter tensors
+        # updated inside the resident fused step (donated, in-program)
+        # or as one follow-on launch per admission on the multi-dispatch
+        # wire path — observability as a batched tensor workload, the
+        # host reads one snapshot per N admissions (the decimated
+        # drain), never a per-packet event.  Precedence mirrors the
+        # other knobs: constructor arg (SketchSpec or count-min width)
+        # > INFW_TELEMETRY env (width) > off.
+        if telemetry is None:
+            env = os.environ.get("INFW_TELEMETRY", "")
+            if env and env not in ("0", "false", "no"):
+                telemetry = (
+                    True if env in ("1", "true", "yes") else int(env)
+                )
+        self._telemetry = None
+        if telemetry is not None and telemetry is not False:
+            from ..kernels.sketch import SketchSpec
+            from ..obs.telemetry import TelemetryTier
+
+            if not isinstance(telemetry, SketchSpec):
+                telemetry = (
+                    SketchSpec.make() if telemetry is True
+                    else SketchSpec.make(width=int(telemetry))
+                )
+            self._telemetry = TelemetryTier(
+                telemetry, device=self._device,
+                track_model=telemetry_track_model,
             )
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
@@ -800,25 +831,53 @@ class TpuClassifier:
                 use_walk = walk_dev
         if flow_probe is not None:
             fused, ctx = flow_probe
-            return {
+            plan = {
                 "flow": True, "fused": fused, "ctx": ctx,
                 "wire_np": wire_np, "tcp_flags": tcp_flags,
                 "path": path, "dev": dev, "block_b": block_b,
                 "ov_dev": ov_dev, "depth": d, "walk_dev": use_walk,
                 "v4_only": v4_only, "kind": kind, "n": wire_np.shape[0],
             }
-        return self._plan_wire(
-            path, dev, block_b, wire_np, v4_only, kind,
-            ov_dev=ov_dev, depth=d, walk_dev=use_walk,
-        )
+        else:
+            plan = self._plan_wire(
+                path, dev, block_b, wire_np, v4_only, kind,
+                ov_dev=ov_dev, depth=d, walk_dev=use_walk,
+            )
+        if self._telemetry is not None:
+            # multi-dispatch telemetry (ISSUE-13): the sketch update
+            # launches at materialize time with the admission's merged
+            # verdicts (one extra async device program, no host
+            # round-trip); the miss sub-dispatch inside _launch_flow
+            # goes through _plan_wire/_launch_wire directly and so never
+            # double-counts
+            plan["telem_wire"] = wire_np
+            plan["telem_flags"] = tcp_flags
+        return plan
 
     def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
         """Second half: launch the classify on a prepare_packed plan."""
         if plan.get("resident"):
+            # telemetry (when on) already rode the fused program
             return self._launch_resident(plan, apply_stats)
         if plan.get("flow"):
-            return self._launch_flow(plan, apply_stats)
-        return self._launch_wire(plan, apply_stats)
+            pending = self._launch_flow(plan, apply_stats)
+        else:
+            pending = self._launch_wire(plan, apply_stats)
+        tel = self._telemetry
+        if tel is None or "telem_wire" not in plan:
+            return pending
+        telem_wire = plan["telem_wire"]
+        telem_flags = plan["telem_flags"]
+
+        def materialize() -> ClassifyOutput:
+            out = pending.result()
+            # one follow-on device program per admission: wire +
+            # verdicts in, nothing back (the decimated drain is the
+            # only telemetry readback)
+            tel.update(telem_wire, out.results, tflags_np=telem_flags)
+            return out
+
+        return PendingClassify(materialize)
 
     # -- resident serving loop (ISSUE-12) ------------------------------------
 
@@ -851,10 +910,12 @@ class TpuClassifier:
                 d = int(dclass)
         n = wire_np.shape[0]
         kind = (wire_np[:, 0] & 3).astype(np.int32)
+        tel = self._telemetry
         fn = jaxpath.jitted_resident_step(
             tier.config.entries, tier.config.ways, ctx.path,
             bool(v4_only) and ctx.path == "trie", d, ctx.d_max,
             ctx.ov_dev is not None,
+            sketch=tel.spec if tel is not None else None,
         )
         tables_args = (
             (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
@@ -864,7 +925,7 @@ class TpuClassifier:
         fused, epoch = tier.resident_dispatch(
             fn, tables_args, wire_dev, n, wire_np=wire_np,
             tflags_np=tcp_flags, gens_snap=gens_snap,
-            alloc_note=pool.note_alloc,
+            alloc_note=pool.note_alloc, telemetry=tel,
         )
         pool.note("dispatches")
         try:
@@ -900,6 +961,8 @@ class TpuClassifier:
                 inserts=inserts, evictions=evictions, promotes=promotes,
             )
             tier.resident_note_materialized(epoch)
+            if self._telemetry is not None:
+                self._telemetry.resident_note_materialized(epoch)
             if evictions and tier.on_evict is not None:
                 try:
                     tier.on_evict(evictions, inserts, epoch)
@@ -925,6 +988,26 @@ class TpuClassifier:
         return {} if self._resident is None else (
             self._resident.counter_values()
         )
+
+    @property
+    def telemetry(self):
+        """The TelemetryTier when the telemetry plane is enabled."""
+        return self._telemetry
+
+    def telemetry_counters(self):
+        """telemetry_* counters for /metrics (empty when off)."""
+        return {} if self._telemetry is None else (
+            self._telemetry.counter_values()
+        )
+
+    def warm_telemetry_ladder(self, ladder) -> int:
+        """Pre-compile the classic sketch-update executables across the
+        batch ladder (scheduler prewarm hook; resident fused variants
+        warm through the production dispatch like every other fused
+        program)."""
+        if self._telemetry is None:
+            return 0
+        return self._telemetry.warm(ladder)
 
     def mark_resident_warm(self) -> None:
         """Freeze the pool's prewarm allocation baseline (called by

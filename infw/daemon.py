@@ -43,7 +43,7 @@ import numpy as np
 
 from . import platform as platform_mod
 from .compiler import CompileError
-from .constants import KIND_IPV6, KIND_OTHER, MAX_TARGETS
+from .constants import DENY, KIND_IPV6, KIND_OTHER, MAX_TARGETS
 from .interfaces import InterfaceError, InterfaceRegistry, default_registry
 from .nodestate_controller import NodeStateReconciler
 from .obs.events import EventRing, EventsLogger, emit_deny_events
@@ -230,7 +230,8 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             mesh: Optional[str] = None,
                             compressed: Optional[bool] = None,
                             flow_table=None,
-                            resident: Optional[bool] = None):
+                            resident: Optional[bool] = None,
+                            telemetry=None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -255,6 +256,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                 "--resident is a device-backend feature; the cpu "
                 "reference classifier serves the multi-dispatch path"
             )
+        if telemetry is not None:
+            log.warning(
+                "--telemetry is a device-backend feature; the cpu "
+                "reference classifier exports no sketch plane"
+            )
         return classifier_class("cpu")
     if backend == "tpu":
         import functools
@@ -277,6 +283,12 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             # launch (validated there) rides into every classifier
             # generation the syncer constructs
             kw["flow_table"] = flow_table
+        if telemetry is not None:
+            # device-resident telemetry plane (infw.obs.telemetry): a
+            # SketchSpec built at launch rides into every classifier
+            # generation; the daemon attaches its obs ring + drain
+            # cadence on the idle loop (_telemetry_maintenance)
+            kw["telemetry"] = telemetry
         if mesh:
             from .backend.mesh import resolve_mesh_spec
 
@@ -366,6 +378,25 @@ class _ResidentCounters:
             return {}
 
 
+class _TelemetryCounters:
+    """telemetry_* counters as a /metrics provider (same getter
+    indirection: survives classifier reloads; no telemetry tier renders
+    nothing)."""
+
+    def __init__(self, clf_getter) -> None:
+        self._get = clf_getter
+
+    def counter_values(self):
+        clf = self._get()
+        tc = getattr(clf, "telemetry_counters", None)
+        if clf is None or tc is None:
+            return {}
+        try:
+            return tc()
+        except Exception:
+            return {}
+
+
 # --- daemon ------------------------------------------------------------------
 
 class Daemon:
@@ -402,6 +433,10 @@ class Daemon:
         flow_table=None,
         resident: bool = False,
         ring: Optional[str] = None,
+        telemetry=None,
+        telemetry_drain: int = 256,
+        trace: bool = False,
+        trace_slow_us: float = 50_000.0,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -426,6 +461,25 @@ class Daemon:
         # ISSUE-12): the syncer's classifiers run the donated-buffer
         # fused serving loop; resident_* pool gauges export on /metrics.
         self.resident = bool(resident)
+        # Device-resident telemetry plane (--telemetry / INFW_TELEMETRY,
+        # ISSUE-13): count-min + top-K heavy-hitter tensors updated
+        # inside the serving dispatch; the daemon owns the decimated
+        # summarizer cadence (one small D2H per --telemetry-drain
+        # admissions), the summary records on the obs event ring, the
+        # telemetry_* counters on /metrics and the per-tenant
+        # token-bucket sampling of raw deny-event export.
+        self.telemetry = telemetry  # validated SketchSpec or None
+        self.telemetry_drain = max(1, int(telemetry_drain))
+        self._telemetry_attached: set = set()
+        self._telemetry_drain_last = 0.0
+        # Serving-path tracing (--trace): per-stage span clocks through
+        # the ingest/serving pipeline, exported as Prometheus histograms
+        # on /metrics + sampled TraceSpanRecords for slow admissions.
+        self.tracer = None
+        if trace:
+            from .obs.telemetry import SpanTracer
+
+            self.tracer = SpanTracer(slow_us=float(trace_slow_us))
         # Persistent pinned host ingest ring (--ring / INFW_RING): a
         # preallocated shared-memory SPSC ring producers write packed
         # wire records into IN PLACE — the ingest loop admits by ring
@@ -536,6 +590,7 @@ class Daemon:
                 mesh=mesh, compressed=compressed,
                 flow_table=flow_table if backend != "cpu" else None,
                 resident=self.resident if backend != "cpu" else None,
+                telemetry=self.telemetry if backend != "cpu" else None,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -615,6 +670,23 @@ class Daemon:
                 lambda: self.syncer.classifier
             )
             self.metrics_registry.register_counters(self._resident_counters)
+        if self.telemetry is not None and backend != "cpu":
+            # telemetry_* counters (updates, drains, summaries, sampled/
+            # suppressed raw events, drain seq) — the decimation's
+            # accounting half
+            self._telemetry_counters = _TelemetryCounters(
+                lambda: self.syncer.classifier
+            )
+            self.metrics_registry.register_counters(self._telemetry_counters)
+        if self.tracer is not None:
+            # span histograms (ingressnodefirewall_node_span_us) +
+            # trace_* sample counters; slow-admission TraceSpanRecords
+            # land on the obs event ring next to deny events
+            self.tracer.attach_ring(self.ring)
+            self.metrics_registry.register_histograms(
+                self.tracer.histograms
+            )
+            self.metrics_registry.register_counters(self.tracer)
         if self.ingest_ring is not None:
             # ring_* cursor/backpressure gauges
             self.metrics_registry.register_counters(self.ingest_ring)
@@ -938,6 +1010,10 @@ class Daemon:
         if clf is None or clf.tables is None:
             return 0
         processed = 0
+        # getattr: tolerate the bare ingest-only harness (bench.py and
+        # the ingest tests build Daemon.__new__ without __init__, the
+        # h2d_stage_depth pattern below)
+        tracer = getattr(self, "tracer", None)
 
         # Deadline scheduling (infw.scheduler, --deadline-us): job sizes
         # come from the policy's service-time model — the largest ladder
@@ -1000,8 +1076,8 @@ class Daemon:
             os.replace(jpath + ".tmp", jpath)
             os.remove(fctx["path"])
             clf.stats.add(stats_from_results(results, np.asarray(batch.pkt_len)))
-            emit_deny_events(self.ring, results, batch.ifindex,
-                             batch.pkt_len, fb, batch=batch)
+            self._emit_deny_sampled(clf, results, batch.ifindex,
+                                    batch.pkt_len, fb, batch)
             processed += 1
 
         def seg_done(fctx) -> None:
@@ -1022,8 +1098,18 @@ class Daemon:
             if files and total >= self.max_tick_packets:
                 break  # the rest belongs to the next tick
             try:
+                t_read0 = time.perf_counter()
                 fb = read_frames_any(path)
+                t_read1 = time.perf_counter()
                 batch = parse_frames_buf(fb)
+                if tracer is not None:
+                    # file-drop taxonomy: ingest = file read, pack =
+                    # frame parse (the wire pack itself is charged per
+                    # job in prepare below)
+                    h = tracer.histograms
+                    h.observe("ingest", (t_read1 - t_read0) * 1e6)
+                    h.observe("pack",
+                              (time.perf_counter() - t_read1) * 1e6)
             except (OSError, ValueError, struct.error, IndexError) as e:
                 # A parse crash must consume the file like a bad header
                 # does — leaving it would wedge the tick at this file
@@ -1159,11 +1245,14 @@ class Daemon:
             like the old dispatch did (the caller maps it to
             job_failed)."""
             nonlocal packed_ok, can_stage
+            t_prep0 = time.perf_counter()
             segs = [(f, idx) for f, idx in job["segments"] if not f["failed"]]
             job["segments"] = segs
             if not segs:
                 return None
             n = sum(len(idx) for _f, idx in segs)
+            if tracer is not None:
+                job["trace"] = tracer.begin(n)
             if packed_ok:
                 parts = [
                     f["batch"].pack_wire_subset(np.ascontiguousarray(idx, np.int64))
@@ -1182,9 +1271,14 @@ class Daemon:
                 v4_only = all(v4 for _w, v4 in parts)
                 if can_stage and h2d_overlap:
                     try:
+                        t_h2d0 = time.perf_counter()
                         plan = clf.prepare_packed(
                             wire, v4_only, depth=job.get("depth")
                         )
+                        tr = job.get("trace")
+                        if tr is not None:
+                            tr.add("pack", t_h2d0 - t_prep0)
+                            tr.add("h2d", time.perf_counter() - t_h2d0)
                         return ("plan", plan)
                     except RuntimeError:
                         if clf.supports_packed() or clf.active_path is None:
@@ -1294,8 +1388,12 @@ class Daemon:
 
         def drain_one() -> None:
             job, pending = inflight.popleft()
+            tr = job.get("trace")
             try:
+                t_mat0 = time.perf_counter()
                 out = pending.result()
+                if tr is not None:
+                    tr.add("materialize", time.perf_counter() - t_mat0)
             except Exception as e:
                 job_failed(job, e)
                 return
@@ -1304,6 +1402,7 @@ class Daemon:
                     note_sched_drain(job, time.monotonic())
                 except Exception as e:
                     log.error("scheduler accounting failed: %s", e)
+            t_drain0 = time.perf_counter()
             off = 0
             for f, idx in job["segments"]:
                 k = len(idx)
@@ -1312,6 +1411,9 @@ class Daemon:
                     f["xdp"][idx] = np.asarray(out.xdp)[off : off + k]
                 off += k
                 seg_done(f)
+            if tr is not None:
+                tr.add("drain", time.perf_counter() - t_drain0)
+                tracer.finish(tr)
 
         inflight: deque = deque()
         staged: deque = deque()
@@ -1351,7 +1453,11 @@ class Daemon:
                 job, prep = staged.popleft()
                 job["t_launch"] = time.monotonic()
                 try:
+                    t_disp0 = time.perf_counter()
                     pending = launch(job, prep)
+                    tr = job.get("trace")
+                    if tr is not None:
+                        tr.add("dispatch", time.perf_counter() - t_disp0)
                 except Exception as e:
                     job_failed(job, e)
                     continue
@@ -1397,16 +1503,29 @@ class Daemon:
         budget = self.max_tick_packets if budget is None else int(budget)
         processed = 0
         inflight = self._ring_inflight
+        tracer = getattr(self, "tracer", None)
         while processed < budget:
+            t0 = time.perf_counter()
             chunk = ring.pop(timeout=0.0)
             if chunk is None:
                 break
+            trace = None
+            if tracer is not None:
+                # span taxonomy on the ring path: ingest = cursor pop,
+                # h2d = prepare_packed (staging device_put; the record
+                # arrives pre-packed so pack is the producer's cost),
+                # dispatch = program launch, materialize = readback,
+                # drain = slot release + bookkeeping
+                trace = tracer.begin(chunk.wire.shape[0])
+                trace.add("ingest", time.perf_counter() - t0)
             try:
                 if packed:
                     plan = clf.prepare_packed(
                         chunk.wire, chunk.v4_only,
                         tcp_flags=chunk.tcp_flags,
                     )
+                    if trace is not None:
+                        trace.mark("h2d")
                     pending = clf.classify_prepared(plan, apply_stats=True)
                 else:
                     # non-packed backend (the cpu reference): rebuild
@@ -1416,11 +1535,13 @@ class Daemon:
                         _batch_from_wire(chunk.wire, chunk.tcp_flags),
                         apply_stats=True,
                     )
+                if trace is not None:
+                    trace.mark("dispatch")
             except Exception as e:
                 log.error("ring ingest dispatch failed: %s", e)
                 chunk.release()
                 continue
-            inflight.append((chunk, pending))
+            inflight.append((chunk, pending, trace))
             processed += chunk.wire.shape[0]
             while len(inflight) > self.pipeline_depth:
                 self._ring_drain_one()
@@ -1429,13 +1550,18 @@ class Daemon:
         return processed
 
     def _ring_drain_one(self) -> None:
-        chunk, pending = self._ring_inflight.popleft()
+        chunk, pending, trace = self._ring_inflight.popleft()
         try:
             pending.result()
+            if trace is not None:
+                trace.mark("materialize")
         except Exception as e:
             log.error("ring ingest classify failed: %s", e)
         finally:
             chunk.release()
+            if trace is not None:
+                trace.mark("drain")
+                self.tracer.finish(trace)  # trace only exists when tracer does
 
     def _maybe_prewarm_ladder(self, clf) -> None:
         """Pre-warm every batch-size ladder shape against the CURRENT
@@ -1547,6 +1673,10 @@ class Daemon:
                 self._flow_maintenance()
             except Exception as e:
                 log.error("flow maintenance error: %s", e)
+            try:
+                self._telemetry_maintenance()
+            except Exception as e:
+                log.error("telemetry maintenance error: %s", e)
 
     def _attach_flow_events(self, clf) -> None:
         """Wire a classifier's flow tier to the obs event ring (once
@@ -1583,6 +1713,61 @@ class Daemon:
                     age()
         if now - self._flow_age_last >= 5.0:
             self._flow_age_last = now
+
+    def _telemetry_maintenance(self) -> None:
+        """Idle-loop telemetry upkeep: attach the obs ring + drain
+        cadence to any new classifier generation's tier, and force a
+        time-based drain every few seconds so low-traffic windows still
+        produce timely summaries (the admission-count decimation only
+        fires under load)."""
+        if self.telemetry is None:
+            return
+        clf = self.syncer.classifier
+        tier = getattr(clf, "telemetry", None)
+        if tier is None:
+            return
+        if id(tier) not in self._telemetry_attached:
+            tier.attach_ring(self.ring)
+            tier.drain_every = self.telemetry_drain
+            self._telemetry_attached.add(id(tier))
+        now = time.monotonic()
+        if now - self._telemetry_drain_last >= 5.0:
+            self._telemetry_drain_last = now
+            with tier._lock:
+                pending = tier._window_admissions > 0
+            if pending:
+                tier.drain(force=True)
+
+    def _emit_deny_sampled(self, clf, results, ifindex, pkt_len, frames,
+                           batch) -> None:
+        """Deny-event export with the telemetry tier's per-tenant token
+        bucket in front (ISSUE-13): the full firehose is replaced by
+        bounded raw evidence — exact totals always travel in the sketch
+        summaries; the bucket releases at most its budget of raw
+        records, the rest counts as telemetry_suppressed_events (never
+        as ring loss — suppression is policy, not overflow)."""
+        tel = getattr(clf, "telemetry", None)
+        if tel is None:
+            emit_deny_events(self.ring, results, ifindex, pkt_len, frames,
+                             batch=batch)
+            return
+        results = np.asarray(results)
+        deny_idx = np.nonzero((results & 0xFF) == DENY)[0]
+        if len(deny_idx) == 0:
+            return
+        grant = tel.sample_allow(0, len(deny_idx))
+        if grant >= len(deny_idx):
+            emit_deny_events(self.ring, results, ifindex, pkt_len, frames,
+                             batch=batch)
+            return
+        if grant == 0:
+            return
+        keep = deny_idx[:grant]
+        emit_deny_events(
+            self.ring, results[keep], np.asarray(ifindex)[keep],
+            np.asarray(pkt_len)[keep],
+            None if frames is None else [frames[int(i)] for i in keep],
+        )
 
     def stop(self) -> None:
         """SIGTERM path: stop polling/serving, detach the dataplane but
@@ -1762,6 +1947,41 @@ def main(argv: Optional[List[str]] = None) -> int:
              "CLI beats INFW_RESIDENT",
     )
     p.add_argument(
+        "--telemetry", nargs="?", const="2048",
+        default=os.environ.get("INFW_TELEMETRY") or None,
+        help="device-resident telemetry plane (tpu backend): count-min "
+             "+ top-K heavy-hitter sketches updated inside the serving "
+             "dispatch, per-tenant top-talker / deny-storm / SYN-rate "
+             "summaries on the obs event ring at a decimated cadence, "
+             "telemetry_* counters on /metrics, and per-tenant "
+             "token-bucket sampling of raw deny-event export.  Optional "
+             "value = count-min width (default 2048).  CLI beats "
+             "INFW_TELEMETRY",
+    )
+    p.add_argument(
+        "--telemetry-drain", type=int,
+        default=os.environ.get("INFW_TELEMETRY_DRAIN") or 256,
+        help="summarizer decimation: admissions per sketch drain (one "
+             "small D2H each; default 256).  CLI beats "
+             "INFW_TELEMETRY_DRAIN",
+    )
+    p.add_argument(
+        "--trace", action="store_true",
+        default=os.environ.get("INFW_TRACE", "")
+        not in ("", "0", "false", "no"),
+        help="serving-path tracing: per-stage span clocks (ingest -> "
+             "pack -> H2D -> dispatch -> materialize -> drain) exported "
+             "as Prometheus histograms on /metrics, with sampled "
+             "TraceSpanRecords for slow admissions on the obs event "
+             "ring.  CLI beats INFW_TRACE",
+    )
+    p.add_argument(
+        "--trace-slow-us", type=float,
+        default=os.environ.get("INFW_TRACE_SLOW_US") or 50_000.0,
+        help="slow-admission threshold for sampled trace records "
+             "(default 50000us)",
+    )
+    p.add_argument(
         "--ring",
         default=os.environ.get("INFW_RING") or None,
         help="persistent pinned host ingest ring: path of a "
@@ -1837,6 +2057,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.resident and args.backend == "cpu":
         p.error("--resident requires the tpu backend (the cpu reference "
                 "classifier has no device-resident serving loop)")
+    # Telemetry knobs share it too: a bad sketch width / drain cadence
+    # (flag OR env-derived) fails the launch, never the sync loop.
+    telemetry_spec = None
+    if args.telemetry is not None and str(args.telemetry) not in (
+        "0", "", "false", "no"
+    ):
+        if args.backend == "cpu":
+            p.error("--telemetry requires the tpu backend (the cpu "
+                    "reference classifier has no device sketch plane)")
+        from .kernels.sketch import SketchSpec
+
+        raw = str(args.telemetry)
+        if raw in ("1", "true", "yes"):
+            raw = "2048"  # bare flag / truthy env: the default geometry
+        try:
+            if int(raw) < 8:
+                raise ValueError(
+                    f"--telemetry width must be >= 8, got {raw}"
+                )
+            telemetry_spec = SketchSpec.make(
+                width=int(raw),
+                depth=int(os.environ.get("INFW_TELEMETRY_DEPTH") or 4),
+                topk=int(os.environ.get("INFW_TELEMETRY_TOPK") or 256),
+            )
+        except ValueError as e:
+            p.error(str(e))
+    if int(args.telemetry_drain) < 1:
+        p.error(f"--telemetry-drain must be >= 1, got "
+                f"{args.telemetry_drain}")
+    if not float(args.trace_slow_us) > 0:
+        p.error(f"--trace-slow-us must be positive, got "
+                f"{args.trace_slow_us}")
     if args.ring:
         ring_dir = os.path.dirname(os.path.abspath(args.ring)) or "."
         if not os.path.isdir(ring_dir):
@@ -1895,6 +2147,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         tenants=int(args.tenants) if args.tenants else None,
         flow_table=flow_cfg,
         resident=args.resident,
+        telemetry=telemetry_spec,
+        telemetry_drain=int(args.telemetry_drain),
+        trace=args.trace,
+        trace_slow_us=float(args.trace_slow_us),
         ring=args.ring,
     )
     stop = threading.Event()

@@ -679,7 +679,8 @@ class FlowTier:
                           wire_np: Optional[np.ndarray] = None,
                           tenant_np: Optional[np.ndarray] = None,
                           tflags_np: Optional[np.ndarray] = None,
-                          gens_snap=None, alloc_note=None):
+                          gens_snap=None, alloc_note=None,
+                          telemetry=None):
         """Run one fused resident step and chain the donated buffers:
         ``fn(flow, gens, pages, epoch, *tables_args, wire, tenant,
         tflags, max_age) -> (new flow, new epoch, fused)``.  The updated
@@ -717,10 +718,28 @@ class FlowTier:
                     alloc_note("epoch")
             gens_dev = self._gens_dev if gens_snap is None else gens_snap[0]
             pages_dev = self._pages_dev
-            new_flow, new_epoch, fused = fn(
-                self._flow, gens_dev, pages_dev, epoch_dev, *tables_args,
-                wire_dev, tenant, tflags, self._max_age_dev,
-            )
+            if telemetry is not None:
+                # telemetry fused variant (ISSUE-13): the donated sketch
+                # tensors chain through the SAME dispatch — exchanged
+                # under the telemetry tier's lock (flow lock -> telemetry
+                # lock, the one nesting order) so sketch updates land in
+                # device-dispatch order
+                def launch(sk):
+                    nf, ne, sk2, fz = fn(
+                        self._flow, gens_dev, pages_dev, epoch_dev, sk,
+                        *tables_args, wire_dev, tenant, tflags,
+                        self._max_age_dev,
+                    )
+                    return sk2, (nf, ne, fz)
+                new_flow, new_epoch, fused = telemetry.resident_exchange(
+                    launch, epoch, wire_np, tenant_np, tflags_np,
+                )
+            else:
+                new_flow, new_epoch, fused = fn(
+                    self._flow, gens_dev, pages_dev, epoch_dev,
+                    *tables_args, wire_dev, tenant, tflags,
+                    self._max_age_dev,
+                )
             self._flow = new_flow
             self._epoch_dev = new_epoch
             self._epoch_dev_val = epoch

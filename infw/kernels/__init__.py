@@ -617,6 +617,63 @@ def _build_resident_ring_fused(b: int):
                 wire, zeros, zeros, max_age)
 
 
+# -- telemetry-plane fixtures/builders (ISSUE-13) ----------------------------
+#
+# The device-resident sketch update (kernels.sketch): count-min + top-K
+# heavy-hitter + per-tenant counter scatters, donated state.  Two forms
+# are hot-path: the standalone follow-on launch (multi-dispatch wire
+# path) and the resident fused step's in-program composition.  Builders
+# return FRESH donated operands per call (the executing lints consume
+# them).
+
+
+def _telemetry_spec():
+    from .sketch import SketchSpec
+
+    return SketchSpec.make(depth=3, width=256, topk=32, ways=2)
+
+
+def _fresh_sketch_state(spec):
+    import jax
+
+    from .sketch import SketchState, zero_state_host
+
+    return SketchState(
+        *(jax.device_put(a) for a in zero_state_host(spec))
+    )
+
+
+def _build_sketch_update(b: int):
+    """The classic telemetry launch: one device program updating the
+    whole telemetry plane from (wire, verdicts), state donated, no
+    readback."""
+    import jax
+
+    from . import sketch as sketch_mod
+
+    spec = _telemetry_spec()
+    fn = sketch_mod.jitted_sketch_update(spec)
+    zeros = jax.device_put(np.zeros(b, np.int32))
+    res = jax.device_put(np.zeros(b, np.uint32))
+    return fn, (_fresh_sketch_state(spec), _fixture_wire(b), zeros, zeros,
+                res)
+
+
+def _build_resident_telemetry_fused(b: int):
+    """The resident fused step with the telemetry plane riding the same
+    program: flow columns + epoch + sketch tensors all donated."""
+    from . import jaxpath
+
+    spec = _telemetry_spec()
+    cfg, flow, gens, pages, epoch, max_age, zeros = _resident_operands(b)
+    fn = jaxpath.jitted_resident_step(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False, sketch=spec
+    )
+    return fn, (flow, gens, pages, epoch, _fresh_sketch_state(spec),
+                _fixture_device_tables(True), _fixture_wire(b), zeros,
+                zeros, max_age)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -834,6 +891,14 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         KernelEntrypoint(
             "classify-wire/resident-ring-fused", "xla",
             _build_resident_ring_fused, donate=(0, 3),
+        ),
+        KernelEntrypoint(
+            "telemetry/sketch-update", "xla", _build_sketch_update,
+            donate=(0,),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-telemetry-fused", "xla",
+            _build_resident_telemetry_fused, donate=(0, 3, 4),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

@@ -4353,9 +4353,9 @@ def jitted_flow_occupancy():
 def _resident_step_core(
     flow: FlowTable, gens: jax.Array, page_table: jax.Array,
     epoch: jax.Array, tdev, wire: jax.Array, tenant: jax.Array,
-    tflags: jax.Array, max_age: jax.Array, ov=None,
+    tflags: jax.Array, max_age: jax.Array, ov=None, sk=None,
     *, slab_entries: int, ways: int, path: str, v4_only: bool,
-    depth: Optional[int], d_max: int,
+    depth: Optional[int], d_max: int, sketch=None,
 ):
     batch = unpack_wire(wire)
     e1 = (epoch + jnp.int32(1)).astype(jnp.int32)
@@ -4409,6 +4409,18 @@ def _resident_step_core(
         ]),
         counts,
     ])
+    if sketch is not None:
+        # device-resident telemetry (ISSUE-13): the sketch update rides
+        # the SAME device program as the verdicts — count-min + top-K +
+        # tenant-counter scatters over the merged res16, donated like
+        # the flow columns, nothing read back (the decimated drain is
+        # the only D2H the telemetry plane ever pays)
+        from . import sketch as sketch_mod
+
+        sk2 = sketch_mod._sketch_update_core(
+            sk, batch, tenant, tflags, merged, spec=sketch,
+        )
+        return flow2, e1, sk2, fused
     return flow2, e1, fused
 
 
@@ -4430,11 +4442,17 @@ def split_resident_outputs(arr: np.ndarray, b: int):
 #: registry and the jaxcheck donation lint share one source of truth
 RESIDENT_DONATE_ARGNUMS = (0, 3)
 
+#: the telemetry variant additionally donates the sketch tensors
+#: (operand 4, right after the epoch) — telemetry state is rewritten in
+#: place every admission exactly like the flow columns
+RESIDENT_SKETCH_DONATE_ARGNUMS = (0, 3, 4)
+
 
 @functools.lru_cache(maxsize=None)
 def jitted_resident_step(
     slab_entries: int, ways: int, path: str, v4_only: bool = False,
     depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
+    sketch=None,
 ):
     """The resident fused executable, cache-keyed on (flow geometry,
     layout path, wire format specialization) — batch shape and the trie
@@ -4450,7 +4468,28 @@ def jitted_resident_step(
     copied), so the caller must treat the inputs as consumed and chain
     the returned arrays into the next dispatch."""
     kw = dict(slab_entries=slab_entries, ways=ways, path=path,
-              v4_only=v4_only, depth=depth, d_max=d_max)
+              v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch)
+    if sketch is not None:
+        # telemetry variant (ISSUE-13): the donated sketch tensors ride
+        # at position 4, between the epoch and the table operands —
+        # f(flow, gens, pages, epoch, sk, tables[, ov], wire, tenant,
+        # tflags, max_age) -> (flow', epoch', sk', fused)
+        if overlay:
+            def f(flow, gens, page_table, epoch, sk, tdev, ov, wire,
+                  tenant, tflags, max_age):
+                return _resident_step_core(
+                    flow, gens, page_table, epoch, tdev, wire, tenant,
+                    tflags, max_age, ov=ov, sk=sk, **kw,
+                )
+        else:
+            def f(flow, gens, page_table, epoch, sk, tdev, wire,
+                  tenant, tflags, max_age):
+                return _resident_step_core(
+                    flow, gens, page_table, epoch, tdev, wire, tenant,
+                    tflags, max_age, sk=sk, **kw,
+                )
+
+        return jax.jit(f, donate_argnums=RESIDENT_SKETCH_DONATE_ARGNUMS)
     if overlay:
         def f(flow, gens, page_table, epoch, tdev, ov, wire, tenant,
               tflags, max_age):

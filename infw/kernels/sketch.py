@@ -1,0 +1,400 @@
+"""Device-resident telemetry sketches (ISSUE-13).
+
+The observability analogue of the flow tier: per-packet deny events over
+a perf ring collapse at replay scale (the ROADMAP's firehose note), so
+the COUNTING moves on-device next to the verdicts — a count-min sketch
+plus a top-K heavy-hitter table in fixed-shape device tensors, updated
+by deterministic scatters inside the same device program that classifies
+(the resident fused step) or as one follow-on launch per admission (the
+multi-dispatch wire path).  The host reads NOTHING per packet; a
+decimated drain (obs.telemetry.TelemetryTier) snapshots the tensors once
+per N admissions and derives per-tenant top-talker / deny-storm /
+SYN-rate summaries host-side.
+
+State (SketchState, one pytree like FlowTable):
+
+- ``cms``  (D, W) int32 — count-min rows: D independent hashes of the
+  (tenant, src, kind|verdict) key over W buckets; the estimate of any
+  key's count is min over rows, with the classic CM guarantee
+  (overcount only, error <= e*N/W per row with prob 1-e^-D).  Counters
+  saturate at ``sat`` (min(c+delta, sat)) so a drain gap can never wrap
+  a counter into nonsense — the clamp the ``sketchsat`` injected defect
+  drops.
+- ``keys`` (K, 6) uint32 / ``cnt`` (K,) int32 — the heavy-hitter table:
+  a ways-way set-associative exact-key store (the flow-insert shape);
+  a lane whose post-update CMS estimate beats its slot's resident count
+  replaces it (SpaceSaving-flavored, winner-lane deduplicated so
+  duplicate-slot scatters stay deterministic).
+- ``tcnt`` (T, 4) int32 — exact per-tenant [packets, allows, denies,
+  pure SYNs] for the deny-storm / SYN-rate summaries.
+
+Bit-reproducibility contract (the flow-tier discipline): every update is
+a deterministic scatter form (add / max / winner-lane set), and
+``HostSketchModel`` mirrors each one in numpy bit-for-bit — the
+statecheck ``telemetry`` config compares device tensors against the
+model at every settled check.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_SKETCH_SAT_BUG env var), the DEVICE kernels skip the
+#: count-min saturation clamp (counters grow unboundedly past ``sat``)
+#: while the host model keeps clamping — the statecheck acceptance
+#: (tools/infw_lint.py state --inject-defect sketchsat) must catch the
+#: divergence and ddmin-shrink it.  Never set in production.
+_INJECT_SKETCH_SAT_BUG = False
+
+
+def _inject_sketch_sat_bug() -> bool:
+    if _INJECT_SKETCH_SAT_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_SKETCH_SAT_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
+#: sketch key words: [tenant, ip0, ip1, ip2, ip3, (kind<<8)|action] —
+#: the (src, tenant, verdict) aggregation key of the ISSUE-13 summaries
+#: (kind rides along so the drain can render the address family).
+SKETCH_KEY_WORDS = 6
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << (max(int(n), 1) - 1).bit_length())
+
+
+class SketchSpec(NamedTuple):
+    """Geometry of one telemetry plane (hashable — the jit cache key)."""
+
+    depth: int = 4            # count-min rows
+    width: int = 2048         # buckets per row (power of two)
+    topk: int = 256           # heavy-hitter slots (power of two)
+    ways: int = 4             # set-associative probes per key
+    sat: int = 0x7FFFFFFF     # count-min saturation clamp
+    max_tenants: int = 1
+
+    @staticmethod
+    def make(depth: int = 4, width: int = 2048, topk: int = 256,
+             ways: int = 4, sat: int = 0x7FFFFFFF,
+             max_tenants: int = 1) -> "SketchSpec":
+        if depth < 1 or depth > 8:
+            raise ValueError(f"sketch depth must be in [1, 8], got {depth}")
+        if not 1 <= ways <= 8:
+            raise ValueError(f"sketch ways must be in [1, 8], got {ways}")
+        if sat < 1:
+            raise ValueError(f"sketch sat must be >= 1, got {sat}")
+        if max_tenants < 1:
+            raise ValueError("sketch max_tenants must be >= 1")
+        return SketchSpec(
+            depth=int(depth), width=_pow2(width), topk=_pow2(topk),
+            ways=int(ways), sat=int(sat), max_tenants=int(max_tenants),
+        )
+
+
+class SketchState(NamedTuple):
+    """Device telemetry tensors (host numpy in the model's mirror)."""
+
+    cms: object   # (D, W) int32
+    keys: object  # (K, 6) uint32
+    cnt: object   # (K,) int32
+    tcnt: object  # (T, 4) int32 [pkts, allows, denies, syns]
+
+
+def zero_state_host(spec: SketchSpec) -> SketchState:
+    return SketchState(
+        cms=np.zeros((spec.depth, spec.width), np.int32),
+        keys=np.zeros((spec.topk, SKETCH_KEY_WORDS), np.uint32),
+        cnt=np.zeros(spec.topk, np.int32),
+        tcnt=np.zeros((spec.max_tenants, 4), np.int32),
+    )
+
+
+# --- shared key/hash forms (numpy and jax compute IDENTICAL values) ----------
+
+
+def _key_words_np(f, tenant: np.ndarray, res: np.ndarray) -> np.ndarray:
+    """(B, 6) uint32 key from host-unpacked wire fields (flow.host_
+    unpack_wire dict) + verdicts; the jax twin is _key_words_jax."""
+    act = (np.asarray(res).astype(np.uint32)) & np.uint32(0xFF)
+    w5 = act | ((f["kind"].astype(np.uint32) & np.uint32(3)) << np.uint32(8))
+    return np.stack([
+        tenant.astype(np.uint32),
+        f["ip_words"][:, 0].astype(np.uint32),
+        f["ip_words"][:, 1].astype(np.uint32),
+        f["ip_words"][:, 2].astype(np.uint32),
+        f["ip_words"][:, 3].astype(np.uint32),
+        w5,
+    ], axis=1)
+
+
+def _hash_np(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """FNV-1a over the 6 key words -> (h1, h2); h2 forced odd (the flow
+    tier's double-hash form, pure wrapping u32 arithmetic)."""
+    h = np.full(keys.shape[0], 0x811C9DC5, np.uint32)
+    for w in range(SKETCH_KEY_WORDS):
+        h = (h ^ keys[:, w].astype(np.uint32)) * np.uint32(0x01000193)
+    return h, (h >> np.uint32(16)) | np.uint32(1)
+
+
+# --- the host oracle ---------------------------------------------------------
+
+
+class HostSketchModel:
+    """Bit-exact numpy mirror of the device sketch-update kernel: same
+    key/hash forms, same scatter order (cms add+clamp -> top-K matched
+    max -> top-K winner-lane replace -> tenant counters), same
+    deterministic dedup rules.  The statecheck ``telemetry`` config
+    compares every device tensor against this after each settled op."""
+
+    def __init__(self, spec: SketchSpec) -> None:
+        self.spec = spec
+        s = zero_state_host(spec)
+        self.cms, self.keys, self.cnt, self.tcnt = (
+            s.cms, s.keys, s.cnt, s.tcnt
+        )
+
+    def columns(self):
+        return {"cms": self.cms, "keys": self.keys, "cnt": self.cnt,
+                "tcnt": self.tcnt}
+
+    def clear(self) -> None:
+        s = zero_state_host(self.spec)
+        self.cms, self.keys, self.cnt, self.tcnt = (
+            s.cms, s.keys, s.cnt, s.tcnt
+        )
+
+    def update(self, wire: np.ndarray, res: np.ndarray,
+               tenant: Optional[np.ndarray] = None,
+               tflags: Optional[np.ndarray] = None) -> None:
+        from ..constants import IPPROTO_TCP, KIND_IPV4, KIND_IPV6
+        from ..flow import host_unpack_wire
+        from .jaxpath import TCP_ACK, TCP_SYN
+
+        spec = self.spec
+        wire = np.asarray(wire, np.uint32)
+        b = wire.shape[0]
+        f = host_unpack_wire(wire)
+        tenant = (np.zeros(b, np.int32) if tenant is None
+                  else np.asarray(tenant, np.int32))
+        tflags = (np.zeros(b, np.int32) if tflags is None
+                  else np.asarray(tflags, np.int32))
+        res = np.asarray(res).astype(np.uint32)
+        keyw = _key_words_np(f, tenant, res)
+        is_ip = (f["kind"] == KIND_IPV4) | (f["kind"] == KIND_IPV6)
+        t_ok = (tenant >= 0) & (tenant < spec.max_tenants)
+        elig = is_ip & t_ok
+        h1, h2 = _hash_np(keyw)
+        D, W, K, Wy = spec.depth, spec.width, spec.topk, spec.ways
+        rows = np.arange(D, dtype=np.uint32)[None, :]
+        col = ((h1[:, None] + rows * h2[:, None])
+               & np.uint32(W - 1)).astype(np.int64)      # (B, D)
+        flat = rows.astype(np.int64) * W + col
+        # 1. count-min add + saturation clamp (the model ALWAYS clamps)
+        cms = self.cms.reshape(-1)
+        np.add.at(cms, flat[elig].reshape(-1), 1)
+        np.minimum(cms, np.int32(spec.sat), out=cms)
+        self.cms = cms.reshape(D, W)
+        # post-update estimate: min over rows (identical for duplicate
+        # keys in the batch — same buckets, same settled counts)
+        est = np.min(self.cms.reshape(-1)[flat], axis=1).astype(np.int32)
+        # 2. heavy-hitter probe
+        wid = np.arange(Wy, dtype=np.uint32)[None, :]
+        cand = ((h1[:, None] + wid * h2[:, None])
+                & np.uint32(K - 1)).astype(np.int64)     # (B, Wy)
+        ek = self.keys[cand]                             # (B, Wy, 6)
+        ecnt = self.cnt[cand]                            # (B, Wy)
+        occupied = ecnt > 0
+        match_w = np.all(ek == keyw[:, None, :], axis=2) & occupied
+        match_w &= elig[:, None]
+        widx = np.arange(Wy, dtype=np.int32)[None, :]
+        m_first = np.min(np.where(match_w, widx, Wy), axis=1)
+        matched = m_first < Wy
+        mslot = np.sum(np.where(widx == m_first[:, None], cand, 0), axis=1)
+        # matched refresh: order-free max scatter
+        np.maximum.at(self.cnt, mslot[matched], est[matched])
+        # replacement: first empty way, else min-count way; replace only
+        # when the estimate strictly beats the resident count
+        e_first = np.min(np.where(~occupied, widx, Wy), axis=1)
+        vmin = np.argmin(ecnt, axis=1).astype(np.int32)
+        vway = np.where(e_first < Wy, e_first, vmin)
+        vslot = np.sum(np.where(widx == vway[:, None], cand, 0), axis=1)
+        vcnt = np.where(
+            e_first < Wy, 0,
+            np.sum(np.where(widx == vway[:, None], ecnt, 0), axis=1),
+        )
+        want = elig & ~matched & (est > vcnt)
+        lane = np.arange(b, dtype=np.int64)
+        winner = np.full(K + 1, -1, np.int64)
+        np.maximum.at(winner, np.where(want, vslot, K), lane)
+        win = want & (winner[np.clip(vslot, 0, K)] == lane)
+        ws = vslot[win]
+        self.keys[ws] = keyw[win]
+        self.cnt[ws] = est[win]
+        # 3. exact per-tenant counters
+        from ..constants import ALLOW, DENY
+
+        act = (res & 0xFF).astype(np.int32)
+        is_tcp = f["proto"] == IPPROTO_TCP
+        syn = is_tcp & ((tflags & TCP_SYN) != 0) & ((tflags & TCP_ACK) == 0)
+        upd = np.stack([
+            np.ones(b, np.int32),
+            (act == ALLOW).astype(np.int32),
+            (act == DENY).astype(np.int32),
+            syn.astype(np.int32),
+        ], axis=1)
+        np.add.at(self.tcnt, np.clip(tenant, 0, spec.max_tenants - 1)[elig],
+                  upd[elig])
+
+
+# --- device kernels ----------------------------------------------------------
+
+
+def _key_words_jax(batch, tenant, res):
+    import jax.numpy as jnp
+
+    act = res.astype(jnp.uint32) & jnp.uint32(0xFF)
+    w5 = act | ((batch.kind.astype(jnp.uint32) & 3) << 8)
+    return jnp.stack([
+        tenant.astype(jnp.uint32),
+        batch.ip_words[:, 0].astype(jnp.uint32),
+        batch.ip_words[:, 1].astype(jnp.uint32),
+        batch.ip_words[:, 2].astype(jnp.uint32),
+        batch.ip_words[:, 3].astype(jnp.uint32),
+        w5,
+    ], axis=1)
+
+
+def _hash_jax(keys):
+    import jax.numpy as jnp
+
+    h = jnp.full(keys.shape[:1], 0x811C9DC5, jnp.uint32)
+    for w in range(SKETCH_KEY_WORDS):
+        h = (h ^ keys[:, w].astype(jnp.uint32)) * jnp.uint32(0x01000193)
+    return h, (h >> 16) | jnp.uint32(1)
+
+
+def _sketch_update_core(sk: SketchState, batch, tenant, tflags, res,
+                        *, spec: SketchSpec) -> SketchState:
+    """One batch of telemetry updates — the in-program form the resident
+    fused step composes (jaxpath._resident_step_core) and the standalone
+    launch (jitted_sketch_update) wraps.  Every write is a deterministic
+    scatter; HostSketchModel.update mirrors this function statement for
+    statement."""
+    import jax.numpy as jnp
+
+    from ..constants import ALLOW, DENY, IPPROTO_TCP, KIND_IPV4, KIND_IPV6
+    from .jaxpath import TCP_ACK, TCP_SYN
+
+    D, W, K, Wy = spec.depth, spec.width, spec.topk, spec.ways
+    b = batch.kind.shape[0]
+    keyw = _key_words_jax(batch, tenant, res)
+    is_ip = (batch.kind == KIND_IPV4) | (batch.kind == KIND_IPV6)
+    t_ok = (tenant >= 0) & (tenant < spec.max_tenants)
+    elig = is_ip & t_ok
+    h1, h2 = _hash_jax(keyw)
+    rows = jnp.arange(D, dtype=jnp.uint32)[None, :]
+    col = ((h1[:, None] + rows * h2[:, None])
+           & jnp.uint32(W - 1)).astype(jnp.int32)
+    flat = rows.astype(jnp.int32) * W + col                 # (B, D)
+    # 1. count-min add + saturation clamp (dropped by the injected
+    # sketchsat defect — DEVICE side only, so the model diverges)
+    idx = jnp.where(elig[:, None], flat, D * W)
+    cms = sk.cms.reshape(-1).at[idx.reshape(-1)].add(1, mode="drop")
+    if not _inject_sketch_sat_bug():
+        cms = jnp.minimum(cms, jnp.int32(spec.sat))
+    est = jnp.min(
+        jnp.take(cms, flat.reshape(-1), mode="clip").reshape(b, D), axis=1
+    ).astype(jnp.int32)
+    # 2. heavy-hitter table
+    wid = jnp.arange(Wy, dtype=jnp.uint32)[None, :]
+    cand = ((h1[:, None] + wid * h2[:, None])
+            & jnp.uint32(K - 1)).astype(jnp.int32)          # (B, Wy)
+    ek = jnp.take(sk.keys, cand, axis=0, mode="clip")       # (B, Wy, 6)
+    ecnt = jnp.take(sk.cnt, cand, axis=0, mode="clip")      # (B, Wy)
+    occupied = ecnt > 0
+    match_w = (
+        jnp.all(ek == keyw[:, None, :], axis=2) & occupied & elig[:, None]
+    )
+    widx = jnp.arange(Wy, dtype=jnp.int32)[None, :]
+    m_first = jnp.min(jnp.where(match_w, widx, Wy), axis=1)
+    matched = m_first < Wy
+    mslot = jnp.sum(jnp.where(widx == m_first[:, None], cand, 0), axis=1)
+    cnt = sk.cnt.at[jnp.where(matched, mslot, K)].max(est, mode="drop")
+    e_first = jnp.min(jnp.where(~occupied, widx, Wy), axis=1)
+    vmin = jnp.argmin(ecnt, axis=1).astype(jnp.int32)
+    vway = jnp.where(e_first < Wy, e_first, vmin)
+    vslot = jnp.sum(jnp.where(widx == vway[:, None], cand, 0), axis=1)
+    vcnt = jnp.where(
+        e_first < Wy, 0,
+        jnp.sum(jnp.where(widx == vway[:, None], ecnt, 0), axis=1),
+    )
+    want = elig & ~matched & (est > vcnt)
+    lane = jnp.arange(b, dtype=jnp.int32)
+    winner = jnp.full(K + 1, -1, jnp.int32).at[
+        jnp.where(want, vslot, K)
+    ].max(lane, mode="drop")
+    win = want & (jnp.take(winner, jnp.clip(vslot, 0, K),
+                           mode="clip") == lane)
+    idx_w = jnp.where(win, vslot, K)
+    keys = sk.keys.at[idx_w].set(keyw, mode="drop")
+    cnt = cnt.at[idx_w].set(est, mode="drop")
+    # 3. exact per-tenant counters
+    act = (res.astype(jnp.uint32) & 0xFF).astype(jnp.int32)
+    is_tcp = batch.proto == IPPROTO_TCP
+    syn = is_tcp & ((tflags & TCP_SYN) != 0) & ((tflags & TCP_ACK) == 0)
+    upd = jnp.stack([
+        jnp.ones(b, jnp.int32),
+        (act == ALLOW).astype(jnp.int32),
+        (act == DENY).astype(jnp.int32),
+        syn.astype(jnp.int32),
+    ], axis=1)
+    trow = jnp.where(
+        elig, jnp.clip(tenant, 0, spec.max_tenants - 1), spec.max_tenants
+    )
+    tcnt = sk.tcnt.at[trow].add(upd, mode="drop")
+    return SketchState(cms=cms.reshape(D, W), keys=keys, cnt=cnt, tcnt=tcnt)
+
+
+#: donated operand position of the standalone sketch update — the
+#: persistent telemetry tensors are rewritten in place every admission
+#: (input-output aliasing, verified by the jaxcheck donation lint).
+SKETCH_DONATE_ARGNUMS = (0,)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_sketch_update(spec: SketchSpec):
+    """The multi-dispatch telemetry launch: one device program updating
+    the whole telemetry plane from (wire, verdicts) with NO readback —
+    the host learns nothing until the decimated drain.  Cache keyed on
+    the sketch geometry only; batch shape specializes through jit's
+    shape keying (warmed by the scheduler ladder).  The state operand is
+    DONATED: the returned tensors alias the inputs in place."""
+    import jax
+
+    from . import jaxpath
+
+    def f(sk, wire, tenant, tflags, res):
+        return _sketch_update_core(
+            sk, jaxpath.unpack_wire(wire), tenant, tflags, res, spec=spec
+        )
+
+    return jax.jit(f, donate_argnums=SKETCH_DONATE_ARGNUMS)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_sketch_clear():
+    """Donated zeroing of the telemetry tensors — the drain's reset
+    reuses the very buffers it snapshots (no fresh device allocation on
+    the decimated path)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(sk):
+        return SketchState(*(jnp.zeros_like(a) for a in sk))
+
+    return jax.jit(f, donate_argnums=(0,))

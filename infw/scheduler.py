@@ -409,6 +409,17 @@ def prewarm_ladder(clf, ladder, include_depth_classes: bool = True,
             n_done += int(warm_flow([int(b) for b in ladder]) or 0)
         except Exception as e:  # degrade, never refuse
             log.debug("flow prewarm skipped: %s", e)
+    warm_tel = getattr(clf, "warm_telemetry_ladder", None)
+    if warm_tel is not None:
+        # telemetry plane (ISSUE-13): the ladder loop above warmed the
+        # resident fused sketch variants through the production
+        # dispatch; this compiles the classic follow-on sketch-update
+        # launch for every ladder shape too, so telemetry never costs a
+        # serving-path compile in either dispatch mode
+        try:
+            n_done += int(warm_tel([int(b) for b in ladder]) or 0)
+        except Exception as e:  # degrade, never refuse
+            log.debug("telemetry prewarm skipped: %s", e)
     mark_resident = getattr(clf, "mark_resident_warm", None)
     if mark_resident is not None:
         # resident-pool-aware prewarm (ISSUE-12): the ladder loop above
@@ -486,6 +497,7 @@ class ContinuousScheduler:
         clock: Callable[[], float] = time.monotonic,
         txn_batcher=None,
         txn_flush: Optional[Callable] = None,
+        tracer=None,
     ) -> None:
         self.clf = clf
         self.policy = policy
@@ -515,6 +527,11 @@ class ContinuousScheduler:
         self.ring = ring
         self.stats = stats if stats is not None else SchedulerStats()
         self._clock = clock
+        #: serving-path span tracer (obs.telemetry.SpanTracer): when
+        #: given, every admitted job charges pack / dispatch /
+        #: materialize / drain spans to the shared histograms (the
+        #: daemon's ingest tick charges ingest/pack the same way)
+        self.tracer = tracer
 
     # -- dispatch plumbing ---------------------------------------------------
 
@@ -663,7 +680,11 @@ class ContinuousScheduler:
                         return
                     job, pending = pending_q.popleft()
                 try:
+                    tr = job.get("trace")
+                    t_mat0 = time.perf_counter()
                     out = pending.result()
+                    if tr is not None:
+                        tr.add("materialize", time.perf_counter() - t_mat0)
                     t_done = self._clock()
                     idx = job["idx"]
                     k = len(idx)
@@ -677,6 +698,9 @@ class ContinuousScheduler:
                     n_miss = int((lat > deadline_s).sum())
                     self.stats.note_complete(k, 0)
                     self._emit_miss(n_miss, k, float(lat.max()), deadline_s)
+                    if tr is not None:
+                        tr.mark("drain")
+                        self.tracer.finish(tr)
                 except BaseException as e:  # surfaced by serve() at exit
                     errs.append(e)
                 with cv:
@@ -714,13 +738,22 @@ class ContinuousScheduler:
             bucket = ladder_bucket(len(idx), max(cap, len(idx)))
             self.stats.note_admit(len(idx), bucket, spilled=spilled)
             batch_sizes.append(len(idx))
+            trace = (
+                self.tracer.begin(len(idx))
+                if self.tracer is not None else None
+            )
             thunk = self._dispatch(target, batch, idx, bucket,
                                    tenant_of=tenant_of)
+            if trace is not None:
+                # subset pack + ladder pad + prepare_packed (H2D start)
+                trace.mark("pack")
             # the bucket travels with the job: the drain thread must
             # feed the service observation to the bucket the job was
             # DISPATCHED at, not a recomputation that forgets spill
             # scaling
-            staged.append(({"idx": idx, "bucket": bucket}, thunk))
+            staged.append((
+                {"idx": idx, "bucket": bucket, "trace": trace}, thunk,
+            ))
 
         def launch_ready() -> None:
             while staged:
@@ -729,7 +762,11 @@ class ContinuousScheduler:
                         return
                 job, thunk = staged.popleft()
                 job["t_launch"] = self._clock()
+                t_disp0 = time.perf_counter()
                 pending = thunk()
+                tr = job.get("trace")
+                if tr is not None:
+                    tr.add("dispatch", time.perf_counter() - t_disp0)
                 with cv:
                     pending_q.append((job, pending))
                     outstanding[0] += 1

@@ -397,6 +397,7 @@ def test_arena_classifier_fused_and_overlay():
 # --- zero-recompile warm-arena contract -------------------------------------
 
 
+@pytest.mark.slow
 def test_zero_recompiles_across_tenant_counts_and_lifecycle():
     """The recompile lint (the scheduler/test_statecheck _cache_size
     pattern): on a warm arena, growing the ACTIVE tenant count through
@@ -676,6 +677,7 @@ def test_daemon_tenants_flag_validation(capsys):
 # --- statecheck arena configs + pageflip defect -----------------------------
 
 
+@pytest.mark.slow
 def test_statecheck_arena_configs():
     from infw.analysis import statecheck
 
@@ -685,6 +687,7 @@ def test_statecheck_arena_configs():
         assert rep["ok"], rep
 
 
+@pytest.mark.slow
 def test_pageflip_defect_caught_and_shrunk():
     from infw.analysis import statecheck
     from infw.analysis.shrink import shrink_case

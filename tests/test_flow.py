@@ -395,6 +395,7 @@ def test_invalidation_on_tenant_swap():
 # --- zero-recompile warm lifecycle -------------------------------------------
 
 
+@pytest.mark.slow
 def test_zero_recompile_warm_flow_lifecycle():
     """After the ladder warm, the whole flow lifecycle — probe across
     batch sizes and occupancies, insert, age, invalidation — compiles
@@ -491,6 +492,7 @@ def test_flow_evict_record_renders():
 # --- statecheck configs ------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_statecheck_flow_config_clean():
     from infw.analysis import statecheck
 
@@ -499,6 +501,7 @@ def test_statecheck_flow_config_clean():
     assert rep["ok"], rep.get("failure")
 
 
+@pytest.mark.slow
 def test_statecheck_flowstale_defect_caught():
     import infw.flow as flow_mod
     from infw.analysis import statecheck

@@ -134,6 +134,7 @@ def test_mesh_v4_only_batch():
     assert "wire8" in m.wire_stats(), m.wire_stats()
 
 
+@pytest.mark.slow
 def test_mesh_packed_contract_and_depth_steering():
     """The daemon's exact hot loop — v6_depth_groups + prepare_packed /
     classify_prepared staged plans — against the mesh, including the
@@ -194,6 +195,7 @@ def test_mesh_midstream_reshard_rules_sharded():
     _assert_parity(m, s, t2, batch)
 
 
+@pytest.mark.slow
 def test_mesh_midstream_patch_replicated():
     """On the replicated config a 1-key rules edit must take the
     diff-scatter patch path (kilobytes broadcast, not a full re-put) and

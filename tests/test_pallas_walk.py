@@ -141,6 +141,7 @@ def test_walk_positions_tail_matches_oracle():
     np.testing.assert_array_equal(np.asarray(res3), np.asarray(res))
 
 
+@pytest.mark.slow
 def test_walk_oob_and_fail_closed():
     """Invalidated lanes resolve deterministically to UNDEF -> XDP_PASS
     (the kernel's no-match semantics, kernel.c:453), never to a stale or

@@ -1,0 +1,695 @@
+"""Device-resident telemetry plane (ISSUE-13): sketch kernels vs the
+numpy oracle, the decimated drain's exactly-once contract, token-bucket
+sampling, serving-path tracing, the attack-trace workloads, and the
+statecheck telemetry configs.
+
+Tier-1 keeps the cheap oracle/parity/policy tests; the jit-heavy
+classifier-path and statecheck sweeps are slow-marked and run in
+``make test``, ``make state-check`` (telemetry configs + the sketchsat
+acceptance) and ``make telemetry-bench`` (retention + steady-state +
+detection gates).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from infw import testing
+from infw.kernels.sketch import (
+    HostSketchModel,
+    SketchSpec,
+    SketchState,
+    jitted_sketch_clear,
+    jitted_sketch_update,
+    zero_state_host,
+)
+from infw.obs.telemetry import (
+    SPAN_STAGES,
+    SketchSnapshot,
+    SpanHistograms,
+    SpanTracer,
+    TelemetryTier,
+    TokenBucket,
+    summarize_snapshot,
+)
+
+#: one small spec shared across tests so the jitted update compiles once
+SPEC = SketchSpec.make(depth=3, width=64, topk=16, ways=2, sat=9,
+                       max_tenants=3)
+
+
+def _tables(n=256, seed=3):
+    rng = np.random.default_rng(seed)
+    return testing.random_tables_fast(
+        rng, n_entries=n, width=4, v6_fraction=0.4, ifindexes=(2, 3)
+    )
+
+
+def _device_state(spec):
+    import jax
+
+    return SketchState(*(jax.device_put(a) for a in zero_state_host(spec)))
+
+
+# --- kernel vs model oracle ---------------------------------------------------
+
+
+def test_sketch_kernel_matches_model_bit_exact():
+    """Count-min adds (with the saturation clamp engaged by the tiny
+    sat), top-K refresh/replace/eviction churn (tiny table), tenant
+    counters — device tensors vs HostSketchModel, bit for bit, across
+    repeated seeded batches with duplicate keys and invalid tenants."""
+    import jax
+
+    tables = _tables()
+    rng = np.random.default_rng(7)
+    model = HostSketchModel(SPEC)
+    state = _device_state(SPEC)
+    fn = jitted_sketch_update(SPEC)
+    for it in range(5):
+        b = testing.random_batch(rng, tables, 96)
+        wire = b.pack_wire().astype(np.uint32)
+        res = rng.integers(0, 1 << 16, len(b)).astype(np.uint32)
+        tenant = rng.integers(-1, 4, len(b)).astype(np.int32)
+        tflags = rng.integers(0, 32, len(b)).astype(np.int32)
+        state = fn(state, jax.device_put(wire), jax.device_put(tenant),
+                   jax.device_put(tflags), jax.device_put(res))
+        model.update(wire, res, tenant, tflags)
+        for name in state._fields:
+            assert np.array_equal(
+                np.asarray(getattr(state, name)), model.columns()[name]
+            ), (it, name)
+    # the tiny sat must have engaged, or the clamp path went untested
+    assert model.cms.max() == SPEC.sat
+    # donated clear: both sides back to zero
+    state = jitted_sketch_clear()(state)
+    model.clear()
+    for name in state._fields:
+        assert np.array_equal(
+            np.asarray(getattr(state, name)), model.columns()[name]
+        )
+
+
+def test_sketchsat_defect_diverges_from_model():
+    """The injected saturation-clamp drop (device side only) must break
+    the bit-identity the previous test pins — the surface the statecheck
+    sketchsat acceptance shrinks on."""
+    import jax
+
+    import infw.kernels.sketch as sketch_mod
+
+    spec = SketchSpec.make(depth=2, width=32, topk=8, ways=2, sat=3)
+    tables = _tables()
+    b = testing.random_batch(np.random.default_rng(8), tables, 128)
+    wire = b.pack_wire().astype(np.uint32)
+    res = np.zeros(len(b), np.uint32)
+    zeros = np.zeros(len(b), np.int32)
+    model = HostSketchModel(spec)
+    state = _device_state(spec)
+    sketch_mod._INJECT_SKETCH_SAT_BUG = True
+    try:
+        fn = jitted_sketch_update(spec)
+        state = fn(state, jax.device_put(wire), jax.device_put(zeros),
+                   jax.device_put(zeros), jax.device_put(res))
+    finally:
+        sketch_mod._INJECT_SKETCH_SAT_BUG = False
+        jitted_sketch_update.cache_clear()  # the cached fn baked the bug
+    model.update(wire, res)
+    assert not np.array_equal(np.asarray(state.cms), model.cms)
+    assert int(np.asarray(state.cms).max()) > spec.sat
+
+
+def test_sketch_spec_validation():
+    with pytest.raises(ValueError):
+        SketchSpec.make(depth=0)
+    with pytest.raises(ValueError):
+        SketchSpec.make(ways=9)
+    with pytest.raises(ValueError):
+        SketchSpec.make(sat=0)
+    s = SketchSpec.make(width=100, topk=10)
+    assert s.width == 128 and s.topk == 16  # pow2 bucketing
+
+
+# --- summarizer ---------------------------------------------------------------
+
+
+def test_summarize_snapshot_flags_and_top_talkers():
+    spec = SketchSpec.make(depth=2, width=32, topk=8, ways=2,
+                           max_tenants=4)
+    s = zero_state_host(spec)
+    # tenant 1: deny storm; tenant 2: syn flood; tenant 3: quiet
+    s.tcnt[1] = [100, 10, 90, 0]
+    s.tcnt[2] = [100, 95, 5, 80]
+    s.tcnt[3] = [10, 10, 0, 0]
+    # two heavy hitters: a v4 deny talker and a v6 allow talker
+    s.keys[5] = [1, 0x0A000001, 0, 0, 0, (1 << 8) | 1]
+    s.cnt[5] = 90
+    s.keys[2] = [2, 0x20010DB8, 0, 0, 1, (2 << 8) | 2]
+    s.cnt[2] = 40
+    snap = SketchSnapshot(seq=7, admissions=12, cms=s.cms, keys=s.keys,
+                          cnt=s.cnt, tcnt=s.tcnt)
+    rec = summarize_snapshot(snap, top_n=4, min_packets=32)
+    assert rec.seq == 7 and rec.admissions == 12
+    by_t = {t["tenant"]: t for t in rec.tenants}
+    assert by_t[1]["deny_storm"] and not by_t[1]["syn_flood"]
+    assert by_t[2]["syn_flood"] and not by_t[2]["deny_storm"]
+    assert 3 in by_t and not by_t[3]["deny_storm"]  # under min_packets
+    assert [h["count"] for h in rec.top] == [90, 40]
+    assert rec.top[0]["src"] == "10.0.0.1" and rec.top[0]["verdict"] == "deny"
+    assert rec.top[1]["src"].startswith("2001:db8")
+    # the record renders operator lines (the events-log consumer)
+    text = "\n".join(rec.lines())
+    assert "DENY-STORM" in text and "SYN-FLOOD" in text
+    assert "10.0.0.1" in text
+
+
+# --- token bucket / sampling --------------------------------------------------
+
+
+def test_token_bucket_never_exceeds_budget():
+    tb = TokenBucket(rate=10.0, burst=5.0)
+    granted = tb.take(100, now=0.0)
+    assert granted == 5  # the burst cap
+    assert tb.take(100, now=0.0) == 0
+    # 1s later: exactly rate tokens refilled, capped at burst
+    assert tb.take(100, now=1.0) == 5
+    # over any window, grants <= burst + rate * elapsed (hard ceiling)
+    tb2 = TokenBucket(rate=7.0, burst=3.0)
+    total = 0
+    for i in range(200):
+        total += tb2.take(5, now=i * 0.1)
+    assert total <= 3 + 7 * (199 * 0.1) + 1
+
+
+def test_tier_sample_allow_accounts_suppression():
+    tier = TelemetryTier(SPEC, track_model=False)
+    tier._sample_rate, tier._sample_burst = 2.0, 4.0
+    g1 = tier.sample_allow(0, 10, now=0.0)
+    g2 = tier.sample_allow(0, 10, now=0.0)
+    assert g1 == 4 and g2 == 0
+    # independent per-tenant buckets
+    assert tier.sample_allow(1, 3, now=0.0) == 3
+    vals = tier.counter_values()
+    assert vals["telemetry_sampled_events_total"] == 7
+    assert vals["telemetry_suppressed_events_total"] == 16
+
+
+# --- the decimated drain ------------------------------------------------------
+
+
+def _update_tier(tier, tables, rng, n=64, tenant_hi=3):
+    b = testing.random_batch(rng, tables, n)
+    wire = b.pack_wire().astype(np.uint32)
+    res = rng.integers(0, 1 << 16, n).astype(np.uint32)
+    tenant = rng.integers(0, tenant_hi, n).astype(np.int32)
+    tier.update(wire, res, tenant_np=tenant)
+
+
+def test_drain_decimation_and_seq():
+    """One drain per drain_every admissions, seq gap-free, device and
+    model both zeroed after — and counts land in EXACTLY one window
+    (window admission counts sum to the total)."""
+    tier = TelemetryTier(SPEC, track_model=True, drain_every=4)
+    tables = _tables()
+    rng = np.random.default_rng(11)
+    recs = []
+
+    class Ring:
+        def push(self, r):
+            recs.append(r)
+
+    tier.attach_ring(Ring())
+    for _ in range(10):
+        _update_tier(tier, tables, rng)
+    # 10 admissions at drain_every=4 -> 2 auto-drains
+    assert [r.seq for r in recs] == [1, 2]
+    assert sum(r.admissions for r in recs) == 8
+    recs2 = tier.drain(force=True)
+    assert recs2[0].seq == 3 and recs2[0].admissions == 2
+    cols = tier.columns()
+    assert all((cols[n] == 0).all() for n in cols)
+    assert all(
+        (tier.model.columns()[n] == 0).all() for n in tier.model.columns()
+    )
+
+
+def test_drain_exactly_once_under_concurrent_updates():
+    """Updates from several threads racing forced drains: every seq is
+    emitted exactly once with no gaps, every admission lands in exactly
+    one window, and the device tensors still match the model at the
+    settled end (the generation-stamp discipline)."""
+    tier = TelemetryTier(SPEC, track_model=True, drain_every=6)
+    tables = _tables()
+    recs = []
+    lock = threading.Lock()
+
+    class Ring:
+        def push(self, r):
+            with lock:
+                recs.append(r)
+
+    tier.attach_ring(Ring())
+    errs = []
+
+    def traffic(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(12):
+                _update_tier(tier, tables, rng, n=32)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def drainer():
+        try:
+            for _ in range(8):
+                tier.drain(force=True)
+                time.sleep(0.001)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=traffic, args=(s,)) for s in (1, 2)]
+    threads.append(threading.Thread(target=drainer))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    final = tier.drain(force=True)[0]
+    with lock:
+        # drain() publishes on the ring itself — the returned record is
+        # the ring's last entry, not an extra one
+        assert recs[-1] is final
+        seqs = [r.seq for r in recs]
+        total = sum(r.admissions for r in recs)
+    assert seqs == list(range(1, len(seqs) + 1))  # exactly-once, gap-free
+    assert total == 24  # every admission in exactly one window
+    cols = tier.columns()
+    mcols = tier.model.columns()
+    for name in cols:
+        assert np.array_equal(cols[name], mcols[name]), name
+
+
+# --- tracing ------------------------------------------------------------------
+
+
+def test_span_histograms_render_prometheus():
+    h = SpanHistograms()
+    h.observe("dispatch", 3.0)
+    h.observe("dispatch", 1000.0)
+    h.observe("ingest", 0.5)
+    text = h.render_histograms()
+    assert "# TYPE ingressnodefirewall_node_span_us histogram" in text
+    assert 'span_us_bucket{stage="dispatch",le="+Inf"} 2' in text
+    assert 'span_us_bucket{stage="dispatch",le="4"} 1' in text
+    assert 'span_us_count{stage="dispatch"} 2' in text
+    assert 'span_us_count{stage="ingest"} 1' in text
+    # cumulative buckets are monotone
+    v = h.values()["dispatch"]
+    assert v["count"] == 2 and v["sum_us"] == pytest.approx(1003.0)
+
+
+def test_histograms_survive_registry_reload():
+    """The weak-registry discipline (obs.statistics): a LIVE tracer's
+    histograms survive re-registration and repeated renders; a dropped
+    provider disappears from the exposition instead of double
+    counting."""
+    import gc
+
+    from infw.obs.statistics import Registry
+
+    reg = Registry()
+    h = SpanHistograms()
+    h.observe("pack", 10.0)
+    reg.register_histograms(h)
+    reg.register_histograms(h)  # idempotent
+    t1 = reg.render_text()
+    assert t1.count('span_us_count{stage="pack"} 1') == 1
+    # re-register into a fresh registry (the daemon-reload shape): the
+    # provider moves, no duplicate series, counts intact
+    reg2 = Registry()
+    reg2.register_histograms(h)
+    h.observe("pack", 20.0)
+    assert 'span_us_count{stage="pack"} 2' in reg2.render_text()
+    # dropped provider vanishes from the old registry
+    del h
+    gc.collect()
+    assert "span_us" not in reg.render_text()
+
+
+def test_tracer_slow_sampling_token_bucket():
+    recs = []
+
+    class Ring:
+        def push(self, r):
+            recs.append(r)
+
+    tr = SpanTracer(ring=Ring(), slow_us=100.0, sample_rate=0.0,
+                    sample_burst=2.0)
+    for _ in range(5):
+        t = tr.begin(8)
+        t.add("dispatch", 0.001)  # 1000us, slow
+        tr.finish(t, now=0.0)
+    # only the burst budget of slow records was sampled
+    assert len(recs) == 2
+    assert tr.counters["slow_sampled"] == 2
+    assert tr.counters["slow_suppressed"] == 3
+    assert tr.counters["traces"] == 5
+    assert recs[0].n_packets == 8 and recs[0].spans_us["dispatch"] > 100
+    # fast traces observe histograms but never sample
+    t = tr.begin(1)
+    t.add("dispatch", 1e-6)
+    tr.finish(t, now=0.0)
+    assert len(recs) == 2
+    assert "trace-span" in recs[0].lines()[0]
+    assert all(s in SPAN_STAGES for s in ("ingest", "drain"))
+
+
+# --- attack traces / loadgen --------------------------------------------------
+
+
+def test_attack_trace_modes_deterministic():
+    tables = _tables(400, seed=9)
+    for mode in testing.ATTACK_MODES:
+        b1, m1 = testing.attack_trace_batch(
+            np.random.default_rng(4), tables, 2048, mode=mode,
+            chunk_packets=512,
+        )
+        b2, m2 = testing.attack_trace_batch(
+            np.random.default_rng(4), tables, 2048, mode=mode,
+            chunk_packets=512,
+        )
+        assert np.array_equal(b1.pack_wire(), b2.pack_wire())
+        assert np.array_equal(b1.tcp_flags, b2.tcp_flags)
+        assert m1["start"] == 512  # chunk-aligned onset
+        assert m1["n_attack"] > 0
+        mask = m1["attack_mask"]
+        assert not mask[: m1["start"]].any()
+        if mode == "synflood":
+            from infw.kernels.jaxpath import TCP_SYN
+
+            assert (b1.tcp_flags[mask] == TCP_SYN).all()
+        if mode == "portscan":
+            assert len(m1["attackers"]) == 1
+        if mode == "denystorm":
+            from infw import oracle
+
+            ref = oracle.classify(tables, b1)
+            atk = (np.asarray(ref.results)[mask] & 0xFF) == 1
+            assert atk.all()  # every attack lane oracle-denies
+
+
+def test_loadgen_attack_modes(tmp_path):
+    import importlib.util
+    import sys
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    spec = importlib.util.spec_from_file_location(
+        "infw_loadgen_atk", os.path.join(tools_dir, "loadgen.py")
+    )
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+
+    out1, out2 = str(tmp_path / "a"), str(tmp_path / "b")
+    args = ["--rate", "1000000", "--n", "2048", "--file-packets", "512",
+            "--seed", "11", "--attack", "synflood",
+            "--attack-fraction", "0.5", "--attack-start", "0.25"]
+    assert lg.main(["--out", out1] + args) == 0
+    assert lg.main(["--out", out2] + args) == 0
+    files = sorted(f for f in os.listdir(out1) if f.endswith(".frames"))
+    for fn in files:  # byte-deterministic with the attack injected
+        assert open(os.path.join(out1, fn), "rb").read() == \
+            open(os.path.join(out2, fn), "rb").read()
+    with open(os.path.join(out1, "loadgen-manifest.json")) as f:
+        man = json.load(f)
+    assert man["attack"] == "synflood"
+    assert man["attack_start_packet"] == 512
+    assert len(man["attackers"]) == 2 and man["attack_packets"] > 0
+    # bad knobs fail the launch
+    with pytest.raises(SystemExit):
+        lg.main(["--out", str(tmp_path / "x"), "--rate", "1", "--n", "1",
+                 "--attack", "synflood", "--attack-fraction", "1.5"])
+
+
+def test_daemon_telemetry_flag_validation(tmp_path):
+    from infw.daemon import main as daemon_main
+
+    base = ["--state-dir", str(tmp_path), "--node-name", "n"]
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "cpu", "--telemetry", "2048"])
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "tpu", "--telemetry", "4"])
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "tpu", "--telemetry", "junk"])
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "tpu", "--telemetry-drain", "0"])
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "tpu", "--trace-slow-us", "-1"])
+
+
+# --- classifier integration (jit-heavy: make test / telemetry-bench) ---------
+
+
+def _run_chunks(clf, tables, n_chunks=4, bs=64):
+    clf.load_tables(tables)
+    out = None
+    for i in range(n_chunks):
+        b = testing.random_batch(np.random.default_rng(100 + i), tables, bs)
+        b.tcp_flags = np.random.default_rng(i).integers(
+            0, 32, len(b)
+        ).astype(np.int32)
+        w, v4 = b.pack_wire_subset(np.arange(len(b)))
+        out = clf.classify_prepared(
+            clf.prepare_packed(w, v4, tcp_flags=b.tcp_flags),
+            apply_stats=False,
+        ).result()
+    return out
+
+
+@pytest.mark.slow
+def test_classifier_paths_update_identically():
+    """Classic wire, flow-probe and resident-fused dispatch must leave
+    bit-identical telemetry state (device == model on each, and equal
+    across paths for the same traffic) — the in-program sketch is the
+    same function the follow-on launch runs."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+
+    tables = _tables(300, seed=5)
+    spec = SketchSpec.make(depth=3, width=128, topk=32, ways=2,
+                           max_tenants=2)
+    states = {}
+    for label, kw in (
+        ("classic", {}),
+        ("flow", {"flow_table": FlowConfig.make(entries=512)}),
+        ("resident", {"flow_table": FlowConfig.make(entries=512),
+                      "resident": True}),
+    ):
+        clf = TpuClassifier(interpret=True, force_path="trie",
+                            telemetry=spec, telemetry_track_model=True,
+                            **kw)
+        _run_chunks(clf, tables)
+        cols = clf.telemetry.columns()
+        mcols = clf.telemetry.model.columns()
+        for name in cols:
+            assert np.array_equal(cols[name], mcols[name]), (label, name)
+        states[label] = cols
+        clf.close()
+    for name in states["classic"]:
+        assert np.array_equal(states["classic"][name],
+                              states["flow"][name]), name
+        assert np.array_equal(states["classic"][name],
+                              states["resident"][name]), name
+
+
+@pytest.mark.slow
+def test_verdicts_unchanged_with_telemetry():
+    """Telemetry on vs off: verdicts and stats bit-identical (the
+    sketch is observation, never policy)."""
+    from infw.backend.tpu import TpuClassifier
+
+    tables = _tables(300, seed=6)
+    a = TpuClassifier(interpret=True, force_path="trie",
+                      telemetry=SketchSpec.make(width=128, topk=16))
+    b = TpuClassifier(interpret=True, force_path="trie")
+    oa = _run_chunks(a, tables)
+    ob = _run_chunks(b, tables)
+    assert np.array_equal(oa.results, ob.results)
+    assert np.array_equal(oa.stats_delta, ob.stats_delta)
+    a.close()
+    b.close()
+
+
+@pytest.mark.slow
+def test_drain_exactly_once_under_concurrent_patch():
+    """The satellite contract: summary records stay exactly-once (seq
+    gap-free, every admission in one window) while rule patches land
+    concurrently with traffic and forced drains, and the device sketch
+    still matches the model at the settled end."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.compiler import IncrementalTables
+
+    tables = _tables(200, seed=12)
+    clf = TpuClassifier(interpret=True, force_path="trie",
+                        telemetry=SketchSpec.make(
+                            depth=2, width=64, topk=16, ways=2),
+                        telemetry_track_model=True)
+    clf.load_tables(tables)
+    tier = clf.telemetry
+    recs = []
+    lock = threading.Lock()
+
+    class Ring:
+        def push(self, r):
+            with lock:
+                recs.append(r)
+
+    tier.attach_ring(Ring())
+    errs = []
+    stop = threading.Event()
+
+    def patcher():
+        try:
+            upd = IncrementalTables.from_content(
+                dict(tables.content), rule_width=4
+            )
+            rng = np.random.default_rng(77)
+            for _ in range(6):
+                keys = list(upd.content)
+                k = keys[int(rng.integers(0, len(keys)))]
+                upd.apply({k: testing.random_rules(rng, 4)}, [])
+                clf.load_tables(upd.snapshot(),
+                                dirty_hint=upd.peek_dirty())
+                upd.clear_dirty()
+                time.sleep(0.01)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def drainer():
+        while not stop.is_set():
+            tier.drain(force=True)
+            time.sleep(0.005)
+
+    tp = threading.Thread(target=patcher)
+    td = threading.Thread(target=drainer)
+    tp.start()
+    td.start()
+    for i in range(10):
+        b = testing.random_batch(np.random.default_rng(500 + i),
+                                 tables, 48)
+        w, v4 = b.pack_wire_subset(np.arange(len(b)))
+        clf.classify_prepared(
+            clf.prepare_packed(w, v4), apply_stats=False
+        ).result()
+    tp.join()
+    stop.set()
+    td.join()
+    assert not errs
+    final = tier.drain(force=True)[0]
+    with lock:
+        assert recs[-1] is final
+        seqs = [r.seq for r in recs]
+        total = sum(r.admissions for r in recs)
+    assert seqs == list(range(1, len(seqs) + 1))
+    assert total == tier.admissions
+    cols = tier.columns()
+    mcols = tier.model.columns()
+    for name in cols:
+        assert np.array_equal(cols[name], mcols[name]), name
+    clf.close()
+
+
+@pytest.mark.slow
+def test_zero_recompile_warm_telemetry_lifecycle():
+    """After the scheduler ladder prewarm, serving dispatches with
+    telemetry on compile nothing — neither the fused resident sketch
+    variant nor the classic follow-on update (the _cache_size
+    discipline)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.kernels import jaxpath
+    from infw.scheduler import prewarm_ladder
+
+    tables = _tables(300, seed=13)
+    spec = SketchSpec.make(depth=2, width=128, topk=16, ways=2)
+    fcfg = FlowConfig.make(entries=512)
+    clf = TpuClassifier(interpret=True, force_path="trie",
+                        flow_table=fcfg, resident=True, telemetry=spec)
+    clf.load_tables(tables)
+    prewarm_ladder(clf, (32, 64))
+    fns = [
+        jaxpath.jitted_resident_step(fcfg.entries, fcfg.ways, "trie",
+                                     v4, None, 0, False, sketch=spec)
+        for v4 in (False, True)
+    ] + [jitted_sketch_update(spec)]
+    c0 = sum(f._cache_size() for f in fns)
+    for i in range(6):
+        b = testing.random_batch(np.random.default_rng(900 + i),
+                                 tables, 32 if i % 2 else 64)
+        w, v4 = b.pack_wire_subset(np.arange(len(b)))
+        clf.classify_prepared(
+            clf.prepare_packed(w, v4), apply_stats=False
+        ).result()
+    assert sum(f._cache_size() for f in fns) == c0
+    assert clf.resident.steady_allocs() == 0
+    clf.close()
+
+
+@pytest.mark.slow
+def test_statecheck_telemetry_configs_clean():
+    from infw.analysis import statecheck
+
+    for name in ("telemetry", "telemetry-resident"):
+        rep = statecheck.run_config(name, seed=0, n_ops=8,
+                                    shrink_on_failure=False)
+        assert rep["ok"], (name, rep["failure"])
+
+
+@pytest.mark.slow
+def test_statecheck_sketchsat_defect_caught():
+    import infw.kernels.sketch as sketch_mod
+    from infw.analysis import statecheck
+
+    sketch_mod._INJECT_SKETCH_SAT_BUG = True
+    try:
+        jitted_sketch_update.cache_clear()
+        rep = statecheck.run_config("telemetry", seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+    finally:
+        sketch_mod._INJECT_SKETCH_SAT_BUG = False
+        jitted_sketch_update.cache_clear()
+    assert not rep["ok"]
+    assert rep["failure"]["phase"] == "telemetry-model"
+
+
+@pytest.mark.slow
+def test_scheduler_tracer_observes_spans():
+    """ContinuousScheduler with a tracer: every admitted job charges
+    pack/dispatch/materialize/drain spans, and the histograms render on
+    a registry like any other provider."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.obs.statistics import Registry
+    from infw.scheduler import ContinuousScheduler, FixedChunkPolicy
+
+    tables = _tables(200, seed=14)
+    clf = TpuClassifier(interpret=True, force_path="trie")
+    clf.load_tables(tables)
+    tracer = SpanTracer(slow_us=1e12)
+    sched = ContinuousScheduler(clf, FixedChunkPolicy(64), tracer=tracer)
+    batch = testing.random_batch(np.random.default_rng(15), tables, 256)
+    offs = np.zeros(256)
+    res = sched.serve(batch, offs)
+    assert len(res.results) == 256
+    vals = tracer.histograms.values()
+    for stage in ("pack", "dispatch", "materialize", "drain"):
+        assert vals[stage]["count"] >= 1, stage
+    reg = Registry()
+    reg.register_histograms(tracer.histograms)
+    assert 'span_us_count{stage="pack"}' in reg.render_text()
+    clf.close()

@@ -302,7 +302,8 @@ def cmd_jax(args) -> int:
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
-                         "arena-ctrie", "flow", "flow-ctrie", "resident")
+                         "arena-ctrie", "flow", "flow-ctrie", "resident",
+                         "telemetry", "telemetry-resident")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -321,7 +322,7 @@ def _run_inject_defect(args, as_json: bool) -> int:
     to a <= 2-op (delete, readd) reproducer."""
     from infw import flow as flow_mod, resident as resident_mod, txn as txn_mod
     from infw.analysis import statecheck
-    from infw.kernels import jaxpath
+    from infw.kernels import jaxpath, sketch as sketch_mod
 
     defect = args.inject_defect
     mod, flag, config, bound = {
@@ -350,6 +351,14 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # single edit op
         "residentstale": (resident_mod, "_INJECT_RESIDENT_STALE_BUG",
                           "resident", 3),
+        # dropped count-min saturation clamp (infw.kernels.sketch): the
+        # DEVICE sketch update stops clamping at ``sat`` while the host
+        # model keeps clamping — the telemetry config's tiny sat makes
+        # the very first settled check's witness traffic push a bucket
+        # past the clamp, so the device-vs-model bit-identity pass
+        # diverges and the shrinker reduces to (at most) one traffic op
+        "sketchsat": (sketch_mod, "_INJECT_SKETCH_SAT_BUG",
+                      "telemetry", 3),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
@@ -528,7 +537,8 @@ def main(argv=None) -> int:
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
-                                  "flowstale", "residentstale"),
+                                  "flowstale", "residentstale",
+                                  "sketchsat"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

@@ -85,29 +85,96 @@ def synth_columns(rng: np.random.Generator, n: int, v6_fraction: float,
     }, n_flows
 
 
+def inject_attack(rng: np.random.Generator, c: dict, n: int, mode: str,
+                  attack_fraction: float, attack_start: float,
+                  n_attackers: int, file_packets: int):
+    """Overwrite a seeded subset of the synthetic lanes with an attack
+    (infw.testing.attack_trace_batch's modes, tables-free form): the
+    attack begins at ``attack_start`` of the stream rounded down to a
+    file/record boundary and claims ``attack_fraction`` of the lanes
+    from then on.  Returns (tcp_flags, meta); byte-deterministic per
+    (seeded rng, arguments).  Note deny verdicts depend on the DAEMON's
+    loaded ruleset — the tables-free generator guarantees the top-talker
+    / SYN-rate surfaces, and `--attack denystorm` aims every attack lane
+    at one (src, dst_port) pair so a single deny rule covers it."""
+    from infw.kernels.jaxpath import TCP_ACK, TCP_SYN
+
+    cp = max(int(file_packets), 1)
+    start = (int(n * float(attack_start)) // cp) * cp
+    mask = (np.arange(n) >= start) & (
+        rng.random(n) < float(attack_fraction)
+    )
+    k = int(mask.sum())
+    n_src = 1 if mode == "portscan" else max(1, int(n_attackers))
+    srcs = np.zeros((n_src, 4), np.uint32)
+    srcs[:, 0] = rng.integers(1, 1 << 32, n_src, dtype=np.uint64)
+    lane_src = np.arange(k) % n_src
+    c["kind"][mask] = 1
+    c["ip_words"][mask] = srcs[lane_src]
+    c["proto"][mask] = 6
+    c["icmp_type"][mask] = 0
+    c["icmp_code"][mask] = 0
+    flags = np.where(c["proto"] == 6, TCP_ACK, 0).astype(np.int32)
+    if mode == "synflood":
+        c["dst_port"][mask] = 443
+        flags[mask] = TCP_SYN
+    elif mode == "portscan":
+        c["dst_port"][mask] = np.arange(k) % 65536
+    else:  # denystorm: one (src, port) pair per attacker — rule-sized
+        c["dst_port"][mask] = 80
+    meta = {
+        "attack": mode, "attack_start_packet": int(start),
+        "attack_packets": k,
+        "attackers": [
+            ".".join(str(b) for b in int(s[0]).to_bytes(4, "big"))
+            for s in srcs
+        ],
+    }
+    return flags, meta
+
+
 def synth_batch(rng: np.random.Generator, n: int, v6_fraction: float,
                 ifindex: int, established_fraction: float = 0.0,
-                file_packets: int = 4096):
+                file_packets: int = 4096, attack=None):
     """Synthetic columns -> frames buffer (the file-drop producer)."""
     c, n_flows = synth_columns(rng, n, v6_fraction,
                                established_fraction, file_packets)
+    meta = {}
+    if attack is not None:
+        _flags, meta = inject_attack(
+            rng, c, n, attack["mode"], attack["fraction"],
+            attack["start"], attack["attackers"], file_packets,
+        )
+        # frames carry no TCP flag bytes (parse_frames_buf degrades
+        # flags to 0) — SYN-rate telemetry needs the --ring producer;
+        # the top-talker / deny-storm surfaces work on either path
     fb = build_frames_bulk(c["kind"], c["ip_words"], c["proto"],
                            c["dst_port"], c["icmp_type"], c["icmp_code"])
     fb.ifindex = np.full(n, int(ifindex), np.uint32)
-    return fb, n_flows
+    return fb, n_flows, meta
 
 
 def synth_wire_batch(rng: np.random.Generator, n: int, v6_fraction: float,
                      ifindex: int, established_fraction: float = 0.0,
-                     file_packets: int = 4096):
+                     file_packets: int = 4096, attack=None):
     """Synthetic columns -> PacketBatch (the --ring producer: packed
     wire records, no frames round-trip).  pkt_len is synthesized
-    deterministically; every synthetic proto is l4-parseable."""
+    deterministically; every synthetic proto is l4-parseable.  With
+    ``attack``, the batch carries the injected TCP flags column (the
+    ring record format ships it, so pure-SYN floods reach the daemon's
+    flow/telemetry tiers intact)."""
     from infw.packets import PacketBatch
 
     c, n_flows = synth_columns(rng, n, v6_fraction,
                                established_fraction, file_packets)
-    return PacketBatch(
+    meta = {}
+    flags = None
+    if attack is not None:
+        flags, meta = inject_attack(
+            rng, c, n, attack["mode"], attack["fraction"],
+            attack["start"], attack["attackers"], file_packets,
+        )
+    batch = PacketBatch(
         kind=c["kind"],
         l4_ok=np.ones(n, np.int32),
         ifindex=np.full(n, int(ifindex), np.int32),
@@ -117,7 +184,10 @@ def synth_wire_batch(rng: np.random.Generator, n: int, v6_fraction: float,
         icmp_type=c["icmp_type"],
         icmp_code=c["icmp_code"],
         pkt_len=rng.integers(60, 1500, n).astype(np.int32),
-    ), n_flows
+    )
+    if flags is not None:
+        batch.tcp_flags = flags
+    return batch, n_flows, meta
 
 
 def _ring_main(args, rng, offs) -> int:
@@ -128,10 +198,10 @@ def _ring_main(args, rng, offs) -> int:
     into a stretched offered load)."""
     from infw.ring import IngestRing
 
-    batch, n_flows = synth_wire_batch(
+    batch, n_flows, attack_meta = synth_wire_batch(
         rng, args.n, args.v6_fraction, args.ifindex,
         established_fraction=args.established_fraction,
-        file_packets=args.file_packets,
+        file_packets=args.file_packets, attack=_attack_dict(args),
     )
     fp = int(args.file_packets)
     n_rec = -(-args.n // fp)
@@ -142,7 +212,7 @@ def _ring_main(args, rng, offs) -> int:
         "mode": "ring", "records": int(n_rec), "file_packets": fp,
         "duration_s": float(offs[-1]), "seed": int(args.seed),
         "established_fraction": float(args.established_fraction),
-        "n_flows": int(n_flows),
+        "n_flows": int(n_flows), **attack_meta,
     }
     print(json.dumps(summary), flush=True)
     if args.dry_run:
@@ -164,9 +234,14 @@ def _ring_main(args, rng, offs) -> int:
         wire, v4_only = batch.pack_wire_subset(
             np.arange(lo, hi, dtype=np.int64)
         )
-        wv, _fl, token = ring.reserve(wire.shape[0], wire.shape[1],
-                                      timeout=30.0)
+        flags = getattr(batch, "tcp_flags", None)
+        wv, fl, token = ring.reserve(
+            wire.shape[0], wire.shape[1],
+            with_flags=flags is not None, timeout=30.0,
+        )
         np.copyto(wv, wire)
+        if fl is not None and flags is not None:
+            np.copyto(fl, flags[lo:hi])
         ring.commit(token, v4_only=v4_only)
     done = time.monotonic() - t0
     print(json.dumps({
@@ -182,6 +257,13 @@ def _ring_main(args, rng, offs) -> int:
               "producer) — offered load was lower than requested",
               file=sys.stderr)
     return 0
+
+
+def _attack_dict(args):
+    if args.attack is None:
+        return None
+    return {"mode": args.attack, "fraction": args.attack_fraction,
+            "start": args.attack_start, "attackers": args.attackers}
 
 
 def main(argv=None) -> int:
@@ -223,10 +305,40 @@ def main(argv=None) -> int:
                         "blocks (backpressure) and counts as schedule "
                         "lag.  Record format: see README 'Resident "
                         "serving'")
+    p.add_argument("--attack", choices=("synflood", "portscan", "denystorm"),
+                   default=None,
+                   help="inject a seeded adversarial traffic mix (the "
+                        "telemetry tier's workload, "
+                        "infw.testing.attack_trace_batch modes): a "
+                        "deterministic subset of lanes after "
+                        "--attack-start becomes the attack.  synflood = "
+                        "pure-SYN TCP from --attackers sources (SYN "
+                        "flags ship in --ring mode; frames files carry "
+                        "no flag bytes); portscan = one source sweeping "
+                        "dst ports; denystorm = one (src, port 80) pair "
+                        "per attacker, sized for a single deny rule on "
+                        "the daemon side.  Manifest records mode, start "
+                        "and attacker addresses")
+    p.add_argument("--attack-fraction", type=float, default=0.4,
+                   help="fraction of post-start lanes the attack claims "
+                        "(default 0.4)")
+    p.add_argument("--attack-start", type=float, default=0.25,
+                   help="where the attack begins, as a fraction of the "
+                        "stream, rounded down to a file/record boundary "
+                        "(default 0.25)")
+    p.add_argument("--attackers", type=int, default=2,
+                   help="distinct attack sources (portscan always uses "
+                        "1; default 2)")
     p.add_argument("--dry-run", action="store_true",
                    help="print the schedule summary without writing or "
                         "sleeping")
     args = p.parse_args(argv)
+    if not 0.0 <= args.attack_fraction <= 1.0:
+        p.error("--attack-fraction must be in [0, 1]")
+    if not 0.0 <= args.attack_start < 1.0:
+        p.error("--attack-start must be in [0, 1)")
+    if args.attackers < 1:
+        p.error("--attackers must be >= 1")
     if args.rate <= 0 or args.n <= 0 or args.file_packets <= 0:
         p.error("--rate, --n and --file-packets must be positive")
     if not 0.0 <= args.established_fraction < 1.0:
@@ -263,9 +375,11 @@ def main(argv=None) -> int:
         offs = testing.poisson_arrivals(rng, args.rate, args.n)
     if args.ring:
         return _ring_main(args, rng, offs)
-    fb, n_flows = synth_batch(rng, args.n, args.v6_fraction, args.ifindex,
-                              established_fraction=args.established_fraction,
-                              file_packets=args.file_packets)
+    fb, n_flows, attack_meta = synth_batch(
+        rng, args.n, args.v6_fraction, args.ifindex,
+        established_fraction=args.established_fraction,
+        file_packets=args.file_packets, attack=_attack_dict(args),
+    )
 
     fp = int(args.file_packets)
     n_files = -(-args.n // fp)
@@ -279,7 +393,7 @@ def main(argv=None) -> int:
         "files": int(n_files), "file_packets": fp,
         "duration_s": float(offs[-1]), "seed": int(args.seed),
         "established_fraction": float(args.established_fraction),
-        "n_flows": int(n_flows),
+        "n_flows": int(n_flows), **attack_meta,
     }
     print(json.dumps(summary), flush=True)
     if args.dry_run:
