@@ -5,9 +5,9 @@
 PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
-	churn-bench flow-bench resident-bench telemetry-bench native \
-	entry-check dryrun-multichip mesh-check spill-read wire-check lint \
-	static-check state-check clean
+	churn-bench flow-bench resident-bench telemetry-bench mlscore-bench \
+	native entry-check dryrun-multichip mesh-check spill-read wire-check \
+	lint static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -81,6 +81,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect sketchsat
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect mlquant
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-donation-defect --entries defect/undonated-buffer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -209,10 +210,26 @@ resident-bench:
 telemetry-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --telemetry-bench
 
+# The MXU anomaly-scoring tier (bench.bench_mlscore) standalone at
+# smoke scale off-TPU: shadow-mode device scores bit-identical to the
+# HostScoreModel oracle AND verdicts bit-identical to the scoring-off
+# path + the CPU oracle (gated before any timing line), detection
+# precision >= INFW_MLSCORE_PRECISION_MIN (default 0.95) and recall >=
+# INFW_MLSCORE_RECALL_MIN (default 0.9) on the seeded synflood +
+# portscan traces with detection latency reported beside them, served
+# classify-throughput retention with scoring on at a FIXED OFFERED
+# LOAD (70% of the scoring-off capacity, gated at
+# INFW_MLSCORE_RETENTION_MIN, default 0.95), a warmed zero-recompile /
+# zero-alloc steady state with scoring on, and an enforce-mode leg
+# (attacker flows denied, failsafe cells never rewritten).  The
+# statecheck mlscore config runs FIRST and gates record publication.
+mlscore-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --mlscore-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench mlscore-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

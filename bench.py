@@ -3195,6 +3195,426 @@ def telemetry_bench_main() -> int:
     return 0
 
 
+def bench_mlscore(rng, on_tpu):
+    """ISSUE-14 anomaly-scoring tier (``make mlscore-bench``, folded
+    into bench-checked): the MXU inference plane measured four ways on
+    seeded labeled attack traces (testing.attack_trace_batch):
+
+    - ORACLE GATE before any timing line: shadow-mode verdicts with
+      scoring on bit-identical to the off path AND the CPU oracle, and
+      the device score tensors + per-lane scores bit-identical to the
+      HostScoreModel across the dense, ctrie and resident serving
+      paths;
+    - DETECTION QUALITY: per-lane precision/recall of the device
+      anomaly decisions against the generator's ground-truth attack
+      mask (label discipline: features never read the labels —
+      benchruns/README.md), gated at INFW_MLSCORE_PRECISION_MIN /
+      INFW_MLSCORE_RECALL_MIN, plus detection latency (admissions from
+      onset until a drained anomaly-verdict record surfaces the planted
+      attacker);
+    - RETENTION (the telemetry-bench discipline): served classify
+      throughput at a FIXED OFFERED LOAD — 70%% of the scoring-off
+      capacity, calibrated in-record — scoring on vs off on the
+      resident serving loop, interleaved min-vs-min, gated at
+      INFW_MLSCORE_RETENTION_MIN;
+    - ZERO-COST STEADY STATE: a warmed run with scoring on must leave
+      the fused executables' caches and the resident pool's allocation
+      counter exactly where the prewarm left them;
+    - ENFORCE LEG: with enforcement on, post-onset attacker lanes are
+      denied (ruleId 0) while failsafe-port cells keep their rule
+      verdicts bit-exactly (the failsaferules precedence contract).
+
+    Returns the record dict for the mlscore-bench gate."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.kernels.mxu_score import (
+        DENY as _DENY,
+        HostScoreModel,
+        ScoreSpec,
+        default_model,
+        failsafe_lane_mask_np,
+        zero_tparams,
+    )
+    from infw.scheduler import prewarm_ladder
+
+    out = {}
+    n_entries = 100_000 if on_tpu else 20_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.4,
+        ifindexes=(2, 3),
+    )
+    spec = ScoreSpec.make()  # the production default geometry
+    model = default_model(spec)
+    bs = 256
+    trace, meta = testing.attack_trace_batch(
+        np.random.default_rng(1400), tables, bs * 60, mode="synflood",
+        chunk_packets=bs,
+    )
+    tflags = np.asarray(trace.tcp_flags, np.int32)
+
+    def chunked(tr, fl):
+        cs = []
+        for lo in range(0, len(tr), bs):
+            sub = np.arange(lo, lo + bs, dtype=np.int64)
+            w, v4 = tr.pack_wire_subset(sub)
+            cs.append((w, v4, np.ascontiguousarray(fl[sub])))
+        return cs
+
+    chunks = chunked(trace, tflags)
+    fcfg = FlowConfig.make(entries=1 << 14)
+    clf_on = TpuClassifier(force_path="trie", flow_table=fcfg,
+                           resident=True, mlscore=spec,
+                           mlscore_model=model)
+    clf_off = TpuClassifier(force_path="trie",
+                            flow_table=FlowConfig.make(entries=1 << 14),
+                            resident=True)
+    for c in (clf_on, clf_off):
+        c.load_tables(tables)
+        prewarm_ladder(c, (bs,))
+
+    # -- oracle + model bit-identity gate BEFORE any timing line ------------
+    # the three-path sweep: dense (tiny table), ctrie, trie-resident —
+    # shadow scores and state must match the HostScoreModel bit for bit
+    # on every path, and verdicts must match the scoring-off path + the
+    # CPU oracle
+    small = testing.random_tables(np.random.default_rng(7), n_entries=48,
+                                  width=8)
+    strace, _smeta = testing.attack_trace_batch(
+        np.random.default_rng(1401), small, bs * 6, mode="synflood",
+        chunk_packets=bs,
+    )
+    sflags = np.asarray(strace.tcp_flags, np.int32)
+    schunks = chunked(strace, sflags)
+    sref = oracle.classify(small, strace)
+    for label, kw in (
+        ("dense", dict(force_path="dense")),
+        ("ctrie", dict(force_path="ctrie")),
+        ("resident", dict(force_path="trie",
+                          flow_table=FlowConfig.make(entries=1 << 12),
+                          resident=True)),
+    ):
+        chk = TpuClassifier(mlscore=spec, mlscore_model=model,
+                            mlscore_track_model=True, **kw)
+        chk.load_tables(small)
+        chk.mlscore.set_keep_masks(len(schunks))
+        twin = HostScoreModel(spec, model, zero_tparams(spec))
+        n_div = 0
+        off = 0
+        twin_scores = []
+        for w, v4, tf in schunks:
+            o = chk.classify_prepared(
+                chk.prepare_packed(w, v4, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            ts, _ta, _tr = twin.update(w, o.results, None, tf)
+            twin_scores.append(ts)
+            n_div += int(
+                (o.results != sref.results[off : off + len(w)]).sum()
+            )
+            off += len(w)
+        if n_div:
+            raise RuntimeError(
+                f"mlscore-bench verdict mismatch on the {label} path: "
+                f"{n_div} divergences vs the CPU oracle (shadow mode "
+                "must never touch verdicts)"
+            )
+        cols = chk.mlscore.columns()
+        mcols = chk.mlscore.model.columns()
+        for name in cols:
+            if not np.array_equal(cols[name], mcols[name]):
+                raise RuntimeError(
+                    f"mlscore-bench score oracle mismatch ({label}): "
+                    f"tensor {name!r} diverged from the host model"
+                )
+        got = [s for _e, _a, s in chk.mlscore.recent_masks()]
+        want = [np.clip(s, -32768, 32767) for s in twin_scores]
+        if not all(np.array_equal(g, w) for g, w in zip(got, want)):
+            raise RuntimeError(
+                f"mlscore-bench per-lane scores diverged from the "
+                f"host model on the {label} path"
+            )
+        chk.close()
+    log("mlscore: oracle gate clean (dense/ctrie/resident score + "
+        "state + verdict bit-identity)")
+
+    # -- detection quality on the labeled traces ----------------------------
+    for mode in ("synflood", "portscan"):
+        dtrace, dmeta = testing.attack_trace_batch(
+            np.random.default_rng(1400), tables, bs * 60, mode=mode,
+            chunk_packets=bs,
+        )
+        dflags = np.asarray(dtrace.tcp_flags, np.int32)
+        truth = np.asarray(dmeta["attack_mask"], bool)
+        det = TpuClassifier(force_path="trie",
+                            flow_table=FlowConfig.make(entries=1 << 14),
+                            resident=True, mlscore=spec,
+                            mlscore_model=model)
+        det.load_tables(tables)
+        tier = det.mlscore
+        tier.set_keep_masks(len(dtrace) // bs)
+        srcs = {
+            ".".join(str(b) for b in int(s[0]).to_bytes(4, "big"))
+            if k == 1 else "v6"
+            for s, k in dmeta["attackers"]
+        }
+        start_chunk = dmeta["start"] // bs
+        detected_at = None
+        pred = np.zeros(len(dtrace), bool)
+        for ci, (w, v4, tf) in enumerate(chunked(dtrace, dflags)):
+            det.classify_prepared(
+                det.prepare_packed(w, v4, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            _e, anom, _s = tier.recent_masks()[-1]
+            pred[ci * bs : ci * bs + len(w)] = anom
+            if ci < start_chunk or detected_at is not None:
+                continue
+            rec = tier.drain(force=True)[0]
+            if any(h["src"] in srcs for h in rec.top):
+                detected_at = ci - start_chunk + 1
+        tp = int((pred & truth).sum())
+        fp = int((pred & ~truth).sum())
+        fn = int((~pred & truth).sum())
+        precision = tp / max(tp + fp, 1)
+        recall = tp / max(tp + fn, 1)
+        if detected_at is None:
+            raise RuntimeError(
+                f"mlscore-bench: {mode} attacker never surfaced in the "
+                "drained anomaly-verdict records"
+            )
+        log(f"mlscore: {mode} precision {precision:.4f} recall "
+            f"{recall:.4f} (TP={tp} FP={fp} FN={fn}); detected after "
+            f"{detected_at} post-onset admission(s)")
+        emit(f"anomaly detection precision ({mode}, device decisions "
+             "vs labeled trace)", precision, "ratio", vs_baseline=0.0)
+        emit(f"anomaly detection recall ({mode})", recall, "ratio",
+             vs_baseline=0.0)
+        emit(f"anomaly detection latency ({mode}, drain-per-admission)",
+             float(detected_at), "admissions", vs_baseline=0.0)
+        out[f"precision_{mode}"] = float(precision)
+        out[f"recall_{mode}"] = float(recall)
+        out[f"detect_{mode}_admissions"] = float(detected_at)
+        det.close()
+
+    # -- retention at a fixed offered load (interleaved min-vs-min) ---------
+    def run_pass(clf):
+        clf.flow.reset()
+        if clf.mlscore is not None:
+            clf.mlscore.reset_state()  # per-pass reset (benchruns rules)
+        t0 = time.perf_counter()
+        for w, v4, tf in chunks:
+            clf.classify_prepared(
+                clf.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+        return time.perf_counter() - t0
+
+    clf_on.mark_resident_warm()
+    clf_off.mark_resident_warm()
+    reps = 5 if on_tpu else 3
+    best = {"on": 1e9, "off": 1e9}
+    for _ in range(reps):
+        best["off"] = min(best["off"], run_pass(clf_off))
+        best["on"] = min(best["on"], run_pass(clf_on))
+    raw_ab = best["off"] / max(best["on"], 1e-12)
+    log(f"mlscore: RAW full-speed A/B — scoring-on {best['on']*1e3:.1f} "
+        f"ms vs off {best['off']*1e3:.1f} ms over {len(trace)} pkts "
+        f"({raw_ab:.3f}, ungated reference)")
+    emit("raw full-speed dispatch A/B with anomaly scoring on "
+         "(resident fused serving loop, ungated reference)",
+         raw_ab, "ratio", vs_baseline=0.0)
+    out["raw_ab"] = float(raw_ab)
+
+    cap_off = len(trace) / best["off"]
+    offered = 0.7 * cap_off
+    sched = np.arange(len(chunks)) * (bs / offered)
+    sched_end = len(trace) / offered
+
+    def run_offered(clf):
+        clf.flow.reset()
+        if clf.mlscore is not None:
+            clf.mlscore.reset_state()
+        t0 = time.perf_counter()
+        for (w, v4, tf), s in zip(chunks, sched):
+            now = time.perf_counter() - t0
+            if now < s:
+                time.sleep(s - now)
+            clf.classify_prepared(
+                clf.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+            ).result()
+        return max(time.perf_counter() - t0, sched_end)
+
+    best_o = {"on": 1e9, "off": 1e9}
+    for _ in range(reps):
+        best_o["off"] = min(best_o["off"], run_offered(clf_off))
+        best_o["on"] = min(best_o["on"], run_offered(clf_on))
+    ach_on = len(trace) / best_o["on"]
+    ach_off = len(trace) / best_o["off"]
+    retention = ach_on / max(ach_off, 1e-12)
+    log(f"mlscore: served throughput at {offered/1e3:.1f} K pkt/s "
+        f"offered (70% of scoring-off capacity {cap_off/1e3:.1f} K): "
+        f"on {ach_on/1e3:.1f} K vs off {ach_off/1e3:.1f} K -> "
+        f"retention {retention:.3f}")
+    emit("classify throughput retention with anomaly scoring on "
+         "(fixed offered load at 70% of scoring-off capacity, "
+         "resident serving loop, synflood trace)",
+         retention, "ratio", vs_baseline=0.0)
+    out["retention"] = float(retention)
+
+    # -- zero-recompile / zero-alloc steady state (scoring ON) --------------
+    clf_on.mark_resident_warm()
+    fn_t = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False,
+        score=spec,
+    )
+    fn_t4 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", True, None, 0, False,
+        score=spec,
+    )
+    from infw.kernels.mxu_score import jitted_score_update
+
+    fn_c = jitted_score_update(spec)
+    cache0 = fn_t._cache_size() + fn_t4._cache_size() + fn_c._cache_size()
+    n_disp = 0
+    while n_disp < 300:
+        for w, v4, tf in chunks:
+            clf_on.classify_prepared(
+                clf_on.prepare_packed(w, v4, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            n_disp += 1
+            if n_disp >= 300:
+                break
+    grew = (
+        fn_t._cache_size() + fn_t4._cache_size() + fn_c._cache_size()
+    ) - cache0
+    allocs = clf_on.resident.steady_allocs()
+    if grew or allocs:
+        raise RuntimeError(
+            f"mlscore steady state not zero-cost: {grew} recompile(s), "
+            f"{allocs} pool allocation(s) across {n_disp} warmed "
+            "dispatches with scoring on"
+        )
+    log(f"mlscore steady state: {n_disp} fused dispatches with scoring "
+        "on, 0 recompiles, 0 pool allocations")
+    emit("mlscore-on steady-state recompiles + pool allocations per "
+         "300 warmed dispatches", float(grew + allocs), "events",
+         vs_baseline=0.0)
+    out["steady"] = float(grew + allocs)
+
+    # -- enforce leg: mitigation sticks, failsafe precedence holds ----------
+    enf = TpuClassifier(force_path="trie",
+                        flow_table=FlowConfig.make(entries=1 << 14),
+                        resident=True, mlscore=spec, mlscore_model=model,
+                        mlscore_mode="enforce")
+    enf.load_tables(tables)
+    res_enf = []
+    for w, v4, tf in chunks:
+        o = enf.classify_prepared(
+            enf.prepare_packed(w, v4, tcp_flags=tf), apply_stats=False
+        ).result()
+        res_enf.append(o.results)
+    res_enf = np.concatenate(res_enf)
+    truth = np.asarray(meta["attack_mask"], bool)
+    post = np.zeros(len(trace), bool)
+    post[meta["start"] :] = True
+    atk = truth & post
+    denied = (res_enf & 0xFF) == _DENY
+    mitigated = float(denied[atk].mean()) if atk.any() else 0.0
+    enf.mlscore.drain(force=True)  # fold tstat into the counters
+    enforced_total = int(enf.mlscore.counter_values()
+                         ["mlscore_enforced_total"])
+    if enforced_total <= 0:
+        raise RuntimeError("mlscore-bench: enforce mode rewrote nothing "
+                           "on the synflood trace")
+    # failsafe precedence: with EVERYTHING anomalous, failsafe-port
+    # cells must keep their rule verdicts bit-exactly
+    enf.mlscore.set_threshold(-(10 ** 6))
+    fs_batch = testing.random_batch(np.random.default_rng(9), tables, bs)
+    fs_batch.proto[:] = 6
+    fs_ports = np.asarray([22, 6443, 2379, 2380, 10250, 10257, 10259],
+                          np.int32)
+    fs_batch.dst_port[:] = fs_ports[np.arange(bs) % len(fs_ports)]
+    fs_batch.tcp_flags = np.full(bs, jaxpath.TCP_ACK, np.int32)
+    w, v4 = fs_batch.pack_wire_subset(np.arange(bs, dtype=np.int64))
+    o_enf = enf.classify_prepared(
+        enf.prepare_packed(w, v4, tcp_flags=fs_batch.tcp_flags),
+        apply_stats=False,
+    ).result()
+    ref = oracle.classify(tables, fs_batch)
+    fs_mask = failsafe_lane_mask_np(fs_batch.proto, fs_batch.dst_port)
+    if not np.array_equal(o_enf.results[fs_mask], ref.results[fs_mask]):
+        raise RuntimeError(
+            "mlscore-bench: enforce mode rewrote a failsafe-port cell "
+            "(the failsaferules precedence contract)"
+        )
+    log(f"mlscore enforce: {mitigated:.3f} of post-onset attack lanes "
+        f"denied ({enforced_total} rewrites); failsafe cells "
+        "bit-identical to the rule verdicts")
+    emit("enforce-mode mitigation (fraction of post-onset attack lanes "
+         "denied, synflood trace)", mitigated, "ratio", vs_baseline=0.0)
+    out["enforce_mitigation"] = mitigated
+    enf.close()
+    for c in (clf_on, clf_off):
+        c.close()
+    return out
+
+
+def mlscore_bench_main() -> int:
+    """``make mlscore-bench``: the anomaly-scoring tier standalone (CPU
+    smoke off TPU) with the regression gates — detection precision >=
+    INFW_MLSCORE_PRECISION_MIN (default 0.95) and recall >=
+    INFW_MLSCORE_RECALL_MIN (default 0.9) on both labeled traces,
+    classify retention with scoring on >= INFW_MLSCORE_RETENTION_MIN
+    (default 0.95), and the statecheck mlscore config runs FIRST and
+    gates record publication (the telemetry-bench discipline)."""
+    precision_min = float(
+        os.environ.get("INFW_MLSCORE_PRECISION_MIN", "0.95")
+    )
+    recall_min = float(os.environ.get("INFW_MLSCORE_RECALL_MIN", "0.9"))
+    retention_min = float(
+        os.environ.get("INFW_MLSCORE_RETENTION_MIN", "0.95")
+    )
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("mlscore", seed=0, n_ops=8,
+                                shrink_on_failure=False)
+    if not rep["ok"]:
+        log(f"mlscore-bench FAIL: statecheck mlscore not green before "
+            f"record publication: {rep['failure']}")
+        return 1
+    log(f"mlscore-bench: statecheck mlscore green "
+        f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_mlscore(rng, on_tpu)
+    emit_compact_record()
+    problems = []
+    for mode in ("synflood", "portscan"):
+        if not rec.get(f"precision_{mode}", 0.0) >= precision_min:
+            problems.append(
+                f"precision_{mode} {rec.get(f'precision_{mode}', 0):.3f}"
+                f" < gate {precision_min}"
+            )
+        if not rec.get(f"recall_{mode}", 0.0) >= recall_min:
+            problems.append(
+                f"recall_{mode} {rec.get(f'recall_{mode}', 0):.3f} < "
+                f"gate {recall_min}"
+            )
+    if not rec.get("retention", 0.0) >= retention_min:
+        problems.append(
+            f"retention {rec.get('retention', 0):.3f} < gate "
+            f"{retention_min}"
+        )
+    if problems:
+        for p in problems:
+            log(f"mlscore-bench FAIL: {p}")
+        return 1
+    log("mlscore-bench OK: " + ", ".join(
+        f"{k}={v:.3f}" for k, v in sorted(rec.items())
+    ))
+    return 0
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -3538,4 +3958,6 @@ if __name__ == "__main__":
         sys.exit(resident_bench_main())
     if "--telemetry-bench" in sys.argv:
         sys.exit(telemetry_bench_main())
+    if "--mlscore-bench" in sys.argv:
+        sys.exit(mlscore_bench_main())
     sys.exit(main())

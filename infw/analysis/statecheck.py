@@ -128,6 +128,19 @@ FLOW_KINDS = ("flow_traffic", "flow_age")
 #: (flow_seed/count fields), so shrunk repros print unchanged.
 TELEMETRY_KINDS = ("sketch_traffic", "sketch_drain")
 
+#: anomaly-scoring ops (mlscore configs only, ISSUE-14):
+#: ``score_traffic`` drives one seeded packet batch through the
+#: production classify dispatch with the scoring tier engaged — every
+#: count-min / source-table / tstat scatter AND the quantized forest +
+#: MLP arithmetic the device performs is mirrored bit-exactly by the
+#: HostScoreModel, and the settled check compares every tensor
+#: (including the clamp-stressed MLP head — the surface the mlquant
+#: injected-defect acceptance shrinks on); ``score_drain`` runs the
+#: decimated window reset, whose seq stamps must stay gap-free.
+#: Batches reuse the flow_traffic substrate (flow_seed/count fields),
+#: so shrunk repros print unchanged.
+SCORE_KINDS = ("score_traffic", "score_drain")
+
 #: explicit transaction-boundary record (txn-mode configs only): the
 #: driver buffers single-key ops and applies them as ONE folded
 #: transaction (infw.txn.fold_ops) at each boundary — checks run only
@@ -165,9 +178,10 @@ class EditOp:
 
     def describe(self) -> str:
         tag = f"@t{self.tenant}" if self.tenant else ""
-        if self.kind in ("flow_traffic", "sketch_traffic"):
+        if self.kind in ("flow_traffic", "sketch_traffic",
+                         "score_traffic"):
             return f"{self.kind}(seed={self.flow_seed}, n={self.count})"
-        if self.kind in ("flow_age", "sketch_drain"):
+        if self.kind in ("flow_age", "sketch_drain", "score_drain"):
             return self.kind
         if self.kind in ("full_replace", TXN_FLUSH):
             return self.kind + tag
@@ -284,6 +298,15 @@ class StateConfig:
     #: sketchsat injected defect (device clamp dropped) diverges
     #: immediately
     telemetry_sat: int = 9
+    #: > 0 = anomaly-scoring tier enabled with this count-min width
+    #: (ISSUE-14): the op alphabet extends with SCORE_KINDS, the
+    #: classifier runs with a (deliberately tiny) ScoreSpec + the
+    #: clamp-stress model + the shadow HostScoreModel, and every
+    #: settled check adds the device-vs-model score-tensor bit-identity
+    #: pass.  Shadow mode only: enforce rewrites verdicts, which the
+    #: plain-oracle classify equivalence would (rightly) flag — enforce
+    #: correctness is covered by tests/test_mlscore.py + bench_mlscore.
+    mlscore: int = 0
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -377,6 +400,22 @@ CONFIGS: Dict[str, StateConfig] = {
         # step (donated sketch operand chained through the one-program
         # dispatch) — a fused-path telemetry drift diverges here
         StateConfig("telemetry-resident", telemetry=64, flow=4096,
+                    resident=True, witness_b=160),
+        # MXU anomaly scoring (ISSUE-14): the SCORE_KINDS alphabet over
+        # the edit state machine — every feature-table / count-min /
+        # tstat scatter and every quantized forest + MLP inference the
+        # production dispatch performs (scoring rides classify,
+        # including the settled checks' own witness batches) must leave
+        # the device tensors bit-identical to the HostScoreModel.  The
+        # driver runs the clamp-stress model, so the mlquant injected-
+        # defect acceptance (infw_lint state --inject-defect mlquant)
+        # diverges at the first scored admission.
+        StateConfig("mlscore", mlscore=64, steered=True, witness_b=160),
+        # the same alphabet with the tier riding the resident fused
+        # step (donated score operand + persistent model operands
+        # chained through the one-program dispatch) — a fused-path
+        # scoring drift diverges here
+        StateConfig("mlscore-resident", mlscore=64, flow=4096,
                     resident=True, witness_b=160),
     )
 }
@@ -518,6 +557,22 @@ def generate_ops(
                 continue
             if r < 0.45:
                 ops.append(EditOp(kind="sketch_drain"))
+                continue
+        if config.mlscore:
+            r = rng.random()
+            if r < 0.35:
+                # repeated seeds accumulate per-source counters across
+                # replays (rates, fraction features, LRU churn in the
+                # tiny table) — the surfaces the scoring checks and
+                # the mlquant acceptance shrink on
+                ops.append(EditOp(
+                    kind="score_traffic",
+                    flow_seed=int(rng.integers(1, 4)),
+                    count=64,
+                ))
+                continue
+            if r < 0.45:
+                ops.append(EditOp(kind="score_drain"))
                 continue
         kind = str(rng.choice(kinds, p=probs))
         if kind in ("rules_edit", "order_change", "key_delete") and not keys:
@@ -1107,6 +1162,27 @@ class _Driver:
                 sat=config.telemetry_sat, max_tenants=1,
             )
             flow_kw["telemetry_track_model"] = True
+        if config.mlscore:
+            from ..kernels.mxu_score import ScoreSpec, clamp_stress_model
+
+            if backend == "mesh":
+                raise ValueError(
+                    "mlscore configs are single-chip (the scoring "
+                    "tensors are not mesh-placed yet)"
+                )
+            # deliberately TINY geometry (LRU churn within the op
+            # horizon) + the clamp-stress model: the MLP requant clamp
+            # engages on the first scored admission, so the mlquant
+            # injected defect diverges immediately; shadow mode only
+            # (see the StateConfig.mlscore note)
+            spec = ScoreSpec.make(
+                trees=4, depth=3, slots=32, ways=2, cms_depth=2,
+                cms_width=config.mlscore, sat=511, hidden=4,
+                max_tenants=1,
+            )
+            flow_kw["mlscore"] = spec
+            flow_kw["mlscore_model"] = clamp_stress_model(spec)
+            flow_kw["mlscore_track_model"] = True
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 
@@ -1132,7 +1208,8 @@ class _Driver:
         self._flow_base = (
             compile_tables_from_content(
                 dict(base_content), rule_width=config.width
-            ) if (config.flow or config.telemetry) else None
+            ) if (config.flow or config.telemetry or config.mlscore)
+            else None
         )
         self._flow_failure: Optional[Failure] = None
         self.snapshot: Optional[CompiledTables] = None
@@ -1212,6 +1289,9 @@ class _Driver:
         if op.kind in TELEMETRY_KINDS:
             self._apply_telemetry(op)
             return True
+        if op.kind in SCORE_KINDS:
+            self._apply_mlscore(op)
+            return True
         if self.config.txn:
             if op.kind == TXN_FLUSH:
                 self.flush_pending()
@@ -1233,6 +1313,7 @@ class _Driver:
             op.kind in (TXN_FLUSH, "full_replace")
             or op.kind in FLOW_KINDS
             or op.kind in TELEMETRY_KINDS
+            or op.kind in SCORE_KINDS
         ):
             return
         if op.kind == "overlay_spill":
@@ -1425,6 +1506,56 @@ class _Driver:
             return
         batch = self._flow_batch(op)
         self._classify(batch)
+
+    def _apply_mlscore(self, op: EditOp) -> None:
+        """Drive the production scoring plane: score_traffic classifies
+        its seeded batch through the production dispatch (the score
+        update rides the same admission — fused in-program on the
+        resident config, one follow-on launch otherwise); score_drain
+        runs the decimated window reset, checking that the seq stamp
+        advanced exactly once."""
+        tier = getattr(self.clf, "mlscore", None)
+        if tier is None:
+            return
+        if op.kind == "score_drain":
+            seq0 = tier.drain_seq
+            recs = tier.drain(force=True)
+            if len(recs) != 1 or tier.drain_seq != seq0 + 1:
+                self._flow_failure = Failure(
+                    -1, "mlscore-drain",
+                    f"drain emitted {len(recs)} record(s), seq "
+                    f"{seq0} -> {tier.drain_seq} (want exactly one)",
+                )
+            return
+        batch = self._flow_batch(op)
+        self._classify(batch)
+
+    def _check_mlscore(self, step: int) -> Optional[Failure]:
+        """Device scoring tensors vs the shadow HostScoreModel, bit for
+        bit — every feature-table / count-min / tstat scatter and every
+        quantized inference the production dispatch performed was
+        mirrored, so any divergence is a kernel/model semantics drift
+        (the mlquant acceptance's catch surface)."""
+        tier = getattr(self.clf, "mlscore", None)
+        if tier is None or tier.model is None:
+            return None
+        cols = tier.columns()
+        mcols = tier.model.columns()
+        for name, dev_arr in cols.items():
+            want = mcols[name]
+            if not np.array_equal(dev_arr, want):
+                flat_d = np.asarray(dev_arr).reshape(-1)
+                flat_w = np.asarray(want).reshape(-1)
+                bad = np.nonzero(flat_d != flat_w)[0]
+                i = int(bad[0])
+                return Failure(
+                    step, "mlscore-model",
+                    f"device score tensor {name!r} diverged from the "
+                    f"host model ({len(bad)} cell(s))",
+                    f"first at flat index {i}: device "
+                    f"{int(flat_d[i])}, model {int(flat_w[i])}",
+                )
+        return None
 
     def _check_telemetry(self, step: int) -> Optional[Failure]:
         """Device sketch tensors vs the shadow HostSketchModel, bit for
@@ -1643,7 +1774,10 @@ class _Driver:
         f = self._check_flow(step)
         if f is not None:
             return f
-        return self._check_telemetry(step)
+        f = self._check_telemetry(step)
+        if f is not None:
+            return f
+        return self._check_mlscore(step)
 
 
 def run_ops(

@@ -66,6 +66,10 @@ class TpuClassifier:
         resident: Optional[bool] = None,
         telemetry=None,
         telemetry_track_model: bool = False,
+        mlscore=None,
+        mlscore_model=None,
+        mlscore_mode: Optional[str] = None,
+        mlscore_track_model: bool = False,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -203,6 +207,38 @@ class TpuClassifier:
                 telemetry, device=self._device,
                 track_model=telemetry_track_model,
             )
+        # MXU anomaly-scoring tier (ISSUE-14, --mlscore / INFW_MLSCORE):
+        # quantized per-flow inference fused into the resident step or
+        # launched once per admission on the multi-dispatch path; the
+        # AnomalyTier applies per-tenant shadow/enforce policy, and a
+        # model hot swap bumps the flow generation like a rule patch.
+        # Precedence mirrors the other knobs: constructor arg
+        # (ScoreSpec or truthy) > INFW_MLSCORE env > off; the mode knob
+        # reads INFW_MLSCORE_MODE when unset (default shadow).
+        if mlscore is None:
+            env = os.environ.get("INFW_MLSCORE", "")
+            if env and env not in ("0", "false", "no"):
+                mlscore = True
+        if mlscore_mode is None:
+            mlscore_mode = os.environ.get("INFW_MLSCORE_MODE") or "shadow"
+        self._mlscore = None
+        if mlscore is not None and mlscore is not False:
+            from ..kernels.mxu_score import ScoreSpec
+            from ..mlscore import AnomalyTier
+
+            if not isinstance(mlscore, ScoreSpec):
+                mlscore = (
+                    ScoreSpec.make() if mlscore is True
+                    else ScoreSpec.make(slots=int(mlscore))
+                )
+            self._mlscore = AnomalyTier(
+                mlscore, model=mlscore_model, device=self._device,
+                mode=mlscore_mode, track_model=mlscore_track_model,
+            )
+            # a model swap behaves like a rule patch: resident flow
+            # entries caching pre-swap (possibly enforced) verdicts go
+            # stale through the same generation stamps
+            self._mlscore.on_swap = self._on_score_model_swap
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
         # bench reads this to put bytes/packet in the replay record
@@ -852,32 +888,82 @@ class TpuClassifier:
             # double-counts
             plan["telem_wire"] = wire_np
             plan["telem_flags"] = tcp_flags
+        if self._mlscore is not None:
+            # multi-dispatch anomaly scoring (ISSUE-14): one in-stream
+            # follow-on launch per admission over (wire, merged RULE
+            # verdicts) — on flow plans it runs INSIDE _launch_flow,
+            # between the verdict merge and the miss insert, so the
+            # flow table caches the ENFORCED verdicts exactly like the
+            # fused path; the miss sub-dispatch never double-scores
+            plan["ml_wire"] = wire_np
+            plan["ml_flags"] = tcp_flags
         return plan
 
     def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
         """Second half: launch the classify on a prepare_packed plan."""
         if plan.get("resident"):
-            # telemetry (when on) already rode the fused program
+            # telemetry/scoring (when on) already rode the fused program
             return self._launch_resident(plan, apply_stats)
         if plan.get("flow"):
+            # flow plans run the scoring launch INSIDE _launch_flow
+            # (between merge and insert — the enforced verdicts must be
+            # what the flow table caches)
             pending = self._launch_flow(plan, apply_stats)
+            ml_done = True
         else:
             pending = self._launch_wire(plan, apply_stats)
+            ml_done = False
+        ml = self._mlscore
         tel = self._telemetry
-        if tel is None or "telem_wire" not in plan:
+        run_ml = ml is not None and not ml_done and "ml_wire" in plan
+        run_tel = tel is not None and "telem_wire" in plan
+        if not run_ml and not run_tel:
             return pending
-        telem_wire = plan["telem_wire"]
-        telem_flags = plan["telem_flags"]
 
         def materialize() -> ClassifyOutput:
             out = pending.result()
-            # one follow-on device program per admission: wire +
-            # verdicts in, nothing back (the decimated drain is the
-            # only telemetry readback)
-            tel.update(telem_wire, out.results, tflags_np=telem_flags)
+            if run_ml:
+                # one follow-on scoring launch per admission over the
+                # merged rule verdicts; in enforce mode the rewritten
+                # res16 replaces the output (verdicts, XDP and stats
+                # re-derive host-side — the wire8 contract)
+                out = self._apply_mlscore_wire(
+                    out, plan["ml_wire"], plan["ml_flags"], apply_stats,
+                )
+            if run_tel:
+                # one follow-on telemetry program per admission: wire +
+                # SERVED verdicts in, nothing back (the decimated drain
+                # is the only telemetry readback)
+                tel.update(plan["telem_wire"], out.results,
+                           tflags_np=plan["telem_flags"])
             return out
 
         return PendingClassify(materialize)
+
+    def _apply_mlscore_wire(self, out: ClassifyOutput, wire_np, tcp_flags,
+                            apply_stats: bool) -> ClassifyOutput:
+        """Score one flow-less wire admission (the follow-on launch) and
+        apply the policy rewrite host-side when it changed anything."""
+        from ..daemon import stats_from_results  # lazy: no import cycle
+        from ..flow import host_unpack_wire
+
+        res16, _anom, _scores = self._mlscore.update(
+            wire_np, out.results, tflags_np=tcp_flags,
+        )
+        if np.array_equal(res16, (out.results & 0xFFFF).astype(np.uint16)):
+            return out
+        f = host_unpack_wire(wire_np)
+        results, xdp = jaxpath.host_finalize_wire(res16, f["kind"])
+        stats_delta = stats_from_results(
+            results, f["pkt_len"].astype(np.int64)
+        )
+        if apply_stats:
+            # the device-side stats already applied inside the launch:
+            # swap them for the post-policy derivation
+            self._stats.add(stats_delta - out.stats_delta)
+        return ClassifyOutput(
+            results=results, xdp=xdp, stats_delta=stats_delta
+        )
 
     # -- resident serving loop (ISSUE-12) ------------------------------------
 
@@ -911,11 +997,13 @@ class TpuClassifier:
         n = wire_np.shape[0]
         kind = (wire_np[:, 0] & 3).astype(np.int32)
         tel = self._telemetry
+        ml = self._mlscore
         fn = jaxpath.jitted_resident_step(
             tier.config.entries, tier.config.ways, ctx.path,
             bool(v4_only) and ctx.path == "trie", d, ctx.d_max,
             ctx.ov_dev is not None,
             sketch=tel.spec if tel is not None else None,
+            score=ml.spec if ml is not None else None,
         )
         tables_args = (
             (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
@@ -925,7 +1013,7 @@ class TpuClassifier:
         fused, epoch = tier.resident_dispatch(
             fn, tables_args, wire_dev, n, wire_np=wire_np,
             tflags_np=tcp_flags, gens_snap=gens_snap,
-            alloc_note=pool.note_alloc, telemetry=tel,
+            alloc_note=pool.note_alloc, telemetry=tel, mlscore=ml,
         )
         pool.note("dispatches")
         try:
@@ -934,7 +1022,7 @@ class TpuClassifier:
             pass
         self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
         return {"resident": True, "fused": fused, "n": n, "kind": kind,
-                "epoch": epoch,
+                "epoch": epoch, "mlscore": ml is not None,
                 "pkt_len": self._wire4_pkt_len(wire_np)}
 
     def _launch_resident(self, plan, apply_stats: bool) -> PendingClassify:
@@ -952,9 +1040,22 @@ class TpuClassifier:
         def materialize() -> ClassifyOutput:
             from ..daemon import stats_from_results  # lazy: no import cycle
 
-            res16, _hit, hits, stale, counts = (
-                jaxpath.split_resident_outputs(np.asarray(plan["fused"]), n)
-            )
+            anom = scores = None
+            if plan.get("mlscore"):
+                # scoring extension of the fused readback: res16 is
+                # the POLICY verdict vector (rewritten in enforce
+                # mode) — stats and XDP derive from what was served
+                res16, _hit, hits, stale, counts, anom, scores = (
+                    jaxpath.split_resident_score_outputs(
+                        np.asarray(plan["fused"]), n
+                    )
+                )
+            else:
+                res16, _hit, hits, stale, counts = (
+                    jaxpath.split_resident_outputs(
+                        np.asarray(plan["fused"]), n
+                    )
+                )
             inserts, evictions, promotes = counts
             tier.stats.add(
                 hits=hits, misses=n - hits, stale_rejects=stale,
@@ -963,6 +1064,10 @@ class TpuClassifier:
             tier.resident_note_materialized(epoch)
             if self._telemetry is not None:
                 self._telemetry.resident_note_materialized(epoch)
+            if anom is not None and self._mlscore is not None:
+                self._mlscore.resident_note_materialized(
+                    epoch, anom_np=anom, score_np=scores,
+                )
             if evictions and tier.on_evict is not None:
                 try:
                     tier.on_evict(evictions, inserts, epoch)
@@ -1008,6 +1113,41 @@ class TpuClassifier:
         if self._telemetry is None:
             return 0
         return self._telemetry.warm(ladder)
+
+    @property
+    def mlscore(self):
+        """The AnomalyTier when the scoring plane is enabled."""
+        return self._mlscore
+
+    def mlscore_counters(self):
+        """mlscore_* counters for /metrics (empty when off)."""
+        return {} if self._mlscore is None else (
+            self._mlscore.counter_values()
+        )
+
+    def warm_mlscore_ladder(self, ladder) -> int:
+        """Pre-compile the classic score-update executables across the
+        batch ladder (scheduler prewarm hook; the resident fused score
+        variants warm through the production dispatch)."""
+        if self._mlscore is None:
+            return 0
+        return self._mlscore.warm(ladder)
+
+    def set_score_model(self, model, version=None) -> None:
+        """Hot-swap the anomaly model (validated artifact -> new value
+        operands, zero recompiles).  The tier's on_swap hook then runs
+        _on_score_model_swap: a model swap behaves like a rule patch."""
+        if self._mlscore is None:
+            raise RuntimeError("mlscore tier is not enabled")
+        self._mlscore.swap_model(model, version=version)
+
+    def _on_score_model_swap(self) -> None:
+        """Invalidate flow-cached verdicts after a model swap through
+        the SAME generation stamps every table edit uses — in enforce
+        mode the flow table caches enforced verdicts, and a swapped
+        model must not keep serving the old model's denies."""
+        if self._flow is not None:
+            self._flow.bump_generation()
 
     def mark_resident_warm(self) -> None:
         """Freeze the pool's prewarm allocation baseline (called by
@@ -1056,6 +1196,7 @@ class TpuClassifier:
             ).astype(np.int64)
             stats_delta = stats_from_results(res16.astype(np.uint32), pl)
             miss = np.nonzero(~hitmask)[0]
+            miss_out = None
             if len(miss):
                 m = len(miss)
                 bucket = flow_mod.flow_miss_bucket(m)
@@ -1067,7 +1208,7 @@ class TpuClassifier:
                     pad[:, 0] = 3  # KIND_OTHER: PASS, no stats
                     miss_wire = np.concatenate([miss_wire, pad])
                 sub_kind = (miss_wire[:, 0] & 3).astype(np.int32)
-                out = self._launch_wire(
+                miss_out = self._launch_wire(
                     self._plan_wire(
                         plan["path"], plan["dev"], plan["block_b"],
                         miss_wire, plan["v4_only"], sub_kind,
@@ -1076,10 +1217,28 @@ class TpuClassifier:
                     ),
                     apply_stats=False,
                 ).result()
-                res16[miss] = (out.results[:m] & 0xFFFF).astype(np.uint16)
-                stats_delta += out.stats_delta
+                res16[miss] = (
+                    miss_out.results[:m] & 0xFFFF
+                ).astype(np.uint16)
+                stats_delta += miss_out.stats_delta
+            if self._mlscore is not None:
+                # the scoring launch rides between the verdict merge
+                # and the miss insert: the flow table must cache the
+                # ENFORCED verdicts (bit-identical to the fused path,
+                # where _score_update_core runs before the in-program
+                # insert), and stats re-derive from what was served
+                # when the policy rewrote anything
+                new16, _anom, _scores = self._mlscore.update(
+                    wire_np, res16.astype(np.uint32), tflags_np=tcp_flags,
+                )
+                if not np.array_equal(new16, res16):
+                    res16 = new16
+                    stats_delta = stats_from_results(
+                        res16.astype(np.uint32), pl
+                    )
+            if len(miss):
                 verdicts = np.zeros(miss_wire.shape[0], np.uint32)
-                verdicts[:m] = out.results[:m] & 0xFFFF
+                verdicts[:m] = res16[miss].astype(np.uint32)
                 mflags = None
                 if tcp_flags is not None:
                     mflags = np.zeros(miss_wire.shape[0], np.int32)

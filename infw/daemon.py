@@ -231,7 +231,9 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             compressed: Optional[bool] = None,
                             flow_table=None,
                             resident: Optional[bool] = None,
-                            telemetry=None):
+                            telemetry=None,
+                            mlscore=None,
+                            mlscore_mode: Optional[str] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -261,6 +263,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                 "--telemetry is a device-backend feature; the cpu "
                 "reference classifier exports no sketch plane"
             )
+        if mlscore is not None:
+            log.warning(
+                "--mlscore is a device-backend feature; the cpu "
+                "reference classifier serves unscored"
+            )
         return classifier_class("cpu")
     if backend == "tpu":
         import functools
@@ -289,6 +296,16 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             # generation; the daemon attaches its obs ring + drain
             # cadence on the idle loop (_telemetry_maintenance)
             kw["telemetry"] = telemetry
+        if mlscore is not None:
+            # MXU anomaly scoring (infw.mlscore): the launch-validated
+            # (ScoreSpec, ScoreModel) bundle rides into every classifier
+            # generation; the daemon attaches the obs ring, the drain
+            # cadence and the <state-dir>/models/ hot-swap scan on the
+            # idle loop (_mlscore_maintenance)
+            spec, model = mlscore
+            kw["mlscore"] = spec
+            kw["mlscore_model"] = model
+            kw["mlscore_mode"] = mlscore_mode or "shadow"
         if mesh:
             from .backend.mesh import resolve_mesh_spec
 
@@ -304,6 +321,15 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                     log.warning(
                         "--compressed is single-chip only; the mesh "
                         "backend serves the per-level trie layout"
+                    )
+                if kw.pop("mlscore", None) is not None:
+                    # the scoring tensors are not mesh-placed yet (the
+                    # telemetry-plane posture, ISSUE-13/14)
+                    kw.pop("mlscore_model", None)
+                    kw.pop("mlscore_mode", None)
+                    log.warning(
+                        "--mlscore is single-chip only; the mesh "
+                        "backend serves unscored"
                     )
                 return functools.partial(
                     classifier_class("mesh"), mesh=m, **kw
@@ -397,6 +423,25 @@ class _TelemetryCounters:
             return {}
 
 
+class _MlScoreCounters:
+    """mlscore_* counters as a /metrics provider (same getter
+    indirection: survives classifier reloads; no scoring tier renders
+    nothing)."""
+
+    def __init__(self, clf_getter) -> None:
+        self._get = clf_getter
+
+    def counter_values(self):
+        clf = self._get()
+        mc = getattr(clf, "mlscore_counters", None)
+        if clf is None or mc is None:
+            return {}
+        try:
+            return mc()
+        except Exception:
+            return {}
+
+
 # --- daemon ------------------------------------------------------------------
 
 class Daemon:
@@ -437,6 +482,8 @@ class Daemon:
         telemetry_drain: int = 256,
         trace: bool = False,
         trace_slow_us: float = 50_000.0,
+        mlscore=None,
+        mlscore_mode: Optional[str] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -472,6 +519,22 @@ class Daemon:
         self.telemetry_drain = max(1, int(telemetry_drain))
         self._telemetry_attached: set = set()
         self._telemetry_drain_last = 0.0
+        # MXU anomaly scoring (--mlscore [MODEL] / INFW_MLSCORE,
+        # ISSUE-14): per-flow quantized inference fused into the
+        # serving dispatch with shadow/enforce mitigation; the daemon
+        # owns the anomaly-verdict records on the obs event ring, the
+        # mlscore_* counters on /metrics and the <state-dir>/models/
+        # hot-swap dir (versioned npz+manifest artifacts, consumed on
+        # the idle loop — a swap behaves like a rule patch).
+        self.mlscore = mlscore  # validated (ScoreSpec, ScoreModel) or None
+        self.mlscore_mode = mlscore_mode or "shadow"
+        self._mlscore_attached: set = set()
+        self._mlscore_drain_last = 0.0
+        # last models-dir hot-swapped artifact (consumed from disk) —
+        # re-applied to rebuilt classifier generations so an escalation
+        # rebuild can't silently revert to the launch-time model
+        self._mlscore_swapped_model = None
+        self.models_dir = os.path.join(state_dir, "models")
         # Serving-path tracing (--trace): per-stage span clocks through
         # the ingest/serving pipeline, exported as Prometheus histograms
         # on /metrics + sampled TraceSpanRecords for slow admissions.
@@ -569,6 +632,8 @@ class Daemon:
                 self.out_dir]
         if self.tenants_max:
             dirs.append(self.tenants_dir)
+        if self.mlscore is not None:
+            dirs.append(self.models_dir)
         for d in dirs:
             os.makedirs(d, exist_ok=True)
 
@@ -591,6 +656,8 @@ class Daemon:
                 flow_table=flow_table if backend != "cpu" else None,
                 resident=self.resident if backend != "cpu" else None,
                 telemetry=self.telemetry if backend != "cpu" else None,
+                mlscore=self.mlscore if backend != "cpu" else None,
+                mlscore_mode=self.mlscore_mode,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -678,6 +745,13 @@ class Daemon:
                 lambda: self.syncer.classifier
             )
             self.metrics_registry.register_counters(self._telemetry_counters)
+        if self.mlscore is not None and backend != "cpu":
+            # mlscore_* counters (updates, anomalies, enforced denies,
+            # model swaps, drain seq) — the policy tier's accounting
+            self._mlscore_counters = _MlScoreCounters(
+                lambda: self.syncer.classifier
+            )
+            self.metrics_registry.register_counters(self._mlscore_counters)
         if self.tracer is not None:
             # span histograms (ingressnodefirewall_node_span_us) +
             # trace_* sample counters; slow-admission TraceSpanRecords
@@ -1677,6 +1751,10 @@ class Daemon:
                 self._telemetry_maintenance()
             except Exception as e:
                 log.error("telemetry maintenance error: %s", e)
+            try:
+                self._mlscore_maintenance()
+            except Exception as e:
+                log.error("mlscore maintenance error: %s", e)
 
     def _attach_flow_events(self, clf) -> None:
         """Wire a classifier's flow tier to the obs event ring (once
@@ -1737,6 +1815,75 @@ class Daemon:
                 pending = tier._window_admissions > 0
             if pending:
                 tier.drain(force=True)
+
+    def _mlscore_maintenance(self) -> None:
+        """Idle-loop scoring upkeep: attach the obs ring to any new
+        classifier generation's tier, force a time-based drain so
+        low-traffic windows still produce timely anomaly-verdict
+        records, and consume dropped model artifacts from
+        <state-dir>/models/ — each *.npz (+ required .json manifest)
+        hot-swaps through set_score_model (a swap behaves like a rule
+        patch: the flow generation bumps); bad or mismatched artifacts
+        are consumed and logged, never retried forever (the edits-dir
+        bad-file discipline)."""
+        if self.mlscore is None:
+            return
+        clf = self.syncer.classifier
+        tier = getattr(clf, "mlscore", None)
+        if tier is None:
+            return
+        if id(tier) not in self._mlscore_attached:
+            tier.attach_ring(self.ring)
+            self._mlscore_attached.add(id(tier))
+            # a classifier REBUILD (rules-edit escalation, re-place)
+            # constructs its tier from the factory's launch-time model —
+            # re-apply the last hot-swapped artifact (already consumed
+            # from the models dir) so a rebuild can't silently revert
+            swapped = getattr(self, "_mlscore_swapped_model", None)
+            if (swapped is not None
+                    and tier.model_version != swapped.version):
+                try:
+                    clf.set_score_model(swapped)
+                    log.info("mlscore: re-applied hot-swapped model "
+                             "%s to new classifier generation",
+                             swapped.version)
+                except Exception as e:
+                    log.error("mlscore: re-apply of swapped model "
+                              "failed: %s", e)
+        now = time.monotonic()
+        if now - self._mlscore_drain_last >= 5.0:
+            self._mlscore_drain_last = now
+            with tier._lock:
+                pending = tier._window_admissions > 0
+            if pending:
+                tier.drain(force=True)
+        # model hot-swap dir: consume complete npz+manifest pairs
+        from .mlscore import load_model
+
+        try:
+            names = sorted(os.listdir(self.models_dir))
+        except OSError:
+            return
+        for fn in names:
+            if not fn.endswith(".npz"):
+                continue
+            path = os.path.join(self.models_dir, fn)
+            if not os.path.exists(path + ".json"):
+                continue  # manifest not landed yet — next tick
+            try:
+                model = load_model(path)
+                clf.set_score_model(model)
+                self._mlscore_swapped_model = model
+                log.info("mlscore: hot-swapped model %s (version %s)",
+                         fn, tier.model_version)
+            except Exception as e:
+                log.error("mlscore: model artifact %s rejected: %s",
+                          fn, e)
+            for p in (path, path + ".json"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
     def _emit_deny_sampled(self, clf, results, ifindex, pkt_len, frames,
                            batch) -> None:
@@ -1982,6 +2129,30 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(default 50000us)",
     )
     p.add_argument(
+        "--mlscore", nargs="?", const="default",
+        default=os.environ.get("INFW_MLSCORE") or None,
+        help="MXU anomaly-scoring tier (tpu backend): per-flow "
+             "quantized decision-forest (+optional int8 MLP) inference "
+             "fused into the serving dispatch — SYN-flood / port-scan "
+             "/ rate-anomaly verdicts the rule tables cannot express.  "
+             "Optional value = path to a versioned model artifact "
+             "(.npz + .json manifest, infw.mlscore.save_model); bare "
+             "flag loads the built-in detection forest.  Anomaly-"
+             "verdict records ride the obs event ring, mlscore_* "
+             "counters /metrics, and <state-dir>/models/ hot-swaps "
+             "artifacts live (a swap behaves like a rule patch).  CLI "
+             "beats INFW_MLSCORE",
+    )
+    p.add_argument(
+        "--mlscore-mode", choices=("shadow", "enforce"),
+        default=os.environ.get("INFW_MLSCORE_MODE") or "shadow",
+        help="anomaly mitigation policy: shadow (default) scores and "
+             "records only; enforce rewrites over-threshold flows to "
+             "Deny (ruleId 0) — NEVER failsafe-port cells "
+             "(infw.failsaferules, the coverage-proof port list) and "
+             "never existing rule Denies.  CLI beats INFW_MLSCORE_MODE",
+    )
+    p.add_argument(
         "--ring",
         default=os.environ.get("INFW_RING") or None,
         help="persistent pinned host ingest ring: path of a "
@@ -2086,6 +2257,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     if int(args.telemetry_drain) < 1:
         p.error(f"--telemetry-drain must be >= 1, got "
                 f"{args.telemetry_drain}")
+    # Scoring knobs share the launch-time validation posture: a bad
+    # model artifact, an env-derived mode typo or a cpu backend must
+    # fail the launch with a usage error, never raise inside the sync
+    # loop and leave an empty PASS-everything dataplane.
+    mlscore_bundle = None
+    if args.mlscore is not None and str(args.mlscore) not in (
+        "0", "", "false", "no"
+    ):
+        if args.backend == "cpu":
+            p.error("--mlscore requires the tpu backend (the cpu "
+                    "reference classifier has no scoring plane)")
+        if args.mlscore_mode not in ("shadow", "enforce"):
+            p.error(f"invalid INFW_MLSCORE_MODE {args.mlscore_mode!r} "
+                    "(expected shadow|enforce)")
+        from .kernels.mxu_score import ScoreSpec, default_model
+
+        raw = str(args.mlscore)
+        try:
+            if raw in ("default", "1", "true", "yes"):
+                spec = ScoreSpec.make()
+                model = default_model(spec)
+            else:
+                from .mlscore import load_model
+
+                model = load_model(raw)
+                spec = model.spec
+            mlscore_bundle = (spec, model)
+        except (ValueError, OSError) as e:
+            p.error(f"--mlscore: {e}")
+    elif args.mlscore_mode == "enforce":
+        # scoring resolved OFF (flag absent OR an explicit falsy env
+        # value like INFW_MLSCORE=0): enforce mode with no scoring tier
+        # would silently serve unmitigated — fail the launch either way
+        p.error("--mlscore-mode enforce requires --mlscore")
     if not float(args.trace_slow_us) > 0:
         p.error(f"--trace-slow-us must be positive, got "
                 f"{args.trace_slow_us}")
@@ -2151,6 +2356,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         telemetry_drain=int(args.telemetry_drain),
         trace=args.trace,
         trace_slow_us=float(args.trace_slow_us),
+        mlscore=mlscore_bundle,
+        mlscore_mode=args.mlscore_mode,
         ring=args.ring,
     )
     stop = threading.Event()

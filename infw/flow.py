@@ -680,7 +680,7 @@ class FlowTier:
                           tenant_np: Optional[np.ndarray] = None,
                           tflags_np: Optional[np.ndarray] = None,
                           gens_snap=None, alloc_note=None,
-                          telemetry=None):
+                          telemetry=None, mlscore=None):
         """Run one fused resident step and chain the donated buffers:
         ``fn(flow, gens, pages, epoch, *tables_args, wire, tenant,
         tflags, max_age) -> (new flow, new epoch, fused)``.  The updated
@@ -718,28 +718,57 @@ class FlowTier:
                     alloc_note("epoch")
             gens_dev = self._gens_dev if gens_snap is None else gens_snap[0]
             pages_dev = self._pages_dev
-            if telemetry is not None:
-                # telemetry fused variant (ISSUE-13): the donated sketch
-                # tensors chain through the SAME dispatch — exchanged
-                # under the telemetry tier's lock (flow lock -> telemetry
-                # lock, the one nesting order) so sketch updates land in
-                # device-dispatch order
-                def launch(sk):
-                    nf, ne, sk2, fz = fn(
-                        self._flow, gens_dev, pages_dev, epoch_dev, sk,
-                        *tables_args, wire_dev, tenant, tflags,
-                        self._max_age_dev,
+            # telemetry / mlscore fused variants (ISSUE-13/14): the
+            # donated sketch and score tensors chain through the SAME
+            # dispatch — exchanged under each tier's lock in the ONE
+            # nesting order (flow lock -> telemetry lock -> mlscore
+            # lock) so their updates land in device-dispatch order.
+            # Operand order matches jitted_resident_step: flow, gens,
+            # pages, epoch, [sk], [sc, model, tparams], tables..., wire.
+            def run(sk_state=None, sc_ops=None):
+                ops = [self._flow, gens_dev, pages_dev, epoch_dev]
+                if sk_state is not None:
+                    ops.append(sk_state)
+                if sc_ops is not None:
+                    ops.extend(sc_ops)
+                return fn(*ops, *tables_args, wire_dev, tenant, tflags,
+                          self._max_age_dev)
+
+            if telemetry is not None and mlscore is not None:
+                def launch_sk(sk):
+                    held = {}
+
+                    def launch_sc(sc, model, tparams):
+                        nf, ne, sk2, sc2, fz = run(sk, (sc, model,
+                                                        tparams))
+                        held["sk2"] = sk2
+                        held["rest"] = (nf, ne, fz)
+                        return sc2, held["rest"]
+
+                    mlscore.resident_exchange(
+                        launch_sc, epoch, wire_np, tenant_np, tflags_np,
                     )
+                    return held["sk2"], held["rest"]
+
+                new_flow, new_epoch, fused = telemetry.resident_exchange(
+                    launch_sk, epoch, wire_np, tenant_np, tflags_np,
+                )
+            elif telemetry is not None:
+                def launch(sk):
+                    nf, ne, sk2, fz = run(sk)
                     return sk2, (nf, ne, fz)
                 new_flow, new_epoch, fused = telemetry.resident_exchange(
                     launch, epoch, wire_np, tenant_np, tflags_np,
                 )
-            else:
-                new_flow, new_epoch, fused = fn(
-                    self._flow, gens_dev, pages_dev, epoch_dev,
-                    *tables_args, wire_dev, tenant, tflags,
-                    self._max_age_dev,
+            elif mlscore is not None:
+                def launch(sc, model, tparams):
+                    nf, ne, sc2, fz = run(None, (sc, model, tparams))
+                    return sc2, (nf, ne, fz)
+                new_flow, new_epoch, fused = mlscore.resident_exchange(
+                    launch, epoch, wire_np, tenant_np, tflags_np,
                 )
+            else:
+                new_flow, new_epoch, fused = run()
             self._flow = new_flow
             self._epoch_dev = new_epoch
             self._epoch_dev_val = epoch

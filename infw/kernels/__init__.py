@@ -674,6 +674,76 @@ def _build_resident_telemetry_fused(b: int):
                 zeros, max_age)
 
 
+# -- anomaly-scoring fixtures/builders (ISSUE-14) ----------------------------
+#
+# The MXU scoring update (kernels.mxu_score): per-source feature
+# scatters + the oblivious-forest one-hot matmul + the int8 MLP head +
+# the per-tenant policy, donated state.  Two hot-path forms: the
+# standalone follow-on launch (multi-dispatch wire path) and the
+# resident fused step's in-program composition.  Model value operands
+# are persistent, NOT donated.
+
+
+def _score_spec():
+    from .mxu_score import ScoreSpec
+
+    return ScoreSpec.make(trees=4, depth=3, slots=64, ways=2,
+                          cms_depth=2, cms_width=128, hidden=4)
+
+
+def _fresh_score_state(spec):
+    import jax
+
+    from .mxu_score import ScoreState, zero_state_host
+
+    return ScoreState(
+        *(jax.device_put(a) for a in zero_state_host(spec))
+    )
+
+
+def _score_model_operands(spec):
+    import jax
+
+    from .mxu_score import clamp_stress_model, model_device, zero_tparams
+
+    return (model_device(clamp_stress_model(spec)),
+            jax.device_put(zero_tparams(spec)))
+
+
+def _build_score_update(b: int):
+    """The classic scoring launch: one device program updating the
+    feature state and scoring every lane from (wire, verdicts), state
+    donated."""
+    import jax
+
+    from . import mxu_score as mxu_score_mod
+
+    spec = _score_spec()
+    fn = mxu_score_mod.jitted_score_update(spec)
+    model, tparams = _score_model_operands(spec)
+    zeros = jax.device_put(np.zeros(b, np.int32))
+    res = jax.device_put(np.zeros(b, np.uint32))
+    return fn, (_fresh_score_state(spec), model, tparams,
+                _fixture_wire(b), zeros, zeros, res)
+
+
+def _build_resident_mlscore_fused(b: int):
+    """The resident fused step with the scoring plane riding the same
+    program: flow columns + epoch + score state donated; the model
+    value / tparams operands persist across dispatches."""
+    from . import jaxpath
+
+    spec = _score_spec()
+    cfg, flow, gens, pages, epoch, max_age, zeros = _resident_operands(b)
+    model, tparams = _score_model_operands(spec)
+    fn = jaxpath.jitted_resident_step(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False, score=spec
+    )
+    return fn, (flow, gens, pages, epoch, _fresh_score_state(spec),
+                model, tparams, _fixture_device_tables(True),
+                _fixture_wire(b), zeros, zeros, max_age)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -899,6 +969,14 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         KernelEntrypoint(
             "classify-wire/resident-telemetry-fused", "xla",
             _build_resident_telemetry_fused, donate=(0, 3, 4),
+        ),
+        KernelEntrypoint(
+            "mlscore/score-update", "xla", _build_score_update,
+            donate=(0,),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-mlscore-fused", "xla",
+            _build_resident_mlscore_fused, donate=(0, 3, 4),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

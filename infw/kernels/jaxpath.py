@@ -4353,9 +4353,10 @@ def jitted_flow_occupancy():
 def _resident_step_core(
     flow: FlowTable, gens: jax.Array, page_table: jax.Array,
     epoch: jax.Array, tdev, wire: jax.Array, tenant: jax.Array,
-    tflags: jax.Array, max_age: jax.Array, ov=None, sk=None,
+    tflags: jax.Array, max_age: jax.Array, ov=None, sk=None, sc=None,
+    model=None, tparams=None,
     *, slab_entries: int, ways: int, path: str, v4_only: bool,
-    depth: Optional[int], d_max: int, sketch=None,
+    depth: Optional[int], d_max: int, sketch=None, score=None,
 ):
     batch = unpack_wire(wire)
     e1 = (epoch + jnp.int32(1)).astype(jnp.int32)
@@ -4391,8 +4392,26 @@ def _resident_step_core(
     # the wire contract (check_wire_ruleids at plan time) guarantees the
     # stateless result fits 16 bits, exactly like the fused wire path
     merged = jnp.where(hit, served, res & 0xFFFF).astype(jnp.uint32)
+    sc2 = score_out = anom = None
+    if score is not None:
+        # MXU anomaly scoring (ISSUE-14): the feature update + forest/
+        # MLP inference + per-tenant policy ride the SAME device
+        # program, on the merged RULE verdicts (pre-policy — features
+        # never read their own rewrites).  In enforce mode ``merged2``
+        # carries the rewritten verdicts, and it is what the miss
+        # insert below caches — mitigation sticks to the flow, and a
+        # model swap invalidates it through the very generation stamps
+        # a rule patch uses.
+        from . import mxu_score as mxu_score_mod
+
+        sc2, score_out, anom, merged2 = mxu_score_mod._score_update_core(
+            sc, batch, tenant, tflags, merged, model, tparams, spec=score,
+        )
+        merged2 = merged2.astype(jnp.uint32)
+    else:
+        merged2 = merged
     flow2, counts = _flow_insert_core(
-        flow1, gens, page_table, batch, tenant, tflags, merged, e1,
+        flow1, gens, page_table, batch, tenant, tflags, merged2, e1,
         slab_entries=slab_entries, ways=ways, lane_ok=~hit,
     )
     # res16-only readback (the wire8 contract): per-ruleId statistics
@@ -4400,27 +4419,42 @@ def _resident_step_core(
     # that never left the host — shipping the (1024, 6) stats tensor
     # would cost ~24 KB per admission, dwarfing the ~100 B the resident
     # loop actually needs back
-    fused = jnp.concatenate([
-        _pack_res16(merged.astype(jnp.uint16)),
+    parts = [
+        _pack_res16(merged2.astype(jnp.uint16)),
         _pack_bits32(hit),
         jnp.stack([
             jnp.sum(hit.astype(jnp.int32)),
             jnp.sum(stale.astype(jnp.int32)),
         ]),
         counts,
-    ])
+    ]
+    if score is not None:
+        # scoring extension of the fused readback: the anomaly bitmap
+        # (b/32 words) and the int16-saturated per-lane scores (b/2
+        # words) — what shadow records, the precision/recall legs and
+        # the cross-path identity gate read; internal state stays exact
+        # int32 on device
+        s16 = jnp.clip(score_out, -32768, 32767).astype(jnp.int16)
+        parts.append(_pack_bits32(anom))
+        parts.append(_pack_res16(s16.astype(jnp.uint16)))
+    fused = jnp.concatenate(parts)
     if sketch is not None:
         # device-resident telemetry (ISSUE-13): the sketch update rides
         # the SAME device program as the verdicts — count-min + top-K +
-        # tenant-counter scatters over the merged res16, donated like
-        # the flow columns, nothing read back (the decimated drain is
-        # the only D2H the telemetry plane ever pays)
+        # tenant-counter scatters over the SERVED res16 (post-policy,
+        # so telemetry counts what the dataplane actually did), donated
+        # like the flow columns, nothing read back (the decimated drain
+        # is the only D2H the telemetry plane ever pays)
         from . import sketch as sketch_mod
 
         sk2 = sketch_mod._sketch_update_core(
-            sk, batch, tenant, tflags, merged, spec=sketch,
+            sk, batch, tenant, tflags, merged2, spec=sketch,
         )
+        if score is not None:
+            return flow2, e1, sk2, sc2, fused
         return flow2, e1, sk2, fused
+    if score is not None:
+        return flow2, e1, sc2, fused
     return flow2, e1, fused
 
 
@@ -4437,6 +4471,23 @@ def split_resident_outputs(arr: np.ndarray, b: int):
     return res16, hit, hits, stale, counts
 
 
+def split_resident_score_outputs(arr: np.ndarray, b: int):
+    """Host inverse of the SCORING resident step's fused buffer ->
+    (res16[b] — policy-rewritten in enforce mode, hit mask, hits,
+    stale, (inserts, evictions, promotes), anom mask[b], scores[b]
+    int32 from the int16-saturated readback)."""
+    nw = (b + 1) // 2
+    nh = -(-b // 32)
+    res16, hit, hits, stale, counts = split_resident_outputs(
+        arr[: nw + nh + 6], b
+    )
+    base = nw + nh + 6
+    anom = unpack_bits32_host(arr[base : base + nh], b)
+    s16 = unpack_res16_host(arr[base + nh : base + nh + nw], b)
+    scores = s16.astype(np.uint16).astype(np.int16).astype(np.int32)
+    return res16, hit, hits, stale, counts, anom, scores
+
+
 #: donated operand positions of the resident step — the flow column
 #: pytree and the device epoch scalar; declared here so the entrypoint
 #: registry and the jaxcheck donation lint share one source of truth
@@ -4447,62 +4498,79 @@ RESIDENT_DONATE_ARGNUMS = (0, 3)
 #: place every admission exactly like the flow columns
 RESIDENT_SKETCH_DONATE_ARGNUMS = (0, 3, 4)
 
+#: the anomaly-scoring variant donates the score state at position 4
+#: (or 5 when the sketch tensors are present too); the model value and
+#: tparams operands that follow it are persistent, NOT donated
+RESIDENT_SCORE_DONATE_ARGNUMS = (0, 3, 4)
+RESIDENT_SKETCH_SCORE_DONATE_ARGNUMS = (0, 3, 4, 5)
+
+
+def resident_donate_argnums(sketch: bool, score: bool) -> tuple:
+    """The donated positions for a (sketch?, score?) resident variant —
+    one source of truth for the factory below, the entrypoint registry
+    and the jaxcheck donation lint."""
+    donate = [0, 3]
+    pos = 4
+    if sketch:
+        donate.append(pos)
+        pos += 1
+    if score:
+        donate.append(pos)
+    return tuple(donate)
+
 
 @functools.lru_cache(maxsize=None)
 def jitted_resident_step(
     slab_entries: int, ways: int, path: str, v4_only: bool = False,
     depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
-    sketch=None,
+    sketch=None, score=None,
 ):
     """The resident fused executable, cache-keyed on (flow geometry,
-    layout path, wire format specialization) — batch shape and the trie
-    level count specialize through jit's shape/pytree keying, so a
-    warmed ladder serves every admission with zero recompiles (the same
-    contract as every other serving factory, test-pinned).
+    layout path, wire format specialization, sketch/score geometry) —
+    batch shape and the trie level count specialize through jit's
+    shape/pytree keying, so a warmed ladder serves every admission with
+    zero recompiles (the same contract as every other serving factory,
+    test-pinned).
 
-    Signature: f(flow, gens, page_table, epoch, tables[, overlay], wire,
-    tenant, tflags, max_age) -> (new flow columns, new epoch, fused
-    readback).  ``flow`` and ``epoch`` are DONATED: the returned columns
-    and epoch alias the input buffers in place (XLA input_output_alias;
-    the jaxcheck donation lint fails if a donated buffer is silently
+    Operand order: f(flow, gens, page_table, epoch, [sk], [sc, model,
+    tparams], tables[, overlay], wire, tenant, tflags, max_age) ->
+    (flow', epoch', [sk'], [sc'], fused).  ``flow``, ``epoch`` and the
+    optional sketch/score states are DONATED: the returned tensors
+    alias the input buffers in place (XLA input_output_alias; the
+    jaxcheck donation lint fails if a donated buffer is silently
     copied), so the caller must treat the inputs as consumed and chain
-    the returned arrays into the next dispatch."""
+    the returned arrays into the next dispatch.  The score model/
+    tparams operands are persistent device arrays — a model hot swap
+    replaces them whole with spec-fixed shapes, so swapping never
+    recompiles."""
     kw = dict(slab_entries=slab_entries, ways=ways, path=path,
-              v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch)
-    if sketch is not None:
-        # telemetry variant (ISSUE-13): the donated sketch tensors ride
-        # at position 4, between the epoch and the table operands —
-        # f(flow, gens, pages, epoch, sk, tables[, ov], wire, tenant,
-        # tflags, max_age) -> (flow', epoch', sk', fused)
+              v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch,
+              score=score)
+    has_sk = sketch is not None
+    has_sc = score is not None
+
+    def f(*args):
+        flow, gens, page_table, epoch = args[:4]
+        i = 4
+        sk = sc = model = tparams = None
+        if has_sk:
+            sk = args[i]
+            i += 1
+        if has_sc:
+            sc, model, tparams = args[i], args[i + 1], args[i + 2]
+            i += 3
+        tdev = args[i]
+        i += 1
+        ov = None
         if overlay:
-            def f(flow, gens, page_table, epoch, sk, tdev, ov, wire,
-                  tenant, tflags, max_age):
-                return _resident_step_core(
-                    flow, gens, page_table, epoch, tdev, wire, tenant,
-                    tflags, max_age, ov=ov, sk=sk, **kw,
-                )
-        else:
-            def f(flow, gens, page_table, epoch, sk, tdev, wire,
-                  tenant, tflags, max_age):
-                return _resident_step_core(
-                    flow, gens, page_table, epoch, tdev, wire, tenant,
-                    tflags, max_age, sk=sk, **kw,
-                )
+            ov = args[i]
+            i += 1
+        wire, tenant, tflags, max_age = args[i : i + 4]
+        return _resident_step_core(
+            flow, gens, page_table, epoch, tdev, wire, tenant, tflags,
+            max_age, ov=ov, sk=sk, sc=sc, model=model, tparams=tparams,
+            **kw,
+        )
 
-        return jax.jit(f, donate_argnums=RESIDENT_SKETCH_DONATE_ARGNUMS)
-    if overlay:
-        def f(flow, gens, page_table, epoch, tdev, ov, wire, tenant,
-              tflags, max_age):
-            return _resident_step_core(
-                flow, gens, page_table, epoch, tdev, wire, tenant,
-                tflags, max_age, ov=ov, **kw,
-            )
-    else:
-        def f(flow, gens, page_table, epoch, tdev, wire, tenant,
-              tflags, max_age):
-            return _resident_step_core(
-                flow, gens, page_table, epoch, tdev, wire, tenant,
-                tflags, max_age, **kw,
-            )
-
-    return jax.jit(f, donate_argnums=RESIDENT_DONATE_ARGNUMS)
+    return jax.jit(f, donate_argnums=resident_donate_argnums(has_sk,
+                                                             has_sc))

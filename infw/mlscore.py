@@ -1,0 +1,666 @@
+"""Anomaly-scoring policy tier: shadow/enforce mitigation over the MXU
+inference kernels (ISSUE-14).
+
+The control-plane half of kernels.mxu_score: ``AnomalyTier`` owns the
+donated device ScoreState, the model value operands (hot-swapped whole,
+never recompiled) and the per-tenant [threshold, mode] policy rows, and
+drives scoring on BOTH serving paths — the donated exchange the
+resident fused step chains through (jaxpath.jitted_resident_step(score=
+spec)) and the one-follow-on-launch-per-admission form on the
+multi-dispatch wire path (the telemetry wiring shape, ISSUE-13).
+
+Policy semantics:
+
+- **shadow** (default): scores and per-tenant counters only — verdicts
+  are never touched; ``anomaly-verdict`` summary records ride the obs
+  event ring at the decimated drain cadence.
+- **enforce**: a lane over its tenant's threshold is rewritten to Deny
+  (ruleId 0) — but NEVER a failsafe cell (kernels.mxu_score.failsafe_
+  lane_mask_np, the same infw.failsaferules port list the
+  analysis/rules.py coverage proof checks) and never an existing rule
+  Deny.  On the flow paths the ENFORCED verdict is what batch-inserts
+  into the flow table, so mitigation sticks to the flow — and a model
+  swap bumps the flow generation exactly like a rule patch
+  (TpuClassifier.set_score_model), so stale enforced verdicts are
+  invalidated by the same stamps every table edit uses.
+
+Models are versioned artifacts: ``save_model``/``load_model`` write an
+npz of the value arrays plus a JSON manifest (format tag, version, the
+geometry, a sha256 of the npz bytes) — the daemon's ``<state-dir>/
+models/`` hot-swap dir consumes exactly these pairs.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from .kernels.mxu_score import (
+    DEFAULT_THRESHOLD,
+    HostScoreModel,
+    ScoreModel,
+    ScoreSpec,
+    ScoreState,
+    default_model,
+    model_device,
+    validate_model,
+    zero_state_host,
+    zero_tparams,
+)
+
+#: manifest format tag (bump on any incompatible artifact change)
+MODEL_FORMAT = "infw-mlscore-v1"
+
+
+# --- versioned model artifacts (npz + JSON manifest) -------------------------
+
+
+def save_model(model: ScoreModel, path: str,
+               version: Optional[str] = None) -> str:
+    """Write ``path`` (.npz of the value arrays) plus ``path + '.json'``
+    (the manifest: format, version, geometry, sha256 of the npz bytes).
+    Returns the manifest path.  Writes are tmp+rename, so a hot-swap
+    dir scanner can never observe a torn artifact."""
+    validate_model(model)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **model.arrays())
+    os.replace(tmp, path)
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    manifest = {
+        "format": MODEL_FORMAT,
+        "version": str(version or model.version),
+        "spec": dict(model.spec._asdict()),
+        "sha256": digest,
+    }
+    mpath = path + ".json"
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(mpath + ".tmp", mpath)
+    return mpath
+
+
+def load_model(path: str) -> ScoreModel:
+    """Load a versioned model artifact.  The manifest is REQUIRED and
+    its checksum must match the npz bytes — a silently corrupted or
+    hand-edited artifact must fail at the control plane, never produce
+    wrong scores on the serving path."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    mpath = path + ".json"
+    if not os.path.exists(mpath):
+        raise ValueError(f"score model manifest missing: {mpath}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != MODEL_FORMAT:
+        raise ValueError(
+            f"score model format {manifest.get('format')!r} != "
+            f"{MODEL_FORMAT!r}"
+        )
+    with open(path, "rb") as f:
+        raw = f.read()
+    digest = hashlib.sha256(raw).hexdigest()
+    if digest != manifest.get("sha256"):
+        raise ValueError(
+            f"score model checksum mismatch for {path} (manifest "
+            f"{manifest.get('sha256', '')[:12]}.., npz {digest[:12]}..)"
+        )
+    spec = ScoreSpec.make(**manifest["spec"])
+    import io
+
+    with np.load(io.BytesIO(raw)) as z:
+        model = ScoreModel(
+            spec=spec, version=str(manifest.get("version", "unversioned")),
+            **{k: z[k] for k in
+               ("fidx", "fthr", "leaf", "w1", "b1", "w2", "b2", "qshift")},
+        )
+    validate_model(model)
+    return model
+
+
+# --- ring records ------------------------------------------------------------
+
+
+@dataclass
+class AnomalyVerdictRecord:
+    """One decimated drain window of the anomaly tier, exactly once:
+    per-tenant scored/anomalous/enforced counts with the window's max
+    score and the tenant's policy row, plus the window's most-anomalous
+    sources decoded from the device feature table.  ``seq`` is the
+    gap-free drain generation (the telemetry-summary discipline)."""
+
+    seq: int
+    admissions: int
+    tenants: List[dict] = field(default_factory=list)
+    top: List[dict] = field(default_factory=list)
+
+    def lines(self) -> List[str]:
+        out = [
+            f"anomaly-verdict seq={self.seq} "
+            f"admissions={self.admissions} tenants={len(self.tenants)}"
+        ]
+        for t in self.tenants:
+            mode = "ENFORCE" if t.get("enforce") else "shadow"
+            out.append(
+                f"\ttenant {t['tenant']}: {t['scored']} scored, "
+                f"{t['anom']} anomalous, {t['enforced']} enforced, "
+                f"max {t['max_score']} (thr {t['threshold']}, {mode})"
+            )
+        for h in self.top:
+            out.append(
+                f"\tanomalous-src tenant {h['tenant']} {h['src']}: "
+                f"{h['anom_hits']} hit(s), ~{h['pkts']} pkts"
+            )
+        return out
+
+
+def _format_src(keys_row: np.ndarray) -> str:
+    kind = int(keys_row[5]) & 3
+    if kind == 1:
+        return ".".join(str(b) for b in int(keys_row[1]).to_bytes(4, "big"))
+    import ipaddress
+
+    return str(ipaddress.IPv6Address(
+        keys_row[1:5].astype(">u4").tobytes()
+    ))
+
+
+class ScoreSnapshot(NamedTuple):
+    """One drained window's host copies (summary inputs)."""
+
+    seq: int
+    admissions: int
+    skeys: np.ndarray
+    scols: np.ndarray
+    tstat: np.ndarray
+    tparams: np.ndarray
+
+
+def summarize_snapshot(snap: ScoreSnapshot,
+                       top_n: int = 8) -> AnomalyVerdictRecord:
+    """Derive the drain-window record from one snapshot: exact tstat
+    rows per tenant; the feature table's anomaly-hit column (stable
+    sort on (-hits, slot): deterministic ties) becomes the anomalous-
+    source list."""
+    rec = AnomalyVerdictRecord(seq=snap.seq, admissions=snap.admissions)
+    for t in np.nonzero(snap.tstat[:, 0] > 0)[0]:
+        scored, anom, enforced, mx = (int(x) for x in snap.tstat[t])
+        rec.tenants.append({
+            "tenant": int(t), "scored": scored, "anom": anom,
+            "enforced": enforced, "max_score": mx,
+            "threshold": int(snap.tparams[t, 0]),
+            "enforce": bool(snap.tparams[t, 1]),
+        })
+    hits = snap.scols[:, 6]
+    occ = np.nonzero(hits > 0)[0]
+    order = occ[np.argsort(-hits[occ], kind="stable")][:top_n]
+    for slot in order:
+        row = snap.skeys[slot]
+        rec.top.append({
+            "tenant": int(row[0]),
+            "src": _format_src(row),
+            "anom_hits": int(hits[slot]),
+            "pkts": int(snap.scols[slot, 0]),
+            "slot": int(slot),
+        })
+    return rec
+
+
+# --- the device tier ---------------------------------------------------------
+
+
+class AnomalyTier:
+    """Host-side owner of the device scoring plane.
+
+    Thread-safety / ordering: every device mutation (classic update
+    launch, resident donated exchange, drain snapshot+reset, model
+    swap) runs under ONE lock, so score updates land in a total device
+    order; the optional HostScoreModel mirror replays the SAME order
+    through a pending queue (resident admissions' verdicts are
+    host-resident only at materialize — the TelemetryTier discipline).
+    Lock nesting: the flow tier's dispatch lock and the telemetry
+    tier's lock may be held when this lock is taken, never the reverse
+    (flow -> telemetry -> mlscore).
+
+    ``track_model`` is a SHADOW-mode facility (statecheck / tests): the
+    mirror replays from the served verdicts, which under enforcement no
+    longer carry the pre-policy rule verdicts — constructing a tracked
+    tier with enforcement on (or enabling it later) raises.
+    """
+
+    def __init__(self, spec: ScoreSpec, model: Optional[ScoreModel] = None,
+                 device=None, mode: str = "shadow",
+                 threshold: int = DEFAULT_THRESHOLD,
+                 track_model: bool = False, drain_every: int = 256,
+                 ring=None, keep_masks: int = 0) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if mode not in ("shadow", "enforce"):
+            raise ValueError(
+                f"mlscore mode must be shadow|enforce, got {mode!r}"
+            )
+        if track_model and mode == "enforce":
+            raise ValueError(
+                "mlscore track_model is shadow-only (the mirror replays "
+                "from served verdicts, which enforcement rewrites)"
+            )
+        self.spec = spec
+        self._device = device
+        self._lock = threading.Lock()
+        host = zero_state_host(spec)
+        put = lambda a: jax.device_put(jnp.asarray(a), device)
+        self._state = ScoreState(*(put(a) for a in host))
+        host_model = model or default_model(spec)
+        validate_model(host_model)
+        if host_model.spec != spec:
+            raise ValueError("mlscore model geometry != tier spec")
+        self._host_model = host_model
+        self._model_dev = model_device(host_model, device)
+        self._tparams_np = zero_tparams(
+            spec, threshold=threshold, enforce=(mode == "enforce")
+        )
+        self._tparams_dev = put(self._tparams_np)
+        self.model = (
+            HostScoreModel(spec, host_model, self._tparams_np)
+            if track_model else None
+        )
+        #: pending model mirrors in device-dispatch order (the
+        #: TelemetryTier queue shape): resident entries hold the fused
+        #: buffer and replay once the admission materializes
+        self._mirror_q: list = []
+        self.drain_every = int(drain_every)
+        self._admissions = 0
+        self._window_admissions = 0
+        self._drain_seq = 0
+        self._ring = ring
+        self._zeros_cache: Dict[int, tuple] = {}
+        #: test/bench facility: retain the last ``keep_masks``
+        #: admissions' (epoch, anom mask, scores) triples — how the
+        #: precision/recall legs read device decisions without a
+        #: per-admission readback in production (0 = off)
+        self._keep_masks = int(keep_masks)
+        self._masks: list = []
+        self.counters = {
+            "updates": 0, "drains": 0, "records": 0,
+            "anomalies": 0, "enforced": 0, "model_swaps": 0,
+        }
+        self.model_version = host_model.version
+        #: control-plane hook run after a successful model swap (the
+        #: classifier wires flow-generation invalidation here, so a
+        #: swap behaves like a rule patch)
+        self.on_swap = None
+        self.top_n = 8
+
+    # -- plumbing ------------------------------------------------------------
+
+    def attach_ring(self, ring) -> None:
+        with self._lock:
+            self._ring = ring
+
+    def _put(self, a):
+        import jax
+
+        return jax.device_put(a, self._device)
+
+    def _zeros(self, b: int):
+        z = self._zeros_cache.get(b)
+        if z is None:
+            z = (
+                self._put(np.zeros(b, np.int32)),
+                self._put(np.zeros(b, np.int32)),
+            )
+            self._zeros_cache[b] = z
+        return z
+
+    def _note(self, key: str, n: int = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def reset_state(self) -> None:
+        """Zero the device score state (and the tracking mirror) without
+        touching model/policy/counters — the bench's per-pass reset so
+        interleaved A/B reps start from identical state.  One small H2D
+        of zeros; shapes are spec-fixed, so nothing recompiles."""
+        with self._lock:
+            host = zero_state_host(self.spec)
+            self._state = ScoreState(*(self._put(a) for a in host))
+            if self.model is not None:
+                self.model.reset_state()
+            self._mirror_q.clear()
+            self._masks.clear()
+
+    # -- policy --------------------------------------------------------------
+
+    def set_mode(self, mode: str, tenant: Optional[int] = None) -> None:
+        """Flip shadow/enforce for one tenant (or all): one tiny
+        tparams re-upload, no recompile — mode is a runtime operand."""
+        if mode not in ("shadow", "enforce"):
+            raise ValueError(
+                f"mlscore mode must be shadow|enforce, got {mode!r}"
+            )
+        with self._lock:
+            if mode == "enforce" and self.model is not None:
+                raise ValueError(
+                    "mlscore track_model is shadow-only; detach the "
+                    "mirror before enforcing"
+                )
+            rows = (
+                slice(None) if tenant is None
+                else int(tenant)
+            )
+            self._tparams_np[rows, 1] = 1 if mode == "enforce" else 0
+            self._tparams_dev = self._put(self._tparams_np)
+            hook = self.on_swap
+        # a policy flip changes what the tier would decide NOW — flow
+        # entries caching verdicts enforced under the old policy must go
+        # stale exactly like after a model swap (same generation stamps)
+        if hook is not None:
+            hook()
+
+    def set_threshold(self, threshold: int,
+                      tenant: Optional[int] = None) -> None:
+        with self._lock:
+            rows = slice(None) if tenant is None else int(tenant)
+            self._tparams_np[rows, 0] = int(threshold)
+            self._tparams_dev = self._put(self._tparams_np)
+            hook = self.on_swap
+        if hook is not None:
+            hook()
+
+    def tparams(self) -> np.ndarray:
+        with self._lock:
+            return self._tparams_np.copy()
+
+    def swap_model(self, model: ScoreModel,
+                   version: Optional[str] = None) -> None:
+        """Hot-swap the model values: validate, upload the new operand
+        arrays whole (spec-fixed shapes — zero recompiles), replace the
+        mirror's model, then fire ``on_swap`` (the classifier's flow-
+        generation bump: a model swap behaves like a rule patch)."""
+        validate_model(model)
+        if model.spec != self.spec:
+            raise ValueError(
+                f"score model geometry {model.spec} != tier spec "
+                f"{self.spec} (geometry changes are a tier rebuild, "
+                "not a hot swap)"
+            )
+        with self._lock:
+            self._host_model = model
+            self._model_dev = model_device(model, self._device)
+            self.model_version = str(version or model.version)
+            if self.model is not None:
+                self.model.swap(model)
+            self._note("model_swaps")
+            hook = self.on_swap
+        if hook is not None:
+            hook()
+
+    def host_model(self) -> ScoreModel:
+        with self._lock:
+            return self._host_model
+
+    # -- updates -------------------------------------------------------------
+
+    def update(self, wire_np: np.ndarray, res: np.ndarray,
+               tenant_np: Optional[np.ndarray] = None,
+               tflags_np: Optional[np.ndarray] = None):
+        """The multi-dispatch path's scoring launch: ONE device program
+        per admission over (wire, merged rule verdicts), donated state.
+        Returns host copies (res16' uint16, anom bool, scores int32) —
+        the caller (backend/tpu) swaps its verdicts for res16' so
+        enforcement lands before the flow insert and the stats
+        derivation, bit-identically to the fused path."""
+        from .kernels import mxu_score
+
+        b = wire_np.shape[0]
+        wire = self._put(np.ascontiguousarray(wire_np, np.uint32))
+        res_dev = self._put(np.asarray(res, np.uint32))
+        zt, zf = None, None
+        if tenant_np is None or tflags_np is None:
+            zt, zf = self._zeros(b)
+        tenant = (zt if tenant_np is None
+                  else self._put(np.ascontiguousarray(tenant_np, np.int32)))
+        tflags = (zf if tflags_np is None
+                  else self._put(np.ascontiguousarray(tflags_np, np.int32)))
+        fn = mxu_score.jitted_score_update(self.spec)
+        with self._lock:
+            sc2, score, anom, res_out = fn(
+                self._state, self._model_dev, self._tparams_dev, wire,
+                tenant, tflags, res_dev,
+            )
+            self._state = sc2
+            self._admissions += 1
+            self._window_admissions += 1
+            epoch = self._admissions
+            self._note("updates")
+            if self.model is not None:
+                self._mirror_q.append(
+                    (np.asarray(wire_np, np.uint32).copy(),
+                     None if tenant_np is None
+                     else np.asarray(tenant_np, np.int32).copy(),
+                     None if tflags_np is None
+                     else np.asarray(tflags_np, np.int32).copy(),
+                     np.asarray(res, np.uint32).copy(), None)
+                )
+                self._replay_ready_locked()
+        # reported scores are int16-saturated on BOTH paths: the fused
+        # resident readback packs them into an int16 lane, so the
+        # classic path clips identically (the anom decision was made
+        # in-kernel on the raw int32 — only the report saturates)
+        score_np = np.clip(np.asarray(score), -32768, 32767).astype(np.int32)
+        anom_np = np.asarray(anom)
+        res16 = (np.asarray(res_out) & 0xFFFF).astype(np.uint16)
+        self._note_result(epoch, anom_np, score_np)
+        self.maybe_drain()
+        return res16, anom_np, score_np
+
+    def resident_exchange(self, launch, epoch: int,
+                          wire_np, tenant_np, tflags_np):
+        """The resident fused step's donated score chain: ``launch(sc,
+        model, tparams) -> (sc', rest)`` runs under this tier's lock so
+        score updates land in device-dispatch order; the model mirror
+        (track_model only) queues with the fused buffer and replays
+        once the admission materializes."""
+        with self._lock:
+            sc2, rest = launch(
+                self._state, self._model_dev, self._tparams_dev
+            )
+            self._state = sc2
+            self._admissions += 1
+            self._window_admissions += 1
+            self._note("updates")
+            if self.model is not None:
+                fused = rest[-1]
+                self._mirror_q.append(
+                    (np.asarray(wire_np, np.uint32).copy(),
+                     None if tenant_np is None
+                     else np.asarray(tenant_np, np.int32).copy(),
+                     None if tflags_np is None
+                     else np.asarray(tflags_np, np.int32).copy(),
+                     None, fused)
+                )
+        return rest
+
+    def _replay_ready_locked(self) -> None:
+        """Drain the head of the mirror queue in device order (the
+        TelemetryTier shape): a resident entry's verdicts live in its
+        fused buffer — np.asarray blocks until the dispatch lands,
+        which keeps classic entries behind it in order.  Shadow-only:
+        the fused res16 IS the pre-policy rule verdict vector."""
+        from .kernels import jaxpath
+
+        while self._mirror_q:
+            wire, tenant, tflags, res, fused = self._mirror_q[0]
+            if res is None:
+                res16, _hit, _h, _s, _c, _an, _sc = (
+                    jaxpath.split_resident_score_outputs(
+                        np.asarray(fused), wire.shape[0]
+                    )
+                )
+                res = res16.astype(np.uint32)
+            self.model.update(wire, res, tenant, tflags)
+            self._mirror_q.pop(0)
+
+    def _note_result(self, epoch: int, anom_np: np.ndarray,
+                     score_np: Optional[np.ndarray]) -> None:
+        n_anom = int(anom_np.sum()) if anom_np is not None else 0
+        with self._lock:
+            if n_anom:
+                self._note("anomalies", n_anom)
+            if self._keep_masks and anom_np is not None:
+                self._masks.append((epoch, anom_np.copy(),
+                                    None if score_np is None
+                                    else score_np.copy()))
+                del self._masks[:-self._keep_masks]
+
+    def resident_note_materialized(self, epoch: int,
+                                   anom_np: Optional[np.ndarray] = None,
+                                   score_np: Optional[np.ndarray] = None,
+                                   enforced: int = 0) -> None:
+        """Materialize hook for resident admissions: replay pending
+        model mirrors, note the admission's anomaly outcome (the fused
+        buffer's bitmap, parsed by the caller) and run the decimated
+        drain cadence."""
+        if self.model is not None:
+            with self._lock:
+                self._replay_ready_locked()
+        if anom_np is not None:
+            self._note_result(epoch, anom_np, score_np)
+        if enforced:
+            with self._lock:
+                self._note("enforced", enforced)
+        self.maybe_drain()
+
+    def recent_masks(self) -> list:
+        """The retained (epoch, anom mask, scores) triples (keep_masks
+        test/bench facility), oldest first."""
+        with self._lock:
+            return list(self._masks)
+
+    def set_keep_masks(self, n: int) -> None:
+        """Enable/resize the retained-decision window (test/bench
+        only; 0 disables and drops the backlog)."""
+        with self._lock:
+            self._keep_masks = int(n)
+            if not self._keep_masks:
+                self._masks.clear()
+            else:
+                del self._masks[:-self._keep_masks]
+
+    # -- the decimated drain -------------------------------------------------
+
+    def maybe_drain(self) -> List[AnomalyVerdictRecord]:
+        with self._lock:
+            due = self._window_admissions >= self.drain_every
+        return self.drain() if due else []
+
+    def drain(self, force: bool = True) -> List[AnomalyVerdictRecord]:
+        """Snapshot + window-reset the device tensors and emit the
+        window's anomaly-verdict record on the attached ring.  Exactly-
+        once: snapshot and reset run under the tier lock atomically
+        with the admission counters, so every admission lands in
+        exactly one window and ``seq`` stamps are gap-free (the
+        telemetry drain contract).  Only the WINDOW state resets (tstat
+        + per-row anomaly hits); rates persist."""
+        from .kernels import mxu_score
+
+        with self._lock:
+            if not force and self._window_admissions < self.drain_every:
+                return []
+            if self.model is not None:
+                self._replay_ready_locked()
+            snap = ScoreSnapshot(
+                seq=self._drain_seq + 1,
+                admissions=self._window_admissions,
+                skeys=np.asarray(self._state.skeys),
+                scols=np.asarray(self._state.scols),
+                tstat=np.asarray(self._state.tstat),
+                tparams=self._tparams_np.copy(),
+            )
+            self._state = mxu_score.jitted_score_drain()(self._state)
+            if self.model is not None:
+                self.model.drain()
+            self._drain_seq += 1
+            self._window_admissions = 0
+            self._note("drains")
+            enforced = int(snap.tstat[:, 2].sum())
+            if enforced:
+                self._note("enforced", enforced)
+            rec = summarize_snapshot(snap, top_n=self.top_n)
+            self._note("records")
+            if self._ring is not None:
+                self._ring.push(rec)
+        return [rec]
+
+    # -- introspection -------------------------------------------------------
+
+    def columns(self) -> Dict[str, np.ndarray]:
+        """Host copies of the device tensors (the model-compare side),
+        materialized INSIDE the lock — the state is donated per
+        admission, so an off-lock snapshot could be consumed
+        mid-read."""
+        with self._lock:
+            s = self._state
+            return {k: np.asarray(getattr(s, k)) for k in s._fields}
+
+    @property
+    def admissions(self) -> int:
+        with self._lock:
+            return self._admissions
+
+    @property
+    def drain_seq(self) -> int:
+        with self._lock:
+            return self._drain_seq
+
+    def counter_values(self) -> Dict[str, int]:
+        """mlscore_* counters for /metrics."""
+        with self._lock:
+            out = {
+                f"mlscore_{k}_total": int(v)
+                for k, v in self.counters.items()
+            }
+            out["mlscore_admissions_total"] = self._admissions
+            out["mlscore_drain_seq"] = self._drain_seq
+            out["mlscore_window_admissions"] = self._window_admissions
+            out["mlscore_enforce_tenants"] = int(
+                (self._tparams_np[:, 1] != 0).sum()
+            )
+        return out
+
+    def warm(self, ladder) -> int:
+        """Pre-compile the classic score-update executable for every
+        wire shape in ``ladder`` (inert KIND_OTHER rows: every lane
+        ineligible, only the epoch advances — mirrored into the tracked
+        model via tick()).  Prewarm launches must NOT count as
+        admissions (counters, drain window and the mirror all see
+        served traffic only)."""
+        from .kernels import mxu_score
+
+        fn = mxu_score.jitted_score_update(self.spec)
+        n = 0
+        for b in sorted(set(int(x) for x in ladder)):
+            for width in (4, 7):
+                wire_np = np.zeros((b, width), np.uint32)
+                wire_np[:, 0] = 3  # KIND_OTHER
+                wire = self._put(wire_np)
+                zt, zf = self._zeros(b)
+                res = self._put(np.zeros(b, np.uint32))
+                with self._lock:
+                    sc2, _score, _anom, _res = fn(
+                        self._state, self._model_dev, self._tparams_dev,
+                        wire, zt, zf, res,
+                    )
+                    self._state = sc2
+                    if self.model is not None:
+                        self.model.tick()
+                n += 1
+        return n

@@ -43,7 +43,6 @@ from typing import Callable, Dict, List, NamedTuple, Optional
 import numpy as np
 
 from ..kernels.sketch import (
-    SKETCH_KEY_WORDS,
     HostSketchModel,
     SketchSpec,
     SketchState,

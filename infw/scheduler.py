@@ -420,6 +420,17 @@ def prewarm_ladder(clf, ladder, include_depth_classes: bool = True,
             n_done += int(warm_tel([int(b) for b in ladder]) or 0)
         except Exception as e:  # degrade, never refuse
             log.debug("telemetry prewarm skipped: %s", e)
+    warm_ml = getattr(clf, "warm_mlscore_ladder", None)
+    if warm_ml is not None:
+        # anomaly scoring (ISSUE-14): the ladder loop above warmed the
+        # resident fused score variants through the production
+        # dispatch; this compiles the classic follow-on score-update
+        # launch for every ladder shape too, so scoring never costs a
+        # serving-path compile in either dispatch mode
+        try:
+            n_done += int(warm_ml([int(b) for b in ladder]) or 0)
+        except Exception as e:  # degrade, never refuse
+            log.debug("mlscore prewarm skipped: %s", e)
     mark_resident = getattr(clf, "mark_resident_warm", None)
     if mark_resident is not None:
         # resident-pool-aware prewarm (ISSUE-12): the ladder loop above

@@ -1,0 +1,793 @@
+"""MXU anomaly-scoring tier (ISSUE-14): quantized inference kernels vs
+the numpy oracle, shadow/enforce policy semantics (incl. the failsafe
+precedence proof), versioned model artifacts + hot swap, labeled
+loadgen manifests, and the statecheck mlscore configs.
+
+Tier-1 keeps the cheap oracle/policy/artifact/label tests plus one
+small device-kernel parity test; the jit-heavy classifier-path,
+cross-path-identity and statecheck sweeps are slow-marked and run in
+``make test``, ``make state-check`` (mlscore configs + the mlquant
+acceptance) and ``make mlscore-bench`` (oracle + detection +
+retention + steady-state gates).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.constants import ALLOW, DENY
+from infw.kernels import mxu_score as M
+from infw.kernels.jaxpath import TCP_ACK, TCP_SYN
+from infw.kernels.mxu_score import (
+    SCORE_FEATURES,
+    HostScoreModel,
+    ScoreSpec,
+    ScoreState,
+    clamp_stress_model,
+    default_model,
+    failsafe_lane_mask_np,
+    validate_model,
+    zero_state_host,
+    zero_tparams,
+)
+from infw.mlscore import (
+    AnomalyTier,
+    ScoreSnapshot,
+    load_model,
+    save_model,
+    summarize_snapshot,
+)
+
+#: one small spec shared across tests so the jitted update compiles once
+SPEC = ScoreSpec.make(trees=4, depth=3, slots=32, ways=2, cms_depth=2,
+                      cms_width=64, sat=511, hidden=4)
+
+
+def _tables(n=48, seed=3, width=8):
+    rng = np.random.default_rng(seed)
+    return testing.random_tables(rng, n_entries=n, width=width)
+
+
+def _traffic(tables, seed, b=48, syn_frac=0.3):
+    rng = np.random.default_rng(seed)
+    batch = testing.random_batch(rng, tables, b)
+    batch.tcp_flags = np.where(
+        rng.random(b) < syn_frac, TCP_SYN, TCP_ACK
+    ).astype(np.int32)
+    res = (
+        rng.integers(0, 3, b).astype(np.uint32)
+        | (rng.integers(1, 9, b).astype(np.uint32) << 8)
+    )
+    return batch, batch.pack_wire(), res
+
+
+def _device_state(spec):
+    import jax
+
+    return ScoreState(*(jax.device_put(a) for a in zero_state_host(spec)))
+
+
+def _model_operands(spec, model):
+    import jax
+
+    return M.model_device(model), jax.device_put(zero_tparams(spec))
+
+
+# --- spec / model validation -------------------------------------------------
+
+
+def test_spec_validation():
+    assert ScoreSpec.make(slots=100).slots == 128   # pow2 bucketing
+    assert ScoreSpec.make(cms_width=100).cms_width == 128
+    assert ScoreSpec.make(depth=3).leaves == 8
+    for kw in (dict(trees=0), dict(trees=17), dict(depth=0),
+               dict(depth=7), dict(ways=0), dict(ways=9),
+               dict(cms_depth=0), dict(sat=0), dict(hidden=-1),
+               dict(hidden=65), dict(max_tenants=0)):
+        with pytest.raises(ValueError):
+            ScoreSpec.make(**kw)
+
+
+def test_model_validation_contract():
+    m = default_model(SPEC)
+    validate_model(m)  # clean passes
+    with pytest.raises(ValueError, match="fidx"):
+        validate_model(m._replace(fidx=m.fidx.astype(np.int64)))
+    bad = m.fidx.copy()
+    bad[0, 0] = SCORE_FEATURES
+    with pytest.raises(ValueError, match="out of range"):
+        validate_model(m._replace(fidx=bad))
+    with pytest.raises(ValueError, match="qshift"):
+        validate_model(m._replace(qshift=np.asarray([40, 0], np.int32)))
+    with pytest.raises(ValueError, match="leaf"):
+        validate_model(m._replace(leaf=m.leaf[:-1]))
+
+
+def test_clamp_stress_model_requires_head():
+    with pytest.raises(ValueError):
+        clamp_stress_model(ScoreSpec.make(hidden=0))
+
+
+# --- quantized inference semantics (pure numpy, no jit) ----------------------
+
+
+def test_forest_inference_hand_case():
+    """One hand-built feature row through the default forest: the
+    synflood tree (tree 0) fires iff syn_frac>=192 AND pkts>=24 AND the
+    lane is a pure SYN — the leaf one-hot matmul semantics pinned
+    without any state machinery."""
+    spec = ScoreSpec.make(hidden=0)
+    host = HostScoreModel(spec, default_model(spec))
+    row = np.zeros((1, SCORE_FEATURES), np.int32)
+    row[0, 12] = 256   # syn_frac_q8
+    row[0, 0] = 30     # pkts
+    row[0, 6] = 1      # pure-SYN lane
+    assert host.infer(row)[0] == 120
+    row[0, 6] = 0      # same source stats, non-SYN lane
+    assert host.infer(row)[0] == 0
+    row[0, 6], row[0, 0] = 1, 10   # source too small
+    assert host.infer(row)[0] == 0
+
+
+def test_mlp_head_requant_clamp_semantics():
+    """The fixed-point head: features shift+clamp to int8, hidden layer
+    accumulates int32, requantizes with the [0,127] clamp — the exact
+    arithmetic the mlquant defect corrupts."""
+    spec = SPEC
+    m = clamp_stress_model(spec)
+    host = HostScoreModel(spec, m)
+    row = np.zeros((1, SCORE_FEATURES), np.int32)
+    row[0, 8] = 1500   # pkt_len: clamps to 127 at input, * 3 = 381 -> 127
+    assert host.infer(row)[0] == 127
+    row[0, 8] = 10     # 10 * 3 = 30, under the clamp
+    assert host.infer(row)[0] == 30
+
+
+def test_default_model_detects_synthetic_attacks():
+    """Host-model detection smoke on the seeded labeled traces — the
+    cheap (numpy-only) half of the bench_mlscore quality gate.  Trace
+    length matches bench_mlscore (60 chunks): recall is measured over
+    EVERY attack record including the pre-detection onset window, so a
+    short trace under-weights steady state and fails the gate even
+    though the detector is fine."""
+    tables = testing.random_tables_fast(
+        np.random.default_rng(5), n_entries=2000, width=8,
+        v6_fraction=0.4, ifindexes=(2, 3),
+    )
+    spec = ScoreSpec.make()
+    bs = 256
+    for mode in ("synflood", "portscan"):
+        trace, meta = testing.attack_trace_batch(
+            np.random.default_rng(1400), tables, bs * 60, mode=mode,
+            chunk_packets=bs,
+        )
+        host = HostScoreModel(spec, default_model(spec))
+        flags = np.asarray(trace.tcp_flags, np.int32)
+        truth = np.asarray(meta["attack_mask"], bool)
+        pred = np.zeros(len(trace), bool)
+        for lo in range(0, len(trace), bs):
+            sub = np.arange(lo, lo + bs, dtype=np.int64)
+            w, _v4 = trace.pack_wire_subset(sub)
+            _s, anom, _r = host.update(
+                w, np.full(len(sub), ALLOW, np.uint32), None, flags[sub]
+            )
+            pred[lo : lo + bs] = anom
+        tp = int((pred & truth).sum())
+        fp = int((pred & ~truth).sum())
+        fn = int((~pred & truth).sum())
+        assert tp / max(tp + fp, 1) >= 0.95, (mode, tp, fp)
+        assert tp / max(tp + fn, 1) >= 0.9, (mode, tp, fn)
+
+
+# --- device kernel vs host oracle (one small jit compile) --------------------
+
+
+def test_score_kernel_matches_model_bit_exact():
+    """Device update vs HostScoreModel over several admissions with
+    duplicate sources, LRU churn (tiny table) and the clamp-stressed
+    MLP head: every state tensor, per-lane score, anomaly flag and
+    policy verdict must match bit for bit."""
+    import jax
+
+    model = clamp_stress_model(SPEC)
+    host = HostScoreModel(SPEC, model, zero_tparams(SPEC))
+    st = _device_state(SPEC)
+    mdev, tpd = _model_operands(SPEC, model)
+    fn = M.jitted_score_update(SPEC)
+    tables = _tables()
+    for i in range(5):
+        batch, wire, res = _traffic(tables, 100 + (i % 2), b=48)
+        st, score, anom, res_out = fn(
+            st, mdev, tpd,
+            jax.device_put(np.ascontiguousarray(wire, np.uint32)),
+            jax.device_put(np.zeros(48, np.int32)),
+            jax.device_put(batch.tcp_flags),
+            jax.device_put(res),
+        )
+        hs, ha, hr = host.update(wire, res, None, batch.tcp_flags)
+        assert np.array_equal(np.asarray(score), hs), i
+        assert np.array_equal(np.asarray(anom), ha), i
+        assert np.array_equal(np.asarray(res_out), hr), i
+        cols = {k: np.asarray(getattr(st, k)) for k in st._fields}
+        for k, want in host.columns().items():
+            assert np.array_equal(cols[k], want), (i, k)
+
+
+def test_mlquant_defect_diverges_from_model():
+    """The injected mlquant defect (device drops the requant clamp)
+    must split device from model on clamp-stressed traffic — the
+    statecheck acceptance's catch surface."""
+    import jax
+
+    model = clamp_stress_model(SPEC)
+    tables = _tables()
+    batch, wire, res = _traffic(tables, 7)
+    M._INJECT_MLQUANT_BUG = True
+    M.jitted_score_update.cache_clear()
+    try:
+        fn = M.jitted_score_update(SPEC)
+        st = _device_state(SPEC)
+        mdev, tpd = _model_operands(SPEC, model)
+        host = HostScoreModel(SPEC, model, zero_tparams(SPEC))
+        st, score, _a, _r = fn(
+            st, mdev, tpd,
+            jax.device_put(np.ascontiguousarray(wire, np.uint32)),
+            jax.device_put(np.zeros(len(batch), np.int32)),
+            jax.device_put(batch.tcp_flags), jax.device_put(res),
+        )
+        hs, _ha, _hr = host.update(wire, res, None, batch.tcp_flags)
+        assert not np.array_equal(np.asarray(score), hs)
+    finally:
+        M._INJECT_MLQUANT_BUG = False
+        M.jitted_score_update.cache_clear()
+
+
+# --- policy: enforce semantics + the failsafe precedence proof ---------------
+
+
+def test_enforce_rewrite_semantics():
+    """Enforce rewrites over-threshold Allow lanes to Deny (ruleId 0),
+    keeps existing rule Denies (their ruleId survives), and shadow mode
+    never touches anything."""
+    tables = _tables()
+    batch, wire, _ = _traffic(tables, 21, b=32)
+    res = np.full(32, ALLOW, np.uint32)
+    res[:8] = (5 << 8) | DENY  # existing rule denies keep their ruleId
+    # everything anomalous
+    tp = zero_tparams(SPEC, threshold=-(10 ** 6), enforce=True)
+    host = HostScoreModel(SPEC, clamp_stress_model(SPEC), tp)
+    _s, anom, out = host.update(wire, res, None, batch.tcp_flags)
+    elig = (batch.kind == 1) | (batch.kind == 2)
+    fs = failsafe_lane_mask_np(batch.proto, batch.dst_port)
+    assert (out[:8] == res[:8]).all()          # rule denies untouched
+    lanes = elig & ~fs
+    lanes[:8] = False
+    assert (out[lanes] == M.ANOMALY_DENY_RESULT).all()
+    assert (out[~elig] == res[~elig]).all()    # ineligible untouched
+    # shadow: same state trajectory, verdicts untouched
+    host2 = HostScoreModel(SPEC, clamp_stress_model(SPEC),
+                           zero_tparams(SPEC, threshold=-(10 ** 6)))
+    _s2, anom2, out2 = host2.update(wire, res, None, batch.tcp_flags)
+    assert np.array_equal(out2, res)
+    assert np.array_equal(anom, anom2)
+
+
+def test_failsafe_precedence_proof_backed():
+    """The proof-backed failsafe test: (1) the analyzer's coverage
+    proof (analysis/rules.py failsafe-violation over the SAME
+    infw.failsaferules port list) certifies the base ruleset reaches no
+    failsafe Deny; (2) with an everything-is-anomalous enforcing model,
+    a witness sweep over EVERY failsafe cell still serves the rule
+    verdict — enforcement can never manufacture the violation the
+    proof excluded."""
+    from infw import failsaferules
+    from infw.analysis import rules as rules_mod
+    from infw.compiler import LpmKey, compile_tables_from_content
+
+    # an allow-everything base table: one /0 catch-all rule (proto 0 =
+    # protocol-unset, kernel.c:254-257) — the coverage proof must be
+    # clean on it
+    rules = np.zeros((4, 7), np.int32)
+    rules[1] = [1, 0, 0, 0, 0, 0, ALLOW]
+    content = {LpmKey(32, 2, bytes(16)): rules}
+    tables = compile_tables_from_content(content, rule_width=4)
+    findings = rules_mod.analyze_tables(tables)
+    assert not [
+        f for f in findings if f.check == "failsafe-violation"
+    ], "coverage proof must certify the base table"
+    # witness sweep: one lane per failsafe cell + one non-failsafe lane
+    cells = [(6, fs.port) for fs in failsaferules.get_tcp()]
+    cells += [(17, fs.port) for fs in failsaferules.get_udp()]
+    cells.append((6, 8080))  # the control lane: MUST be rewritten
+    b = len(cells)
+    batch = testing.random_batch(np.random.default_rng(2), tables, b)
+    batch.kind[:] = 1
+    batch.ip_words[:, 1:] = 0
+    batch.ifindex[:] = 2
+    batch.l4_ok[:] = 1
+    batch.proto[:] = [p for p, _ in cells]
+    batch.dst_port[:] = [pt for _, pt in cells]
+    batch.icmp_type[:] = 0
+    batch.icmp_code[:] = 0
+    batch.tcp_flags = np.full(b, TCP_ACK, np.int32)
+    ref = oracle.classify(tables, batch)
+    assert ((ref.results & 0xFF) == ALLOW).all()
+    tp = zero_tparams(SPEC, threshold=-(10 ** 6), enforce=True)
+    host = HostScoreModel(SPEC, clamp_stress_model(SPEC), tp)
+    _s, _a, out = host.update(
+        batch.pack_wire(), ref.results, None, batch.tcp_flags
+    )
+    assert np.array_equal(out[:-1], ref.results[:-1]), (
+        "enforce rewrote a failsafe cell"
+    )
+    assert out[-1] == M.ANOMALY_DENY_RESULT, (
+        "the non-failsafe control lane must be rewritten"
+    )
+
+
+# --- versioned artifacts -----------------------------------------------------
+
+
+def test_model_artifact_round_trip(tmp_path):
+    m = clamp_stress_model(SPEC)
+    path = str(tmp_path / "m1.npz")
+    mpath = save_model(m, path, version="v7")
+    assert os.path.exists(path) and mpath == path + ".json"
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert manifest["version"] == "v7"
+    assert manifest["spec"]["slots"] == SPEC.slots
+    loaded = load_model(path)
+    assert loaded.spec == SPEC and loaded.version == "v7"
+    for k, a in m.arrays().items():
+        assert np.array_equal(a, getattr(loaded, k)), k
+
+
+def test_model_artifact_rejects_corruption(tmp_path):
+    m = default_model(SPEC)
+    path = str(tmp_path / "m2.npz")
+    save_model(m, path)
+    with open(path, "ab") as f:
+        f.write(b"x")  # checksum breaks
+    with pytest.raises(ValueError, match="checksum"):
+        load_model(path)
+    os.unlink(path + ".json")
+    with pytest.raises(ValueError, match="manifest"):
+        load_model(path)
+
+
+# --- the AnomalyTier ---------------------------------------------------------
+
+
+def test_tier_drain_exactly_once_and_records():
+    tier = AnomalyTier(SPEC, model=clamp_stress_model(SPEC),
+                       threshold=-(10 ** 6))
+
+    class Ring:
+        def __init__(self):
+            self.recs = []
+
+        def push(self, r):
+            self.recs.append(r)
+
+    ring = Ring()
+    tier.attach_ring(ring)
+    tables = _tables()
+    batch, wire, res = _traffic(tables, 31, b=32)
+    tier.update(wire, res, tflags_np=batch.tcp_flags)
+    recs = tier.drain(force=True)
+    assert len(recs) == 1 and recs[0].seq == 1
+    assert tier.drain_seq == 1
+    [t0] = [t for t in recs[0].tenants if t["tenant"] == 0]
+    assert t0["scored"] > 0 and t0["anom"] > 0 and not t0["enforce"]
+    assert recs[0].top, "anomalous sources must surface"
+    lines = recs[0].lines()
+    assert lines[0].startswith("anomaly-verdict seq=1")
+    assert any("anomalous-src" in ln for ln in lines)
+    assert ring.recs == recs
+    # window reset: tstat + anomhits clear, rates persist
+    cols = tier.columns()
+    assert cols["tstat"].sum() == 0
+    assert cols["scols"][:, 6].sum() == 0
+    assert cols["scols"][:, 0].sum() > 0
+    # drain again: seq advances, empty window
+    recs2 = tier.drain(force=True)
+    assert recs2[0].seq == 2 and not recs2[0].tenants
+
+
+def test_tier_policy_knobs_and_track_guard():
+    tier = AnomalyTier(SPEC)
+    tier.set_threshold(5, tenant=0)
+    tier.set_mode("enforce", tenant=0)
+    tp = tier.tparams()
+    assert tp[0, 0] == 5 and tp[0, 1] == 1
+    with pytest.raises(ValueError):
+        AnomalyTier(SPEC, mode="enforce", track_model=True)
+    t2 = AnomalyTier(SPEC, track_model=True)
+    with pytest.raises(ValueError):
+        t2.set_mode("enforce")
+    with pytest.raises(ValueError):
+        AnomalyTier(SPEC, mode="blocky")
+
+
+def test_tier_model_hot_swap_fires_hook():
+    tier = AnomalyTier(SPEC, model=default_model(SPEC))
+    fired = []
+    tier.on_swap = lambda: fired.append(1)
+    tier.swap_model(clamp_stress_model(SPEC), version="v2")
+    assert fired == [1]
+    assert tier.model_version == "v2"
+    assert tier.counter_values()["mlscore_model_swaps_total"] == 1
+    # geometry change is a rebuild, not a swap
+    other = default_model(ScoreSpec.make(slots=SPEC.slots * 2,
+                                         hidden=SPEC.hidden))
+    with pytest.raises(ValueError, match="geometry"):
+        tier.swap_model(other)
+
+
+def test_summarize_snapshot_orders_sources():
+    skeys = np.zeros((8, 6), np.uint32)
+    scols = np.zeros((8, 8), np.int32)
+    skeys[3] = [0, 0x01020304, 0, 0, 0, 1]
+    skeys[5] = [0, 0x05060708, 0, 0, 0, 1]
+    scols[3, 0], scols[3, 6] = 40, 9
+    scols[5, 0], scols[5, 6] = 10, 17
+    tstat = np.zeros((1, 4), np.int32)
+    tstat[0] = [64, 26, 0, 240]
+    rec = summarize_snapshot(ScoreSnapshot(
+        seq=4, admissions=12, skeys=skeys, scols=scols, tstat=tstat,
+        tparams=zero_tparams(ScoreSpec.make(max_tenants=1)),
+    ))
+    assert rec.top[0]["src"] == "5.6.7.8"  # most anomaly hits first
+    assert rec.top[0]["anom_hits"] == 17
+    assert rec.top[1]["src"] == "1.2.3.4"
+    assert rec.tenants[0]["max_score"] == 240
+
+
+# --- loadgen ground-truth labels (ISSUE-14 satellite) ------------------------
+
+
+def _loadgen():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import loadgen
+
+    return loadgen
+
+
+def test_loadgen_label_round_trip_and_determinism(capsys):
+    lg = _loadgen()
+    args = ["--rate", "100000", "--n", "4096", "--out", "/nonexistent",
+            "--attack", "portscan", "--file-packets", "512",
+            "--seed", "13", "--dry-run"]
+    assert lg.main(args) == 0
+    first = capsys.readouterr().out
+    assert lg.main(args) == 0
+    assert capsys.readouterr().out == first  # byte-deterministic
+    man = json.loads(first.splitlines()[0])
+    lab = man["labels"]
+    assert lab["onset_record"] == man["attack_start_packet"] // 512
+    assert len(lab["record_bitmaps_hex"]) == man["files"]
+    mask = lg.decode_attack_labels(
+        lab["record_bitmaps_hex"], man["n"], man["file_packets"]
+    )
+    assert int(mask.sum()) == man["attack_packets"]
+    assert not mask[: man["attack_start_packet"]].any()
+    assert lg.encode_attack_labels(mask, 512) == lab["record_bitmaps_hex"]
+    ids = lg.attack_lane_src_ids(mask, lab["attack_src_stride"])
+    assert (ids[mask] >= 0).all() and (ids[~mask] == -1).all()
+    assert lab["attack_src_stride"] == 1  # portscan is single-source
+
+
+def test_loadgen_ring_manifest_carries_labels(capsys):
+    lg = _loadgen()
+    assert lg.main(["--rate", "100000", "--n", "2048", "--ring", "/tmp/x",
+                    "--attack", "synflood", "--file-packets", "256",
+                    "--seed", "5", "--dry-run"]) == 0
+    man = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert man["mode"] == "ring" and "labels" in man
+    assert len(man["labels"]["record_bitmaps_hex"]) == man["records"]
+
+
+# --- daemon control plane ----------------------------------------------------
+
+
+def test_daemon_mlscore_flag_validation(tmp_path):
+    from infw.daemon import main as daemon_main
+
+    base = ["--state-dir", str(tmp_path), "--node-name", "n"]
+    with pytest.raises(SystemExit):
+        daemon_main(base + ["--backend", "cpu", "--mlscore"])
+    with pytest.raises(SystemExit):  # enforce without the tier
+        daemon_main(base + ["--backend", "tpu",
+                            "--mlscore-mode", "enforce"])
+    with pytest.raises(SystemExit):  # missing artifact fails the launch
+        daemon_main(base + ["--backend", "tpu", "--mlscore",
+                            str(tmp_path / "missing.npz")])
+    with pytest.raises(SystemExit):  # bad mode choice
+        daemon_main(base + ["--backend", "tpu", "--mlscore",
+                            "--mlscore-mode", "blocky"])
+
+
+def test_entrypoints_registered():
+    from infw.kernels import kernel_entrypoints
+
+    names = {e.name for e in kernel_entrypoints()}
+    assert "mlscore/score-update" in names
+    assert "classify-wire/resident-mlscore-fused" in names
+    by_name = {e.name: e for e in kernel_entrypoints()}
+    assert by_name["mlscore/score-update"].donate == (0,)
+    assert by_name["classify-wire/resident-mlscore-fused"].donate == (
+        0, 3, 4
+    )
+
+
+# --- classifier integration (jit-heavy: make test / mlscore-bench) -----------
+
+
+@pytest.mark.slow
+def test_cross_path_score_identity_shadow_and_enforce():
+    """The ISSUE-14 cross-path gate: fused-resident scoring vs the
+    multi-dispatch follow-on launch must produce bit-identical scores,
+    state and (in enforce mode) identical rewritten verdicts + flow
+    columns on the same trace."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+
+    tables = _tables(n=48)
+    model = clamp_stress_model(SPEC)
+    for mode, thr in (("shadow", None), ("enforce", -1000)):
+        clf_res = TpuClassifier(
+            force_path="trie", flow_table=FlowConfig.make(entries=1024),
+            resident=True, mlscore=SPEC, mlscore_model=model,
+            mlscore_mode=mode,
+        )
+        clf_mul = TpuClassifier(
+            force_path="trie", flow_table=FlowConfig.make(entries=1024),
+            mlscore=SPEC, mlscore_model=model, mlscore_mode=mode,
+        )
+        for c in (clf_res, clf_mul):
+            c.load_tables(tables)
+            c.mlscore.set_keep_masks(8)
+            if thr is not None:
+                c.mlscore.set_threshold(thr)
+        for i in range(4):
+            batch, _w, _r = _traffic(tables, 200 + i, b=64)
+            w, v4 = batch.pack_wire_subset(np.arange(64, dtype=np.int64))
+            o1 = clf_res.classify_prepared(
+                clf_res.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+                apply_stats=False,
+            ).result()
+            o2 = clf_mul.classify_prepared(
+                clf_mul.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+                apply_stats=False,
+            ).result()
+            assert np.array_equal(o1.results, o2.results), (mode, i)
+            assert np.array_equal(o1.xdp, o2.xdp), (mode, i)
+            assert np.array_equal(o1.stats_delta, o2.stats_delta), (
+                mode, i
+            )
+        c1, c2 = clf_res.mlscore.columns(), clf_mul.mlscore.columns()
+        for k in c1:
+            assert np.array_equal(c1[k], c2[k]), (mode, k)
+        # per-lane scores: the fused readback saturates at int16, the
+        # classic launch returns raw int32 — compare on the clamp
+        m1 = clf_res.mlscore.recent_masks()
+        m2 = clf_mul.mlscore.recent_masks()
+        assert len(m1) == len(m2) == 4
+        for (_e1, a1, s1), (_e2, a2, s2) in zip(m1, m2):
+            assert np.array_equal(a1, a2), mode
+            assert np.array_equal(s1, np.clip(s2, -32768, 32767)), mode
+        f1, f2 = clf_res.flow.flow_columns(), clf_mul.flow.flow_columns()
+        for k in f1:
+            assert np.array_equal(f1[k], f2[k]), (mode, k)
+        if mode == "enforce":
+            assert int(
+                clf_res.mlscore.counter_values()["mlscore_enforced_total"]
+            ) == 0  # counted at drain
+            rec = clf_res.mlscore.drain(force=True)[0]
+            assert any(t["enforced"] > 0 for t in rec.tenants)
+        clf_res.close()
+        clf_mul.close()
+
+
+@pytest.mark.slow
+def test_shadow_mode_verdicts_and_oracle():
+    """Shadow scoring must never perturb verdicts, XDP or stats vs the
+    scoring-off path and the CPU oracle (the bench gate's cheap twin),
+    while the tracked HostScoreModel matches the device tensors."""
+    from infw.backend.tpu import TpuClassifier
+
+    tables = _tables(n=48)
+    clf = TpuClassifier(force_path="trie", mlscore=SPEC,
+                        mlscore_model=clamp_stress_model(SPEC),
+                        mlscore_track_model=True)
+    off = TpuClassifier(force_path="trie")
+    clf.load_tables(tables)
+    off.load_tables(tables)
+    for i in range(3):
+        batch, _w, _r = _traffic(tables, 300 + i, b=64)
+        w, v4 = batch.pack_wire_subset(np.arange(64, dtype=np.int64))
+        o1 = clf.classify_prepared(
+            clf.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+            apply_stats=False,
+        ).result()
+        o2 = off.classify_prepared(
+            off.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+            apply_stats=False,
+        ).result()
+        ref = oracle.classify(tables, batch)
+        assert np.array_equal(o1.results, o2.results)
+        assert np.array_equal(o1.results, ref.results)
+        assert np.array_equal(o1.stats_delta, o2.stats_delta)
+    cols = clf.mlscore.columns()
+    for k, want in clf.mlscore.model.columns().items():
+        assert np.array_equal(cols[k], want), k
+    clf.close()
+    off.close()
+
+
+@pytest.mark.slow
+def test_model_swap_invalidates_flow_cache():
+    """A model hot-swap must behave like a rule patch: in enforce mode
+    the flow table caches enforced verdicts, and the swap's generation
+    bump makes them stale — the same lanes re-serve under the NEW
+    model's policy on the very next admission."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+
+    tables = _tables(n=48)
+    clf = TpuClassifier(
+        force_path="trie", flow_table=FlowConfig.make(entries=1024),
+        resident=True, mlscore=SPEC,
+        mlscore_model=clamp_stress_model(SPEC), mlscore_mode="enforce",
+    )
+    clf.load_tables(tables)
+    clf.mlscore.set_threshold(-1000)   # everything anomalous
+    batch, _w, _r = _traffic(tables, 41, b=64)
+    batch.tcp_flags = np.full(64, TCP_ACK, np.int32)
+    w, v4 = batch.pack_wire_subset(np.arange(64, dtype=np.int64))
+    o1 = clf.classify_prepared(
+        clf.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+        apply_stats=False,
+    ).result()
+    fs = failsafe_lane_mask_np(batch.proto, batch.dst_port)
+    elig = ((batch.kind == 1) | (batch.kind == 2)) & ~fs
+    denied = (o1.results & 0xFF) == DENY
+    assert denied[elig].all(), "enforce-all must deny eligible lanes"
+    gen0 = int(np.asarray(clf.flow._gens_host)[0])
+    # swap to a never-fires model and raise the threshold: the cached
+    # enforced denies must NOT survive the swap — a policy flip AND a
+    # model swap each bump the generation (both change what the tier
+    # would decide now)
+    clf.mlscore.set_threshold(10 ** 6)
+    clf.set_score_model(default_model(SPEC), version="calm")
+    assert int(np.asarray(clf.flow._gens_host)[0]) == gen0 + 2
+    o2 = clf.classify_prepared(
+        clf.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+        apply_stats=False,
+    ).result()
+    ref = oracle.classify(tables, batch)
+    assert np.array_equal(o2.results, ref.results), (
+        "post-swap verdicts must re-derive from the rules"
+    )
+    clf.close()
+
+
+@pytest.mark.slow
+def test_zero_recompile_warm_lifecycle():
+    """After the scheduler prewarm, serving with scoring on must never
+    compile: the fused score variants' and the classic launch's caches
+    stay exactly where the ladder left them (the resident-bench
+    discipline)."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.kernels import jaxpath
+    from infw.scheduler import prewarm_ladder
+
+    tables = _tables(n=48)
+    fcfg = FlowConfig.make(entries=1024)
+    clf = TpuClassifier(force_path="trie", flow_table=fcfg,
+                        resident=True, mlscore=SPEC,
+                        mlscore_model=default_model(SPEC))
+    clf.load_tables(tables)
+    prewarm_ladder(clf, (32, 64))
+    fn7 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False,
+        score=SPEC,
+    )
+    fn4 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", True, None, 0, False,
+        score=SPEC,
+    )
+    fnc = M.jitted_score_update(SPEC)
+    cache0 = fn7._cache_size() + fn4._cache_size() + fnc._cache_size()
+    allocs0 = clf.resident.steady_allocs()
+    for i in range(6):
+        batch, _w, _r = _traffic(tables, 500 + i, b=32 if i % 2 else 64)
+        w, v4 = batch.pack_wire_subset(
+            np.arange(len(batch), dtype=np.int64)
+        )
+        clf.classify_prepared(
+            clf.prepare_packed(w, v4, tcp_flags=batch.tcp_flags),
+            apply_stats=False,
+        ).result()
+    assert fn7._cache_size() + fn4._cache_size() + fnc._cache_size() \
+        == cache0
+    assert clf.resident.steady_allocs() == allocs0
+    clf.close()
+
+
+@pytest.mark.slow
+def test_daemon_models_dir_hot_swap(tmp_path):
+    """The <state-dir>/models/ hot-swap dir: a dropped npz+manifest
+    pair swaps the live model and is consumed; a corrupt artifact is
+    consumed, logged and the old model keeps serving."""
+    from infw.daemon import Daemon
+    from infw.interfaces import Interface, InterfaceRegistry
+
+    reg = InterfaceRegistry()
+    reg.add(Interface(name="dummy0", index=10))
+    spec = ScoreSpec.make()
+    d = Daemon(
+        state_dir=str(tmp_path / "state"), node_name="t",
+        backend="tpu", registry=reg, metrics_port=0, health_port=0,
+        file_poll_interval_s=0.02,
+        mlscore=(spec, default_model(spec)), mlscore_mode="shadow",
+    )
+    assert os.path.isdir(d.models_dir)
+    clf = d.syncer._factory()
+    d.syncer._classifier = clf  # the test_resident daemon idiom
+    assert clf.mlscore is not None
+    assert clf.mlscore.model_version == "default"
+    m2 = default_model(spec)._replace(version="hot-v2")
+    save_model(m2, os.path.join(d.models_dir, "m2.npz"))
+    d._mlscore_maintenance()
+    assert clf.mlscore.model_version == "hot-v2"
+    assert os.listdir(d.models_dir) == []  # consumed
+    # corrupt artifact: consumed, version unchanged
+    p = os.path.join(d.models_dir, "bad.npz")
+    save_model(m2._replace(version="bad"), p)
+    with open(p, "ab") as f:
+        f.write(b"junk")
+    d._mlscore_maintenance()
+    assert clf.mlscore.model_version == "hot-v2"
+    assert os.listdir(d.models_dir) == []
+    # a classifier REBUILD (escalation/re-place) constructs its tier
+    # from the factory's launch-time model — the consumed hot-swapped
+    # artifact must be re-applied, not silently reverted
+    clf2 = d.syncer._factory()
+    d.syncer._classifier = clf2
+    assert clf2.mlscore.model_version == "default"  # fresh from factory
+    d._mlscore_maintenance()
+    assert clf2.mlscore.model_version == "hot-v2"
+    d.stop()
+
+
+@pytest.mark.slow
+def test_statecheck_mlscore_configs_green():
+    from infw.analysis import statecheck
+
+    for cfg in ("mlscore", "mlscore-resident"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        assert rep["ok"], (cfg, rep.get("failure"))
+
+
+@pytest.mark.slow
+def test_statecheck_catches_mlquant_defect():
+    from infw.analysis import statecheck
+
+    M._INJECT_MLQUANT_BUG = True
+    M.jitted_score_update.cache_clear()
+    try:
+        rep = statecheck.run_config("mlscore", seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+    finally:
+        M._INJECT_MLQUANT_BUG = False
+        M.jitted_score_update.cache_clear()
+    assert not rep["ok"]
+    assert "mlscore-model" in rep["failure"]["phase"]

@@ -322,7 +322,11 @@ def _run_inject_defect(args, as_json: bool) -> int:
     to a <= 2-op (delete, readd) reproducer."""
     from infw import flow as flow_mod, resident as resident_mod, txn as txn_mod
     from infw.analysis import statecheck
-    from infw.kernels import jaxpath, sketch as sketch_mod
+    from infw.kernels import (
+        jaxpath,
+        mxu_score as mxu_score_mod,
+        sketch as sketch_mod,
+    )
 
     defect = args.inject_defect
     mod, flag, config, bound = {
@@ -359,6 +363,16 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # diverges and the shrinker reduces to (at most) one traffic op
         "sketchsat": (sketch_mod, "_INJECT_SKETCH_SAT_BUG",
                       "telemetry", 3),
+        # dropped MLP requantization clamp (infw.kernels.mxu_score):
+        # the DEVICE scoring kernels stop saturating the hidden layer
+        # at 127 (activations wrap through int8) while the host model
+        # keeps clamping — the mlscore config runs the clamp-stress
+        # model, so the very first scored admission's witness traffic
+        # pushes an activation past the clamp and the device-vs-model
+        # bit-identity pass diverges, shrinking to (at most) one
+        # traffic op
+        "mlquant": (mxu_score_mod, "_INJECT_MLQUANT_BUG",
+                    "mlscore", 3),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
@@ -538,7 +552,7 @@ def main(argv=None) -> int:
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
                                   "flowstale", "residentstale",
-                                  "sketchsat"),
+                                  "sketchsat", "mlquant"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

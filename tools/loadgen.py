@@ -85,6 +85,52 @@ def synth_columns(rng: np.random.Generator, n: int, v6_fraction: float,
     }, n_flows
 
 
+def encode_attack_labels(mask: np.ndarray, file_packets: int) -> list:
+    """Per-record ground-truth label bitmaps: the (n,) bool attack-lane
+    mask packed little-bit-first per record window and hex-encoded —
+    one string per dropped file / ring record, so a measuring consumer
+    scores precision/recall against EXACTLY the lanes the generator
+    overwrote (not just "packets from attacker IPs", which undercounts
+    when an attacker address collides with background traffic).
+    Byte-deterministic: same mask -> same strings."""
+    mask = np.asarray(mask, bool)
+    fp = max(int(file_packets), 1)
+    out = []
+    for lo in range(0, len(mask), fp):
+        out.append(np.packbits(
+            mask[lo : lo + fp], bitorder="little"
+        ).tobytes().hex())
+    return out
+
+
+def decode_attack_labels(hex_bitmaps: list, n: int,
+                         file_packets: int) -> np.ndarray:
+    """Inverse of encode_attack_labels -> the (n,) bool mask."""
+    fp = max(int(file_packets), 1)
+    mask = np.zeros(n, bool)
+    for i, h in enumerate(hex_bitmaps):
+        lo = i * fp
+        hi = min(lo + fp, n)
+        bits = np.unpackbits(
+            np.frombuffer(bytes.fromhex(h), np.uint8), bitorder="little"
+        )[: hi - lo]
+        mask[lo:hi] = bits.astype(bool)
+    return mask
+
+
+def attack_lane_src_ids(mask: np.ndarray, n_src: int) -> np.ndarray:
+    """(n,) int32 attacker id per lane (-1 = background): attack lanes
+    take source index (position in the attack sequence) % n_src — the
+    deterministic assignment inject_attack uses, exposed so consumers
+    can attribute each labeled lane to its attacker address without
+    re-running the generator."""
+    mask = np.asarray(mask, bool)
+    ids = np.full(len(mask), -1, np.int32)
+    idx = np.nonzero(mask)[0]
+    ids[idx] = (np.arange(len(idx)) % max(int(n_src), 1)).astype(np.int32)
+    return ids
+
+
 def inject_attack(rng: np.random.Generator, c: dict, n: int, mode: str,
                   attack_fraction: float, attack_start: float,
                   n_attackers: int, file_packets: int):
@@ -129,6 +175,18 @@ def inject_attack(rng: np.random.Generator, c: dict, n: int, mode: str,
             ".".join(str(b) for b in int(s[0]).to_bytes(4, "big"))
             for s in srcs
         ],
+        # per-RECORD ground-truth labels (ISSUE-14): honest
+        # precision/recall needs per-lane truth, not just attacker IPs
+        # — the onset record index, one hex bitmap of attack lanes per
+        # dropped file / ring record (decode_attack_labels), and the
+        # lane->attacker assignment stride (attack_lane_src_ids).
+        # Features must never read these (benchruns/README.md label
+        # discipline); they exist for the measuring consumer only.
+        "labels": {
+            "onset_record": int(start) // cp,
+            "attack_src_stride": int(n_src),
+            "record_bitmaps_hex": encode_attack_labels(mask, cp),
+        },
     }
     return flags, meta
 
