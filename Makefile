@@ -68,7 +68,11 @@ lint:
 #      verdict — device state, host model and cold rebuild all agree,
 #      so the catch must be oracle divergence on the flow-path witness,
 #      shrunk to a (flow_traffic, rules_edit) pair;
-#   4. the strict jax audit must FAIL on a deliberately injected
+#   4. --inject-defect cowleak makes the CoW arena's clone path forget
+#      the donor page's refcount decrement (jaxpath._INJECT_COWLEAK_
+#      BUG); check_arena's refcount-vs-page-table-rows invariant must
+#      catch it on the shared-then-edited-biased arena-cow config;
+#   5. the strict jax audit must FAIL on a deliberately injected
 #      implicit host->device transfer (and pass without it — the plain
 #      strict audit runs in entry-check/static-check).
 # Must be green before any bench record is published (benchruns/README).
@@ -78,6 +82,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cskip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cowleak
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect sketchsat

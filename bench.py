@@ -2324,6 +2324,152 @@ def bench_tenant(rng, on_tpu):
              n_tenants * table_b / 1e6, "MB", vs_baseline=0.0)
         out[f"footprint_ratio_{n_tenants}"] = float(ratio)
         clf.close()
+
+    # -- CoW redundancy ladder (ISSUE-15) -----------------------------------
+    # 1/10/100 distinct rulesets across N tenants: HBM bytes/tenant
+    # under content-addressed sharing vs unshared (one slab per tenant),
+    # create-from-shared-content latency vs a cold bake, and the CoW
+    # clone-then-patch latency vs the PR-10 full-rebake baseline —
+    # every A/B interleaved min-vs-min (benchruns rules).
+    n_cow = 10_000 if on_tpu else 2_500
+    ladder = (1, 10, 100)
+    cow_tabs = [
+        testing.random_tables_fast(
+            np.random.default_rng(12000 + i), n_entries=48, width=4,
+            v6_fraction=0.3, ifindexes=(2, 3),
+        )
+        for i in range(max(ladder))
+    ]
+    for distinct in ladder:
+        # the pool is sized to the rung's CONTENT capacity, not the
+        # tenant count — that is the lever: N tenants on D rulesets
+        # cost D slabs (+ spare pages for CoW headroom) + one
+        # page-table row each
+        spec = jaxpath.arena_spec_for(
+            "ctrie", cow_tabs[:distinct], pages=distinct + 4,
+            max_tenants=n_cow + 4, headroom=2.0,
+        )
+        pt_bytes = spec.max_tenants * 4
+        al = jaxpath.ArenaAllocator(spec)
+        t0 = time.perf_counter()
+        for t in range(n_cow):
+            al.load_tenant(t, cow_tabs[t % distinct])
+        create_s = time.perf_counter() - t0
+        pool_b = al.pool_bytes()
+        slab_b = (pool_b - pt_bytes) // spec.pages
+        shared_pt = pool_b / n_cow
+        unshared_pt = (n_cow * slab_b + pt_bytes) / n_cow
+        ratio = unshared_pt / max(shared_pt, 1e-9)
+        assert al.counters["slab_writes"] == distinct
+        log(f"cow ladder {distinct:3d}/{n_cow} distinct: "
+            f"{shared_pt:.0f} B/tenant shared vs {unshared_pt:.0f} B "
+            f"unshared ({ratio:.1f}x), {n_cow} creates in "
+            f"{create_s*1e3:.0f} ms")
+        emit(f"cow HBM bytes/tenant @{distinct} distinct of {n_cow}",
+             shared_pt, "B", vs_baseline=0.0)
+        emit(f"cow bytes/tenant reduction @{distinct} distinct", ratio,
+             "x", vs_baseline=0.0)
+        out[f"cow_bytes_ratio_{distinct}"] = float(ratio)
+        if distinct != max(ladder):
+            del al
+            continue
+
+        # create-from-shared-content vs cold bake (interleaved): the
+        # hash-hit create is a dict probe + page-table flip; the cold
+        # bake pays canonical build + full-slab fused scatter.  Cold
+        # tables are FRESH objects per rep (no memoized bake).
+        reps = 4
+        cold_tabs = [
+            testing.random_tables_fast(
+                np.random.default_rng(13000 + i), n_entries=48, width=4,
+                v6_fraction=0.3, ifindexes=(2, 3),
+            )
+            for i in range(reps)
+        ]
+        shared_s, cold_s = float("inf"), float("inf")
+        spare = n_cow
+        for i in range(reps):
+            t0 = time.perf_counter()
+            assert al.load_tenant(spare, cow_tabs[0]) == "share"
+            jax.block_until_ready(al.arena.page_table)
+            shared_s = min(shared_s, time.perf_counter() - t0)
+            al.destroy_tenant(spare)
+            t0 = time.perf_counter()
+            assert al.load_tenant(spare + 1, cold_tabs[i]) == "assign"
+            jax.block_until_ready(al.arena.nodes)
+            cold_s = min(cold_s, time.perf_counter() - t0)
+            al.destroy_tenant(spare + 1)
+        log(f"cow create-from-shared {shared_s*1e6:.0f} us vs cold bake "
+            f"{cold_s*1e3:.2f} ms ({cold_s/max(shared_s,1e-9):.0f}x)")
+        emit("cow create-from-shared-content", shared_s * 1e6, "us",
+             vs_baseline=0.0)
+        emit("cow cold slab bake", cold_s * 1e6, "us", vs_baseline=0.0)
+        out["cow_create_speedup"] = float(cold_s / max(shared_s, 1e-9))
+        del al
+
+    # -- CoW clone-then-patch vs the PR-10 re-upload baseline ----------------
+    # A PRODUCTION-SIZED slab (the swap-bench scale), small pool: the
+    # clone copies the donor's canonical mirror and patches the dirty
+    # rows — no table rebuild; the baseline recompiles + rebakes the
+    # edited snapshot from scratch (what every edit of a shared ruleset
+    # cost before content addressing).  Interleaved min-vs-min.
+    from infw.compiler import IncrementalTables as _IT
+
+    n_clone = 200_000 if on_tpu else 20_000
+    base_big = testing.clean_tables_fast(
+        np.random.default_rng(777), n_entries=n_clone, width=4
+    )
+    base_content = dict(base_big.content)
+    spec = jaxpath.arena_spec_for(
+        "ctrie", (base_big,), pages=6, max_tenants=8, headroom=1.5
+    )
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, base_big)
+    al.load_tenant(1, base_big)  # the shared baseline (refcount 2)
+    k_edit = sorted(
+        base_content, key=lambda k: (k.ingress_ifindex, k.ip_data)
+    )[0]
+    reps = 3
+    clone_s, rebake_s = float("inf"), float("inf")
+    for i in range(reps):
+        upd = _IT.from_content(dict(base_content), rule_width=4)
+        snap0 = upd.snapshot()
+        al.load_tenant(2, snap0)  # joins the shared baseline
+        upd.start_dirty_tracking()
+        r = np.asarray(base_content[k_edit]).copy()
+        r[1] = [1, 6, 1000 + i, 0, 0, 0, 2]
+        upd.apply({k_edit: r}, [])
+        hint = upd.peek_dirty()
+        snap1 = upd.snapshot()
+        assert al.tenant_shares_page(2)
+        t0 = time.perf_counter()
+        path = al.load_tenant(2, snap1, hint=hint)
+        jax.block_until_ready(al.arena.nodes)
+        clone_s = min(clone_s, time.perf_counter() - t0)
+        assert path == "cow", path
+        al.destroy_tenant(2)
+        # baseline: the same edited ruleset re-uploaded — canonical
+        # bake (cpoptrie build) + full-slab write from a FRESH snapshot
+        # object (no memoized layout), the PR-10 path for a full
+        # tenant-ruleset replacement; the updater compile stays off the
+        # clock on both sides
+        upd2 = _IT.from_content(dict(base_content), rule_width=4)
+        upd2.apply({k_edit: r}, [])
+        snap2 = upd2.snapshot()
+        t0 = time.perf_counter()
+        al.load_tenant(3, snap2)
+        jax.block_until_ready(al.arena.nodes)
+        rebake_s = min(rebake_s, time.perf_counter() - t0)
+        al.destroy_tenant(3)
+    log(f"cow clone-then-patch @{n_clone} entries {clone_s*1e3:.1f} ms "
+        f"vs PR-10 rebake {rebake_s*1e3:.1f} ms "
+        f"({rebake_s/max(clone_s,1e-9):.1f}x)")
+    emit(f"cow clone-then-patch @{n_clone} entries", clone_s * 1e3, "ms",
+         vs_baseline=0.0)
+    emit(f"cow edit full-rebake baseline @{n_clone} entries",
+         rebake_s * 1e3, "ms", vs_baseline=0.0)
+    out["cow_clone_speedup"] = float(rebake_s / max(clone_s, 1e-9))
+    del al
     return out
 
 
@@ -2335,9 +2481,10 @@ def tenant_bench_main() -> int:
     statecheck arena equivalence configs run FIRST and gate record
     publication, mirroring the churn-bench discipline."""
     speedup_min = float(os.environ.get("INFW_SWAP_SPEEDUP_MIN", "10.0"))
+    cow_ratio_min = float(os.environ.get("INFW_COW_BYTES_RATIO_MIN", "20.0"))
     from infw.analysis import statecheck
 
-    for cfg in ("arena", "arena-ctrie"):
+    for cfg in ("arena", "arena-ctrie", "arena-cow"):
         rep = statecheck.run_config(cfg, seed=0, n_ops=6,
                                     shrink_on_failure=False)
         if not rep["ok"]:
@@ -2354,6 +2501,11 @@ def tenant_bench_main() -> int:
     if not rec.get("swap_speedup", 0.0) >= speedup_min:
         log(f"tenant-bench FAIL: swap speedup "
             f"{rec.get('swap_speedup', 0):.1f}x < gate {speedup_min}x")
+        rc = 1
+    if not rec.get("cow_bytes_ratio_100", 0.0) >= cow_ratio_min:
+        log(f"tenant-bench FAIL: CoW bytes/tenant reduction "
+            f"{rec.get('cow_bytes_ratio_100', 0):.1f}x @100 distinct < "
+            f"gate {cow_ratio_min}x")
         rc = 1
     if rc == 0:
         log("tenant-bench OK: " + ", ".join(

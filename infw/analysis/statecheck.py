@@ -274,6 +274,14 @@ class StateConfig:
     #: an edit leaking across slabs diverges some OTHER tenant's lanes)
     arena: str = ""
     tenants: int = 3
+    #: arena configs: probability that a tenant_create / tenant_swap
+    #: op re-uses the CURRENT content of another live tenant instead of
+    #: fresh keys — the shared-then-edited bias of the CoW arena
+    #: (ISSUE-15): copies land as content-hash shares (refcount > 1)
+    #: and the per-tenant edits that follow exercise the clone-then-
+    #: patch path, the substrate of the cowleak injected-defect
+    #: acceptance
+    cow_bias: float = 0.0
     #: > 0 = stateful flow tier enabled with this many slab entries:
     #: the op alphabet extends with FLOW_KINDS, the classifier runs
     #: with flow_table + the shadow HostFlowModel, and every settled
@@ -358,6 +366,19 @@ CONFIGS: Dict[str, StateConfig] = {
                     force_path=None, witness_b=144),
         StateConfig("arena-ctrie", arena="ctrie", n_entries=36, width=4,
                     force_path="ctrie", witness_b=144),
+        # content-addressed CoW sharing (ISSUE-15): the same arena
+        # alphabet with the generator biased toward SHARED-then-edited
+        # tenants (tenant_create/tenant_swap frequently copy a live
+        # tenant's current content, so pages run at refcount > 1 and
+        # per-tenant edits exercise clone-then-patch), checked by the
+        # refcount/aliasing/hash-index invariants in check_arena plus
+        # the usual per-slab cold-rebuild + mixed-tenant oracle passes.
+        # The cowleak injected-defect acceptance (infw_lint state
+        # --inject-defect cowleak) runs this config under the
+        # forgotten-donor-decrement bug.
+        StateConfig("arena-cow", arena="ctrie", n_entries=24, width=4,
+                    force_path="ctrie", witness_b=144, tenants=2,
+                    cow_bias=0.6),
         # stateful flow tier (ISSUE-11): the FLOW_KINDS alphabet over
         # the edit state machine — flow hits must stay bit-identical to
         # the stateless path across inserts, evictions (the tiny table
@@ -1936,7 +1957,12 @@ def generate_arena_ops(
 ) -> List[EditOp]:
     """Seeded op sequence over the ARENA alphabet: per-tenant single-key
     ops plus the tenant lifecycle (create with fresh content, hot-swap
-    to fresh content — the page-flip path — and destroy)."""
+    to fresh content — the page-flip path — and destroy).  With
+    ``config.cow_bias`` > 0, creates/swaps copy a live tenant's CURRENT
+    content that often instead of sampling fresh keys — the shared-
+    then-edited distribution of the CoW arena configs (copies land as
+    content-hash shares; the edits that follow exercise clone-then-
+    patch and the refcount invariants)."""
     tenants = partition_tenants(base_content, config.tenants)
     key_rules = {t: dict(c) for t, c in tenants.items()}
     idents = {
@@ -1961,6 +1987,23 @@ def generate_arena_ops(
             items.append((k, _sample_rules(config, rng)))
         return tuple(items)
 
+    def sampled_content(live):
+        """cow_bias sample: a live tenant's current content, verbatim —
+        ops stay self-contained (concrete keys/rules), so shrunk
+        sequences replay identically."""
+        if not live or rng.random() >= config.cow_bias:
+            return None
+        donor = int(live[int(rng.integers(0, len(live)))])
+        items = tuple(
+            (k, np.asarray(r).copy())
+            for k, r in sorted(
+                key_rules[donor].items(),
+                key=lambda kv: (kv[0].ingress_ifindex, kv[0].prefix_len,
+                                kv[0].ip_data),
+            )
+        )
+        return items if items else None
+
     for _ in range(n_ops):
         kind = str(rng.choice(kinds, p=probs))
         live = sorted(key_rules)
@@ -1969,14 +2012,15 @@ def generate_arena_ops(
         if kind == "tenant_create":
             t = next_tid
             next_tid += 1
-            items = fresh_content(2, 6)
+            items = sampled_content(live) or fresh_content(2, 6)
             key_rules[t] = {k: r for k, r in items}
             idents[t] = {k.masked_identity() for k, _ in items}
             ops.append(EditOp(kind="tenant_create", tenant=t, items=items))
             continue
         t = int(live[int(rng.integers(0, len(live)))])
         if kind == "tenant_swap":
-            items = fresh_content(2, 6)
+            items = sampled_content([x for x in live if x != t])
+            items = items or fresh_content(2, 6)
             key_rules[t] = {k: r for k, r in items}
             idents[t] = {k.masked_identity() for k, _ in items}
             ops.append(EditOp(kind="tenant_swap", tenant=t, items=items))
@@ -2021,13 +2065,37 @@ def check_arena(alloc) -> List[str]:
     """Invariant contract over a live ArenaAllocator: the device pools
     must be bit-identical to the host mirrors (every mutation flows
     through both), the page table must agree with the host tenant map,
-    and the free/occupied page partition must be exact."""
+    the free/occupied page partition must be exact, and — under
+    content-addressed CoW sharing (ISSUE-15) — the refcount/aliasing
+    bookkeeping must balance:
+
+    - sum of page-table references per physical page == its refcount
+      (the invariant the injected cowleak defect violates);
+    - no free-list page is referenced by any page-table row;
+    - no zero-refcount page is referenced (and vice versa: a refcounted
+      page has at least one referencing row);
+    - stage holds are non-negative and held pages are never free;
+    - the hash index is consistent with the host mirrors: every indexed
+      page is live, not hash-dirty, and re-hashing its canonical slab
+      reproduces the registered key (index entries and their inverse
+      agree both ways)."""
     viols: List[str] = []
     with alloc._lock:
         dev = alloc._dev
         host = dict(alloc._host)
         tenant_page = dict(alloc._tenant_page)
         free = list(alloc._free)
+        page_refs = dict(alloc._page_refs)
+        page_holds = dict(alloc._page_holds)
+        hash_page = dict(alloc._hash_page)
+        page_hash = dict(alloc._page_hash)
+        hash_dirty = set(alloc._hash_dirty)
+        canon = {
+            p: (tuple(np.array(a, copy=True)
+                      for a in alloc._canonical_of_page(p)),
+                alloc._page_nnodes.get(p, 0))
+            for p in set(page_hash)
+        }
     for name, harr in host.items():
         darr = np.asarray(getattr(dev, name))
         if darr.shape != harr.shape or darr.dtype != harr.dtype:
@@ -2039,7 +2107,7 @@ def check_arena(alloc) -> List[str]:
         if not np.array_equal(darr, harr):
             rows = np.nonzero(
                 (darr.reshape(darr.shape[0], -1)
-                 != harr.reshape(darr.shape[0], -1)).any(axis=1)
+                 != harr.reshape(harr.shape[0], -1)).any(axis=1)
             )[0]
             viols.append(
                 f"{name}: {len(rows)} device row(s) diverge from the host "
@@ -2056,14 +2124,54 @@ def check_arena(alloc) -> List[str]:
     mapped = set(tenant_page.values())
     if mapped & set(free):
         viols.append(f"pages both free and mapped: {sorted(mapped & set(free))}")
-    if len(mapped) != len(tenant_page):
-        viols.append("two tenants share one page")
     live_rows = set(np.nonzero(pt >= 0)[0].tolist())
     if live_rows != set(tenant_page):
         viols.append(
             f"page_table rows {sorted(live_rows)} != tenant map "
             f"{sorted(tenant_page)}"
         )
+    # -- refcount / aliasing (CoW) -------------------------------------------
+    recount: Dict[int, int] = {}
+    for _t, p in tenant_page.items():
+        recount[p] = recount.get(p, 0) + 1
+    for p in sorted(set(recount) | set(page_refs)):
+        want = recount.get(p, 0)
+        got = page_refs.get(p, 0)
+        if want != got:
+            viols.append(
+                f"page {p}: refcount {got} != {want} page-table "
+                f"reference(s) (the cowleak invariant)"
+            )
+    for p in free:
+        if recount.get(p, 0):
+            viols.append(f"free page {p} is referenced by a page-table row")
+        if page_holds.get(p, 0):
+            viols.append(f"free page {p} carries a stage hold")
+    for p, h in page_holds.items():
+        if h < 0:
+            viols.append(f"page {p}: negative stage holds ({h})")
+    # -- hash index vs mirrors ------------------------------------------------
+    for h, p in hash_page.items():
+        if page_hash.get(p) != h:
+            viols.append(f"hash index -> page {p} but inverse disagrees")
+        if p in free:
+            viols.append(f"hash index maps content to FREE page {p}")
+        if p in hash_dirty:
+            viols.append(f"page {p} both indexed and hash-dirty")
+        got = canon.get(p)
+        if got is not None:
+            arrays, n_nodes = got
+            from ..kernels.jaxpath import slab_content_hash
+
+            real = slab_content_hash(arrays, n_nodes)
+            if real != h:
+                viols.append(
+                    f"page {p}: indexed content hash is stale (the host "
+                    f"mirror no longer hashes to the registered key)"
+                )
+    for p, h in page_hash.items():
+        if hash_page.get(h) != p:
+            viols.append(f"page {p} inverse-hash entry has no index row")
     return viols
 
 

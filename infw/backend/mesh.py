@@ -481,11 +481,31 @@ class MeshArenaClassifier:
                     hint=None) -> str:
         return self._alloc.load_tenant(tenant, tables, hint=hint)
 
+    def stage_tenant(self, tables: CompiledTables) -> int:
+        """Content-addressed staging (hash hit = an already-resident
+        shared page, no bake); lifecycle scatters broadcast replicated
+        exactly like the single-chip path."""
+        return self._alloc.stage(tables)
+
+    def activate_tenant(self, tenant: int, page: int,
+                        tables=None) -> None:
+        self._alloc.activate(tenant, page, tables)
+
     def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
         self._alloc.swap_tenant(tenant, tables)
 
     def destroy_tenant(self, tenant: int) -> None:
         self._alloc.destroy_tenant(tenant)
+
+    def compact(self) -> int:
+        return self._alloc.compact()
+
+    def dedup_sweep(self, limit=None) -> dict:
+        """Background content re-merge: page-table row flips broadcast
+        through the replicated scatter path — shared pages stay placed
+        by the SAME whole-slab partition rules as private ones (a
+        refcount is host bookkeeping; GSPMD never sees it)."""
+        return self._alloc.dedup_sweep(limit)
 
     def tenant_counters(self) -> dict:
         return self._alloc.counter_values()

@@ -1690,24 +1690,35 @@ class ArenaClassifier:
         had_page = self._alloc.page_of(tenant) is not None
         rules_only = had_page and jaxpath.hint_trie_unchanged(hint)
         if not self._fused_deep or rules_only:
-            # rules-only edits never touch the node pool, so the planes
-            # need no refresh ordering; without fused planes there is
-            # nothing to pair
-            path = self._alloc.load_tenant(tenant, tables, hint=hint)
+            # rules-only edits of a PRIVATE slab never touch the node
+            # pool, so the planes need no refresh ordering; a rules-only
+            # edit of a SHARED slab CoW-clones (a structural write of an
+            # unreachable fresh page), which the allocator covers by
+            # running pre_flip after the clone write and strictly before
+            # the page-table flip — the same new-planes/old-table
+            # pairing the swap path guarantees
+            path = self._alloc.load_tenant(
+                tenant, tables, hint=hint,
+                pre_flip=self._refresh_planes if self._fused_deep else None,
+            )
             self._after_mutation()
             self._flow_note(tenant)
             return path
         # fused planes live: a structural install must not let a
         # classify pair the NEW page table with stale planes — route
-        # through stage (free page bake) -> plane refresh -> flip, the
-        # same ordering the swap path guarantees
+        # through stage (free page bake, or a content-hash HIT on an
+        # already-resident page) -> plane refresh -> flip, the same
+        # ordering the swap path guarantees
         try:
             page = self._alloc.stage(tables)
         except jaxpath.ArenaCapacityError:
             # no free page for staging: in-place rewrite with an
             # immediate refresh — a narrow stale window only on a full
             # pool (keep >= 1 free page when serving the fused walk)
-            path = self._alloc.load_tenant(tenant, tables, hint=hint)
+            path = self._alloc.load_tenant(
+                tenant, tables, hint=hint,
+                pre_flip=self._refresh_planes,
+            )
             self._after_mutation()
             self._flow_note(tenant)
             return path
@@ -1782,6 +1793,19 @@ class ArenaClassifier:
                 self._flow.set_page(t, self._alloc.page_of(t))
             self._flow.bump_all_generations()
         return moved
+
+    def dedup_sweep(self, limit: Optional[int] = None) -> dict:
+        """Background content re-merge (the lazy half of the CoW
+        arena): re-hash stale pages and flip tenants whose slab content
+        re-converged onto one shared page.  Flips only — no slab
+        writes, so the fused planes need no refresh; moved tenants'
+        flow slabs re-steer and invalidate like any other page move."""
+        rep = self._alloc.dedup_sweep(limit)
+        if rep["moved"]:
+            for t in rep["moved"]:
+                self._flow_note(t)
+            self._after_mutation()
+        return rep
 
     def _after_mutation(self) -> None:
         if self._fused_deep:

@@ -504,6 +504,10 @@ class Daemon:
         self.flow_table = flow_table
         self._flow_attached: set = set()
         self._flow_age_last = 0.0
+        # CoW arena upkeep (ISSUE-15): the idle-loop dedup sweep
+        # re-hashes patched/cloned tenant slabs and re-merges pages
+        # whose content re-converged (flips only — compile-free)
+        self._tenant_dedup_last = 0.0
         # Zero-copy resident serving (--resident / INFW_RESIDENT,
         # ISSUE-12): the syncer's classifiers run the donated-buffer
         # fused serving loop; resident_* pool gauges export on /metrics.
@@ -1736,6 +1740,10 @@ class Daemon:
             except Exception as e:
                 log.error("tenant edit scan error: %s", e)
             try:
+                self._tenant_dedup_maintenance()
+            except Exception as e:
+                log.error("tenant dedup sweep error: %s", e)
+            try:
                 self.process_ring_once()
             except Exception as e:
                 log.error("ring ingest error: %s", e)
@@ -1791,6 +1799,28 @@ class Daemon:
                     age()
         if now - self._flow_age_last >= 5.0:
             self._flow_age_last = now
+
+    def _tenant_dedup_maintenance(self) -> None:
+        """Idle-loop CoW arena upkeep: every few seconds, re-hash
+        tenant slabs whose content hash went stale (in-place patches /
+        CoW clones) and re-merge pages that re-converged onto one
+        shared slab — page-table flips only, never a slab write, so
+        the sweep is serving-path-safe at any cadence.  Bounded per
+        pass (``limit``) so one sweep never monopolizes the idle loop
+        on a large pool."""
+        if self.tenant_registry is None:
+            return
+        now = time.monotonic()
+        if now - self._tenant_dedup_last < 5.0:
+            return
+        self._tenant_dedup_last = now
+        sweep = getattr(self.tenant_registry.classifier, "dedup_sweep", None)
+        if sweep is not None:
+            rep = sweep(limit=64)
+            if rep.get("merged"):
+                log.info("tenant dedup sweep: %d page(s) re-hashed, "
+                         "%d tenant row(s) re-merged",
+                         rep["hashed"], rep["merged"])
 
     def _telemetry_maintenance(self) -> None:
         """Idle-loop telemetry upkeep: attach the obs ring + drain

@@ -23,6 +23,7 @@ Two LPM strategies, selected by table size:
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
 import threading
 import time
@@ -2984,6 +2985,26 @@ def _inject_pageflip_bug() -> bool:
     return env not in ("", "0", "false", "no")
 
 
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_COWLEAK_BUG env var), the copy-on-write clone path of
+#: ArenaAllocator.load_tenant "forgets" the donor page's refcount
+#: decrement after flipping the editing tenant onto its private clone —
+#: the classic CoW leak (the donor page can never drop to zero and be
+#: reclaimed).  The statecheck acceptance gate (tools/infw_lint.py
+#: state --inject-defect cowleak, on the shared-then-edited-biased
+#: "arena-cow" config) proves check_arena's refcount/aliasing
+#: invariants catch it with a shrunk reproducer.  Never set in
+#: production.
+_INJECT_COWLEAK_BUG = False
+
+
+def _inject_cowleak_bug() -> bool:
+    if _INJECT_COWLEAK_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_COWLEAK_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
 class ArenaCapacityError(ValueError):
     """A tenant table does not fit the arena's slab geometry (entries,
     node rows, trie depth, rule width, lut span) or the pool is out of
@@ -3167,10 +3188,14 @@ def _dense_slab_arrays(spec: ArenaSpec, tables: CompiledTables):
     )
 
 
-def _ctrie_slab_arrays(spec: ArenaSpec, page: int, tables: CompiledTables):
-    """Full-slab host arrays for the ctrie family with the page's
-    GLOBAL offsets baked in: node ids += page*SN, target positions +=
-    page*ST, joined positions += page*SJ, root ids += page*R0.  Raises
+def _ctrie_canonical_slab(spec: ArenaSpec, tables: CompiledTables):
+    """Page-independent ("canonical") full-slab host arrays for the
+    ctrie family: slab-local indices, zero padding — the form the
+    content hash is computed over (identical rulesets bake to identical
+    bytes regardless of which physical page they land on).  Returns
+    (arrays, n_nodes); ``n_nodes`` is the real skip-node row count,
+    needed because node-row offsets apply unconditionally to real rows
+    (offsetting/un-offsetting is row-count-dependent).  Raises
     ArenaCapacityError when any per-slab bound is exceeded."""
     host = _ctrie_host_layout(tables)
     if host is None:
@@ -3211,34 +3236,86 @@ def _ctrie_slab_arrays(spec: ArenaSpec, page: int, tables: CompiledTables):
             f"root_lut spans {root_lut.shape[0]} ifindexes > slab bound "
             f"{spec.lut_rows}"
         )
+    l0b = np.zeros((spec.l0_rows, 2), np.int32)
+    l0b[: l0.shape[0]] = l0
+    nodesb = np.zeros((spec.node_rows, 20), np.uint32)
+    nodesb[: nodes.shape[0]] = nodes.astype(np.uint32)
+    tgtb = np.zeros(spec.target_rows, np.int32)
+    tgtb[: targets.shape[0]] = targets.astype(np.int32)
+    joinb = np.zeros((spec.joined_rows, joined.shape[1]), np.uint16)
+    joinb[: joined.shape[0]] = joined
+    lutb = np.zeros(spec.lut_rows, np.int32)
+    lutb[: root_lut.shape[0]] = root_lut.astype(np.int32)
+    return (l0b, nodesb, tgtb, joinb, lutb), int(nodes.shape[0])
+
+
+def _offset_ctrie_slab(spec: ArenaSpec, arrays, n_nodes: int, page: int):
+    """Canonical ctrie slab arrays -> the page's resident form: node
+    ids += page*SN, target positions += page*ST, joined positions +=
+    page*SJ, root ids += page*R0 (zero entries stay zero; real node
+    rows offset unconditionally — hence ``n_nodes``).  Never mutates
+    the canonical arrays."""
+    l0, nodes, targets, joined, root_lut = arrays
+    if page == 0:
+        return l0, nodes, targets, joined, root_lut
     nb = page * spec.node_rows
     tb = page * spec.target_rows
     jb = page * spec.joined_rows
     rb = page * spec.root_nodes
+    l0o = np.zeros_like(l0)
+    l0o[:, 0] = np.where(l0[:, 0] > 0, l0[:, 0] + nb, 0)
+    l0o[:, 1] = np.where(l0[:, 1] > 0, l0[:, 1] + jb, 0)
+    nodeso = nodes.copy()
+    nodeso[:n_nodes, 0] += np.uint32(nb)
+    nodeso[:n_nodes, 1] += np.uint32(tb)
+    tgto = np.where(targets > 0, targets + jb, 0).astype(np.int32)
+    luto = (root_lut.astype(np.int64) + rb).astype(np.int32)
+    return l0o, nodeso, tgto, joined, luto
 
-    l0b = np.zeros((spec.l0_rows, 2), np.int32)
-    src = l0.copy()
-    src[:, 0] = np.where(src[:, 0] > 0, src[:, 0] + nb, 0)
-    src[:, 1] = np.where(src[:, 1] > 0, src[:, 1] + jb, 0)
-    l0b[: src.shape[0]] = src
 
-    nodesb = np.zeros((spec.node_rows, 20), np.uint32)
-    nsrc = nodes.astype(np.uint32, copy=True)
-    nsrc[:, 0] += np.uint32(nb)
-    nsrc[:, 1] += np.uint32(tb)
-    nodesb[: nsrc.shape[0]] = nsrc
+def _unoffset_ctrie_slab(spec: ArenaSpec, arrays, n_nodes: int, page: int):
+    """Inverse of _offset_ctrie_slab: a page's resident slab rows back
+    to the canonical (page-independent) form — what the content hash
+    and the CoW clone read from the host mirror."""
+    l0, nodes, targets, joined, root_lut = arrays
+    if page == 0:
+        return l0, nodes, targets, joined, root_lut
+    nb = page * spec.node_rows
+    tb = page * spec.target_rows
+    jb = page * spec.joined_rows
+    rb = page * spec.root_nodes
+    l0c = np.zeros_like(l0)
+    l0c[:, 0] = np.where(l0[:, 0] > 0, l0[:, 0] - nb, 0)
+    l0c[:, 1] = np.where(l0[:, 1] > 0, l0[:, 1] - jb, 0)
+    nodesc = nodes.copy()
+    nodesc[:n_nodes, 0] -= np.uint32(nb)
+    nodesc[:n_nodes, 1] -= np.uint32(tb)
+    tgtc = np.where(targets > 0, targets - jb, 0).astype(np.int32)
+    lutc = (root_lut.astype(np.int64) - rb).astype(np.int32)
+    return l0c, nodesc, tgtc, joined, lutc
 
-    tgtb = np.zeros(spec.target_rows, np.int32)
-    tsrc = targets.astype(np.int32, copy=True)
-    tgtb[: tsrc.shape[0]] = np.where(tsrc > 0, tsrc + jb, 0)
 
-    joinb = np.zeros((spec.joined_rows, joined.shape[1]), np.uint16)
-    joinb[: joined.shape[0]] = joined
+def _ctrie_slab_arrays(spec: ArenaSpec, page: int, tables: CompiledTables):
+    """Full-slab host arrays for the ctrie family with the page's
+    GLOBAL offsets baked in (the canonical bake + the page offset pass).
+    Raises ArenaCapacityError when any per-slab bound is exceeded."""
+    arrays, n_nodes = _ctrie_canonical_slab(spec, tables)
+    return _offset_ctrie_slab(spec, arrays, n_nodes, page)
 
-    lutb = np.full(spec.lut_rows, rb, np.int32)
-    lutb[: root_lut.shape[0]] = root_lut.astype(np.int64) + rb
 
-    return l0b, nodesb, tgtb, joinb, lutb
+def slab_content_hash(arrays, n_nodes: int = 0) -> bytes:
+    """Canonical content hash of one baked slab: sha256 over the
+    page-independent slab arrays' bytes (shape/dtype-framed) plus the
+    real node-row count.  Hashing the BAKED arrays (not the spec) means
+    two rulesets that compile to the same forwarding state dedup even
+    when their specs differ cosmetically."""
+    h = hashlib.sha256()
+    h.update(str(int(n_nodes)).encode())
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(repr((a.shape, a.dtype.str)).encode())
+        h.update(a.tobytes())
+    return h.digest()
 
 
 # -- arena classify kernels --------------------------------------------------
@@ -3445,11 +3522,29 @@ class ArenaAllocator:
     warm arena performs zero jit compiles across tenant create / swap /
     patch / destroy (test-pinned by the recompile-lint suite).
 
-    Thread-safety: all mutating entry points take the internal lock;
-    ``arena`` snapshots the current device tuple (classify dispatches
-    finish on the tuple they captured — the double-buffer contract,
-    per-row granular here because a page-table flip only redirects
-    lanes of the flipped tenant)."""
+    Slabs are CONTENT-ADDRESSED and shared COPY-ON-WRITE (ISSUE-15):
+    a canonical sha256 over the baked (page-independent) slab arrays
+    maps identical rulesets to ONE physical page with refcounted
+    page-table rows — N tenants on the same baseline cost one slab,
+    and installing a ruleset whose content is already resident is a
+    page-table row flip (no bake, no device write).  A tenant EDIT on
+    a shared page triggers clone-then-patch: the donor's canonical
+    arrays copy host-side, the dirty rows patch the copy, and the
+    result lands in a free page through the warmed full-slab fused
+    scatter before the editing tenant's page-table row flips — the
+    donor's refcount decrements (free at zero) and every OTHER sharer
+    keeps serving the untouched donor slab, gap-free.  In-place
+    patches of a private (refcount-1) page stay O(dirty rows); they
+    mark the page's content hash stale, and a background
+    ``dedup_sweep`` re-hashes stale pages and re-merges pages whose
+    content re-converged.
+
+    Thread-safety: all mutating entry points take the internal lock
+    (re-entrant: the ``pre_flip`` plane-refresh callback reads
+    allocator state back); ``arena`` snapshots the current device
+    tuple (classify dispatches finish on the tuple they captured — the
+    double-buffer contract, per-row granular here because a page-table
+    flip only redirects lanes of the flipped tenant)."""
 
     def __init__(self, spec: ArenaSpec, device=None, shardings=None):
         """``device`` is a jax device OR a Sharding (scatter payloads
@@ -3461,7 +3556,7 @@ class ArenaAllocator:
         self.spec = spec
         self._device = device
         self._shardings = shardings or {}
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         P = spec.pages
         if spec.family == "dense":
             S = P * spec.entries
@@ -3497,9 +3592,29 @@ class ArenaAllocator:
         self._free = list(range(P))
         self._tenant_page: dict = {}
         self._tenant_tables: dict = {}
+        #: CoW bookkeeping -------------------------------------------------
+        #: page -> count of page-table rows referencing it (the tenant
+        #: references; the check_arena invariant is that this equals
+        #: the recount from _tenant_page at every boundary)
+        self._page_refs: dict = {}
+        #: page -> count of stage() reservations not yet activated /
+        #: released (holds keep a page alive independent of refs and
+        #: pin its page id against compaction/dedup moves)
+        self._page_holds: dict = {}
+        #: page -> real skip-node row count of the resident slab (what
+        #: makes page-offset stripping well-defined; persists across a
+        #: free so a standby claim-back stays canonicalizable)
+        self._page_nnodes: dict = {}
+        #: content hash -> page and inverse, for pages whose hash is
+        #: KNOWN-current; pages go _hash_dirty on in-place patch /
+        #: CoW clone / free-list claim-back and dedup_sweep re-indexes
+        self._hash_page: dict = {}
+        self._page_hash: dict = {}
+        self._hash_dirty: set = set()
         self.counters = {
             "assigns": 0, "patches": 0, "swaps": 0, "flips": 0,
             "destroys": 0, "compactions": 0, "slab_writes": 0,
+            "shared_hits": 0, "cow_clones": 0, "dedup_merges": 0,
         }
         #: bumps on every structural slab write — consumers that derive
         #: secondary layouts from the node pool (the paged Pallas walk's
@@ -3540,6 +3655,34 @@ class ArenaAllocator:
         with self._lock:
             return len(self._free)
 
+    def page_refcount(self, page: int) -> int:
+        """Page-table references on one physical page (0 for free /
+        hold-only pages)."""
+        with self._lock:
+            return self._page_refs.get(page, 0)
+
+    def page_holds(self, page: int) -> int:
+        with self._lock:
+            return self._page_holds.get(page, 0)
+
+    def tenant_shares_page(self, tenant: int) -> bool:
+        """True when the tenant's slab is shared (another tenant's
+        page-table row or a stage hold references the same physical
+        page) — the condition under which an edit must CoW instead of
+        patching in place."""
+        with self._lock:
+            page = self._tenant_page.get(tenant)
+            return page is not None and self._is_shared(page)
+
+    def distinct_slabs(self) -> int:
+        """Live physical pages (referenced or held) — the real HBM
+        occupancy denominator under sharing."""
+        with self._lock:
+            live = set(self._page_refs) | {
+                p for p, h in self._page_holds.items() if h > 0
+            }
+            return len(live)
+
     def pool_bytes(self) -> int:
         """Resident HBM footprint of the pools (the denominator of the
         arena-vs-N-tables bench line)."""
@@ -3574,9 +3717,18 @@ class ArenaAllocator:
         """tenant_* counters for /metrics (the obs satellite): gauges
         for slab occupancy plus monotonic mutation counts."""
         with self._lock:
+            live = set(self._page_refs) | {
+                p for p, h in self._page_holds.items() if h > 0
+            }
             out = {
                 "tenant_active_slabs": len(self._tenant_page),
                 "tenant_free_slabs": len(self._free),
+                "tenant_distinct_slabs": len(live),
+                "tenant_shared_pages": sum(
+                    1 for n in self._page_refs.values() if n > 1
+                ),
+                "tenant_hash_index": len(self._hash_page),
+                "tenant_hash_dirty": len(self._hash_dirty),
             }
             for k, v in self.counters.items():
                 out[f"tenant_{k}_total"] = v
@@ -3633,6 +3785,166 @@ class ArenaAllocator:
         return (s.l0_rows, s.node_rows, s.target_rows, s.joined_rows,
                 s.lut_rows)
 
+    def _array_names(self):
+        if self.spec.family == "dense":
+            return ("key_words", "mask_words", "mask_len", "rules")
+        return ("l0", "nodes", "targets", "joined", "root_lut")
+
+    # -- content addressing / CoW plumbing ------------------------------------
+
+    def _is_shared(self, page: int) -> bool:
+        """A page is shared when >1 page-table row references it OR a
+        stage hold reserves it — either way an in-place write would
+        mutate state some OTHER consumer is serving/holding, so edits
+        must copy-on-write."""
+        return (
+            self._page_refs.get(page, 0) > 1
+            or self._page_holds.get(page, 0) > 0
+        )
+
+    def _bake_canonical(self, tables: CompiledTables):
+        """(canonical arrays, n_nodes, content hash) for one tenant
+        table — the page-independent bake the hash index keys on.
+        Memoized on the tables object (same trick as the cpoptrie host
+        caches), so repeated installs of a known baseline pay the bake
+        and the hash ONCE and every later tenant-create-from-content
+        is a dict probe + page-table flip."""
+        cached = getattr(tables, "_arena_slab_cache", None)
+        if cached is not None and cached[0] == self.spec:
+            return cached[1], cached[2], cached[3]
+        if self.spec.family == "dense":
+            arrays = _dense_slab_arrays(self.spec, tables)
+            n_nodes = 0
+        else:
+            arrays, n_nodes = _ctrie_canonical_slab(self.spec, tables)
+        chash = slab_content_hash(arrays, n_nodes)
+        try:
+            object.__setattr__(
+                tables, "_arena_slab_cache",
+                (self.spec, arrays, n_nodes, chash),
+            )
+        except Exception:
+            pass
+        return arrays, n_nodes, chash
+
+    def _offset(self, arrays, n_nodes: int, page: int):
+        """Canonical slab arrays -> the page's resident form (identity
+        for the dense family: dense slabs carry no cross-row indices)."""
+        if self.spec.family == "dense":
+            return arrays
+        return _offset_ctrie_slab(self.spec, arrays, n_nodes, page)
+
+    def _canonical_of_page(self, page: int):
+        """Canonical (page-independent) arrays of one resident page,
+        derived from the host mirror by stripping the page offsets —
+        the CoW clone / compaction / dedup-rehash source.  Returns
+        mirror VIEWS for the dense family and page 0; callers that
+        mutate must copy."""
+        arrays = tuple(
+            self._host[name][page * r : (page + 1) * r]
+            for name, r in zip(self._array_names(), self._slab_rows())
+        )
+        if self.spec.family == "dense":
+            return arrays
+        return _unoffset_ctrie_slab(
+            self.spec, arrays, self._page_nnodes.get(page, 0), page
+        )
+
+    def _unindex(self, page: int) -> None:
+        """Drop a page's hash-index entry (and its inverse) if present."""
+        old = self._page_hash.pop(page, None)
+        if old is not None and self._hash_page.get(old) == page:
+            del self._hash_page[old]
+
+    def _index_page(self, page: int, chash: bytes) -> bool:
+        """Register a page's known-current content hash.  When another
+        live page already owns the hash, the index keeps pointing at it
+        and this page stays hash-dirty (dedup_sweep merges the
+        duplicates); returns whether the page was indexed."""
+        self._unindex(page)
+        self._hash_dirty.discard(page)
+        cur = self._hash_page.get(chash)
+        if cur is not None and cur != page:
+            self._hash_dirty.add(page)
+            return False
+        self._hash_page[chash] = page
+        self._page_hash[page] = chash
+        return True
+
+    def _mark_hash_dirty(self, page: int) -> None:
+        """The page's content diverged from its registered hash (an
+        in-place patch): unindex now, re-hash lazily in dedup_sweep —
+        keeping the patch fast path O(dirty rows), not O(slab hash)."""
+        self._unindex(page)
+        self._hash_dirty.add(page)
+
+    def _incref(self, page: int) -> None:
+        self._page_refs[page] = self._page_refs.get(page, 0) + 1
+
+    def _decref(self, page: int, from_clone: bool = False) -> None:
+        """Drop one page-table reference; the page frees at zero (with
+        no holds).  ``from_clone`` marks the CoW donor decrement — the
+        exact statement the injected cowleak defect forgets."""
+        if from_clone and _inject_cowleak_bug():
+            return
+        n = self._page_refs.get(page, 0) - 1
+        if n > 0:
+            self._page_refs[page] = n
+            return
+        self._page_refs.pop(page, None)
+        if self._page_holds.get(page, 0) == 0:
+            self._release_page(page)
+
+    def _release_page(self, page: int) -> None:
+        """Return a page to the free list: unindex its hash (a free
+        page must never be a dedup hit — _alloc_page may rebake it) but
+        keep the slab bytes/mirror/n_nodes, so the standby claim-back
+        pattern (activate straight off the free list) keeps serving
+        valid content."""
+        self._unindex(page)
+        self._hash_dirty.discard(page)
+        if page not in self._free:
+            self._free.append(page)
+
+    def _clone_patched_canonical(self, donor_page: int, old, new, hint):
+        """The CoW clone-then-patch bake: copy the DONOR page's
+        canonical arrays (no table recompile — the point of the clone)
+        and apply the rules-only dirty rows of ``new`` on the copy.
+        Returns (arrays, n_nodes) or None when the hinted patch cannot
+        express the edit (caller falls back to a full canonical bake)."""
+        dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+        dirty = dirty[(dirty >= 0) & (dirty < new.rules.shape[0])]
+        arrays = [np.array(a, copy=True)
+                  for a in self._canonical_of_page(donor_page)]
+        n_nodes = self._page_nnodes.get(donor_page, 0)
+        if self.spec.family == "dense":
+            kw, mw, ml, rules, _lv, _tg, _lut, _j = _host_device_layout(
+                new, pad=False, with_trie=False
+            )
+            if rules.dtype != np.uint16 or (
+                rules.shape[1] != self.spec.rule_slots * 5
+                or kw.shape[0] > self.spec.entries
+            ):
+                return None
+            rows = dirty[dirty < kw.shape[0]]
+            for arr, src in zip(arrays, (kw, mw, ml, rules)):
+                arr[rows] = src[rows]
+            return tuple(arrays), n_nodes
+        # ctrie: structure untouched by contract (rules-only hint) —
+        # only the joined plane's dirty tidx rows change
+        _seed_ctrie_caches_forward(old, new, dirty)
+        pr = _joined_tidx_patch_rows(new, dirty)
+        if pr is None:
+            return None
+        pos, rows = pr
+        if len(pos) and (
+            int(pos.max()) >= self.spec.joined_rows
+            or rows.shape[1] != arrays[3].shape[1]
+        ):
+            return None
+        arrays[3][pos] = rows
+        return tuple(arrays), n_nodes
+
     def _patch_arrays(self, dev):
         """The arrays a rules-only tenant edit scatters (the hint fast
         path): the dense group, or the ctrie joined plane."""
@@ -3640,16 +3952,14 @@ class ArenaAllocator:
             return (dev.key_words, dev.mask_words, dev.mask_len, dev.rules)
         return (dev.joined,)
 
-    def _write_slab(self, page: int, slab_arrays) -> None:
+    def _write_slab(self, page: int, slab_arrays, n_nodes: int = 0) -> None:
         """Bake one tenant's full slab into the pools: ONE fused
         txn_scatter across every family array (whole slab rows, so a
         reused page carries no stale bytes).  Mirrors update first —
-        they are the diff/bench/equivalence source of truth."""
-        names = (
-            ("key_words", "mask_words", "mask_len", "rules")
-            if self.spec.family == "dense"
-            else ("l0", "nodes", "targets", "joined", "root_lut")
-        )
+        they are the diff/bench/equivalence source of truth.
+        ``n_nodes`` records the slab's real skip-node row count (what
+        keeps the page's canonical form derivable from the mirror)."""
+        names = self._array_names()
         entries = []
         for name, rows, arr in zip(names, self._slab_rows(), slab_arrays):
             base = page * rows
@@ -3663,6 +3973,7 @@ class ArenaAllocator:
         if patched is None:  # pages >= 4 makes this unreachable
             raise ArenaCapacityError("slab write exceeded the scatter budget")
         self._dev = self._dev._replace(**dict(zip(names, patched)))
+        self._page_nnodes[page] = int(n_nodes)
         self.counters["slab_writes"] += 1
         self.node_gen += 1
         self._dirty_node_pages.add(page)
@@ -3688,18 +3999,16 @@ class ArenaAllocator:
         self._dev = self._dev._replace(page_table=pt)
         self.counters["flips"] += 1
 
-    def _bake(self, page: int, tables: CompiledTables):
-        if self.spec.family == "dense":
-            return _dense_slab_arrays(self.spec, tables)
-        return _ctrie_slab_arrays(self.spec, page, tables)
-
     # -- tenant lifecycle ----------------------------------------------------
 
     def _alloc_page(self) -> int:
         if not self._free:
             raise ArenaCapacityError(
                 f"arena out of pages ({self.spec.pages} total, "
-                f"{len(self._tenant_page)} tenants resident)"
+                f"{len(self._page_refs)} distinct slabs live for "
+                f"{len(self._tenant_page)} tenants; an edit of a SHARED "
+                "slab needs a free page to copy-on-write into — size the "
+                "pool with spare pages beyond the distinct-content count)"
             )
         return self._free.pop(0)
 
@@ -3710,36 +4019,137 @@ class ArenaAllocator:
             )
 
     def load_tenant(self, tenant: int, tables: CompiledTables,
-                    hint=None) -> str:
+                    hint=None, pre_flip=None) -> str:
         """Install/refresh one tenant's table.  Returns the device path
-        taken: "patch" (rules-only row scatter into the resident slab),
-        "rewrite" (in-place full slab bake — structural edit, no page
-        change), or "assign" (fresh page + page-table flip)."""
+        taken:
+
+        - "patch":   rules-only row scatter into the tenant's PRIVATE
+                     resident slab (refcount 1, no holds);
+        - "share":   the baked content is already resident on some page
+                     (hash hit) — refcount bump + page-table flip, no
+                     bake, no slab write;
+        - "cow":     the tenant's page is shared and the edit forced a
+                     private copy: clone-then-patch (or a full bake for
+                     structural edits) into a free page, flip, donor
+                     refcount decremented;
+        - "rewrite": in-place full slab bake of a private page
+                     (structural edit, no page change);
+        - "assign":  fresh page + page-table flip.
+
+        ``pre_flip`` (optional callable) runs after any slab write and
+        strictly BEFORE the page-table flip of paths that redirect the
+        tenant to a new page — the fused-walk classifier passes its
+        plane refresh here so classify never pairs a new page table
+        with stale planes (new-planes/old-table is the safe pairing)."""
         self._check_tenant(tenant)
         with self._lock:
             page = self._tenant_page.get(tenant)
             old = self._tenant_tables.get(tenant)
-            if page is not None and old is not None and hint is not None:
+            shared = page is not None and self._is_shared(page)
+            if (page is not None and not shared and old is not None
+                    and hint is not None):
                 if self._try_patch(tenant, page, old, tables, hint):
                     self._tenant_tables[tenant] = tables
                     self.counters["patches"] += 1
+                    # content diverged from the registered hash; the
+                    # dedup sweep re-hashes lazily
+                    self._mark_hash_dirty(page)
                     return "patch"
-            if page is not None:
-                self._write_slab(page, self._bake(page, tables))
+            if shared and old is not None and hint_trie_unchanged(hint):
+                # CoW clone-then-patch: bake-free (donor canonical copy
+                # + dirty rows) — skips the hash-index probe on purpose
+                # (hashing would force the full bake the clone avoids;
+                # re-convergence is dedup_sweep's job)
+                can = self._clone_patched_canonical(page, old, tables, hint)
+                if can is not None:
+                    return self._cow_install(
+                        tenant, page, can[0], can[1], None, tables,
+                        pre_flip,
+                    )
+            arrays, n_nodes, chash = self._bake_canonical(tables)
+            hit = self._hash_page.get(chash)
+            if hit is not None:
+                if hit == page:
+                    # content unchanged (or a no-op edit): nothing to do
+                    self._tenant_tables[tenant] = tables
+                    return "share"
+                self._tenant_page[tenant] = hit
+                self._incref(hit)
+                self._tenant_tables[tenant] = tables
+                if pre_flip is not None:
+                    pre_flip()
+                self._flip(tenant, hit)
+                if page is not None:
+                    self._decref(page)
+                self.counters["shared_hits"] += 1
+                return "share"
+            if page is None:
+                new_page = self._alloc_page()
+                try:
+                    self._write_slab(
+                        new_page, self._offset(arrays, n_nodes, new_page),
+                        n_nodes=n_nodes,
+                    )
+                except Exception:
+                    self._free.insert(0, new_page)  # never leak the page
+                    raise
+                self._index_page(new_page, chash)
+                self._tenant_page[tenant] = new_page
+                self._page_refs[new_page] = 1
+                self._tenant_tables[tenant] = tables
+                if pre_flip is not None:
+                    pre_flip()
+                self._flip(tenant, new_page)
+                self.counters["assigns"] += 1
+                return "assign"
+            if not shared:
+                self._write_slab(
+                    page, self._offset(arrays, n_nodes, page),
+                    n_nodes=n_nodes,
+                )
+                self._index_page(page, chash)
                 self._tenant_tables[tenant] = tables
                 self.counters["assigns"] += 1
                 return "rewrite"
-            page = self._alloc_page()
-            try:
-                self._write_slab(page, self._bake(page, tables))
-            except Exception:
-                self._free.insert(0, page)  # never leak the page
-                raise
-            self._tenant_page[tenant] = page
-            self._tenant_tables[tenant] = tables
-            self._flip(tenant, page)
-            self.counters["assigns"] += 1
-            return "assign"
+            # shared page + structural edit: full bake into a private
+            # page (the CoW slow path)
+            return self._cow_install(
+                tenant, page, arrays, n_nodes, chash, tables, pre_flip,
+            )
+
+    def _cow_install(self, tenant, donor, arrays, n_nodes, chash,
+                     tables, pre_flip) -> str:
+        """The CoW landing sequence: write the private copy into a free
+        page (ONE warmed full-slab fused scatter — the clone and the
+        patch land together), refresh planes (pre_flip), flip the
+        editing tenant's page-table row, and only then decrement the
+        donor's refcount — every other sharer serves the untouched
+        donor slab throughout (no serving gap)."""
+        new_page = self._alloc_page()
+        try:
+            self._write_slab(
+                new_page, self._offset(arrays, n_nodes, new_page),
+                n_nodes=n_nodes,
+            )
+        except Exception:
+            self._free.insert(0, new_page)
+            raise
+        if chash is not None:
+            self._index_page(new_page, chash)
+        else:
+            # clone-then-patch: content hash unknown (computing it
+            # would cost the O(slab) pass the clone skipped) — the
+            # dedup sweep re-hashes in the background
+            self._hash_dirty.add(new_page)
+        self._tenant_page[tenant] = new_page
+        self._page_refs[new_page] = 1
+        self._tenant_tables[tenant] = tables
+        if pre_flip is not None:
+            pre_flip()
+        self._flip(tenant, new_page)
+        self._decref(donor, from_clone=True)
+        self.counters["cow_clones"] += 1
+        return "cow"
 
     def _try_patch(self, tenant, page, old, new, hint) -> bool:
         """Rules-only per-slab patch (the Map.Update analogue inside
@@ -3800,55 +4210,82 @@ class ArenaAllocator:
         return True
 
     def stage(self, tables: CompiledTables) -> int:
-        """Bake a table into a FREE page without activating it — the
-        pre-warm half of a hot swap.  Returns the staged page id
-        (reserved until activate/release)."""
+        """Content-addressed staging: hash the canonical bake and, on
+        an index HIT, reserve the ALREADY-RESIDENT page (a hold — no
+        bake, no device write; N stages of the same baseline cost one
+        slab).  On a miss, bake into a free page and index it.  Returns
+        the staged page id (reserved until activate/release)."""
         with self._lock:
+            arrays, n_nodes, chash = self._bake_canonical(tables)
+            hit = self._hash_page.get(chash)
+            if hit is not None:
+                self._page_holds[hit] = self._page_holds.get(hit, 0) + 1
+                self.counters["shared_hits"] += 1
+                return hit
             page = self._alloc_page()
             try:
-                self._write_slab(page, self._bake(page, tables))
+                self._write_slab(
+                    page, self._offset(arrays, n_nodes, page),
+                    n_nodes=n_nodes,
+                )
             except Exception:
                 self._free.insert(0, page)
                 raise
+            self._index_page(page, chash)
+            self._page_holds[page] = self._page_holds.get(page, 0) + 1
             return page
 
     def release(self, page: int) -> None:
-        """Return a staged-but-never-activated page to the free list."""
+        """Drop one staged-but-never-activated reservation; the page
+        frees when no references and no other holds remain."""
         with self._lock:
-            if page not in self._free and page not in self._tenant_page.values():
-                self._free.append(page)
+            h = self._page_holds.get(page, 0)
+            if h <= 0:
+                return
+            if h == 1:
+                self._page_holds.pop(page, None)
+            else:
+                self._page_holds[page] = h - 1
+            if (
+                self._page_refs.get(page, 0) == 0
+                and self._page_holds.get(page, 0) == 0
+            ):
+                self._release_page(page)
 
     def activate(self, tenant: int, page: int,
                  tables: Optional[CompiledTables] = None) -> None:
-        """Hot-swap: flip the tenant's page-table row to a staged page
-        (O(1) scatter) and free the previous slab.  THE measured swap
-        path of bench_tenant."""
+        """Hot-swap: flip the tenant's page-table row to a staged (or
+        shared) page — O(1) scatter — bump its refcount, and decrement
+        the previous slab's.  THE measured swap path of bench_tenant.
+        Activating a page live for ANOTHER tenant is sharing, not an
+        error: both tenants' rows reference one refcounted slab."""
         self._check_tenant(tenant)
         with self._lock:
-            owner = next(
-                (t for t, p in self._tenant_page.items()
-                 if p == page and t != tenant), None,
-            )
-            if owner is not None:
-                raise ArenaCapacityError(
-                    f"page {page} is live for tenant {owner}"
-                )
             # a re-activated page may sit on the free list (the
-            # ping-pong standby pattern frees the previous page on each
-            # flip): claim it back so no page is ever both free and
-            # mapped (the check_arena invariant)
+            # ping-pong standby pattern drops the previous page to
+            # refcount 0 on each flip): claim it back — the slab bytes
+            # persisted — and mark it for a dedup re-hash
             if page in self._free:
                 self._free.remove(page)
+                self._hash_dirty.add(page)
+            h = self._page_holds.get(page, 0)
+            if h:  # consume one stage reservation
+                if h == 1:
+                    self._page_holds.pop(page, None)
+                else:
+                    self._page_holds[page] = h - 1
             old_page = self._tenant_page.get(tenant)
             self._tenant_page[tenant] = page
             if tables is not None:
                 self._tenant_tables[tenant] = tables
             else:
                 # the previous table no longer describes the slab now
-                # serving; a stale record would let compact() rebake the
-                # PRE-swap ruleset — drop it (compaction then leaves
-                # this tenant in place until the next recorded load)
+                # serving; a stale record would let a later CoW patch
+                # apply against the PRE-swap ruleset — drop it (the
+                # canonical mirror keeps the page movable regardless)
                 self._tenant_tables.pop(tenant, None)
+            if old_page != page:
+                self._incref(page)
             # the injected pageflip defect fires ONLY on the swap of an
             # already-resident tenant — the exact transition the
             # statecheck acceptance gate must prove is covered
@@ -3856,11 +4293,8 @@ class ArenaAllocator:
                 tenant, page,
                 _inject=_inject_pageflip_bug() and old_page is not None,
             )
-            if (
-                old_page is not None and old_page != page
-                and old_page not in self._free
-            ):
-                self._free.append(old_page)
+            if old_page is not None and old_page != page:
+                self._decref(old_page)
             self.counters["swaps"] += 1
 
     def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
@@ -3869,45 +4303,130 @@ class ArenaAllocator:
         self.activate(tenant, page, tables)
 
     def destroy_tenant(self, tenant: int) -> None:
+        """Flip the tenant's row to -1 and drop its reference: a page
+        SHARED with other tenants survives (they keep serving it); a
+        private page frees."""
         self._check_tenant(tenant)
         with self._lock:
             page = self._tenant_page.pop(tenant, None)
             self._tenant_tables.pop(tenant, None)
             self._flip(tenant, -1)
             if page is not None:
-                self._free.append(page)
+                self._decref(page)
             self.counters["destroys"] += 1
 
     def compact(self) -> int:
-        """Repack live slabs into the lowest-numbered pages (slab
-        rewrite + flip per moved tenant) so a long create/destroy churn
-        leaves the occupied region contiguous.  Returns tenants moved."""
+        """Repack live slabs into the lowest-numbered pages so a long
+        create/destroy churn leaves the occupied region contiguous.
+        Each move rebakes the page from its CANONICAL mirror (no tenant
+        tables needed — shared and tables-less pages move too), then
+        flips EVERY sharer's page-table row; the donor page is
+        reclaimed only after the last row has flipped, so there is no
+        serving gap (rows flip one warmed scatter at a time, but both
+        pages hold identical content throughout the window).  Staged
+        pages (live holds) are pinned: their page id is a reservation
+        some caller will activate.  Returns tenant rows moved."""
         moved = 0
         with self._lock:
-            # only tenants with a recorded table can move (a tables-less
-            # activate dropped its record — the slab cannot be rebaked)
-            order = sorted(
-                ((t, p) for t, p in self._tenant_page.items()
-                 if t in self._tenant_tables),
-                key=lambda kv: kv[1],
-            )
-            all_pages = sorted(
-                self._free + [p for _t, p in order]
-            )
-            for (tenant, page), target in zip(order, all_pages):
-                if target == page:
-                    continue
-                tables = self._tenant_tables[tenant]
-                self._free.remove(target)
-                self._write_slab(target, self._bake(target, tables))
-                self._tenant_page[tenant] = target
-                self._flip(tenant, target)
-                self._free.append(page)
-                moved += 1
+            while True:
+                live = sorted(
+                    p for p in self._page_refs
+                    if self._page_holds.get(p, 0) == 0
+                )
+                src = tgt = None
+                for p in reversed(live):
+                    lower = [f for f in self._free if f < p]
+                    if lower:
+                        src, tgt = p, min(lower)
+                        break
+                if src is None:
+                    break
+                arrays = tuple(
+                    np.array(a, copy=True)
+                    for a in self._canonical_of_page(src)
+                )
+                n_nodes = self._page_nnodes.get(src, 0)
+                self._free.remove(tgt)
+                self._write_slab(
+                    tgt, self._offset(arrays, n_nodes, tgt),
+                    n_nodes=n_nodes,
+                )
+                # transfer refcount + hash-index identity to the new
+                # page BEFORE the flips (bookkeeping must never lag the
+                # device rows)
+                self._page_refs[tgt] = self._page_refs.pop(src)
+                chash = self._page_hash.pop(src, None)
+                if chash is not None and self._hash_page.get(chash) == src:
+                    self._hash_page[chash] = tgt
+                    self._page_hash[tgt] = chash
+                elif src in self._hash_dirty:
+                    self._hash_dirty.discard(src)
+                    self._hash_dirty.add(tgt)
+                sharers = sorted(
+                    t for t, p in self._tenant_page.items() if p == src
+                )
+                for t in sharers:
+                    self._tenant_page[t] = tgt
+                    self._flip(t, tgt)
+                    moved += 1
+                # every sharer's row has flipped; only now reclaim
+                if src not in self._free:
+                    self._free.append(src)
             self._free.sort()
             if moved:
                 self.counters["compactions"] += 1
         return moved
+
+    def dedup_sweep(self, limit: Optional[int] = None) -> dict:
+        """Background re-merge (the lazy half of content addressing):
+        re-hash pages whose content hash went stale (in-place patch,
+        CoW clone, free-list claim-back), re-index them, and MERGE
+        pages whose content re-converged with an already-indexed page —
+        every tenant of the duplicate flips onto the canonical page
+        (warmed 1-row scatters, old slab serves until its row flips),
+        then the duplicate frees.  Staged pages re-index but never
+        merge away (their page id is a live reservation).  Compile-free
+        by construction.  Returns {"hashed", "merged", "moved"} —
+        ``moved`` lists tenant ids whose physical page changed, so the
+        classifier wrapper can re-steer flow slabs."""
+        hashed = 0
+        moved: list = []
+        with self._lock:
+            dirty = sorted(self._hash_dirty)
+            if limit is not None:
+                dirty = dirty[: max(int(limit), 0)]
+            for page in dirty:
+                if (
+                    self._page_refs.get(page, 0) == 0
+                    and self._page_holds.get(page, 0) == 0
+                ):
+                    self._hash_dirty.discard(page)
+                    continue
+                chash = slab_content_hash(
+                    self._canonical_of_page(page),
+                    self._page_nnodes.get(page, 0),
+                )
+                hashed += 1
+                cur = self._hash_page.get(chash)
+                if cur is None or cur == page:
+                    self._index_page(page, chash)
+                    continue
+                if self._page_holds.get(page, 0):
+                    self._hash_dirty.discard(page)
+                    continue
+                sharers = sorted(
+                    t for t, p in self._tenant_page.items() if p == page
+                )
+                for t in sharers:
+                    self._tenant_page[t] = cur
+                    self._incref(cur)
+                    self._flip(t, cur)
+                    self._decref(page)
+                    moved.append(t)
+                self._hash_dirty.discard(page)
+                if sharers:
+                    self.counters["dedup_merges"] += 1
+        return {"hashed": hashed, "merged": len(moved), "moved": moved}
 
 
 # === stateful flow tier (device-resident connection tracking) ================

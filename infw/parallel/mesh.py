@@ -690,6 +690,15 @@ def classify_on_mesh(
 # pool placement engages GSPMD under the SAME jitted classify
 # factories the single chip uses (jaxpath.jitted_classify_arena_wire_
 # fused), with the wire/tenant operands sharded over "data".
+#
+# Content-addressed CoW sharing (ISSUE-15) composes with these rules
+# for free: a SHARED page is still exactly one whole-slab block on one
+# "rules" shard — refcounts and the hash index are host bookkeeping
+# GSPMD never sees, sharing flips are the same replicated 1-row
+# page-table scatter as a private swap, and a CoW clone lands through
+# the same replicated full-slab write as a bake.  Nothing here is
+# per-tenant, so 100K page-table rows referencing 100 slabs place
+# identically to 100 rows referencing 100 slabs.
 
 ARENA_PARTITION_RULES = {
     "dense": {

@@ -1088,6 +1088,16 @@ class TenantRegistry:
         self._op_lock = threading.RLock()
         self._names: Dict[str, int] = {}
         self._updaters: Dict[int, IncrementalTables] = {}
+        #: per-tenant shared-delta overlay content (ISSUE-15): small
+        #: deltas of a tenant sitting on a SHARED (content-addressed)
+        #: page ride the dense overlay side-pool instead of forcing a
+        #: CoW clone — only brand-new prefixes (and edits/deletes of
+        #: overlay-resident ones) are overlay-eligible, because the
+        #: longest-prefix combine is strict (an overlay entry with the
+        #: same prefix as a main-slab entry would lose the tie).  Any
+        #: non-eligible edit folds the overlay back into the main
+        #: updater and lands as the clone it was deferring.
+        self._overlays: Dict[int, Dict[LpmKey, np.ndarray]] = {}
         #: creates in flight: name -> reserved id.  The name/id become
         #: visible in _names/_updaters only once the compile + slab
         #: load SUCCEEDS, so concurrent edits on a half-created tenant
@@ -1182,12 +1192,36 @@ class TenantRegistry:
     def update_tenant(self, name: str,
                       ups: Dict[LpmKey, np.ndarray], dels) -> str:
         """Incremental per-tenant edit: one updater apply + one per-slab
-        device patch (dirty-hinted).  Escalates to a rebuild exactly
-        like the single-tenant syncer (CompileError / capacity)."""
+        device patch (dirty-hinted).  When the tenant sits on a SHARED
+        content-addressed page and the delta is overlay-eligible (only
+        brand-new prefixes added, or overlay-resident ones edited/
+        deleted), the delta rides the dense overlay side-pool and the
+        shared slab stays untouched — no CoW clone (returns "overlay").
+        Otherwise the edit lands in the main slab: the allocator
+        patches a private page in place or CoW-clones a shared one, and
+        any deferred overlay content folds back in first.  Escalates to
+        a rebuild exactly like the single-tenant syncer (CompileError /
+        capacity)."""
         with self._op_lock:
             tid = self.tenant_id(name)
             with self._lock:
                 upd = self._updaters[tid]
+            if self._try_overlay_delta(tid, upd, ups, dels):
+                return "overlay"
+            merge_ov = self._overlays.get(tid)
+            if merge_ov:
+                # the deferred shared-page delta folds back into the
+                # main updater before the edit that forced the clone
+                # (the dict clears only after the load succeeds).
+                # Overlay keys THIS edit deletes must not fold back in:
+                # apply() runs deletes before upserts, so a folded-in
+                # copy would resurrect the key the caller just removed
+                del_idents = {k.masked_identity() for k in dels}
+                ups = {
+                    **{k: v for k, v in merge_ov.items()
+                       if k.masked_identity() not in del_idents},
+                    **dict(ups),
+                }
             try:
                 if ups and not upd.fits(ups):
                     raise CompileError("trie depth exceeded; rebuild")
@@ -1206,7 +1240,66 @@ class TenantRegistry:
             snap = upd.snapshot()
             path = self._clf.load_tenant(tid, snap, hint=hint)
             upd.clear_dirty()
+            if merge_ov:
+                self._clear_overlay(tid)
             return path
+
+    def _try_overlay_delta(self, tid: int, upd, ups, dels) -> bool:
+        """Route a small delta of a shared-page tenant into the dense
+        overlay side-pool.  Eligible iff the classifier HAS an overlay
+        pool, the tenant's main page is shared (a main-slab write would
+        CoW-clone), every delete targets an overlay-resident identity,
+        and every upsert is either overlay-resident or a brand-new
+        identity (same-prefix-as-main entries would lose the strict
+        longest-prefix tie and must clone instead).  Commits the
+        overlay dict only after the device load succeeds; an overlay
+        capacity overflow falls back to the clone path."""
+        ov_alloc = getattr(self._clf, "overlay_allocator", None)
+        if ov_alloc is None:
+            return False
+        alloc = getattr(self._clf, "allocator", None)
+        if alloc is None or not alloc.tenant_shares_page(tid):
+            return False
+        ov = self._overlays.get(tid, {})
+        ov_idents = {k.masked_identity(): k for k in ov}
+        base_idents = set(upd._ident_to_t)
+        for k in dels:
+            if k.masked_identity() not in ov_idents:
+                return False
+        for k in ups:
+            ident = k.masked_identity()
+            if ident in base_idents and ident not in ov_idents:
+                return False
+        new_ov = dict(ov)
+        for k in dels:
+            new_ov.pop(ov_idents[k.masked_identity()], None)
+        for k, r in ups.items():
+            old_k = ov_idents.get(k.masked_identity())
+            if old_k is not None and old_k != k:
+                new_ov.pop(old_k, None)
+            new_ov[k] = np.asarray(r)
+        try:
+            if new_ov:
+                ct = compile_tables_from_content(
+                    new_ov, rule_width=self._rule_width
+                )
+                self._clf.load_tenant_overlay(tid, ct)
+            else:
+                self._clf.load_tenant_overlay(tid, None)
+        except Exception:
+            # overlay slab bound exceeded (or the side-pool is full):
+            # the caller folds everything into the main slab instead
+            return False
+        self._overlays[tid] = new_ov
+        return True
+
+    def _clear_overlay(self, tid: int) -> None:
+        self._overlays.pop(tid, None)
+        if getattr(self._clf, "overlay_allocator", None) is not None:
+            try:
+                self._clf.load_tenant_overlay(tid, None)
+            except Exception:
+                pass
 
     def apply_edit_transaction(self, name: str, ops) -> str:
         """Fold + apply a batched edit transaction for one tenant
@@ -1251,6 +1344,12 @@ class TenantRegistry:
             dict(content), rule_width=self._rule_width
         )
         snap = upd.snapshot()
+        # the overlay delta belongs to the ruleset being REPLACED:
+        # clear it BEFORE the flip, so concurrent classifies see either
+        # old-main+delta or (briefly) old-main alone — bounded
+        # staleness of states that actually existed — never the
+        # new-main+stale-delta hybrid that never did
+        self._clear_overlay(tid)
         t0 = time.perf_counter()
         if hasattr(self._clf, "stage_tenant"):
             page = self._clf.stage_tenant(snap)
@@ -1281,6 +1380,7 @@ class TenantRegistry:
 
     def _destroy_finish(self, name: str, tid: int) -> None:
         from .obs.events import TenantSwapRecord
+        self._overlays.pop(tid, None)  # clf.destroy_tenant freed the slab
         with self._lock:
             self._names.pop(name, None)
             self._updaters.pop(tid, None)

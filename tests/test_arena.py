@@ -82,12 +82,23 @@ def test_capacity_errors():
     with pytest.raises(jaxpath.ArenaCapacityError):
         al.load_tenant(99, tabs[0])  # tenant id out of range
     al.load_tenant(0, tabs[0])
+    # identical content shares ONE page (content addressing), so page
+    # exhaustion needs DISTINCT rulesets
     al.load_tenant(1, tabs[0])
-    # pages 4 but only 2 tenant ids; exhaust pages via staging
-    al.stage(tabs[0])
-    al.stage(tabs[0])
+    assert al.page_of(1) == al.page_of(0)
+    assert al.free_pages() == 3
+    distinct = [
+        testing.random_tables(np.random.default_rng(7000 + i),
+                              n_entries=16, width=4)
+        for i in range(4)
+    ]
+    al.stage(distinct[0])
+    al.stage(distinct[1])
+    al.stage(distinct[2])
     with pytest.raises(jaxpath.ArenaCapacityError, match="out of pages"):
-        al.stage(tabs[0])
+        al.stage(distinct[3])
+    # re-staging resident content never needs a page
+    assert al.stage(distinct[0]) in range(spec.pages)
 
 
 # --- mixed-tenant classify bit-identity -------------------------------------
@@ -172,15 +183,17 @@ def test_activate_free_list_consistency():
         al.activate(0, pg_a if i % 2 == 0 else pg_b)
         assert check_arena(al) == []
         assert sorted(al._free) == sorted(set(al._free))
-    # odd flip count: tenant 0 ends on pg_a, the tabs[1] slab
-    # activating a page live for ANOTHER tenant must refuse
+    # odd flip count: tenant 0 ends on pg_a, the tabs[1] slab.
+    # activating a page live for ANOTHER tenant now SHARES it
+    # (refcounted page-table rows, ISSUE-15) instead of refusing
     al.load_tenant(1, tabs[1])
-    with pytest.raises(jaxpath.ArenaCapacityError, match="live for tenant"):
-        al.activate(0, al.page_of(1))
-    # tables-less activate drops the stale record: compact leaves the
-    # tenant in place instead of rebaking the old ruleset
+    al.activate(0, al.page_of(1))
+    assert al.page_of(0) == al.page_of(1)
+    assert al.page_refcount(al.page_of(1)) == 2
+    assert check_arena(al) == []
+    # tables-less activate drops the stale record; the canonical host
+    # mirror still lets compact() move the page correctly
     assert al.tables_of(0) is None
-    before = np.asarray(al.arena.page_table).copy()
     al.compact()
     assert check_arena(al) == []
     b = testing.random_batch(np.random.default_rng(3), tabs[1], 48)
@@ -453,7 +466,11 @@ def test_zero_recompiles_across_tenant_counts_and_lifecycle():
         r[1] = [1, 6, 81, 0, 0, 0, 1]
         upd.apply({k: r}, [])
         hint = upd.peek_dirty()
-        assert al.load_tenant(0, upd.snapshot(), hint=hint) == "patch"
+        # tenant 0 shares its page with every even tenant (identical
+        # content), so the rules-only edit lands as a CoW clone — which
+        # must be exactly as compile-free as the in-place patch (the
+        # clone rides the warmed full-slab fused scatter)
+        assert al.load_tenant(0, upd.snapshot(), hint=hint) == "cow"
         al.destroy_tenant(counts[-1] - 1)
         classify(counts[-1] - 1)
         assert fn._cache_size() == fn0, family

@@ -1,0 +1,493 @@
+"""Content-addressed copy-on-write arena (ISSUE-15).
+
+Covers the content-hash share path (N tenants on one baseline cost one
+slab; create-from-known-content is a page-table flip with NO slab
+write), the CoW clone-then-patch path (an edit on a shared page lands
+in a private clone — bit-identical to a fresh bake — while every other
+sharer's verdicts stay byte-stable), the refcount edge cases the PR-10
+review flagged (activate of a live page = sharing, destroy of a
+sharer, compaction moving a shared page with every row flipped before
+reclaim), the shared-delta overlay routing in the tenant registry, the
+background dedup sweep, cross-tenant isolation under sharing on both
+ArenaClassifier and MeshArenaClassifier, and the cowleak injected
+defect / arena-cow statecheck config.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from infw import oracle, testing
+from infw.backend.tpu import ArenaClassifier
+from infw.compiler import IncrementalTables, compile_tables_from_content
+from infw.kernels import jaxpath
+from infw.analysis.statecheck import check_arena
+
+
+def _mk(seed, n=18, width=4, v6=0.4):
+    return testing.random_tables(
+        np.random.default_rng(seed), n_entries=n, width=width,
+        v6_fraction=v6,
+    )
+
+
+def _spec(family, tabs, pages=8, max_tenants=8):
+    return jaxpath.arena_spec_for(family, tabs, pages=pages,
+                                  max_tenants=max_tenants)
+
+
+def _classify(al, tab, tenant_id, n=48, seed=3):
+    b = testing.random_batch(np.random.default_rng(seed), tab, n)
+    spec = al.spec
+    d_max = spec.d_max if spec.family == "ctrie" else 0
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        spec.family, spec.pages, d_max
+    )
+    fused = fn(al.arena, jax.device_put(b.pack_wire()),
+               jax.device_put(np.full(n, tenant_id, np.int32)))
+    res16, _stats = jaxpath.split_wire_outputs(np.asarray(fused), n)
+    results, _xdp = jaxpath.host_finalize_wire(res16, np.asarray(b.kind))
+    return results, oracle.classify(tab, b).results
+
+
+def _shared_pair(family="ctrie", n=18):
+    """Two tenants on ONE shared page via independent updaters over the
+    same content — the CoW test substrate."""
+    base = _mk(40, n=n)
+    u0 = IncrementalTables.from_content(dict(base.content), rule_width=4)
+    u1 = IncrementalTables.from_content(dict(base.content), rule_width=4)
+    s0, s1 = u0.snapshot(), u1.snapshot()
+    spec = _spec(family, [s0, s1])
+    al = jaxpath.ArenaAllocator(spec)
+    assert al.load_tenant(0, s0) == "assign"
+    # a DIFFERENT tables object with identical content shares: the hash
+    # is over the baked slab arrays, not object identity
+    assert al.load_tenant(1, s1) == "share"
+    u0.start_dirty_tracking()
+    u1.start_dirty_tracking()
+    return al, u0, u1, s0, s1
+
+
+# --- content-addressed sharing ----------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["dense", "ctrie"])
+def test_content_hash_share_and_refcounts(family):
+    al, _u0, _u1, s0, s1 = _shared_pair(family)
+    assert al.page_of(0) == al.page_of(1)
+    assert al.page_refcount(al.page_of(0)) == 2
+    assert al.counters["slab_writes"] == 1  # ONE physical bake
+    assert al.counters["shared_hits"] == 1
+    assert al.distinct_slabs() == 1
+    assert check_arena(al) == []
+    r0, w0 = _classify(al, s0, 0)
+    r1, w1 = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r0, w0)
+    np.testing.assert_array_equal(r1, w1)
+
+
+def test_create_from_known_content_writes_no_slab():
+    """The capacity lever: 20 tenants over 2 distinct rulesets cost 2
+    slab bakes; every other create is a hash probe + page-table flip."""
+    tabs = [_mk(60), _mk(61)]
+    spec = _spec("ctrie", tabs, pages=8, max_tenants=24)
+    al = jaxpath.ArenaAllocator(spec)
+    for t in range(20):
+        al.load_tenant(t, tabs[t % 2])
+    assert al.counters["slab_writes"] == 2
+    assert al.distinct_slabs() == 2
+    assert al.free_pages() == spec.pages - 2
+    assert al.page_refcount(al.page_of(0)) == 10
+    assert check_arena(al) == []
+
+
+# --- CoW clone-then-patch ---------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["dense", "ctrie"])
+def test_cow_clone_then_patch(family):
+    al, u0, _u1, _s0, s1 = _shared_pair(family)
+    donor = al.page_of(0)
+    before = {
+        name: np.asarray(getattr(al.arena, name)).copy()
+        for name in al._host if name != "page_table"
+    }
+    k = sorted(u0.content, key=lambda kk: (kk.ingress_ifindex,
+                                           kk.ip_data))[0]
+    r = np.asarray(u0.content[k]).copy()
+    r[1] = [1, 6, 443, 0, 0, 0, 1]
+    u0.apply({k: r}, [])
+    hint = u0.peek_dirty()
+    snap = u0.snapshot()
+    assert al.load_tenant(0, snap, hint=hint) == "cow"
+    # the editing tenant moved to a private page; the donor survives
+    # with its refcount DECREMENTED (the cowleak invariant)
+    assert al.page_of(0) != donor
+    assert al.page_of(1) == donor
+    assert al.page_refcount(donor) == 1
+    assert al.page_refcount(al.page_of(0)) == 1
+    assert al.counters["cow_clones"] == 1
+    assert check_arena(al) == []
+    # donor slab rows byte-stable: the other sharer never saw the edit
+    rows = dict(zip(al._array_names(), al._slab_rows()))
+    for name, nrows in rows.items():
+        np.testing.assert_array_equal(
+            np.asarray(getattr(al.arena, name))[
+                donor * nrows : (donor + 1) * nrows
+            ],
+            before[name][donor * nrows : (donor + 1) * nrows],
+            err_msg=f"donor {name} rows changed under CoW",
+        )
+    # the clone is bit-identical to a FRESH bake of the new snapshot
+    al2 = jaxpath.ArenaAllocator(al.spec)
+    al2.load_tenant(0, snap)
+    pg, pg2 = al.page_of(0), al2.page_of(0)
+    c1 = al._canonical_of_page(pg)
+    c2 = al2._canonical_of_page(pg2)
+    for a, b in zip(c1, c2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # verdicts: editor diverged, sharer byte-stable
+    r0, w0 = _classify(al, snap, 0)
+    np.testing.assert_array_equal(r0, w0)
+    r1, w1 = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r1, w1)
+
+
+def test_cowleak_defect_caught_by_invariants():
+    al, u0, _u1, _s0, _s1 = _shared_pair("ctrie")
+    k = list(u0.content)[0]
+    r = np.asarray(u0.content[k]).copy()
+    r[1] = [1, 17, 53, 0, 0, 0, 2]
+    u0.apply({k: r}, [])
+    jaxpath._INJECT_COWLEAK_BUG = True
+    try:
+        assert al.load_tenant(0, u0.snapshot(),
+                              hint=u0.peek_dirty()) == "cow"
+        viols = check_arena(al)
+    finally:
+        jaxpath._INJECT_COWLEAK_BUG = False
+    assert any("cowleak" in v or "refcount" in v for v in viols)
+
+
+# --- refcount edge cases (the PR-10 review sweep, now under sharing) --------
+
+
+def test_activate_live_page_shares_and_destroy_sharer():
+    tabs = [_mk(70), _mk(71)]
+    spec = _spec("ctrie", tabs)
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, tabs[0])
+    al.load_tenant(1, tabs[1])
+    # activate() of a page live for ANOTHER tenant shares it
+    old_page1 = al.page_of(1)
+    al.activate(1, al.page_of(0), tabs[0])
+    assert al.page_of(1) == al.page_of(0)
+    assert al.page_refcount(al.page_of(0)) == 2
+    # tenant 1's previous private page dropped to refcount 0 and freed
+    assert old_page1 in al._free
+    assert check_arena(al) == []
+    # destroy of a SHARING tenant: the page survives for the other
+    al.destroy_tenant(0)
+    assert al.page_refcount(al.page_of(1)) == 1
+    assert check_arena(al) == []
+    r1, w1 = _classify(al, tabs[0], 1)
+    np.testing.assert_array_equal(r1, w1)
+    # destroy of the LAST sharer frees the page
+    page = al.page_of(1)
+    al.destroy_tenant(1)
+    assert al.page_refcount(page) == 0
+    assert page in al._free
+    assert check_arena(al) == []
+
+
+def test_compact_moves_shared_page_all_rows_flip():
+    tabs = [_mk(80), _mk(81)]
+    spec = _spec("ctrie", tabs)
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, tabs[0])      # page 0
+    al.load_tenant(1, tabs[1])      # page 1
+    al.load_tenant(2, tabs[1])      # shares page 1
+    al.destroy_tenant(0)            # frees page 0 below the shared page
+    src = al.page_of(1)
+    assert al.page_of(2) == src and src > 0
+    moved = al.compact()
+    # BOTH sharers' page-table rows flipped; the donor page reclaimed
+    # only after (it is back on the free list, not referenced)
+    assert moved == 2
+    tgt = al.page_of(1)
+    assert tgt < src and al.page_of(2) == tgt
+    assert al.page_refcount(tgt) == 2
+    assert src in al._free
+    assert check_arena(al) == []
+    for t in (1, 2):
+        r, w = _classify(al, tabs[1], t)
+        np.testing.assert_array_equal(r, w)
+    # a staged page (live hold) is pinned: its id is a reservation
+    held = al.stage(_mk(82, n=8))
+    al.destroy_tenant(1)
+    al.destroy_tenant(2)
+    assert al.compact() == 0
+    assert al.page_holds(held) == 1 and held not in al._free
+    al.release(held)
+    assert held in al._free
+    assert check_arena(al) == []
+
+
+# --- dedup sweep ------------------------------------------------------------
+
+
+def test_dedup_sweep_remerges_reconverged_pages():
+    al, u0, _u1, _s0, _s1 = _shared_pair("ctrie")
+    k = sorted(u0.content, key=lambda kk: (kk.ingress_ifindex,
+                                           kk.ip_data))[0]
+    orig = np.asarray(u0.content[k]).copy()
+    r = orig.copy()
+    r[1] = [1, 6, 8080, 0, 0, 0, 2]
+    u0.apply({k: r}, [])
+    assert al.load_tenant(0, u0.snapshot(), hint=u0.peek_dirty()) == "cow"
+    u0.clear_dirty()
+    assert al.distinct_slabs() == 2
+    # edit BACK to the shared baseline: the private clone's content
+    # re-converges (an in-place patch — the page is private now)
+    u0.apply({k: orig}, [])
+    assert al.load_tenant(0, u0.snapshot(), hint=u0.peek_dirty()) == "patch"
+    rep = al.dedup_sweep()
+    assert rep["merged"] == 1 and rep["moved"] == [0]
+    assert al.page_of(0) == al.page_of(1)
+    assert al.page_refcount(al.page_of(0)) == 2
+    assert al.distinct_slabs() == 1
+    assert al.counters["dedup_merges"] == 1
+    assert check_arena(al) == []
+    # idempotent when converged
+    assert al.dedup_sweep() == {"hashed": 0, "merged": 0, "moved": []}
+
+
+# --- registry: shared-delta overlay routing ---------------------------------
+
+
+def test_overlay_delta_routing_paths():
+    """Cheap (classify-free) pin of the shared-delta routing decision:
+    brand-new prefixes of a shared-page tenant ride the overlay (no
+    clone, refcount stays), base-key edits force the clone and fold the
+    overlay back.  The end-to-end verdict checks live in the slow
+    test_registry_shared_delta_rides_overlay_then_clone."""
+    from infw.syncer import TenantRegistry
+
+    base = _mk(90, n=12)
+    spec = _spec("ctrie", [base], pages=6, max_tenants=6)
+    ov_spec = jaxpath.make_arena_spec(
+        "dense", pages=6, max_tenants=6, entries=16, rule_slots=4
+    )
+    clf = ArenaClassifier(spec, overlay_spec=ov_spec, interpret=True,
+                          fused_deep=False)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(base.content))
+    reg.create_tenant("b", dict(base.content))
+    tid_b = reg.tenant_id("b")
+    al = clf.allocator
+    assert al.page_refcount(al.page_of(tid_b)) == 2
+    (k_new, r_new), = _mk(91, n=1, v6=0.0).content.items()
+    assert reg.update_tenant("b", {k_new: np.asarray(r_new)}, []) == "overlay"
+    assert al.page_refcount(al.page_of(tid_b)) == 2
+    assert al.counters["cow_clones"] == 0
+    assert clf.overlay_allocator.page_of(tid_b) is not None
+    # deleting the overlay-resident key is overlay-eligible too
+    assert reg.update_tenant("b", {}, [k_new]) == "overlay"
+    assert clf.overlay_allocator.page_of(tid_b) is None
+    assert reg.update_tenant("b", {k_new: np.asarray(r_new)}, []) == "overlay"
+    # a base-key edit is NOT overlay-expressible: clone + fold-back
+    k0 = sorted(base.content, key=lambda kk: (kk.ingress_ifindex,
+                                              kk.ip_data))[0]
+    r0 = np.asarray(base.content[k0]).copy()
+    r0[1] = [1, 6, 22, 0, 0, 0, 1]
+    # ...and deleting the overlay key in the SAME clone-forcing edit
+    # must not fold a resurrected copy back into the slab
+    assert reg.update_tenant("b", {k0: r0}, [k_new]) != "overlay"
+    assert al.page_refcount(al.page_of(tid_b)) == 1
+    assert clf.overlay_allocator.page_of(tid_b) is None
+    ident = k_new.masked_identity()
+    upd_b = reg._updaters[tid_b]
+    assert ident not in upd_b._ident_to_t  # deleted, not resurrected
+    assert check_arena(al) == []
+    clf.close()
+
+
+@pytest.mark.slow
+def test_registry_shared_delta_rides_overlay_then_clone():
+    from infw.syncer import TenantRegistry
+
+    base = _mk(90)
+    spec = _spec("ctrie", [base], pages=6, max_tenants=6)
+    ov_spec = jaxpath.make_arena_spec(
+        "dense", pages=6, max_tenants=6, entries=16, rule_slots=4
+    )
+    clf = ArenaClassifier(spec, overlay_spec=ov_spec, interpret=True,
+                          fused_deep=False)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(base.content))
+    reg.create_tenant("b", dict(base.content))
+    tid_b = reg.tenant_id("b")
+    assert clf.allocator.page_refcount(clf.allocator.page_of(tid_b)) == 2
+    # a brand-new prefix for b rides the overlay side-pool: NO clone,
+    # the shared main slab stays refcount 2
+    newk = testing.random_tables(
+        np.random.default_rng(911), n_entries=1, width=4, v6_fraction=0.0
+    )
+    (k_new, r_new), = newk.content.items()
+    assert reg.update_tenant("b", {k_new: np.asarray(r_new)}, []) == "overlay"
+    assert clf.allocator.page_refcount(clf.allocator.page_of(tid_b)) == 2
+    assert clf.allocator.counters["cow_clones"] == 0
+    assert clf.overlay_allocator.page_of(tid_b) is not None
+    # b classifies against base + delta; a stays on the pristine base
+    merged = compile_tables_from_content(
+        {**dict(base.content), k_new: np.asarray(r_new)}, rule_width=4
+    )
+    bb = testing.random_batch(np.random.default_rng(5), merged, 64)
+    out = reg.classify_mixed(bb, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out.results, oracle.classify(merged, bb).results
+    )
+    ba = testing.random_batch(np.random.default_rng(6), base, 48)
+    out_a = reg.classify_mixed(ba, ["a"] * 48, apply_stats=False)
+    np.testing.assert_array_equal(
+        out_a.results, oracle.classify(base, ba).results
+    )
+    # editing a BASE key is not overlay-eligible (the strict longest-
+    # prefix tie): it forces the deferred clone, folding the overlay
+    # delta back into b's private slab
+    k0 = sorted(base.content, key=lambda kk: (kk.ingress_ifindex,
+                                              kk.ip_data))[0]
+    r0 = np.asarray(base.content[k0]).copy()
+    r0[1] = [1, 6, 22, 0, 0, 0, 1]
+    path = reg.update_tenant("b", {k0: r0}, [])
+    assert path != "overlay"
+    assert clf.allocator.page_refcount(clf.allocator.page_of(tid_b)) == 1
+    assert clf.overlay_allocator.page_of(tid_b) is None  # folded + cleared
+    merged2 = compile_tables_from_content(
+        {**dict(base.content), k_new: np.asarray(r_new), k0: r0},
+        rule_width=4,
+    )
+    b2 = testing.random_batch(np.random.default_rng(7), merged2, 64)
+    out2 = reg.classify_mixed(b2, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out2.results, oracle.classify(merged2, b2).results
+    )
+    # a never moved
+    out_a2 = reg.classify_mixed(ba, ["a"] * 48, apply_stats=False)
+    np.testing.assert_array_equal(out_a2.results, out_a.results)
+    assert check_arena(clf.allocator) == []
+    clf.close()
+
+
+# --- classifier-level isolation under sharing --------------------------------
+
+
+@pytest.mark.slow
+def test_classifier_cow_isolation_oracle():
+    """Two tenants on one shared page classify bit-identically to their
+    per-tenant CPU oracles; an edit by one diverges ONLY that tenant —
+    the other's verdicts are byte-stable across the clone (compared
+    against the pre-edit output, not just the oracle)."""
+    from infw.syncer import TenantRegistry
+
+    base = _mk(95, n=24)
+    spec = _spec("ctrie", [base], pages=6, max_tenants=6)
+    clf = ArenaClassifier(spec, interpret=True, fused_deep=False)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(base.content))
+    reg.create_tenant("b", dict(base.content))
+    assert clf.allocator.page_of(0) == clf.allocator.page_of(1)
+    ba = testing.random_batch(np.random.default_rng(11), base, 64)
+    want = oracle.classify(base, ba).results
+    out_a0 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    out_b0 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a0.results, want)
+    np.testing.assert_array_equal(out_b0.results, want)
+    k = sorted(base.content, key=lambda kk: (kk.ingress_ifindex,
+                                             kk.ip_data))[0]
+    r = np.asarray(base.content[k]).copy()
+    r[1] = [1, 0, 0, 0, 0, 0, 1]
+    reg.update_tenant("b", {k: r}, [])
+    assert clf.allocator.page_of(0) != clf.allocator.page_of(1)
+    merged = compile_tables_from_content(
+        {**dict(base.content), k: r}, rule_width=4
+    )
+    out_b1 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out_b1.results, oracle.classify(merged, ba).results
+    )
+    out_a1 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a1.results, out_a0.results)
+    assert check_arena(clf.allocator) == []
+    clf.close()
+
+
+@pytest.mark.slow
+def test_mesh_cow_isolation():
+    """The same share -> edit -> diverge-only-the-editor flow on the
+    mesh classifier (8 virtual devices): lifecycle scatters broadcast
+    replicated, shared pages placed by the same partition rules."""
+    from infw.backend.mesh import MeshArenaClassifier
+    from infw.syncer import TenantRegistry
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs >= 8 virtual devices")
+    base = _mk(97, n=20)
+    spec = _spec("ctrie", [base], pages=8, max_tenants=8)
+    clf = MeshArenaClassifier(spec, data_shards=8)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(base.content))
+    reg.create_tenant("b", dict(base.content))
+    al = clf.allocator
+    assert al.page_of(0) == al.page_of(1)
+    assert al.page_refcount(al.page_of(0)) == 2
+    ba = testing.random_batch(np.random.default_rng(13), base, 64)
+    want = oracle.classify(base, ba).results
+    out_a0 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    out_b0 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a0.results, want)
+    np.testing.assert_array_equal(out_b0.results, want)
+    k = sorted(base.content, key=lambda kk: (kk.ingress_ifindex,
+                                             kk.ip_data))[0]
+    r = np.asarray(base.content[k]).copy()
+    r[1] = [1, 0, 0, 0, 0, 0, 2]
+    reg.update_tenant("b", {k: r}, [])
+    assert al.page_of(0) != al.page_of(1)
+    merged = compile_tables_from_content(
+        {**dict(base.content), k: r}, rule_width=4
+    )
+    out_b1 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out_b1.results, oracle.classify(merged, ba).results
+    )
+    out_a1 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a1.results, out_a0.results)
+    assert check_arena(al) == []
+    clf.close()
+
+
+# --- statecheck config / defect acceptance ----------------------------------
+
+
+@pytest.mark.slow
+def test_statecheck_arena_cow_config_green():
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("arena-cow", seed=0, n_ops=8,
+                                shrink_on_failure=False)
+    assert rep["ok"], rep
+
+
+@pytest.mark.slow
+def test_cowleak_defect_caught_and_shrunk():
+    from infw.analysis import statecheck
+
+    jaxpath._INJECT_COWLEAK_BUG = True
+    try:
+        rep = statecheck.run_config("arena-cow", seed=0, n_ops=12,
+                                    max_shrink_runs=64)
+    finally:
+        jaxpath._INJECT_COWLEAK_BUG = False
+    assert not rep["ok"]
+    assert rep["failure"]["phase"] == "invariant"
+    assert rep["shrunk"]["ops"] <= 3

@@ -302,8 +302,8 @@ def cmd_jax(args) -> int:
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
-                         "arena-ctrie", "flow", "flow-ctrie", "resident",
-                         "telemetry", "telemetry-resident")
+                         "arena-ctrie", "arena-cow", "flow", "flow-ctrie",
+                         "resident", "telemetry", "telemetry-resident")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -338,6 +338,13 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # the arena invariant/oracle layers, shrunk to the one
         # tenant_swap op
         "pageflip": (jaxpath, "_INJECT_PAGEFLIP_BUG", "arena-ctrie", 3),
+        # CoW donor-refcount leak: the clone path of the content-
+        # addressed arena "forgets" to decrement the donor page's
+        # refcount after flipping the editing tenant onto its private
+        # copy — caught by check_arena's refcount-vs-page-table-rows
+        # invariant on the shared-then-edited-biased arena-cow config,
+        # shrinking to (copy-create, edit) plus slack
+        "cowleak": (jaxpath, "_INJECT_COWLEAK_BUG", "arena-cow", 3),
         # dropped flow invalidation: a rule edit's generation bump is
         # silently skipped (infw.flow.bump_generation no-ops), so the
         # flow tier keeps serving the PRE-edit cached verdict.  Device
@@ -382,8 +389,12 @@ def _run_inject_defect(args, as_json: bool) -> int:
     # then-readd in one txn; traffic-edit-traffic on one seed): give the
     # generator a horizon that reliably produces one and the shrinker
     # the budget to reduce it
-    n_ops = max(args.ops, 12) if defect in ("fold", "flowstale") else args.ops
-    shrink_runs = 64 if defect in ("fold", "flowstale") else 32
+    n_ops = (
+        max(args.ops, 12)
+        if defect in ("fold", "flowstale", "cowleak")
+        else args.ops
+    )
+    shrink_runs = 64 if defect in ("fold", "flowstale", "cowleak") else 32
     if args.configs:
         print(f"note: --inject-defect {defect} always runs the "
               f"{config!r} config (the defect's layout regime); "
@@ -551,7 +562,7 @@ def main(argv=None) -> int:
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
-                                  "flowstale", "residentstale",
+                                  "cowleak", "flowstale", "residentstale",
                                   "sketchsat", "mlquant"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
