@@ -6,8 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
 	churn-bench flow-bench resident-bench telemetry-bench mlscore-bench \
-	native entry-check dryrun-multichip mesh-check spill-read wire-check \
-	lint static-check state-check clean
+	pipeline-bench native entry-check dryrun-multichip mesh-check \
+	spill-read wire-check lint static-check state-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -85,6 +85,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cowleak
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect slotepoch
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect sketchsat
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect mlquant
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
@@ -231,10 +232,25 @@ telemetry-bench:
 mlscore-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --mlscore-bench
 
+# The pipelined-admission tier (bench.bench_pipeline) standalone at
+# smoke scale off-TPU: the K=4 device-side superbatch epoch loop + the
+# two-slot overlap vs the single-dispatch resident loop, packets/s
+# above the link floor at batch 32 and 128, interleaved min-vs-min,
+# gated at INFW_PIPELINE_OVERLAP_MIN (default 1.3x, the ISSUE-16
+# acceptance).  Superbatch verdicts/stats/flow-columns/sketch tensors
+# are gated bit-identical to K sequential fused dispatches in-tier, a
+# warmed steady-state run cycling BOTH pipeline slots asserts zero
+# allocations + zero recompiles, and the DeviceStripe mesh leg reports
+# packets/s + device-busy fraction at 1/2/4/8 devices (hence the
+# 8-virtual-device MESH_ENV).  The statecheck pipeline config runs
+# FIRST and gates record publication.
+pipeline-bench:
+	$(MESH_ENV) $(PY) bench.py --pipeline-bench
+
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench mlscore-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench mlscore-bench pipeline-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

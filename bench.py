@@ -2931,6 +2931,393 @@ def resident_bench_main() -> int:
     return 0
 
 
+# --- pipelined admissions: superbatch + two-slot overlap (ISSUE-16) --------
+
+
+def bench_pipeline(rng, on_tpu):
+    """ISSUE-16 pipeline tier (``make pipeline-bench``, folded into
+    bench-checked): packets/s of the pipelined resident serving loop —
+    the K=4 device-side epoch program (jitted_resident_superbatch: one
+    dispatch chews four stacked admissions with the flow/epoch/sketch
+    state chained through the loop carry) plus the two-slot overlap
+    (the next superbatch is dispatched before the previous one's rows
+    are materialized) — against the single-dispatch resident loop it
+    pipelines, at batch 32 and batch 128.
+
+    Methodology (benchruns/README):
+    - interleaved min-vs-min over the SAME 90%%-established trace, each
+      pass from a cold flow table;
+    - dataplane-attributable: each pass's wall subtracts the in-record
+      link floor (noop round-trip) once per DEVICE DISPATCH before the
+      packets/s division — the serial pass pays the floor n_chunks
+      times, the superbatch pass n_chunks/K times, so the subtraction
+      is conservative for the reported speedup;
+    - ORACLE GATE before any timing line: superbatch verdicts + stats
+      bit-identical to K sequential fused dispatches AND the CPU
+      oracle, with the flow columns and the sketch tensors compared
+      after the full pass (telemetry plane enabled on the gate pair);
+    - ZERO-ALLOC + ZERO-RECOMPILE gate across BOTH pipeline slots: a
+      warmed steady-state run cycling slot parity (3 singles + one K=4
+      superbatch per cycle — an odd 7-admission stride, so superbatches
+      start from both slots) must leave the pool allocation counter and
+      both executable caches flat;
+    - DEVICE-BUSY FRACTION (achieved overlap): the serial pass's total
+      above-floor compute / the pipelined wall — >1 means the epoch
+      loop retired the same admissions in less device time than the
+      single-dispatch baseline spent on them;
+    - MESH LEG (ungated reference): DeviceStripe packets/s at 1/2/4/8
+      devices, admissions striped round-robin over per-device ingest
+      rings, with the ring occupancy/backpressure gauges surfaced in
+      the record so overlap regressions are diagnosable.
+
+    Returns the record dict for the pipeline-bench gate
+    (INFW_PIPELINE_OVERLAP_MIN on the batch-32/128 throughput ratios)."""
+    import tempfile
+
+    from infw.backend.mesh import DeviceStripe
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.kernels.sketch import SketchSpec
+    from infw.ring import IngestRing
+    from infw.scheduler import prewarm_ladder
+
+    K = 4
+    out = {}
+    floor = _slo_floor()
+    log(f"pipeline: link sync floor {floor*1e3:.3f} ms, superbatch K={K}")
+    n_entries = 100_000 if on_tpu else 20_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.5,
+        ifindexes=(2, 3),
+    )
+    fcfg = FlowConfig.make(entries=1 << 14)
+
+    def make_clf(spec=None, device=None):
+        kw = {"telemetry": spec} if spec is not None else {}
+        c = TpuClassifier(
+            force_path="trie", flow_table=FlowConfig.make(entries=1 << 14),
+            resident=True, device=device, **kw,
+        )
+        c.load_tables(tables)
+        return c
+
+    def make_chunks(bs, n_chunks, seed):
+        batch, meta = testing.flow_trace_batch(
+            np.random.default_rng(seed), tables, bs * n_chunks, 0.9,
+            chunk_packets=bs,
+        )
+        wire = batch.pack_wire()
+        tflags = np.asarray(batch.tcp_flags, np.int32)
+        chunks = [
+            (np.ascontiguousarray(wire[lo:lo + bs]),
+             np.ascontiguousarray(tflags[lo:lo + bs]))
+            for lo in range(0, len(batch), bs)
+        ]
+        return batch, meta, chunks
+
+    def super_plan(clf, chunks, g):
+        stack = np.stack([chunks[g + j][0] for j in range(K)])
+        fstack = np.stack([chunks[g + j][1] for j in range(K)])
+        plan = clf.prepare_packed_super(stack, False,
+                                        tcp_flags_stack=fstack)
+        if plan is None:
+            raise RuntimeError("superbatch plan unexpectedly refused")
+        return plan
+
+    # -- superbatch bit-identity gate BEFORE any timing line ----------------
+    # K sequential fused dispatches vs one K-stacked epoch program, the
+    # telemetry plane riding both: per-row verdicts + stats vs each
+    # other AND the CPU oracle, then the full flow columns and sketch
+    # tensors compared after the pass
+    bs_gate = 64
+    batch, _m, chunks = make_chunks(bs_gate, 16, 9100)
+    ref = oracle.classify(tables, batch)
+    spec = SketchSpec.make()
+    tel_seq = make_clf(spec)
+    tel_sup = make_clf(spec)
+    n_div = 0
+    for g in range(0, len(chunks), K):
+        rows = tel_sup.classify_prepared_super(
+            super_plan(tel_sup, chunks, g), apply_stats=False
+        )
+        for j in range(K):
+            w, tf = chunks[g + j]
+            o_seq = tel_seq.classify_prepared(
+                tel_seq.prepare_packed(w, False, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+            o_sup = rows[j].result()
+            want = ref.results[(g + j) * bs_gate:(g + j + 1) * bs_gate]
+            n_div += int((o_sup.results != want).sum())
+            n_div += int((o_sup.results != o_seq.results).sum())
+            n_div += int((o_sup.stats_delta != o_seq.stats_delta).sum())
+    if n_div:
+        raise RuntimeError(
+            f"pipeline-bench superbatch mismatch: {n_div} divergences "
+            "vs K sequential fused dispatches / CPU oracle"
+        )
+    fc_sup = tel_sup.flow.flow_columns()
+    fc_seq = tel_seq.flow.flow_columns()
+    for name in fc_sup:
+        if not np.array_equal(fc_sup[name], fc_seq[name]):
+            raise RuntimeError(
+                f"pipeline-bench flow-column mismatch: {name!r} diverged "
+                "between superbatch and sequential dispatches"
+            )
+    cols_sup = tel_sup.telemetry.columns()
+    cols_seq = tel_seq.telemetry.columns()
+    for name in cols_sup:
+        if not np.array_equal(cols_sup[name], cols_seq[name]):
+            raise RuntimeError(
+                f"pipeline-bench sketch mismatch: tensor {name!r} "
+                "diverged between superbatch and sequential dispatches"
+            )
+    tel_seq.close()
+    tel_sup.close()
+    log(f"pipeline: superbatch bit-identity gate clean ({len(chunks)} "
+        "chunks — verdicts, stats, flow columns, sketch tensors)")
+
+    # -- pipelined vs single-dispatch A/B (interleaved min-vs-min) ----------
+    ser = make_clf()
+    pipe = make_clf()
+    t0 = time.perf_counter()
+    prewarm_ladder(ser, (32, 128))
+    prewarm_ladder(pipe, (32, 128))
+    log(f"pipeline: ladder prewarm in {time.perf_counter()-t0:.1f}s")
+
+    def run_serial(clf, chunks):
+        clf.flow.reset()
+        t0 = time.perf_counter()
+        for w, tf in chunks:
+            clf.classify_prepared(
+                clf.prepare_packed(w, False, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+        return time.perf_counter() - t0
+
+    def run_pipelined(clf, chunks):
+        clf.flow.reset()
+        t0 = time.perf_counter()
+        pending = []
+        for g in range(0, len(chunks), K):
+            rows = clf.classify_prepared_super(
+                super_plan(clf, chunks, g), apply_stats=False
+            )
+            # two-slot overlap: the PREVIOUS superbatch's rows
+            # materialize only after this one is in flight
+            for p in pending:
+                p.result()
+            pending = rows
+        for p in pending:
+            p.result()
+        return time.perf_counter() - t0
+
+    reps = 5 if on_tpu else 3
+    ser_above_per_chunk_128 = 0.0
+    for bs in (32, 128):
+        n_chunks = 48
+        batch, meta, chunks = make_chunks(bs, n_chunks, 8800 + bs)
+        run_serial(ser, chunks)  # warm the timed shapes (untimed)
+        run_pipelined(pipe, chunks)
+        best = {"ser": 1e9, "pipe": 1e9}
+        for _ in range(reps):  # interleaved min-vs-min
+            best["ser"] = min(best["ser"], run_serial(ser, chunks))
+            best["pipe"] = min(best["pipe"], run_pipelined(pipe, chunks))
+        above_ser = max(best["ser"] - floor * n_chunks, 1e-9)
+        above_pipe = max(best["pipe"] - floor * (n_chunks // K), 1e-9)
+        pps_ser = len(batch) / above_ser
+        pps_pipe = len(batch) / above_pipe
+        speedup = pps_pipe / pps_ser
+        busy = above_ser / best["pipe"]
+        if bs == 128:
+            ser_above_per_chunk_128 = above_ser / n_chunks
+        log(f"pipeline @batch={bs}: pipelined {pps_pipe:,.0f} pkt/s vs "
+            f"single-dispatch {pps_ser:,.0f} pkt/s -> {speedup:.2f}x; "
+            f"device-busy fraction {busy:.2f} "
+            f"({meta['n_flows']} flows)")
+        emit(
+            f"pipelined serving throughput above link floor @batch={bs} "
+            f"(K={K} superbatch epoch loop, two-slot overlap)",
+            pps_pipe, "packets/s", vs_baseline=0.0,
+        )
+        emit(
+            f"single-dispatch serving throughput above link floor "
+            f"@batch={bs} (A/B same record)",
+            pps_ser, "packets/s", vs_baseline=0.0,
+        )
+        emit(f"pipeline overlap win @batch={bs}", speedup, "x",
+             vs_baseline=0.0)
+        emit(f"device-busy fraction @batch={bs} (baseline-relative)",
+             busy, "fraction", vs_baseline=0.0)
+        out[f"pipeline_speedup_{bs}"] = float(speedup)
+        out[f"pps_pipelined_{bs}"] = float(pps_pipe)
+        out[f"pps_single_{bs}"] = float(pps_ser)
+        out[f"device_busy_{bs}"] = float(busy)
+
+    # -- zero-alloc / zero-recompile steady state across BOTH slots ---------
+    # cycles of 3 single dispatches + one K=4 superbatch: the 7-admission
+    # stride is odd, so consecutive cycles land the superbatch (and the
+    # singles) on alternating pipeline slots; pool allocations and both
+    # executable caches must stay exactly flat
+    bs = 32
+    _b, _m, chunks = make_chunks(bs, 28, 8899)
+    fn1 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False
+    )
+    fnK = jaxpath.jitted_resident_superbatch(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False
+    )
+    pipe.flow.reset()
+    for g in range(0, len(chunks) - K + 1, 7):  # warm both shapes (untimed)
+        for j in range(3):
+            w, tf = chunks[g + j]
+            pipe.classify_prepared(
+                pipe.prepare_packed(w, False, tcp_flags=tf),
+                apply_stats=False,
+            ).result()
+        for p in pipe.classify_prepared_super(
+            super_plan(pipe, chunks, g + 3), apply_stats=False
+        ):
+            p.result()
+    pipe.mark_resident_warm()
+    cache0 = fn1._cache_size() + fnK._cache_size()
+    n_disp = 0
+    while n_disp < 400:
+        for g in range(0, len(chunks) - K + 1, 7):
+            for j in range(3):
+                w, tf = chunks[g + j]
+                pipe.classify_prepared(
+                    pipe.prepare_packed(w, False, tcp_flags=tf),
+                    apply_stats=False,
+                ).result()
+            for p in pipe.classify_prepared_super(
+                super_plan(pipe, chunks, g + 3), apply_stats=False
+            ):
+                p.result()
+            n_disp += 4
+    grew = (fn1._cache_size() + fnK._cache_size()) - cache0
+    allocs = pipe.resident.steady_allocs()
+    if grew or allocs:
+        raise RuntimeError(
+            f"pipeline steady state not zero-cost: {grew} recompile(s), "
+            f"{allocs} pool allocation(s) across {n_disp} warmed "
+            "dispatches over both slots"
+        )
+    ctr = pipe.resident_counters()
+    log(f"pipeline steady state: {n_disp} dispatches over both slots, "
+        f"0 recompiles, 0 pool allocations "
+        f"(slot0={ctr['resident_slot0_dispatches_total']} "
+        f"slot1={ctr['resident_slot1_dispatches_total']} "
+        f"super={ctr['resident_superbatch_dispatches_total']})")
+    emit("pipeline steady-state pool allocations per 400 dispatches "
+         "(both slots)", float(allocs), "allocations", vs_baseline=0.0)
+    out["steady_allocs"] = float(allocs)
+    out["steady_recompiles"] = float(grew)
+    ser.close()
+    pipe.close()
+
+    # -- mesh leg: DeviceStripe packets/s at 1/2/4/8 devices ----------------
+    # admissions striped round-robin over per-device ingest rings; the
+    # ring occupancy/backpressure gauges ride the record (ungated
+    # reference — CPU "devices" share cores, so smoke scaling is flat)
+    ndev = len(jax.devices())
+    bs, n_chunks = 128, 32
+    batch, _m, chunks = make_chunks(bs, n_chunks, 9300)
+    for width in (1, 2, 4, 8):
+        if width > ndev:
+            log(f"pipeline: stripe width {width} skipped "
+                f"(only {ndev} devices)")
+            continue
+        with tempfile.TemporaryDirectory() as d:
+            stripe = DeviceStripe(
+                width=width, ring_dir=d, ring_slots=n_chunks + 8,
+                ring_slot_packets=bs, force_path="trie",
+                flow_table=FlowConfig.make(entries=1 << 14), resident=True,
+            )
+            stripe.load_tables(tables)
+            prods = [
+                IngestRing.attach(os.path.join(d, f"stripe{i}.ring"))
+                for i in range(width)
+            ]
+
+            def fill():
+                for i, (w, tf) in enumerate(chunks):
+                    prods[i % width].push(w, v4_only=False, tcp_flags=tf)
+
+            fill()  # warm (untimed)
+            n = stripe.drain_rings_once()
+            if n != len(batch):
+                raise RuntimeError(
+                    f"stripe width {width} drained {n} of {len(batch)}"
+                )
+            stripe.mark_resident_warm()
+            best = 1e9
+            for _ in range(reps):
+                fill()
+                t0 = time.perf_counter()
+                stripe.drain_rings_once()
+                best = min(best, time.perf_counter() - t0)
+            pps = len(batch) / best
+            busy = ser_above_per_chunk_128 * n_chunks / (best * width)
+            cv = stripe.counter_values()
+            blocked = sum(
+                p.counter_values()["ring_blocked_us_total"] for p in prods
+            )
+            log(f"pipeline stripe width={width}: {pps:,.0f} pkt/s, "
+                f"per-device busy fraction {busy:.2f}, ring depth hwm "
+                f"{cv['ring_depth_hwm']}, producer blocked {blocked} us")
+            emit(
+                f"striped admission throughput @{width} device(s) "
+                "(per-device ingest rings, round-robin)",
+                pps, "packets/s", vs_baseline=0.0,
+            )
+            out[f"stripe_pps_{width}"] = float(pps)
+            out[f"stripe_busy_{width}"] = float(busy)
+            out["ring_depth_hwm"] = float(cv["ring_depth_hwm"])
+            out["ring_blocked_us"] = float(blocked)
+            for p in prods:
+                p.close()
+            stripe.close()
+    return out
+
+
+def pipeline_bench_main() -> int:
+    """``make pipeline-bench``: the pipelined-admission tier standalone
+    (CPU smoke off TPU) with the regression gate — the K=4 superbatch +
+    two-slot overlap must beat the single-dispatch resident loop's
+    packets/s at batch 32 AND batch 128 by INFW_PIPELINE_OVERLAP_MIN
+    (default 1.3x, the ISSUE-16 acceptance), with the superbatch
+    bit-identity and zero-alloc/zero-recompile both-slots gates
+    enforced inside the tier.  The statecheck pipeline config runs
+    FIRST and gates record publication (the resident-bench
+    discipline)."""
+    overlap_min = float(os.environ.get("INFW_PIPELINE_OVERLAP_MIN", "1.3"))
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("pipeline", seed=0, n_ops=6,
+                                shrink_on_failure=False)
+    if not rep["ok"]:
+        log(f"pipeline-bench FAIL: statecheck pipeline not green before "
+            f"record publication: {rep['failure']}")
+        return 1
+    log(f"pipeline-bench: statecheck pipeline green "
+        f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2024)
+    rec = bench_pipeline(rng, on_tpu)
+    emit_compact_record()
+    worst = min(rec.get("pipeline_speedup_32", 0.0),
+                rec.get("pipeline_speedup_128", 0.0))
+    if not worst >= overlap_min:
+        log(f"pipeline-bench FAIL: pipelined/single throughput ratio "
+            f"{worst:.2f}x < gate {overlap_min}x")
+        return 1
+    log("pipeline-bench OK: " + ", ".join(
+        f"{k}={v:.3f}" for k, v in sorted(rec.items())
+    ))
+    return 0
+
+
 def bench_telemetry(rng, on_tpu):
     """ISSUE-13 telemetry tier (``make telemetry-bench``, folded into
     bench-checked): the device-resident telemetry plane measured three
@@ -4108,6 +4495,8 @@ if __name__ == "__main__":
         sys.exit(flow_bench_main())
     if "--resident-bench" in sys.argv:
         sys.exit(resident_bench_main())
+    if "--pipeline-bench" in sys.argv:
+        sys.exit(pipeline_bench_main())
     if "--telemetry-bench" in sys.argv:
         sys.exit(telemetry_bench_main())
     if "--mlscore-bench" in sys.argv:

@@ -409,6 +409,22 @@ def _donation_lint(ep, ladder: Sequence[int]) -> List[AuditFinding]:
             ),
             detail=(text.splitlines()[0][:400] if text else ""),
         ))
+    if "superbatch" in ep.name and "while(" not in text:
+        # The K-admission epoch program must actually lower to a
+        # device-side loop: an unrolled program compiles K copies of
+        # the serving step (code size and compile time scale with K)
+        # and leaves no loop carry for XLA to alias the donated flow/
+        # epoch/sketch/score state through.
+        out.append(AuditFinding(
+            entry=ep.name,
+            check="superbatch-loop",
+            severity="error",
+            message=(
+                "superbatch entrypoint compiled without a device-side "
+                "while op — the K-admission epoch loop unrolled, so "
+                "the donated carry cannot alias across admissions"
+            ),
+        ))
     return out
 
 
@@ -440,6 +456,35 @@ def donation_defect_entrypoint():
         )
 
     return KernelEntrypoint("defect/undonated-buffer", "xla", build,
+                            donate=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _superbatch_defect_jit():
+    import jax
+
+    # donates and aliases cleanly, but the compiled program contains no
+    # loop at all — the superbatch-loop lint's acceptance fixture
+    return jax.jit(lambda x: x + 1, donate_argnums=(0,))
+
+
+def superbatch_defect_entrypoint():
+    """A deliberately loop-free 'superbatch' entrypoint: donation
+    aliases fine, but the compiled program has no while op, so the
+    superbatch-loop lint (the ISSUE-16 device-side epoch-loop contract)
+    must fail — rides ``--inject-donation-defect`` alongside the
+    unaliasable-donation fixture."""
+    import jax
+    import numpy as np
+
+    from ..kernels import KernelEntrypoint
+
+    def build(b: int):
+        return _superbatch_defect_jit(), (
+            jax.device_put(np.zeros(int(b), np.int32)),
+        )
+
+    return KernelEntrypoint("defect/superbatch-unrolled", "xla", build,
                             donate=(0,))
 
 
@@ -569,8 +614,9 @@ def audit_all(
     ``include_transfer_defect`` appends the deliberately defective
     host-operand entrypoint — the audit must then FAIL (the injected
     acceptance of the transfer lint).  ``include_donation_defect``
-    appends the declared-but-unaliasable donation entrypoint — the
-    donation lint's acceptance, same contract."""
+    appends the declared-but-unaliasable donation entrypoint AND the
+    loop-free superbatch entrypoint — the donation and superbatch-loop
+    lints' acceptance, same contract."""
     from ..kernels import kernel_entrypoints
 
     eps = list(kernel_entrypoints())
@@ -578,6 +624,7 @@ def audit_all(
         eps.append(transfer_defect_entrypoint())
     if include_donation_defect:
         eps.append(donation_defect_entrypoint())
+        eps.append(superbatch_defect_entrypoint())
     reports = []
     for ep in eps:
         if names and ep.name not in names:

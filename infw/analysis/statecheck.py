@@ -295,6 +295,15 @@ class StateConfig:
     #: (a dropped table-generation refresh on the resident pool) must
     #: be caught by oracle divergence
     resident: bool = False
+    #: pipelined admissions (ISSUE-16, requires resident): every
+    #: flow_traffic op drives TWO in-flight resident dispatches
+    #: materialized OUT OF DISPATCH ORDER (pass 1) and the stacked
+    #: superbatch device epoch loop (pass 2) — the oracle + flow-model
+    #: checks then pin the slot discipline, the donated epoch chain
+    #: across both slots, and the device-epoch-ordered host-mirror
+    #: drain; the slotepoch injected defect (slot-1 dispatches re-seed a
+    #: stale device epoch) must be caught by flow-column divergence
+    pipeline: bool = False
     #: > 0 = telemetry plane enabled with this count-min width
     #: (ISSUE-13): the op alphabet extends with TELEMETRY_KINDS, the
     #: classifier runs with a (deliberately tiny) SketchSpec + the
@@ -405,6 +414,18 @@ CONFIGS: Dict[str, StateConfig] = {
         # residentstale injected-defect acceptance, infw_lint state
         # --inject-defect residentstale) all surface here
         StateConfig("resident", flow=4096, witness_b=160, resident=True),
+        # overlapped multi-admission pipeline (ISSUE-16): the same flow
+        # alphabet with every flow_traffic op split across TWO pipeline
+        # slots materialized in reverse dispatch order (the host-mirror
+        # queue must drain in device-epoch order regardless) and then
+        # re-driven through the stacked superbatch device epoch loop
+        # (lax.scan carry chaining flow columns + epoch on-device) —
+        # oracle verdicts, statistics and the donated flow columns must
+        # all stay bit-identical.  The slotepoch injected-defect
+        # acceptance (infw_lint state --inject-defect slotepoch) runs
+        # this config under the stale-slot-1-epoch-reseed bug.
+        StateConfig("pipeline", flow=4096, witness_b=160, resident=True,
+                    pipeline=True),
         # device-resident telemetry plane (ISSUE-13): the TELEMETRY_
         # KINDS alphabet over the edit state machine — every count-min /
         # top-K / tenant-counter scatter the production dispatch
@@ -1484,26 +1505,101 @@ class _Driver:
         from ..testing import stats_dict_from_array
 
         for pass_i in range(2):
-            out = self.clf.classify(batch, apply_stats=False)
-            if not np.array_equal(out.results, ref.results):
-                bad = np.nonzero(out.results != ref.results)[0]
+            if self.config.pipeline:
+                # both pipeline legs per op: pass 1 = two in-flight
+                # slots materialized out of dispatch order, pass 2 =
+                # the stacked superbatch device epoch loop
+                results, stats_delta = self._classify_pipeline(
+                    batch, superbatch=pass_i == 1
+                )
+            else:
+                out = self.clf.classify(batch, apply_stats=False)
+                results, stats_delta = out.results, out.stats_delta
+            if not np.array_equal(results, ref.results):
+                bad = np.nonzero(results != ref.results)[0]
                 i = int(bad[0])
                 self._flow_failure = Failure(
                     -1, "flow-classify",
                     f"{len(bad)}/{len(batch)} flow_traffic verdict(s) "
                     f"diverge from the CPU oracle on pass {pass_i + 1} "
                     f"(seed {op.flow_seed})",
-                    f"first at packet {i}: got {int(out.results[i]):#x}, "
+                    f"first at packet {i}: got {int(results[i]):#x}, "
                     f"oracle {int(ref.results[i]):#x}",
                 )
                 return
-            if stats_dict_from_array(out.stats_delta) != ref.stats:
+            if stats_dict_from_array(stats_delta) != ref.stats:
                 self._flow_failure = Failure(
                     -1, "flow-stats",
                     f"flow_traffic statistics diverge on pass "
                     f"{pass_i + 1} (seed {op.flow_seed})",
                 )
                 return
+
+    def _classify_pipeline(self, batch, superbatch: bool):
+        """Drive one witness batch through the ISSUE-16 pipeline: split
+        into two equal half-admissions and either (a) dispatch both
+        back-to-back into the two pipeline slots and materialize in
+        REVERSE dispatch order — the host flow-model mirror must still
+        drain in device-epoch order — or (b) stack them into ONE
+        superbatch dispatch (the device-side epoch loop) and materialize
+        its per-row pendings in reverse.  An odd trailing packet rides a
+        single-admission dispatch.  Returns (results, summed stats)."""
+        n = len(batch)
+        k = n // 2
+        wire = batch.pack_wire()
+        flags = np.asarray(batch.tcp_flags, np.int32)
+        results = np.zeros(n, np.uint32)
+        stats = None
+        pends = []
+        if superbatch and k >= 1:
+            stack = np.ascontiguousarray(
+                np.stack([wire[:k], wire[k:2 * k]])
+            )
+            fstack = np.ascontiguousarray(np.stack([flags[:k],
+                                                    flags[k:2 * k]]))
+            plan = self.clf.prepare_packed_super(
+                stack, False, tcp_flags_stack=fstack
+            )
+            if plan is None:
+                raise RuntimeError(
+                    "superbatch dispatch fell back on the pipeline "
+                    "config (resident context unavailable?)"
+                )
+            pends = [
+                (p, np.arange(j * k, (j + 1) * k, dtype=np.int64))
+                for j, p in enumerate(
+                    self.clf.classify_prepared_super(
+                        plan, apply_stats=False
+                    )
+                )
+            ]
+        else:
+            for lo, hi in ((0, k), (k, 2 * k)):
+                if hi <= lo:
+                    continue
+                plan = self.clf.prepare_packed(
+                    wire[lo:hi], False, tcp_flags=flags[lo:hi]
+                )
+                pends.append((
+                    self.clf.classify_prepared(plan, apply_stats=False),
+                    np.arange(lo, hi, dtype=np.int64),
+                ))
+        if 2 * k < n:
+            plan = self.clf.prepare_packed(
+                wire[2 * k:], False, tcp_flags=flags[2 * k:]
+            )
+            pends.append((
+                self.clf.classify_prepared(plan, apply_stats=False),
+                np.arange(2 * k, n, dtype=np.int64),
+            ))
+        for pending, idx in reversed(pends):
+            out = pending.result()
+            results[idx] = out.results
+            stats = (
+                out.stats_delta if stats is None
+                else stats + out.stats_delta
+            )
+        return results, stats
 
     def _apply_telemetry(self, op: EditOp) -> None:
         """Drive the production telemetry plane: sketch_traffic

@@ -577,3 +577,127 @@ class MeshArenaClassifier:
 
     def close(self) -> None:
         self._closed = True
+
+
+class DeviceStripe:
+    """Per-device pipeline striping (ISSUE-16): ``width`` single-chip
+    ``TpuClassifier`` instances, each PINNED to one device of the pool,
+    each running its own donated resident pipeline — and optionally each
+    fed by its own shared-memory ingest ring.  Where
+    ``MeshTpuClassifier`` shards ONE dispatch over the ("data","rules")
+    mesh (scale a single admission), a stripe scales ADMISSION
+    THROUGHPUT: the scheduler round-robins whole admissions across the
+    stripe (``ContinuousScheduler(stripe=...)``), so k chips run k
+    independent overlapped epoch chains — per-device flow state, no
+    cross-chip synchronization on the serving path.
+
+    The two compose with the deployment: stripe across chips when flows
+    hash-partition cleanly at the NIC edge (per-device flow tables are
+    disjoint by construction), mesh-shard when one admission must span
+    the pool.
+    """
+
+    def __init__(self, devices=None, width: Optional[int] = None,
+                 ring_dir: Optional[str] = None,
+                 ring_slots: int = 16, ring_slot_packets: int = 4096,
+                 **clf_kw) -> None:
+        devices = list(jax.devices() if devices is None else devices)
+        if width is not None:
+            if width > len(devices):
+                raise ValueError(
+                    f"stripe width {width} exceeds the {len(devices)}-"
+                    "device pool"
+                )
+            devices = devices[:width]
+        if not devices:
+            raise ValueError("empty device stripe")
+        self.classifiers = [
+            TpuClassifier(device=d, **clf_kw) for d in devices
+        ]
+        #: per-device ingest rings (ring_dir/stripe<i>.ring) — one SPSC
+        #: ring per chip, so producers hash-partition flows at the edge
+        #: and each chip's pipeline drains its own ring cursor
+        self.rings = []
+        if ring_dir is not None:
+            import os as _os
+
+            from ..ring import IngestRing
+
+            for i in range(len(self.classifiers)):
+                self.rings.append(IngestRing.create(
+                    _os.path.join(ring_dir, f"stripe{i}.ring"),
+                    slots=ring_slots, slot_packets=ring_slot_packets,
+                ))
+        self._inflight = [[] for _ in self.classifiers]
+        self._rr = 0
+
+    @property
+    def width(self) -> int:
+        return len(self.classifiers)
+
+    def next_classifier(self):
+        """Round-robin admission target (the scheduler's stripe hook)."""
+        clf = self.classifiers[self._rr % len(self.classifiers)]
+        self._rr += 1
+        return clf
+
+    def load_tables(self, tables, **kw) -> None:
+        for clf in self.classifiers:
+            clf.load_tables(tables, **kw)
+
+    def mark_resident_warm(self) -> None:
+        for clf in self.classifiers:
+            if getattr(clf, "resident", None) is not None:
+                clf.mark_resident_warm()
+
+    def drain_rings_once(self, budget_per_device: int = 1 << 30) -> int:
+        """Pop committed records from every device's ring and dispatch
+        each on its OWN classifier, holding up to PIPELINE_SLOTS
+        admissions in flight per device before materializing (the same
+        overlap discipline as the daemon's single-ring ingest); slots
+        release in pop order.  Returns packets processed."""
+        from ..resident import ResidentPool
+
+        processed = 0
+        for i, (clf, ring) in enumerate(zip(self.classifiers, self.rings)):
+            infl = self._inflight[i]
+            done = 0
+            while done < budget_per_device:
+                chunk = ring.pop(timeout=0.0)
+                if chunk is None:
+                    break
+                plan = clf.prepare_packed(
+                    chunk.wire, chunk.v4_only, tcp_flags=chunk.tcp_flags,
+                )
+                pending = clf.classify_prepared(plan, apply_stats=True)
+                infl.append((chunk, pending))
+                done += chunk.wire.shape[0]
+                while len(infl) > ResidentPool.PIPELINE_SLOTS:
+                    c, p = infl.pop(0)
+                    p.result()
+                    c.release()
+            processed += done
+        for infl in self._inflight:
+            while infl:
+                c, p = infl.pop(0)
+                p.result()
+                c.release()
+        return processed
+
+    def counter_values(self) -> dict:
+        """Aggregated stripe gauges: per-device resident/ring counters
+        summed, plus the stripe width."""
+        out: dict = {"stripe_width": len(self.classifiers)}
+        for clf in self.classifiers:
+            for k, v in clf.resident_counters().items():
+                out[k] = out.get(k, 0) + v
+        for ring in self.rings:
+            for k, v in ring.counter_values().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def close(self) -> None:
+        for ring in self.rings:
+            ring.close()
+        for clf in self.classifiers:
+            clf.close()

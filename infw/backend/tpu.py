@@ -1016,6 +1016,7 @@ class TpuClassifier:
             alloc_note=pool.note_alloc, telemetry=tel, mlscore=ml,
         )
         pool.note("dispatches")
+        pool.note(f"slot{(epoch - 1) & 1}_dispatches")
         try:
             fused.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -1024,6 +1025,126 @@ class TpuClassifier:
         return {"resident": True, "fused": fused, "n": n, "kind": kind,
                 "epoch": epoch, "mlscore": ml is not None,
                 "pkt_len": self._wire4_pkt_len(wire_np)}
+
+    def prepare_packed_super(self, wire_stack: np.ndarray, v4_only: bool,
+                             tcp_flags_stack: Optional[np.ndarray] = None):
+        """Plan + DISPATCH ``k`` stacked same-shape admissions through
+        the superbatch device epoch program (ISSUE-16,
+        jaxpath.jitted_resident_superbatch): flow probe/insert, sketch
+        updates and anomaly-score state chain through the device-side
+        scan carry, with one stacked (k, L) fused readback instead of k
+        host round-trips — bit-identical to k sequential fused
+        dispatches by construction.  ``wire_stack`` is (k, b, w) with
+        every row one admission of ONE shape class (same b/w/v4_only/
+        flags presence — jit shape keying would recompile otherwise).
+        Returns None when the resident path cannot serve (the caller
+        falls back to k single-admission plans, degrade never
+        refuse)."""
+        if (
+            self._resident is None or self._flow is None
+            or wire_stack.ndim != 3 or wire_stack.shape[2] not in (4, 7)
+        ):
+            return None
+        tier = self._flow
+        pool = self._resident
+        # generation-ordering contract: flow-generation snapshot BEFORE
+        # the table snapshot (see resident_gens_snapshot)
+        gens_snap = tier.resident_gens_snapshot()
+        ctx = pool.context(self)
+        if ctx is None:
+            pool.note("fallbacks")
+            return None
+        k, n, w = wire_stack.shape
+        tel = self._telemetry
+        ml = self._mlscore
+        fn = jaxpath.jitted_resident_superbatch(
+            tier.config.entries, tier.config.ways, ctx.path,
+            bool(v4_only) and ctx.path == "trie", None, ctx.d_max,
+            ctx.ov_dev is not None,
+            sketch=tel.spec if tel is not None else None,
+            score=ml.spec if ml is not None else None,
+        )
+        tables_args = (
+            (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
+            else (ctx.tdev,)
+        )
+        wire_dev = pool.stage_wire(self, wire_stack.reshape(k * n, w))
+        wire_dev = wire_dev.reshape(k, n, w)
+        fused, epoch = tier.resident_dispatch_super(
+            fn, tables_args, wire_dev, k, n, wire_np=wire_stack,
+            tflags_np=tcp_flags_stack, gens_snap=gens_snap,
+            alloc_note=pool.note_alloc, telemetry=tel, mlscore=ml,
+        )
+        pool.note("dispatches")
+        pool.note("superbatch_dispatches")
+        pool.note("superbatch_admissions", k)
+        try:
+            fused.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self._note_wire(f"wire{w}", k * n, wire_stack.nbytes)
+        kinds = (wire_stack[:, :, 0] & 3).astype(np.int32)
+        pkt_lens = [self._wire4_pkt_len(wire_stack[j]) for j in range(k)]
+        return {"resident_super": True, "fused": fused, "k": k, "n": n,
+                "kinds": kinds, "epoch0": epoch - k,
+                "mlscore": ml is not None, "pkt_lens": pkt_lens}
+
+    def classify_prepared_super(self, plan, apply_stats: bool = True):
+        """Materialize half of a superbatch plan: ONE pending per
+        admission row, in dispatch order — the daemon pairs each with
+        its ring chunk and releases slots independently; out-of-order
+        result() calls stay correct because every tier's mirror queue
+        drains in device-epoch order (resident_note_materialized)."""
+        tier = self._flow
+        k, n, epoch0 = plan["k"], plan["n"], plan["epoch0"]
+
+        def make_row(j: int) -> PendingClassify:
+            epoch = epoch0 + 1 + j
+            kind = plan["kinds"][j]
+            pkt_len = plan["pkt_lens"][j]
+
+            def materialize() -> ClassifyOutput:
+                from ..daemon import stats_from_results  # lazy: no cycle
+
+                row = jaxpath.resident_fused_host((plan["fused"], j))
+                anom = scores = None
+                if plan.get("mlscore"):
+                    res16, _hit, hits, stale, counts, anom, scores = (
+                        jaxpath.split_resident_score_outputs(row, n)
+                    )
+                else:
+                    res16, _hit, hits, stale, counts = (
+                        jaxpath.split_resident_outputs(row, n)
+                    )
+                inserts, evictions, promotes = counts
+                tier.stats.add(
+                    hits=hits, misses=n - hits, stale_rejects=stale,
+                    inserts=inserts, evictions=evictions,
+                    promotes=promotes,
+                )
+                tier.resident_note_materialized(epoch)
+                if self._telemetry is not None:
+                    self._telemetry.resident_note_materialized(epoch)
+                if anom is not None and self._mlscore is not None:
+                    self._mlscore.resident_note_materialized(
+                        epoch, anom_np=anom, score_np=scores,
+                    )
+                if evictions and tier.on_evict is not None:
+                    try:
+                        tier.on_evict(evictions, inserts, epoch)
+                    except Exception:
+                        pass
+                results, xdp = jaxpath.host_finalize_wire(res16, kind)
+                stats_delta = stats_from_results(results, pkt_len)
+                if apply_stats:
+                    self._stats.add(stats_delta)
+                return ClassifyOutput(
+                    results=results, xdp=xdp, stats_delta=stats_delta
+                )
+
+            return PendingClassify(materialize)
+
+        return [make_row(j) for j in range(k)]
 
     def _launch_resident(self, plan, apply_stats: bool) -> PendingClassify:
         """Materialize half of the resident plan: ONE ~100 B fused

@@ -484,6 +484,7 @@ class Daemon:
         trace_slow_us: float = 50_000.0,
         mlscore=None,
         mlscore_mode: Optional[str] = None,
+        superbatch_k: Optional[int] = None,
     ) -> None:
         self.state_dir = state_dir
         self.node_name = node_name
@@ -554,6 +555,15 @@ class Daemon:
         # reallocation on the hot path); the popped slot views double
         # as the H2D staging buffers and are released only after the
         # dispatch that read them materialized.
+        # Device-side epoch loop (ISSUE-16): when the ring holds >= K
+        # committed chunks of one shape class, the ingest loop stacks
+        # them into ONE superbatch dispatch
+        # (jaxpath.jitted_resident_superbatch) — K admissions chew
+        # entirely on-device, one stacked fused readback.  K=1 disables
+        # gathering (every chunk rides the single fused path).
+        if superbatch_k is None:
+            superbatch_k = int(os.environ.get("INFW_SUPERBATCH_K", "1") or 1)
+        self.superbatch_k = max(1, int(superbatch_k))
         self.ingest_ring = None
         self._ring_inflight: deque = deque()
         if ring:
@@ -1582,20 +1592,14 @@ class Daemon:
         processed = 0
         inflight = self._ring_inflight
         tracer = getattr(self, "tracer", None)
-        while processed < budget:
-            t0 = time.perf_counter()
-            chunk = ring.pop(timeout=0.0)
-            if chunk is None:
-                break
-            trace = None
-            if tracer is not None:
-                # span taxonomy on the ring path: ingest = cursor pop,
-                # h2d = prepare_packed (staging device_put; the record
-                # arrives pre-packed so pack is the producer's cost),
-                # dispatch = program launch, materialize = readback,
-                # drain = slot release + bookkeeping
-                trace = tracer.begin(chunk.wire.shape[0])
-                trace.add("ingest", time.perf_counter() - t0)
+        super_k = self.superbatch_k
+        can_super = (
+            packed and super_k >= 2
+            and getattr(clf, "prepare_packed_super", None) is not None
+        )
+        carry: list = []  # popped-but-undispatched (shape-class break)
+
+        def dispatch_one(chunk, trace) -> bool:
             try:
                 if packed:
                     plan = clf.prepare_packed(
@@ -1618,11 +1622,91 @@ class Daemon:
             except Exception as e:
                 log.error("ring ingest dispatch failed: %s", e)
                 chunk.release()
-                continue
+                return False
             inflight.append((chunk, pending, trace))
-            processed += chunk.wire.shape[0]
+            return True
+
+        while processed < budget:
+            t0 = time.perf_counter()
+            chunk = carry.pop(0) if carry else ring.pop(timeout=0.0)
+            if chunk is None:
+                break
+            trace = None
+            if tracer is not None:
+                # span taxonomy on the ring path: ingest = cursor pop,
+                # h2d = prepare_packed (staging device_put; the record
+                # arrives pre-packed so pack is the producer's cost),
+                # dispatch = program launch, materialize = readback,
+                # drain = slot release + bookkeeping
+                trace = tracer.begin(chunk.wire.shape[0])
+                trace.add("ingest", time.perf_counter() - t0)
+            group = [chunk]
+            if can_super and not carry:
+                # gather up to K committed records of ONE shape class —
+                # same (n, width, v4_only, flags presence); the jit
+                # cache keys on exactly those, a mixed stack would
+                # recompile.  A mismatch carries to the next loop turn
+                # (releases stay in pop order either way).
+                while len(group) < super_k:
+                    try:
+                        nxt = ring.pop(timeout=0.0)
+                    except ValueError as e:
+                        log.error("ring ingest pop failed: %s", e)
+                        break
+                    if nxt is None:
+                        break
+                    if (nxt.wire.shape != chunk.wire.shape
+                            or nxt.v4_only != chunk.v4_only
+                            or (nxt.tcp_flags is None)
+                            != (chunk.tcp_flags is None)):
+                        carry.append(nxt)
+                        break
+                    group.append(nxt)
+            if len(group) >= 2:
+                # one stacked H2D (the stack copy is the staging write —
+                # slot views are not contiguous across slots) + ONE
+                # device epoch-loop dispatch for the whole group
+                wire_stack = np.stack([c.wire for c in group])
+                flags_stack = (
+                    None if chunk.tcp_flags is None
+                    else np.stack([c.tcp_flags for c in group])
+                )
+                plan = None
+                try:
+                    plan = clf.prepare_packed_super(
+                        wire_stack, chunk.v4_only,
+                        tcp_flags_stack=flags_stack,
+                    )
+                    if plan is not None:
+                        if trace is not None:
+                            trace.mark("h2d")
+                        pends = clf.classify_prepared_super(
+                            plan, apply_stats=True
+                        )
+                        if trace is not None:
+                            trace.mark("dispatch")
+                except Exception as e:
+                    log.error("ring superbatch dispatch failed: %s", e)
+                    plan = None
+                if plan is not None:
+                    for j, (c, p) in enumerate(zip(group, pends)):
+                        inflight.append((c, p, trace if j == 0 else None))
+                        processed += c.wire.shape[0]
+                    while len(inflight) > self.pipeline_depth:
+                        self._ring_drain_one()
+                    continue
+                # superbatch declined (resident fallback): serve each
+                # gathered record through the single-admission path
+            for j, c in enumerate(group):
+                if dispatch_one(c, trace if j == 0 else None):
+                    processed += c.wire.shape[0]
             while len(inflight) > self.pipeline_depth:
                 self._ring_drain_one()
+        # a shape-class break popped one record past the budget: it must
+        # still dispatch (releases are strictly in pop order)
+        for c in carry:
+            if dispatch_one(c, None):
+                processed += c.wire.shape[0]
         while inflight:
             self._ring_drain_one()
         return processed
@@ -1992,6 +2076,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--health-port", type=int, default=DEFAULT_HEALTH_PORT)
     p.add_argument("--ingest-chunk", type=int, default=DEFAULT_INGEST_CHUNK)
     p.add_argument("--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH)
+    p.add_argument(
+        "--superbatch-k", type=int, default=None,
+        help="stack up to K same-shape ring records into one device-side "
+             "epoch-loop dispatch (default INFW_SUPERBATCH_K or 1 = off)")
     p.add_argument("--max-tick-packets", type=int,
                    default=DEFAULT_MAX_TICK_PACKETS)
     p.add_argument("--event-ring-size", type=int, default=1 << 21,
@@ -2368,6 +2456,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_tick_packets=args.max_tick_packets,
         event_ring_size=args.event_ring_size,
         pipeline_depth=args.pipeline_depth,
+        superbatch_k=args.superbatch_k,
         events_socket=args.events_socket or None,
         fused_deep=False if args.no_fused_deep else None,
         wire_codec=args.wire_codec,

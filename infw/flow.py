@@ -83,6 +83,25 @@ def _inject_flow_stale_bug() -> bool:
     return env not in ("", "0", "false", "no")
 
 
+#: TEST-ONLY defect injection (ISSUE-16): when truthy (module flag or
+#: the INFW_INJECT_SLOT_EPOCH_BUG env var), the SECOND pipeline slot's
+#: resident dispatch skips the donated epoch chain — instead of riding
+#: the slot-0 dispatch's incremented device scalar it re-seeds from the
+#: host counter TWO behind, so the device stamps slot-1 inserts with a
+#: stale epoch while the host model stamps the true one.  The statecheck
+#: acceptance (tools/infw_lint.py state --inject-defect slotepoch) must
+#: catch this by flow-column divergence with a shrunk reproducer.
+#: Never set in production.
+_INJECT_SLOT_EPOCH_BUG = False
+
+
+def _inject_slot_epoch_bug() -> bool:
+    if _INJECT_SLOT_EPOCH_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_SLOT_EPOCH_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
 def _pow2(n: int) -> int:
     return max(8, 1 << (max(int(n), 1) - 1).bit_length())
 
@@ -491,6 +510,12 @@ class FlowTier:
         #: into the model in DEVICE order, and the insert half needs the
         #: merged verdicts — only host-resident at materialize time
         self._mirror_q: list = []
+        #: pipeline slot parity (ISSUE-16): resident dispatches
+        #: alternate between the two in-flight admission slots; the
+        #: counter only matters for observability and the slotepoch
+        #: injected-defect surface — the donated chain itself is
+        #: slot-agnostic (one device-ordered epoch sequence)
+        self._resident_slot = 0
         self.model = HostFlowModel(config) if track_model else None
 
     # -- generation / paging -------------------------------------------------
@@ -708,7 +733,20 @@ class FlowTier:
         with self._lock:
             self._epoch += 1
             epoch = self._epoch
-            if self._epoch_dev is not None and self._epoch_dev_val == epoch - 1:
+            slot = self._resident_slot
+            self._resident_slot ^= 1
+            if slot == 1 and _inject_slot_epoch_bug():
+                # TEST-ONLY (slotepoch defect): the second pipeline
+                # slot skips the donated epoch chain — it re-seeds TWO
+                # behind the host counter, so the device stamps slot-1
+                # inserts with a stale epoch while the host model
+                # stamps the true one (flow-column divergence at the
+                # next settled check)
+                epoch_dev = self._put(np.int32(epoch - 2))
+            elif (
+                self._epoch_dev is not None
+                and self._epoch_dev_val == epoch - 1
+            ):
                 epoch_dev = self._epoch_dev  # donated chain: no upload
             else:
                 # first dispatch, or a classic probe bumped the host
@@ -787,6 +825,120 @@ class FlowTier:
                 ))
         return fused, epoch
 
+    def resident_dispatch_super(self, fn, tables_args, wire_dev, k: int,
+                                b: int,
+                                wire_np: Optional[np.ndarray] = None,
+                                tenant_np: Optional[np.ndarray] = None,
+                                tflags_np: Optional[np.ndarray] = None,
+                                gens_snap=None, alloc_note=None,
+                                telemetry=None, mlscore=None):
+        """Run ONE superbatch device program over ``k`` stacked
+        admissions (jaxpath.jitted_resident_superbatch) and chain the
+        donated buffers exactly like ``resident_dispatch`` — the device
+        epoch advances ``k`` times INSIDE the program (the scan carry),
+        the host counter advances ``k`` here, and the model mirror
+        queues one entry PER ADMISSION, each referencing its row of the
+        stacked (k, L) fused readback — so out-of-order materialize
+        still drains in device-epoch order.  ``wire_np`` / ``tenant_np``
+        / ``tflags_np`` are (k, b[, w]) host stacks.  Returns
+        (fused stack, last epoch)."""
+        zt, zf = None, None
+        if tenant_np is None or tflags_np is None:
+            if (k, b) not in self._zeros_cache and alloc_note is not None:
+                alloc_note("zeros")
+            zt, zf = self._zeros((k, b))
+        tenant = (
+            zt if tenant_np is None
+            else self._put(np.ascontiguousarray(tenant_np, np.int32))
+        )
+        tflags = (
+            zf if tflags_np is None
+            else self._put(np.ascontiguousarray(tflags_np, np.int32))
+        )
+        with self._lock:
+            epoch0 = self._epoch
+            self._epoch += k
+            epoch = self._epoch
+            # both pipeline slots advance through one superbatch: keep
+            # the parity counter honest for the interleaved single path
+            self._resident_slot = (self._resident_slot + k) & 1
+            if (
+                self._epoch_dev is not None
+                and self._epoch_dev_val == epoch0
+            ):
+                epoch_dev = self._epoch_dev  # donated chain: no upload
+            else:
+                epoch_dev = self._put(np.int32(epoch0))
+                if alloc_note is not None:
+                    alloc_note("epoch")
+            gens_dev = self._gens_dev if gens_snap is None else gens_snap[0]
+            pages_dev = self._pages_dev
+
+            def run(sk_state=None, sc_ops=None):
+                ops = [self._flow, gens_dev, pages_dev, epoch_dev]
+                if sk_state is not None:
+                    ops.append(sk_state)
+                if sc_ops is not None:
+                    ops.extend(sc_ops)
+                return fn(*ops, *tables_args, wire_dev, tenant, tflags,
+                          self._max_age_dev)
+
+            if telemetry is not None and mlscore is not None:
+                def launch_sk(sk):
+                    held = {}
+
+                    def launch_sc(sc, model, tparams):
+                        nf, ne, sk2, sc2, fz = run(sk, (sc, model,
+                                                        tparams))
+                        held["sk2"] = sk2
+                        held["rest"] = (nf, ne, fz)
+                        return sc2, held["rest"]
+
+                    mlscore.resident_exchange_super(
+                        launch_sc, epoch0, k, wire_np, tenant_np,
+                        tflags_np,
+                    )
+                    return held["sk2"], held["rest"]
+
+                new_flow, new_epoch, fused = telemetry.resident_exchange_super(
+                    launch_sk, epoch0, k, wire_np, tenant_np, tflags_np,
+                )
+            elif telemetry is not None:
+                def launch(sk):
+                    nf, ne, sk2, fz = run(sk)
+                    return sk2, (nf, ne, fz)
+                new_flow, new_epoch, fused = telemetry.resident_exchange_super(
+                    launch, epoch0, k, wire_np, tenant_np, tflags_np,
+                )
+            elif mlscore is not None:
+                def launch(sc, model, tparams):
+                    nf, ne, sc2, fz = run(None, (sc, model, tparams))
+                    return sc2, (nf, ne, fz)
+                new_flow, new_epoch, fused = mlscore.resident_exchange_super(
+                    launch, epoch0, k, wire_np, tenant_np, tflags_np,
+                )
+            else:
+                new_flow, new_epoch, fused = run()
+            self._flow = new_flow
+            self._epoch_dev = new_epoch
+            self._epoch_dev_val = epoch
+            if self.model is not None:
+                gens_host = (
+                    self._gens_host.copy() if gens_snap is None
+                    else gens_snap[1]
+                )
+                wire_stack = np.asarray(wire_np, np.uint32)
+                for j in range(k):
+                    self._mirror_q.append((
+                        epoch0 + 1 + j, wire_stack[j].copy(),
+                        None if tenant_np is None else np.asarray(
+                            tenant_np[j], np.int32).copy(),
+                        None if tflags_np is None else np.asarray(
+                            tflags_np[j], np.int32).copy(),
+                        (fused, j), gens_host,
+                    ))
+        return fused, epoch
+
     def resident_seed_epoch(self) -> None:
         """Re-sync the device epoch chain to the host counter (one tiny
         upload).  Called at warm-mark time: the classic probe/insert
@@ -813,8 +965,11 @@ class FlowTier:
                 ep, wire_np, tenant_np, tflags_np, fused, gens_host = (
                     self._mirror_q.pop(0)
                 )
+                # a superbatch entry references one row of the stacked
+                # (k, L) readback; resident_fused_host blocks until the
+                # dispatch lands either way
                 res16, hit, _h, _s, _c = jaxpath.split_resident_outputs(
-                    np.asarray(fused), wire_np.shape[0]
+                    jaxpath.resident_fused_host(fused), wire_np.shape[0]
                 )
                 self.model.probe(wire_np, tenant_np, tflags_np, ep)
                 self.model.insert(

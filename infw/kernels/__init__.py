@@ -617,6 +617,38 @@ def _build_resident_ring_fused(b: int):
                 wire, zeros, zeros, max_age)
 
 
+@functools.lru_cache(maxsize=None)
+def _fixture_wire_stack(b: int, k: int = 2):
+    """K stacked (B, 7) wire batches for the superbatch epoch program —
+    rows rotated per admission so the K steps don't degenerate into
+    identical flow probes."""
+    import jax
+
+    w = _fixture_batch(b).pack_wire()
+    return jax.device_put(
+        np.stack([np.roll(w, j, axis=0) for j in range(k)])
+    )
+
+
+def _build_resident_superbatch_fused(b: int):
+    """The device-side epoch program (ISSUE-16): K=2 stacked admissions
+    chewed by one while-loop dispatch, flow columns + epoch chained
+    through the loop carry.  Donation matches the single step — the
+    carry must alias in place through the while loop or every
+    superbatch silently copies the whole flow slab K times."""
+    import jax
+
+    from . import jaxpath
+
+    cfg, flow, gens, pages, epoch, max_age, _z = _resident_operands(b)
+    zeros = jax.device_put(np.zeros((2, b), np.int32))
+    fn = jaxpath.jitted_resident_superbatch(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False
+    )
+    return fn, (flow, gens, pages, epoch, _fixture_device_tables(True),
+                _fixture_wire_stack(b), zeros, zeros, max_age)
+
+
 # -- telemetry-plane fixtures/builders (ISSUE-13) ----------------------------
 #
 # The device-resident sketch update (kernels.sketch): count-min + top-K
@@ -672,6 +704,25 @@ def _build_resident_telemetry_fused(b: int):
     return fn, (flow, gens, pages, epoch, _fresh_sketch_state(spec),
                 _fixture_device_tables(True), _fixture_wire(b), zeros,
                 zeros, max_age)
+
+
+def _build_resident_superbatch_telemetry_fused(b: int):
+    """The superbatch epoch program with the telemetry plane riding the
+    loop carry: sketch tensors donated and chained through the while
+    loop alongside the flow columns (ISSUE-16)."""
+    import jax
+
+    from . import jaxpath
+
+    spec = _telemetry_spec()
+    cfg, flow, gens, pages, epoch, max_age, _z = _resident_operands(b)
+    zeros = jax.device_put(np.zeros((2, b), np.int32))
+    fn = jaxpath.jitted_resident_superbatch(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False, sketch=spec
+    )
+    return fn, (flow, gens, pages, epoch, _fresh_sketch_state(spec),
+                _fixture_device_tables(True), _fixture_wire_stack(b),
+                zeros, zeros, max_age)
 
 
 # -- anomaly-scoring fixtures/builders (ISSUE-14) ----------------------------
@@ -963,12 +1014,20 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
             _build_resident_ring_fused, donate=(0, 3),
         ),
         KernelEntrypoint(
+            "classify-wire/resident-superbatch-fused", "xla",
+            _build_resident_superbatch_fused, donate=(0, 3),
+        ),
+        KernelEntrypoint(
             "telemetry/sketch-update", "xla", _build_sketch_update,
             donate=(0,),
         ),
         KernelEntrypoint(
             "classify-wire/resident-telemetry-fused", "xla",
             _build_resident_telemetry_fused, donate=(0, 3, 4),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-superbatch-telemetry-fused", "xla",
+            _build_resident_superbatch_telemetry_fused, donate=(0, 3, 4),
         ),
         KernelEntrypoint(
             "mlscore/score-update", "xla", _build_score_update,

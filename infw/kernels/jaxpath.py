@@ -5093,3 +5093,108 @@ def jitted_resident_step(
 
     return jax.jit(f, donate_argnums=resident_donate_argnums(has_sk,
                                                              has_sc))
+
+
+# === resident superbatch: the device-side epoch loop (ISSUE-16) ==============
+#
+# K stacked admissions chewed through in ONE device program: the fused
+# step's body runs under a lax.scan (an XLA while loop with stacked
+# outs), the donated flow columns / epoch scalar / sketch / score state
+# chained through the loop CARRY — no intermediate host round-trips, no
+# per-admission Python dispatch.  Bit-identity is by construction: the
+# scan body IS _resident_step_core, the same integer-deterministic
+# function K sequential jitted_resident_step dispatches run, applied to
+# the same carry chain in the same order — verdicts, statistics, flow
+# columns and sketch/score state all land bit-identical (pinned by the
+# statecheck `pipeline` config and the bench_pipeline identity gate).
+# The fused readbacks stack to one (K, L) buffer: the host splits rows
+# with resident_fused_host and drains the model mirrors per admission
+# in device-epoch order exactly as on the single-step path.
+
+
+def resident_fused_host(fused) -> np.ndarray:
+    """Host view of ONE admission's fused readback: either a bare
+    fused buffer (single-step dispatch) or a ``(stack, row)`` pair
+    referencing one row of a superbatch's stacked (K, L) readback.
+    np.asarray blocks until the dispatch lands — the mirror-queue
+    drain's ordering contract."""
+    if isinstance(fused, tuple):
+        stack, row = fused
+        return np.asarray(stack)[int(row)]
+    return np.asarray(fused)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_resident_superbatch(
+    slab_entries: int, ways: int, path: str, v4_only: bool = False,
+    depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
+    sketch=None, score=None,
+):
+    """The K-admission device epoch program, cache-keyed exactly like
+    jitted_resident_step (K and the batch shape specialize through
+    jit's shape keying — a warmed (K, B, W) shape recompiles never).
+
+    Operand order matches the single-step factory with the wire/tenant/
+    tflags operands STACKED along a leading K axis: f(flow, gens,
+    page_table, epoch, [sk], [sc, model, tparams], tables[, overlay],
+    wire (K, B, W), tenant (K, B), tflags (K, B), max_age) -> (flow',
+    epoch', [sk'], [sc'], fused (K, L)).  Donation is identical to the
+    single step (flow, epoch, sketch, score) — XLA aliases the carry
+    in place through the while loop, verified against the compiled
+    HLO by the jaxcheck donation lint."""
+    kw = dict(slab_entries=slab_entries, ways=ways, path=path,
+              v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch,
+              score=score)
+    has_sk = sketch is not None
+    has_sc = score is not None
+
+    def f(*args):
+        flow, gens, page_table, epoch = args[:4]
+        i = 4
+        sk = sc = model = tparams = None
+        if has_sk:
+            sk = args[i]
+            i += 1
+        if has_sc:
+            sc, model, tparams = args[i], args[i + 1], args[i + 2]
+            i += 3
+        tdev = args[i]
+        i += 1
+        ov = None
+        if overlay:
+            ov = args[i]
+            i += 1
+        wire, tenant, tflags, max_age = args[i : i + 4]
+
+        def body(carry, xs):
+            fl, ep, skc, scc = carry
+            w, tn, tf = xs
+            out = _resident_step_core(
+                fl, gens, page_table, ep, tdev, w, tn, tf, max_age,
+                ov=ov, sk=skc, sc=scc, model=model, tparams=tparams,
+                **kw,
+            )
+            fl2, ep2 = out[0], out[1]
+            j = 2
+            sk2 = sc2 = None
+            if has_sk:
+                sk2 = out[j]
+                j += 1
+            if has_sc:
+                sc2 = out[j]
+                j += 1
+            return (fl2, ep2, sk2, sc2), out[-1]
+
+        (flow2, e2, sk2, sc2), fused = jax.lax.scan(
+            body, (flow, epoch, sk, sc), (wire, tenant, tflags)
+        )
+        outs = [flow2, e2]
+        if has_sk:
+            outs.append(sk2)
+        if has_sc:
+            outs.append(sc2)
+        outs.append(fused)
+        return tuple(outs)
+
+    return jax.jit(f, donate_argnums=resident_donate_argnums(has_sk,
+                                                             has_sc))

@@ -489,12 +489,42 @@ class AnomalyTier:
                 )
         return rest
 
+    def resident_exchange_super(self, launch, epoch0: int, k: int,
+                                wire_np, tenant_np, tflags_np):
+        """The superbatch variant of ``resident_exchange`` (ISSUE-16):
+        one launch carries ``k`` stacked admissions with the donated
+        score state chained through the device-side scan carry; the
+        model mirror queues ``k`` entries, one per admission, each
+        holding its row of the stacked (k, L) fused readback."""
+        with self._lock:
+            sc2, rest = launch(
+                self._state, self._model_dev, self._tparams_dev
+            )
+            self._state = sc2
+            self._admissions += k
+            self._window_admissions += k
+            self._note("updates", k)
+            if self.model is not None:
+                fused = rest[-1]
+                wire_stack = np.asarray(wire_np, np.uint32)
+                for j in range(k):
+                    self._mirror_q.append(
+                        (wire_stack[j].copy(),
+                         None if tenant_np is None
+                         else np.asarray(tenant_np[j], np.int32).copy(),
+                         None if tflags_np is None
+                         else np.asarray(tflags_np[j], np.int32).copy(),
+                         None, (fused, j))
+                    )
+        return rest
+
     def _replay_ready_locked(self) -> None:
         """Drain the head of the mirror queue in device order (the
         TelemetryTier shape): a resident entry's verdicts live in its
-        fused buffer — np.asarray blocks until the dispatch lands,
-        which keeps classic entries behind it in order.  Shadow-only:
-        the fused res16 IS the pre-policy rule verdict vector."""
+        fused buffer (or its row of a superbatch's stacked readback) —
+        resident_fused_host blocks until the dispatch lands, which
+        keeps classic entries behind it in order.  Shadow-only: the
+        fused res16 IS the pre-policy rule verdict vector."""
         from .kernels import jaxpath
 
         while self._mirror_q:
@@ -502,7 +532,7 @@ class AnomalyTier:
             if res is None:
                 res16, _hit, _h, _s, _c, _an, _sc = (
                     jaxpath.split_resident_score_outputs(
-                        np.asarray(fused), wire.shape[0]
+                        jaxpath.resident_fused_host(fused), wire.shape[0]
                     )
                 )
                 res = res16.astype(np.uint32)

@@ -348,9 +348,37 @@ class TelemetryTier:
                 )
         return rest
 
+    def resident_exchange_super(self, launch: Callable, epoch0: int,
+                                k: int, wire_np, tenant_np, tflags_np):
+        """The superbatch variant of ``resident_exchange`` (ISSUE-16):
+        one launch carries ``k`` stacked admissions, the donated sketch
+        state chained through the device-side scan carry — so the model
+        mirror queues ``k`` entries, one per admission, each holding its
+        row of the stacked (k, L) fused readback."""
+        with self._lock:
+            sk2, rest = launch(self._state)
+            self._state = sk2
+            self._admissions += k
+            self._window_admissions += k
+            self._note("updates", k)
+            if self.model is not None:
+                fused = rest[-1]
+                wire_stack = np.asarray(wire_np, np.uint32)
+                for j in range(k):
+                    self._mirror_q.append(
+                        (wire_stack[j].copy(),
+                         None if tenant_np is None
+                         else np.asarray(tenant_np[j], np.int32).copy(),
+                         None if tflags_np is None
+                         else np.asarray(tflags_np[j], np.int32).copy(),
+                         None, (fused, j))
+                    )
+        return rest
+
     def _replay_ready_locked(self) -> None:
         """Drain the head of the mirror queue in device order.  A
-        resident entry's verdicts live in its fused buffer — np.asarray
+        resident entry's verdicts live in its fused buffer (or its row
+        of a superbatch's stacked readback) — resident_fused_host
         blocks until the dispatch lands, which is correct (the entry is
         already in flight) and keeps classic entries behind it in
         order."""
@@ -360,7 +388,7 @@ class TelemetryTier:
             wire, tenant, tflags, res, fused = self._mirror_q[0]
             if res is None:
                 res16, _hit, _h, _s, _c = jaxpath.split_resident_outputs(
-                    np.asarray(fused), wire.shape[0]
+                    jaxpath.resident_fused_host(fused), wire.shape[0]
                 )
                 res = res16.astype(np.uint32)
             self.model.update(wire, res, tenant, tflags)

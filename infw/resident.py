@@ -77,12 +77,24 @@ class ResidentPool:
     operands; the pool's own lock guards only its cache and counters.
     """
 
+    #: two in-flight admissions (the ISSUE-16 pipeline): slot N+1's
+    #: pack/encode/H2D and slot N-1's fused readback overlap slot N's
+    #: compute — the bound the daemon's ring ingest and the scheduler's
+    #: stage_depth both honor on the resident path
+    PIPELINE_SLOTS = 2
+
     def __init__(self, device=None) -> None:
         self._lock = threading.Lock()
         self._ctx: Optional[ResidentContext] = None
         self._device = device
         self.counters = {
             "allocs": 0, "reuses": 0, "dispatches": 0, "fallbacks": 0,
+            # superbatch (device-side epoch loop, ISSUE-16): one
+            # dispatch chews k stacked admissions entirely on-device
+            "superbatch_dispatches": 0, "superbatch_admissions": 0,
+            # per-pipeline-slot dispatch parity (observability: a stuck
+            # slot shows as one counter flatlining)
+            "slot0_dispatches": 0, "slot1_dispatches": 0,
         }
         #: allocation count at warm-completion (mark_warm): the serving-
         #: path gate is allocs - warm_allocs == 0

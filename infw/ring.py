@@ -100,7 +100,14 @@ class IngestRing:
         self.slots = int(slots)
         self.slot_bytes = int(slot_bytes)
         self._u64 = np.frombuffer(mm, np.uint64, 6, 0)
-        self._stats = {"pushed": 0, "popped": 0, "blocked_waits": 0}
+        #: per-process (producer and consumer each attach their own
+        #: instance): depth_hwm is the occupancy high-watermark this
+        #: side observed; blocked_us is cumulative wall time reserve()
+        #: spent waiting on a full ring — the PRODUCER-side backpressure
+        #: signal, distinct from falling behind an open-loop schedule
+        #: (tools/loadgen.py --ring splits the two in its manifest)
+        self._stats = {"pushed": 0, "popped": 0, "blocked_waits": 0,
+                       "depth_hwm": 0, "blocked_us": 0}
         #: consumer-side read cursor: records between tail and here are
         #: popped but not yet released (their slot views may be in
         #: flight as H2D staging buffers) — the producer only reuses
@@ -249,11 +256,21 @@ class IngestRing:
             )
         deadline = None if timeout is None else time.monotonic() + timeout
         seq = self.head
+        t_block = None
         while seq - self.tail >= self.slots:
+            if t_block is None:
+                t_block = time.monotonic()
             self._stats["blocked_waits"] += 1
             if deadline is not None and time.monotonic() > deadline:
+                self._stats["blocked_us"] += int(
+                    (time.monotonic() - t_block) * 1e6
+                )
                 raise TimeoutError("ingest ring full (consumer stalled)")
             time.sleep(0.0005)
+        if t_block is not None:
+            self._stats["blocked_us"] += int(
+                (time.monotonic() - t_block) * 1e6
+            )
         off = self._slot_off(seq)
         hdr32 = np.frombuffer(self._mm, np.uint32, 4, off + 8)
         flags = (FLAG_TCP_FLAGS if with_flags else 0)
@@ -281,6 +298,9 @@ class IngestRing:
         np.frombuffer(self._mm, np.uint64, 1, off)[0] = seq + 1
         self._u64[3] = seq + 1
         self._stats["pushed"] += 1
+        depth = len(self)
+        if depth > self._stats["depth_hwm"]:
+            self._stats["depth_hwm"] = depth
         return seq
 
     def push(self, wire: np.ndarray, v4_only: bool = False,
@@ -343,6 +363,9 @@ class IngestRing:
                 off + _SLOT_HEADER_BYTES + n * width * 4,
             )
         self._stats["popped"] += 1
+        depth = self.head - seq
+        if depth > self._stats["depth_hwm"]:
+            self._stats["depth_hwm"] = depth
         self._read_seq = seq + 1
         return RingChunk(self, seq, wire, fl, bool(flags & FLAG_V4_ONLY))
 
@@ -354,7 +377,9 @@ class IngestRing:
             "ring_pushed_total": self._stats["pushed"],
             "ring_popped_total": self._stats["popped"],
             "ring_blocked_waits_total": self._stats["blocked_waits"],
+            "ring_blocked_us_total": self._stats["blocked_us"],
             "ring_depth": len(self),
+            "ring_depth_hwm": self._stats["depth_hwm"],
             "ring_slots": self.slots,
         }
 

@@ -509,8 +509,16 @@ class ContinuousScheduler:
         txn_batcher=None,
         txn_flush: Optional[Callable] = None,
         tracer=None,
+        stripe=None,
     ) -> None:
         self.clf = clf
+        #: per-device admission striping (ISSUE-16,
+        #: backend.mesh.DeviceStripe): when given, every PRIMARY job
+        #: (not spill, not oversized-split) round-robins across the
+        #: stripe's pinned classifiers — k chips run k independent
+        #: overlapped pipelines; spill and tenant jobs keep their
+        #: explicit targets
+        self.stripe = stripe
         self.policy = policy
         self.spill_clf = spill_clf
         #: update-storm interleaving (infw.txn): when a TxnBatcher and a
@@ -745,6 +753,12 @@ class ContinuousScheduler:
                 _push_job(self.clf, g, False)
 
         def _push_job(target, idx, spilled: bool) -> None:
+            if target is self.clf and self.stripe is not None:
+                # device round-robin: each admission lands whole on one
+                # chip of the stripe (its own flow state and donated
+                # epoch chain) — striping scales admissions/s, the mesh
+                # spill target scales one admission
+                target = self.stripe.next_classifier()
             cap = self.policy.max_admit * max(spill_width, 1)
             bucket = ladder_bucket(len(idx), max(cap, len(idx)))
             self.stats.note_admit(len(idx), bucket, spilled=spilled)

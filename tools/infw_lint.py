@@ -303,7 +303,8 @@ def cmd_jax(args) -> int:
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
                          "arena-ctrie", "arena-cow", "flow", "flow-ctrie",
-                         "resident", "telemetry", "telemetry-resident")
+                         "resident", "pipeline", "telemetry",
+                         "telemetry-resident")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -362,6 +363,14 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # single edit op
         "residentstale": (resident_mod, "_INJECT_RESIDENT_STALE_BUG",
                           "resident", 3),
+        # stale slot-1 epoch re-seed (ISSUE-16): every second resident
+        # dispatch (pipeline slot 1) silently re-uploads the device
+        # epoch at epoch-2 instead of chaining the donated scalar, so
+        # the device stamps flow rows one epoch behind the host model —
+        # caught by the pipeline config's flow-column bit-identity pass
+        # at the first settled check (one flow_traffic op dispatches
+        # both slots), shrinking to a single traffic op plus slack
+        "slotepoch": (flow_mod, "_INJECT_SLOT_EPOCH_BUG", "pipeline", 3),
         # dropped count-min saturation clamp (infw.kernels.sketch): the
         # DEVICE sketch update stops clamping at ``sat`` while the host
         # model keeps clamping — the telemetry config's tiny sat makes
@@ -563,7 +572,7 @@ def main(argv=None) -> int:
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
                                   "cowleak", "flowstale", "residentstale",
-                                  "sketchsat", "mlquant"),
+                                  "slotepoch", "sketchsat", "mlquant"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

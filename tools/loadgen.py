@@ -278,6 +278,15 @@ def _ring_main(args, rng, offs) -> int:
     ring = IngestRing.attach(args.ring)
     t0 = time.monotonic()
     worst_lag = 0.0
+    # two DIFFERENT failure signals an open-loop producer must not
+    # conflate: time reserve() spent blocked on a full ring is CONSUMER
+    # backpressure (the daemon fell behind; by design the ring blocks
+    # rather than drops), while lag beyond that is the PRODUCER falling
+    # behind its own schedule (pack/copy too slow for --rate).  The
+    # ring's blocked_us counter (this process's attach) provides the
+    # split.
+    worst_producer_lag = 0.0
+    blocked_s = 0.0
     for i in range(n_rec):
         target = t0 + float(rec_starts[i])
         lag = time.monotonic() - target
@@ -285,6 +294,7 @@ def _ring_main(args, rng, offs) -> int:
             time.sleep(-lag)
         else:
             worst_lag = max(worst_lag, lag)
+            worst_producer_lag = max(worst_producer_lag, lag - blocked_s)
         lo, hi = i * fp, min((i + 1) * fp, args.n)
         # fused subset pack straight from the SoA columns, then one
         # in-place copy into the reserved (mapped) slot — the producer
@@ -301,18 +311,27 @@ def _ring_main(args, rng, offs) -> int:
         if fl is not None and flags is not None:
             np.copyto(fl, flags[lo:hi])
         ring.commit(token, v4_only=v4_only)
+        blocked_s = ring.counter_values()["ring_blocked_us_total"] / 1e6
     done = time.monotonic() - t0
     print(json.dumps({
         "offered_duration_s": float(offs[-1]),
         "actual_duration_s": done,
         "worst_schedule_lag_s": worst_lag,
-        "fell_behind": worst_lag > 0.01,
+        "worst_producer_lag_s": worst_producer_lag,
+        "ring_blocked_s": blocked_s,
+        "ring_backpressured": blocked_s > 0.01,
+        "fell_behind": worst_producer_lag > 0.01,
         **{k: int(v) for k, v in ring.counter_values().items()},
     }), flush=True)
-    if worst_lag > 0.01:
+    if blocked_s > 0.01:
+        print("loadgen: WARNING ring backpressure blocked the producer "
+              f"for {blocked_s*1e3:.1f} ms total (consumer fell behind) "
+              "— offered load was lower than requested",
+              file=sys.stderr)
+    if worst_producer_lag > 0.01:
         print("loadgen: WARNING fell behind its open-loop schedule by "
-              f"{worst_lag*1e3:.1f} ms (ring backpressure or a slow "
-              "producer) — offered load was lower than requested",
+              f"{worst_producer_lag*1e3:.1f} ms net of ring blocking "
+              "(slow producer) — offered load was lower than requested",
               file=sys.stderr)
     return 0
 
