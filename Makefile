@@ -72,7 +72,12 @@ lint:
 #      the donor page's refcount decrement (jaxpath._INJECT_COWLEAK_
 #      BUG); check_arena's refcount-vs-page-table-rows invariant must
 #      catch it on the shared-then-edited-biased arena-cow config;
-#   5. the strict jax audit must FAIL on a deliberately injected
+#   5. --inject-defect spliceleak makes the subtree-splicing arena's
+#      unsplice path forget the old plane's refcount decrement
+#      (jaxpath._INJECT_SPLICELEAK_BUG); check_arena's plane-refcount-
+#      vs-splice-row-recount invariant must catch it on the near-copy-
+#      biased arena-splice config;
+#   6. the strict jax audit must FAIL on a deliberately injected
 #      implicit host->device transfer (and pass without it — the plain
 #      strict audit runs in entry-check/static-check).
 # Must be green before any bench record is published (benchruns/README).
@@ -83,6 +88,7 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect fold
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect pageflip
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect cowleak
+	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect spliceleak
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect flowstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect residentstale
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect slotepoch
@@ -175,6 +181,21 @@ churn-bench:
 tenant-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --tenant-bench
 
+# The structural-compression ladder (bench.bench_splice) standalone at
+# smoke scale off-TPU: a drift chain of similar-NOT-identical tenants
+# (every tenant a k-edit delta of its predecessor, k in {1, 16, 256})
+# through the shared-subtree splice layer — HBM bytes/tenant vs one
+# flat slab per tenant (gated on INFW_SPLICE_BYTES_RATIO_MIN, default
+# 10x at the k=16 rung over 2.5K CPU tenants, the ISSUE-17
+# acceptance), the splice-indirect walk-latency tax vs a flat arena
+# (INFW_SPLICE_WALK_TAX_MAX, default 2x, interleaved min-vs-min), and
+# the zero-recompile warm drift lifecycle.  Sampled tenants are
+# oracle-checked bit-exact inside the tier, and the arena-splice
+# statecheck config runs BEFORE any record is published.
+# INFW_SPLICE_TENANTS overrides the gate rung's tenant count.
+splice-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --splice-bench
+
 # The stateful flow tier (bench.bench_flow) standalone at smoke scale
 # off-TPU: classify throughput at the 0/50/90/99% established-flow
 # ladder (flow tier vs the stateless baseline, interleaved, verdicts
@@ -250,7 +271,7 @@ pipeline-bench:
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench flow-bench resident-bench telemetry-bench mlscore-bench pipeline-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench splice-bench flow-bench resident-bench telemetry-bench mlscore-bench pipeline-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -2473,6 +2473,298 @@ def bench_tenant(rng, on_tpu):
     return out
 
 
+def bench_splice(rng, on_tpu):
+    """Structural-compression ladder (ISSUE-17, ``make splice-bench``,
+    folded into bench-checked) — the similar-NOT-identical extension of
+    the tenant tier's CoW ladder.  Content addressing (ISSUE-15) only
+    pays off for bit-identical rulesets; this tier measures the
+    subtree-plane splice layer on a drift chain of tenants where every
+    tenant is a k-edit delta of its predecessor (no two identical):
+
+    - **bytes/tenant rungs** at k ∈ {1, 16, 256} rules-edits between
+      neighbours: resident HBM of the spliced pool (shared trunk pages
+      + refcounted subtree planes + splice banks) vs one flat slab per
+      tenant, k=16 over 2.5K CPU / 10K TPU tenants is the gate rung
+      (INFW_SPLICE_BYTES_RATIO_MIN);
+    - **walk-latency tax**: the same 64-tenant mixed batch through the
+      spliced arena vs a flat (unspliced) arena holding identical
+      tables, interleaved min-vs-min — the splice indirection must
+      cost <2x (INFW_SPLICE_WALK_TAX_MAX);
+    - **oracle gate**: sampled tenants' verdicts bit-identical to
+      per-tenant CPU oracles through the spliced fused dispatch BEFORE
+      any timing or footprint line;
+    - **zero-recompile pin**: k more drift edits + a fresh tenant load
+      + classify on the warm arena must compile nothing.
+
+    The base table puts one deep entry (alternating /24 subnet and /32
+    host — the two masks whose subtrees leaf-push to a single target
+    row) in each of 192 distinct /16s, so every l0 slot owns exactly
+    one plane-eligible subtree and a k-edit delta dirties exactly k
+    subtrees.  Returns the record dict for the splice-bench gates."""
+    from infw import oracle as oracle_mod, packets as packets_mod
+    from infw.compiler import IncrementalTables as _IT, LpmKey
+
+    out = {}
+    width = 4
+    n_keys = 192
+    base_content = {}
+    for i in range(n_keys):
+        mask = 24 if i % 2 else 32
+        data = bytes(
+            [10 + (i >> 8), i & 0xFF, 1 + (i % 254), i % 251]
+        ) + bytes(12)
+        base_content[LpmKey(mask + 32, 2, data)] = testing.random_rules(
+            rng, width
+        )
+    base = _IT.from_content(dict(base_content), rule_width=width).snapshot()
+    keys = sorted(base_content, key=lambda k: k.ip_data)
+
+    gate_tenants = int(os.environ.get(
+        "INFW_SPLICE_TENANTS", "10240" if on_tpu else "2560"
+    ))
+    ladder = (
+        (1, max(gate_tenants // 5, 8)),
+        (16, gate_tenants),
+        (256, max(gate_tenants // 10, 8)),
+    )
+    for k, n_t in ladder:
+        erng = np.random.default_rng(31000 + k)
+        upd = _IT.from_content(dict(base_content), rule_width=width)
+        # plane pool sized to the rung's DISTINCT subtree versions:
+        # the 192 base subtrees plus one new plane per edit (a k-edit
+        # delta dirties min(k, 192) subtrees per tenant)
+        planes = n_keys + n_t * min(k, n_keys) + 64
+        spec = jaxpath.arena_spec_for(
+            "ctrie", (base,), pages=8, max_tenants=n_t + 8,
+            headroom=1.5, plane_slots=planes, plane_node_rows=8,
+            plane_target_rows=8, plane_joined_rows=8, splice_slots=256,
+        )
+        al = jaxpath.ArenaAllocator(spec)
+        al.load_tenant(0, base)
+        sample_ids = sorted({0, 1, n_t // 3, n_t // 2, n_t - 1})
+        snaps = {0: base}
+        snaps64 = [base]
+        cur = 0
+        t0 = time.perf_counter()
+        for t in range(1, n_t):
+            edits = {}
+            for j in range(k):
+                edits[keys[(cur + j) % n_keys]] = testing.random_rules(
+                    erng, width
+                )
+            cur = (cur + k) % n_keys
+            upd.apply(edits, [])
+            snap = upd.snapshot()
+            al.load_tenant(t, snap)
+            if t in sample_ids:
+                snaps[t] = snap
+            if len(snaps64) < 64:
+                snaps64.append(snap)
+        create_s = time.perf_counter() - t0
+
+        # -- footprint: spliced pool vs one flat slab per tenant ------------
+        ar = al.arena
+        P = spec.pages
+        nb = ar.nodes.nbytes // ar.nodes.shape[0]
+        tb = ar.targets.nbytes // ar.targets.shape[0]
+        jb = ar.joined.nbytes // ar.joined.shape[0]
+        slab_b = (ar.l0.nbytes // P + ar.root_lut.nbytes // P
+                  + spec.node_rows * nb + spec.target_rows * tb
+                  + spec.joined_rows * jb)
+        plane_b = (spec.plane_node_rows * nb
+                   + spec.plane_target_rows * tb
+                   + spec.plane_joined_rows * jb)
+        cnt = al.counter_values()
+        trunk_pages = cnt["tenant_distinct_slabs"]
+        n_planes = al.distinct_planes()
+        if trunk_pages > 4:
+            raise RuntimeError(
+                f"splice ladder k={k}: {trunk_pages} trunk pages live — "
+                "the drift chain fell back to whole-slab tenants"
+            )
+        spliced_total = (trunk_pages * slab_b + n_planes * plane_b
+                         + ar.splice.nbytes + ar.page_table.nbytes)
+        spliced_pt = spliced_total / n_t
+        flat_pt = slab_b + 4  # full slab + its page-table row
+        ratio = flat_pt / max(spliced_pt, 1e-9)
+        log(f"splice ladder k={k:3d} @{n_t} tenants: "
+            f"{spliced_pt/1e3:.1f} KB/tenant spliced "
+            f"({trunk_pages} trunk page(s), {n_planes} planes) vs "
+            f"{flat_pt/1e3:.1f} KB flat ({ratio:.1f}x), "
+            f"{n_t} creates in {create_s:.1f} s")
+        emit(f"splice HBM bytes/tenant @k={k} of {n_t}", spliced_pt, "B",
+             vs_baseline=0.0)
+        emit(f"splice bytes/tenant reduction @k={k}", ratio, "x",
+             vs_baseline=0.0)
+        out[f"splice_bytes_ratio_k{k}"] = float(ratio)
+
+        # -- oracle gate: sampled tenants bit-identical to CPU oracles ------
+        fn = jaxpath.jitted_classify_arena_wire_fused(
+            "ctrie", spec.pages, spec.d_max, spec=spec
+        )
+        for t in sample_ids:
+            tab = snaps[t]
+            b = testing.random_batch(
+                np.random.default_rng(500 + t), tab, 64
+            )
+            fused = fn(
+                al.arena, jax.device_put(b.pack_wire()),
+                jax.device_put(np.full(len(b), t, np.int32)),
+            )
+            res16, _ = jaxpath.split_wire_outputs(np.asarray(fused), len(b))
+            got, _ = jaxpath.host_finalize_wire(res16, np.asarray(b.kind))
+            want = oracle_mod.classify(tab, b).results
+            if not np.array_equal(got, want):
+                raise RuntimeError(
+                    f"splice ladder k={k} oracle mismatch tenant {t}: "
+                    f"{int((got != want).sum())}/{len(b)} verdicts"
+                )
+        log(f"splice ladder k={k} oracle bit-identity OK "
+            f"({len(sample_ids)} sampled tenants)")
+
+        if k != 16:
+            del al
+            continue
+
+        # -- walk-latency tax vs a flat arena (gate rung only) --------------
+        n_lat = min(64, n_t)
+        flat_spec = jaxpath.arena_spec_for(
+            "ctrie", (base,), pages=n_lat + 2, max_tenants=n_lat + 2,
+            headroom=1.5,
+        )
+        flat = jaxpath.ArenaAllocator(flat_spec)
+        parts, tags, wants = [], [], []
+        for t in range(n_lat):
+            flat.load_tenant(t, snaps64[t])
+            b = testing.random_batch(
+                np.random.default_rng(900 + t), snaps64[t], 16
+            )
+            parts.append(b)
+            tags.append(np.full(len(b), t, np.int32))
+            wants.append(oracle_mod.classify(snaps64[t], b).results)
+        batch = packets_mod.concat(parts)
+        tenant = np.concatenate(tags)
+        want = np.concatenate(wants)
+        B = len(batch)
+        wire = jax.device_put(batch.pack_wire())
+        tenant_dev = jax.device_put(tenant)
+        fn_flat = jaxpath.jitted_classify_arena_wire_fused(
+            "ctrie", flat_spec.pages, flat_spec.d_max
+        )
+        kinds = np.asarray(batch.kind)
+        for name, f, arena in (
+            ("spliced", fn, al.arena), ("flat", fn_flat, flat.arena)
+        ):
+            res16, _ = jaxpath.split_wire_outputs(
+                np.asarray(f(arena, wire, tenant_dev)), B
+            )
+            got, _ = jaxpath.host_finalize_wire(res16, kinds)
+            if not np.array_equal(got, want):
+                raise RuntimeError(
+                    f"splice walk-tax oracle mismatch on the {name} side"
+                )
+
+        def spliced_once():
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(al.arena, wire, tenant_dev))
+            return time.perf_counter() - t0
+
+        def flat_once():
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn_flat(flat.arena, wire, tenant_dev))
+            return time.perf_counter() - t0
+
+        sp_s, fl_s = float("inf"), float("inf")
+        spliced_once()
+        flat_once()  # warm both off the clock
+        for _ in range(16 if on_tpu else 8):  # interleaved min-vs-min
+            sp_s = min(sp_s, spliced_once())
+            fl_s = min(fl_s, flat_once())
+        tax = sp_s / max(fl_s, 1e-9)
+        log(f"splice walk tax @{n_lat} tenants x {B} packets: "
+            f"{sp_s*1e6:.0f} us spliced vs {fl_s*1e6:.0f} us flat "
+            f"({tax:.2f}x)")
+        emit(f"splice-indirect walk @{n_lat} tenants", sp_s * 1e6, "us",
+             vs_baseline=0.0)
+        emit(f"flat-slab walk @{n_lat} tenants", fl_s * 1e6, "us",
+             vs_baseline=0.0)
+        out["splice_walk_tax"] = float(tax)
+        del flat
+
+        # -- zero-recompile pin: warm drift + classify compiles nothing -----
+        scatter0 = jaxpath._scatter_rows_jit()._cache_size()
+        fn0 = fn._cache_size()
+        edits = {}
+        for j in range(k):
+            edits[keys[(cur + j) % n_keys]] = testing.random_rules(
+                erng, width
+            )
+        upd.apply(edits, [])
+        assert al.load_tenant(n_t, upd.snapshot()) in (
+            "share", "assign"
+        )
+        jax.block_until_ready(fn(al.arena, wire, tenant_dev))
+        if fn._cache_size() != fn0:
+            raise RuntimeError(
+                "splice ladder: classify executable recompiled on the "
+                "warm drift lifecycle"
+            )
+        grew = jaxpath._scatter_rows_jit()._cache_size() - scatter0
+        if grew:
+            raise RuntimeError(
+                f"splice ladder: {grew} scatter executable(s) compiled "
+                "on the warm drift lifecycle"
+            )
+        log("splice ladder zero-recompile pin OK (k-edit drift load + "
+            "classify on the warm arena)")
+        out["splice_zero_recompile"] = 1.0
+        del al
+    return out
+
+
+def splice_bench_main() -> int:
+    """``make splice-bench``: the structural-compression ladder
+    standalone (CPU smoke off TPU) with the ISSUE-17 regression gates —
+    the k=16 rung's bytes/tenant reduction must clear
+    INFW_SPLICE_BYTES_RATIO_MIN (default 10x) and the splice-indirect
+    walk must stay under INFW_SPLICE_WALK_TAX_MAX (default 2x) of the
+    flat walk.  The arena-splice statecheck config runs FIRST and gates
+    record publication, mirroring the tenant-bench discipline."""
+    ratio_min = float(os.environ.get("INFW_SPLICE_BYTES_RATIO_MIN", "10.0"))
+    tax_max = float(os.environ.get("INFW_SPLICE_WALK_TAX_MAX", "2.0"))
+    from infw.analysis import statecheck
+
+    for cfg in ("arena-splice",):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        if not rep["ok"]:
+            log(f"splice-bench FAIL: statecheck {cfg} not green before "
+                f"record publication: {rep['failure']}")
+            return 1
+        log(f"splice-bench: statecheck {cfg} green "
+            f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(2025)
+    rec = bench_splice(rng, on_tpu)
+    emit_compact_record()
+    rc = 0
+    if not rec.get("splice_bytes_ratio_k16", 0.0) >= ratio_min:
+        log(f"splice-bench FAIL: bytes/tenant reduction "
+            f"{rec.get('splice_bytes_ratio_k16', 0):.1f}x @k=16 < "
+            f"gate {ratio_min}x")
+        rc = 1
+    if not rec.get("splice_walk_tax", float("inf")) < tax_max:
+        log(f"splice-bench FAIL: walk tax "
+            f"{rec.get('splice_walk_tax', float('inf')):.2f}x >= "
+            f"gate {tax_max}x")
+        rc = 1
+    if rc == 0:
+        log("splice-bench OK: " + ", ".join(
+            f"{k}={v:.2f}" for k, v in sorted(rec.items())
+        ))
+    return rc
+
+
 def tenant_bench_main() -> int:
     """``make tenant-bench``: the multi-tenant arena tier standalone
     (CPU smoke off TPU) with the regression gates — the pre-staged
@@ -4491,6 +4783,8 @@ if __name__ == "__main__":
         sys.exit(churn_bench_main())
     if "--tenant-bench" in sys.argv:
         sys.exit(tenant_bench_main())
+    if "--splice-bench" in sys.argv:
+        sys.exit(splice_bench_main())
     if "--flow-bench" in sys.argv:
         sys.exit(flow_bench_main())
     if "--resident-bench" in sys.argv:

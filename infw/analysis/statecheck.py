@@ -282,6 +282,16 @@ class StateConfig:
     #: patch path, the substrate of the cowleak injected-defect
     #: acceptance
     cow_bias: float = 0.0
+    #: arena configs: probability that a tenant_create / tenant_swap op
+    #: uses a NEAR-copy of a live tenant (its content plus one or two
+    #: rule edits) instead of fresh keys, and that a rules_edit lands
+    #: on a deep (>16-bit) key — the structurally-similar distribution
+    #: of the subtree-splicing arena (ISSUE-17): near-copies land as
+    #: shared trunk pages + shared subtree planes, and the deep edits
+    #: that follow exercise patch/unsplice/re-merge and the plane
+    #: refcount invariants (the spliceleak injected-defect substrate).
+    #: > 0 additionally builds the arena with subtree plane geometry.
+    splice_bias: float = 0.0
     #: > 0 = stateful flow tier enabled with this many slab entries:
     #: the op alphabet extends with FLOW_KINDS, the classifier runs
     #: with flow_table + the shadow HostFlowModel, and every settled
@@ -388,6 +398,21 @@ CONFIGS: Dict[str, StateConfig] = {
         StateConfig("arena-cow", arena="ctrie", n_entries=24, width=4,
                     force_path="ctrie", witness_b=144, tenants=2,
                     cow_bias=0.6),
+        # cross-slab structural compression (ISSUE-17): the same arena
+        # alphabet with the generator biased toward NEAR-copied tenants
+        # (create/swap take a live tenant's content plus a rule edit or
+        # two, so trunks and subtree planes run shared) and deep-key
+        # rule edits (edits INSIDE shared subtrees: the patch/unsplice/
+        # re-merge alphabet), checked by the splice invariants in
+        # check_arena (live refcounted planes, refcount == splice-row
+        # recount, residual-trunk + planes recompose bit-identical to
+        # the whole-slab canonical bake) plus the usual mixed-tenant
+        # oracle passes.  The spliceleak injected-defect acceptance
+        # (infw_lint state --inject-defect spliceleak) runs this config
+        # under the forgotten-plane-decrement bug.
+        StateConfig("arena-splice", arena="ctrie", n_entries=24, width=4,
+                    force_path="ctrie", witness_b=144, tenants=2,
+                    splice_bias=0.6),
         # stateful flow tier (ISSUE-11): the FLOW_KINDS alphabet over
         # the edit state machine — flow hits must stay bit-identical to
         # the stateless path across inserts, evictions (the tiny table
@@ -2100,6 +2125,30 @@ def generate_arena_ops(
         )
         return items if items else None
 
+    def near_copy_content(live):
+        """splice_bias sample: a live tenant's content plus one or two
+        fresh rule rows — structurally similar, not identical, so the
+        subtree-splicing arena shares the trunk + most planes and
+        diverges only the edited subtrees.  Concrete rules are sampled
+        HERE (self-contained ops shrink/replay identically)."""
+        if not live or rng.random() >= config.splice_bias:
+            return None
+        donor = int(live[int(rng.integers(0, len(live)))])
+        items = [
+            (k, np.asarray(r).copy())
+            for k, r in sorted(
+                key_rules[donor].items(),
+                key=lambda kv: (kv[0].ingress_ifindex, kv[0].prefix_len,
+                                kv[0].ip_data),
+            )
+        ]
+        if not items:
+            return None
+        for _ in range(int(rng.integers(1, 3))):
+            i = int(rng.integers(0, len(items)))
+            items[i] = (items[i][0], _sample_rules(config, rng))
+        return tuple(items)
+
     for _ in range(n_ops):
         kind = str(rng.choice(kinds, p=probs))
         live = sorted(key_rules)
@@ -2108,15 +2157,17 @@ def generate_arena_ops(
         if kind == "tenant_create":
             t = next_tid
             next_tid += 1
-            items = sampled_content(live) or fresh_content(2, 6)
+            items = (sampled_content(live) or near_copy_content(live)
+                     or fresh_content(2, 6))
             key_rules[t] = {k: r for k, r in items}
             idents[t] = {k.masked_identity() for k, _ in items}
             ops.append(EditOp(kind="tenant_create", tenant=t, items=items))
             continue
         t = int(live[int(rng.integers(0, len(live)))])
         if kind == "tenant_swap":
-            items = sampled_content([x for x in live if x != t])
-            items = items or fresh_content(2, 6)
+            others = [x for x in live if x != t]
+            items = (sampled_content(others) or near_copy_content(others)
+                     or fresh_content(2, 6))
             key_rules[t] = {k: r for k, r in items}
             idents[t] = {k.masked_identity() for k, _ in items}
             ops.append(EditOp(kind="tenant_swap", tenant=t, items=items))
@@ -2139,6 +2190,13 @@ def generate_arena_ops(
             idents[t].add(k.masked_identity())
             ops.append(EditOp(kind=kind, key=k, rules=r, tenant=t))
             continue
+        if (config.splice_bias > 0 and kind == "rules_edit"
+                and rng.random() < config.splice_bias):
+            # edit-inside-shared-subtree bias: deep keys live in the
+            # factored subtrees, so this routes the edit through the
+            # patch/unsplice path rather than the trunk-owned scatter
+            deep = [x for x in keys if x.prefix_len > 16]
+            keys = deep or keys
         k = keys[int(rng.integers(0, len(keys)))]
         if kind == "key_delete":
             key_rules[t].pop(k)
@@ -2174,7 +2232,20 @@ def check_arena(alloc) -> List[str]:
     - the hash index is consistent with the host mirrors: every indexed
       page is live, not hash-dirty, and re-hashing its canonical slab
       reproduces the registered key (index entries and their inverse
-      agree both ways)."""
+      agree both ways).
+
+    Under subtree splicing (ISSUE-17) the contract extends:
+
+    - every splice row targets a LIVE refcounted plane (never freed /
+      zero-ref — the invariant the injected spliceleak defect
+      violates), and per-plane refcount == the number of splice rows
+      across all tenant slabs;
+    - the trunk's SPLICE_TAG l0 slots and the tenant's splice map agree
+      exactly (an unspliced subtree never shadows a still-referenced
+      plane);
+    - the active-bank device splice rows reproduce the host tenant map;
+    - recomposing the residual trunk + spliced planes re-hashes to the
+      tenant's whole-slab canonical hash."""
     viols: List[str] = []
     with alloc._lock:
         dev = alloc._dev
@@ -2192,6 +2263,25 @@ def check_arena(alloc) -> List[str]:
                 alloc._page_nnodes.get(p, 0))
             for p in set(page_hash)
         }
+        spliced = bool(getattr(alloc, "_spliced", False))
+        if spliced:
+            page_decomposed = set(alloc._page_decomposed)
+            plane_refs = dict(alloc._plane_refs)
+            plane_holds = dict(alloc._plane_holds)
+            plane_free = set(alloc._plane_free)
+            tenant_splices = {
+                t: dict(m) for t, m in alloc._tenant_splices.items() if m
+            }
+            tenant_bank = dict(alloc._tenant_bank)
+            splice_metas = dict(alloc._tenant_splice_meta)
+            tenant_tables = dict(alloc._tenant_tables)
+            plane_canon = {
+                ps: tuple(np.array(a, copy=True)
+                          for a in alloc._canonical_of_plane(ps))
+                for ps in set(plane_refs) | set(plane_holds)
+            }
+        else:
+            page_decomposed = set()
     for name, harr in host.items():
         darr = np.asarray(getattr(dev, name))
         if darr.shape != harr.shape or darr.dtype != harr.dtype:
@@ -2209,7 +2299,9 @@ def check_arena(alloc) -> List[str]:
                 f"{name}: {len(rows)} device row(s) diverge from the host "
                 f"mirror, first at row {int(rows[0])}"
             )
-    pt = host["page_table"]
+    # spliced page-table rows carry the active splice BANK in the high
+    # bits; decode to bare page numbers for the bookkeeping contract
+    pt = alloc._decode_page_table(host["page_table"])
     for t, p in tenant_page.items():
         if not (0 <= t < len(pt)) or pt[t] != p:
             viols.append(
@@ -2260,6 +2352,10 @@ def check_arena(alloc) -> List[str]:
             from ..kernels.jaxpath import slab_content_hash
 
             real = slab_content_hash(arrays, n_nodes)
+            if p in page_decomposed:
+                # residual trunks hash in their own key domain so a
+                # trunk can never content-alias a whole (unspliced) slab
+                real = b"T" + real
             if real != h:
                 viols.append(
                     f"page {p}: indexed content hash is stale (the host "
@@ -2268,6 +2364,126 @@ def check_arena(alloc) -> List[str]:
     for p, h in page_hash.items():
         if hash_page.get(h) != p:
             viols.append(f"page {p} inverse-hash entry has no index row")
+    if spliced:
+        viols.extend(_check_splice(
+            alloc, host, tenant_page, page_decomposed, plane_refs,
+            plane_holds, plane_free, tenant_splices, tenant_bank,
+            splice_metas, tenant_tables, plane_canon, canon,
+        ))
+    return viols
+
+
+def _check_splice(
+    alloc, host, tenant_page, page_decomposed, plane_refs, plane_holds,
+    plane_free, tenant_splices, tenant_bank, splice_metas, tenant_tables,
+    plane_canon, canon,
+) -> List[str]:
+    """The subtree-splicing half of the arena contract (ISSUE-17): the
+    plane refcount/aliasing bookkeeping, splice-row/trunk agreement,
+    the active-bank device rows, and the recompose re-hash."""
+    from ..kernels.jaxpath import (
+        SPLICE_TAG, _ctrie_canonical_slab, _recompose_ctrie_slab,
+        slab_content_hash,
+    )
+
+    viols: List[str] = []
+    spec = alloc.spec
+    K = spec.splice_slots
+    mt = spec.max_tenants
+    # -- plane refcounts vs splice-row recount (the spliceleak invariant)
+    recount: Dict[int, int] = {}
+    for t, m in tenant_splices.items():
+        for slot, ps in m.items():
+            recount[ps] = recount.get(ps, 0) + 1
+            if ps in plane_free:
+                viols.append(
+                    f"tenant {t} slot {slot}: splice row targets FREE "
+                    f"plane {ps}"
+                )
+            if plane_refs.get(ps, 0) <= 0:
+                viols.append(
+                    f"tenant {t} slot {slot}: splice row targets "
+                    f"zero-ref plane {ps} (the spliceleak invariant)"
+                )
+    for ps in sorted(set(recount) | set(plane_refs)):
+        want = recount.get(ps, 0)
+        got = plane_refs.get(ps, 0)
+        if want != got:
+            viols.append(
+                f"plane {ps}: refcount {got} != {want} splice row(s) "
+                f"across all tenant slabs (the spliceleak invariant)"
+            )
+    for ps in plane_free:
+        if plane_refs.get(ps, 0) or plane_holds.get(ps, 0):
+            viols.append(f"free plane {ps} still refcounted/held")
+    # -- trunk SPLICE_TAG slots vs the tenant map (no shadowing) -----------
+    l0 = host["l0"]
+    l0_rows = spec.l0_rows
+    for t, page in tenant_page.items():
+        m = tenant_splices.get(t, {})
+        if not m and page not in page_decomposed:
+            continue
+        if m and page not in page_decomposed:
+            viols.append(
+                f"tenant {t}: splice rows present but page {page} is "
+                "not a residual trunk"
+            )
+            continue
+        slab_l0 = l0[page * l0_rows:(page + 1) * l0_rows]
+        tagged = {
+            int(v) - int(SPLICE_TAG)
+            for v in slab_l0[:, 0] if int(v) >= int(SPLICE_TAG)
+        }
+        if tagged != set(m):
+            viols.append(
+                f"tenant {t}: trunk SPLICE_TAG slots {sorted(tagged)} "
+                f"!= splice map {sorted(m)} (an unspliced subtree "
+                "shadows, or a spliced one lost, its plane row)"
+            )
+        # -- active-bank device splice rows reproduce the host map ---------
+        bank = tenant_bank.get(t, 0)
+        row0 = (bank * mt + t) * K
+        rows = host["splice"][row0:row0 + K]
+        for slot in range(K):
+            want = m.get(slot, -1)
+            if int(rows[slot]) != want:
+                viols.append(
+                    f"tenant {t} bank {bank} slot {slot}: active splice "
+                    f"row {int(rows[slot])} != host map {want}"
+                )
+                break
+        # -- recompose re-hash: residual trunk + planes == whole slab ------
+        tables = tenant_tables.get(t)
+        metas = splice_metas.get(t)
+        trunk = canon.get(page)
+        if tables is None or metas is None or trunk is None:
+            continue
+        planes = []
+        ok = True
+        for mm in metas:
+            ps = m.get(mm.slot)
+            pc = None if ps is None else plane_canon.get(ps)
+            if pc is None:
+                viols.append(
+                    f"tenant {t} slot {mm.slot}: meta has no live plane"
+                )
+                ok = False
+                break
+            planes.append((pc[0], pc[1], pc[2], mm.n_local))
+        if not ok:
+            continue
+        try:
+            whole = _recompose_ctrie_slab(spec, trunk[0], metas, planes)
+        except Exception as e:  # pragma: no cover - structural corruption
+            viols.append(f"tenant {t}: recompose failed: {e}")
+            continue
+        want_arrays, want_n = _ctrie_canonical_slab(spec, tables)
+        if slab_content_hash(whole, trunk[1]) != slab_content_hash(
+                want_arrays, want_n):
+            viols.append(
+                f"tenant {t}: residual trunk + spliced planes no longer "
+                "re-hash to the whole-slab canonical bake"
+            )
     return viols
 
 
@@ -2280,6 +2496,19 @@ def _arena_spec_for_case(
     failure).  Depth bound 18 = the deepest level count a /128 v6 key
     can force (path compression only shrinks it)."""
     ent = len(base_content) + 6 * n_ops + 8
+    splice_kwargs = {}
+    if cfg.splice_bias > 0:
+        # subtree plane geometry (ISSUE-17): generous bounds derived
+        # the same way as the page pool — capacity errors degrade to
+        # whole-slab installs, never fail, but a well-sized pool keeps
+        # the splice alphabet actually exercised
+        splice_kwargs = dict(
+            plane_slots=8 * ent,
+            plane_node_rows=16,
+            plane_target_rows=16,
+            plane_joined_rows=16,
+            splice_slots=64,
+        )
     return jaxpath.make_arena_spec(
         cfg.arena,
         pages=max(cfg.tenants + n_ops + 2, 4),
@@ -2291,6 +2520,7 @@ def _arena_spec_for_case(
         node_rows=20 * ent,
         target_rows=12 * ent,
         d_max=18,
+        **splice_kwargs,
     )
 
 
@@ -2379,6 +2609,58 @@ class _ArenaDriver:
             op.key, np.asarray(op.rules)
         )
 
+    def _check_spliced_slab(self, alloc, tid: int, t_name: str,
+                            page: int, clone, step: int):
+        """Cold-rebuild equivalence for a SPLICED tenant: recompose the
+        resident residual trunk + its spliced planes (all read from the
+        host mirrors) and require bit-identity with the canonical cold
+        bake of the cache-stripped snapshot clone."""
+        with alloc._lock:
+            trunk = tuple(
+                np.array(a, copy=True)
+                for a in alloc._canonical_of_page(page)
+            )
+            metas = alloc._tenant_splice_meta.get(tid)
+            m = dict(alloc._tenant_splices.get(tid) or {})
+            planes = []
+            for mm in metas or ():
+                ps = m.get(mm.slot)
+                if ps is None:
+                    return Failure(
+                        step, "raw",
+                        f"spliced tenant {t_name!r} slot {mm.slot} has "
+                        "no splice row")
+                pn, pt_, pj, n_local = alloc._canonical_of_plane(ps)
+                planes.append((np.array(pn, copy=True),
+                               np.array(pt_, copy=True),
+                               np.array(pj, copy=True), mm.n_local))
+        try:
+            whole = jaxpath._recompose_ctrie_slab(
+                alloc.spec, trunk, metas, planes
+            )
+            want, _n = jaxpath._ctrie_canonical_slab(alloc.spec, clone)
+        except jaxpath.ArenaCapacityError as e:
+            return Failure(step, "raw",
+                           f"cold rebuild of tenant {t_name!r} no "
+                           f"longer fits its slab: {e}")
+        names = ("l0", "nodes", "targets", "joined", "root_lut")
+        for arr_name, got, exp in zip(names, whole, want):
+            if not np.array_equal(np.asarray(got), np.asarray(exp)):
+                bad = np.nonzero(
+                    (np.asarray(got).reshape(got.shape[0], -1)
+                     != np.asarray(exp).reshape(exp.shape[0], -1)
+                     ).any(axis=1)
+                )[0]
+                return Failure(
+                    step, "raw",
+                    f"spliced tenant {t_name!r} slab {arr_name}: trunk "
+                    "+ planes recompose diverged from the cold "
+                    "canonical bake",
+                    f"{len(bad)} row(s), first at canonical row "
+                    f"{int(bad[0])} (page {page})",
+                )
+        return None
+
     def check(self, step: int) -> Optional[Failure]:
         from .. import oracle, testing
 
@@ -2407,6 +2689,16 @@ class _ArenaDriver:
             with self.reg._lock:
                 upd = self.reg._updaters[tid]
             clone = _cold_clone(upd.snapshot())
+            if getattr(alloc, "_spliced", False) and alloc.tenant_splices(tid):
+                # spliced tenant: the page holds a RESIDUAL trunk, not
+                # the flat slab — recompose trunk + planes from the
+                # host mirrors and compare against the canonical cold
+                # bake (page-independent form) bit-exactly
+                f = self._check_spliced_slab(alloc, tid, t_name, page,
+                                             clone, step)
+                if f is not None:
+                    return f
+                continue
             try:
                 if spec.family == "dense":
                     slab = jaxpath._dense_slab_arrays(spec, clone)

@@ -464,7 +464,10 @@ class MeshArenaClassifier:
         self._alloc = _jp.ArenaAllocator(
             spec,
             device=meshmod.arena_replicated(mesh),
-            shardings=meshmod.arena_shardings(mesh, spec.family, spec.pages),
+            shardings=meshmod.arena_shardings(
+                mesh, spec.family, spec.pages,
+                spliced=getattr(spec, "spliced", False),
+            ),
         )
         self._stats = StatsAccumulator()
         self._closed = False
@@ -542,8 +545,9 @@ class MeshArenaClassifier:
             NamedSharding(self._mesh, P("data")),
         )
         d_max = spec.d_max if spec.family == "ctrie" else 0
+        sp = {"spec": spec} if getattr(spec, "spliced", False) else {}
         fused = jaxpath.jitted_classify_arena_wire_fused(
-            spec.family, spec.pages, d_max
+            spec.family, spec.pages, d_max, **sp
         )(self._alloc.arena, wire, tenant)
         try:
             fused.copy_to_host_async()

@@ -1946,13 +1946,17 @@ class ArenaClassifier:
         build only on first touch; afterwards ONLY the written slabs'
         plane rows re-derive and scatter (SN is 128-row aligned, so a
         slab maps 1:1 onto its plane rows) — O(slab) per mutation, not
-        O(pool), keeping the hot-swap path flip-sized."""
+        O(pool), keeping the hot-swap path flip-sized.  Subtree-plane
+        writes (spliced arenas) patch the same way at their pool-row
+        bases: O(touched subtrees), never a pool rebuild."""
         gen, pages, rows = self._alloc.consume_dirty_node_pages()
+        pblocks = self._alloc.consume_dirty_plane_rows()[1] if hasattr(
+            self._alloc, "consume_dirty_plane_rows") else []
         with self._lock:
             if gen == self._planes_gen and self._planes is not None:
                 return
             planes = self._planes
-            if planes is None or not pages:
+            if planes is None or (not pages and not pblocks):
                 nodes = self._alloc.host_nodes()
                 planes = (
                     None if nodes is None
@@ -1962,12 +1966,16 @@ class ArenaClassifier:
                 )
             else:
                 sn = self._alloc.spec.node_rows
-                for p in pages:
-                    slab_planes = pallas_walk._split_cnode_rows(rows[p])
+                patches = [
+                    (p * sn, rows[p][:sn]) for p in pages
+                ] + [(b, blk) for b, blk in pblocks]
+                for base, blk in patches:
+                    nr = blk.shape[0]
+                    slab_planes = pallas_walk._split_cnode_rows(blk)
                     patched = jaxpath._capped_scatter(
                         planes,
-                        p * sn + np.arange(sn, dtype=np.int64),
-                        slab_planes[:sn],
+                        base + np.arange(nr, dtype=np.int64),
+                        slab_planes[:nr],
                         self._device,
                     )
                     if patched is None:  # oversized delta: full rebuild
@@ -2125,19 +2133,24 @@ class ArenaClassifier:
         ov = None if self._ov_alloc is None else self._ov_alloc.arena
         ov_busy = ov is not None and self._ov_alloc.tenants()
         d_max = spec.d_max if spec.family == "ctrie" else 0
+        # spliced arenas key the (cached) factories on the spec so the
+        # entry stage resolves splice rows; unspliced callers keep the
+        # legacy cache identity by not passing the kwarg at all
+        sp = {"spec": spec} if getattr(spec, "spliced", False) else {}
         if (
             self._fused_deep and self._planes is not None and not ov_busy
         ):
             fused = pallas_walk.jitted_classify_arena_cwalk_wire_fused(
-                spec.pages, d_max, self._interpret
+                spec.pages, d_max, self._interpret, **sp
             )(arena, self._planes, wire, tenant)
         elif ov_busy:
             fused = jaxpath.jitted_classify_arena_wire_fused(
-                spec.family, spec.pages, d_max, self._ov_alloc.spec.pages
+                spec.family, spec.pages, d_max, self._ov_alloc.spec.pages,
+                **sp
             )(arena, ov, wire, tenant)
         else:
             fused = jaxpath.jitted_classify_arena_wire_fused(
-                spec.family, spec.pages, d_max
+                spec.family, spec.pages, d_max, **sp
             )(arena, wire, tenant)
         try:
             fused.copy_to_host_async()

@@ -1889,9 +1889,11 @@ class Daemon:
         tenant slabs whose content hash went stale (in-place patches /
         CoW clones) and re-merge pages that re-converged onto one
         shared slab — page-table flips only, never a slab write, so
-        the sweep is serving-path-safe at any cadence.  Bounded per
-        pass (``limit``) so one sweep never monopolizes the idle loop
-        on a large pool."""
+        the sweep is serving-path-safe at any cadence.  On spliced
+        arenas the same pass re-merges subtree planes that unspliced
+        apart and later re-converged (splice-row flips, ISSUE-17).
+        Bounded per pass (``limit``) so one sweep never monopolizes
+        the idle loop on a large pool."""
         if self.tenant_registry is None:
             return
         now = time.monotonic()
@@ -1901,10 +1903,12 @@ class Daemon:
         sweep = getattr(self.tenant_registry.classifier, "dedup_sweep", None)
         if sweep is not None:
             rep = sweep(limit=64)
-            if rep.get("merged"):
+            if rep.get("merged") or rep.get("plane_merged"):
                 log.info("tenant dedup sweep: %d page(s) re-hashed, "
-                         "%d tenant row(s) re-merged",
-                         rep["hashed"], rep["merged"])
+                         "%d tenant row(s) re-merged, "
+                         "%d subtree plane(s) re-merged",
+                         rep["hashed"], rep["merged"],
+                         rep.get("plane_merged", 0))
 
     def _telemetry_maintenance(self) -> None:
         """Idle-loop telemetry upkeep: attach the obs ring + drain

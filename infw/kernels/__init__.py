@@ -460,6 +460,53 @@ def _build_arena_wire(family: str):
     return build
 
 
+@functools.lru_cache(maxsize=None)
+def _fixture_splice_arena():
+    """A subtree-spliced ctrie arena (ISSUE-17) holding the canonical
+    fixture table as tenant 0 and a near-copy (one rules edit on a deep
+    key) as tenant 1 — trunk + most subtree planes shared, the classify
+    entry resolving through the splice indirection."""
+    from ..compiler import IncrementalTables
+    from .. import testing
+    from . import jaxpath
+
+    rng = np.random.default_rng(33)
+    t0 = _fixture_tables(False)
+    upd = IncrementalTables.from_content(dict(t0.content), rule_width=4)
+    deep = sorted(
+        (k for k in t0.content if k.prefix_len > 16),
+        key=lambda k: (k.ingress_ifindex, k.prefix_len, k.ip_data),
+    )
+    if deep:
+        upd.apply({deep[0]: testing.random_rules(rng, 4)})
+    t1 = upd.snapshot()
+    spec = jaxpath.arena_spec_for(
+        "ctrie", (t0, t1), pages=4, max_tenants=8,
+        plane_slots=256, plane_node_rows=16, plane_target_rows=16,
+        plane_joined_rows=16, splice_slots=64,
+    )
+    alloc = jaxpath.ArenaAllocator(spec)
+    alloc.load_tenant(0, t0)
+    alloc.load_tenant(1, t1)
+    return alloc
+
+
+def _build_arena_splice_wire(b: int):
+    from . import jaxpath
+
+    alloc = _fixture_splice_arena()
+    if not alloc.distinct_planes():
+        raise EntrypointUnavailable(
+            "fixture tables decompose to no shared subtree planes"
+        )
+    spec = alloc.spec
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        "ctrie", spec.pages, spec.d_max, spec=spec
+    )
+    wire, tenant = _fixture_arena_wire(b)
+    return fn, (alloc.arena, wire, tenant)
+
+
 def _build_pallas_arena_walk(b: int):
     import jax
 
@@ -995,6 +1042,10 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         ),
         KernelEntrypoint(
             "classify-wire/arena-trie", "xla", _build_arena_wire("ctrie")
+        ),
+        KernelEntrypoint(
+            "classify-wire/arena-splice-trie", "xla",
+            _build_arena_splice_wire,
         ),
         KernelEntrypoint(
             "classify/pallas-arena-walk", "pallas", _build_pallas_arena_walk

@@ -3005,11 +3005,51 @@ def _inject_cowleak_bug() -> bool:
     return env not in ("", "0", "false", "no")
 
 
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_SPLICELEAK_BUG env var), the unsplice path of
+#: ArenaAllocator._splice_edit "forgets" the old subtree plane's
+#: refcount decrement after repointing the editing tenant's splice row
+#: at its private (or re-merged) plane — the subtree-granular CoW leak
+#: (the shared plane can never drop to zero and be reclaimed).  The
+#: statecheck acceptance gate (tools/infw_lint.py state --inject-defect
+#: spliceleak, on the near-copy-biased "arena-splice" config) proves
+#: check_arena's splice refcount invariants catch it with a shrunk
+#: reproducer.  Never set in production.
+_INJECT_SPLICELEAK_BUG = False
+
+
+def _inject_spliceleak_bug() -> bool:
+    if _INJECT_SPLICELEAK_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_SPLICELEAK_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
 class ArenaCapacityError(ValueError):
     """A tenant table does not fit the arena's slab geometry (entries,
     node rows, trie depth, rule width, lut span) or the pool is out of
     free pages.  Callers either re-size the arena (a new pool
     generation) or refuse the tenant — never silently truncate."""
+
+
+class _PlaneCapacityError(ArenaCapacityError):
+    """The subtree plane pool is exhausted.  Internal: the decomposed
+    install/stage paths catch it and fall back to the whole-slab path
+    (degrade to flat slabs, never refuse the tenant); page exhaustion
+    keeps raising plain ArenaCapacityError."""
+
+
+#: Splice-indirect walk encoding (ISSUE-17).  A spliced l0 slot stores
+#: SPLICE_TAG + slot_id in column 0 (instead of root-node-id + 1): the
+#: walk entry resolves the slot through the tenant's splice-table rows
+#: to a shared plane id, and descends from that plane's root row in the
+#: appended plane pool region.  The tag bit doubles as the page-table
+#: BANK bit: a spliced arena's page-table rows encode
+#: ``page | bank << 30`` so one 1-row flip switches a tenant's page AND
+#: its (double-buffered) splice-table bank atomically.
+SPLICE_TAG = np.int32(1 << 30)
+_SPLICE_BANK_SHIFT = 30
+_SPLICE_PAGE_MASK = (1 << 30) - 1
 
 
 class ArenaSpec(NamedTuple):
@@ -3028,6 +3068,16 @@ class ArenaSpec(NamedTuple):
     node_rows: int     # ctrie merged skip-node rows per slab (SN)
     target_rows: int   # ctrie flat target rows per slab (ST)
     d_max: int         # static descent unroll bound (pool-wide)
+    # -- structural (subtree-splice) compression geometry (ISSUE-17) ---------
+    # zero everywhere -> a plain (unspliced) arena; ctrie-only.  The
+    # plane pool is APPENDED to the slab pools (rows pages*SN .. on),
+    # so every resident index stays pool-global and the walk kernels
+    # never branch on "slab vs plane".
+    plane_slots: int = 0        # shared subtree planes in the pool (PP)
+    plane_node_rows: int = 0    # skip-node rows per plane (SNP)
+    plane_target_rows: int = 0  # target rows per plane (STP)
+    plane_joined_rows: int = 0  # joined rows per plane (SJP, row 0 unused)
+    splice_slots: int = 0       # splice-table rows per tenant slab (K)
 
     @property
     def joined_rows(self) -> int:
@@ -3038,6 +3088,22 @@ class ArenaSpec(NamedTuple):
     @property
     def l0_rows(self) -> int:
         return self.root_nodes * 65536
+
+    @property
+    def spliced(self) -> bool:
+        """True when the arena factors shared subtrees into the
+        refcounted plane pool and reads them through the per-tenant
+        splice table."""
+        return self.plane_slots > 0 and self.splice_slots > 0
+
+    @property
+    def splice_rows(self) -> int:
+        """Device splice-table rows: two banks (double-buffered per
+        tenant so a splice-map update lands atomically with the
+        page-table flip) of max_tenants * splice_slots."""
+        if not self.spliced:
+            return 1  # degenerate placeholder array
+        return 2 * self.max_tenants * self.splice_slots
 
 
 def make_arena_spec(
@@ -3051,12 +3117,19 @@ def make_arena_spec(
     node_rows: int = 128,
     target_rows: int = 64,
     d_max: int = 6,
+    plane_slots: int = 0,
+    plane_node_rows: int = 0,
+    plane_target_rows: int = 0,
+    plane_joined_rows: int = 0,
+    splice_slots: int = 0,
 ) -> ArenaSpec:
     """Normalize + validate an arena geometry: row counts bucket to the
     shared scatter-ladder shapes (node rows additionally to 128-row
     tiles for the Pallas byte planes), and the pool must satisfy the
     capped-scatter budget (a full-slab write is <= pool/4 rows, i.e.
-    pages >= 4) and the int32 DIR-16 indexing bound."""
+    pages >= 4) and the int32 DIR-16 indexing bound.  Non-zero splice
+    geometry (ctrie-only) appends a ``plane_slots``-deep shared subtree
+    plane pool and a two-bank per-tenant splice table."""
     if family not in ("dense", "ctrie"):
         raise ValueError(f"unknown arena family {family!r}")
     if pages < 4:
@@ -3075,11 +3148,42 @@ def make_arena_spec(
             f"arena l0 pool {pages}x{root_nodes} root nodes exceeds int32 "
             "DIR-16 indexing"
         )
+    splicey = (plane_slots, plane_node_rows, plane_target_rows,
+               plane_joined_rows, splice_slots)
+    if any(v < 0 for v in splicey):
+        raise ValueError("splice geometry fields must be >= 0")
+    if any(splicey):
+        if family != "ctrie":
+            raise ValueError("subtree-splice compression is ctrie-only")
+        if not all(splicey):
+            raise ValueError(
+                "splice geometry is all-or-nothing: plane_slots, "
+                "plane_node_rows, plane_target_rows, plane_joined_rows "
+                "and splice_slots must all be > 0"
+            )
+        # plane rows bucket to small multiples of 8 (they ride the same
+        # warmed fused scatter; no 128-row tiling needed — the Pallas
+        # byte planes pad the POOL TOTAL to 128 rows internally)
+        r8 = lambda x: -(-int(x) // 8) * 8
+        plane_node_rows = r8(plane_node_rows)
+        plane_target_rows = r8(plane_target_rows)
+        plane_joined_rows = r8(plane_joined_rows)
+        total_nodes = pages * node_rows + plane_slots * plane_node_rows
+        if total_nodes + 1 >= int(SPLICE_TAG):
+            raise ValueError(
+                f"node pool {total_nodes} rows collides with the splice "
+                f"tag space (< {int(SPLICE_TAG)})"
+            )
+        if splice_slots >= int(SPLICE_TAG):
+            raise ValueError("splice_slots exceeds the splice tag space")
     return ArenaSpec(
         family=family, pages=pages, max_tenants=max_tenants,
         entries=entries, rule_slots=rule_slots, lut_rows=lut_rows,
         root_nodes=root_nodes, node_rows=node_rows,
         target_rows=target_rows, d_max=d_max,
+        plane_slots=plane_slots, plane_node_rows=plane_node_rows,
+        plane_target_rows=plane_target_rows,
+        plane_joined_rows=plane_joined_rows, splice_slots=splice_slots,
     )
 
 
@@ -3090,11 +3194,14 @@ def arena_spec_for(
     max_tenants: int,
     headroom: float = 1.0,
     d_max: Optional[int] = None,
+    **splice_kwargs,
 ) -> ArenaSpec:
     """Size an ArenaSpec from sample tenant tables: take per-family
     maxima over the samples, scaled by ``headroom``, then bucket via
     make_arena_spec.  The samples must be u16-packable (the arena's
-    resident rule layout)."""
+    resident rule layout).  ``splice_kwargs`` (plane_slots,
+    plane_node_rows, ...) pass through to make_arena_spec for spliced
+    geometries."""
     ent = 1
     rs = 1
     lut = 1
@@ -3124,6 +3231,7 @@ def arena_spec_for(
         entries=h(ent), rule_slots=rs, lut_rows=h(lut), root_nodes=r0,
         node_rows=h(nn), target_rows=h(tt),
         d_max=d_max if d_max is not None else dm,
+        **splice_kwargs,
     )
 
 
@@ -3147,14 +3255,25 @@ class CtrieArena(NamedTuple):
     (_ctrie_descend) and the tail gathers run on the flat pools
     untouched.  Pool row 0 of ``targets``/``joined`` doubles as the
     global sentinel (page 0's slab sentinel — all slabs keep their
-    local row 0 zero)."""
+    local row 0 zero).
+
+    Spliced geometries (spec.spliced) APPEND the shared subtree plane
+    pool to ``nodes``/``targets``/``joined`` (plane_slots slabs of
+    plane_*_rows each, starting at row pages*SN / pages*ST / pages*SJ)
+    and carry the two-bank per-tenant ``splice`` table: row
+    (bank*max_tenants + tenant)*K + slot holds the plane id serving
+    that tenant's spliced l0 slot (-1 = unused).  Plane-internal
+    indices are baked pool-global exactly like slab indices, so the
+    descent and tail gathers stay splice-oblivious; only the l0 entry
+    resolves through the indirection."""
 
     l0: jax.Array          # (P*R0*65536, 2) int32
-    nodes: jax.Array       # (P*SN, 20) uint32
-    targets: jax.Array     # (P*ST,) int32 global joined positions
-    joined: jax.Array      # (P*(S+1), 3+R*5) uint16
+    nodes: jax.Array       # (P*SN [+ PP*SNP], 20) uint32
+    targets: jax.Array     # (P*ST [+ PP*STP],) int32 global joined positions
+    joined: jax.Array      # (P*(S+1) [+ PP*SJP], 3+R*5) uint16
     root_lut: jax.Array    # (P*SL,) int32 global root ids
-    page_table: jax.Array  # (max_tenants,) int32
+    splice: jax.Array      # (2*max_tenants*K,) int32 plane ids, -1 unused
+    page_table: jax.Array  # (max_tenants,) int32 (spliced: page|bank<<30)
 
 
 # -- slab baking (host) ------------------------------------------------------
@@ -3263,7 +3382,12 @@ def _offset_ctrie_slab(spec: ArenaSpec, arrays, n_nodes: int, page: int):
     jb = page * spec.joined_rows
     rb = page * spec.root_nodes
     l0o = np.zeros_like(l0)
-    l0o[:, 0] = np.where(l0[:, 0] > 0, l0[:, 0] + nb, 0)
+    # spliced l0 slots (SPLICE_TAG + slot) are slab-local slot ids
+    # resolved through the tenant splice table — never page-offset
+    tag = l0[:, 0] >= SPLICE_TAG
+    l0o[:, 0] = np.where(
+        tag, l0[:, 0], np.where(l0[:, 0] > 0, l0[:, 0] + nb, 0)
+    )
     l0o[:, 1] = np.where(l0[:, 1] > 0, l0[:, 1] + jb, 0)
     nodeso = nodes.copy()
     nodeso[:n_nodes, 0] += np.uint32(nb)
@@ -3285,7 +3409,10 @@ def _unoffset_ctrie_slab(spec: ArenaSpec, arrays, n_nodes: int, page: int):
     jb = page * spec.joined_rows
     rb = page * spec.root_nodes
     l0c = np.zeros_like(l0)
-    l0c[:, 0] = np.where(l0[:, 0] > 0, l0[:, 0] - nb, 0)
+    tag = l0[:, 0] >= SPLICE_TAG
+    l0c[:, 0] = np.where(
+        tag, l0[:, 0], np.where(l0[:, 0] > 0, l0[:, 0] - nb, 0)
+    )
     l0c[:, 1] = np.where(l0[:, 1] > 0, l0[:, 1] - jb, 0)
     nodesc = nodes.copy()
     nodesc[:n_nodes, 0] -= np.uint32(nb)
@@ -3316,6 +3443,254 @@ def slab_content_hash(arrays, n_nodes: int = 0) -> bytes:
         h.update(repr((a.shape, a.dtype.str)).encode())
         h.update(a.tobytes())
     return h.digest()
+
+
+# -- structural (subtree) decomposition (host) --------------------------------
+
+
+def _np_popcount_rows(bitmaps: np.ndarray) -> np.ndarray:
+    """(n, 8) uint32 bitmap rows -> (n,) per-row set-bit counts."""
+    b = np.ascontiguousarray(bitmaps.astype(np.uint32)).view(np.uint8)
+    return np.unpackbits(b, axis=-1).sum(axis=1).astype(np.int64)
+
+
+class _SpliceSub(NamedTuple):
+    """One factored subtree of a decomposed ctrie slab: the mapping
+    between the subtree's canonical PLANE form (plane-local indices,
+    content-canonical bytes shared across tenants) and its footprint in
+    the tenant's whole-slab canonical form.  ``node_rows``/``tpos`` are
+    the ORIGINAL (ascending) slab row positions the subtree occupied;
+    plane-local row i is node_rows[i] / tpos[i] (BFS emission order is
+    monotone in node id, so the sorted restriction IS the subtree's own
+    BFS order).  ``tidx`` is the sorted list of tidx+1 joined positions
+    the subtree owns; plane-local joined row 1+j carries the original
+    row bytes of tidx[j] (the self-indexed bytes are identical across
+    rules-only-variant tenants, which is what makes planes shareable).
+    ``dead_cb``/``dead_tb`` preserve the original base values of rows
+    with zero children/targets (dead pointers are never descended but
+    must round-trip bit-exactly for the whole-slab hash invariant)."""
+
+    slot: int               # splice slot id (l0 slot order)
+    e: int                  # l0 row of the subtree root's DIR-16 slot
+    root: int               # original root node id
+    node_rows: np.ndarray   # (n_local,) int64 ascending original node ids
+    dead_cb: np.ndarray     # (n_local,) int64, -1 where child_base live
+    dead_tb: np.ndarray     # (n_local,) int64, -1 where target_base live
+    tpos: np.ndarray        # (n_t,) int64 ascending original target rows
+    tidx: np.ndarray        # (n_j,) int64 ascending original tidx+1 values
+    n_local: int            # real plane node rows
+    plane: tuple            # (pnodes, ptargets, pjoined) canonical arrays
+    phash: bytes            # slab_content_hash(plane, n_local)
+
+
+def _decompose_one_subtree(spec, arrays, n_nodes, cc, tc, e, slot,
+                           claimed_nodes, claimed_tidx, best0_tidx):
+    """Try to factor the subtree rooted at l0 row ``e`` into one plane.
+    Returns a _SpliceSub or None (doesn't fit the plane geometry, or
+    overlaps an already-claimed/trunk-owned row — the subtree then
+    stays resident in the trunk slab)."""
+    l0, nodes, targets, joined, _root_lut = arrays
+    snp = spec.plane_node_rows
+    root = int(l0[e, 0]) - 1
+    if root < 0 or root >= n_nodes:
+        return None
+    cb = nodes[:n_nodes, 0].astype(np.int64)
+    tb = nodes[:n_nodes, 1].astype(np.int64)
+    # BFS-collect the subtree's node rows (bounded by the plane size)
+    rows: list = []
+    seen: set = set()
+    frontier = [root]
+    while frontier:
+        nxt: list = []
+        for nid in frontier:
+            if nid in seen or len(rows) >= snp:
+                return None
+            seen.add(nid)
+            rows.append(nid)
+            c = int(cc[nid])
+            if c:
+                b = int(cb[nid])
+                if b < 0 or b + c > n_nodes:
+                    return None
+                nxt.extend(range(b, b + c))
+        frontier = nxt
+    if not rows:
+        return None
+    nr = np.array(sorted(rows), np.int64)
+    if claimed_nodes[nr].any():
+        return None
+    # target rows owned by the subtree (contiguous per node)
+    tl: list = []
+    for nid in nr:
+        t = int(tc[nid])
+        if t:
+            b = int(tb[nid])
+            if b < 1 or b + t > targets.shape[0]:
+                return None
+            tl.extend(range(b, b + t))
+    if len(tl) != len(set(tl)) or len(tl) > spec.plane_target_rows:
+        return None
+    tpos = np.array(sorted(tl), np.int64)
+    tvals = targets[tpos].astype(np.int64) if len(tpos) else np.zeros(0, np.int64)
+    live = tvals[tvals > 0]
+    if len(set(live.tolist())) != len(live):
+        return None
+    tidx = np.unique(live)
+    if len(tidx) + 1 > spec.plane_joined_rows:
+        return None
+    if len(tidx) and int(tidx.max()) >= joined.shape[0]:
+        return None
+    for v in tidx.tolist():
+        if v in claimed_tidx or v in best0_tidx:
+            return None
+    # bake the canonical plane (plane-local indices)
+    n_local = len(nr)
+    pn = np.zeros((snp, 20), np.uint32)
+    pn[:n_local] = nodes[nr]
+    dead_cb = np.full(n_local, -1, np.int64)
+    dead_tb = np.full(n_local, -1, np.int64)
+    pos_of_node = {int(v): i for i, v in enumerate(nr)}
+    pos_of_t = {int(v): i for i, v in enumerate(tpos)}
+    for i, nid in enumerate(nr.tolist()):
+        if int(cc[nid]):
+            lb = pos_of_node.get(int(cb[nid]))
+            if lb is None:
+                return None
+            pn[i, 0] = np.uint32(lb)
+        else:
+            dead_cb[i] = int(cb[nid])
+            pn[i, 0] = 0
+        if int(tc[nid]):
+            lt = pos_of_t.get(int(tb[nid]))
+            if lt is None:
+                return None
+            pn[i, 1] = np.uint32(lt)
+        else:
+            dead_tb[i] = int(tb[nid])
+            pn[i, 1] = 0
+    pt = np.zeros(spec.plane_target_rows, np.int32)
+    for j, v in enumerate(tvals.tolist()):
+        pt[j] = 0 if v <= 0 else 1 + int(np.searchsorted(tidx, v))
+    pj = np.zeros((spec.plane_joined_rows, joined.shape[1]), np.uint16)
+    for j, v in enumerate(tidx.tolist()):
+        pj[1 + j] = joined[v]
+    plane = (pn, pt, pj)
+    return _SpliceSub(
+        slot=slot, e=int(e), root=root, node_rows=nr, dead_cb=dead_cb,
+        dead_tb=dead_tb, tpos=tpos, tidx=tidx, n_local=n_local,
+        plane=plane, phash=slab_content_hash(plane, n_local),
+    )
+
+
+def _decompose_ctrie_slab(spec: ArenaSpec, arrays, n_nodes: int):
+    """Factor a canonical ctrie slab into (trunk arrays, subtree metas):
+    each factorable l0 subtree (fits the plane geometry, disjoint from
+    every other factored subtree, owns none of the <=16-bit best0
+    joined rows) moves to a canonical plane; its l0 slot becomes
+    SPLICE_TAG + slot and its node/target/joined rows ZERO in the
+    trunk (no renumbering — trunk bytes stay content-canonical, and
+    structurally-identical tenants produce bit-identical trunks).
+    Returns None when nothing factors (caller installs whole-slab)."""
+    if not spec.spliced or n_nodes <= 0:
+        return None
+    l0, nodes, targets, joined, root_lut = arrays
+    cc = _np_popcount_rows(nodes[:n_nodes, 4:12])
+    tc = _np_popcount_rows(nodes[:n_nodes, 12:20])
+    best0 = l0[:, 1]
+    best0_tidx = set(int(v) for v in best0[best0 > 0].tolist())
+    claimed_nodes = np.zeros(n_nodes, bool)
+    claimed_tidx: set = set()
+    metas: list = []
+    for e in np.nonzero(l0[:, 0] > 0)[0].tolist():
+        if len(metas) >= spec.splice_slots:
+            break
+        m = _decompose_one_subtree(
+            spec, arrays, n_nodes, cc, tc, e, len(metas),
+            claimed_nodes, claimed_tidx, best0_tidx,
+        )
+        if m is None:
+            continue
+        claimed_nodes[m.node_rows] = True
+        claimed_tidx.update(m.tidx.tolist())
+        metas.append(m)
+    if not metas:
+        return None
+    tl0 = l0.copy()
+    tn = nodes.copy()
+    tt = targets.copy()
+    tj = joined.copy()
+    for m in metas:
+        tl0[m.e, 0] = np.int32(int(SPLICE_TAG) + m.slot)
+        tn[m.node_rows] = 0
+        tt[m.tpos] = 0
+        tj[m.tidx] = 0
+    return (tl0, tn, tt, tj, root_lut.copy()), tuple(metas)
+
+
+def _recompose_ctrie_slab(spec: ArenaSpec, trunk_arrays, metas, planes):
+    """Inverse of _decompose_ctrie_slab: trunk + canonical planes back
+    to the tenant's whole-slab canonical arrays — the invariant teeth
+    of check_arena (residual slab + spliced planes must reproduce the
+    whole-slab canonical bytes/hash bit-exactly).  ``planes`` aligns
+    with ``metas``: (pnodes, ptargets, pjoined, n_local) each."""
+    l0, nodes, targets, joined, root_lut = (
+        np.array(a, copy=True) for a in trunk_arrays
+    )
+    for m, (pn, pt, pj, n_local) in zip(metas, planes):
+        l0[m.e, 0] = np.int32(m.root + 1)
+        out = np.array(pn[:n_local], copy=True)
+        ccp = _np_popcount_rows(out[:, 4:12])
+        tcp = _np_popcount_rows(out[:, 12:20])
+        local_cb = np.clip(out[:, 0].astype(np.int64), 0, max(n_local - 1, 0))
+        glob_cb = np.where(ccp > 0, m.node_rows[local_cb], m.dead_cb)
+        if len(m.tpos):
+            local_tb = np.clip(
+                out[:, 1].astype(np.int64), 0, len(m.tpos) - 1
+            )
+            glob_tb = np.where(tcp > 0, m.tpos[local_tb], m.dead_tb)
+        else:
+            glob_tb = m.dead_tb
+        out[:, 0] = glob_cb.astype(np.uint32)
+        out[:, 1] = glob_tb.astype(np.uint32)
+        nodes[m.node_rows] = out
+        for j, p in enumerate(m.tpos.tolist()):
+            v = int(pt[j])
+            targets[p] = 0 if v <= 0 else np.int32(m.tidx[v - 1])
+        for j, v in enumerate(m.tidx.tolist()):
+            joined[v] = pj[1 + j]
+    return l0, nodes, targets, joined, root_lut
+
+
+def _offset_plane_slab(spec: ArenaSpec, plane_arrays, n_local: int, ps: int):
+    """Canonical plane arrays -> the plane slot's resident (pool-
+    global) form: node rows += plane-pool base + ps*SNP, target bases
+    += target base + ps*STP, target values += joined base + ps*SJP —
+    after which the shared descent walks the plane exactly like slab
+    rows.  Never mutates the canonical arrays."""
+    pn, pt, pj = plane_arrays
+    nb = spec.pages * spec.node_rows + ps * spec.plane_node_rows
+    tb = spec.pages * spec.target_rows + ps * spec.plane_target_rows
+    jb = spec.pages * spec.joined_rows + ps * spec.plane_joined_rows
+    pno = pn.copy()
+    pno[:n_local, 0] += np.uint32(nb)
+    pno[:n_local, 1] += np.uint32(tb)
+    pto = np.where(pt > 0, pt + jb, 0).astype(np.int32)
+    return pno, pto, pj
+
+
+def _unoffset_plane_slab(spec: ArenaSpec, plane_arrays, n_local: int,
+                         ps: int):
+    """Inverse of _offset_plane_slab: resident plane rows back to the
+    canonical plane form (the dedup-rehash / recompose source)."""
+    pn, pt, pj = plane_arrays
+    nb = spec.pages * spec.node_rows + ps * spec.plane_node_rows
+    tb = spec.pages * spec.target_rows + ps * spec.plane_target_rows
+    jb = spec.pages * spec.joined_rows + ps * spec.plane_joined_rows
+    pnc = pn.copy()
+    pnc[:n_local, 0] -= np.uint32(nb)
+    pnc[:n_local, 1] -= np.uint32(tb)
+    ptc = np.where(pt > 0, pt - jb, 0).astype(np.int32)
+    return pnc, ptc, np.array(pj, copy=True)
 
 
 # -- arena classify kernels --------------------------------------------------
@@ -3373,16 +3748,31 @@ def classify_arena_dense(
 
 
 def _arena_ctrie_entry(
-    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *, pages: int
+    ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
+    pages: int, spec: Optional[ArenaSpec] = None,
 ):
     """Tenant-steered entry of the paged compressed walk: tenant ->
     page (device page table) -> slab root_lut row -> GLOBAL root node
     -> DIR-16 slot.  Returns (node, alive, best0) in pool-global terms
-    — everything past here is the shared _ctrie_descend."""
+    — everything past here is the shared _ctrie_descend.
+
+    On a spliced arena (``spec.spliced``), page-table rows decode to
+    (page, bank), and a SPLICE_TAG-tagged l0 slot resolves through the
+    tenant's active splice-table bank to a shared plane id: the walk
+    enters at that plane's root row in the appended plane pool (local
+    row 0 — BFS emission makes the subtree root the minimum node id)
+    with NO host round-trip.  best0 (<=16-bit prefixes) is always
+    trunk-owned, so the leaf-push fallback is splice-oblivious."""
     SL = ca.root_lut.shape[0] // pages
     R0 = ca.l0.shape[0] // (pages * 65536)
-    pg = _arena_pages(ca.page_table, tenant)
-    valid = pg >= 0
+    pg_raw = _arena_pages(ca.page_table, tenant)
+    valid = pg_raw >= 0
+    spliced = spec is not None and spec.spliced
+    if spliced:
+        bank = jnp.where(valid, pg_raw >> _SPLICE_BANK_SHIFT, 0)
+        pg = jnp.where(valid, pg_raw & _SPLICE_PAGE_MASK, -1)
+    else:
+        pg = pg_raw
     pg0 = jnp.clip(pg, 0)
     if_ok = (batch.ifindex >= 0) & (batch.ifindex < SL)
     lidx = pg0 * SL + jnp.clip(batch.ifindex, 0, SL - 1)
@@ -3396,19 +3786,40 @@ def _arena_ctrie_entry(
     in0 = valid & (e0 >= 0) & (e0 < ca.l0.shape[0])
     rows0 = jnp.take(ca.l0, e0, axis=0, mode="clip")
     best0 = jnp.where(in0 & (rows0[:, 1] > 0), rows0[:, 1], 0)
-    alive = in0 & (rows0[:, 0] > 0)
-    node = jnp.where(alive, rows0[:, 0] - 1, 0)
+    v = rows0[:, 0]
+    if spliced:
+        K = spec.splice_slots
+        mt = ca.page_table.shape[0]
+        is_sp = v >= jnp.int32(SPLICE_TAG)
+        slot = jnp.clip(v - jnp.int32(SPLICE_TAG), 0, K - 1)
+        t0 = jnp.clip(tenant, 0, mt - 1).astype(jnp.int32)
+        srow = (bank * mt + t0) * K + slot
+        ps = jnp.take(ca.splice, srow, mode="clip").astype(jnp.int32)
+        plane_root = (
+            pages * spec.node_rows
+            + jnp.clip(ps, 0) * spec.plane_node_rows
+        )
+        alive = in0 & jnp.where(is_sp, ps >= 0, v > 0)
+        node = jnp.where(
+            is_sp, plane_root, jnp.maximum(v, 1) - 1
+        ).astype(jnp.int32)
+        node = jnp.where(alive, node, 0)
+    else:
+        alive = in0 & (v > 0)
+        node = jnp.where(alive, v - 1, 0)
     return node, alive, best0
 
 
 def arena_ctrie_rows(
     ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
-    pages: int, d_max: int,
+    pages: int, d_max: int, spec: Optional[ArenaSpec] = None,
 ) -> jax.Array:
     """(B, 3 + R*5) joined rows from the paged compressed walk —
     per-tenant verdicts bit-identical to ctrie_walk_rows over that
     tenant's standalone CTrieTables."""
-    node, alive, best0 = _arena_ctrie_entry(ca, batch, tenant, pages=pages)
+    node, alive, best0 = _arena_ctrie_entry(
+        ca, batch, tenant, pages=pages, spec=spec
+    )
     win = _ctrie_descend(ca.nodes, batch, node, alive, d_max)
     in_w = (win >= 0) & (win < ca.targets.shape[0])
     tval = jnp.where(
@@ -3425,9 +3836,11 @@ def arena_ctrie_rows(
 
 def arena_ctrie_result_and_score(
     ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
-    pages: int, d_max: int,
+    pages: int, d_max: int, spec: Optional[ArenaSpec] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    rows = arena_ctrie_rows(ca, batch, tenant, pages=pages, d_max=d_max)
+    rows = arena_ctrie_rows(
+        ca, batch, tenant, pages=pages, d_max=d_max, spec=spec
+    )
     matched = (
         rows[:, 0].astype(jnp.int32) | (rows[:, 1].astype(jnp.int32) << 16)
     ) > 0
@@ -3437,10 +3850,10 @@ def arena_ctrie_result_and_score(
 
 def classify_arena_ctrie(
     ca: CtrieArena, batch: DeviceBatch, tenant: jax.Array, *,
-    pages: int, d_max: int,
+    pages: int, d_max: int, spec: Optional[ArenaSpec] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     raw, _s = arena_ctrie_result_and_score(
-        ca, batch, tenant, pages=pages, d_max=d_max
+        ca, batch, tenant, pages=pages, d_max=d_max, spec=spec
     )
     return finalize(raw, batch)
 
@@ -3448,6 +3861,7 @@ def classify_arena_ctrie(
 def classify_arena_with_overlay(
     main, overlay: DenseArena, batch: DeviceBatch, tenant: jax.Array, *,
     pages: int, ov_pages: int, d_max: int = 0,
+    spec: Optional[ArenaSpec] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Arena classify with the per-tenant dense overlay side-pool: the
     longest-prefix combine of classify_with_overlay, both sides
@@ -3455,7 +3869,7 @@ def classify_arena_with_overlay(
     DenseArena."""
     if isinstance(main, CtrieArena):
         raw_m, score_m = arena_ctrie_result_and_score(
-            main, batch, tenant, pages=pages, d_max=d_max
+            main, batch, tenant, pages=pages, d_max=d_max, spec=spec
         )
     else:
         raw_m, score_m = arena_dense_result_and_score(
@@ -3470,13 +3884,16 @@ def classify_arena_with_overlay(
 
 @functools.lru_cache(maxsize=None)
 def jitted_classify_arena_wire_fused(
-    family: str, pages: int, d_max: int = 0, ov_pages: int = 0
+    family: str, pages: int, d_max: int = 0, ov_pages: int = 0,
+    spec: Optional[ArenaSpec] = None,
 ):
     """The arena wire launch: (arena[, overlay], wire, tenant) ->
     fused (res16, stats) single-buffer output — the production
     mixed-tenant dispatch.  Cache keyed on the pool geometry statics
-    (family, pages, d_max, overlay pages), which are FIXED per arena:
-    tenant count, swaps and patches never re-specialize."""
+    (family, pages, d_max, overlay pages, and — spliced arenas only —
+    the full spec), which are FIXED per arena: tenant count, swaps and
+    patches never re-specialize.  Callers pass ``spec`` only when
+    spec.spliced (legacy cache arity preserved)."""
     if family == "dense":
         if ov_pages:
             def f(arena, ov, wire, tenant):
@@ -3497,13 +3914,14 @@ def jitted_classify_arena_wire_fused(
                 res, _x, stats = classify_arena_with_overlay(
                     arena, ov, unpack_wire(wire), tenant,
                     pages=pages, ov_pages=ov_pages, d_max=d_max,
+                    spec=spec,
                 )
                 return fuse_wire_outputs(res.astype(jnp.uint16), stats)
         else:
             def f(arena, wire, tenant):
                 res, _x, stats = classify_arena_ctrie(
                     arena, unpack_wire(wire), tenant,
-                    pages=pages, d_max=d_max,
+                    pages=pages, d_max=d_max, spec=spec,
                 )
                 return fuse_wire_outputs(res.astype(jnp.uint16), stats)
     else:
@@ -3567,15 +3985,24 @@ class ArenaAllocator:
                 "rules": np.zeros((S, spec.rule_slots * 5), np.uint16),
             }
         else:
+            pp = spec.plane_slots
             host = {
                 "l0": np.zeros((P * spec.l0_rows, 2), np.int32),
-                "nodes": np.zeros((P * spec.node_rows, 20), np.uint32),
-                "targets": np.zeros(P * spec.target_rows, np.int32),
+                "nodes": np.zeros(
+                    (P * spec.node_rows + pp * spec.plane_node_rows, 20),
+                    np.uint32,
+                ),
+                "targets": np.zeros(
+                    P * spec.target_rows + pp * spec.plane_target_rows,
+                    np.int32,
+                ),
                 "joined": np.zeros(
-                    (P * spec.joined_rows, 3 + spec.rule_slots * 5),
+                    (P * spec.joined_rows + pp * spec.plane_joined_rows,
+                     3 + spec.rule_slots * 5),
                     np.uint16,
                 ),
                 "root_lut": np.zeros(P * spec.lut_rows, np.int32),
+                "splice": np.full(spec.splice_rows, -1, np.int32),
             }
         host["page_table"] = np.full(spec.max_tenants, -1, np.int32)
         self._host = host
@@ -3615,7 +4042,41 @@ class ArenaAllocator:
             "assigns": 0, "patches": 0, "swaps": 0, "flips": 0,
             "destroys": 0, "compactions": 0, "slab_writes": 0,
             "shared_hits": 0, "cow_clones": 0, "dedup_merges": 0,
+            "plane_writes": 0, "plane_hits": 0, "splice_unsplices": 0,
+            "splice_merges": 0,
         }
+        #: structural (subtree-splice) compression state (ISSUE-17) ---------
+        self._spliced = spec.spliced
+        #: plane id free list / refcounts / stage holds (plane analogue
+        #: of the page bookkeeping; a plane frees at zero refs + holds)
+        self._plane_free = list(range(spec.plane_slots))
+        self._plane_refs: dict = {}
+        self._plane_holds: dict = {}
+        self._plane_nnodes: dict = {}
+        #: plane content hash -> plane id and inverse (plane-granular
+        #: content addressing; planes go hash-dirty on in-place joined
+        #: patches and dedup_sweep re-merges re-converged planes)
+        self._hash_plane: dict = {}
+        self._plane_hash: dict = {}
+        self._plane_hash_dirty: set = set()
+        #: tenant -> {splice slot -> plane id} (the host truth of the
+        #: active splice-table bank) and tenant -> _SpliceSub metas
+        #: (slot ownership maps for recompose / edit routing)
+        self._tenant_splices: dict = {}
+        self._tenant_splice_meta: dict = {}
+        #: tenant -> active splice bank (0/1); the page-table row
+        #: encodes page | bank << 30 so both flip in ONE scatter
+        self._tenant_bank: dict = {}
+        #: pages whose resident slab is a decomposed TRUNK (hash-index
+        #: keys domain-tagged b"T"+hash so a trunk never dedups against
+        #: a whole slab of coincidentally-equal bytes)
+        self._page_decomposed: set = set()
+        #: page -> stack of staged splice plans [((slot, plane), ...)]
+        #: consumed LIFO by activate()/release()
+        self._stage_plans: dict = {}
+        #: planes whose node rows changed since the last
+        #: consume_dirty_plane_rows() (the Pallas byte-plane refresh)
+        self._dirty_plane_rows: set = set()
         #: bumps on every structural slab write — consumers that derive
         #: secondary layouts from the node pool (the paged Pallas walk's
         #: byte planes) rebuild when this moves; rules-only patches
@@ -3713,6 +4174,46 @@ class ArenaAllocator:
             } if "nodes" in self._host else {}
             return self.node_gen, pages, rows
 
+    def consume_dirty_plane_rows(self):
+        """(node_gen, [(pool row base, plane node rows), ...]) of every
+        subtree plane whose node rows changed since the last call — the
+        plane-region analogue of consume_dirty_node_pages (plane writes
+        bump node_gen but touch no page slab, so the Pallas byte-plane
+        consumer refreshes O(touched subtrees), never the pool)."""
+        with self._lock:
+            planes = sorted(self._dirty_plane_rows)
+            self._dirty_plane_rows = set()
+            blocks: list = []
+            if planes and "nodes" in self._host:
+                snp = self.spec.plane_node_rows
+                for ps in planes:
+                    b = self._plane_base(ps)[0]
+                    blocks.append(
+                        (b, self._host["nodes"][b: b + snp].copy())
+                    )
+            return self.node_gen, blocks
+
+    def plane_refcount(self, ps: int) -> int:
+        """Splice-row references on one subtree plane (0 for free /
+        hold-only planes)."""
+        with self._lock:
+            return self._plane_refs.get(ps, 0)
+
+    def tenant_splices(self, tenant: int) -> dict:
+        """{splice slot -> plane id} of the tenant's active splice
+        bank (empty for unspliced tenants)."""
+        with self._lock:
+            return dict(self._tenant_splices.get(tenant) or {})
+
+    def distinct_planes(self) -> int:
+        """Live subtree planes (referenced or held) — the plane-pool
+        half of the HBM occupancy numerator."""
+        with self._lock:
+            live = set(self._plane_refs) | {
+                p for p, h in self._plane_holds.items() if h > 0
+            }
+            return len(live)
+
     def counter_values(self) -> dict:
         """tenant_* counters for /metrics (the obs satellite): gauges
         for slab occupancy plus monotonic mutation counts."""
@@ -3730,6 +4231,21 @@ class ArenaAllocator:
                 "tenant_hash_index": len(self._hash_page),
                 "tenant_hash_dirty": len(self._hash_dirty),
             }
+            if self._spliced:
+                live_planes = set(self._plane_refs) | {
+                    p for p, h in self._plane_holds.items() if h > 0
+                }
+                out["arena_subtree_planes"] = len(live_planes)
+                out["arena_shared_subtrees"] = sum(
+                    1 for n in self._plane_refs.values() if n > 1
+                )
+                out["arena_splice_rows"] = sum(
+                    len(m) for m in self._tenant_splices.values()
+                )
+                out["splice_unsplices"] = self.counters[
+                    "splice_unsplices"
+                ]
+                out["splice_merges"] = self.counters["splice_merges"]
             for k, v in self.counters.items():
                 out[f"tenant_{k}_total"] = v
             return out
@@ -3757,6 +4273,31 @@ class ArenaAllocator:
                 np.zeros((rows,) + tuple(arr.shape[1:]), arr.dtype),
             ))
         txn_scatter(entries, self._device)
+        if self._spliced:
+            # plane writes: one fused txn_scatter over the three plane
+            # arrays at their per-plane row counts, plus the K-row
+            # splice-bank scatter — the whole splice lifecycle (plane
+            # share/unsplice/merge, splice-map update, bank flip) then
+            # rides warmed executables only
+            txn_scatter(
+                [
+                    (
+                        getattr(dev, name),
+                        np.zeros(rows, np.int64),
+                        np.zeros(
+                            (rows,) + tuple(getattr(dev, name).shape[1:]),
+                            getattr(dev, name).dtype,
+                        ),
+                    )
+                    for name, rows in zip(
+                        ("nodes", "targets", "joined"), self._plane_rows()
+                    )
+                ],
+                self._device,
+            )
+            K = self.spec.splice_slots
+            _scatter(dev.splice, np.arange(K, dtype=np.int64),
+                     np.full(K, -1, np.int32), self._device)
         # rules-only patch combo (ladder) for the hint fast path
         patchable = [self._patch_arrays(dev)]
         for group in patchable:
@@ -3789,6 +4330,244 @@ class ArenaAllocator:
         if self.spec.family == "dense":
             return ("key_words", "mask_words", "mask_len", "rules")
         return ("l0", "nodes", "targets", "joined", "root_lut")
+
+    # -- subtree plane plumbing (spliced arenas) ------------------------------
+
+    def _plane_rows(self):
+        s = self.spec
+        return (s.plane_node_rows, s.plane_target_rows,
+                s.plane_joined_rows)
+
+    def _plane_base(self, ps: int):
+        """(nodes, targets, joined) pool row bases of plane ``ps`` —
+        the plane pool region starts where the page slabs end."""
+        s = self.spec
+        return (s.pages * s.node_rows + ps * s.plane_node_rows,
+                s.pages * s.target_rows + ps * s.plane_target_rows,
+                s.pages * s.joined_rows + ps * s.plane_joined_rows)
+
+    def _decode_page_table(self, vals):
+        """Strip the splice bank bit off page-table values (identity on
+        unspliced arenas); -1 absent rows pass through."""
+        vals = np.asarray(vals)
+        if not self._spliced:
+            return vals
+        return np.where(
+            vals >= 0, vals & _SPLICE_PAGE_MASK, vals
+        ).astype(vals.dtype)
+
+    def _canonical_of_plane(self, ps: int):
+        """(pnodes, ptargets, pjoined, n_local) canonical form of one
+        resident plane, derived from the host mirror by stripping the
+        plane-slot offsets."""
+        names = ("nodes", "targets", "joined")
+        arrs = tuple(
+            self._host[name][b: b + r]
+            for name, r, b in zip(names, self._plane_rows(),
+                                  self._plane_base(ps))
+        )
+        n_local = self._plane_nnodes.get(ps, 0)
+        return _unoffset_plane_slab(self.spec, arrs, n_local, ps) + (
+            n_local,
+        )
+
+    def _plane_is_shared(self, ps: int) -> bool:
+        return (
+            self._plane_refs.get(ps, 0) > 1
+            or self._plane_holds.get(ps, 0) > 0
+        )
+
+    def _alloc_plane(self) -> int:
+        if not self._plane_free:
+            raise _PlaneCapacityError(
+                f"arena out of subtree planes ({self.spec.plane_slots} "
+                "total) — the decomposed install falls back to the "
+                "whole-slab path"
+            )
+        return self._plane_free.pop(0)
+
+    def _write_plane(self, ps: int, plane_arrays, n_local: int) -> None:
+        """Bake one canonical plane into the pool region: mirror first,
+        then ONE fused txn_scatter across nodes/targets/joined at the
+        plane row counts (warmed in _warm)."""
+        resident = _offset_plane_slab(self.spec, plane_arrays, n_local, ps)
+        names = ("nodes", "targets", "joined")
+        entries = []
+        for name, rows, base, arr in zip(
+            names, self._plane_rows(), self._plane_base(ps), resident
+        ):
+            self._host[name][base: base + rows] = arr
+            entries.append((
+                getattr(self._dev, name),
+                base + np.arange(rows, dtype=np.int64),
+                arr,
+            ))
+        patched = txn_scatter(entries, self._device)
+        if patched is None:
+            raise ArenaCapacityError(
+                "plane write exceeded the scatter budget"
+            )
+        self._dev = self._dev._replace(**dict(zip(names, patched)))
+        self._plane_nnodes[ps] = int(n_local)
+        self.counters["plane_writes"] += 1
+        self.node_gen += 1
+        self._dirty_plane_rows.add(ps)
+
+    def _unindex_plane(self, ps: int) -> None:
+        old = self._plane_hash.pop(ps, None)
+        if old is not None and self._hash_plane.get(old) == ps:
+            del self._hash_plane[old]
+
+    def _index_plane(self, ps: int, phash: bytes) -> bool:
+        self._unindex_plane(ps)
+        self._plane_hash_dirty.discard(ps)
+        cur = self._hash_plane.get(phash)
+        if cur is not None and cur != ps:
+            self._plane_hash_dirty.add(ps)
+            return False
+        self._hash_plane[phash] = ps
+        self._plane_hash[ps] = phash
+        return True
+
+    def _plane_incref(self, ps: int) -> None:
+        self._plane_refs[ps] = self._plane_refs.get(ps, 0) + 1
+
+    def _plane_decref(self, ps: int, from_unsplice: bool = False) -> None:
+        """Drop one splice-row reference on a plane; the plane frees at
+        zero (with no holds).  ``from_unsplice`` marks the unsplice
+        path's old-plane decrement — the exact statement the injected
+        spliceleak defect forgets."""
+        if from_unsplice and _inject_spliceleak_bug():
+            return
+        n = self._plane_refs.get(ps, 0) - 1
+        if n > 0:
+            self._plane_refs[ps] = n
+            return
+        self._plane_refs.pop(ps, None)
+        if self._plane_holds.get(ps, 0) == 0:
+            self._release_plane(ps)
+
+    def _release_plane(self, ps: int) -> None:
+        self._unindex_plane(ps)
+        self._plane_hash_dirty.discard(ps)
+        if ps not in self._plane_free:
+            self._plane_free.append(ps)
+
+    def _release_plane_hold(self, ps: int) -> None:
+        h = self._plane_holds.get(ps, 0)
+        if h <= 0:
+            return
+        if h == 1:
+            self._plane_holds.pop(ps, None)
+        else:
+            self._plane_holds[ps] = h - 1
+        if (
+            self._plane_refs.get(ps, 0) == 0
+            and self._plane_holds.get(ps, 0) == 0
+        ):
+            self._release_plane(ps)
+
+    def _acquire_plane(self, m: "_SpliceSub") -> int:
+        """Content-addressed plane acquisition for one subtree meta:
+        hash HIT -> refcount bump on the already-resident plane (N
+        near-copy tenants cost ONE plane); miss -> alloc + warmed
+        write + index.  Returns the plane id with one reference
+        taken."""
+        ps = self._hash_plane.get(m.phash)
+        if ps is not None:
+            self._plane_incref(ps)
+            self.counters["plane_hits"] += 1
+            return ps
+        ps = self._alloc_plane()
+        try:
+            self._write_plane(ps, m.plane, m.n_local)
+        except Exception:
+            if ps not in self._plane_free:
+                self._plane_free.insert(0, ps)
+            raise
+        self._index_plane(ps, m.phash)
+        self._plane_refs[ps] = 1
+        return ps
+
+    def _write_splice_rows(self, tenant: int, slot_map: dict) -> None:
+        """Write the tenant's FULL splice row block (all K slots; -1
+        for unused) to the INACTIVE bank and switch the tenant's bank
+        variable — the very next _flip() publishes page + bank in one
+        1-row page-table scatter, so classify never pairs a new splice
+        map with the old page (or vice versa)."""
+        K = self.spec.splice_slots
+        mt = self.spec.max_tenants
+        bank = 1 - self._tenant_bank.get(tenant, 0)
+        vals = np.full(K, -1, np.int32)
+        for slot, ps in slot_map.items():
+            vals[slot] = ps
+        base = (bank * mt + tenant) * K
+        self._host["splice"][base: base + K] = vals
+        sp = _scatter(
+            self._dev.splice,
+            base + np.arange(K, dtype=np.int64),
+            vals, self._device,
+        )
+        self._dev = self._dev._replace(splice=sp)
+        self._tenant_bank[tenant] = bank
+
+    def _clear_splice_rows(self, tenant: int) -> None:
+        """Blank the tenant's ACTIVE splice bank (no bank switch) —
+        used after the tenant stopped serving spliced content (whole-
+        slab activate / destroy), purely for mirror hygiene: an
+        untagged l0 never reads the splice table."""
+        K = self.spec.splice_slots
+        mt = self.spec.max_tenants
+        bank = self._tenant_bank.get(tenant, 0)
+        base = (bank * mt + tenant) * K
+        vals = np.full(K, -1, np.int32)
+        self._host["splice"][base: base + K] = vals
+        sp = _scatter(
+            self._dev.splice,
+            base + np.arange(K, dtype=np.int64),
+            vals, self._device,
+        )
+        self._dev = self._dev._replace(splice=sp)
+
+    def _drop_tenant_planes(self, tenant: int) -> None:
+        """Release every plane the tenant's splice rows reference and
+        clear its splice state (the tenant leaves decomposed serving)."""
+        smap = self._tenant_splices.pop(tenant, None)
+        self._tenant_splice_meta.pop(tenant, None)
+        if smap:
+            self._clear_splice_rows(tenant)
+            for ps in smap.values():
+                self._plane_decref(ps)
+
+    def _bake_decomposed(self, tables: CompiledTables):
+        """(trunk arrays, n_nodes, trunk hash key, metas) of one tenant
+        table's subtree decomposition, or None when nothing factors.
+        Memoized on the tables object like _bake_canonical, so repeated
+        installs of a known near-copy pay the decompose ONCE.  The
+        trunk key is domain-tagged (b"T" + hash) — a trunk page never
+        hash-collides with a whole slab."""
+        if not self._spliced:
+            return None
+        cached = getattr(tables, "_arena_splice_cache", None)
+        if cached is not None and cached[0] == self.spec:
+            return cached[1]
+        arrays, n_nodes, _chash = self._bake_canonical(tables)
+        dec = _decompose_ctrie_slab(self.spec, arrays, n_nodes)
+        if dec is None:
+            result = None
+        else:
+            trunk, metas = dec
+            result = (
+                trunk, n_nodes,
+                b"T" + slab_content_hash(trunk, n_nodes), metas,
+            )
+        try:
+            object.__setattr__(
+                tables, "_arena_splice_cache", (self.spec, result)
+            )
+        except Exception:
+            pass
+        return result
 
     # -- content addressing / CoW plumbing ------------------------------------
 
@@ -3974,16 +4753,28 @@ class ArenaAllocator:
             raise ArenaCapacityError("slab write exceeded the scatter budget")
         self._dev = self._dev._replace(**dict(zip(names, patched)))
         self._page_nnodes[page] = int(n_nodes)
+        # a full-slab write is whole-slab content by default; the trunk
+        # writer re-marks decomposed pages right after
+        self._page_decomposed.discard(page)
         self.counters["slab_writes"] += 1
         self.node_gen += 1
         self._dirty_node_pages.add(page)
 
     def _flip(self, tenant: int, page: int, _inject: bool = False) -> None:
         """The page-table row flip — the O(1) activation that replaces
-        a full re-upload.  Injected defect (pageflip, activate-only):
-        the device row keeps its STALE value while the host mirror
-        moves on — the arena keeps serving the OLD slab after a swap."""
-        self._host["page_table"][tenant] = page
+        a full re-upload.  On a spliced arena the row encodes
+        ``page | bank << 30``: the tenant's splice-table bank publishes
+        in the SAME scatter as its page, which is what makes a splice-
+        map change atomic with the page move.  Injected defect
+        (pageflip, activate-only): the device row keeps its STALE value
+        while the host mirror moves on — the arena keeps serving the
+        OLD slab after a swap."""
+        enc = page
+        if self._spliced and page >= 0:
+            enc = page | (
+                self._tenant_bank.get(tenant, 0) << _SPLICE_BANK_SHIFT
+            )
+        self._host["page_table"][tenant] = enc
         if _inject:
             self.counters["flips"] += 1
             return
@@ -3993,7 +4784,7 @@ class ArenaAllocator:
         pt = _scatter(
             self._dev.page_table,
             np.array([tenant], np.int64),
-            np.array([page], np.int32),
+            np.array([enc], np.int32),
             self._device,
         )
         self._dev = self._dev._replace(page_table=pt)
@@ -4036,12 +4827,36 @@ class ArenaAllocator:
                      (structural edit, no page change);
         - "assign":  fresh page + page-table flip.
 
+        Spliced arenas add subtree-granular paths:
+
+        - "patch":    additionally covers rules-only edits that land
+                      inside PRIVATE planes / the private trunk;
+        - "unsplice": a rules-only edit inside a SHARED subtree plane
+                      repointed just that slot at a private (or
+                      re-converged) plane — K splice rows + one bank
+                      flip, trunk untouched;
+        - "share":    trunk hash hit AND every plane hash hit (the
+                      create-from-near-copy case costs the changed
+                      planes only).
+
         ``pre_flip`` (optional callable) runs after any slab write and
         strictly BEFORE the page-table flip of paths that redirect the
         tenant to a new page — the fused-walk classifier passes its
         plane refresh here so classify never pairs a new page table
         with stale planes (new-planes/old-table is the safe pairing)."""
         self._check_tenant(tenant)
+        with self._lock:
+            if self._spliced:
+                return self._load_tenant_spliced(
+                    tenant, tables, hint, pre_flip
+                )
+            return self._load_tenant_whole(tenant, tables, hint, pre_flip)
+
+    def _load_tenant_whole(self, tenant: int, tables: CompiledTables,
+                           hint=None, pre_flip=None) -> str:
+        """Whole-slab install — the pre-splice lifecycle, and the
+        spliced arena's degrade-never-refuse fallback (tables that
+        don't decompose, plane-pool exhaustion)."""
         with self._lock:
             page = self._tenant_page.get(tenant)
             old = self._tenant_tables.get(tenant)
@@ -4116,6 +4931,265 @@ class ArenaAllocator:
             return self._cow_install(
                 tenant, page, arrays, n_nodes, chash, tables, pre_flip,
             )
+
+    def _write_trunk(self, page: int, trunk_arrays, n_nodes: int,
+                     tkey: bytes) -> None:
+        """Bake a decomposed trunk slab into ``page`` and index it
+        under its domain-tagged key (b"T" + hash): trunk bytes are
+        content-canonical across structurally-identical tenants, so N
+        near-copies share ONE trunk page."""
+        self._write_slab(
+            page, self._offset(trunk_arrays, n_nodes, page),
+            n_nodes=n_nodes,
+        )
+        self._page_decomposed.add(page)
+        self._index_page(page, tkey)
+
+    def _load_tenant_spliced(self, tenant: int, tables: CompiledTables,
+                             hint, pre_flip) -> str:
+        """The decomposed install: rules-only edits route through
+        _splice_edit (touched subtrees only); otherwise decompose,
+        acquire planes content-addressed, land/share the trunk, write
+        the splice rows to the inactive bank and publish page + bank in
+        one flip.  Tables that don't decompose (or plane-pool
+        exhaustion) degrade to the whole-slab path."""
+        page = self._tenant_page.get(tenant)
+        old = self._tenant_tables.get(tenant)
+        if (page is not None and old is not None and hint is not None
+                and self._tenant_splices.get(tenant)
+                and hint_trie_unchanged(hint)):
+            r = self._splice_edit(tenant, page, old, tables, hint,
+                                  pre_flip)
+            if r is not None:
+                return r
+        dec = self._bake_decomposed(tables)
+        if dec is None:
+            # a previously-spliced tenant's hint describes an edit
+            # against DECOMPOSED residency — never let the whole-slab
+            # fast paths patch a trunk as if it were a flat slab
+            if self._tenant_splices.get(tenant):
+                hint = None
+            r = self._load_tenant_whole(tenant, tables, hint, pre_flip)
+            self._drop_tenant_planes(tenant)
+            return r
+        trunk_arrays, n_nodes, tkey, metas = dec
+        hits0 = self.counters["plane_hits"]
+        got: list = []
+        try:
+            for m in metas:
+                got.append(self._acquire_plane(m))
+        except _PlaneCapacityError:
+            for ps in got:
+                self._plane_decref(ps)
+            r = self._load_tenant_whole(tenant, tables, None, pre_flip)
+            self._drop_tenant_planes(tenant)
+            return r
+        all_hit = (self.counters["plane_hits"] - hits0) == len(metas)
+        shared_trunk = self._hash_page.get(tkey)
+        wrote = False
+        try:
+            if shared_trunk is not None:
+                target = shared_trunk
+            elif page is not None and not self._is_shared(page):
+                self._write_trunk(page, trunk_arrays, n_nodes, tkey)
+                target = page
+                wrote = True
+            else:
+                target = self._alloc_page()
+                try:
+                    self._write_trunk(target, trunk_arrays, n_nodes, tkey)
+                except Exception:
+                    self._free.insert(0, target)
+                    raise
+                wrote = True
+        except ArenaCapacityError:
+            for ps in got:
+                self._plane_decref(ps)
+            raise
+        old_map = dict(self._tenant_splices.get(tenant) or {})
+        slot_map = {m.slot: ps for m, ps in zip(metas, got)}
+        self._write_splice_rows(tenant, slot_map)
+        self._tenant_splices[tenant] = slot_map
+        self._tenant_splice_meta[tenant] = metas
+        if target != page:
+            self._tenant_page[tenant] = target
+            self._incref(target)
+        self._tenant_tables[tenant] = tables
+        if pre_flip is not None:
+            pre_flip()
+        self._flip(tenant, target)
+        if page is not None and target != page:
+            self._decref(page)
+        for ps in old_map.values():
+            self._plane_decref(ps)
+        if shared_trunk is not None and all_hit:
+            self.counters["shared_hits"] += 1
+            return "share"
+        if page is None:
+            self.counters["assigns"] += 1
+            return "assign"
+        if wrote and target == page:
+            self.counters["assigns"] += 1
+            return "rewrite"
+        if target != page:
+            if wrote:
+                self.counters["cow_clones"] += 1
+                return "cow"
+            self.counters["shared_hits"] += 1
+            return "share"
+        # same trunk page; "unsplice" when the plane set changed
+        return "unsplice" if slot_map != old_map else "share"
+
+    def _splice_edit(self, tenant: int, page: int, old, new, hint,
+                     pre_flip):
+        """Rules-only edit of a spliced tenant, routed per dirty joined
+        row to its owning subtree: trunk-owned rows patch the (private)
+        trunk in place; plane-owned rows patch a private plane in place
+        (lazy re-hash) or UNSPLICE a shared plane — repoint just that
+        slot at a freshly-written private plane (or re-share an
+        already-resident identical one), publish the new splice map via
+        bank write + flip, and decrement the old plane's refcount (the
+        spliceleak injection site).  Returns None when the edit can't
+        be expressed this way (caller falls back to the decomposed full
+        install)."""
+        metas = self._tenant_splice_meta.get(tenant)
+        cur = self._tenant_splices.get(tenant)
+        if not metas or not cur:
+            return None
+        dirty = np.unique(np.asarray(hint.get("dense", ()), np.int64))
+        dirty = dirty[(dirty >= 0) & (dirty < new.rules.shape[0])]
+        _seed_ctrie_caches_forward(old, new, dirty)
+        pr = _joined_tidx_patch_rows(new, dirty)
+        if pr is None:
+            return None
+        pos, rows = pr
+        if len(pos) and (
+            int(pos.max()) >= self.spec.joined_rows
+            or rows.shape[1] != self._dev.joined.shape[1]
+        ):
+            return None
+        if len(pos) == 0:
+            self._tenant_tables[tenant] = new
+            self.counters["patches"] += 1
+            return "patch"
+        rowmap = {int(p): rows[j] for j, p in enumerate(pos.tolist())}
+        own: dict = {}
+        for i, m in enumerate(metas):
+            for v in m.tidx.tolist():
+                own[v] = i
+        trunk_pos: list = []
+        by_slot: dict = {}
+        for p in pos.tolist():
+            i = own.get(int(p))
+            if i is None:
+                trunk_pos.append(int(p))
+            else:
+                by_slot.setdefault(i, []).append(int(p))
+        if trunk_pos and self._is_shared(page):
+            # trunk CoW: route through the full decomposed install
+            return None
+        # plan plane actions BEFORE mutating anything, so a plane-pool
+        # shortage (or a merge-target hazard) bails cleanly
+        plans: list = []
+        allocs = 0
+        dropping: set = set()
+        for i in sorted(by_slot):
+            plist = by_slot[i]
+            m = metas[i]
+            ps = cur.get(m.slot)
+            if ps is None:
+                return None
+            pn, pt, pj, n_local = self._canonical_of_plane(ps)
+            if n_local != m.n_local:
+                return None
+            pj2 = np.array(pj, copy=True)
+            for p in plist:
+                j = int(np.searchsorted(m.tidx, p))
+                if j >= len(m.tidx) or int(m.tidx[j]) != p:
+                    return None
+                pj2[1 + j] = rowmap[p]
+            if not self._plane_is_shared(ps):
+                plans.append(("patch", m, ps, plist, None, None))
+                continue
+            plane = (pn, pt, pj2)
+            h = slab_content_hash(plane, n_local)
+            tgt = self._hash_plane.get(h)
+            if tgt is not None and tgt != ps:
+                plans.append(("merge", m, ps, plist, tgt, None))
+            else:
+                plans.append(("unsplice", m, ps, plist, None, (plane, h)))
+                allocs += 1
+            dropping.add(ps)
+        if allocs > len(self._plane_free):
+            return None
+        for kind, _m, _ps, _pl, tgt, _b in plans:
+            if kind == "merge" and tgt in dropping:
+                # the merge target is itself being dropped this edit —
+                # ordering hazard; take the full-install path instead
+                return None
+        if trunk_pos:
+            gpos = (
+                page * self.spec.joined_rows
+                + np.array(trunk_pos, np.int64)
+            )
+            vals = np.stack([rowmap[p] for p in trunk_pos])
+            self._host["joined"][gpos] = vals
+            joined = _capped_scatter(
+                self._dev.joined, gpos, vals, self._device
+            )
+            if joined is None:
+                return None
+            self._dev = self._dev._replace(joined=joined)
+            self._mark_hash_dirty(page)
+        changed: dict = {}
+        for kind, m, ps, plist, tgt, built in plans:
+            if kind == "patch":
+                jb = self._plane_base(ps)[2]
+                lpos = np.array(
+                    [jb + 1 + int(np.searchsorted(m.tidx, p))
+                     for p in plist],
+                    np.int64,
+                )
+                vals = np.stack([rowmap[p] for p in plist])
+                self._host["joined"][lpos] = vals
+                joined = _capped_scatter(
+                    self._dev.joined, lpos, vals, self._device
+                )
+                if joined is None:
+                    return None
+                self._dev = self._dev._replace(joined=joined)
+                self._unindex_plane(ps)
+                self._plane_hash_dirty.add(ps)
+            elif kind == "merge":
+                self._plane_incref(tgt)
+                changed[m.slot] = tgt
+                self.counters["splice_merges"] += 1
+                self._plane_decref(ps, from_unsplice=True)
+            else:
+                plane, h = built
+                nps = self._alloc_plane()
+                try:
+                    self._write_plane(nps, plane, m.n_local)
+                except Exception:
+                    if nps not in self._plane_free:
+                        self._plane_free.insert(0, nps)
+                    raise
+                self._index_plane(nps, h)
+                self._plane_refs[nps] = 1
+                changed[m.slot] = nps
+                self.counters["splice_unsplices"] += 1
+                self._plane_decref(ps, from_unsplice=True)
+        if changed:
+            newmap = dict(cur)
+            newmap.update(changed)
+            self._write_splice_rows(tenant, newmap)
+            self._tenant_splices[tenant] = newmap
+            if pre_flip is not None:
+                pre_flip()
+            self._flip(tenant, page)
+        self._tenant_tables[tenant] = new
+        self.counters["patches"] += 1
+        return "unsplice" if changed else "patch"
 
     def _cow_install(self, tenant, donor, arrays, n_nodes, chash,
                      tables, pre_flip) -> str:
@@ -4216,6 +5290,13 @@ class ArenaAllocator:
         slab).  On a miss, bake into a free page and index it.  Returns
         the staged page id (reserved until activate/release)."""
         with self._lock:
+            if self._spliced:
+                dec = self._bake_decomposed(tables)
+                if dec is not None:
+                    try:
+                        return self._stage_spliced(dec)
+                    except _PlaneCapacityError:
+                        pass  # degrade to whole-slab staging
             arrays, n_nodes, chash = self._bake_canonical(tables)
             hit = self._hash_page.get(chash)
             if hit is not None:
@@ -4235,10 +5316,133 @@ class ArenaAllocator:
             self._page_holds[page] = self._page_holds.get(page, 0) + 1
             return page
 
+    def _stage_spliced(self, dec) -> int:
+        """Decomposed staging: hold the shared planes (writing the
+        missing ones) plus the trunk page, and record the splice PLAN
+        on the page's stack — activate() rederives the plan from the
+        tables and consumes it (holds become refs), release() pops it.
+        Raises _PlaneCapacityError (rolled back) for the whole-slab
+        fallback."""
+        trunk_arrays, n_nodes, tkey, metas = dec
+        got: list = []
+        try:
+            for m in metas:
+                ps = self._hash_plane.get(m.phash)
+                if ps is None:
+                    ps = self._alloc_plane()
+                    try:
+                        self._write_plane(ps, m.plane, m.n_local)
+                    except Exception:
+                        if ps not in self._plane_free:
+                            self._plane_free.insert(0, ps)
+                        raise
+                    self._index_plane(ps, m.phash)
+                else:
+                    self.counters["plane_hits"] += 1
+                self._plane_holds[ps] = self._plane_holds.get(ps, 0) + 1
+                got.append(ps)
+        except _PlaneCapacityError:
+            for ps in got:
+                self._release_plane_hold(ps)
+            raise
+        hit = self._hash_page.get(tkey)
+        if hit is not None:
+            self._page_holds[hit] = self._page_holds.get(hit, 0) + 1
+            self.counters["shared_hits"] += 1
+            page = hit
+        else:
+            page = self._alloc_page()
+            try:
+                self._write_trunk(page, trunk_arrays, n_nodes, tkey)
+            except Exception:
+                self._free.insert(0, page)
+                for ps in got:
+                    self._release_plane_hold(ps)
+                raise
+            self._page_holds[page] = self._page_holds.get(page, 0) + 1
+        self._stage_plans.setdefault(page, []).append(
+            tuple((m.slot, ps) for m, ps in zip(metas, got))
+        )
+        return page
+
+    def _take_stage_plan(self, page: int, tables):
+        """Match + pop the staged splice plan for (page, tables):
+        (plan, metas) when this page was splice-staged for these
+        tables, else None (whole-slab activate).  The memoized
+        decompose plus the held planes' stable hash index make the
+        rederivation exact."""
+        if not self._spliced or tables is None:
+            return None
+        plans = self._stage_plans.get(page)
+        if not plans:
+            return None
+        dec = self._bake_decomposed(tables)
+        if dec is None:
+            return None
+        _trunk, _nn, _tkey, metas = dec
+        want = tuple(
+            (m.slot, self._hash_plane.get(m.phash)) for m in metas
+        )
+        if any(ps is None for _slot, ps in want) or want not in plans:
+            return None
+        plans.remove(want)
+        if not plans:
+            self._stage_plans.pop(page, None)
+        return want, metas
+
+    def _activate_spliced(self, tenant: int, page: int, tables,
+                          plan, metas) -> None:
+        """Activate a splice-staged page: consume the plane holds into
+        splice-row references, write the tenant's splice rows to the
+        inactive bank, and publish page + bank in ONE flip — the
+        spliced hot-swap stays O(1) page-table scatter + K splice
+        rows."""
+        if page in self._free:
+            self._free.remove(page)
+            self._hash_dirty.add(page)
+        h = self._page_holds.get(page, 0)
+        if h:  # consume one stage reservation
+            if h == 1:
+                self._page_holds.pop(page, None)
+            else:
+                self._page_holds[page] = h - 1
+        old_page = self._tenant_page.get(tenant)
+        old_map = dict(self._tenant_splices.get(tenant) or {})
+        slot_map: dict = {}
+        for slot, ps in plan:
+            self._plane_incref(ps)
+            self._release_plane_hold(ps)
+            slot_map[slot] = ps
+        self._write_splice_rows(tenant, slot_map)
+        self._tenant_splices[tenant] = slot_map
+        self._tenant_splice_meta[tenant] = metas
+        self._tenant_page[tenant] = page
+        self._tenant_tables[tenant] = tables
+        if old_page != page:
+            self._incref(page)
+        self._flip(
+            tenant, page,
+            _inject=_inject_pageflip_bug() and old_page is not None,
+        )
+        if old_page is not None and old_page != page:
+            self._decref(old_page)
+        for ps in old_map.values():
+            self._plane_decref(ps)
+        self.counters["swaps"] += 1
+
     def release(self, page: int) -> None:
         """Drop one staged-but-never-activated reservation; the page
-        frees when no references and no other holds remain."""
+        frees when no references and no other holds remain.  On a
+        spliced arena a splice-staged reservation also releases its
+        plan's plane holds (plans pop LIFO per page)."""
         with self._lock:
+            plans = self._stage_plans.get(page)
+            if plans:
+                plan = plans.pop()
+                if not plans:
+                    self._stage_plans.pop(page, None)
+                for _slot, ps in plan:
+                    self._release_plane_hold(ps)
             h = self._page_holds.get(page, 0)
             if h <= 0:
                 return
@@ -4261,6 +5465,11 @@ class ArenaAllocator:
         error: both tenants' rows reference one refcounted slab."""
         self._check_tenant(tenant)
         with self._lock:
+            taken = self._take_stage_plan(page, tables)
+            if taken is not None:
+                return self._activate_spliced(
+                    tenant, page, tables, taken[0], taken[1]
+                )
             # a re-activated page may sit on the free list (the
             # ping-pong standby pattern drops the previous page to
             # refcount 0 on each flip): claim it back — the slab bytes
@@ -4295,6 +5504,10 @@ class ArenaAllocator:
             )
             if old_page is not None and old_page != page:
                 self._decref(old_page)
+            if self._spliced and self._tenant_splices.get(tenant):
+                # the tenant now serves whole-slab content; its splice
+                # rows are unread (untagged l0) — release the planes
+                self._drop_tenant_planes(tenant)
             self.counters["swaps"] += 1
 
     def swap_tenant(self, tenant: int, tables: CompiledTables) -> None:
@@ -4313,6 +5526,9 @@ class ArenaAllocator:
             self._flip(tenant, -1)
             if page is not None:
                 self._decref(page)
+            if self._spliced:
+                self._drop_tenant_planes(tenant)
+                self._tenant_bank.pop(tenant, None)
             self.counters["destroys"] += 1
 
     def compact(self) -> int:
@@ -4355,6 +5571,10 @@ class ArenaAllocator:
                 # page BEFORE the flips (bookkeeping must never lag the
                 # device rows)
                 self._page_refs[tgt] = self._page_refs.pop(src)
+                if src in self._page_decomposed:
+                    # the moved slab is a trunk; the flag (like
+                    # _page_nnodes) persists on src for claim-back
+                    self._page_decomposed.add(tgt)
                 chash = self._page_hash.pop(src, None)
                 if chash is not None and self._hash_page.get(chash) == src:
                     self._hash_page[chash] = tgt
@@ -4406,6 +5626,12 @@ class ArenaAllocator:
                     self._canonical_of_page(page),
                     self._page_nnodes.get(page, 0),
                 )
+                if page in self._page_decomposed:
+                    # trunk slabs hash in their own domain: a trunk
+                    # must never dedup against a whole slab of
+                    # coincidentally-equal bytes (their l0 tags mean
+                    # different things)
+                    chash = b"T" + chash
                 hashed += 1
                 cur = self._hash_page.get(chash)
                 if cur is None or cur == page:
@@ -4426,7 +5652,66 @@ class ArenaAllocator:
                 self._hash_dirty.discard(page)
                 if sharers:
                     self.counters["dedup_merges"] += 1
-        return {"hashed": hashed, "merged": len(moved), "moved": moved}
+            plane_merged = 0
+            if self._spliced:
+                plane_merged = self._dedup_planes(limit)
+        rep = {"hashed": hashed, "merged": len(moved), "moved": moved}
+        if self._spliced:
+            rep["plane_merged"] = plane_merged
+        return rep
+
+    def _dedup_planes(self, limit: Optional[int] = None) -> int:
+        """The plane half of dedup_sweep: re-hash hash-dirty planes
+        (in-place plane patches), re-index them, and MERGE planes whose
+        content re-converged — every splice row of the duplicate
+        repoints at the canonical plane (K-row bank write + 1-row flip
+        per affected tenant, old plane serves until its rows flip),
+        then the duplicate frees.  Held planes re-index but never merge
+        away.  Returns planes merged."""
+        merged = 0
+        pdirty = sorted(self._plane_hash_dirty)
+        if limit is not None:
+            pdirty = pdirty[: max(int(limit), 0)]
+        for ps in pdirty:
+            if (
+                self._plane_refs.get(ps, 0) == 0
+                and self._plane_holds.get(ps, 0) == 0
+            ):
+                self._plane_hash_dirty.discard(ps)
+                continue
+            pn, pt, pj, n_local = self._canonical_of_plane(ps)
+            h = slab_content_hash((pn, pt, pj), n_local)
+            cur = self._hash_plane.get(h)
+            if cur is None or cur == ps:
+                self._index_plane(ps, h)
+                continue
+            if self._plane_holds.get(ps, 0):
+                self._plane_hash_dirty.discard(ps)
+                continue
+            affected = sorted(
+                t for t, smap in self._tenant_splices.items()
+                if ps in smap.values()
+            )
+            for t in affected:
+                smap = self._tenant_splices[t]
+                newmap: dict = {}
+                for slot, v in smap.items():
+                    if v == ps:
+                        self._plane_incref(cur)
+                        newmap[slot] = cur
+                    else:
+                        newmap[slot] = v
+                self._write_splice_rows(t, newmap)
+                self._tenant_splices[t] = newmap
+                self._flip(t, self._tenant_page[t])
+                for v in smap.values():
+                    if v == ps:
+                        self._plane_decref(ps)
+            self._plane_hash_dirty.discard(ps)
+            if affected:
+                merged += 1
+                self.counters["splice_merges"] += 1
+        return merged
 
 
 # === stateful flow tier (device-resident connection tracking) ================

@@ -1156,16 +1156,24 @@ def build_arena_cwalk_planes(
 def classify_arena_cwalk(
     ca, planes: jax.Array, batch: DeviceBatch, tenant: jax.Array, *,
     pages: int, d_max: int, interpret: bool = False,
-    block_b: int = BLOCK_B,
+    block_b: int = BLOCK_B, spec=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Mixed-tenant forward pass via the fused paged walk; verdicts
-    bit-identical to jaxpath.classify_arena_ctrie on the same arena."""
+    bit-identical to jaxpath.classify_arena_ctrie on the same arena.
+
+    With a spliced ``spec`` the entry stage resolves SPLICE_TAG l0
+    slots through the tenant's splice rows into the shared plane-pool
+    region appended to the node pool — plane slab writes bake
+    pool-global child/target ids, so the kernel body and the rules
+    tail run unmodified over spliced and residual rows alike."""
     from .jaxpath import (
         _arena_ctrie_entry, joined_rule_rows, rule_scan,
     )
 
     B = batch.kind.shape[0]
-    node, alive, best0 = _arena_ctrie_entry(ca, batch, tenant, pages=pages)
+    node, alive, best0 = _arena_ctrie_entry(
+        ca, batch, tenant, pages=pages, spec=spec
+    )
     node = jnp.where(alive, node, -1)
     meta = jnp.stack(
         [
@@ -1205,15 +1213,18 @@ def classify_arena_cwalk(
 
 @functools.lru_cache(maxsize=None)
 def jitted_classify_arena_cwalk_wire_fused(
-    pages: int, d_max: int, interpret: bool, block_b: int = BLOCK_B
+    pages: int, d_max: int, interpret: bool, block_b: int = BLOCK_B,
+    spec=None,
 ):
     """The paged-walk wire launch: (arena, planes, wire, tenant) ->
-    fused (res16, stats) — keyed on the pool geometry statics only, so
-    tenant lifecycle never re-specializes."""
+    fused (res16, stats) — keyed on the pool geometry statics only
+    (plus the ArenaSpec when splicing is on), so tenant lifecycle
+    never re-specializes."""
     def f(ca, planes, wire, tenant):
         res, _x, stats = classify_arena_cwalk(
             ca, planes, unpack_wire(wire), tenant,
             pages=pages, d_max=d_max, interpret=interpret, block_b=block_b,
+            spec=spec,
         )
         return fuse_wire_outputs(res.astype(jnp.uint16), stats)
 

@@ -714,21 +714,27 @@ ARENA_PARTITION_RULES = {
         "targets": P("rules"),
         "joined": P("rules", None),
         "root_lut": P("rules"),
+        # splice rows steer per packet like the page table: replicated
+        "splice": P(),
         "page_table": P(),
     },
 }
 
 
-def arena_shardings(mesh: Mesh, family: str, pages: int):
+def arena_shardings(mesh: Mesh, family: str, pages: int,
+                    spliced: bool = False):
     """Per-pool-array NamedShardings for an arena on ``mesh``.  Pages
     shard over "rules" when they divide the axis; otherwise everything
     replicates (capacity does not scale, correctness never at risk) —
-    the usual degrade-never-refuse posture."""
+    the usual degrade-never-refuse posture.  A SPLICED ctrie arena
+    appends the shared subtree plane pool to the node/target/joined
+    pools, so rows are no longer whole-page blocks: replicate the lot
+    (the plane pool IS the compressed form — capacity already scaled)."""
     rules = mesh.shape["rules"]
     if family not in ARENA_PARTITION_RULES:
         raise ValueError(f"unknown arena family {family!r}")
     specs = ARENA_PARTITION_RULES[family]
-    if rules > 1 and pages % rules != 0:
+    if spliced or (rules > 1 and pages % rules != 0):
         specs = {k: P() for k in specs}
     return {k: NamedSharding(mesh, s) for k, s in specs.items()}
 

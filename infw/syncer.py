@@ -1198,9 +1198,12 @@ class TenantRegistry:
         deleted), the delta rides the dense overlay side-pool and the
         shared slab stays untouched — no CoW clone (returns "overlay").
         Otherwise the edit lands in the main slab: the allocator
-        patches a private page in place or CoW-clones a shared one, and
-        any deferred overlay content folds back in first.  Escalates to
-        a rebuild exactly like the single-tenant syncer (CompileError /
+        patches a private page in place or CoW-clones a shared one —
+        or, for a subtree-SPLICED tenant (ISSUE-17), patches a private
+        plane / unsplices just the edited subtree, which is why spliced
+        tenants skip the overlay detour entirely — and any deferred
+        overlay content folds back in first.  Escalates to a rebuild
+        exactly like the single-tenant syncer (CompileError /
         capacity)."""
         with self._op_lock:
             tid = self.tenant_id(name)
@@ -1259,6 +1262,15 @@ class TenantRegistry:
             return False
         alloc = getattr(self._clf, "allocator", None)
         if alloc is None or not alloc.tenant_shares_page(tid):
+            return False
+        if getattr(alloc, "tenant_splices", None) and alloc.tenant_splices(tid):
+            # overlay-vs-unsplice-vs-clone routing (ISSUE-17): a
+            # subtree-SPLICED tenant never needs the overlay detour — a
+            # deep edit patches a private plane or unsplices exactly
+            # one subtree in place (the whole-slab CoW clone the
+            # overlay exists to avoid no longer happens), so the edit
+            # rides the main-slab splice path and the slab stays
+            # structurally compressed
             return False
         ov = self._overlays.get(tid, {})
         ov_idents = {k.masked_identity(): k for k in ov}

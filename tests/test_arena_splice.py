@@ -1,0 +1,427 @@
+"""Cross-slab structural compression: shared-subtree planes (ISSUE-17).
+
+Covers the decompose/recompose round trip (residual trunk + canonical
+planes reproduce the whole-slab canonical bytes bit-exactly), the
+near-copy share path (similar-NOT-identical tenants share the trunk and
+every unchanged subtree plane; only divergent subtrees cost planes),
+the subtree-granular edit alphabet (patch inside a private plane,
+unsplice of a shared plane, CoW of a shared trunk) with bystander
+byte-stability, the dedup sweep's plane re-merge, the /metrics splice
+gauges, cross-tenant isolation with teeth on both ArenaClassifier and
+MeshArenaClassifier (8 virtual devices), the zero-recompile warm drift
+lifecycle, and the spliceleak injected defect / arena-splice statecheck
+config.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from infw import oracle, testing
+from infw.backend.tpu import ArenaClassifier
+from infw.compiler import IncrementalTables, LpmKey, \
+    compile_tables_from_content
+from infw.kernels import jaxpath
+from infw.analysis.statecheck import check_arena
+
+
+def _splice_content(n16=16, seed=5, width=4):
+    """One deep entry per /16 — alternating /24 subnet and /32 host,
+    the two masks whose subtrees leaf-push to a single target row, so
+    every l0 slot factors into exactly one plane-eligible subtree."""
+    rng = np.random.default_rng(seed)
+    content = {}
+    for i in range(n16):
+        mask = 24 if i % 2 else 32
+        data = bytes([10, i, 1 + i % 254, i % 251]) + bytes(12)
+        content[LpmKey(mask + 32, 2, data)] = testing.random_rules(
+            rng, width
+        )
+    return content
+
+
+def _sspec(tabs, pages=6, max_tenants=8, planes=256):
+    return jaxpath.arena_spec_for(
+        "ctrie", tabs, pages=pages, max_tenants=max_tenants,
+        plane_slots=planes, plane_node_rows=8, plane_target_rows=8,
+        plane_joined_rows=8, splice_slots=64,
+    )
+
+
+def _classify(al, tab, tenant_id, n=48, seed=3):
+    b = testing.random_batch(np.random.default_rng(seed), tab, n)
+    spec = al.spec
+    sp = {"spec": spec} if spec.spliced else {}
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        spec.family, spec.pages, spec.d_max, **sp
+    )
+    fused = fn(al.arena, jax.device_put(b.pack_wire()),
+               jax.device_put(np.full(n, tenant_id, np.int32)))
+    res16, _stats = jaxpath.split_wire_outputs(np.asarray(fused), n)
+    results, _xdp = jaxpath.host_finalize_wire(res16, np.asarray(b.kind))
+    return results, oracle.classify(tab, b).results
+
+
+def _spliced_pair(n16=16):
+    """Two tenants over the SAME content via independent updaters —
+    trunk shared, every subtree plane shared (refcount 2)."""
+    content = _splice_content(n16)
+    u0 = IncrementalTables.from_content(dict(content), rule_width=4)
+    u1 = IncrementalTables.from_content(dict(content), rule_width=4)
+    s0, s1 = u0.snapshot(), u1.snapshot()
+    spec = _sspec([s0, s1])
+    al = jaxpath.ArenaAllocator(spec)
+    assert al.load_tenant(0, s0) == "assign"
+    assert al.load_tenant(1, s1) == "share"
+    u0.start_dirty_tracking()
+    u1.start_dirty_tracking()
+    return al, u0, u1, s0, s1
+
+
+def _edit(u, k, port):
+    r = np.asarray(u.content[k]).copy()
+    r[1] = [1, 6, port, 0, 0, 0, 1]
+    u.apply({k: r}, [])
+    return u.peek_dirty(), u.snapshot()
+
+
+# --- decompose / recompose round trip ---------------------------------------
+
+
+def test_decompose_recompose_roundtrip():
+    content = _splice_content()
+    tab = compile_tables_from_content(dict(content), rule_width=4)
+    spec = _sspec([tab])
+    arrays, n_nodes = jaxpath._ctrie_canonical_slab(spec, tab)
+    dec = jaxpath._decompose_ctrie_slab(spec, arrays, n_nodes)
+    assert dec is not None
+    trunk, metas = dec
+    # every /16 subtree factored; its l0 slot carries the splice tag
+    assert len(metas) == len(content)
+    tl0 = trunk[0]
+    tagged = sorted(
+        int(v) - int(jaxpath.SPLICE_TAG)
+        for v in tl0[:, 0] if int(v) >= int(jaxpath.SPLICE_TAG)
+    )
+    assert tagged == [m.slot for m in metas]
+    # factored node/target/joined rows are ZEROED in the trunk (content-
+    # canonical residual form: structurally-identical tenants produce
+    # bit-identical trunks)
+    for m in metas:
+        assert not trunk[1][m.node_rows].any()
+        assert not trunk[2][m.tpos].any()
+        assert not trunk[3][m.tidx].any()
+    planes = [(m.plane[0], m.plane[1], m.plane[2], m.n_local)
+              for m in metas]
+    whole = jaxpath._recompose_ctrie_slab(spec, trunk, metas, planes)
+    for got, want in zip(whole, arrays):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert jaxpath.slab_content_hash(whole, n_nodes) == \
+        jaxpath.slab_content_hash(arrays, n_nodes)
+
+
+def test_offset_plane_roundtrip():
+    """Canonical plane -> resident (pool-global) form -> back is the
+    identity; the resident form's indices all land inside the plane
+    pool region (what lets the shared descent walk planes unmodified)."""
+    content = _splice_content()
+    tab = compile_tables_from_content(dict(content), rule_width=4)
+    spec = _sspec([tab])
+    arrays, n_nodes = jaxpath._ctrie_canonical_slab(spec, tab)
+    _trunk, metas = jaxpath._decompose_ctrie_slab(spec, arrays, n_nodes)
+    m = metas[0]
+    ps = 3
+    resident = jaxpath._offset_plane_slab(spec, m.plane, m.n_local, ps)
+    back = jaxpath._unoffset_plane_slab(spec, resident, m.n_local, ps)
+    for got, want in zip(back, m.plane):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --- near-copy sharing / plane refcounts ------------------------------------
+
+
+def test_spliced_share_plane_refcounts_and_gauges():
+    al, _u0, _u1, s0, s1 = _spliced_pair()
+    assert al.page_of(0) == al.page_of(1)  # one shared residual trunk
+    assert al.distinct_planes() == 16      # each subtree stored ONCE
+    assert len(al.tenant_splices(0)) == 16
+    assert al.tenant_splices(0) == al.tenant_splices(1)
+    assert al.counters["plane_hits"] == 16  # tenant 1 wrote no plane
+    cnt = al.counter_values()
+    for gauge in ("arena_subtree_planes", "arena_shared_subtrees",
+                  "arena_splice_rows", "splice_unsplices",
+                  "splice_merges"):
+        assert gauge in cnt, gauge
+    assert cnt["arena_subtree_planes"] == 16
+    assert cnt["arena_shared_subtrees"] == 16  # refcount > 1 planes
+    assert cnt["arena_splice_rows"] == 32
+    assert check_arena(al) == []
+    r0, w0 = _classify(al, s0, 0)
+    r1, w1 = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r0, w0)
+    np.testing.assert_array_equal(r1, w1)
+
+
+def test_near_copy_costs_only_divergent_planes():
+    """A k-edit near-copy shares the trunk and all unchanged planes —
+    the whole point of structural compression."""
+    content = _splice_content()
+    u = IncrementalTables.from_content(dict(content), rule_width=4)
+    s0 = u.snapshot()
+    keys = sorted(content, key=lambda k: k.ip_data)
+    spec = _sspec([s0])
+    al = jaxpath.ArenaAllocator(spec)
+    al.load_tenant(0, s0)
+    writes0 = al.counters["plane_writes"]
+    for i in range(2):
+        r = np.asarray(u.content[keys[i]]).copy()
+        r[1] = [1, 6, 7000 + i, 0, 0, 0, 2]
+        u.apply({keys[i]: r}, [])
+    s1 = u.snapshot()
+    # "share" is reserved for all-planes-hit loads; the near-copy still
+    # lands on the SHARED residual trunk (content-addressed hash hit)
+    al.load_tenant(1, s1)
+    assert al.page_of(0) == al.page_of(1)
+    # 2 divergent subtrees cost 2 plane writes; 14 planes re-shared
+    assert al.counters["plane_writes"] - writes0 == 2
+    assert al.distinct_planes() == 18
+    assert check_arena(al) == []
+    r0, w0 = _classify(al, s0, 0)
+    r1, w1 = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r0, w0)
+    np.testing.assert_array_equal(r1, w1)
+
+
+# --- subtree-granular edits / bystander isolation ---------------------------
+
+
+def test_unsplice_edit_isolates_bystander():
+    al, u0, _u1, _s0, s1 = _spliced_pair()
+    k = sorted(u0.content, key=lambda kk: kk.ip_data)[0]
+    shared_plane = al.tenant_splices(0)[0] if 0 in al.tenant_splices(0) \
+        else list(al.tenant_splices(0).values())[0]
+    before1 = dict(al.tenant_splices(1))
+    hint, snap = _edit(u0, k, 443)
+    assert al.load_tenant(0, snap, hint=hint) == "unsplice"
+    # the editor repointed ONE slot at a private plane; the bystander's
+    # splice map is untouched and the old plane survives for it
+    m0, m1 = al.tenant_splices(0), al.tenant_splices(1)
+    assert m1 == before1
+    diff = [s for s in m0 if m0[s] != m1[s]]
+    assert len(diff) == 1
+    assert al.page_of(0) == al.page_of(1)  # trunk still shared
+    assert al.counters["splice_unsplices"] == 1
+    assert check_arena(al) == []
+    r0, w0 = _classify(al, snap, 0)
+    np.testing.assert_array_equal(r0, w0)
+    r1, w1 = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r1, w1)
+    del shared_plane
+    # a second edit of the SAME subtree now lands in the private plane
+    hint2, snap2 = _edit(u0, k, 8443)
+    assert al.load_tenant(0, snap2, hint=hint2) == "patch"
+    assert al.tenant_splices(0) == m0
+    assert check_arena(al) == []
+    r0b, w0b = _classify(al, snap2, 0)
+    np.testing.assert_array_equal(r0b, w0b)
+    r1b, _ = _classify(al, s1, 1)
+    np.testing.assert_array_equal(r1b, r1)  # bystander byte-stable
+
+
+def test_dedup_sweep_remerges_reconverged_planes():
+    al, u0, _u1, _s0, _s1 = _spliced_pair()
+    k = sorted(u0.content, key=lambda kk: kk.ip_data)[0]
+    orig = np.asarray(u0.content[k]).copy()
+    hint, snap = _edit(u0, k, 9090)
+    assert al.load_tenant(0, snap, hint=hint) == "unsplice"
+    u0.clear_dirty()
+    assert al.distinct_planes() == 17
+    # edit BACK: the private plane's content re-converges with the
+    # shared one; the sweep re-merges it (splice-row flip, no write)
+    u0.apply({k: orig}, [])
+    assert al.load_tenant(0, u0.snapshot(), hint=u0.peek_dirty()) \
+        == "patch"
+    rep = al.dedup_sweep()
+    assert rep["plane_merged"] == 1
+    assert al.distinct_planes() == 16
+    assert al.tenant_splices(0) == al.tenant_splices(1)
+    assert al.counters["splice_merges"] == 1
+    assert check_arena(al) == []
+
+
+def test_spliceleak_defect_caught_by_invariants():
+    al, u0, _u1, _s0, _s1 = _spliced_pair()
+    k = sorted(u0.content, key=lambda kk: kk.ip_data)[0]
+    hint, snap = _edit(u0, k, 1234)
+    jaxpath._INJECT_SPLICELEAK_BUG = True
+    try:
+        assert al.load_tenant(0, snap, hint=hint) == "unsplice"
+        viols = check_arena(al)
+    finally:
+        jaxpath._INJECT_SPLICELEAK_BUG = False
+    assert any("spliceleak" in v for v in viols), viols
+
+
+# --- classifier-level isolation with teeth ----------------------------------
+
+
+@pytest.mark.slow
+def test_classifier_splice_isolation_oracle():
+    """Two near-copy tenants through ArenaClassifier + TenantRegistry:
+    both bit-identical to their oracles; a deep-key edit by one rides
+    the splice path and diverges ONLY that tenant (the other compared
+    byte-stable against its pre-edit output, not just the oracle)."""
+    from infw.syncer import TenantRegistry
+
+    content = _splice_content(n16=12, seed=7)
+    base = compile_tables_from_content(dict(content), rule_width=4)
+    spec = _sspec([base], pages=6, max_tenants=6)
+    clf = ArenaClassifier(spec, interpret=True, fused_deep=False)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(content))
+    reg.create_tenant("b", dict(content))
+    al = clf.allocator
+    assert al.page_of(0) == al.page_of(1)
+    assert al.distinct_planes() == 12
+    ba = testing.random_batch(np.random.default_rng(11), base, 64)
+    want = oracle.classify(base, ba).results
+    out_a0 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    out_b0 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a0.results, want)
+    np.testing.assert_array_equal(out_b0.results, want)
+    k = sorted(content, key=lambda kk: kk.ip_data)[0]
+    r = np.asarray(content[k]).copy()
+    r[1] = [1, 0, 0, 0, 0, 0, 1]
+    reg.update_tenant("b", {k: r}, [])
+    # the edit stayed subtree-granular: trunk still shared, one plane
+    # diverged — never an overlay detour, never a whole-slab clone
+    assert al.page_of(0) == al.page_of(1)
+    assert al.tenant_splices(0) != al.tenant_splices(1)
+    merged = compile_tables_from_content(
+        {**dict(content), k: r}, rule_width=4
+    )
+    out_b1 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out_b1.results, oracle.classify(merged, ba).results
+    )
+    out_a1 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a1.results, out_a0.results)
+    assert check_arena(al) == []
+    clf.close()
+
+
+@pytest.mark.slow
+def test_mesh_splice_isolation():
+    """The same share -> unsplice -> diverge-only-the-editor flow on
+    MeshArenaClassifier (8 virtual devices): the splice table and plane
+    pool are replicated like the page table, so the per-packet gather
+    stays device-local."""
+    from infw.backend.mesh import MeshArenaClassifier
+    from infw.syncer import TenantRegistry
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs >= 8 virtual devices")
+    content = _splice_content(n16=12, seed=9)
+    base = compile_tables_from_content(dict(content), rule_width=4)
+    spec = _sspec([base], pages=8, max_tenants=8)
+    clf = MeshArenaClassifier(spec, data_shards=8)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(content))
+    reg.create_tenant("b", dict(content))
+    al = clf.allocator
+    assert al.page_of(0) == al.page_of(1)
+    assert al.distinct_planes() == 12
+    ba = testing.random_batch(np.random.default_rng(13), base, 64)
+    want = oracle.classify(base, ba).results
+    out_a0 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    out_b0 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a0.results, want)
+    np.testing.assert_array_equal(out_b0.results, want)
+    k = sorted(content, key=lambda kk: kk.ip_data)[0]
+    r = np.asarray(content[k]).copy()
+    r[1] = [1, 0, 0, 0, 0, 0, 2]
+    reg.update_tenant("b", {k: r}, [])
+    assert al.page_of(0) == al.page_of(1)
+    assert al.tenant_splices(0) != al.tenant_splices(1)
+    merged = compile_tables_from_content(
+        {**dict(content), k: r}, rule_width=4
+    )
+    out_b1 = reg.classify_mixed(ba, ["b"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(
+        out_b1.results, oracle.classify(merged, ba).results
+    )
+    out_a1 = reg.classify_mixed(ba, ["a"] * 64, apply_stats=False)
+    np.testing.assert_array_equal(out_a1.results, out_a0.results)
+    assert check_arena(al) == []
+    clf.close()
+
+
+# --- zero-recompile warm drift lifecycle ------------------------------------
+
+
+@pytest.mark.slow
+def test_zero_recompile_warm_splice_lifecycle():
+    """Once the spliced arena is warm (one load, one unsplice edit, one
+    classify), the whole drift alphabet — near-copy create, unsplice,
+    patch, classify — compiles and allocates nothing."""
+    al, u0, u1, _s0, _s1 = _spliced_pair()
+    keys = sorted(u0.content, key=lambda kk: kk.ip_data)
+    hint, snap = _edit(u0, keys[0], 1111)
+    assert al.load_tenant(0, snap, hint=hint) == "unsplice"  # warm edit
+    b = testing.random_batch(np.random.default_rng(1), snap, 64)
+    wire = jax.device_put(b.pack_wire())
+    fn = jaxpath.jitted_classify_arena_wire_fused(
+        "ctrie", al.spec.pages, al.spec.d_max, spec=al.spec
+    )
+
+    def classify(t):
+        np.asarray(fn(al.arena, wire,
+                      jax.device_put(np.full(64, t, np.int32))))
+
+    classify(0)  # the one allowed compile of the classify factory
+    scatter0 = jaxpath._scatter_rows_jit()._cache_size()
+    fn0 = fn._cache_size()
+    # near-copy create (trunk share + divergent plane), unsplice, patch
+    hint2, snap2 = _edit(u1, keys[1], 2222)
+    assert al.load_tenant(1, snap2, hint=hint2) == "unsplice"
+    u1.clear_dirty()
+    assert al.load_tenant(2, snap2) == "share"
+    hint3, snap3 = _edit(u0, keys[0], 3333)
+    assert al.load_tenant(0, snap3, hint=hint3) == "patch"
+    for t in (0, 1, 2):
+        classify(t)
+    al.destroy_tenant(2)
+    assert fn._cache_size() == fn0
+    grew = jaxpath._scatter_rows_jit()._cache_size() - scatter0
+    assert grew == 0, (
+        f"{grew} scatter executable(s) compiled on the warm spliced "
+        "drift lifecycle"
+    )
+    assert check_arena(al) == []
+
+
+# --- statecheck config / defect acceptance ----------------------------------
+
+
+@pytest.mark.slow
+def test_statecheck_arena_splice_config_green():
+    from infw.analysis import statecheck
+
+    rep = statecheck.run_config("arena-splice", seed=0, n_ops=8,
+                                shrink_on_failure=False)
+    assert rep["ok"], rep
+
+
+@pytest.mark.slow
+def test_spliceleak_defect_caught_and_shrunk():
+    from infw.analysis import statecheck
+
+    jaxpath._INJECT_SPLICELEAK_BUG = True
+    try:
+        rep = statecheck.run_config("arena-splice", seed=0, n_ops=12,
+                                    max_shrink_runs=64)
+    finally:
+        jaxpath._INJECT_SPLICELEAK_BUG = False
+    assert not rep["ok"]
+    assert rep["failure"]["phase"] == "invariant"
+    assert rep["shrunk"]["ops"] <= 4

@@ -302,7 +302,8 @@ def cmd_jax(args) -> int:
 #: (tests/test_statecheck.py) — selectable here via --configs.
 DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "ctrie-overlay", "txn", "txn-ctrie", "arena",
-                         "arena-ctrie", "arena-cow", "flow", "flow-ctrie",
+                         "arena-ctrie", "arena-cow", "arena-splice",
+                         "flow", "flow-ctrie",
                          "resident", "pipeline", "telemetry",
                          "telemetry-resident")
 
@@ -346,6 +347,15 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # invariant on the shared-then-edited-biased arena-cow config,
         # shrinking to (copy-create, edit) plus slack
         "cowleak": (jaxpath, "_INJECT_COWLEAK_BUG", "arena-cow", 3),
+        # subtree-plane refcount leak: the unsplice path of the
+        # structural-compression arena "forgets" to decrement the OLD
+        # plane's refcount after re-pointing the editing tenant's
+        # splice row at its private copy — caught by check_arena's
+        # plane-refcount-vs-splice-row-recount invariant on the
+        # near-copy-biased arena-splice config, shrinking to
+        # (near-copy create, deep edit) plus slack
+        "spliceleak": (jaxpath, "_INJECT_SPLICELEAK_BUG",
+                       "arena-splice", 3),
         # dropped flow invalidation: a rule edit's generation bump is
         # silently skipped (infw.flow.bump_generation no-ops), so the
         # flow tier keeps serving the PRE-edit cached verdict.  Device
@@ -400,10 +410,13 @@ def _run_inject_defect(args, as_json: bool) -> int:
     # the budget to reduce it
     n_ops = (
         max(args.ops, 12)
-        if defect in ("fold", "flowstale", "cowleak")
+        if defect in ("fold", "flowstale", "cowleak", "spliceleak")
         else args.ops
     )
-    shrink_runs = 64 if defect in ("fold", "flowstale", "cowleak") else 32
+    shrink_runs = (
+        64 if defect in ("fold", "flowstale", "cowleak", "spliceleak")
+        else 32
+    )
     if args.configs:
         print(f"note: --inject-defect {defect} always runs the "
               f"{config!r} config (the defect's layout regime); "
@@ -571,8 +584,9 @@ def main(argv=None) -> int:
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
                          choices=("joined-pad", "cskip", "fold", "pageflip",
-                                  "cowleak", "flowstale", "residentstale",
-                                  "slotepoch", "sketchsat", "mlquant"),
+                                  "cowleak", "spliceleak", "flowstale",
+                                  "residentstale", "slotepoch", "sketchsat",
+                                  "mlquant"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "
