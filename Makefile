@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
 	churn-bench flow-bench resident-bench telemetry-bench mlscore-bench \
 	pipeline-bench native entry-check dryrun-multichip mesh-check \
-	spill-read wire-check lint static-check state-check clean
+	spill-read wire-check lint static-check state-check lock-check \
+	sched-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -106,6 +107,7 @@ state-check:
 		echo "state-check FAIL: donation audit exited $$rc (want 1 = caught)"; \
 		exit 1; \
 	fi
+	$(MAKE) sched-check
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-transfer-defect --entries defect/implicit-transfer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -130,10 +132,23 @@ state-check:
 #   3. the jax audit across the shape ladder, strict (incl. the
 #      transfer-guard lint);
 #   4. the state checker with its injected-defect acceptances.
+# Concurrency verifier (ISSUE-18): the static lock-order/guard pass
+# (repo-wide, zero unsuppressed findings) plus its lockorder
+# injected-defect acceptance, and the deterministic interleaving
+# explorer's four production scenarios plus the cowrace acceptance.
+lock-check:
+	$(PY) tools/infw_lint.py lock --strict
+	$(PY) tools/infw_lint.py lock --inject-defect lockorder
+
+sched-check:
+	$(MESH_ENV) $(PY) tools/infw_lint.py sched --strict
+	$(MESH_ENV) $(PY) tools/infw_lint.py sched --inject-defect cowrace
+
 static-check: lint
 	$(PY) tools/infw_lint.py rules --ignore failsafe-violation --strict
 	$(PY) tools/infw_lint.py rules --acceptance
 	$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict
+	$(MAKE) lock-check
 	$(MAKE) state-check
 	@echo "static-check OK"
 

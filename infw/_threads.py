@@ -1,0 +1,123 @@
+"""Crash-surfacing background threads + cooperative-scheduler hooks.
+
+Two small facilities the concurrency verifier (ISSUE-18) builds on:
+
+``spawn`` — the ONLY sanctioned way to start a background thread inside
+``infw/``.  The reference daemonset's goroutines die loudly (a panicking
+goroutine takes the pod down and the kubelet restarts it); a bare Python
+daemon thread dies silently and the control plane limps on without its
+flusher/drainer/poller.  ``spawn`` wraps the target so an escaping
+exception is logged with a full traceback and counted on /metrics
+(``infw_thread_crashes_total``, via the ``CRASH_COUNTERS`` provider)
+before the thread exits.  lockcheck rule (d) — thread hygiene — flags
+any raw ``threading.Thread(...)`` construction elsewhere in ``infw/``.
+
+``sched_point`` — an explicit yield sitecall for the deterministic
+interleaving explorer (infw.analysis.schedcheck).  In production it is
+one module-global read and a ``None`` check; under schedcheck a
+cooperative scheduler registers itself here and every ``sched_point``
+(plus every shimmed lock acquire/release) becomes a serialization point
+the explorer can preempt at.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger("infw.threads")
+
+# -- crash surfacing ---------------------------------------------------------
+
+_crash_lock = threading.Lock()
+_crash_total = 0
+_crash_by_name: Dict[str, int] = {}
+
+
+def _note_crash(name: str) -> None:
+    global _crash_total
+    with _crash_lock:
+        _crash_total += 1
+        _crash_by_name[name] = _crash_by_name.get(name, 0) + 1
+
+
+class _CrashCounters:
+    """Counter provider for the /metrics registry
+    (obs.statistics.Registry.register_counters): total background-thread
+    crashes since process start — zero in a healthy control plane."""
+
+    def counter_values(self) -> Dict[str, int]:
+        with _crash_lock:
+            return {"thread_crashes_total": _crash_total}
+
+    def crash_counts(self) -> Dict[str, int]:
+        """Per-thread-name crash counts (diagnostics/tests)."""
+        with _crash_lock:
+            return dict(_crash_by_name)
+
+
+CRASH_COUNTERS = _CrashCounters()
+
+
+def reset_crash_counters() -> None:
+    """Test hook: zero the process-wide crash counters."""
+    global _crash_total
+    with _crash_lock:
+        _crash_total = 0
+        _crash_by_name.clear()
+
+
+def spawn(target: Callable, *, name: Optional[str] = None,
+          args: tuple = (), kwargs: Optional[dict] = None,
+          daemon: bool = True, start: bool = True,
+          on_error: Optional[Callable[[BaseException], None]] = None
+          ) -> threading.Thread:
+    """Start (or build, with ``start=False``) a crash-surfacing
+    background thread.  An exception escaping ``target`` is logged with
+    its traceback, counted in ``infw_thread_crashes_total``, handed to
+    ``on_error`` (when given — e.g. the scheduler's serve loop collects
+    drainer errors to re-raise on the caller's thread) and then
+    re-raised so the interpreter's threading excepthook still fires."""
+    kwargs = kwargs or {}
+    tname = name or getattr(target, "__name__", "infw-thread")
+
+    def _run() -> None:
+        try:
+            target(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - surfacing, not hiding
+            _note_crash(tname)
+            log.exception("background thread %r crashed: %s", tname, e)
+            if on_error is not None:
+                try:
+                    on_error(e)
+                except Exception:
+                    log.exception("on_error hook for %r failed", tname)
+            raise
+
+    t = threading.Thread(target=_run, name=tname, daemon=daemon)
+    if start:
+        t.start()
+    return t
+
+
+# -- cooperative-scheduler sitecall ------------------------------------------
+
+#: The active deterministic scheduler (infw.analysis.schedcheck installs
+#: one for the duration of a scenario run).  Production value: None.
+_ACTIVE_SCHEDULER = None
+
+
+def set_scheduler(sched) -> None:
+    """Install/clear the cooperative scheduler ``sched_point`` reports
+    to.  schedcheck-only; pass None to restore production behavior."""
+    global _ACTIVE_SCHEDULER
+    _ACTIVE_SCHEDULER = sched
+
+
+def sched_point(tag: Optional[str] = None) -> None:
+    """Explicit interleaving point.  No-op in production; under an
+    installed schedcheck scheduler, a preemption opportunity for threads
+    the scheduler manages (unmanaged threads pass straight through)."""
+    s = _ACTIVE_SCHEDULER
+    if s is not None:
+        s.sched_point(tag)

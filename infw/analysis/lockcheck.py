@@ -1,0 +1,815 @@
+"""Static lock-order / guard analysis over the infw control plane
+(ISSUE-18, the static half of the concurrency verifier).
+
+The reference dataplane's safety story is the eBPF verifier: the kernel
+proves the XDP program safe before it serves a packet.  Our threaded
+control plane (txn flush, scheduler drainers, daemon idle loop, CoW
+page flips) has disciplines that lived in comments — this pass makes
+them machine-checked.  One AST sweep over ``infw/`` (the production
+packages; ``infw/analysis`` itself is excluded — the verifier spawns
+raw threads to control them):
+
+- **inventory**: every ``threading.Lock/RLock/Condition/Event``
+  instantiation, per class (``self._lock = threading.Lock()``) or per
+  module (``_lib_lock = threading.Lock()``);
+- **acquisition graph**: which lock is acquired while which is held —
+  ``with``-statements and explicit ``.acquire()/.release()`` pairs,
+  followed through method calls ONE level deep (``self.m()`` resolves
+  in-class; ``x.m()`` resolves through a parameter annotation naming an
+  inventoried class, falling back to a unique-method-name match);
+- **checks**:
+  (a) ``lock-cycle`` — cycles in the graph = potential deadlock, each
+      edge reported with its witness code path;
+  (b) ``guarded-field`` — an instance attribute stored both under the
+      class's lock and outside any lock (the torn-publish race);
+      ``*_locked``-suffixed methods and private methods whose in-class
+      callsites all hold a lock count as under-lock;
+  (c) ``ordering-contract`` / ``lock-order`` — ``@must_precede`` call
+      ordering inside the decorated function, and measured edges that
+      contradict ``infw.contracts.LOCK_ORDER``;
+  (d) ``thread-hygiene`` — raw ``threading.Thread(...)`` construction
+      anywhere but ``infw/_threads.py`` (backgrounds threads must use
+      the crash-surfacing ``spawn`` wrapper).
+
+The analysis is lexical and one-call-deep by design: it reads source
+order inside one function (a ``must_precede`` body is expected to be a
+linear landing sequence) and does not chase closures or second-level
+calls.  False positives go to ``lockcheck_suppressions.txt`` next to
+this file, one per line with a justification.
+
+``--inject-defect lockorder`` (via tools/infw_lint.py lock) appends a
+synthetic module holding the telemetry lock while re-entering the flow
+tier — the reverse of the declared flow->telemetry nesting — and the
+gate asserts the cycle is reported with both witness paths (the real
+one in flow.py and the injected one).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+LOCK_KINDS = ("Lock", "RLock", "Condition", "Event")
+#: kinds that participate in the acquisition graph (Event has no
+#: acquire/held semantics) and whether re-entry on self is legal
+GRAPH_KINDS = ("Lock", "RLock", "Condition")
+REENTRANT_KINDS = ("RLock", "Condition")  # Condition() wraps an RLock
+
+#: the synthetic --inject-defect lockorder module: holds the telemetry
+#: tier's lock while re-entering the flow tier (bump_generation takes
+#: FlowTier._lock) — the exact reverse of the declared nesting, closing
+#: a cycle against flow.py's real flow->telemetry edge.
+_LOCKORDER_DEFECT_SRC = '''\
+"""Synthetic lockcheck defect (lock --inject-defect lockorder)."""
+
+
+def drain_and_invalidate(tier: "TelemetryTier", flow: "FlowTier"):
+    with tier._lock:
+        flow.bump_generation(0)
+'''
+_LOCKORDER_DEFECT_NAME = "_defect_lockorder.py"
+
+
+# -- data model --------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    check: str       # lock-cycle | guarded-field | ordering-contract |
+                     # lock-order | thread-hygiene | self-deadlock
+    severity: str    # "error" | "warning"
+    where: str       # "infw/flow.py:123"
+    subject: str     # suppression key, e.g. "TelemetryTier.counters"
+    message: str
+    witnesses: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check, "severity": self.severity,
+            "where": self.where, "subject": self.subject,
+            "message": self.message, "witnesses": list(self.witnesses),
+        }
+
+
+@dataclass
+class LockSite:
+    module: str              # repo-relative path
+    cls: Optional[str]       # None for module-level locks
+    attr: str
+    kind: str                # Lock | RLock | Condition | Event
+    lineno: int
+
+    @property
+    def node(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls}.{self.attr}"
+        base = os.path.basename(self.module)
+        return f"{base}:{self.attr}"
+
+    def to_dict(self) -> dict:
+        return {"module": self.module, "class": self.cls,
+                "attr": self.attr, "kind": self.kind, "line": self.lineno,
+                "node": self.node}
+
+
+@dataclass
+class _Method:
+    module: str
+    cls: Optional[str]
+    name: str
+    fn: ast.FunctionDef
+    param_ann: Dict[str, str] = field(default_factory=dict)
+    acquires: Set[str] = field(default_factory=set)   # direct lock nodes
+    # (held-stack, lineno, callee ast expr) — resolved in pass B
+    calls: List[Tuple[Tuple[str, ...], int, ast.expr]] = (
+        field(default_factory=list))
+    # direct nested acquisitions: (held, acquired, lineno)
+    edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    # self-attribute stores: (attr, locked, lineno)
+    writes: List[Tuple[str, bool, int]] = field(default_factory=list)
+    # in-class callsites: (callee method name, locked, lineno)
+    self_calls: List[Tuple[str, bool, int]] = field(default_factory=list)
+    # raw threading.Thread(...) constructions: linenos
+    raw_threads: List[int] = field(default_factory=list)
+    # must_precede declarations: (first, then, decorator lineno)
+    contracts: List[Tuple[str, str, int]] = field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclass
+class Corpus:
+    sites: List[LockSite] = field(default_factory=list)
+    #: class name -> list of (module, {lock attr -> kind})
+    classes: Dict[str, List[Tuple[str, Dict[str, str]]]] = (
+        field(default_factory=dict))
+    methods: List[_Method] = field(default_factory=list)
+    #: lock node -> kind
+    kinds: Dict[str, str] = field(default_factory=dict)
+    #: module -> {module-level lock name -> node}
+    mod_locks: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    parse_errors: List[str] = field(default_factory=list)
+
+    def class_locks(self, cls: str) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for _mod, locks in self.classes.get(cls, []):
+            out.update(locks)
+        return out
+
+
+# -- corpus construction -----------------------------------------------------
+
+
+def _lock_kind_of_call(call: ast.expr) -> Optional[str]:
+    """``threading.Lock()`` / ``Lock()`` -> kind name, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_KINDS and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in LOCK_KINDS:
+        return f.id
+    return None
+
+
+def _ann_class(node: Optional[ast.expr]) -> Optional[str]:
+    """Extract a class name from a parameter annotation: ``"FlowTier"``,
+    ``FlowTier``, ``Optional["FlowTier"]`` all resolve."""
+    if node is None:
+        return None
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            return sub.value.split(".")[-1].strip("'\" ") or None
+        if isinstance(sub, ast.Name) and sub.id not in ("Optional", "Union"):
+            return sub.id
+    return None
+
+
+def default_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def corpus_files(root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """(relative path, source) for every production module under
+    ``infw/`` — the analysis package itself excluded (its scheduler
+    spawns the raw threads it controls)."""
+    root = root or default_root()
+    parent = os.path.dirname(root)
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in ("__pycache__", "analysis", "native", "_build")
+        )
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, parent)
+            with open(path, encoding="utf-8") as f:
+                out.append((rel, f.read()))
+    return out
+
+
+def build_corpus(root: Optional[str] = None,
+                 files: Optional[List[Tuple[str, str]]] = None,
+                 inject_defect: Optional[str] = None) -> Corpus:
+    files = list(files) if files is not None else corpus_files(root)
+    if inject_defect == "lockorder":
+        files.append((f"infw/{_LOCKORDER_DEFECT_NAME}",
+                      _LOCKORDER_DEFECT_SRC))
+    elif inject_defect is not None:
+        raise ValueError(f"unknown lockcheck defect {inject_defect!r}")
+    corpus = Corpus()
+    trees = []
+    for rel, src in files:
+        try:
+            trees.append((rel, ast.parse(src)))
+        except SyntaxError as e:
+            corpus.parse_errors.append(f"{rel}: {e}")
+    # pass 0: lock inventory + class/method index
+    for rel, tree in trees:
+        mod_locks: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _lock_kind_of_call(node.value)
+                if kind:
+                    site = LockSite(rel, None, node.targets[0].id, kind,
+                                    node.lineno)
+                    corpus.sites.append(site)
+                    if kind in GRAPH_KINDS:
+                        mod_locks[site.attr] = site.node
+                    corpus.kinds[site.node] = kind
+        corpus.mod_locks[rel] = mod_locks
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            locks: Dict[str, str] = {}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        kind = _lock_kind_of_call(sub.value)
+                        if kind:
+                            site = LockSite(rel, node.name, t.attr, kind,
+                                            sub.lineno)
+                            corpus.sites.append(site)
+                            corpus.kinds[site.node] = kind
+                            if kind in GRAPH_KINDS:
+                                locks[t.attr] = site.node
+            corpus.classes.setdefault(node.name, []).append((rel, locks))
+    # pass A: per-function lexical scan
+    for rel, tree in trees:
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                corpus.methods.append(_scan_function(corpus, rel, None, node))
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        corpus.methods.append(
+                            _scan_function(corpus, rel, node.name, sub))
+    return corpus
+
+
+def _scan_function(corpus: Corpus, module: str, cls: Optional[str],
+                   fn: ast.FunctionDef) -> _Method:
+    m = _Method(module, cls, fn.name, fn)
+    all_args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+        list(fn.args.kwonlyargs)
+    for a in all_args:
+        c = _ann_class(a.annotation)
+        if c and c in corpus.classes:
+            m.param_ann[a.arg] = c
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dec.func.attr if isinstance(dec.func, ast.Attribute) \
+                else getattr(dec.func, "id", None)
+            if name == "must_precede" and len(dec.args) == 2 and all(
+                    isinstance(a, ast.Constant) for a in dec.args):
+                m.contracts.append(
+                    (dec.args[0].value, dec.args[1].value, dec.lineno))
+
+    # thread hygiene is purely syntactic — full walk, nested closures
+    # included (the lexical lock walker below skips nested functions)
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if (isinstance(f, ast.Attribute) and f.attr == "Thread" and
+                    isinstance(f.value, ast.Name) and
+                    f.value.id == "threading") or (
+                    isinstance(f, ast.Name) and f.id == "Thread"):
+                m.raw_threads.append(sub.lineno)
+
+    own_locks = corpus.class_locks(cls) if cls else {}
+    mod_locks = corpus.mod_locks.get(module, {})
+
+    def resolve_lock(expr: ast.expr) -> Optional[str]:
+        """with-subject / acquire-receiver -> lock node, or None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and attr in own_locks:
+                return f"{cls}.{attr}"
+            ann = m.param_ann.get(base)
+            if ann and attr in corpus.class_locks(ann):
+                return f"{ann}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in mod_locks:
+            return mod_locks[expr.id]
+        return None
+
+    explicit: List[str] = []  # .acquire()d, not yet .release()d
+
+    def note_acquire(node: str, held: Tuple[str, ...], lineno: int) -> None:
+        m.acquires.add(node)
+        for h in held:
+            if h != node:
+                m.edges.append((h, node, lineno))
+
+    def scan_expr(expr: ast.expr, held: Tuple[str, ...]) -> None:
+        """Record calls/stores/raw-Thread in one expression subtree,
+        not descending into nested function bodies."""
+        stack: List[ast.AST] = [expr]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                m.calls.append((held, sub.lineno, f))
+                if cls and isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    m.self_calls.append((f.attr, bool(held), sub.lineno))
+
+    def note_store(target: ast.expr, held: Tuple[str, ...],
+                   lineno: int) -> None:
+        t = target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            m.writes.append((t.attr, bool(held), lineno))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                note_store(el, held, lineno)
+
+    def walk_block(stmts: List[ast.stmt], held: Tuple[str, ...]) -> None:
+        for st in stmts:
+            cur = held + tuple(explicit)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.With):
+                inner = held
+                for item in st.items:
+                    scan_expr(item.context_expr, inner + tuple(explicit))
+                    node = resolve_lock(item.context_expr)
+                    if node is not None:
+                        note_acquire(node, inner + tuple(explicit),
+                                     st.lineno)
+                        inner = inner + (node,)
+                walk_block(st.body, inner)
+                continue
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                f = st.value.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("acquire", "release"):
+                    node = resolve_lock(f.value)
+                    if node is not None:
+                        if f.attr == "acquire":
+                            note_acquire(node, cur, st.lineno)
+                            explicit.append(node)
+                        elif node in explicit:
+                            explicit.remove(node)
+                        continue
+            # simple/compound statements: record expression events, then
+            # recurse into compound bodies with the same held context
+            for fld, val in ast.iter_fields(st):
+                if isinstance(val, ast.expr):
+                    scan_expr(val, cur)
+                elif isinstance(val, list):
+                    for v in val:
+                        if isinstance(v, ast.expr):
+                            scan_expr(v, cur)
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for t in targets:
+                    note_store(t, cur, st.lineno)
+            for body_field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, body_field, None)
+                if sub:
+                    walk_block(sub, held)
+            for h in getattr(st, "handlers", []) or []:
+                walk_block(h.body, held)
+
+    walk_block(fn.body, ())
+    return m
+
+
+# -- analysis ----------------------------------------------------------------
+
+
+def _method_index(corpus: Corpus):
+    """(class, method) -> _Method; method-name -> owning lock classes."""
+    by_cls: Dict[Tuple[Optional[str], str], _Method] = {}
+    owners: Dict[str, Set[str]] = {}
+    for m in corpus.methods:
+        by_cls.setdefault((m.cls, m.name), m)
+        if m.cls and corpus.class_locks(m.cls):
+            owners.setdefault(m.name, set()).add(m.cls)
+    mod_funcs: Dict[Tuple[str, str], _Method] = {}
+    for m in corpus.methods:
+        if m.cls is None:
+            mod_funcs[(m.module, m.name)] = m
+    return by_cls, owners, mod_funcs
+
+
+def build_graph(corpus: Corpus):
+    """The lock-acquisition graph: edge (held -> acquired) with witness
+    strings, from direct nesting plus one-level call resolution."""
+    by_cls, owners, mod_funcs = _method_index(corpus)
+    edges: Dict[Tuple[str, str], List[str]] = {}
+    self_deadlocks: List[Finding] = []
+
+    def add_edge(a: str, b: str, witness: str) -> None:
+        edges.setdefault((a, b), []).append(witness)
+
+    for m in corpus.methods:
+        for held, acq, lineno in m.edges:
+            add_edge(held, acq,
+                     f"{m.module}:{lineno} {m.qualname}: holds {held}, "
+                     f"acquires {acq} (with-statement)")
+        for held, lineno, fexpr in m.calls:
+            if not held:
+                continue
+            target: Optional[_Method] = None
+            if isinstance(fexpr, ast.Attribute) and \
+                    isinstance(fexpr.value, ast.Name):
+                base, name = fexpr.value.id, fexpr.attr
+                if base == "self" and m.cls:
+                    target = by_cls.get((m.cls, name))
+                elif base in m.param_ann:
+                    target = by_cls.get((m.param_ann[base], name))
+                else:
+                    own = owners.get(name, set())
+                    if len(own) == 1:
+                        target = by_cls.get((next(iter(own)), name))
+            elif isinstance(fexpr, ast.Name):
+                target = mod_funcs.get((m.module, fexpr.id))
+            if target is None or target is m:
+                continue
+            for acq in sorted(target.acquires):
+                for h in held:
+                    if h == acq:
+                        if corpus.kinds.get(acq) not in REENTRANT_KINDS:
+                            self_deadlocks.append(Finding(
+                                "self-deadlock", "error",
+                                f"{m.module}:{lineno}", acq,
+                                f"{m.qualname} holds non-reentrant {acq} "
+                                f"and calls {target.qualname} which "
+                                f"acquires it again",
+                            ))
+                        continue
+                    add_edge(h, acq,
+                             f"{m.module}:{lineno} {m.qualname}: holds "
+                             f"{h}, calls {target.qualname} "
+                             f"({target.module}:{target.fn.lineno}) which "
+                             f"acquires {acq}")
+    return edges, self_deadlocks
+
+
+def _find_cycles(edges) -> List[List[str]]:
+    """One simple cycle per strongly connected component (size > 1)."""
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(adj[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    cycles = []
+    for comp in sccs:
+        comp_set = set(comp)
+        start = sorted(comp)[0]
+        # BFS back to start within the component
+        prev: Dict[str, str] = {}
+        frontier = [start]
+        seen = {start}
+        found = None
+        while frontier and found is None:
+            nxt = []
+            for u in frontier:
+                for w in adj[u]:
+                    if w == start:
+                        found = u
+                        break
+                    if w in comp_set and w not in seen:
+                        seen.add(w)
+                        prev[w] = u
+                        nxt.append(w)
+                if found is not None:
+                    break
+            frontier = nxt
+        path = [found]
+        while path[-1] != start:
+            path.append(prev[path[-1]])
+        cycles.append(list(reversed(path)))
+    return cycles
+
+
+def _guarded_fields(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    by_class: Dict[str, List[_Method]] = {}
+    for m in corpus.methods:
+        if m.cls and corpus.class_locks(m.cls):
+            by_class.setdefault(m.cls, []).append(m)
+    for cls, methods in sorted(by_class.items()):
+        # private-method lock context from in-class callsites, to a
+        # fixed point: a callsite counts as locked when it is lexically
+        # under the lock OR its enclosing method resolved to 'locked'
+        callsites: Dict[str, List[Tuple[str, bool]]] = {}
+        for m in methods:
+            for name, locked, _ln in m.self_calls:
+                callsites.setdefault(name, []).append((m.name, locked))
+        mctx: Dict[str, str] = {}
+        for m in methods:
+            if m.name.endswith("_locked"):
+                mctx[m.name] = "locked"
+            elif m.name in ("__init__", "__new__", "__post_init__"):
+                mctx[m.name] = "init"
+            else:
+                mctx[m.name] = "plain"
+        for _ in range(len(methods)):
+            changed = False
+            for m in methods:
+                if mctx[m.name] != "plain" or not m.name.startswith("_") \
+                        or m.name.startswith("__"):
+                    continue
+                sites = callsites.get(m.name, [])
+                if not sites:
+                    continue
+                if all(locked or mctx.get(c) == "locked"
+                       for c, locked in sites):
+                    mctx[m.name] = "locked"
+                    changed = True
+                elif all(mctx.get(c) == "init" for c, _l in sites):
+                    mctx[m.name] = "init"
+                    changed = True
+            if not changed:
+                break
+
+        def method_ctx(m: _Method) -> str:
+            return mctx[m.name]
+        locked_w: Dict[str, Tuple[str, int]] = {}
+        unlocked_w: Dict[str, Tuple[str, int]] = {}
+        lock_attrs = set(corpus.class_locks(cls))
+        for m in methods:
+            ctx = method_ctx(m)
+            if ctx == "init":
+                continue
+            for attr, locked, lineno in m.writes:
+                if attr in lock_attrs:
+                    continue
+                if locked or ctx == "locked":
+                    locked_w.setdefault(attr, (m.module, lineno))
+                elif m.name not in ("__init__", "__new__",
+                                    "__post_init__"):
+                    unlocked_w.setdefault(
+                        attr, (f"{m.module}:{lineno}", m.name))
+        for attr in sorted(set(locked_w) & set(unlocked_w)):
+            lmod, lline = locked_w[attr]
+            uwhere, umeth = unlocked_w[attr]
+            findings.append(Finding(
+                "guarded-field", "warning", uwhere, f"{cls}.{attr}",
+                f"{cls}.{attr} is stored under the lock "
+                f"({lmod}:{lline}) but also outside any lock in "
+                f"{cls}.{umeth} ({uwhere}) — torn publish",
+            ))
+    return findings
+
+
+def _contracts(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in corpus.methods:
+        if not m.contracts:
+            continue
+        call_lines: Dict[str, List[int]] = {}
+        for _held, lineno, fexpr in m.calls:
+            leaf = fexpr.attr if isinstance(fexpr, ast.Attribute) \
+                else getattr(fexpr, "id", None)
+            if leaf:
+                call_lines.setdefault(leaf, []).append(lineno)
+        store_lines: Dict[str, List[int]] = {}
+        for attr, _locked, lineno in m.writes:
+            store_lines.setdefault(attr, []).append(lineno)
+
+        def positions(name: str) -> List[int]:
+            if name.startswith("store:"):
+                return sorted(store_lines.get(name[len("store:"):], []))
+            return sorted(call_lines.get(name, []))
+
+        for first, then, dec_line in m.contracts:
+            subj = f"{m.qualname}:{first}<{then}"
+            fpos, tpos = positions(first), positions(then)
+            where = f"{m.module}:{m.fn.lineno}"
+            if not fpos:
+                findings.append(Finding(
+                    "ordering-contract", "error", where, subj,
+                    f"@must_precede({first!r}, {then!r}) on {m.qualname}: "
+                    f"no occurrence of {first!r} in the body"))
+            elif not tpos:
+                findings.append(Finding(
+                    "ordering-contract", "warning", where, subj,
+                    f"@must_precede({first!r}, {then!r}) on {m.qualname}: "
+                    f"no occurrence of {then!r} (vacuous contract)"))
+            elif min(tpos) < min(fpos):
+                findings.append(Finding(
+                    "ordering-contract", "error",
+                    f"{m.module}:{min(tpos)}", subj,
+                    f"{m.qualname}: {then!r} at line {min(tpos)} precedes "
+                    f"the first {first!r} at line {min(fpos)} "
+                    f"(@must_precede declared at line {dec_line})"))
+    return findings
+
+
+def _declared_closure(pairs) -> Set[Tuple[str, str]]:
+    closure = set(pairs)
+    changed = True
+    while changed:
+        changed = False
+        for (a, b) in list(closure):
+            for (c, d) in list(closure):
+                if b == c and (a, d) not in closure:
+                    closure.add((a, d))
+                    changed = True
+    return closure
+
+
+def analyze(corpus: Corpus, declared_order=None) -> Tuple[List[Finding],
+                                                          dict]:
+    if declared_order is None:
+        from infw import contracts
+        declared_order = contracts.LOCK_ORDER
+    findings: List[Finding] = []
+    for err in corpus.parse_errors:
+        findings.append(Finding("parse-error", "error", err.split(":")[0],
+                                err, err))
+    edges, self_deadlocks = build_graph(corpus)
+    findings.extend(self_deadlocks)
+    # (a) cycles
+    for cyc in _find_cycles(edges):
+        ring = cyc + [cyc[0]]
+        wits = []
+        for a, b in zip(ring, ring[1:]):
+            ws = edges.get((a, b), [])
+            wits.append(ws[0] if ws else f"(edge {a} -> {b})")
+        findings.append(Finding(
+            "lock-cycle", "error", wits[0].split(" ")[0],
+            " -> ".join(ring),
+            f"lock-acquisition cycle {' -> '.join(ring)} — potential "
+            f"deadlock ({len(cyc)} witness paths)",
+            witnesses=wits,
+        ))
+    # (c) declared lock order violated by a measured edge
+    closure = _declared_closure(declared_order)
+    for (a, b), wits in sorted(edges.items()):
+        if (b, a) in closure:
+            findings.append(Finding(
+                "lock-order", "error", wits[0].split(" ")[0],
+                f"{a} -> {b}",
+                f"acquisition edge {a} -> {b} contradicts the declared "
+                f"order ({b} before {a}); witness: {wits[0]}",
+                witnesses=wits[:2],
+            ))
+    # (b) guarded fields
+    findings.extend(_guarded_fields(corpus))
+    # (c) must_precede contracts
+    findings.extend(_contracts(corpus))
+    # (d) thread hygiene
+    for m in corpus.methods:
+        if m.module.endswith("_threads.py"):
+            continue
+        for lineno in m.raw_threads:
+            findings.append(Finding(
+                "thread-hygiene", "error", f"{m.module}:{lineno}",
+                f"{m.qualname}",
+                f"{m.qualname} constructs threading.Thread directly; "
+                f"background threads must use infw._threads.spawn (crash "
+                f"surfacing + thread_crashes_total)"))
+    stats = {
+        "modules": len(corpus.mod_locks),
+        "lock_sites": len(corpus.sites),
+        "graph_nodes": len({n for e in edges for n in e}),
+        "graph_edges": len(edges),
+        "edges": {f"{a} -> {b}": ws[0] for (a, b), ws in sorted(
+            edges.items())},
+    }
+    return findings, stats
+
+
+# -- suppressions / entry point ----------------------------------------------
+
+
+def default_suppressions_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lockcheck_suppressions.txt")
+
+
+def load_suppressions(path: Optional[str] = None):
+    """Lines of ``check-id subject-glob  # justification``; blank lines
+    and pure comments skipped.  A justification is REQUIRED."""
+    path = path or default_suppressions_path()
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, reason = line.partition("#")
+            parts = body.split()
+            if len(parts) != 2 or not reason.strip():
+                raise ValueError(
+                    f"{path}:{n}: expected 'check subject-glob  # why', "
+                    f"got {line!r}")
+            out.append((parts[0], parts[1], reason.strip()))
+    return out
+
+
+def analyze_repo(root: Optional[str] = None,
+                 inject_defect: Optional[str] = None,
+                 suppressions_path: Optional[str] = None) -> dict:
+    corpus = build_corpus(root, inject_defect=inject_defect)
+    findings, stats = analyze(corpus)
+    supp = load_suppressions(suppressions_path)
+    kept, suppressed = [], []
+    for f in findings:
+        hit = next((s for s in supp
+                    if s[0] == f.check and fnmatch.fnmatch(f.subject, s[1])),
+                   None)
+        (suppressed if hit else kept).append(
+            (f, hit[2] if hit else None))
+    return {
+        "inventory": [s.to_dict() for s in corpus.sites],
+        "findings": [f.to_dict() for f, _ in kept],
+        "suppressed": [dict(f.to_dict(), reason=r) for f, r in suppressed],
+        "stats": stats,
+        "errors": sum(1 for f, _ in kept if f.severity == "error"),
+        "warnings": sum(1 for f, _ in kept if f.severity == "warning"),
+    }

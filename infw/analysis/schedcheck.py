@@ -1,0 +1,776 @@
+"""Deterministic interleaving explorer for the threaded control plane
+(ISSUE-18, dynamic layer).
+
+lockcheck (the static layer) proves the lock GRAPH is sane; this module
+checks the actual interleavings.  A cooperative scheduler shims the
+inventoried locks on live objects (``instrument``) so that every lock
+acquire/release — plus every explicit ``infw._threads.sched_point`` —
+becomes a serialization point: exactly ONE scenario thread runs between
+points, and the driver decides who runs next.  A run is therefore a
+pure function of its ``Schedule`` (start thread + a sparse map of
+forced preemptions), which makes every discovered race replayable from
+a short schedule string and shrinkable.
+
+Exploration is preemption-bounded in the CHESS style: the serial
+orders run first (they also measure the decision horizon), then every
+single-preemption schedule up to the horizon (systematic — this is
+what finds the cowrace defect deterministically), then seeded random
+schedules with up to ``bound`` preemptions.  A failing schedule is
+shrunk ddmin-style (greedy preemption removal to a fixpoint) to a
+minimal repro whose realized trace compresses to a few segments —
+``s0@4:t1`` reads "start thread 0, at decision 4 force thread 1".
+
+The production scenarios (SCENARIOS) drive real control-plane objects
+— ArenaAllocator, FlowTier + TxnApplier, TelemetryTier, TenantRegistry
+— two threads each, with the statecheck invariants as the oracle.
+"""
+from __future__ import annotations
+
+import random
+import re
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import _threads
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+_RLOCK_TYPE = type(threading.RLock())
+
+
+# --- schedules ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Schedule:
+    """One deterministic interleaving: the first thread granted, plus
+    forced preemptions ``(decision_index, thread_index)`` — at every
+    other decision the scheduler keeps the current thread running
+    (falling back to round-robin when it blocks or finishes)."""
+
+    start: int = 0
+    preemptions: Tuple[Tuple[int, int], ...] = ()
+
+    def to_str(self) -> str:
+        return "s%d%s" % (
+            self.start,
+            "".join("@%d:t%d" % (i, t) for i, t in self.preemptions),
+        )
+
+    @staticmethod
+    def from_str(s: str) -> "Schedule":
+        m = re.fullmatch(r"s(\d+)((?:@\d+:t\d+)*)", s.strip())
+        if not m:
+            raise ValueError(f"bad schedule string {s!r}")
+        pre = tuple(
+            (int(i), int(t))
+            for i, t in re.findall(r"@(\d+):t(\d+)", m.group(2))
+        )
+        return Schedule(start=int(m.group(1)), preemptions=pre)
+
+
+def _segments(trace: List[int]) -> List[Tuple[int, int]]:
+    """Compress a per-decision thread trace into (thread, run-length)
+    segments — the human-readable repro form, and the 'schedule length'
+    the acceptance bound counts."""
+    segs: List[Tuple[int, int]] = []
+    for t in trace:
+        if segs and segs[-1][0] == t:
+            segs[-1] = (t, segs[-1][1] + 1)
+        else:
+            segs.append((t, 1))
+    return segs
+
+
+def format_trace(trace: List[int], names: List[str]) -> str:
+    return " ".join(
+        "%s×%d" % (names[t] if t < len(names) else f"t{t}", n)
+        for t, n in _segments(trace)
+    )
+
+
+# --- the cooperative scheduler ----------------------------------------------
+
+
+class _SchedKill(BaseException):
+    """Raised inside a parked thread on detach when it can never make
+    progress (deadlock / stuck runs) — BaseException so scenario code's
+    ``except Exception`` can't swallow the teardown."""
+
+
+class _ThreadState:
+    def __init__(self, idx: int, name: str):
+        self.idx = idx
+        self.name = name
+        self.sem = threading.Semaphore(0)
+        self.killed = False
+        self.done = False
+        self.crashed: Optional[Tuple[str, str]] = None  # (repr, traceback)
+        self.blocked_on: Optional["ShimLock"] = None
+        self.held: List[str] = []
+        self.last_tag: Optional[str] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class DetScheduler:
+    """Semaphore-handoff cooperative scheduler: managed threads own a
+    grant semaphore each; the driver owns one.  Exactly one side runs
+    at any instant, so scenario code needs no other synchronization to
+    be replayed deterministically."""
+
+    def __init__(self, schedule: Schedule, timeout: float = 30.0):
+        self.schedule = schedule
+        self.timeout = timeout
+        self._states: List[_ThreadState] = []
+        self._driver = threading.Semaphore(0)
+        self._local = threading.local()
+        self._premap: Dict[int, int] = dict(schedule.preemptions)
+        self._decision = 0
+        self._cur = schedule.start
+        self._detached = False
+        self.trace: List[int] = []
+        self.deadlock: Optional[List[str]] = None
+        self.stuck = False
+
+    # -- managed-thread registration
+
+    def add_thread(self, name: str, body: Callable[[], None]) -> _ThreadState:
+        st = _ThreadState(len(self._states), name)
+
+        def run() -> None:
+            self._local.state = st
+            st.sem.acquire()  # first grant
+            try:
+                if not st.killed:
+                    body()
+            except _SchedKill:
+                pass
+            except BaseException as e:  # noqa: BLE001 - reported, not hidden
+                st.crashed = (repr(e), traceback.format_exc())
+            finally:
+                st.done = True
+                self._driver.release()
+
+        # raw Thread on purpose: spawn()'s crash counters would turn
+        # every intentionally-crashing exploration run into /metrics
+        # noise (analysis/ is outside the lockcheck corpus)
+        st.thread = threading.Thread(
+            target=run, name=f"schedcheck-{name}", daemon=True
+        )
+        self._states.append(st)
+        return st
+
+    # -- thread-side protocol
+
+    def _current(self) -> Optional[_ThreadState]:
+        return getattr(self._local, "state", None)
+
+    def _switch(self, st: _ThreadState) -> None:
+        """Hand control to the driver and park until re-granted."""
+        if self._detached:
+            return
+        self._driver.release()
+        st.sem.acquire()
+        if st.killed:
+            raise _SchedKill()
+
+    def sched_point(self, tag: Optional[str] = None) -> None:
+        """infw._threads.sched_point lands here for managed threads;
+        unmanaged threads (the driver, production threads) pass
+        through."""
+        st = self._current()
+        if st is None or self._detached:
+            return
+        st.last_tag = tag
+        self._switch(st)
+
+    # -- driver side
+
+    def _runnable(self, st: _ThreadState) -> bool:
+        if st.done:
+            return False
+        lk = st.blocked_on
+        if lk is None:
+            return True
+        return lk._owner is None or (lk._reentrant and lk._owner is st)
+
+    def _pick(self) -> Optional[_ThreadState]:
+        d = self._decision
+        self._decision += 1
+        runnable = [st for st in self._states if self._runnable(st)]
+        if not runnable:
+            if not all(st.done for st in self._states):
+                self.deadlock = [
+                    "%s waiting on %s holding [%s]"
+                    % (st.name,
+                       st.blocked_on._name if st.blocked_on else "?",
+                       ", ".join(st.held))
+                    for st in self._states if not st.done
+                ]
+            return None
+        forced = self._premap.get(d)
+        if forced is not None:
+            for st in runnable:
+                if st.idx == forced:
+                    return st
+        for st in runnable:  # keep the current thread running
+            if st.idx == self._cur:
+                return st
+        # round-robin from the current index
+        order = sorted(runnable, key=lambda s: (s.idx - self._cur) % max(
+            len(self._states), 1))
+        return order[0]
+
+    def run(self) -> None:
+        _threads.set_scheduler(self)
+        try:
+            for st in self._states:
+                st.thread.start()
+            while True:
+                nxt = self._pick()
+                if nxt is None:
+                    break
+                self._cur = nxt.idx
+                self.trace.append(nxt.idx)
+                nxt.sem.release()
+                if not self._driver.acquire(timeout=self.timeout):
+                    self.stuck = True
+                    break
+        finally:
+            self._detach()
+            _threads.set_scheduler(None)
+
+    def _detach(self) -> None:
+        """Exploration over: let leftover threads run natively (shims
+        fall through to the real locks) so they release what they hold
+        before the invariant check runs on the driver thread.  Threads
+        that can never progress (deadlock / stuck) are killed at their
+        park point instead — waiting out a real deadlock on join would
+        cost the full timeout per run."""
+        self._detached = True
+        kill = self.deadlock is not None or self.stuck
+        for st in self._states:
+            if not st.done:
+                st.killed = kill
+                st.sem.release()
+        for st in self._states:
+            if st.thread is not None:
+                st.thread.join(timeout=5.0)
+
+
+# --- lock shims --------------------------------------------------------------
+
+
+class ShimLock:
+    """Wraps a real Lock/RLock: managed threads serialize through the
+    scheduler (a decision point before every acquire and after every
+    release); unmanaged threads — and everything after detach — use the
+    real lock directly."""
+
+    def __init__(self, inner, name: str, sched: DetScheduler,
+                 reentrant: bool):
+        self._inner = inner
+        self._name = name
+        self._sched = sched
+        self._reentrant = reentrant
+        self._owner: Optional[_ThreadState] = None
+        self._depth = 0
+
+    def _managed(self) -> Optional[_ThreadState]:
+        if self._sched._detached:
+            return None
+        return self._sched._current()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = self._managed()
+        if st is None:
+            if timeout != -1:
+                return self._inner.acquire(blocking, timeout)
+            return self._inner.acquire(blocking)
+        self._sched.sched_point(("acquire", self._name))
+        while True:
+            free = self._owner is None or (
+                self._reentrant and self._owner is st
+            )
+            if free and self._inner.acquire(blocking=False):
+                self._owner = st
+                self._depth += 1
+                st.held.append(self._name)
+                return True
+            if not blocking:
+                return False
+            st.blocked_on = self
+            self._sched._switch(st)
+            st.blocked_on = None
+            if self._sched._detached:
+                self._inner.acquire()
+                self._owner = st
+                self._depth += 1
+                return True
+
+    def release(self) -> None:
+        st = self._managed()
+        self._inner.release()
+        if st is not None and self._owner is st:
+            self._depth -= 1
+            if self._depth == 0:
+                self._owner = None
+            if self._name in st.held:
+                st.held.remove(self._name)
+            self._sched.sched_point(("release", self._name))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def instrument(sched: DetScheduler, *objects) -> List[str]:
+    """Replace every Lock/RLock instance attribute on the given live
+    objects with a ShimLock bound to ``sched``.  Returns the shimmed
+    lock names (``Type._attr``) for the report."""
+    names: List[str] = []
+    for obj in objects:
+        for attr, val in list(vars(obj).items()):
+            if isinstance(val, _LOCK_TYPES):
+                name = f"{type(obj).__name__}.{attr}"
+                setattr(obj, attr, ShimLock(
+                    val, name, sched,
+                    reentrant=isinstance(val, _RLOCK_TYPE),
+                ))
+                names.append(name)
+    return names
+
+
+# --- runs, exploration, shrinking -------------------------------------------
+
+
+@dataclass
+class RunResult:
+    schedule: Schedule
+    ok: bool
+    trace: List[int]
+    thread_names: List[str]
+    crashes: List[Tuple[str, str, str]] = field(default_factory=list)
+    invariant_errors: List[str] = field(default_factory=list)
+    deadlock: Optional[List[str]] = None
+    stuck: bool = False
+
+    @property
+    def segments(self) -> int:
+        return len(_segments(self.trace))
+
+    def describe(self) -> str:
+        parts = [f"schedule={self.schedule.to_str()}",
+                 f"trace=[{format_trace(self.trace, self.thread_names)}]"]
+        if self.deadlock:
+            parts.append("DEADLOCK: " + "; ".join(self.deadlock))
+        if self.stuck:
+            parts.append("STUCK (driver timeout)")
+        for name, exc, _tb in self.crashes:
+            parts.append(f"CRASH {name}: {exc}")
+        for e in self.invariant_errors:
+            parts.append(f"INVARIANT: {e}")
+        return "\n".join(parts)
+
+
+def run_scenario(factory: Callable[[], dict], schedule: Schedule,
+                 timeout: float = 30.0) -> RunResult:
+    """One deterministic run: fresh scenario state, shimmed locks,
+    schedule replayed, invariant checked after the threads join."""
+    ctx = factory()
+    sched = DetScheduler(schedule, timeout=timeout)
+    instrument(sched, *ctx.get("objects", ()))
+    names = []
+    for name, body in ctx["threads"]:
+        sched.add_thread(name, body)
+        names.append(name)
+    sched.run()
+    crashes = [
+        (st.name, st.crashed[0], st.crashed[1])
+        for st in sched._states if st.crashed
+    ]
+    inv_errors: List[str] = []
+    if not sched.stuck and sched.deadlock is None:
+        try:
+            inv_errors = list(ctx["invariant"]() or [])
+        except Exception as e:  # noqa: BLE001 - the oracle itself failed
+            inv_errors = [f"invariant raised: {e!r}"]
+    ok = (not crashes and not inv_errors and sched.deadlock is None
+          and not sched.stuck)
+    return RunResult(
+        schedule=schedule, ok=ok, trace=sched.trace, thread_names=names,
+        crashes=crashes, invariant_errors=inv_errors,
+        deadlock=sched.deadlock, stuck=sched.stuck,
+    )
+
+
+def shrink_schedule(factory: Callable[[], dict], schedule: Schedule,
+                    timeout: float = 30.0
+                    ) -> Tuple[Schedule, RunResult]:
+    """ddmin-style greedy shrink: drop preemptions one at a time while
+    the failure reproduces, to a fixpoint — the surviving schedule is
+    1-minimal (every remaining preemption is load-bearing)."""
+    cur = schedule
+    res = run_scenario(factory, cur, timeout)
+    changed = True
+    while changed and cur.preemptions:
+        changed = False
+        for i in range(len(cur.preemptions)):
+            cand = Schedule(
+                cur.start,
+                cur.preemptions[:i] + cur.preemptions[i + 1:],
+            )
+            r = run_scenario(factory, cand, timeout)
+            if not r.ok:
+                cur, res, changed = cand, r, True
+                break
+    return cur, res
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    ok: bool
+    runs: int
+    horizon: int
+    failure: Optional[RunResult] = None
+    shrunk: Optional[RunResult] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "scenario": self.scenario, "ok": self.ok,
+            "runs": self.runs, "horizon": self.horizon,
+        }
+        if self.failure is not None:
+            d["failure"] = {
+                "schedule": self.failure.schedule.to_str(),
+                "detail": self.failure.describe(),
+            }
+        if self.shrunk is not None:
+            d["shrunk"] = {
+                "schedule": self.shrunk.schedule.to_str(),
+                "segments": self.shrunk.segments,
+                "trace": format_trace(self.shrunk.trace,
+                                      self.shrunk.thread_names),
+                "detail": self.shrunk.describe(),
+            }
+        return d
+
+
+def explore(name: str, factory: Callable[[], dict], *, seed: int = 0,
+            runs: int = 24, bound: int = 2, timeout: float = 30.0
+            ) -> ExploreResult:
+    """Seeded bounded exploration.  Order: serial schedules per start
+    thread (these also measure the decision horizon), the systematic
+    single-preemption sweep, then seeded random schedules with up to
+    ``bound`` preemptions — ``runs`` caps the total.  First failure is
+    shrunk and returned."""
+    executed = 0
+    horizon = 0
+    nthreads = 0
+
+    def _run(sch: Schedule) -> RunResult:
+        nonlocal executed, horizon
+        r = run_scenario(factory, sch, timeout)
+        executed += 1
+        horizon = max(horizon, len(r.trace))
+        return r
+
+    def _fail(r: RunResult) -> ExploreResult:
+        shrunk_sched, shrunk_res = shrink_schedule(factory, r.schedule,
+                                                   timeout)
+        return ExploreResult(name, False, executed, horizon,
+                             failure=r, shrunk=shrunk_res)
+
+    probe = factory()
+    nthreads = len(probe["threads"])
+    del probe
+    for start in range(nthreads):
+        r = _run(Schedule(start=start))
+        if not r.ok:
+            return _fail(r)
+    for i in range(horizon):
+        for t in range(nthreads):
+            if executed >= runs:
+                break
+            r = _run(Schedule(start=0, preemptions=((i, t),)))
+            if not r.ok:
+                return _fail(r)
+    rng = random.Random(seed)
+    while executed < runs:
+        k = rng.randint(1, max(bound, 1))
+        pts = sorted(rng.sample(range(max(horizon, 1)),
+                                min(k, max(horizon, 1))))
+        pre = tuple((i, rng.randrange(nthreads)) for i in pts)
+        r = _run(Schedule(start=rng.randrange(nthreads), preemptions=pre))
+        if not r.ok:
+            return _fail(r)
+    return ExploreResult(name, True, executed, horizon)
+
+
+# --- production scenarios ----------------------------------------------------
+
+
+def _arena_pair(family: str = "dense", n: int = 14):
+    """Two tenants sharing one content-addressed page, with a pending
+    rules-only edit staged on tenant 0 — the CoW race substrate (the
+    test-suite's _shared_pair, trimmed)."""
+    import numpy as np
+
+    from .. import testing
+    from ..compiler import IncrementalTables
+    from ..kernels import jaxpath
+
+    base = testing.random_tables(
+        np.random.default_rng(40), n_entries=n, width=4, v6_fraction=0.35
+    )
+    u0 = IncrementalTables.from_content(dict(base.content), rule_width=4)
+    u1 = IncrementalTables.from_content(dict(base.content), rule_width=4)
+    s0, s1 = u0.snapshot(), u1.snapshot()
+    spec = jaxpath.arena_spec_for(family, [s0, s1], pages=6, max_tenants=4)
+    al = jaxpath.ArenaAllocator(spec)
+    assert al.load_tenant(0, s0) == "assign"
+    assert al.load_tenant(1, s1) == "share"
+    u0.start_dirty_tracking()
+    k = sorted(u0.content, key=lambda kk: (kk.ingress_ifindex,
+                                           kk.ip_data))[0]
+    r = np.asarray(u0.content[k]).copy()
+    r[1] = [1, 6, 443, 0, 0, 0, 1]
+    u0.apply({k: r}, [])
+    hint = u0.peek_dirty()
+    snap = u0.snapshot()
+    return al, snap, hint
+
+
+def scenario_cow_vs_dedup() -> dict:
+    """Concurrent update_tenant (a CoW-forcing edit) + dedup_sweep on
+    the shared page's allocator."""
+    from .statecheck import check_arena
+
+    al, snap, hint = _arena_pair()
+
+    def edit():
+        al.load_tenant(0, snap, hint=hint)
+
+    def sweep():
+        al.dedup_sweep()
+
+    return {
+        "threads": [("edit", edit), ("sweep", sweep)],
+        "objects": [al],
+        "invariant": lambda: check_arena(al),
+    }
+
+
+def scenario_cow_vs_destroy() -> dict:
+    """CoW edit racing the donor's last sharer being destroyed — the
+    cowrace injected defect's discovery scenario (green without the
+    defect)."""
+    from .statecheck import check_arena
+
+    al, snap, hint = _arena_pair()
+
+    def edit():
+        al.load_tenant(0, snap, hint=hint)
+
+    def destroy():
+        al.destroy_tenant(1)
+
+    return {
+        "threads": [("edit", edit), ("destroy", destroy)],
+        "objects": [al],
+        "invariant": lambda: check_arena(al),
+    }
+
+
+def scenario_flush_vs_resident() -> dict:
+    """Edits-flush (TxnApplier.apply -> load_tables -> generation bump)
+    racing resident dispatches on the same FlowTier — the PR-9/12
+    thread pair.  The fused step is a host stub (the chain plumbing,
+    not the kernel, is under test)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..compiler import IncrementalTables
+    from ..flow import FlowConfig, FlowTier
+    from ..txn import TxnApplier
+
+    flow = FlowTier(FlowConfig(entries=256, pages=1, max_tenants=1))
+
+    class _StubClf:
+        supports_overlay = False
+
+        def __init__(self):
+            self.loads = 0
+
+        def load_tables(self, snap, dirty_hint=None):
+            _threads.sched_point("stub-load")
+            self.loads += 1
+            flow.bump_generation(0)
+
+    from .. import testing
+
+    clf = _StubClf()
+    base = testing.random_tables(np.random.default_rng(9), n_entries=4,
+                                 width=4, v6_fraction=0.0)
+    upd = IncrementalTables.from_content(dict(base.content), rule_width=4)
+    app = TxnApplier(clf, upd)
+
+    def fake_step(flow_cols, gens, pages, epoch, wire, tenant, tflags,
+                  max_age):
+        return flow_cols, epoch + jnp.int32(1), jnp.zeros((4,), jnp.uint32)
+
+    wire = np.zeros((4, 7), np.uint32)
+    zeros = np.zeros(4, np.int32)
+
+    def flush():
+        app.apply([], reason="schedcheck")
+
+    def dispatch():
+        for _ in range(2):
+            flow.resident_dispatch(
+                fake_step, (), None, 4, wire_np=wire,
+                tenant_np=zeros, tflags_np=zeros,
+            )
+
+    def invariant():
+        errs = []
+        if flow._epoch != 2:
+            errs.append(f"epoch {flow._epoch} != 2 dispatches")
+        if flow._epoch_dev_val != flow._epoch:
+            errs.append("device epoch mirror diverged from host counter")
+        if clf.loads != 1:
+            errs.append(f"{clf.loads} table loads != 1 flush")
+        if int(flow._gens_host[0]) != 1:
+            errs.append(f"gen {int(flow._gens_host[0])} != 1 bump")
+        return errs
+
+    return {
+        "threads": [("flush", flush), ("dispatch", dispatch)],
+        "objects": [flow, app, flow.stats],
+        "invariant": invariant,
+    }
+
+
+def scenario_drain_vs_patch() -> dict:
+    """Telemetry drain(force) racing sketch-update patches: the
+    exactly-once window contract — every admission lands in exactly one
+    drained window, seq stamps gap-free."""
+    import numpy as np
+
+    from ..kernels.sketch import SketchSpec
+    from ..obs.telemetry import TelemetryTier
+
+    tier = TelemetryTier(
+        SketchSpec.make(depth=2, width=256, topk=64),
+        drain_every=1 << 30,  # only the racing explicit drain fires
+    )
+    rng = np.random.default_rng(7)
+    wire = rng.integers(0, 2**32, size=(4, 7), dtype=np.uint32)
+    res = np.zeros(4, np.uint32)
+    drained: List = []
+
+    def patch():
+        for _ in range(2):
+            tier.update(wire, res)
+
+    def drain():
+        drained.extend(tier.drain(force=True))
+
+    def invariant():
+        errs = []
+        final = tier.drain(force=True)
+        recs = drained + list(final)
+        total = sum(r.admissions for r in recs)
+        if total != 2:
+            errs.append(f"drained admissions {total} != 2 updates")
+        seqs = [r.seq for r in recs]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            errs.append(f"drain seqs not gap-free/increasing: {seqs}")
+        return errs
+
+    return {
+        "threads": [("patch", patch), ("drain", drain)],
+        "objects": [tier],
+        "invariant": invariant,
+    }
+
+
+def scenario_create_vs_edit() -> dict:
+    """TenantRegistry.create_tenant racing update_tenant on another
+    tenant over a real ArenaClassifier — the publish-name-only-after-
+    load discipline plus arena invariants under op interleaving."""
+    import numpy as np
+
+    from .. import testing
+    from ..backend.tpu import ArenaClassifier
+    from ..kernels import jaxpath
+    from ..syncer import TenantRegistry
+    from .statecheck import check_arena
+
+    ta = testing.random_tables(np.random.default_rng(50), n_entries=10,
+                               width=4, v6_fraction=0.0)
+    tb = testing.random_tables(np.random.default_rng(51), n_entries=10,
+                               width=4, v6_fraction=0.0)
+    spec = jaxpath.arena_spec_for("dense", [ta, tb], pages=6,
+                                  max_tenants=4)
+    clf = ArenaClassifier(spec, interpret=True, fused_deep=False)
+    reg = TenantRegistry(clf, rule_width=4)
+    reg.create_tenant("a", dict(ta.content))
+    k = sorted(ta.content, key=lambda kk: (kk.ingress_ifindex,
+                                           kk.ip_data))[0]
+    r = np.asarray(ta.content[k]).copy()
+    r[0] = [1, 6, 8443, 0, 0, 0, 1]
+
+    def create():
+        reg.create_tenant("b", dict(tb.content))
+
+    def edit():
+        reg.update_tenant("a", {k: r}, [])
+
+    def invariant():
+        errs = []
+        ids = reg.tenant_ids_by_name()
+        if set(ids) != {"a", "b"}:
+            errs.append(f"tenants after race: {sorted(ids)} != ['a','b']")
+        errs.extend(check_arena(clf.allocator))
+        return errs
+
+    return {
+        "threads": [("create", create), ("edit", edit)],
+        "objects": [reg, clf.allocator],
+        "invariant": invariant,
+    }
+
+
+#: name -> factory; the four production scenarios the gate runs, plus
+#: the cowrace-discovery pair (green without the injected defect).
+SCENARIOS: Dict[str, Callable[[], dict]] = {
+    "cow-vs-dedup": scenario_cow_vs_dedup,
+    "flush-vs-resident": scenario_flush_vs_resident,
+    "drain-vs-patch": scenario_drain_vs_patch,
+    "create-vs-edit": scenario_create_vs_edit,
+    "cow-vs-destroy": scenario_cow_vs_destroy,
+}
+
+#: the default gate set (ISSUE-18's four production scenarios;
+#: cow-vs-destroy joins via --scenarios or the cowrace injection)
+DEFAULT_SCENARIOS = (
+    "cow-vs-dedup", "flush-vs-resident", "drain-vs-patch",
+    "create-vs-edit",
+)
+
+
+def explore_all(scenarios=DEFAULT_SCENARIOS, *, seed: int = 0,
+                runs: int = 24, bound: int = 2,
+                timeout: float = 30.0) -> List[ExploreResult]:
+    return [
+        explore(name, SCENARIOS[name], seed=seed, runs=runs, bound=bound,
+                timeout=timeout)
+        for name in scenarios
+    ]

@@ -29,6 +29,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from .._threads import spawn
 from ..compiler import CompiledTables
 from ..constants import ALLOW, DENY, KIND_IPV6
 from ..kernels import jaxpath, pallas_dense, pallas_walk, wire_decode
@@ -689,9 +690,7 @@ class TpuClassifier:
                     self._active = self._active[:5] + (resident,)
                     self._walk_meta = meta
 
-        threading.Thread(
-            target=work, name="infw-walk-rebuild", daemon=True
-        ).start()
+        spawn(work, name="infw-walk-rebuild")
 
     # -- classify -----------------------------------------------------------
 

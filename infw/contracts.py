@@ -1,0 +1,54 @@
+"""Declared concurrency contracts — the machine-checked half of the
+comment disciplines PRs 10/13/15/17 introduced.
+
+Two kinds of declaration live here, both consumed statically by
+``infw.analysis.lockcheck`` (the decorators are runtime no-ops):
+
+``@must_precede("first", "then")`` — inside the decorated function,
+every call to ``then`` must come after a call to ``first`` (checked by
+source position; the decorated body is expected to be the linear landing
+sequence, not a dispatch table).  ``then``/``first`` name either a
+callee (``self.first(...)`` / ``first(...)``) or, with a ``store:``
+prefix, a store to an instance attribute (``store:_names`` matches
+``self._names[...] = ...`` and ``self._names = ...``) — so
+publish-after-load disciplines are expressible too.
+
+``LOCK_ORDER`` — the global lock-nesting order: ``(outer, inner)`` pairs
+meaning ``outer`` may be held while acquiring ``inner``, NEVER the
+reverse.  lockcheck flags any measured acquisition edge that contradicts
+a declared pair (directly or through the declared order's transitive
+closure).  Lock names are ``ClassName._attr`` as inventoried by
+lockcheck.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+#: Declared lock-nesting order (PR 13's discipline, extended by PR 14):
+#: the fused resident dispatch holds the flow tier's lock while
+#: exchanging donated buffers under the telemetry tier's lock, which in
+#: turn wraps the anomaly tier's exchange — flow -> telemetry ->
+#: mlscore, never any reverse edge.
+LOCK_ORDER: List[Tuple[str, str]] = [
+    ("FlowTier._lock", "TelemetryTier._lock"),
+    ("TelemetryTier._lock", "AnomalyTier._lock"),
+    ("FlowTier._lock", "AnomalyTier._lock"),
+]
+
+#: must_precede registry: qualname -> list of (first, then) pairs.
+#: Filled at import time by the decorators below; lockcheck reads the
+#: decorators from source, so this registry is for runtime
+#: introspection/tests only.
+MUST_PRECEDE: Dict[str, List[Tuple[str, str]]] = {}
+
+
+def must_precede(first: str, then: str) -> Callable:
+    """Declare an intra-function ordering contract (see module
+    docstring).  Identity decorator at runtime."""
+
+    def deco(fn: Callable) -> Callable:
+        key = getattr(fn, "__qualname__", getattr(fn, "__name__", str(fn)))
+        MUST_PRECEDE.setdefault(key, []).append((first, then))
+        return fn
+
+    return deco

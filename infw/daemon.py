@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ._threads import spawn
 from . import platform as platform_mod
 from .compiler import CompileError
 from .constants import DENY, KIND_IPV6, KIND_OTHER, MAX_TARGETS
@@ -719,6 +720,11 @@ class Daemon:
         # deny-event loss/queue totals on /metrics (events.go:79-82's
         # LostSamples, exported instead of only logged)
         self.metrics_registry.register_counters(self.ring)
+        # background-thread crash accounting (infw._threads.spawn): zero
+        # in a healthy control plane, so any nonzero reading is a page
+        from ._threads import CRASH_COUNTERS
+
+        self.metrics_registry.register_counters(CRASH_COUNTERS)
         # per-format H2D wire accounting (TpuClassifier.wire_stats) as
         # counters; the getter indirection survives table reloads and the
         # CPU backend (no wire_stats) renders nothing.  Registry holds
@@ -1062,11 +1068,8 @@ class Daemon:
                     len(items), e,
                 )
 
-        th = threading.Thread(
-            target=work, name="infw-edit-flush", daemon=True
-        )
+        th = spawn(work, name="infw-edit-flush")
         self._edit_flush_thread = th
-        th.start()
         return True
 
     # -- ingest --------------------------------------------------------------
@@ -1794,12 +1797,10 @@ class Daemon:
         for port in {self.metrics_port, self.health_port}:
             srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
             self._servers.append(srv)
-            t = threading.Thread(target=srv.serve_forever, daemon=True)
-            t.start()
+            t = spawn(srv.serve_forever, name="infw-daemon-http")
             self._threads.append(t)
         self.events_logger.start()
-        t = threading.Thread(target=self._file_loop, daemon=True)
-        t.start()
+        t = spawn(self._file_loop, name="infw-file-loop")
         self._threads.append(t)
         log.info(
             "daemon started node=%s backend=%s metrics=127.0.0.1:%d",

@@ -705,7 +705,8 @@ class FlowTier:
                           tenant_np: Optional[np.ndarray] = None,
                           tflags_np: Optional[np.ndarray] = None,
                           gens_snap=None, alloc_note=None,
-                          telemetry=None, mlscore=None):
+                          telemetry: Optional["TelemetryTier"] = None,
+                          mlscore: Optional["AnomalyTier"] = None):
         """Run one fused resident step and chain the donated buffers:
         ``fn(flow, gens, pages, epoch, *tables_args, wire, tenant,
         tflags, max_age) -> (new flow, new epoch, fused)``.  The updated
@@ -831,7 +832,8 @@ class FlowTier:
                                 tenant_np: Optional[np.ndarray] = None,
                                 tflags_np: Optional[np.ndarray] = None,
                                 gens_snap=None, alloc_note=None,
-                                telemetry=None, mlscore=None):
+                                telemetry: Optional["TelemetryTier"] = None,
+                                mlscore: Optional["AnomalyTier"] = None):
         """Run ONE superbatch device program over ``k`` stacked
         admissions (jaxpath.jitted_resident_superbatch) and chain the
         donated buffers exactly like ``resident_dispatch`` — the device

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compiler import CompiledTables, trie_level_strides
+from ..contracts import must_precede
 from ..constants import (
     ALLOW,
     DENY,
@@ -3025,6 +3026,27 @@ def _inject_spliceleak_bug() -> bool:
     return env not in ("", "0", "false", "no")
 
 
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_COWRACE_BUG env var), ArenaAllocator._cow_install defers
+#: the CoW donor's refcount decrement past the allocator lock release —
+#: load_tenant then lands it as an UNLOCKED read-modify-write (with an
+#: explicit sched_point in the window), so a concurrently locked
+#: decrement (destroy_tenant / dedup_sweep) can interleave between the
+#: read and the write-back and be lost.  The schedcheck acceptance gate
+#: (tools/infw_lint.py sched --inject-defect cowrace) proves the
+#: deterministic interleaving explorer finds the race, ddmin-shrinks
+#: the schedule, and check_arena's cowleak refcount invariant names the
+#: stale page.  Never set in production.
+_INJECT_COWRACE_BUG = False
+
+
+def _inject_cowrace_bug() -> bool:
+    if _INJECT_COWRACE_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_COWRACE_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
 class ArenaCapacityError(ValueError):
     """A tenant table does not fit the arena's slab geometry (entries,
     node rows, trie depth, rule width, lut span) or the pool is out of
@@ -4847,10 +4869,39 @@ class ArenaAllocator:
         self._check_tenant(tenant)
         with self._lock:
             if self._spliced:
-                return self._load_tenant_spliced(
+                path = self._load_tenant_spliced(
                     tenant, tables, hint, pre_flip
                 )
-            return self._load_tenant_whole(tenant, tables, hint, pre_flip)
+            else:
+                path = self._load_tenant_whole(tenant, tables, hint,
+                                               pre_flip)
+        if _inject_cowrace_bug():
+            self._finish_cowrace_pending()
+        return path
+
+    def _finish_cowrace_pending(self) -> None:
+        """TEST-ONLY (cowrace defect): land the donor decref
+        _cow_install deferred — OUTSIDE the allocator lock, as a plain
+        read-modify-write on _page_refs with a sched_point in the
+        window, so schedcheck can interleave a locked decrement
+        (destroy_tenant / dedup_sweep merge) in between and demonstrate
+        the lost update.  Semantics match _decref(donor,
+        from_clone=True) when run serially."""
+        from .. import _threads
+
+        donor = getattr(self, "_cowrace_pending", None)
+        if donor is None:
+            return
+        self._cowrace_pending = None
+        n = self._page_refs.get(donor, 0)
+        _threads.sched_point("cowrace-rmw")
+        n -= 1
+        if n > 0:
+            self._page_refs[donor] = n
+            return
+        self._page_refs.pop(donor, None)
+        if self._page_holds.get(donor, 0) == 0:
+            self._release_page(donor)
 
     def _load_tenant_whole(self, tenant: int, tables: CompiledTables,
                            hint=None, pre_flip=None) -> str:
@@ -5191,6 +5242,7 @@ class ArenaAllocator:
         self.counters["patches"] += 1
         return "unsplice" if changed else "patch"
 
+    @must_precede("pre_flip", "_flip")
     def _cow_install(self, tenant, donor, arrays, n_nodes, chash,
                      tables, pre_flip) -> str:
         """The CoW landing sequence: write the private copy into a free
@@ -5221,7 +5273,12 @@ class ArenaAllocator:
         if pre_flip is not None:
             pre_flip()
         self._flip(tenant, new_page)
-        self._decref(donor, from_clone=True)
+        if _inject_cowrace_bug():
+            # TEST-ONLY (cowrace defect): defer the donor decref past
+            # the lock release — load_tenant lands it unlocked
+            self._cowrace_pending = donor
+        else:
+            self._decref(donor, from_clone=True)
         self.counters["cow_clones"] += 1
         return "cow"
 

@@ -32,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from ._threads import spawn
 from . import platform as platform_mod
 from . import validate
 from .controllers import (
@@ -511,7 +512,7 @@ class Manager:
                     "lease lost holder=%s (stolen after expiry); stopping",
                     self.lease_holder,
                 )
-                threading.Thread(target=self.stop, daemon=True).start()
+                spawn(self.stop, name="infw-mgr-stop")
                 return
 
     def start(self, lease_timeout: Optional[float] = None) -> bool:
@@ -522,22 +523,18 @@ class Manager:
         if self.lease is not None and not self.is_leader:
             if not self._await_lease(lease_timeout):
                 return False
-            t = threading.Thread(target=self._renew_loop, daemon=True)
-            t.start()
+            t = spawn(self._renew_loop, name="infw-lease-renew")
             self._threads.append(t)
         handler = self._make_handler()
         for port in {self.metrics_port, self.health_port}:
             srv = ThreadingHTTPServer(("127.0.0.1", port), handler)
             self._servers.append(srv)
-            t = threading.Thread(target=srv.serve_forever, daemon=True)
-            t.start()
+            t = spawn(srv.serve_forever, name="infw-mgr-http")
             self._threads.append(t)
-        t = threading.Thread(target=self._worker, daemon=True)
-        t.start()
+        t = spawn(self._worker, name="infw-mgr-worker")
         self._threads.append(t)
         if self.apply_dir:
-            t = threading.Thread(target=self._apply_loop, daemon=True)
-            t.start()
+            t = spawn(self._apply_loop, name="infw-mgr-apply")
             self._threads.append(t)
         # Initial full reconciles (the List-driven state resync on start).
         self.enqueue_fanout()

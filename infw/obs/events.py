@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .._threads import spawn
 from ..constants import (
     DENY,
     ETH_P_IP,
@@ -462,8 +463,7 @@ class EventsLogger:
         if self._thread is not None:
             return
         self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._thread = spawn(self._run, name="infw-events-log")
 
     def stop(self) -> None:
         if self._thread is None:

@@ -39,6 +39,7 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from .._threads import spawn
 
 log = logging.getLogger("infw.obs.metricsproxy")
 
@@ -213,10 +214,8 @@ class MetricsProxy:
         return self._server.server_address[1]
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
-        )
-        self._thread.start()
+        self._thread = spawn(self._server.serve_forever,
+                             name="infw-metrics-proxy")
         log.info(
             "metrics proxy listening on :%d (tls=%s) -> http://%s/metrics",
             self.port, self.tls, self.upstream,

@@ -27,6 +27,7 @@ import threading
 import weakref
 from typing import Dict, List, Optional
 
+from .._threads import spawn
 from ..backend.base import Classifier
 from ..failsaferules import MAX_INGRESS_RULES
 
@@ -242,9 +243,8 @@ class Statistics:
                 log.info("Metrics are already being polled")
                 return
             stop = threading.Event()
-            thread = threading.Thread(
-                target=self._poll_loop, args=(classifier, stop), daemon=True
-            )
+            thread = spawn(self._poll_loop, args=(classifier, stop),
+                           name="infw-metrics-poll", start=False)
             self._stop, self._thread = stop, thread
             thread.start()
 

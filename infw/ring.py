@@ -40,6 +40,8 @@ from typing import Optional
 
 import numpy as np
 
+from ._threads import sched_point
+
 _MAGIC = b"INFWRNG1"
 _VERSION = 1
 _HEADER_BYTES = 4096
@@ -106,8 +108,17 @@ class IngestRing:
         #: spent waiting on a full ring — the PRODUCER-side backpressure
         #: signal, distinct from falling behind an open-loop schedule
         #: (tools/loadgen.py --ring splits the two in its manifest)
+        #: Counter write discipline (ISSUE-18): every key is written by
+        #: exactly ONE side of the SPSC pair — pushed/blocked_waits/
+        #: blocked_us/depth_hwm_prod by the producer, popped/
+        #: depth_hwm_cons by the consumer — because a plain-dict
+        #: read-modify-write shared across both threads loses updates
+        #: (the depth_hwm check-then-store raced before the split; the
+        #: schedcheck test pins the fix).  counter_values() merges the
+        #: two watermarks.
         self._stats = {"pushed": 0, "popped": 0, "blocked_waits": 0,
-                       "depth_hwm": 0, "blocked_us": 0}
+                       "depth_hwm_prod": 0, "depth_hwm_cons": 0,
+                       "blocked_us": 0}
         #: consumer-side read cursor: records between tail and here are
         #: popped but not yet released (their slot views may be in
         #: flight as H2D staging buffers) — the producer only reuses
@@ -299,8 +310,9 @@ class IngestRing:
         self._u64[3] = seq + 1
         self._stats["pushed"] += 1
         depth = len(self)
-        if depth > self._stats["depth_hwm"]:
-            self._stats["depth_hwm"] = depth
+        sched_point("ring-hwm-prod")
+        if depth > self._stats["depth_hwm_prod"]:
+            self._stats["depth_hwm_prod"] = depth
         return seq
 
     def push(self, wire: np.ndarray, v4_only: bool = False,
@@ -364,8 +376,9 @@ class IngestRing:
             )
         self._stats["popped"] += 1
         depth = self.head - seq
-        if depth > self._stats["depth_hwm"]:
-            self._stats["depth_hwm"] = depth
+        sched_point("ring-hwm-cons")
+        if depth > self._stats["depth_hwm_cons"]:
+            self._stats["depth_hwm_cons"] = depth
         self._read_seq = seq + 1
         return RingChunk(self, seq, wire, fl, bool(flags & FLAG_V4_ONLY))
 
@@ -379,7 +392,8 @@ class IngestRing:
             "ring_blocked_waits_total": self._stats["blocked_waits"],
             "ring_blocked_us_total": self._stats["blocked_us"],
             "ring_depth": len(self),
-            "ring_depth_hwm": self._stats["depth_hwm"],
+            "ring_depth_hwm": max(self._stats["depth_hwm_prod"],
+                                  self._stats["depth_hwm_cons"]),
             "ring_slots": self.slots,
         }
 

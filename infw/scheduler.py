@@ -58,6 +58,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ._threads import spawn
 from .constants import KIND_IPV6, KIND_OTHER
 from .packets import PacketBatch
 
@@ -833,14 +834,10 @@ class ContinuousScheduler:
                         cv.notify_all()
                     flush_busy[0] = False
 
-            threading.Thread(
-                target=run_flush, name="infw-txn-flush", daemon=True
-            ).start()
+            spawn(run_flush, name="infw-txn-flush")
 
         drainers = [
-            threading.Thread(
-                target=drain_loop, name=f"infw-sched-drain-{i}", daemon=True
-            )
+            spawn(drain_loop, name=f"infw-sched-drain-{i}", start=False)
             for i in range(self.pipeline_depth)
         ]
         for t in drainers:

@@ -51,6 +51,7 @@ from .compiler import (
     min_rule_width,
 )
 from .constants import MAX_RULES_PER_TARGET
+from .contracts import must_precede
 from .interfaces import InterfaceRegistry
 from .spec import IngressNodeFirewallRules
 
@@ -1157,6 +1158,7 @@ class TenantRegistry:
         with self._op_lock:
             return self._create_tenant_locked(name, content)
 
+    @must_precede("load_tenant", "store:_names")
     def _create_tenant_locked(self, name, content) -> int:
         from .obs.events import TenantSwapRecord
 

@@ -1,0 +1,309 @@
+"""Static concurrency verifier (ISSUE-18, infw.analysis.lockcheck):
+graph construction on fixture modules, cycle witness output, the
+guarded-field rule, ordering contracts (call and store: forms), thread
+hygiene, suppression parsing, and the repo-wide sweep + lockorder
+injected-defect acceptances.
+"""
+import textwrap
+
+import pytest
+
+from infw.analysis import lockcheck
+
+
+def _corpus(**files):
+    return lockcheck.build_corpus(files=[
+        (f"fix/{name}.py", textwrap.dedent(src))
+        for name, src in files.items()
+    ])
+
+
+def _analyze(**files):
+    findings, stats = lockcheck.analyze(_corpus(**files),
+                                        declared_order=[])
+    return findings, stats
+
+
+def _by_check(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# --- inventory + acquisition graph ------------------------------------------
+
+
+FIXTURE_AB = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self, b: "B"):
+            with self._lock:
+                b.inner()
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def inner(self):
+            with self._lock:
+                pass
+"""
+
+
+def test_inventory_and_graph_edges():
+    findings, stats = _analyze(mod=FIXTURE_AB)
+    assert stats["lock_sites"] == 2
+    assert "A._lock -> B._lock" in stats["edges"]
+    wit = stats["edges"]["A._lock -> B._lock"]
+    assert "A.outer" in wit and "B.inner" in wit
+    assert not _by_check(findings, "lock-cycle")
+
+
+def test_explicit_acquire_release_tracked():
+    findings, stats = _analyze(mod="""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self, b: "B"):
+                self._lock.acquire()
+                b.inner()
+                self._lock.release()
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert "A._lock -> B._lock" in stats["edges"]
+
+
+def test_cycle_reported_with_both_witnesses():
+    findings, _ = _analyze(mod=textwrap.dedent(FIXTURE_AB) + textwrap.dedent("""
+        def reverse(a: "A", b: "B"):
+            with b._lock:
+                a.outer(b)
+    """))
+    cycles = _by_check(findings, "lock-cycle")
+    assert len(cycles) == 1
+    assert cycles[0].severity == "error"
+    assert len(cycles[0].witnesses) == 2
+    blob = "\n".join(cycles[0].witnesses)
+    assert "A.outer" in blob and "reverse" in blob
+
+
+def test_self_deadlock_on_plain_lock_not_rlock():
+    findings, _ = _analyze(mod="""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert any("self-deadlock" in f.check for f in findings)
+    findings, _ = _analyze(mod="""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert not any("self-deadlock" in f.check for f in findings)
+
+
+# --- guarded fields ----------------------------------------------------------
+
+
+def test_guarded_field_torn_publish_flagged():
+    findings, _ = _analyze(mod="""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def locked_bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def unlocked_bump(self):
+                self._n += 1
+    """)
+    gf = _by_check(findings, "guarded-field")
+    assert [f.subject for f in gf] == ["A._n"]
+    assert "unlocked_bump" in gf[0].message
+
+
+def test_guarded_field_init_and_locked_helpers_exempt():
+    findings, _ = _analyze(mod="""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # init writes never count as unlocked
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._n += 1  # all callsites hold the lock
+
+            def read_locked(self):
+                with self._lock:
+                    return self._n
+    """)
+    assert not _by_check(findings, "guarded-field")
+
+
+# --- ordering contracts -------------------------------------------------------
+
+
+def test_must_precede_pass_and_fail():
+    ok, _ = _analyze(mod="""
+        from infw.contracts import must_precede
+
+        class A:
+            @must_precede("pre_flip", "flip")
+            def install(self, pre_flip):
+                pre_flip()
+                self.flip()
+
+            def flip(self):
+                pass
+    """)
+    assert not _by_check(ok, "ordering-contract")
+    bad, _ = _analyze(mod="""
+        from infw.contracts import must_precede
+
+        class A:
+            @must_precede("pre_flip", "flip")
+            def install(self, pre_flip):
+                self.flip()
+                pre_flip()
+
+            def flip(self):
+                pass
+    """)
+    oc = _by_check(bad, "ordering-contract")
+    assert len(oc) == 1 and oc[0].severity == "error"
+
+
+def test_must_precede_store_form():
+    bad, _ = _analyze(mod="""
+        from infw.contracts import must_precede
+
+        class R:
+            @must_precede("load", "store:_names")
+            def create(self, name):
+                self._names[name] = 1
+                self.load()
+
+            def load(self):
+                pass
+    """)
+    assert _by_check(bad, "ordering-contract")
+    ok, _ = _analyze(mod="""
+        from infw.contracts import must_precede
+
+        class R:
+            @must_precede("load", "store:_names")
+            def create(self, name):
+                self.load()
+                self._names[name] = 1
+
+            def load(self):
+                pass
+    """)
+    assert not _by_check(ok, "ordering-contract")
+
+
+# --- thread hygiene -----------------------------------------------------------
+
+
+def test_thread_hygiene_flags_raw_thread_even_nested():
+    findings, _ = _analyze(mod="""
+        import threading
+
+        def outer():
+            def inner():
+                threading.Thread(target=print, daemon=True).start()
+            inner()
+    """)
+    th = _by_check(findings, "thread-hygiene")
+    assert len(th) == 1 and th[0].severity == "error"
+    ok, _ = _analyze(mod="""
+        from infw._threads import spawn
+
+        def outer():
+            spawn(print, name="x")
+    """)
+    assert not _by_check(ok, "thread-hygiene")
+
+
+# --- suppressions ------------------------------------------------------------
+
+
+def test_suppression_file_requires_justification(tmp_path):
+    good = tmp_path / "s.txt"
+    good.write_text(
+        "# comment\n"
+        "guarded-field A._n  # reviewed: benign\n"
+    )
+    supp = lockcheck.load_suppressions(str(good))
+    assert supp == [("guarded-field", "A._n", "reviewed: benign")]
+    bad = tmp_path / "b.txt"
+    bad.write_text("guarded-field A._n\n")
+    with pytest.raises(ValueError):
+        lockcheck.load_suppressions(str(bad))
+
+
+# --- repo-wide sweep + injected defect ---------------------------------------
+
+
+def test_repo_sweep_zero_unsuppressed_findings():
+    rep = lockcheck.analyze_repo()
+    assert rep["errors"] == 0, rep["findings"]
+    assert rep["warnings"] == 0, rep["findings"]
+    # the shipped suppressions are consumed (cowrace defect shims)
+    assert len(rep["suppressed"]) == 2
+    assert len(rep["inventory"]) >= 19
+    # the declared flow -> telemetry edge is actually measured
+    assert "FlowTier._lock -> TelemetryTier._lock" in rep["stats"]["edges"]
+
+
+def test_lockorder_injection_caught_with_both_witnesses():
+    rep = lockcheck.analyze_repo(inject_defect="lockorder")
+    cycles = [f for f in rep["findings"] if f["check"] == "lock-cycle"]
+    assert cycles, rep["findings"]
+    assert any(len(f["witnesses"]) >= 2 for f in cycles)
+    blob = "\n".join(w for f in cycles for w in f["witnesses"])
+    assert "resident_dispatch" in blob
+    assert "_defect_lockorder" in blob
+    # the synthetic edge also contradicts the declared LOCK_ORDER
+    assert any(f["check"] == "lock-order" for f in rep["findings"])

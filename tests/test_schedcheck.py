@@ -1,0 +1,221 @@
+"""Deterministic interleaving explorer (ISSUE-18, infw.analysis
+.schedcheck): schedule-string roundtrip, deterministic replay of toy
+races, shrinker 1-minimality, deadlock reporting, ring hwm counter
+determinism, and the four production scenarios + the cowrace injected
+defect (slow-marked per the tier-1 budget discipline).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from infw._threads import sched_point
+from infw.analysis import schedcheck
+from infw.analysis.schedcheck import Schedule, run_scenario
+
+
+# --- toy scenarios ------------------------------------------------------------
+
+
+def toy_race_factory():
+    """Classic lost update: unlocked read-modify-write with a yield
+    point between the read and the write."""
+    state = {"n": 0}
+
+    def bump():
+        v = state["n"]
+        sched_point("read")
+        state["n"] = v + 1
+
+    def invariant():
+        if state["n"] != 2:
+            return [f"lost update: n={state['n']} != 2"]
+        return []
+
+    return {
+        "threads": [("a", bump), ("b", bump)],
+        "invariant": invariant,
+        "objects": (),
+    }
+
+
+class _TwoLocks:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+
+
+def toy_deadlock_factory():
+    o = _TwoLocks()
+
+    def ab():
+        with o._lock:
+            with o._other:
+                pass
+
+    def ba():
+        with o._other:
+            with o._lock:
+                pass
+
+    return {
+        "threads": [("ab", ab), ("ba", ba)],
+        "invariant": lambda: [],
+        "objects": (o,),
+    }
+
+
+# --- schedule strings ---------------------------------------------------------
+
+
+def test_schedule_string_roundtrip():
+    for s in (Schedule(0), Schedule(1, ((3, 0),)),
+              Schedule(0, ((1, 1), (7, 0)))):
+        assert Schedule.from_str(s.to_str()) == s
+    assert Schedule.from_str("s0@5:t1") == Schedule(0, ((5, 1),))
+    for bad in ("", "t1", "s0@x:t1", "s0 @1:t1 junk"):
+        with pytest.raises(ValueError):
+            Schedule.from_str(bad)
+
+
+# --- toy race: detection, determinism, shrinking ------------------------------
+
+
+def test_serial_schedules_pass_toy_race():
+    for start in (0, 1):
+        r = run_scenario(toy_race_factory, Schedule(start))
+        assert r.ok, r.describe()
+
+
+def test_toy_race_found_and_replay_is_deterministic():
+    res = schedcheck.explore("toy-race", toy_race_factory, seed=0, runs=16)
+    assert not res.ok
+    assert res.shrunk is not None and not res.shrunk.ok
+    # a repro is only a repro if replaying its schedule string is
+    # bit-identical: same trace, same failure
+    sch = Schedule.from_str(res.shrunk.schedule.to_str())
+    r1 = run_scenario(toy_race_factory, sch)
+    r2 = run_scenario(toy_race_factory, sch)
+    assert r1.trace == r2.trace == res.shrunk.trace
+    assert r1.invariant_errors == r2.invariant_errors
+    assert not r1.ok
+
+
+def test_shrunk_schedule_is_one_minimal():
+    res = schedcheck.explore("toy-race", toy_race_factory, seed=3, runs=16,
+                             bound=4)
+    assert not res.ok
+    shrunk = res.shrunk.schedule
+    # dropping ANY surviving preemption must lose the repro
+    for i in range(len(shrunk.preemptions)):
+        cand = Schedule(shrunk.start,
+                        shrunk.preemptions[:i] + shrunk.preemptions[i + 1:])
+        assert run_scenario(toy_race_factory, cand).ok, (
+            f"preemption {i} of {shrunk.to_str()} is not load-bearing")
+
+
+def test_toy_deadlock_reported_with_held_and_wanted():
+    res = schedcheck.explore("toy-deadlock", toy_deadlock_factory,
+                             seed=0, runs=16)
+    assert not res.ok
+    dl = res.shrunk.deadlock
+    assert dl, res.shrunk.describe()
+    blob = "; ".join(dl)
+    assert "waiting on" in blob and "holding" in blob
+    assert "_TwoLocks._lock" in blob and "_TwoLocks._other" in blob
+
+
+# --- ring hwm counters under forced preemption --------------------------------
+
+
+def test_ring_depth_hwm_deterministic_under_preemption(tmp_path):
+    """The split prod/cons high-water marks (single-writer discipline)
+    must report the true max depth under every single-preemption
+    interleaving of two pushes against a drain — the schedule is forced
+    exactly at the ring-hwm-prod / ring-hwm-cons RMW points."""
+    from infw.ring import IngestRing
+
+    def factory():
+        ring = IngestRing.create(str(tmp_path / "hwm.ring"), slots=4,
+                                 slot_packets=8)
+        chunks = []
+
+        def producer():
+            for _ in range(2):
+                ring.push(np.zeros((2, 7), np.uint32))
+
+        def consumer():
+            while (c := ring.pop(timeout=0.0)) is not None:
+                chunks.append(c)  # no release: depth stays monotonic
+
+        def invariant():
+            errs = []
+            cv = ring.counter_values()
+            # both pushes always complete and nothing is released, so
+            # the depth reaches 2 exactly once on every interleaving
+            if cv["ring_depth_hwm"] != 2:
+                errs.append(f"ring_depth_hwm {cv['ring_depth_hwm']} != 2")
+            if cv["ring_pushed_total"] != 2:
+                errs.append("pushes lost")
+            for c in chunks:
+                c.release()
+            ring.close()
+            return errs
+
+        return {"threads": [("prod", producer), ("cons", consumer)],
+                "invariant": invariant, "objects": ()}
+
+    serial = run_scenario(factory, Schedule(0))
+    assert serial.ok, serial.describe()
+    horizon = len(serial.trace)
+    assert horizon >= 2  # both hwm sched_points were exercised
+    for i in range(horizon):
+        for t in (0, 1):
+            r = run_scenario(factory, Schedule(0, ((i, t),)))
+            assert r.ok, r.describe()
+
+
+# --- production scenarios -----------------------------------------------------
+
+
+def test_drain_vs_patch_serial_leg():
+    # the cheap tier-1 leg: one serial run of the lightest production
+    # scenario (no arena/JAX compilation in its body)
+    r = run_scenario(schedcheck.SCENARIOS["drain-vs-patch"], Schedule(0))
+    assert r.ok, r.describe()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", schedcheck.DEFAULT_SCENARIOS)
+def test_production_scenario_green(name):
+    res = schedcheck.explore(name, schedcheck.SCENARIOS[name],
+                             seed=0, runs=24, bound=2)
+    assert res.ok, res.shrunk.describe() if res.shrunk else "no repro"
+    assert res.runs >= 2  # at least the serial schedules ran
+    assert res.horizon > 0
+
+
+@pytest.mark.slow
+def test_cowrace_injection_caught_and_shrunk():
+    from infw.kernels import jaxpath
+
+    assert not jaxpath._inject_cowrace_bug()
+    jaxpath._INJECT_COWRACE_BUG = True
+    try:
+        res = schedcheck.explore(
+            "cow-vs-destroy", schedcheck.SCENARIOS["cow-vs-destroy"],
+            seed=0, runs=120, bound=2,
+        )
+    finally:
+        jaxpath._INJECT_COWRACE_BUG = False
+    assert not res.ok
+    assert res.shrunk is not None
+    assert res.shrunk.segments <= 6, res.shrunk.describe()
+    assert any("cowleak" in e for e in res.shrunk.invariant_errors), (
+        res.shrunk.describe())
+    # and the defect is OFF again: the same exploration budget is green
+    res2 = schedcheck.explore(
+        "cow-vs-destroy", schedcheck.SCENARIOS["cow-vs-destroy"],
+        seed=0, runs=30, bound=2,
+    )
+    assert res2.ok, res2.shrunk.describe() if res2.shrunk else ""

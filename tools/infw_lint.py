@@ -31,6 +31,29 @@ Subcommands:
           skip-node path (jaxpath._INJECT_CSKIP_BUG), caught by oracle
           divergence on the ctrie config — and verifies the checker
           catches it with a <= 3-op shrunk repro — exit 0 means CAUGHT.
+  lock    Static concurrency verifier (infw.analysis.lockcheck): AST
+          inventory of every Lock/RLock/Condition/Event in ``infw/``,
+          the lock-acquisition graph (cycles reported with both witness
+          code paths), guarded-field torn-publish detection, declared
+          ordering contracts (infw.contracts: @must_precede + the
+          flow->telemetry->mlscore LOCK_ORDER), and background-thread
+          hygiene (every thread must go through infw._threads.spawn).
+          False positives live in analysis/lockcheck_suppressions.txt
+          with one-line justifications.  ``--inject-defect lockorder``
+          reverses the nesting in a synthetic path; the cycle must be
+          reported with BOTH witnesses (exit 0 = caught).
+  sched   Deterministic interleaving explorer (infw.analysis.
+          schedcheck): a cooperative scheduler shims the inventoried
+          locks on live control-plane objects and replays seeded,
+          preemption-bounded schedules over 2-thread production
+          scenarios (CoW edit vs dedup sweep, edits-flush vs resident
+          dispatch, telemetry drain vs patch, registry create vs
+          racing edit).  Failures ddmin-shrink to a minimal schedule
+          string (``s0@5:t1`` = start thread 0, force thread 1 at
+          decision 5).  ``--inject-defect cowrace`` drops the
+          allocator lock around the CoW donor refcount decrement; the
+          explorer must find + shrink the race and check_arena's
+          cowleak invariant must name it (exit 0 = caught).
 
 Exit status: 1 when any error-severity finding exists (or, with
 ``--strict``, any warning too); 0 otherwise.  ``--json`` prints one
@@ -517,6 +540,128 @@ def cmd_state(args) -> int:
     return 1 if n_fail else 0
 
 
+# --- lock subcommand --------------------------------------------------------
+
+
+def cmd_lock(args) -> int:
+    from infw.analysis import lockcheck
+
+    if args.inject_defect:
+        # lockorder acceptance: append the reversed telemetry->flow
+        # nesting path and require a reported cycle with BOTH witness
+        # code paths.  Exit 0 = caught.
+        rep = lockcheck.analyze_repo(inject_defect=args.inject_defect)
+        cycles = [f for f in rep["findings"] if f["check"] == "lock-cycle"]
+        caught = any(len(f.get("witnesses", ())) >= 2 for f in cycles)
+        if args.json:
+            print(json.dumps({"defect": args.inject_defect,
+                              "caught": caught, "cycles": cycles},
+                             indent=2))
+        elif caught:
+            f = cycles[0]
+            print(f"CAUGHT {args.inject_defect}: cycle {f['subject']}")
+            for w in f["witnesses"]:
+                print(f"  witness: {w}")
+        else:
+            print(f"NOT CAUGHT {args.inject_defect}: no lock cycle "
+                  f"with two witnesses reported")
+        return 0 if caught else 1
+
+    rep = lockcheck.analyze_repo()
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        for f in rep["findings"]:
+            print(f"{f['severity']} [{f['check']}] {f['where']} "
+                  f"{f['subject']}: {f['message']}")
+            for w in f.get("witnesses", ()):
+                print(f"  witness: {w}")
+        print(f"lock: {len(rep['inventory'])} lock site(s), "
+              f"{len(rep['stats'].get('edges', {}))} acquisition edge(s), "
+              f"{rep['errors']} error(s), {rep['warnings']} warning(s), "
+              f"{len(rep['suppressed'])} suppressed")
+    if rep["errors"]:
+        return 1
+    if args.strict and rep["warnings"]:
+        return 1
+    return 0
+
+
+# --- sched subcommand -------------------------------------------------------
+
+
+def cmd_sched(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from infw.analysis import schedcheck
+
+    if args.inject_defect:
+        # cowrace acceptance: drop the allocator lock around the CoW
+        # donor refcount decrement; the explorer must find the
+        # interleaving, shrink it to <= 6 schedule steps, and
+        # check_arena's cowleak invariant must name it.  Exit 0 =
+        # caught.
+        from infw.kernels import jaxpath
+
+        old = jaxpath._INJECT_COWRACE_BUG
+        jaxpath._INJECT_COWRACE_BUG = True
+        try:
+            res = schedcheck.explore(
+                "cow-vs-destroy",
+                schedcheck.SCENARIOS["cow-vs-destroy"],
+                seed=args.seed, runs=max(args.runs, 120),
+                bound=args.preemptions,
+            )
+        finally:
+            jaxpath._INJECT_COWRACE_BUG = old
+        caught = (
+            not res.ok and res.shrunk is not None
+            and res.shrunk.segments <= 6
+            and any("cowleak" in e for e in res.shrunk.invariant_errors)
+        )
+        if args.json:
+            print(json.dumps({"defect": args.inject_defect,
+                              "caught": caught, "result": res.to_dict()},
+                             indent=2))
+        elif caught:
+            s = res.shrunk
+            print(f"CAUGHT {args.inject_defect}: schedule "
+                  f"{s.schedule.to_str()} ({s.segments} step(s))")
+            print(f"  trace: {schedcheck.format_trace(s.trace, s.thread_names)}")
+            for e in s.invariant_errors:
+                print(f"  invariant: {e}")
+        else:
+            print(f"NOT CAUGHT {args.inject_defect}: "
+                  + ("no failing interleaving found" if res.ok
+                     else "failure did not shrink to the cowleak repro"))
+        return 0 if caught else 1
+
+    names = ([x for x in args.scenarios.split(",") if x]
+             if args.scenarios else list(schedcheck.DEFAULT_SCENARIOS))
+    unknown = [n for n in names if n not in schedcheck.SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {', '.join(unknown)} "
+              f"(have: {', '.join(schedcheck.SCENARIOS)})", file=sys.stderr)
+        return 2
+    results = schedcheck.explore_all(
+        names, seed=args.seed, runs=args.runs, bound=args.preemptions,
+    )
+    n_fail = sum(1 for r in results if not r.ok)
+    if args.json:
+        print(json.dumps({"results": [r.to_dict() for r in results],
+                          "failures": n_fail, "ok": n_fail == 0},
+                         indent=2))
+    else:
+        for r in results:
+            status = "OK  " if r.ok else "FAIL"
+            print(f"{status} {r.scenario:20s} seed={args.seed} "
+                  f"runs={r.runs} horizon={r.horizon}")
+            if not r.ok and r.shrunk is not None:
+                for line in r.shrunk.describe().splitlines():
+                    print(f"     | {line}")
+        print(f"sched: {len(results)} scenario(s), {n_fail} failure(s)")
+    return 1 if n_fail else 0
+
+
 # --- main -------------------------------------------------------------------
 
 
@@ -596,6 +741,45 @@ def main(argv=None) -> int:
                               "(infw.txn) — and verify the checker "
                               "catches it (exit 0 = caught)")
     p_state.set_defaults(fn=cmd_state)
+
+    p_lock = sub.add_parser("lock", help="static lock-order/guard "
+                                         "analysis (lockcheck)")
+    p_lock.add_argument("--json", action="store_true")
+    p_lock.add_argument("--strict", action="store_true",
+                        help="warnings are fatal too")
+    p_lock.add_argument("--inject-defect", nargs="?", const="lockorder",
+                        default=None, choices=("lockorder",),
+                        help="reverse the flow->telemetry lock nesting "
+                             "in one synthetic path and verify the "
+                             "analyzer reports the cycle with BOTH "
+                             "witness code paths (exit 0 = caught)")
+    p_lock.set_defaults(fn=cmd_lock)
+
+    p_sched = sub.add_parser("sched", help="deterministic interleaving "
+                                           "explorer (schedcheck)")
+    p_sched.add_argument("--json", action="store_true")
+    p_sched.add_argument("--strict", action="store_true",
+                         help="accepted for UX parity (every schedcheck "
+                              "failure is already an error)")
+    p_sched.add_argument("--seed", type=int, default=0,
+                         help="exploration seed (default 0)")
+    p_sched.add_argument("--runs", type=int, default=24,
+                         help="schedules explored per scenario "
+                              "(default 24)")
+    p_sched.add_argument("--preemptions", type=int, default=2,
+                         help="max forced preemptions per random "
+                              "schedule (default 2)")
+    p_sched.add_argument("--scenarios", metavar="NAMES",
+                         help="comma-separated scenario subset "
+                              "(default: the four production scenarios)")
+    p_sched.add_argument("--inject-defect", nargs="?", const="cowrace",
+                         default=None, choices=("cowrace",),
+                         help="drop the allocator lock around the CoW "
+                              "donor refcount decrement and verify the "
+                              "explorer finds the interleaving, shrinks "
+                              "it to <= 6 steps, and check_arena names "
+                              "it (exit 0 = caught)")
+    p_sched.set_defaults(fn=cmd_sched)
 
     args = ap.parse_args(argv)
     return args.fn(args)
