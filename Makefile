@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast bench bench-checked build-bench slo-bench \
 	churn-bench flow-bench resident-bench telemetry-bench mlscore-bench \
-	pipeline-bench native entry-check dryrun-multichip mesh-check \
+	payload-bench pipeline-bench native entry-check dryrun-multichip \
+	mesh-check \
 	spill-read wire-check lint static-check state-check lock-check \
 	sched-check clean
 
@@ -268,6 +269,21 @@ telemetry-bench:
 mlscore-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --mlscore-bench
 
+# The payload matching tier (bench.bench_payload) standalone at smoke
+# scale off-TPU: device/host bit-identity of the Aho-Corasick match
+# bitmaps vs the naive substring oracle across the classic + resident
+# fused paths BEFORE any timing line, the standalone automaton ladder
+# (64/256/1024 patterns x 64/128 prefix bytes), served classify
+# retention with matching on at a FIXED OFFERED LOAD (70% of the
+# headers-only capacity, gated at INFW_PAYLOAD_RETENTION_MIN, default
+# 0.9, at the 64-pattern/64-byte rung), a warmed zero-recompile /
+# zero-alloc run spanning an in-bucket hot swap + mode flips, and an
+# enforce-mode leg (signature lanes denied, failsafe cells never
+# rewritten).  The statecheck payload configs run FIRST and gate
+# record publication.
+payload-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --payload-bench
+
 # The pipelined-admission tier (bench.bench_pipeline) standalone at
 # smoke scale off-TPU: the K=4 device-side superbatch epoch loop + the
 # two-slot overlap vs the single-dispatch resident loop, packets/s
@@ -286,7 +302,7 @@ pipeline-bench:
 # Bench behind the static gate (benchruns/README.md: jaxpr drift must
 # not silently change what the bench measures).  `make bench` itself is
 # left untouched — its stdout is a driver contract.
-bench-checked: static-check build-bench slo-bench churn-bench tenant-bench splice-bench flow-bench resident-bench telemetry-bench mlscore-bench pipeline-bench bench
+bench-checked: static-check build-bench slo-bench churn-bench tenant-bench splice-bench flow-bench resident-bench telemetry-bench mlscore-bench payload-bench pipeline-bench bench
 
 # Wire-codec gate: the delta+varint codec unit/fuzz suite plus a
 # 10K-packet replay smoke through the real daemon ingest on CPU

@@ -4446,6 +4446,420 @@ def mlscore_bench_main() -> int:
     return 0
 
 
+def bench_payload(rng, on_tpu):
+    """ISSUE-19 payload matching tier (``make payload-bench``, folded
+    into bench-checked): the batched Aho-Corasick plane measured four
+    ways:
+
+    - ORACLE GATE before any timing line: shadow-mode verdicts with
+      matching on bit-identical to the CPU oracle (shadow must never
+      touch verdicts), and the device match bitmaps bit-identical to
+      the NAIVE host substring oracle (cpu_ref.payload_match_ref)
+      across the classic and resident fused serving paths;
+    - AUTOMATON LADDER: standalone match throughput over 64/256/1024
+      patterns x 64/128 prefix bytes (the AcSpec bucket grid),
+      interleaved min-of-reps;
+    - RETENTION (the telemetry-bench discipline): served classify
+      throughput at a FIXED OFFERED LOAD — 70%% of the headers-only
+      capacity, calibrated in-record — matching on vs headers-only on
+      the resident serving loop, interleaved min-vs-min, gated at
+      INFW_PAYLOAD_RETENTION_MIN (the 64-pattern / 64-byte rung);
+    - ZERO-RECOMPILE HOT-SWAP: a warmed run with an in-bucket
+      swap_patterns AND a shadow->enforce->shadow mode flip mid-stream
+      must leave the fused executables' caches and the resident pool's
+      allocation counter exactly where the prewarm left them (swaps
+      flip value operands; mode is a device operand);
+    - ENFORCE LEG: signature-bearing lanes are denied (ruleId 0) while
+      failsafe-port cells keep their rule verdicts bit-exactly (the
+      failsaferules precedence contract).
+
+    Returns the record dict for the payload-bench gate."""
+    import jax as _jax
+
+    from infw.backend.cpu_ref import payload_match_ref
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+    from infw.kernels.acmatch import (
+        compile_patterns,
+        jitted_acmatch,
+        model_device,
+    )
+    from infw.kernels.mxu_score import DENY as _DENY, failsafe_lane_mask_np
+    from infw.payload import (
+        attack_payloads,
+        benign_payloads,
+        signature_patterns,
+    )
+    from infw.scheduler import prewarm_ladder
+
+    out = {}
+    n_entries = 100_000 if on_tpu else 20_000
+    tables = testing.random_tables_fast(
+        rng, n_entries=n_entries, width=8, v6_fraction=0.4,
+        ifindexes=(2, 3),
+    )
+    bs = 256
+    pats64 = signature_patterns(np.random.default_rng(11), 64, plen=64)
+
+    def payload_mix(prng, n, pats, plen, attack_frac=0.1):
+        """Benign HTTP-ish prefixes with a planted-signature minority —
+        the loadgen --payload attack-mix shape."""
+        k = max(1, int(n * attack_frac))
+        pay_a, len_a = attack_payloads(prng, k, pats, plen=plen)
+        pay_b, len_b = benign_payloads(prng, n - k, plen=plen)
+        pay = np.concatenate([pay_a, pay_b])
+        lens = np.concatenate([len_a, len_b])
+        perm = prng.permutation(n)
+        return (np.ascontiguousarray(pay[perm]),
+                np.ascontiguousarray(lens[perm].astype(np.int32)))
+
+    # -- oracle + bitmap bit-identity gate BEFORE any timing line -----------
+    small = testing.random_tables(np.random.default_rng(7), n_entries=48,
+                                  width=8)
+    sbatch = testing.random_batch(np.random.default_rng(1501), small,
+                                  bs * 4)
+    sbatch.tcp_flags = np.full(len(sbatch), jaxpath.TCP_ACK, np.int32)
+    spay, slen = payload_mix(np.random.default_rng(1502), len(sbatch),
+                             pats64, 64, attack_frac=0.5)
+    sref = oracle.classify(small, sbatch)
+    for label, kw in (
+        ("classic", dict(force_path="trie")),
+        ("resident", dict(force_path="trie",
+                          flow_table=FlowConfig.make(entries=1 << 12),
+                          resident=True)),
+    ):
+        chk = TpuClassifier(payload=pats64, payload_plen=64,
+                            payload_track=True, **kw)
+        chk.load_tables(small)
+        tier = chk.payload
+        tier.set_keep_masks(len(sbatch) // bs + 1)
+        n_div = 0
+        for lo in range(0, len(sbatch), bs):
+            idx = np.arange(lo, lo + bs, dtype=np.int64)
+            sub = sbatch.take(idx)
+            sub.payload = spay[lo:lo + bs]
+            sub.payload_len = slen[lo:lo + bs]
+            o = chk.classify(sub, apply_stats=False)
+            n_div += int((o.results != sref.results[idx]).sum())
+        if n_div:
+            raise RuntimeError(
+                f"payload-bench verdict mismatch on the {label} path: "
+                f"{n_div} divergences vs the CPU oracle (shadow mode "
+                "must never touch verdicts)"
+            )
+        masks = tier.recent_masks()
+        if not masks:
+            raise RuntimeError(
+                f"payload-bench: no match bitmaps retained on the "
+                f"{label} path (tracking broken?)"
+            )
+        for pay, plen, bitmap, hit in masks:
+            want = payload_match_ref(
+                tier.model.patterns, pay, plen, tier.spec.plen,
+                tier.spec.pwords,
+            )
+            if not np.array_equal(np.asarray(bitmap, np.uint32), want):
+                raise RuntimeError(
+                    f"payload-bench bitmap oracle mismatch ({label}): "
+                    "device Aho-Corasick diverged from the naive host "
+                    "substring reference"
+                )
+            if not np.array_equal(np.asarray(hit, bool),
+                                  (np.asarray(bitmap) != 0).any(axis=1)):
+                raise RuntimeError(
+                    f"payload-bench served-hit mismatch ({label}): the "
+                    "fused merge and the standalone kernel disagree"
+                )
+        chk.close()
+    log("payload: oracle gate clean (classic/resident bitmap + verdict "
+        "bit-identity vs the naive host reference)")
+
+    # -- automaton ladder: patterns x prefix width --------------------------
+    reps = 5 if on_tpu else 3
+    for npat in (64, 256, 1024):
+        for plen in (64, 128):
+            lpats = signature_patterns(
+                np.random.default_rng(100 + npat), npat, plen=plen
+            )
+            model = compile_patterns(lpats, plen=plen)
+            trans, mmap = model_device(model)
+            f = jitted_acmatch(model.spec)
+            pay, lens = payload_mix(np.random.default_rng(5), bs, lpats,
+                                    plen, attack_frac=0.5)
+            pay_d = _jax.device_put(pay)
+            len_d = _jax.device_put(lens)
+            np.asarray(f(trans, mmap, pay_d, len_d))  # warm
+            best = 1e9
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                np.asarray(f(trans, mmap, pay_d, len_d))
+                best = min(best, time.perf_counter() - t0)
+            rate = bs / best
+            path = "matmul" if model.spec.matmul else "gather"
+            log(f"payload ladder: {npat:5d} patterns x {plen:3d} B "
+                f"({model.spec.states} states, {path}): "
+                f"{rate/1e3:.1f} K pkt/s standalone")
+            emit(f"payload match throughput ({npat} patterns x {plen} B "
+                 f"prefix, standalone automaton launch)", rate,
+                 "packets/s", vs_baseline=0.0)
+            out[f"ladder_{npat}x{plen}"] = float(rate)
+
+    # -- retention at a fixed offered load (interleaved min-vs-min) ---------
+    trace = testing.random_batch_fast(np.random.default_rng(1500), tables,
+                                      bs * 40)
+    trace.tcp_flags = np.full(len(trace), jaxpath.TCP_ACK, np.int32)
+    tpay, tlen = payload_mix(np.random.default_rng(1503), len(trace),
+                             pats64, 64)
+
+    def chunked(tr):
+        cs = []
+        for lo in range(0, len(tr), bs):
+            sub = np.arange(lo, lo + bs, dtype=np.int64)
+            w, v4 = tr.pack_wire_subset(sub)
+            cs.append((
+                w, v4,
+                np.ascontiguousarray(tr.tcp_flags[sub]),
+                np.ascontiguousarray(tpay[lo:lo + bs]),
+                np.ascontiguousarray(tlen[lo:lo + bs]),
+            ))
+        return cs
+
+    chunks = chunked(trace)
+    fcfg = FlowConfig.make(entries=1 << 14)
+    clf_on = TpuClassifier(force_path="trie", flow_table=fcfg,
+                           resident=True, payload=pats64,
+                           payload_plen=64)
+    clf_off = TpuClassifier(force_path="trie",
+                            flow_table=FlowConfig.make(entries=1 << 14),
+                            resident=True)
+    for c in (clf_on, clf_off):
+        c.load_tables(tables)
+        prewarm_ladder(c, (bs,))
+
+    def run_pass(clf, with_pay):
+        clf.flow.reset()
+        t0 = time.perf_counter()
+        for w, v4, tf, pay, plen in chunks:
+            clf.classify_prepared(
+                clf.prepare_packed(
+                    w, v4, tcp_flags=tf,
+                    payload=pay if with_pay else None,
+                    payload_len=plen if with_pay else None,
+                ),
+                apply_stats=False,
+            ).result()
+        return time.perf_counter() - t0
+
+    run_pass(clf_on, True)  # warm the payload-fused shape
+    clf_on.mark_resident_warm()
+    clf_off.mark_resident_warm()
+    best = {"on": 1e9, "off": 1e9}
+    for _ in range(reps):
+        best["off"] = min(best["off"], run_pass(clf_off, False))
+        best["on"] = min(best["on"], run_pass(clf_on, True))
+    raw_ab = best["off"] / max(best["on"], 1e-12)
+    log(f"payload: RAW full-speed A/B — matching-on {best['on']*1e3:.1f} "
+        f"ms vs headers-only {best['off']*1e3:.1f} ms over {len(trace)} "
+        f"pkts ({raw_ab:.3f}, ungated reference)")
+    emit("raw full-speed dispatch A/B with payload matching on "
+         "(64 patterns x 64 B, resident fused serving loop, ungated "
+         "reference)", raw_ab, "ratio", vs_baseline=0.0)
+    out["raw_ab"] = float(raw_ab)
+
+    cap_off = len(trace) / best["off"]
+    offered = 0.7 * cap_off
+    sched = np.arange(len(chunks)) * (bs / offered)
+    sched_end = len(trace) / offered
+
+    def run_offered(clf, with_pay):
+        clf.flow.reset()
+        t0 = time.perf_counter()
+        for (w, v4, tf, pay, plen), s in zip(chunks, sched):
+            now = time.perf_counter() - t0
+            if now < s:
+                time.sleep(s - now)
+            clf.classify_prepared(
+                clf.prepare_packed(
+                    w, v4, tcp_flags=tf,
+                    payload=pay if with_pay else None,
+                    payload_len=plen if with_pay else None,
+                ),
+                apply_stats=False,
+            ).result()
+        return max(time.perf_counter() - t0, sched_end)
+
+    best_o = {"on": 1e9, "off": 1e9}
+    for _ in range(reps):
+        best_o["off"] = min(best_o["off"], run_offered(clf_off, False))
+        best_o["on"] = min(best_o["on"], run_offered(clf_on, True))
+    ach_on = len(trace) / best_o["on"]
+    ach_off = len(trace) / best_o["off"]
+    retention = ach_on / max(ach_off, 1e-12)
+    log(f"payload: served throughput at {offered/1e3:.1f} K pkt/s "
+        f"offered (70% of headers-only capacity {cap_off/1e3:.1f} K): "
+        f"on {ach_on/1e3:.1f} K vs off {ach_off/1e3:.1f} K -> "
+        f"retention {retention:.3f}")
+    emit("classify throughput retention with payload matching on "
+         "(fixed offered load at 70% of headers-only capacity, "
+         "resident serving loop, 64 patterns x 64 B prefix)",
+         retention, "ratio", vs_baseline=0.0)
+    out["retention"] = float(retention)
+
+    # -- zero-recompile / zero-alloc hot-swap -------------------------------
+    pspec = clf_on.payload.spec
+    fn_t = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", False, None, 0, False,
+        payload=pspec,
+    )
+    fn_t4 = jaxpath.jitted_resident_step(
+        fcfg.entries, fcfg.ways, "trie", True, None, 0, False,
+        payload=pspec,
+    )
+    fn_m = jitted_acmatch(pspec)
+    cache0 = fn_t._cache_size() + fn_t4._cache_size() + fn_m._cache_size()
+    v0 = clf_on.payload.version
+    n_disp = 0
+    while n_disp < 120:
+        for w, v4, tf, pay, plen in chunks:
+            clf_on.classify_prepared(
+                clf_on.prepare_packed(w, v4, tcp_flags=tf, payload=pay,
+                                      payload_len=plen),
+                apply_stats=False,
+            ).result()
+            n_disp += 1
+            if n_disp == 40:
+                # in-bucket hot swap mid-stream: same AcSpec buckets,
+                # only the device value operands flip
+                clf_on.set_payload_patterns(signature_patterns(
+                    np.random.default_rng(12), 64, plen=64,
+                ))
+            elif n_disp == 80:
+                clf_on.set_payload_mode("enforce")
+            elif n_disp == 100:
+                clf_on.set_payload_mode("shadow")
+            if n_disp >= 120:
+                break
+    grew = (
+        fn_t._cache_size() + fn_t4._cache_size() + fn_m._cache_size()
+    ) - cache0
+    allocs = clf_on.resident.steady_allocs()
+    if clf_on.payload.version != v0 + 1:
+        raise RuntimeError("payload-bench: hot swap did not land "
+                           "(pattern-set version unchanged)")
+    if grew or allocs:
+        raise RuntimeError(
+            f"payload hot-swap not zero-cost: {grew} recompile(s), "
+            f"{allocs} pool allocation(s) across {n_disp} warmed "
+            "dispatches spanning a pattern swap + two mode flips"
+        )
+    log(f"payload hot-swap steady state: {n_disp} fused dispatches "
+        "spanning an in-bucket pattern swap + shadow->enforce->shadow, "
+        "0 recompiles, 0 pool allocations")
+    emit("payload hot-swap recompiles + pool allocations per 120 warmed "
+         "dispatches (in-bucket swap + mode flips mid-stream)",
+         float(grew + allocs), "events", vs_baseline=0.0)
+    out["swap_steady"] = float(grew + allocs)
+
+    # -- enforce leg: mitigation lands, failsafe precedence holds -----------
+    enf = TpuClassifier(force_path="trie",
+                        flow_table=FlowConfig.make(entries=1 << 14),
+                        resident=True, payload=pats64, payload_plen=64,
+                        payload_mode="enforce")
+    enf.load_tables(tables)
+    fs_batch = testing.random_batch(np.random.default_rng(9), tables, bs)
+    fs_batch.proto[:] = 6
+    fs_ports = np.asarray([22, 6443, 2379, 2380, 10250, 10257, 10259],
+                          np.int32)
+    half = bs // 2
+    fs_batch.dst_port[:half] = fs_ports[np.arange(half) % len(fs_ports)]
+    fs_batch.dst_port[half:] = 33000 + np.arange(bs - half)
+    fs_batch.tcp_flags = np.full(bs, jaxpath.TCP_ACK, np.int32)
+    sig = pats64[0]
+    fs_pay = np.zeros((bs, 64), np.uint8)
+    fs_pay[:, 3:3 + len(sig)] = np.frombuffer(sig, np.uint8)
+    w, v4 = fs_batch.pack_wire_subset(np.arange(bs, dtype=np.int64))
+    o_enf = enf.classify_prepared(
+        enf.prepare_packed(w, v4, tcp_flags=fs_batch.tcp_flags,
+                           payload=fs_pay,
+                           payload_len=np.full(bs, 64, np.int32)),
+        apply_stats=False,
+    ).result()
+    ref = oracle.classify(tables, fs_batch)
+    fs_mask = failsafe_lane_mask_np(fs_batch.proto, fs_batch.dst_port)
+    if not np.array_equal(o_enf.results[fs_mask], ref.results[fs_mask]):
+        raise RuntimeError(
+            "payload-bench: enforce mode rewrote a failsafe-port cell "
+            "(the failsaferules precedence contract)"
+        )
+    open_lanes = ~fs_mask & ((ref.results & 0xFF) != _DENY)
+    denied = (o_enf.results & 0xFF) == _DENY
+    mitigated = float(denied[open_lanes].mean()) if open_lanes.any() else 0.0
+    enforced_total = int(
+        enf.payload.counter_values()["payload_enforced_total"]
+    )
+    if enforced_total <= 0:
+        raise RuntimeError("payload-bench: enforce mode rewrote nothing "
+                           "on signature-bearing lanes")
+    log(f"payload enforce: {mitigated:.3f} of open signature-bearing "
+        f"lanes denied ({enforced_total} rewrites); failsafe cells "
+        "bit-identical to the rule verdicts")
+    emit("enforce-mode payload mitigation (fraction of open "
+         "signature-bearing lanes denied)", mitigated, "ratio",
+         vs_baseline=0.0)
+    out["enforce_mitigation"] = mitigated
+    enf.close()
+    for c in (clf_on, clf_off):
+        c.close()
+    return out
+
+
+def payload_bench_main() -> int:
+    """``make payload-bench``: the payload matching tier standalone
+    (CPU smoke off TPU) with the regression gates — classify retention
+    with matching on >= INFW_PAYLOAD_RETENTION_MIN (default 0.9) at the
+    64-pattern / 64-byte rung, the hot-swap zero-recompile pin, and the
+    statecheck payload configs run FIRST and gate record publication
+    (the telemetry-bench discipline)."""
+    retention_min = float(
+        os.environ.get("INFW_PAYLOAD_RETENTION_MIN", "0.9")
+    )
+    from infw.analysis import statecheck
+
+    for cfg in ("payload", "payload-resident"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=8,
+                                    shrink_on_failure=False)
+        if not rep["ok"]:
+            log(f"payload-bench FAIL: statecheck {cfg} not green before "
+                f"record publication: {rep['failure']}")
+            return 1
+        log(f"payload-bench: statecheck {cfg} green "
+            f"({rep['ops']} ops, {rep['entries']} entries)")
+    on_tpu = jax.default_backend() == "tpu"
+    rec = bench_payload(np.random.default_rng(2025), on_tpu)
+    emit_compact_record()
+    problems = []
+    if not rec.get("retention", 0.0) >= retention_min:
+        problems.append(
+            f"retention {rec.get('retention', 0):.3f} < gate "
+            f"{retention_min}"
+        )
+    if rec.get("swap_steady", 1.0) != 0.0:
+        problems.append(
+            f"hot-swap steady state not zero-cost "
+            f"({rec.get('swap_steady')})"
+        )
+    if not rec.get("enforce_mitigation", 0.0) > 0.0:
+        problems.append("enforce mode mitigated nothing")
+    if problems:
+        for p in problems:
+            log(f"payload-bench FAIL: {p}")
+        return 1
+    log("payload-bench OK: " + ", ".join(
+        f"{k}={v:.3f}" for k, v in sorted(rec.items())
+    ))
+    return 0
+
+
 # --- on-device verdict latency ---------------------------------------------
 
 
@@ -4795,4 +5209,6 @@ if __name__ == "__main__":
         sys.exit(telemetry_bench_main())
     if "--mlscore-bench" in sys.argv:
         sys.exit(mlscore_bench_main())
+    if "--payload-bench" in sys.argv:
+        sys.exit(payload_bench_main())
     sys.exit(main())

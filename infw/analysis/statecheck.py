@@ -141,6 +141,22 @@ TELEMETRY_KINDS = ("sketch_traffic", "sketch_drain")
 #: so shrunk repros print unchanged.
 SCORE_KINDS = ("score_traffic", "score_drain")
 
+#: payload-matching ops (payload configs only, ISSUE-19):
+#: ``payload_traffic`` drives one seeded packet batch WITH payload-
+#: prefix columns (benign HTTP-ish prefixes + planted signatures,
+#: including occurrences deliberately crossing the prefix-truncation
+#: boundary) through the production classify dispatch — the device
+#: Aho-Corasick bitmaps the tier retains must stay bit-identical to
+#: the naive host substring oracle (cpu_ref.payload_match_ref), the
+#: surface the aclink injected-defect acceptance shrinks on, and on
+#: the fused paths the SERVED hit bits must equal the standalone
+#: kernel's bitmap.any (the fused-merge pin); ``payload_swap``
+#: hot-swaps a fresh seeded pattern set in-bucket (zero recompile),
+#: after which the SAME checks run against the new automaton.
+#: Batches reuse the flow_traffic substrate (flow_seed/count fields),
+#: so shrunk repros print unchanged.
+PAYLOAD_KINDS = ("payload_traffic", "payload_swap")
+
 #: explicit transaction-boundary record (txn-mode configs only): the
 #: driver buffers single-key ops and applies them as ONE folded
 #: transaction (infw.txn.fold_ops) at each boundary — checks run only
@@ -179,8 +195,10 @@ class EditOp:
     def describe(self) -> str:
         tag = f"@t{self.tenant}" if self.tenant else ""
         if self.kind in ("flow_traffic", "sketch_traffic",
-                         "score_traffic"):
+                         "score_traffic", "payload_traffic"):
             return f"{self.kind}(seed={self.flow_seed}, n={self.count})"
+        if self.kind == "payload_swap":
+            return f"payload_swap(seed={self.flow_seed})"
         if self.kind in ("flow_age", "sketch_drain", "score_drain"):
             return self.kind
         if self.kind in ("full_replace", TXN_FLUSH):
@@ -334,6 +352,16 @@ class StateConfig:
     #: plain-oracle classify equivalence would (rightly) flag — enforce
     #: correctness is covered by tests/test_mlscore.py + bench_mlscore.
     mlscore: int = 0
+    #: > 0 = payload matching tier enabled with this many seeded
+    #: signature patterns (ISSUE-19): the op alphabet extends with
+    #: PAYLOAD_KINDS, the classifier runs the Aho-Corasick tier with
+    #: mask tracking on, and every settled check adds the device-
+    #: bitmap-vs-naive-host-oracle bit-identity pass plus the served-
+    #: hit-vs-standalone-kernel cross-check.  Shadow mode only: enforce
+    #: rewrites verdicts, which the plain-oracle classify equivalence
+    #: would (rightly) flag — enforce correctness is covered by
+    #: tests/test_payload.py + bench_payload.
+    payload: int = 0
 
 
 CONFIGS: Dict[str, StateConfig] = {
@@ -483,6 +511,26 @@ CONFIGS: Dict[str, StateConfig] = {
         # chained through the one-program dispatch) — a fused-path
         # scoring drift diverges here
         StateConfig("mlscore-resident", mlscore=64, flow=4096,
+                    resident=True, witness_b=160),
+        # payload Aho-Corasick matching tier (ISSUE-19): the PAYLOAD_
+        # KINDS alphabet over the edit state machine — every device
+        # match bitmap the production dispatch retains (the tier runs
+        # with mask tracking) must stay bit-identical to the NAIVE host
+        # substring oracle (cpu_ref.payload_match_ref — deliberately
+        # not the constructed automaton, so a construction bug like the
+        # aclink injected defect diverges), across traffic, overlapping
+        # patterns, prefix-truncation straddles and in-bucket hot
+        # swaps.  The aclink acceptance (infw_lint state
+        # --inject-defect aclink) runs this config under the dropped-
+        # failure-link-fold bug.
+        StateConfig("payload", payload=12, steered=True, witness_b=160),
+        # the same alphabet with the tier riding the resident fused
+        # step (match + verdict merge fused into the donated one-
+        # program dispatch) — the retained SERVED hit bits come from
+        # the fused program while the retained bitmap comes from a
+        # standalone launch over the same operands, so the
+        # bitmap.any == hit cross-check pins the fused merge
+        StateConfig("payload-resident", payload=12, flow=4096,
                     resident=True, witness_b=160),
     )
 }
@@ -640,6 +688,28 @@ def generate_ops(
                 continue
             if r < 0.45:
                 ops.append(EditOp(kind="score_drain"))
+                continue
+        if config.payload:
+            r = rng.random()
+            if r < 0.35:
+                # repeated seeds replay byte-identical payload columns
+                # (benign prefixes + planted signatures, truncation
+                # straddles included) — the substrate the aclink
+                # acceptance shrinks on
+                ops.append(EditOp(
+                    kind="payload_traffic",
+                    flow_seed=int(rng.integers(1, 4)),
+                    count=64,
+                ))
+                continue
+            if r < 0.42:
+                # in-bucket hot swap: a fresh seeded pattern set of the
+                # same size, so the automaton value operands flip under
+                # the SAME compiled program
+                ops.append(EditOp(
+                    kind="payload_swap",
+                    flow_seed=int(rng.integers(1, 64)),
+                ))
                 continue
         kind = str(rng.choice(kinds, p=probs))
         if kind in ("rules_edit", "order_change", "key_delete") and not keys:
@@ -1250,6 +1320,26 @@ class _Driver:
             flow_kw["mlscore"] = spec
             flow_kw["mlscore_model"] = clamp_stress_model(spec)
             flow_kw["mlscore_track_model"] = True
+        if config.payload:
+            from ..payload import signature_patterns
+
+            if backend == "mesh":
+                raise ValueError(
+                    "payload configs are single-chip here (the driver "
+                    "drives the single-chip fused dispatch)"
+                )
+            # seeded signature set (overlapping suffixes on purpose —
+            # the failure-link surface the aclink acceptance corrupts);
+            # shadow mode only (see the StateConfig.payload note), mask
+            # tracking on so every admission's device bitmap is
+            # retained for the settled checks' oracle compare
+            flow_kw["payload"] = signature_patterns(
+                np.random.default_rng([_WITNESS_SALT, seed, 0x9A]),
+                config.payload, plen=64,
+            )
+            flow_kw["payload_mode"] = "shadow"
+            flow_kw["payload_plen"] = 64
+            flow_kw["payload_track"] = True
         if backend == "mesh":
             from ..backend.mesh import MeshTpuClassifier
 
@@ -1275,7 +1365,8 @@ class _Driver:
         self._flow_base = (
             compile_tables_from_content(
                 dict(base_content), rule_width=config.width
-            ) if (config.flow or config.telemetry or config.mlscore)
+            ) if (config.flow or config.telemetry or config.mlscore
+                  or config.payload)
             else None
         )
         self._flow_failure: Optional[Failure] = None
@@ -1359,6 +1450,9 @@ class _Driver:
         if op.kind in SCORE_KINDS:
             self._apply_mlscore(op)
             return True
+        if op.kind in PAYLOAD_KINDS:
+            self._apply_payload(op)
+            return True
         if self.config.txn:
             if op.kind == TXN_FLUSH:
                 self.flush_pending()
@@ -1381,6 +1475,7 @@ class _Driver:
             or op.kind in FLOW_KINDS
             or op.kind in TELEMETRY_KINDS
             or op.kind in SCORE_KINDS
+            or op.kind in PAYLOAD_KINDS
         ):
             return
         if op.kind == "overlay_spill":
@@ -1672,6 +1767,112 @@ class _Driver:
         batch = self._flow_batch(op)
         self._classify(batch)
 
+    def _apply_payload(self, op: EditOp) -> None:
+        """Drive the production payload tier: payload_traffic classifies
+        its seeded batch WITH payload-prefix columns through the
+        production dispatch (match + verdict merge fused in-program on
+        the resident config, one follow-on launch otherwise) and checks
+        the verdicts against the CPU oracle (shadow mode: payload
+        matches must NOT change them); payload_swap hot-swaps a fresh
+        seeded pattern set in-bucket through the production swap path."""
+        from .. import oracle
+        from ..payload import attack_payloads, benign_payloads
+
+        tier = getattr(self.clf, "payload", None)
+        if tier is None or self._flow_failure is not None:
+            return
+        if op.kind == "payload_swap":
+            from ..payload import signature_patterns
+
+            pats = signature_patterns(
+                np.random.default_rng(
+                    [_WITNESS_SALT, self.seed, 0x9B, op.flow_seed]
+                ),
+                self.config.payload, plen=int(tier.spec.plen),
+            )
+            spec0 = tier.spec
+            self.clf.set_payload_patterns(pats)
+            if tier.spec != spec0:
+                self._flow_failure = Failure(
+                    -1, "payload-swap",
+                    f"in-bucket pattern swap changed the automaton "
+                    f"geometry {spec0} -> {tier.spec}",
+                )
+            return
+        batch = self._flow_batch(op)
+        rng = np.random.default_rng(
+            [_WITNESS_SALT, self.seed, 0x9C, op.flow_seed]
+        )
+        plen = int(tier.spec.plen)
+        n = len(batch)
+        k = n // 2
+        pay_a, len_a = attack_payloads(
+            rng, k, list(tier.model.patterns), plen=plen
+        )
+        pay_b, len_b = benign_payloads(rng, n - k, plen=plen)
+        batch.payload = np.concatenate([pay_a, pay_b])
+        batch.payload_len = np.concatenate([len_a, len_b])
+        merged = {key: r for (key, r) in self.model.values()}
+        model = compile_tables_from_content(
+            merged, rule_width=self.config.width
+        )
+        ref = oracle.classify(model, batch)
+        out = self.clf.classify(batch, apply_stats=False)
+        if not np.array_equal(out.results, ref.results):
+            bad = np.nonzero(out.results != ref.results)[0]
+            i = int(bad[0])
+            self._flow_failure = Failure(
+                -1, "payload-classify",
+                f"{len(bad)}/{n} payload_traffic verdict(s) diverge "
+                f"from the CPU oracle in SHADOW mode (seed "
+                f"{op.flow_seed}) — shadow matches must not rewrite",
+                f"first at packet {i}: got {int(out.results[i]):#x}, "
+                f"oracle {int(ref.results[i]):#x}",
+            )
+
+    def _check_payload(self, step: int) -> Optional[Failure]:
+        """Every retained admission's device match bitmap vs the NAIVE
+        host substring oracle (payload_match_ref — deliberately
+        independent of the constructed automaton, so a construction bug
+        like the aclink injected defect diverges here), plus the
+        served-hit-vs-standalone-kernel cross-check that pins the fused
+        merge on the resident config."""
+        tier = getattr(self.clf, "payload", None)
+        if tier is None or not tier.tracking:
+            return None
+        from ..backend.cpu_ref import payload_match_ref
+
+        spec = tier.spec
+        pats = list(tier.model.patterns)
+        for i, (pay, plen, bitmap, hit) in enumerate(tier.recent_masks()):
+            want = payload_match_ref(
+                pats, pay, plen, spec.plen, spec.pwords
+            )
+            if not np.array_equal(np.asarray(bitmap, np.uint32), want):
+                bad = np.nonzero(bitmap != want)
+                r, c = int(bad[0][0]), int(bad[1][0])
+                return Failure(
+                    step, "payload-bitmap",
+                    f"device Aho-Corasick bitmap diverged from the "
+                    f"naive host oracle on retained admission {i} "
+                    f"({len(bad[0])} word(s))",
+                    f"first at packet {r} word {c}: device "
+                    f"{int(bitmap[r, c]):#x}, oracle {int(want[r, c]):#x}",
+                )
+            served = np.asarray(hit, bool)
+            derived = (np.asarray(bitmap) != 0).any(axis=1)
+            if not np.array_equal(served, derived):
+                bad = np.nonzero(served != derived)[0]
+                return Failure(
+                    step, "payload-hit",
+                    f"SERVED matched-lane bits diverge from the "
+                    f"standalone kernel's bitmap on retained admission "
+                    f"{i} ({len(bad)} lane(s)) — the fused merge and "
+                    f"the standalone launch disagree",
+                    f"first at packet {int(bad[0])}",
+                )
+        return None
+
     def _check_mlscore(self, step: int) -> Optional[Failure]:
         """Device scoring tensors vs the shadow HostScoreModel, bit for
         bit — every feature-table / count-min / tstat scatter and every
@@ -1919,7 +2120,10 @@ class _Driver:
         f = self._check_telemetry(step)
         if f is not None:
             return f
-        return self._check_mlscore(step)
+        f = self._check_mlscore(step)
+        if f is not None:
+            return f
+        return self._check_payload(step)
 
 
 def run_ops(

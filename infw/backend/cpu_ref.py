@@ -177,3 +177,85 @@ class CpuRefClassifier:
             self._packed = None
             self._tables = None
             self._closed = True
+
+
+# -- payload-tier host oracle (ISSUE-19) -------------------------------------
+#
+# The Aho-Corasick reference the statecheck `payload` config compares
+# against.  Deliberately CONSTRUCTION-INDEPENDENT: a naive
+# find-every-occurrence substring scan over each truncated prefix, so
+# an automaton-construction bug (the aclink injected defect drops one
+# failure-link fold) cannot be shared by both sides of the compare.
+
+
+def payload_match_ref(patterns, pay, plen, prefix_len, pwords):
+    """Naive multi-pattern reference -> (B, pwords) uint32 bitmaps.
+
+    ``patterns`` is a sequence of byte strings (pattern j -> bit j),
+    ``pay`` (B, L) uint8 payload-prefix columns, ``plen`` (B,) valid
+    byte counts, ``prefix_len`` the matched prefix length.  Truncation
+    semantics: pattern j is claimed for packet i iff an occurrence ends
+    wholly within the first ``min(plen[i], prefix_len)`` bytes —
+    occurrences crossing the truncation boundary claim nothing.
+    """
+    pay = np.asarray(pay, np.uint8)
+    plen = np.asarray(plen).astype(np.int64)
+    b = pay.shape[0]
+    out = np.zeros((b, int(pwords)), np.uint32)
+    pats = [bytes(p) for p in patterns]
+    for i in range(b):
+        n = int(min(plen[i], prefix_len, pay.shape[1]))
+        hay = pay[i, :n].tobytes()
+        for j, p in enumerate(pats):
+            if p in hay:
+                out[i, j // 32] |= np.uint32(1 << (j % 32))
+    return out
+
+
+class HostAcAutomaton:
+    """A tiny, independent host Aho-Corasick (goto + failure links
+    walked AT MATCH TIME, no folding) — the second reference
+    implementation tests use to pin the naive scan and the compiled
+    DFA against each other from a third angle."""
+
+    def __init__(self, patterns):
+        self.patterns = [bytes(p) for p in patterns]
+        self.goto = [{}]
+        self.out = [set()]
+        for j, p in enumerate(self.patterns):
+            s = 0
+            for ch in p:
+                if ch not in self.goto[s]:
+                    self.goto.append({})
+                    self.out.append(set())
+                    self.goto[s][ch] = len(self.goto) - 1
+                s = self.goto[s][ch]
+            self.out[s].add(j)
+        from collections import deque
+
+        self.fail = [0] * len(self.goto)
+        q = deque(self.goto[0].values())
+        while q:
+            s = q.popleft()
+            for ch, t in self.goto[s].items():
+                f = self.fail[s]
+                while f and ch not in self.goto[f]:
+                    f = self.fail[f]
+                cand = self.goto[f].get(ch, 0)
+                self.fail[t] = cand if cand != t else 0
+                q.append(t)
+
+    def matches(self, data: bytes):
+        """Set of pattern indices with an occurrence ending in data."""
+        found = set()
+        s = 0
+        for ch in data:
+            while s and ch not in self.goto[s]:
+                s = self.fail[s]
+            s = self.goto[s].get(ch, 0)
+            f = s
+            while f:
+                found |= self.out[f]
+                f = self.fail[f]
+            found |= self.out[s]
+        return found

@@ -71,6 +71,10 @@ class TpuClassifier:
         mlscore_model=None,
         mlscore_mode: Optional[str] = None,
         mlscore_track_model: bool = False,
+        payload=None,
+        payload_mode: Optional[str] = None,
+        payload_plen: Optional[int] = None,
+        payload_track: bool = False,
     ) -> None:
         self._device = device if device is not None else jax.devices()[0]
         self._dense_limit = dense_limit
@@ -240,6 +244,70 @@ class TpuClassifier:
             # entries caching pre-swap (possibly enforced) verdicts go
             # stale through the same generation stamps
             self._mlscore.on_swap = self._on_score_model_swap
+        # Payload-matching tier (ISSUE-19, --payload-patterns /
+        # INFW_PAYLOAD): batched Aho-Corasick multi-pattern matching
+        # over the optional ring-sliced payload-prefix column, fused
+        # into the resident admission program as the fourth
+        # verdict-merge tier or launched once per admission on the
+        # multi-dispatch path.  The automaton is STATELESS on device
+        # (value operands only, nothing donated), so engaging it never
+        # disturbs the resident donation aliasing.  Precedence mirrors
+        # the other knobs: constructor arg (PayloadTier / AcModel /
+        # pattern list / artifact path / pattern count) > INFW_PAYLOAD
+        # env (artifact path or seeded-set count) > off; the mode knob
+        # reads INFW_PAYLOAD_MODE when unset (default shadow).
+        if payload is None:
+            env = os.environ.get("INFW_PAYLOAD", "")
+            if env and env not in ("0", "false", "no"):
+                payload = env
+        if payload_mode is None:
+            payload_mode = os.environ.get("INFW_PAYLOAD_MODE") or "shadow"
+        self._payload = None
+        if payload is not None and payload is not False:
+            from ..kernels.acmatch import AcModel
+            from ..payload import (
+                PayloadTier, load_patterns, signature_patterns,
+            )
+
+            plen = int(payload_plen or 64)
+            if isinstance(payload, PayloadTier):
+                tier = payload
+            elif isinstance(payload, AcModel):
+                tier = PayloadTier(
+                    payload, mode=payload_mode, device=self._device
+                )
+            elif isinstance(payload, (list, tuple)):
+                tier = PayloadTier(
+                    payload, plen=plen, mode=payload_mode,
+                    device=self._device,
+                )
+            elif isinstance(payload, str) and payload not in (
+                "1", "true", "yes"
+            ) and not payload.isdigit():
+                pats, spec, _ver = load_patterns(payload)
+                tier = PayloadTier(
+                    pats, plen=spec.plen, mode=payload_mode, spec=spec,
+                    device=self._device,
+                )
+            else:
+                count = (
+                    64 if payload is True
+                    or payload in ("1", "true", "yes")
+                    else int(payload)
+                )
+                tier = PayloadTier(
+                    signature_patterns(
+                        np.random.default_rng(0), count, plen=plen
+                    ),
+                    plen=plen, mode=payload_mode, device=self._device,
+                )
+            self._payload = tier
+            if payload_track:
+                self._payload.set_keep_masks(256)
+            # a pattern-set swap behaves like a rule patch: flow
+            # entries caching pre-swap (possibly enforced) verdicts go
+            # stale through the same generation stamps
+            self._payload.on_swap = self._on_pattern_swap
         self._stats = StatsAccumulator()
         # per-format H2D accounting {fmt: [packets, payload bytes]} — the
         # bench reads this to put bytes/packet in the replay record
@@ -724,6 +792,14 @@ class TpuClassifier:
         v4_only = not bool((kind == KIND_IPV6).any())
         compact = v4_only and not bool(np.asarray(batch.ip_words)[:, 1:].any())
         wire_np = batch.pack_wire_v4() if compact else batch.pack_wire()
+        pay_np = plen_np = None
+        if self._payload is not None and batch.payload is not None:
+            pay_np = np.asarray(batch.payload)
+            plen_np = (
+                np.asarray(batch.payload_len, np.int32)
+                if batch.payload_len is not None
+                else np.full(pay_np.shape[0], pay_np.shape[1], np.int32)
+            )
         if self._flow is not None:
             # flow tier first: the probe serves established flows and
             # only misses fall through to the stateless dispatch
@@ -731,13 +807,25 @@ class TpuClassifier:
                 self.prepare_packed(
                     wire_np, v4_only,
                     tcp_flags=getattr(batch, "tcp_flags", None),
+                    payload=pay_np, payload_len=plen_np,
                 ),
                 apply_stats=apply_stats,
             )
-        return self._dispatch_wire(
+        pending = self._dispatch_wire(
             path, dev, block_b, wire_np, v4_only, kind, apply_stats,
             ov_dev=ov_dev,
         )
+        if pay_np is None:
+            return pending
+
+        def materialize() -> ClassifyOutput:
+            # one follow-on payload-match launch per admission (the
+            # multi-dispatch form of the fused fourth tier)
+            return self._apply_payload_wire(
+                pending.result(), pay_np, plen_np, wire_np, apply_stats,
+            )
+
+        return PendingClassify(materialize)
 
     def supports_packed(self) -> bool:
         """True when classify_async_packed can take this table generation
@@ -790,6 +878,8 @@ class TpuClassifier:
     def classify_async_packed(
         self, wire_np: np.ndarray, v4_only: bool, apply_stats: bool = True,
         depth=None, tcp_flags: Optional[np.ndarray] = None,
+        payload: Optional[np.ndarray] = None,
+        payload_len: Optional[np.ndarray] = None,
     ) -> PendingClassify:
         # ``depth`` is the (class, generation) pair from v6_depth_groups;
         # a generation mismatch (table swapped since grouping) falls back
@@ -801,12 +891,15 @@ class TpuClassifier:
         wire w0 for the host-side XDP rebuild."""
         return self.classify_prepared(
             self.prepare_packed(wire_np, v4_only, depth=depth,
-                                tcp_flags=tcp_flags),
+                                tcp_flags=tcp_flags, payload=payload,
+                                payload_len=payload_len),
             apply_stats=apply_stats,
         )
 
     def prepare_packed(self, wire_np: np.ndarray, v4_only: bool, depth=None,
-                       tcp_flags: Optional[np.ndarray] = None):
+                       tcp_flags: Optional[np.ndarray] = None,
+                       payload: Optional[np.ndarray] = None,
+                       payload_len: Optional[np.ndarray] = None):
         """First half of classify_async_packed: choose the wire format
         (delta / wire8 / narrow / full per the codec knob and chunk
         eligibility) and START the H2D copy of the chosen payload,
@@ -817,7 +910,9 @@ class TpuClassifier:
         time — in-flight plans finish on the tables they were staged
         against (the double-buffer swap contract)."""
         if self._resident is not None and self._flow is not None:
-            plan = self._plan_resident(wire_np, v4_only, depth, tcp_flags)
+            plan = self._plan_resident(wire_np, v4_only, depth, tcp_flags,
+                                       payload=payload,
+                                       payload_len=payload_len)
             if plan is not None:
                 return plan
         flow_probe = None
@@ -896,6 +991,19 @@ class TpuClassifier:
             # fused path; the miss sub-dispatch never double-scores
             plan["ml_wire"] = wire_np
             plan["ml_flags"] = tcp_flags
+        if self._payload is not None and payload is not None:
+            # multi-dispatch payload matching (ISSUE-19): one follow-on
+            # AC-match launch per admission — on flow plans it runs
+            # INSIDE _launch_flow, between the verdict merge and the
+            # miss insert, so the flow table caches the ENFORCED
+            # verdicts exactly like the fused path
+            plan["pay_np"] = np.asarray(payload)
+            plan["plen_np"] = (
+                np.asarray(payload_len, np.int32)
+                if payload_len is not None
+                else np.full(payload.shape[0], payload.shape[1], np.int32)
+            )
+            plan["pay_wire"] = wire_np
         return plan
 
     def classify_prepared(self, plan, apply_stats: bool = True) -> PendingClassify:
@@ -915,8 +1023,12 @@ class TpuClassifier:
         ml = self._mlscore
         tel = self._telemetry
         run_ml = ml is not None and not ml_done and "ml_wire" in plan
+        run_pay = (
+            self._payload is not None and "pay_np" in plan
+            and not plan.get("flow")
+        )
         run_tel = tel is not None and "telem_wire" in plan
-        if not run_ml and not run_tel:
+        if not run_ml and not run_pay and not run_tel:
             return pending
 
         def materialize() -> ClassifyOutput:
@@ -928,6 +1040,15 @@ class TpuClassifier:
                 # re-derive host-side — the wire8 contract)
                 out = self._apply_mlscore_wire(
                     out, plan["ml_wire"], plan["ml_flags"], apply_stats,
+                )
+            if run_pay:
+                # one follow-on AC-match launch over the (possibly
+                # score-rewritten) verdicts — same ordering as the
+                # fused step: score, then payload, then telemetry
+                # counts what was served
+                out = self._apply_payload_wire(
+                    out, plan["pay_np"], plan["plen_np"],
+                    plan["pay_wire"], apply_stats,
                 )
             if run_tel:
                 # one follow-on telemetry program per admission: wire +
@@ -964,9 +1085,58 @@ class TpuClassifier:
             results=results, xdp=xdp, stats_delta=stats_delta
         )
 
+    def _apply_payload_wire(self, out: ClassifyOutput, pay_np, plen_np,
+                            wire_np, apply_stats: bool) -> ClassifyOutput:
+        """Payload-match one flow-less wire admission (the follow-on
+        launch) and apply the enforce-mode rewrite host-side when it
+        changed anything.  Counters accrue inside the tier."""
+        from ..daemon import stats_from_results  # lazy: no import cycle
+        from ..flow import host_unpack_wire
+
+        f = host_unpack_wire(wire_np)
+        res16 = (out.results & 0xFFFF).astype(np.uint16)
+        new16, _hit = self._payload.apply_wire(
+            res16, pay_np, plen_np, f["proto"], f["dst_port"],
+        )
+        new16 = np.asarray(new16, np.uint16)
+        if np.array_equal(new16, res16):
+            return out
+        results, xdp = jaxpath.host_finalize_wire(new16, f["kind"])
+        stats_delta = stats_from_results(
+            results, f["pkt_len"].astype(np.int64)
+        )
+        if apply_stats:
+            # the device-side stats already applied inside the launch:
+            # swap them for the post-policy derivation
+            self._stats.add(stats_delta - out.stats_delta)
+        return ClassifyOutput(
+            results=results, xdp=xdp, stats_delta=stats_delta
+        )
+
     # -- resident serving loop (ISSUE-12) ------------------------------------
 
-    def _plan_resident(self, wire_np, v4_only, depth, tcp_flags):
+    @staticmethod
+    def _clamp_payload(pay, plen, cap):
+        """Fix a payload column to the tier's prefix cap: (…, L) uint8
+        zero-padded/truncated to (…, cap), lengths clipped to cap (the
+        prefix-truncation contract: only occurrences ending wholly
+        within min(len, cap) count)."""
+        pay = np.ascontiguousarray(pay, np.uint8)
+        w = pay.shape[-1]
+        if plen is None:
+            plen = np.full(pay.shape[:-1], w, np.int32)
+        if w != cap:
+            fixed = np.zeros(pay.shape[:-1] + (cap,), np.uint8)
+            k = min(cap, w)
+            fixed[..., :k] = pay[..., :k]
+            pay = fixed
+        plen = np.minimum(
+            np.ascontiguousarray(plen, np.int32), np.int32(cap)
+        )
+        return pay, plen
+
+    def _plan_resident(self, wire_np, v4_only, depth, tcp_flags,
+                       payload=None, payload_len=None):
         """Plan + DISPATCH one admission through the resident fused
         step (jaxpath.jitted_resident_step): unlike the multi-dispatch
         plan there is no separate launch half — the whole admission is
@@ -997,22 +1167,42 @@ class TpuClassifier:
         kind = (wire_np[:, 0] & 3).astype(np.int32)
         tel = self._telemetry
         ml = self._mlscore
+        pt = self._payload
+        use_pay = pt is not None and payload is not None
+        pay_np = plen_np = None
+        if use_pay:
+            pay_np, plen_np = self._clamp_payload(
+                payload, payload_len, pt.spec.plen
+            )
         fn = jaxpath.jitted_resident_step(
             tier.config.entries, tier.config.ways, ctx.path,
             bool(v4_only) and ctx.path == "trie", d, ctx.d_max,
             ctx.ov_dev is not None,
             sketch=tel.spec if tel is not None else None,
             score=ml.spec if ml is not None else None,
+            payload=pt.spec if use_pay else None,
         )
         tables_args = (
             (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
             else (ctx.tdev,)
         )
         wire_dev = pool.stage_wire(self, wire_np)
+        payload_ops = payload_dev = None
+        if use_pay:
+            # automaton value operands + this admission's payload
+            # column: the pattern tensors are persistent device values
+            # (swapped whole on a pattern hot-swap, never recompiled),
+            # the pay/plen pair rides the wire tail
+            payload_ops = pt.device_ops()
+            payload_dev = (
+                jax.device_put(pay_np, self._device),
+                jax.device_put(plen_np, self._device),
+            )
         fused, epoch = tier.resident_dispatch(
             fn, tables_args, wire_dev, n, wire_np=wire_np,
             tflags_np=tcp_flags, gens_snap=gens_snap,
             alloc_note=pool.note_alloc, telemetry=tel, mlscore=ml,
+            payload_ops=payload_ops, payload_dev=payload_dev,
         )
         pool.note("dispatches")
         pool.note(f"slot{(epoch - 1) & 1}_dispatches")
@@ -1021,12 +1211,19 @@ class TpuClassifier:
         except (AttributeError, RuntimeError):
             pass
         self._note_wire(f"wire{wire_np.shape[1]}", n, wire_np.nbytes)
+        if use_pay:
+            self._note_wire(
+                "payload", n, pay_np.nbytes + plen_np.nbytes
+            )
         return {"resident": True, "fused": fused, "n": n, "kind": kind,
                 "epoch": epoch, "mlscore": ml is not None,
+                "payload": use_pay, "pay_np": pay_np, "plen_np": plen_np,
                 "pkt_len": self._wire4_pkt_len(wire_np)}
 
     def prepare_packed_super(self, wire_stack: np.ndarray, v4_only: bool,
-                             tcp_flags_stack: Optional[np.ndarray] = None):
+                             tcp_flags_stack: Optional[np.ndarray] = None,
+                             payload_stack: Optional[np.ndarray] = None,
+                             payload_len_stack: Optional[np.ndarray] = None):
         """Plan + DISPATCH ``k`` stacked same-shape admissions through
         the superbatch device epoch program (ISSUE-16,
         jaxpath.jitted_resident_superbatch): flow probe/insert, sketch
@@ -1056,12 +1253,20 @@ class TpuClassifier:
         k, n, w = wire_stack.shape
         tel = self._telemetry
         ml = self._mlscore
+        pt = self._payload
+        use_pay = pt is not None and payload_stack is not None
+        pay_np = plen_np = None
+        if use_pay:
+            pay_np, plen_np = self._clamp_payload(
+                payload_stack, payload_len_stack, pt.spec.plen
+            )
         fn = jaxpath.jitted_resident_superbatch(
             tier.config.entries, tier.config.ways, ctx.path,
             bool(v4_only) and ctx.path == "trie", None, ctx.d_max,
             ctx.ov_dev is not None,
             sketch=tel.spec if tel is not None else None,
             score=ml.spec if ml is not None else None,
+            payload=pt.spec if use_pay else None,
         )
         tables_args = (
             (ctx.tdev, ctx.ov_dev) if ctx.ov_dev is not None
@@ -1069,10 +1274,20 @@ class TpuClassifier:
         )
         wire_dev = pool.stage_wire(self, wire_stack.reshape(k * n, w))
         wire_dev = wire_dev.reshape(k, n, w)
+        payload_ops = payload_dev = None
+        if use_pay:
+            # the stacked (k, b, L) payload columns ride the scan xs
+            # next to the wire; automaton operands stay loop-invariant
+            payload_ops = pt.device_ops()
+            payload_dev = (
+                jax.device_put(pay_np, self._device),
+                jax.device_put(plen_np, self._device),
+            )
         fused, epoch = tier.resident_dispatch_super(
             fn, tables_args, wire_dev, k, n, wire_np=wire_stack,
             tflags_np=tcp_flags_stack, gens_snap=gens_snap,
             alloc_note=pool.note_alloc, telemetry=tel, mlscore=ml,
+            payload_ops=payload_ops, payload_dev=payload_dev,
         )
         pool.note("dispatches")
         pool.note("superbatch_dispatches")
@@ -1082,11 +1297,17 @@ class TpuClassifier:
         except (AttributeError, RuntimeError):
             pass
         self._note_wire(f"wire{w}", k * n, wire_stack.nbytes)
+        if use_pay:
+            self._note_wire(
+                "payload", k * n, pay_np.nbytes + plen_np.nbytes
+            )
         kinds = (wire_stack[:, :, 0] & 3).astype(np.int32)
         pkt_lens = [self._wire4_pkt_len(wire_stack[j]) for j in range(k)]
         return {"resident_super": True, "fused": fused, "k": k, "n": n,
                 "kinds": kinds, "epoch0": epoch - k,
-                "mlscore": ml is not None, "pkt_lens": pkt_lens}
+                "mlscore": ml is not None, "payload": use_pay,
+                "pay_np": pay_np, "plen_np": plen_np,
+                "pkt_lens": pkt_lens}
 
     def classify_prepared_super(self, plan, apply_stats: bool = True):
         """Materialize half of a superbatch plan: ONE pending per
@@ -1106,8 +1327,19 @@ class TpuClassifier:
                 from ..daemon import stats_from_results  # lazy: no cycle
 
                 row = jaxpath.resident_fused_host((plan["fused"], j))
-                anom = scores = None
-                if plan.get("mlscore"):
+                anom = scores = pay_hit = pay_rw = None
+                if plan.get("payload"):
+                    parts = jaxpath.split_resident_payload_outputs(
+                        row, n, score=bool(plan.get("mlscore"))
+                    )
+                    pay_hit, pay_rw = parts[-2], parts[-1]
+                    parts = parts[:-2]
+                    if plan.get("mlscore"):
+                        (res16, _hit, hits, stale, counts, anom,
+                         scores) = parts
+                    else:
+                        res16, _hit, hits, stale, counts = parts
+                elif plan.get("mlscore"):
                     res16, _hit, hits, stale, counts, anom, scores = (
                         jaxpath.split_resident_score_outputs(row, n)
                     )
@@ -1127,6 +1359,10 @@ class TpuClassifier:
                 if anom is not None and self._mlscore is not None:
                     self._mlscore.resident_note_materialized(
                         epoch, anom_np=anom, score_np=scores,
+                    )
+                if pay_hit is not None and self._payload is not None:
+                    self._note_payload_resident(
+                        plan, pay_hit, pay_rw, row=j
                     )
                 if evictions and tier.on_evict is not None:
                     try:
@@ -1160,21 +1396,32 @@ class TpuClassifier:
         def materialize() -> ClassifyOutput:
             from ..daemon import stats_from_results  # lazy: no import cycle
 
-            anom = scores = None
-            if plan.get("mlscore"):
+            arr = np.asarray(plan["fused"])
+            anom = scores = pay_hit = pay_rw = None
+            if plan.get("payload"):
+                # payload extension of the fused readback: the last
+                # 2*ceil(n/32) words are the matched-lane + rewritten-
+                # lane bitmaps; res16 is the POLICY verdict vector
+                # (payload-rewritten in enforce mode)
+                parts = jaxpath.split_resident_payload_outputs(
+                    arr, n, score=bool(plan.get("mlscore"))
+                )
+                pay_hit, pay_rw = parts[-2], parts[-1]
+                parts = parts[:-2]
+                if plan.get("mlscore"):
+                    res16, _hit, hits, stale, counts, anom, scores = parts
+                else:
+                    res16, _hit, hits, stale, counts = parts
+            elif plan.get("mlscore"):
                 # scoring extension of the fused readback: res16 is
                 # the POLICY verdict vector (rewritten in enforce
                 # mode) — stats and XDP derive from what was served
                 res16, _hit, hits, stale, counts, anom, scores = (
-                    jaxpath.split_resident_score_outputs(
-                        np.asarray(plan["fused"]), n
-                    )
+                    jaxpath.split_resident_score_outputs(arr, n)
                 )
             else:
                 res16, _hit, hits, stale, counts = (
-                    jaxpath.split_resident_outputs(
-                        np.asarray(plan["fused"]), n
-                    )
+                    jaxpath.split_resident_outputs(arr, n)
                 )
             inserts, evictions, promotes = counts
             tier.stats.add(
@@ -1188,6 +1435,8 @@ class TpuClassifier:
                 self._mlscore.resident_note_materialized(
                     epoch, anom_np=anom, score_np=scores,
                 )
+            if pay_hit is not None and self._payload is not None:
+                self._note_payload_resident(plan, pay_hit, pay_rw)
             if evictions and tier.on_evict is not None:
                 try:
                     tier.on_evict(evictions, inserts, epoch)
@@ -1202,6 +1451,23 @@ class TpuClassifier:
             )
 
         return PendingClassify(materialize)
+
+    def _note_payload_resident(self, plan, pay_hit, pay_rw,
+                               row: Optional[int] = None) -> None:
+        """Fold one resident admission's payload outcome into the tier
+        counters.  The ~100 B fused readback carries only the packed
+        hit/rewrite bitmaps — when mask tracking is on (statecheck),
+        the full (B, PW) match bitmap re-derives through one standalone
+        launch over the SAME automaton operands."""
+        pt = self._payload
+        pay_np = plan["pay_np"]
+        plen_np = plan["plen_np"]
+        if row is not None:
+            pay_np, plen_np = pay_np[row], plen_np[row]
+        bitmap = None
+        if pt.tracking:
+            bitmap = pt.match(pay_np, plen_np)
+        pt.note(bitmap, pay_hit, pay_rw, pay_np=pay_np, plen_np=plen_np)
 
     @property
     def resident(self):
@@ -1266,6 +1532,44 @@ class TpuClassifier:
         the SAME generation stamps every table edit uses — in enforce
         mode the flow table caches enforced verdicts, and a swapped
         model must not keep serving the old model's denies."""
+        if self._flow is not None:
+            self._flow.bump_generation()
+
+    @property
+    def payload(self):
+        """The PayloadTier when the payload-matching tier is enabled."""
+        return self._payload
+
+    def payload_counters(self):
+        """payload_* counters/gauges for /metrics (empty when off)."""
+        return {} if self._payload is None else (
+            self._payload.counter_values()
+        )
+
+    def set_payload_patterns(self, patterns_or_model,
+                             plen: Optional[int] = None) -> None:
+        """Hot-swap the pattern set (must stay in the same AcSpec
+        geometry buckets -> new value operands, zero recompiles).  The
+        tier's on_swap hook then runs _on_pattern_swap: a pattern swap
+        behaves like a rule patch."""
+        if self._payload is None:
+            raise RuntimeError("payload tier is not enabled")
+        self._payload.swap_patterns(patterns_or_model, plen=plen)
+
+    def set_payload_mode(self, mode: str) -> None:
+        """Flip shadow/enforce — a (1,) device value swap, never a
+        recompile; flow-cached verdicts invalidate like a swap (a
+        pre-flip cached Deny must not outlive enforce mode)."""
+        if self._payload is None:
+            raise RuntimeError("payload tier is not enabled")
+        self._payload.set_mode(mode)
+        self._on_pattern_swap()
+
+    def _on_pattern_swap(self) -> None:
+        """Invalidate flow-cached verdicts after a pattern-set swap
+        through the SAME generation stamps every table edit uses — on
+        the flow paths the table caches payload-ENFORCED verdicts, and
+        a swapped set must not keep serving the old set's denies."""
         if self._flow is not None:
             self._flow.bump_generation()
 
@@ -1351,6 +1655,22 @@ class TpuClassifier:
                 new16, _anom, _scores = self._mlscore.update(
                     wire_np, res16.astype(np.uint32), tflags_np=tcp_flags,
                 )
+                if not np.array_equal(new16, res16):
+                    res16 = new16
+                    stats_delta = stats_from_results(
+                        res16.astype(np.uint32), pl
+                    )
+            if self._payload is not None and "pay_np" in plan:
+                # the payload-match launch ALSO rides between merge and
+                # insert (after scoring, same ordering as the fused
+                # step): the flow table must cache the payload-enforced
+                # verdicts — a matched flow stays denied from the cache
+                f = flow_mod.host_unpack_wire(wire_np)
+                new16, _pay_hit = self._payload.apply_wire(
+                    res16.astype(np.uint16), plan["pay_np"],
+                    plan["plen_np"], f["proto"], f["dst_port"],
+                )
+                new16 = np.asarray(new16, np.uint16)
                 if not np.array_equal(new16, res16):
                     res16 = new16
                     stats_delta = stats_from_results(

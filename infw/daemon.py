@@ -234,7 +234,10 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
                             resident: Optional[bool] = None,
                             telemetry=None,
                             mlscore=None,
-                            mlscore_mode: Optional[str] = None):
+                            mlscore_mode: Optional[str] = None,
+                            payload=None,
+                            payload_mode: Optional[str] = None,
+                            payload_plen: Optional[int] = None):
     """``fused_deep`` steers the TPU backend's fused Pallas deep-walk
     dispatch (kernels.pallas_walk) for full-depth v6 chunks; None keeps
     the backend default (on for real TPU hardware, off in interpret
@@ -268,6 +271,11 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             log.warning(
                 "--mlscore is a device-backend feature; the cpu "
                 "reference classifier serves unscored"
+            )
+        if payload is not None:
+            log.warning(
+                "--payload is a device-backend feature; the cpu "
+                "reference classifier serves headers-only"
             )
         return classifier_class("cpu")
     if backend == "tpu":
@@ -307,6 +315,18 @@ def make_classifier_factory(backend: str, fused_deep: Optional[bool] = None,
             kw["mlscore"] = spec
             kw["mlscore_model"] = model
             kw["mlscore_mode"] = mlscore_mode or "shadow"
+        if payload is not None:
+            # payload matching tier (infw.payload): the launch-validated
+            # pattern set (an AcModel / PayloadTier) rides into every
+            # classifier generation; the daemon runs the
+            # <state-dir>/patterns/ hot-swap scan on the idle loop
+            # (_payload_maintenance).  The automaton tensors replicate
+            # onto the mesh via the classifier's device sharding, so the
+            # tier serves on multi-chip nodes too.
+            kw["payload"] = payload
+            kw["payload_mode"] = payload_mode or "shadow"
+            if payload_plen is not None:
+                kw["payload_plen"] = payload_plen
         if mesh:
             from .backend.mesh import resolve_mesh_spec
 
@@ -443,6 +463,25 @@ class _MlScoreCounters:
             return {}
 
 
+class _PayloadCounters:
+    """payload_* counters + pattern-set version gauge as a /metrics
+    provider (same getter indirection: survives classifier reloads; no
+    payload tier renders nothing)."""
+
+    def __init__(self, clf_getter) -> None:
+        self._get = clf_getter
+
+    def counter_values(self):
+        clf = self._get()
+        pc = getattr(clf, "payload_counters", None)
+        if clf is None or pc is None:
+            return {}
+        try:
+            return pc()
+        except Exception:
+            return {}
+
+
 # --- daemon ------------------------------------------------------------------
 
 class Daemon:
@@ -485,6 +524,9 @@ class Daemon:
         trace_slow_us: float = 50_000.0,
         mlscore=None,
         mlscore_mode: Optional[str] = None,
+        payload=None,
+        payload_mode: Optional[str] = None,
+        payload_plen: Optional[int] = None,
         superbatch_k: Optional[int] = None,
     ) -> None:
         self.state_dir = state_dir
@@ -541,6 +583,22 @@ class Daemon:
         # rebuild can't silently revert to the launch-time model
         self._mlscore_swapped_model = None
         self.models_dir = os.path.join(state_dir, "models")
+        # Payload matching tier (--payload [PATTERNS] / INFW_PAYLOAD,
+        # ISSUE-19): batched Aho-Corasick multi-pattern matching over
+        # the ring-sliced payload-prefix column, fused into the serving
+        # dispatch with shadow/enforce mitigation; the daemon owns the
+        # payload_* counters on /metrics and the <state-dir>/patterns/
+        # hot-swap dir (versioned npz+manifest artifacts, consumed on
+        # the idle loop — an in-bucket swap recompiles nothing).
+        self.payload = payload  # patterns / AcModel / PayloadTier or None
+        self.payload_mode = payload_mode or "shadow"
+        self.payload_plen = payload_plen
+        self._payload_attached: set = set()
+        # last patterns-dir hot-swapped set (consumed from disk) —
+        # re-applied to rebuilt classifier generations so an escalation
+        # rebuild can't silently revert to the launch-time pattern set
+        self._payload_swapped = None
+        self.patterns_dir = os.path.join(state_dir, "patterns")
         # Serving-path tracing (--trace): per-stage span clocks through
         # the ingest/serving pipeline, exported as Prometheus histograms
         # on /metrics + sampled TraceSpanRecords for slow admissions.
@@ -570,9 +628,18 @@ class Daemon:
         if ring:
             from .ring import IngestRing
 
+            # a payload tier grows each slot by the prefix column
+            # (n * (L + 4) bytes) so producers can ship payload bytes
+            # through the same zero-copy cursor discipline
+            ring_pw = 0
+            if payload is not None and backend != "cpu":
+                from .kernels.wire_decode import PAYLOAD_PREFIX_WIDTHS
+
+                ring_pw = int(payload_plen or PAYLOAD_PREFIX_WIDTHS[0])
             self.ingest_ring = IngestRing.create(
                 ring, slots=max(8, 2 * self.pipeline_depth + 4),
                 slot_packets=max(self.max_tick_packets, 4096),
+                payload_width=ring_pw,
             )
         # Deadline-aware continuous microbatching (infw.scheduler): with
         # --deadline-us set, ingest jobs are sized by the LARGEST ladder
@@ -649,6 +716,8 @@ class Daemon:
             dirs.append(self.tenants_dir)
         if self.mlscore is not None:
             dirs.append(self.models_dir)
+        if self.payload is not None:
+            dirs.append(self.patterns_dir)
         for d in dirs:
             os.makedirs(d, exist_ok=True)
 
@@ -673,6 +742,9 @@ class Daemon:
                 telemetry=self.telemetry if backend != "cpu" else None,
                 mlscore=self.mlscore if backend != "cpu" else None,
                 mlscore_mode=self.mlscore_mode,
+                payload=self.payload if backend != "cpu" else None,
+                payload_mode=self.payload_mode,
+                payload_plen=self.payload_plen,
             ),
             registry=self.registry,
             stats_poller=self.stats,
@@ -772,6 +844,14 @@ class Daemon:
                 lambda: self.syncer.classifier
             )
             self.metrics_registry.register_counters(self._mlscore_counters)
+        if self.payload is not None and backend != "cpu":
+            # payload_* counters (admissions, scanned lanes, matches,
+            # enforced rewrites, pattern swaps) + the pattern-set
+            # version gauge — the matching tier's accounting
+            self._payload_counters = _PayloadCounters(
+                lambda: self.syncer.classifier
+            )
+            self.metrics_registry.register_counters(self._payload_counters)
         if self.tracer is not None:
             # span histograms (ingressnodefirewall_node_span_us) +
             # trace_* sample counters; slow-admission TraceSpanRecords
@@ -1608,6 +1688,8 @@ class Daemon:
                     plan = clf.prepare_packed(
                         chunk.wire, chunk.v4_only,
                         tcp_flags=chunk.tcp_flags,
+                        payload=chunk.payload,
+                        payload_len=chunk.payload_len,
                     )
                     if trace is not None:
                         trace.mark("h2d")
@@ -1661,7 +1743,12 @@ class Daemon:
                     if (nxt.wire.shape != chunk.wire.shape
                             or nxt.v4_only != chunk.v4_only
                             or (nxt.tcp_flags is None)
-                            != (chunk.tcp_flags is None)):
+                            != (chunk.tcp_flags is None)
+                            or (nxt.payload is None)
+                            != (chunk.payload is None)
+                            or (chunk.payload is not None
+                                and nxt.payload.shape
+                                != chunk.payload.shape)):
                         carry.append(nxt)
                         break
                     group.append(nxt)
@@ -1674,11 +1761,19 @@ class Daemon:
                     None if chunk.tcp_flags is None
                     else np.stack([c.tcp_flags for c in group])
                 )
+                pay_stack = plen_stack = None
+                if chunk.payload is not None:
+                    pay_stack = np.stack([c.payload for c in group])
+                    plen_stack = np.stack(
+                        [c.payload_len for c in group]
+                    )
                 plan = None
                 try:
                     plan = clf.prepare_packed_super(
                         wire_stack, chunk.v4_only,
                         tcp_flags_stack=flags_stack,
+                        payload_stack=pay_stack,
+                        payload_len_stack=plen_stack,
                     )
                     if plan is not None:
                         if trace is not None:
@@ -1848,6 +1943,10 @@ class Daemon:
                 self._mlscore_maintenance()
             except Exception as e:
                 log.error("mlscore maintenance error: %s", e)
+            try:
+                self._payload_maintenance()
+            except Exception as e:
+                log.error("payload maintenance error: %s", e)
 
     def _attach_flow_events(self, clf) -> None:
         """Wire a classifier's flow tier to the obs event ring (once
@@ -1997,6 +2096,67 @@ class Daemon:
                          fn, tier.model_version)
             except Exception as e:
                 log.error("mlscore: model artifact %s rejected: %s",
+                          fn, e)
+            for p in (path, path + ".json"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _payload_maintenance(self) -> None:
+        """Idle-loop payload-tier upkeep: re-apply the last hot-swapped
+        pattern set to any rebuilt classifier generation (a rules-edit
+        escalation rebuild constructs its tier from the factory's
+        launch-time set), then consume dropped pattern-set artifacts
+        from <state-dir>/patterns/ — each *.npz (+ required .json
+        manifest, infw.payload.save_patterns) hot-swaps through
+        set_payload_patterns.  An in-bucket swap recompiles nothing
+        (the zero-recompile discipline); a swap behaves like a rule
+        patch — the flow generation bumps so cached payload verdicts
+        can't serve stale.  Bad or mismatched artifacts are consumed
+        and logged, never retried forever (the edits-dir bad-file
+        discipline)."""
+        if self.payload is None:
+            return
+        clf = self.syncer.classifier
+        tier = getattr(clf, "payload", None)
+        if tier is None:
+            return
+        if id(tier) not in self._payload_attached:
+            self._payload_attached.add(id(tier))
+            swapped = getattr(self, "_payload_swapped", None)
+            if swapped is not None:
+                pats, plen, label = swapped
+                try:
+                    clf.set_payload_patterns(pats, plen=plen)
+                    log.info("payload: re-applied hot-swapped pattern "
+                             "set %s to new classifier generation",
+                             label)
+                except Exception as e:
+                    log.error("payload: re-apply of swapped pattern "
+                              "set failed: %s", e)
+        # pattern hot-swap dir: consume complete npz+manifest pairs
+        from .payload import load_patterns
+
+        try:
+            names = sorted(os.listdir(self.patterns_dir))
+        except OSError:
+            return
+        for fn in names:
+            if not fn.endswith(".npz"):
+                continue
+            path = os.path.join(self.patterns_dir, fn)
+            if not os.path.exists(path + ".json"):
+                continue  # manifest not landed yet — next tick
+            try:
+                pats, spec, label = load_patterns(path)
+                clf.set_payload_patterns(pats, plen=spec.plen)
+                self._payload_swapped = (pats, spec.plen, label)
+                log.info("payload: hot-swapped pattern set %s "
+                         "(version %s, %d patterns)", fn, label,
+                         len(pats))
+            except Exception as e:
+                log.error("payload: pattern artifact %s rejected: %s",
                           fn, e)
             for p in (path, path + ".json"):
                 try:
@@ -2276,6 +2436,39 @@ def main(argv: Optional[List[str]] = None) -> int:
              "never existing rule Denies.  CLI beats INFW_MLSCORE_MODE",
     )
     p.add_argument(
+        "--payload", nargs="?", const="default",
+        default=os.environ.get("INFW_PAYLOAD") or None,
+        help="payload matching tier (tpu backend): batched "
+             "Aho-Corasick multi-pattern matching over ring-sliced "
+             "payload prefixes, fused into the serving dispatch.  "
+             "Optional value = path to a versioned pattern-set "
+             "artifact (.npz + .json manifest, "
+             "infw.payload.save_patterns) or a pattern count for the "
+             "seeded built-in signature set; bare flag loads the "
+             "built-in set.  payload_* counters + the pattern-set "
+             "version gauge export on /metrics, and "
+             "<state-dir>/patterns/ hot-swaps artifacts live (an "
+             "in-bucket swap recompiles nothing; a swap behaves like "
+             "a rule patch).  CLI beats INFW_PAYLOAD",
+    )
+    p.add_argument(
+        "--payload-mode", choices=("shadow", "enforce"),
+        default=os.environ.get("INFW_PAYLOAD_MODE") or "shadow",
+        help="payload mitigation policy: shadow (default) matches and "
+             "counts only; enforce rewrites matched packets to Deny "
+             "(ruleId 0) — NEVER failsafe-port cells and never "
+             "existing rule Denies.  CLI beats INFW_PAYLOAD_MODE",
+    )
+    p.add_argument(
+        "--payload-plen", type=int,
+        default=int(os.environ.get("INFW_PAYLOAD_PLEN") or 0) or None,
+        help="payload prefix width in bytes (64 or 128): how much of "
+             "each packet's payload the ring slices and the automaton "
+             "scans (prefix-truncation semantics — patterns crossing "
+             "the boundary cannot match).  Default 64, or the "
+             "artifact's compiled width.  CLI beats INFW_PAYLOAD_PLEN",
+    )
+    p.add_argument(
         "--ring",
         default=os.environ.get("INFW_RING") or None,
         help="persistent pinned host ingest ring: path of a "
@@ -2414,6 +2607,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         # value like INFW_MLSCORE=0): enforce mode with no scoring tier
         # would silently serve unmitigated — fail the launch either way
         p.error("--mlscore-mode enforce requires --mlscore")
+    # Payload knobs: same launch-time validation posture — a bad
+    # pattern artifact, a bad prefix width or a cpu backend must fail
+    # the launch with a usage error, never inside the sync loop.
+    payload_patterns = None
+    payload_plen = None
+    if args.payload is not None and str(args.payload) not in (
+        "0", "", "false", "no"
+    ):
+        if args.backend == "cpu":
+            p.error("--payload requires the tpu backend (the cpu "
+                    "reference classifier has no payload plane)")
+        if args.payload_mode not in ("shadow", "enforce"):
+            p.error(f"invalid INFW_PAYLOAD_MODE {args.payload_mode!r} "
+                    "(expected shadow|enforce)")
+        from .kernels.wire_decode import PAYLOAD_PREFIX_WIDTHS
+
+        if args.payload_plen is not None:
+            if int(args.payload_plen) not in PAYLOAD_PREFIX_WIDTHS:
+                p.error(f"--payload-plen must be one of "
+                        f"{PAYLOAD_PREFIX_WIDTHS}, got "
+                        f"{args.payload_plen}")
+            payload_plen = int(args.payload_plen)
+        raw = str(args.payload)
+        try:
+            if raw in ("default", "1", "true", "yes") or raw.isdigit():
+                from .payload import signature_patterns
+
+                count = int(raw) if raw.isdigit() else 32
+                payload_patterns = signature_patterns(
+                    np.random.default_rng(0), count,
+                    plen=payload_plen or PAYLOAD_PREFIX_WIDTHS[0],
+                )
+            else:
+                from .payload import load_patterns
+
+                payload_patterns, pspec, _pver = load_patterns(raw)
+                if payload_plen is None:
+                    payload_plen = int(pspec.plen)
+        except (ValueError, OSError) as e:
+            p.error(f"--payload: {e}")
+    elif args.payload_mode == "enforce":
+        # matching resolved OFF: enforce mode with no payload tier
+        # would silently serve unmitigated — fail the launch
+        p.error("--payload-mode enforce requires --payload")
     if not float(args.trace_slow_us) > 0:
         p.error(f"--trace-slow-us must be positive, got "
                 f"{args.trace_slow_us}")
@@ -2482,6 +2719,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_slow_us=float(args.trace_slow_us),
         mlscore=mlscore_bundle,
         mlscore_mode=args.mlscore_mode,
+        payload=payload_patterns,
+        payload_mode=args.payload_mode,
+        payload_plen=payload_plen,
         ring=args.ring,
     )
     stop = threading.Event()

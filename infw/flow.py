@@ -706,7 +706,8 @@ class FlowTier:
                           tflags_np: Optional[np.ndarray] = None,
                           gens_snap=None, alloc_note=None,
                           telemetry: Optional["TelemetryTier"] = None,
-                          mlscore: Optional["AnomalyTier"] = None):
+                          mlscore: Optional["AnomalyTier"] = None,
+                          payload_ops=None, payload_dev=None):
         """Run one fused resident step and chain the donated buffers:
         ``fn(flow, gens, pages, epoch, *tables_args, wire, tenant,
         tflags, max_age) -> (new flow, new epoch, fused)``.  The updated
@@ -763,14 +764,22 @@ class FlowTier:
             # nesting order (flow lock -> telemetry lock -> mlscore
             # lock) so their updates land in device-dispatch order.
             # Operand order matches jitted_resident_step: flow, gens,
-            # pages, epoch, [sk], [sc, model, tparams], tables..., wire.
+            # pages, epoch, [sk], [sc, model, tparams], [payload model
+            # ops], tables..., wire[, pay, plen].  The payload-tier
+            # operands (ISSUE-19) are persistent values, not state — no
+            # exchange closure; they ride every dispatch as-is.
             def run(sk_state=None, sc_ops=None):
                 ops = [self._flow, gens_dev, pages_dev, epoch_dev]
                 if sk_state is not None:
                     ops.append(sk_state)
                 if sc_ops is not None:
                     ops.extend(sc_ops)
-                return fn(*ops, *tables_args, wire_dev, tenant, tflags,
+                if payload_ops is not None:
+                    ops.extend(payload_ops)
+                tail = [wire_dev]
+                if payload_dev is not None:
+                    tail.extend(payload_dev)
+                return fn(*ops, *tables_args, *tail, tenant, tflags,
                           self._max_age_dev)
 
             if telemetry is not None and mlscore is not None:
@@ -833,7 +842,8 @@ class FlowTier:
                                 tflags_np: Optional[np.ndarray] = None,
                                 gens_snap=None, alloc_note=None,
                                 telemetry: Optional["TelemetryTier"] = None,
-                                mlscore: Optional["AnomalyTier"] = None):
+                                mlscore: Optional["AnomalyTier"] = None,
+                                payload_ops=None, payload_dev=None):
         """Run ONE superbatch device program over ``k`` stacked
         admissions (jaxpath.jitted_resident_superbatch) and chain the
         donated buffers exactly like ``resident_dispatch`` — the device
@@ -882,7 +892,12 @@ class FlowTier:
                     ops.append(sk_state)
                 if sc_ops is not None:
                     ops.extend(sc_ops)
-                return fn(*ops, *tables_args, wire_dev, tenant, tflags,
+                if payload_ops is not None:
+                    ops.extend(payload_ops)
+                tail = [wire_dev]
+                if payload_dev is not None:
+                    tail.extend(payload_dev)
+                return fn(*ops, *tables_args, *tail, tenant, tflags,
                           self._max_age_dev)
 
             if telemetry is not None and mlscore is not None:

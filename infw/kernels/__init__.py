@@ -842,6 +842,84 @@ def _build_resident_mlscore_fused(b: int):
                 _fixture_wire(b), zeros, zeros, max_age)
 
 
+# -- payload-matching fixtures/builders (ISSUE-19) ---------------------------
+#
+# The batched Aho-Corasick match (kernels.acmatch) fused into the
+# resident step as the fourth verdict-merge tier.  The automaton
+# operands (transition tensor, match bitmap, mode scalar) are
+# persistent VALUES, never donated — the strict audit proves engaging
+# the payload tier leaves the flow/epoch donation aliasing intact.
+
+
+@functools.lru_cache(maxsize=None)
+def _payload_model():
+    from . import acmatch
+
+    return acmatch.compile_patterns(
+        [b"/etc/passwd", b"passwd", b"<script>", b"\x90\x90\x90\x90"],
+        plen=64,
+    )
+
+
+def _payload_operands(b: int, stacked: bool = False):
+    import jax
+
+    from . import acmatch
+
+    model = _payload_model()
+    trans, mmap = acmatch.model_device(model)
+    pmode = jax.device_put(np.asarray([0], np.int32))
+    pay = np.zeros((b, model.spec.plen), np.uint8)
+    sig = np.frombuffer(b"/etc/passwd", np.uint8)
+    pay[: b // 2, : len(sig)] = sig
+    plen = np.full(b, model.spec.plen, np.int32)
+    if stacked:
+        pay = np.stack([pay, np.roll(pay, 1, axis=0)])
+        plen = np.stack([plen, plen])
+    return (model.spec, (trans, mmap, pmode),
+            jax.device_put(pay), jax.device_put(plen))
+
+
+def _build_resident_payload_fused(b: int):
+    """The resident fused step with the payload-matching tier riding
+    the same program: flow columns + epoch donated exactly as the base
+    step — the automaton operands are value operands placed after
+    every donated position, so the audit's input_output_alias check
+    proves the fourth tier never disturbs the aliasing."""
+    from . import jaxpath
+
+    cfg, flow, gens, pages, epoch, max_age, zeros = _resident_operands(b)
+    spec, pops, pay, plen = _payload_operands(b)
+    fn = jaxpath.jitted_resident_step(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False,
+        payload=spec,
+    )
+    return fn, (flow, gens, pages, epoch, *pops,
+                _fixture_device_tables(True), _fixture_wire(b), pay, plen,
+                zeros, zeros, max_age)
+
+
+def _build_resident_superbatch_payload_fused(b: int):
+    """The superbatch epoch program with the payload tier riding the
+    device-side scan: stacked (K, B, L) payload columns travel the scan
+    xs next to the wire while the automaton operands stay
+    loop-invariant (closed over, one HBM copy for all K steps)."""
+    import jax
+
+    from . import jaxpath
+
+    cfg, flow, gens, pages, epoch, max_age, _z = _resident_operands(b)
+    zeros = jax.device_put(np.zeros((2, b), np.int32))
+    spec, pops, pay, plen = _payload_operands(b, stacked=True)
+    fn = jaxpath.jitted_resident_superbatch(
+        cfg.entries, cfg.ways, "trie", False, None, 0, False,
+        payload=spec,
+    )
+    return fn, (flow, gens, pages, epoch, *pops,
+                _fixture_device_tables(True), _fixture_wire_stack(b),
+                pay, plen, zeros, zeros, max_age)
+
+
 # -- mesh (multi-chip serving) fixtures/builders -----------------------------
 #
 # The MeshTpuClassifier's shard_map'd dispatch (backend/mesh.py,
@@ -1087,6 +1165,14 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         KernelEntrypoint(
             "classify-wire/resident-mlscore-fused", "xla",
             _build_resident_mlscore_fused, donate=(0, 3, 4),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-payload-fused", "xla",
+            _build_resident_payload_fused, donate=(0, 3),
+        ),
+        KernelEntrypoint(
+            "classify-wire/resident-superbatch-payload-fused", "xla",
+            _build_resident_superbatch_payload_fused, donate=(0, 3),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",

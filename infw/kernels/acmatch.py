@@ -1,0 +1,386 @@
+"""Payload tier: batched Aho-Corasick multi-pattern matching (ISSUE-19).
+
+Every verdict the framework emitted before this tier read headers only;
+payload signatures (SNI allowlists, HTTP-method rules, IDS byte
+signatures) need the first bytes of the packet.  This module compiles a
+pattern set into the classic Aho-Corasick goto/failure automaton and
+then FOLDS THE FAILURE LINKS OUT at compile time into a dense DFA:
+
+- ``delta``    (S, 256) int32 — next state for (state, byte), failure
+  chains pre-walked so the device never follows a link at match time;
+- ``matchmap`` (S, PW) uint32 — per-state pattern-output bitmaps with
+  the outputs of every state on the failure chain unioned in (PW =
+  padded-patterns / 32), so landing in a state reports every pattern
+  that ends there, including proper suffixes of longer patterns.
+
+The device then advances B packets one payload byte per step (L steps
+for an L-byte ring-sliced prefix) with two bit-identical transition
+paths selected statically by automaton size:
+
+- **gather** (default, any S): ``next = delta[state, byte]`` — one
+  fused gather per step;
+- **matmul** (MXU, small S): the state rides as an int8 one-hot row
+  ``v`` (B, S); one step is ``u = v @ Dflat`` with ``Dflat`` the
+  (S, 256*S) int8 one-hot transition block, reshaped (B, 256, S) and
+  contracted against the byte one-hot — int8 x int8 with int32
+  accumulation (``preferred_element_type``), exact because every
+  operand is one-hot.  The same trick mxu_score plays for the
+  oblivious forest, generalized from trie descent to DFA transition.
+
+Truncation semantics: only occurrences that END within the first
+``min(payload_len, plen)`` bytes are claimed.  A pattern occurrence
+crossing the prefix-truncation boundary reports NOTHING (no partial
+credit), which the host oracle in backend/cpu_ref.py mirrors by
+searching the truncated prefix only.
+
+Verdict merge: the per-packet any-match bit joins the admission
+program's verdict merge as a fourth tier beside flow/LPM/score.  Like
+the scoring tier's enforce mode, a payload rewrite NEVER touches a
+failsafe lane (mxu_score.failsafe port list) and never overrides an
+existing rule Deny; shadow mode only counts.  The enforce/shadow mode
+travels as a (1,) int32 DEVICE operand so flipping it never recompiles.
+
+The per-spec geometry (padded states / padded patterns / prefix length
+/ path) is the ONLY jit cache key — swapping a same-bucket pattern set
+replaces device value operands without a recompile (the PR-14
+zero-recompile hot-swap discipline).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..constants import DENY
+
+#: the verdict a payload enforce rewrite installs: Deny with ruleId 0
+#: (no table rule produced it) — same shape as the scoring tier's
+#: ANOMALY_DENY_RESULT, distinguished host-side by the rewrite bitmap.
+PAYLOAD_DENY_RESULT = DENY
+
+#: automaton-size threshold below which the one-hot matmul path is the
+#: default: Dflat is S*256*S bytes of int8 (128 states -> 4 MiB), past
+#: which the gather path wins on both memory and FLOPs.
+MATMUL_MAX_STATES = 128
+
+#: test hook (infw_lint state --inject-defect aclink): drop ONE
+#: failure-link output fold during automaton construction — the state
+#: reached by the longest pattern prefix then no longer reports the
+#: suffix patterns its failure chain carries, so any payload containing
+#: an overlapping/suffix match diverges from the naive host oracle.
+_INJECT_ACLINK_BUG = False
+
+
+class AcSpec(NamedTuple):
+    """Geometry of a compiled pattern automaton — hashable, the jit
+    cache key.  Everything here is PADDED: ``states``/``patterns`` are
+    pow2 buckets, so pattern sets that land in the same buckets share
+    one compiled program (zero-recompile hot-swap)."""
+
+    states: int    # padded DFA states (pow2, >= 64)
+    patterns: int  # padded pattern capacity (pow2, >= 32)
+    plen: int      # payload prefix length matched (64 or 128)
+    matmul: bool   # one-hot matmul transition path (else gather)
+
+    @property
+    def pwords(self) -> int:
+        return self.patterns // 32
+
+    @classmethod
+    def make(cls, states: int, patterns: int, plen: int = 64,
+             matmul: Optional[bool] = None) -> "AcSpec":
+        if plen not in (64, 128):
+            raise ValueError(f"plen must be 64 or 128, got {plen}")
+        s = 64
+        while s < states:
+            s *= 2
+        p = 32
+        while p < patterns:
+            p *= 2
+        if matmul is None:
+            matmul = s <= MATMUL_MAX_STATES
+        return cls(states=s, patterns=p, plen=plen, matmul=bool(matmul))
+
+
+class AcModel(NamedTuple):
+    """A compiled pattern set (host arrays) — the versioned-artifact
+    payload (infw.payload.save_patterns) and the source of the device
+    operands (``model_device``)."""
+
+    spec: AcSpec
+    delta: np.ndarray     # (S, 256) int32
+    matchmap: np.ndarray  # (S, PW) uint32
+    patterns: Tuple[bytes, ...]
+
+    def columns(self) -> dict:
+        return {"delta": self.delta, "matchmap": self.matchmap}
+
+
+def validate_patterns(patterns: Sequence[bytes], plen: int) -> None:
+    """Pattern-rule schema validation: non-empty byte strings that can
+    complete within the matched prefix.  A pattern longer than ``plen``
+    could never match (truncation semantics) — rejected loudly rather
+    than silently never firing."""
+    if not patterns:
+        raise ValueError("empty pattern set")
+    seen = set()
+    for i, p in enumerate(patterns):
+        if not isinstance(p, (bytes, bytearray)):
+            raise ValueError(f"pattern {i} is not bytes: {type(p)!r}")
+        if len(p) == 0:
+            raise ValueError(f"pattern {i} is empty")
+        if len(p) > plen:
+            raise ValueError(
+                f"pattern {i} ({len(p)} bytes) exceeds the {plen}-byte "
+                "matched prefix and could never fire"
+            )
+        if bytes(p) in seen:
+            raise ValueError(f"duplicate pattern at index {i}")
+        seen.add(bytes(p))
+
+
+def compile_patterns(patterns: Sequence[bytes], plen: int = 64,
+                     matmul: Optional[bool] = None,
+                     spec: Optional[AcSpec] = None) -> AcModel:
+    """Host-side lowering: trie -> BFS failure links -> dense DFA with
+    the links folded out.  With ``spec`` given, the result is padded
+    into that geometry (hot-swap into an existing compiled program);
+    the spec must fit or compilation refuses."""
+    patterns = tuple(bytes(p) for p in patterns)
+    validate_patterns(patterns, plen)
+    # 1. goto trie
+    goto: List[dict] = [{}]
+    out_state: List[int] = []  # accepting state of each pattern
+    for p in patterns:
+        s = 0
+        for c in p:
+            nxt = goto[s].get(c)
+            if nxt is None:
+                goto.append({})
+                nxt = len(goto) - 1
+                goto[s][c] = nxt
+            s = nxt
+        out_state.append(s)
+    n_states = len(goto)
+    if spec is None:
+        spec = AcSpec.make(n_states, len(patterns), plen, matmul)
+    else:
+        if n_states > spec.states:
+            raise ValueError(
+                f"pattern set needs {n_states} states, spec bucket is "
+                f"{spec.states} (hot-swap would recompile; re-spec)"
+            )
+        if len(patterns) > spec.patterns:
+            raise ValueError(
+                f"{len(patterns)} patterns exceed the spec bucket "
+                f"{spec.patterns}"
+            )
+        if plen != spec.plen:
+            raise ValueError(f"plen {plen} != spec.plen {spec.plen}")
+    S, PW = spec.states, spec.pwords
+    delta = np.zeros((S, 256), np.int32)
+    matchmap = np.zeros((S, PW), np.uint32)
+    for j, s in enumerate(out_state):
+        matchmap[s, j // 32] |= np.uint32(1 << (j % 32))
+    # 2. BFS failure links, folding transitions and outputs as we go
+    # (delta rows of visited states are already fully dense, so a
+    # missing goto edge resolves through ONE indexed read)
+    fail = np.zeros(n_states, np.int32)
+    from collections import deque
+
+    queue = deque()
+    for c, t in goto[0].items():
+        delta[0, c] = t
+        queue.append(t)
+    dropped_fold = False
+    while queue:
+        s = queue.popleft()
+        f = int(fail[s])
+        # the failure-link OUTPUT fold: a state reached by prefix x
+        # also reports every pattern ending at its longest proper
+        # suffix state.  The aclink injected defect drops exactly one
+        # of these folds (the first state whose chain carries output).
+        inherited = matchmap[f]
+        if _INJECT_ACLINK_BUG and not dropped_fold and inherited.any():
+            dropped_fold = True
+        else:
+            matchmap[s] |= inherited
+        for c in range(256):
+            t = goto[s].get(c)
+            if t is None:
+                delta[s, c] = delta[f, c]
+            else:
+                fail[t] = delta[f, c]
+                delta[s, c] = t
+                queue.append(t)
+    # padded states self-loop to root (never reachable; keeps rows inert)
+    return AcModel(spec=spec, delta=delta, matchmap=matchmap,
+                   patterns=patterns)
+
+
+def model_device(model: AcModel, device=None):
+    """Device operands ``(trans, matchmap)`` for the spec's transition
+    path: the dense delta table (gather) or the flattened one-hot
+    block Dflat (matmul).  ``device`` may be a Device OR a replicated
+    NamedSharding (the mesh backend's placement: the automaton tensors
+    replicate across data shards like every other table operand)."""
+    import jax
+
+    spec = model.spec
+    trans = _dflat_host(model) if spec.matmul else model.delta
+    if device is None:
+        return (jax.device_put(trans), jax.device_put(model.matchmap))
+    return (jax.device_put(trans, device),
+            jax.device_put(model.matchmap, device))
+
+
+def _dflat_host(model: AcModel) -> np.ndarray:
+    """(S, 256*S) int8 one-hot transition block: Dflat[s, c*S + t] = 1
+    iff delta[s, c] == t."""
+    S = model.spec.states
+    d = np.zeros((S, 256, S), np.int8)
+    s_idx = np.repeat(np.arange(S), 256)
+    c_idx = np.tile(np.arange(256), S)
+    d[s_idx, c_idx, model.delta.reshape(-1)] = 1
+    return d.reshape(S, 256 * S)
+
+
+# -- device core -------------------------------------------------------------
+
+
+def _acmatch_core(trans, matchmap, pay, plen, *, spec: AcSpec):
+    """Advance B packets through the DFA over the first ``spec.plen``
+    payload bytes -> (B, PW) uint32 match bitmaps.  ``pay`` is
+    (B, L >= plen) uint8 (ring slots may carry a wider bucketed
+    column; extra bytes are ignored), ``plen`` (B,) int32 valid byte
+    counts.  Bytes at positions >= plen neither advance the state nor
+    collect matches — the padding-mask half of the truncation
+    semantics (zero padding must not walk the automaton)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, PW, L = spec.states, spec.pwords, spec.plen
+    b = pay.shape[0]
+    bytes_t = pay[:, :L].astype(jnp.int32).T            # (L, B)
+    pos = jnp.arange(L, dtype=jnp.int32)[:, None]        # (L, 1)
+    active_t = pos < plen.astype(jnp.int32)[None, :]     # (L, B)
+    matches0 = jnp.zeros((b, PW), jnp.uint32)
+
+    if spec.matmul:
+        dflat = trans                                    # (S, 256*S) int8
+        iota_s = jnp.arange(S, dtype=jnp.int32)
+        v0 = jnp.zeros((b, S), jnp.int8).at[:, 0].set(1)
+
+        def step(carry, xs):
+            v, matches = carry
+            byte, active = xs
+            u = jnp.matmul(
+                v, dflat, preferred_element_type=jnp.int32
+            ).reshape(b, 256, S)
+            byte_oh = (
+                byte[:, None] == jnp.arange(256, dtype=jnp.int32)[None, :]
+            ).astype(jnp.int32)
+            w = jnp.sum(u * byte_oh[:, :, None], axis=1)  # (B, S) one-hot
+            v2 = jnp.where(active[:, None], w.astype(jnp.int8), v)
+            st = jnp.sum(w * iota_s[None, :], axis=1)
+            m = jnp.take(matchmap, jnp.clip(st, 0, S - 1), axis=0,
+                         mode="clip")
+            matches = matches | jnp.where(
+                active[:, None], m, jnp.uint32(0)
+            )
+            return (v2, matches), None
+
+        (_, matches), _ = jax.lax.scan(
+            step, (v0, matches0), (bytes_t, active_t)
+        )
+        return matches
+
+    delta = trans                                        # (S, 256) int32
+    state0 = jnp.zeros(b, jnp.int32)
+
+    def step(carry, xs):
+        state, matches = carry
+        byte, active = xs
+        flat = jnp.clip(state, 0, S - 1) * 256 + byte
+        nxt = jnp.take(delta.reshape(-1), flat, mode="clip")
+        state2 = jnp.where(active, nxt, state)
+        m = jnp.take(matchmap, jnp.clip(state2, 0, S - 1), axis=0,
+                     mode="clip")
+        matches = matches | jnp.where(active[:, None], m, jnp.uint32(0))
+        return (state2, matches), None
+
+    (_, matches), _ = jax.lax.scan(
+        step, (state0, matches0), (bytes_t, active_t)
+    )
+    return matches
+
+
+def _payload_merge_core(res, bitmap, pmode, proto, dst_port):
+    """The fourth verdict-merge tier: any-match -> Deny rewrite in
+    enforce mode, with the SAME guardrails as the scoring tier —
+    failsafe lanes (mxu_score port list) and existing rule Denies are
+    never rewritten.  ``pmode`` is a (1,) int32 device operand (0
+    shadow / 1 enforce) so a mode flip is a value swap, not a
+    recompile.  Returns (res_out, hit, rewrite)."""
+    import jax.numpy as jnp
+
+    from .mxu_score import _failsafe_lane_mask_jax
+
+    hit = jnp.any(bitmap != 0, axis=1)
+    enf = pmode[0] != 0
+    fs = _failsafe_lane_mask_jax(proto, dst_port)
+    act = (res.astype(jnp.uint32) & 0xFF).astype(jnp.int32)
+    rewrite = hit & enf & ~fs & (act != DENY)
+    res_out = jnp.where(
+        rewrite, jnp.uint32(PAYLOAD_DENY_RESULT), res.astype(jnp.uint32)
+    )
+    return res_out, hit, rewrite
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_acmatch(spec: AcSpec):
+    """The standalone payload-match launch (classic multi-dispatch
+    path and the statecheck witness): ``f(trans, matchmap, pay, plen)
+    -> (B, PW) uint32`` full match bitmaps.  Stateless — nothing
+    donated; the model operands persist across dispatches."""
+    import jax
+
+    def f(trans, matchmap, pay, plen):
+        return _acmatch_core(trans, matchmap, pay, plen, spec=spec)
+
+    return jax.jit(f)
+
+
+# -- host oracle hooks -------------------------------------------------------
+
+
+def host_match_bitmap(model: AcModel, pay: np.ndarray,
+                      plen: np.ndarray) -> np.ndarray:
+    """Construction-INDEPENDENT host reference: naive substring search
+    over each truncated prefix (backend.cpu_ref.payload_match_ref).
+    Deliberately not a walk of the compiled DFA — a construction bug
+    (the aclink defect) must diverge from this, not be shared by it."""
+    from ..backend.cpu_ref import payload_match_ref
+
+    return payload_match_ref(
+        model.patterns, pay, plen, model.spec.plen, model.spec.pwords
+    )
+
+
+def host_payload_rewrite(model: AcModel, res: np.ndarray,
+                         bitmap: np.ndarray, enforce: bool,
+                         proto: np.ndarray,
+                         dst_port: np.ndarray) -> np.ndarray:
+    """Numpy mirror of _payload_merge_core for the classic follow-on
+    path and the statecheck oracle."""
+    from .mxu_score import failsafe_lane_mask_np
+
+    hit = (bitmap != 0).any(axis=1)
+    if not enforce:
+        return np.asarray(res, np.uint32)
+    fs = failsafe_lane_mask_np(proto, dst_port)
+    act = (np.asarray(res, np.uint32) & np.uint32(0xFF)).astype(np.int32)
+    rewrite = hit & ~fs & (act != DENY)
+    return np.where(rewrite, np.uint32(PAYLOAD_DENY_RESULT),
+                    np.asarray(res, np.uint32))

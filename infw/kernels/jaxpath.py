@@ -6215,9 +6215,11 @@ def _resident_step_core(
     flow: FlowTable, gens: jax.Array, page_table: jax.Array,
     epoch: jax.Array, tdev, wire: jax.Array, tenant: jax.Array,
     tflags: jax.Array, max_age: jax.Array, ov=None, sk=None, sc=None,
-    model=None, tparams=None,
+    model=None, tparams=None, pay=None, plen=None, ptrans=None,
+    pmatch=None, pmode=None,
     *, slab_entries: int, ways: int, path: str, v4_only: bool,
     depth: Optional[int], d_max: int, sketch=None, score=None,
+    payload=None,
 ):
     batch = unpack_wire(wire)
     e1 = (epoch + jnp.int32(1)).astype(jnp.int32)
@@ -6271,8 +6273,31 @@ def _resident_step_core(
         merged2 = merged2.astype(jnp.uint32)
     else:
         merged2 = merged
+    pay_hit = pay_rw = None
+    if payload is not None:
+        # payload-matching tier (ISSUE-19): the Aho-Corasick DFA walk
+        # over the ring-sliced payload-prefix column rides the SAME
+        # device program, as the FOURTH verdict-merge tier — after the
+        # score rewrite, with the same guardrails (failsafe lanes and
+        # existing rule Denies are never overridden).  The automaton
+        # operands (ptrans/pmatch) and the shadow/enforce scalar are
+        # persistent VALUE operands — a pattern hot-swap replaces them
+        # whole with spec-fixed shapes, so swapping never recompiles
+        # and never disturbs the donation aliasing of the state that
+        # precedes them in the operand order.
+        from . import acmatch as acmatch_mod
+
+        bitmap = acmatch_mod._acmatch_core(
+            ptrans, pmatch, pay, plen, spec=payload
+        )
+        merged3, pay_hit, pay_rw = acmatch_mod._payload_merge_core(
+            merged2, bitmap, pmode, batch.proto, batch.dst_port
+        )
+        merged3 = merged3.astype(jnp.uint32)
+    else:
+        merged3 = merged2
     flow2, counts = _flow_insert_core(
-        flow1, gens, page_table, batch, tenant, tflags, merged2, e1,
+        flow1, gens, page_table, batch, tenant, tflags, merged3, e1,
         slab_entries=slab_entries, ways=ways, lane_ok=~hit,
     )
     # res16-only readback (the wire8 contract): per-ruleId statistics
@@ -6281,7 +6306,7 @@ def _resident_step_core(
     # would cost ~24 KB per admission, dwarfing the ~100 B the resident
     # loop actually needs back
     parts = [
-        _pack_res16(merged2.astype(jnp.uint16)),
+        _pack_res16(merged3.astype(jnp.uint16)),
         _pack_bits32(hit),
         jnp.stack([
             jnp.sum(hit.astype(jnp.int32)),
@@ -6298,6 +6323,15 @@ def _resident_step_core(
         s16 = jnp.clip(score_out, -32768, 32767).astype(jnp.int16)
         parts.append(_pack_bits32(anom))
         parts.append(_pack_res16(s16.astype(jnp.uint16)))
+    if payload is not None:
+        # payload extension of the fused readback: the matched-lane and
+        # rewritten-lane bitmaps (b/32 words each) — the counters and
+        # the classic-path identity gate read these; the FULL (b, PW)
+        # match bitmap never crosses the link on the resident path (the
+        # standalone jitted_acmatch launch serves statecheck's
+        # bit-identity compare instead)
+        parts.append(_pack_bits32(pay_hit))
+        parts.append(_pack_bits32(pay_rw))
     fused = jnp.concatenate(parts)
     if sketch is not None:
         # device-resident telemetry (ISSUE-13): the sketch update rides
@@ -6309,7 +6343,7 @@ def _resident_step_core(
         from . import sketch as sketch_mod
 
         sk2 = sketch_mod._sketch_update_core(
-            sk, batch, tenant, tflags, merged2, spec=sketch,
+            sk, batch, tenant, tflags, merged3, spec=sketch,
         )
         if score is not None:
             return flow2, e1, sk2, sc2, fused
@@ -6349,6 +6383,25 @@ def split_resident_score_outputs(arr: np.ndarray, b: int):
     return res16, hit, hits, stale, counts, anom, scores
 
 
+def split_resident_payload_outputs(arr: np.ndarray, b: int,
+                                   score: bool = False):
+    """Host inverse of the PAYLOAD resident step's fused buffer: the
+    base (or scoring) tuple with the matched-lane and rewritten-lane
+    bitmaps appended -> (..., pay_hit[b], pay_rewrote[b]).  The payload
+    extension is the LAST 2*ceil(b/32) words regardless of which other
+    tiers ride the program, so the slice anchors from the end."""
+    arr = np.asarray(arr)
+    nh = -(-b // 32)
+    base, tail = arr[: arr.shape[0] - 2 * nh], arr[arr.shape[0] - 2 * nh:]
+    head = (
+        split_resident_score_outputs(base, b) if score
+        else split_resident_outputs(base, b)
+    )
+    pay_hit = unpack_bits32_host(tail[:nh], b)
+    pay_rw = unpack_bits32_host(tail[nh:], b)
+    return head + (pay_hit, pay_rw)
+
+
 #: donated operand positions of the resident step — the flow column
 #: pytree and the device epoch scalar; declared here so the entrypoint
 #: registry and the jaxcheck donation lint share one source of truth
@@ -6384,7 +6437,7 @@ def resident_donate_argnums(sketch: bool, score: bool) -> tuple:
 def jitted_resident_step(
     slab_entries: int, ways: int, path: str, v4_only: bool = False,
     depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
-    sketch=None, score=None,
+    sketch=None, score=None, payload=None,
 ):
     """The resident fused executable, cache-keyed on (flow geometry,
     layout path, wire format specialization, sketch/score geometry) —
@@ -6403,22 +6456,35 @@ def jitted_resident_step(
     the returned arrays into the next dispatch.  The score model/
     tparams operands are persistent device arrays — a model hot swap
     replaces them whole with spec-fixed shapes, so swapping never
-    recompiles."""
+    recompiles.
+
+    The payload variant (``payload`` = an acmatch.AcSpec) extends the
+    order to f(flow, gens, page_table, epoch, [sk], [sc, model,
+    tparams], [ptrans, pmatch, pmode], tables[, overlay], wire, pay,
+    plen, tenant, tflags, max_age): the automaton operands sit AFTER
+    every donated position, so the fourth tier never perturbs the
+    aliasing contract, and a pattern hot-swap is a value-operand
+    replacement exactly like a score-model swap."""
     kw = dict(slab_entries=slab_entries, ways=ways, path=path,
               v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch,
-              score=score)
+              score=score, payload=payload)
     has_sk = sketch is not None
     has_sc = score is not None
+    has_pay = payload is not None
 
     def f(*args):
         flow, gens, page_table, epoch = args[:4]
         i = 4
         sk = sc = model = tparams = None
+        ptrans = pmatch = pmode = None
         if has_sk:
             sk = args[i]
             i += 1
         if has_sc:
             sc, model, tparams = args[i], args[i + 1], args[i + 2]
+            i += 3
+        if has_pay:
+            ptrans, pmatch, pmode = args[i], args[i + 1], args[i + 2]
             i += 3
         tdev = args[i]
         i += 1
@@ -6426,11 +6492,16 @@ def jitted_resident_step(
         if overlay:
             ov = args[i]
             i += 1
-        wire, tenant, tflags, max_age = args[i : i + 4]
+        if has_pay:
+            wire, pay, plen, tenant, tflags, max_age = args[i : i + 6]
+        else:
+            wire, tenant, tflags, max_age = args[i : i + 4]
+            pay = plen = None
         return _resident_step_core(
             flow, gens, page_table, epoch, tdev, wire, tenant, tflags,
             max_age, ov=ov, sk=sk, sc=sc, model=model, tparams=tparams,
-            **kw,
+            pay=pay, plen=plen, ptrans=ptrans, pmatch=pmatch,
+            pmode=pmode, **kw,
         )
 
     return jax.jit(f, donate_argnums=resident_donate_argnums(has_sk,
@@ -6470,7 +6541,7 @@ def resident_fused_host(fused) -> np.ndarray:
 def jitted_resident_superbatch(
     slab_entries: int, ways: int, path: str, v4_only: bool = False,
     depth: Optional[int] = None, d_max: int = 0, overlay: bool = False,
-    sketch=None, score=None,
+    sketch=None, score=None, payload=None,
 ):
     """The K-admission device epoch program, cache-keyed exactly like
     jitted_resident_step (K and the batch shape specialize through
@@ -6483,22 +6554,34 @@ def jitted_resident_superbatch(
     epoch', [sk'], [sc'], fused (K, L)).  Donation is identical to the
     single step (flow, epoch, sketch, score) — XLA aliases the carry
     in place through the while loop, verified against the compiled
-    HLO by the jaxcheck donation lint."""
+    HLO by the jaxcheck donation lint.
+
+    The payload variant stacks the pay/plen columns with the wire:
+    f(..., [ptrans, pmatch, pmode], tables[, overlay], wire (K, B, W),
+    pay (K, B, L), plen (K, B), tenant, tflags, max_age) — the
+    automaton operands stay loop-INVARIANT (closed over by the scan
+    body), so K admissions walk one resident copy of the transition
+    tensors."""
     kw = dict(slab_entries=slab_entries, ways=ways, path=path,
               v4_only=v4_only, depth=depth, d_max=d_max, sketch=sketch,
-              score=score)
+              score=score, payload=payload)
     has_sk = sketch is not None
     has_sc = score is not None
+    has_pay = payload is not None
 
     def f(*args):
         flow, gens, page_table, epoch = args[:4]
         i = 4
         sk = sc = model = tparams = None
+        ptrans = pmatch = pmode = None
         if has_sk:
             sk = args[i]
             i += 1
         if has_sc:
             sc, model, tparams = args[i], args[i + 1], args[i + 2]
+            i += 3
+        if has_pay:
+            ptrans, pmatch, pmode = args[i], args[i + 1], args[i + 2]
             i += 3
         tdev = args[i]
         i += 1
@@ -6506,15 +6589,25 @@ def jitted_resident_superbatch(
         if overlay:
             ov = args[i]
             i += 1
-        wire, tenant, tflags, max_age = args[i : i + 4]
+        if has_pay:
+            wire, pay, plen, tenant, tflags, max_age = args[i : i + 6]
+            xs = (wire, pay, plen, tenant, tflags)
+        else:
+            wire, tenant, tflags, max_age = args[i : i + 4]
+            xs = (wire, tenant, tflags)
 
-        def body(carry, xs):
+        def body(carry, xs_row):
             fl, ep, skc, scc = carry
-            w, tn, tf = xs
+            if has_pay:
+                w, py, pl, tn, tf = xs_row
+            else:
+                w, tn, tf = xs_row
+                py = pl = None
             out = _resident_step_core(
                 fl, gens, page_table, ep, tdev, w, tn, tf, max_age,
                 ov=ov, sk=skc, sc=scc, model=model, tparams=tparams,
-                **kw,
+                pay=py, plen=pl, ptrans=ptrans, pmatch=pmatch,
+                pmode=pmode, **kw,
             )
             fl2, ep2 = out[0], out[1]
             j = 2
@@ -6528,7 +6621,7 @@ def jitted_resident_superbatch(
             return (fl2, ep2, sk2, sc2), out[-1]
 
         (flow2, e2, sk2, sc2), fused = jax.lax.scan(
-            body, (flow, epoch, sk, sc), (wire, tenant, tflags)
+            body, (flow, epoch, sk, sc), xs
         )
         outs = [flow2, e2]
         if has_sk:

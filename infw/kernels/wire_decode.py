@@ -70,6 +70,43 @@ def payload_bucket(n: int) -> int:
     return -(-n // step) * step
 
 
+#: payload-PREFIX column widths (ISSUE-19): the ring-sliced per-packet
+#: prefix the payload-matching tier consumes is bucketed to exactly two
+#: shapes — small enough that the bucket IS the matched length, so
+#: prefix columns never re-bucket per batch (one jit shape per width).
+PAYLOAD_PREFIX_WIDTHS = (64, 128)
+
+
+def payload_prefix_bucket(n: int) -> int:
+    """Bucketed payload-PREFIX width for an ``n``-byte prefix column —
+    the smaller of the two fixed widths that fits (columns wider than
+    128 are truncated by the producer before they reach the wire)."""
+    for w in PAYLOAD_PREFIX_WIDTHS:
+        if n <= w:
+            return w
+    return PAYLOAD_PREFIX_WIDTHS[-1]
+
+
+def pad_payload_prefix(pay: np.ndarray,
+                       plen: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """Normalize a (B, L) payload-prefix column into its bucket:
+    zero-pad (or truncate) the byte axis to ``payload_prefix_bucket(L)``
+    and clamp the valid-length column to the bucket.  Zero padding is
+    inert — the matcher masks positions >= plen, so pad bytes neither
+    advance the automaton nor collect matches."""
+    pay = np.asarray(pay, np.uint8)
+    b, ln = pay.shape
+    cap = payload_prefix_bucket(ln)
+    if ln < cap:
+        out = np.zeros((b, cap), np.uint8)
+        out[:, :ln] = pay
+    elif ln > cap:
+        out = np.ascontiguousarray(pay[:, :cap])
+    else:
+        out = pay
+    return out, np.clip(np.asarray(plen), 0, cap).astype(np.int32)
+
+
 def pad_payload(payload: np.ndarray) -> np.ndarray:
     """Zero-pad the payload to its bucket.  Trailing zero bytes are
     inert for every section: fixed sections are length-bound by n, and in

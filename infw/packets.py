@@ -50,6 +50,14 @@ class PacketBatch:
     #: first-packet.  Never crosses the classify wire formats — the
     #: verdict does not depend on it.
     tcp_flags: Optional[np.ndarray] = None
+    #: optional (B, L) uint8 payload-prefix column (first 64/128 bytes,
+    #: ISSUE-19) consumed by the payload-matching tier, plus its (B,)
+    #: int32 valid-byte counts (bytes past ``payload_len[i]`` are
+    #: padding the matcher masks off).  Rides BESIDE the packed wire —
+    #: header classification never reads it, so header-only sources
+    #: (None) skip the tier without a shape change.
+    payload: Optional[np.ndarray] = None
+    payload_len: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return int(self.kind.shape[0])
@@ -71,6 +79,13 @@ class PacketBatch:
                 None if self.tcp_flags is None
                 else self.tcp_flags[start:stop]
             ),
+            payload=(
+                None if self.payload is None else self.payload[start:stop]
+            ),
+            payload_len=(
+                None if self.payload_len is None
+                else self.payload_len[start:stop]
+            ),
         )
 
     def take(self, idx: np.ndarray) -> "PacketBatch":
@@ -86,6 +101,12 @@ class PacketBatch:
             },
             tcp_flags=(
                 None if self.tcp_flags is None else self.tcp_flags[idx]
+            ),
+            payload=(
+                None if self.payload is None else self.payload[idx]
+            ),
+            payload_len=(
+                None if self.payload_len is None else self.payload_len[idx]
             ),
         )
 
@@ -224,6 +245,14 @@ class PacketBatch:
             tcp_flags=(
                 None if self.tcp_flags is None
                 else np.pad(self.tcp_flags, (0, pad))
+            ),
+            payload=(
+                None if self.payload is None
+                else np.pad(self.payload, ((0, pad), (0, 0)))
+            ),
+            payload_len=(
+                None if self.payload_len is None
+                else np.pad(self.payload_len, (0, pad))
             ),
         )
 

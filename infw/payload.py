@@ -1,0 +1,390 @@
+"""Payload-matching policy tier: shadow/enforce mitigation over the
+batched Aho-Corasick kernels (ISSUE-19).
+
+The control-plane half of kernels.acmatch: ``PayloadTier`` owns the
+compiled pattern automaton's device value operands (hot-swapped whole,
+never recompiled — the geometry buckets in AcSpec are the only jit
+key), the shadow/enforce mode scalar (a (1,) int32 DEVICE operand, so a
+mode flip is a value swap too), and the match counters, and serves both
+paths — the in-program fourth verdict-merge tier the resident fused
+step carries (jaxpath.jitted_resident_step(payload=spec)) and the
+one-follow-on-launch-per-admission form on the multi-dispatch wire
+path.
+
+Policy semantics mirror the scoring tier's enforce mode: a matched lane
+is rewritten to Deny (ruleId 0) — but NEVER a failsafe cell
+(kernels.mxu_score.failsafe_lane_mask_np, the same infw.failsaferules
+port list) and never an existing rule Deny.  On the flow paths the
+ENFORCED verdict is what batch-inserts into the flow table, so
+mitigation sticks to the flow — and a pattern-set swap bumps the flow
+generation exactly like a rule patch (TpuClassifier.set_payload_
+patterns), invalidating stale cached verdicts through the same stamps
+every table edit uses.
+
+Pattern sets are versioned artifacts: ``save_patterns``/
+``load_patterns`` write an npz (concatenated pattern bytes + lengths)
+plus a JSON manifest (format tag, version, geometry, sha256 of the npz
+bytes) — the daemon's ``<state-dir>/patterns/`` hot-swap dir consumes
+exactly these pairs, the PR-14 models-dir discipline.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .kernels.acmatch import (
+    AcModel,
+    AcSpec,
+    compile_patterns,
+    host_payload_rewrite,
+    jitted_acmatch,
+    model_device,
+    validate_patterns,
+)
+
+#: manifest format tag (bump on any incompatible artifact change)
+PATTERN_FORMAT = "infw-acmatch-v1"
+
+
+# --- versioned pattern-set artifacts (npz + JSON manifest) -------------------
+
+
+def save_patterns(patterns: Sequence[bytes], path: str,
+                  plen: int = 64, version: Optional[str] = None,
+                  spec: Optional[AcSpec] = None) -> str:
+    """Write ``path`` (.npz: concatenated pattern bytes + per-pattern
+    lengths) plus ``path + '.json'`` (the manifest: format, version,
+    geometry, sha256 of the npz bytes).  Returns the manifest path.
+    Writes are tmp+rename, so a hot-swap dir scanner can never observe
+    a torn artifact."""
+    patterns = [bytes(p) for p in patterns]
+    validate_patterns(patterns, plen)
+    if spec is None:
+        spec = compile_patterns(patterns, plen=plen).spec
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    blob = np.frombuffer(b"".join(patterns), np.uint8)
+    lens = np.asarray([len(p) for p in patterns], np.int32)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, blob=blob, lens=lens)
+    os.replace(tmp, path)
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    manifest = {
+        "format": PATTERN_FORMAT,
+        "version": str(version or "0"),
+        "spec": dict(spec._asdict()),
+        "patterns": len(patterns),
+        "sha256": digest,
+    }
+    mpath = path + ".json"
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(mpath + ".tmp", mpath)
+    return mpath
+
+
+def load_patterns(path: str) -> Tuple[List[bytes], AcSpec, str]:
+    """Load a versioned pattern-set artifact -> (patterns, spec,
+    version).  The manifest is REQUIRED and its checksum must match the
+    npz bytes — a corrupted artifact must fail at the control plane,
+    never mis-match on the serving path."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    mpath = path + ".json"
+    if not os.path.exists(mpath):
+        raise ValueError(f"pattern-set manifest missing: {mpath}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != PATTERN_FORMAT:
+        raise ValueError(
+            f"pattern-set format {manifest.get('format')!r} != "
+            f"{PATTERN_FORMAT!r}"
+        )
+    with open(path, "rb") as f:
+        raw = f.read()
+    digest = hashlib.sha256(raw).hexdigest()
+    if digest != manifest.get("sha256"):
+        raise ValueError(
+            f"pattern-set checksum mismatch for {path} (manifest "
+            f"{manifest.get('sha256', '')[:12]}.., npz {digest[:12]}..)"
+        )
+    import io
+
+    with np.load(io.BytesIO(raw)) as z:
+        blob = bytes(np.asarray(z["blob"], np.uint8).tobytes())
+        lens = np.asarray(z["lens"], np.int64)
+    pats, off = [], 0
+    for n in lens:
+        pats.append(blob[off:off + int(n)])
+        off += int(n)
+    spec = AcSpec(**manifest["spec"])
+    return pats, spec, str(manifest.get("version", "0"))
+
+
+# --- seeded traffic/pattern generators (bench, loadgen, statecheck) ---------
+
+_HTTP_METHODS = (b"GET", b"POST", b"HEAD", b"PUT")
+_HTTP_PATHS = (b"/", b"/index.html", b"/api/v1/items", b"/static/app.js",
+               b"/health", b"/favicon.ico")
+
+
+def signature_patterns(rng, count: int, plen: int = 64) -> List[bytes]:
+    """A seeded signature set: a few text tokens (overlapping suffixes
+    on purpose — the failure-link surface) plus random byte signatures
+    of mixed length.  Deterministic per rng state."""
+    base = [b"/etc/passwd", b"etc/passwd", b"passwd", b"<script>",
+            b"script>", b"SELECT ", b"ELECT ", b"\x90\x90\x90\x90"]
+    pats: List[bytes] = list(base[:min(count, len(base))])
+    seen = set(pats)
+    while len(pats) < count:
+        n = int(rng.integers(2, min(17, plen + 1)))
+        p = bytes(rng.integers(0, 256, size=n, dtype=np.uint8).tobytes())
+        if p and p not in seen and len(p) <= plen:
+            seen.add(p)
+            pats.append(p)
+    return pats[:count]
+
+
+def benign_payloads(rng, n: int, plen: int = 64) -> Tuple[np.ndarray,
+                                                          np.ndarray]:
+    """(pay (n, plen) uint8, plen_col (n,) int32): HTTP-ish request
+    prefixes of varying length — the benign traffic shape loadgen's
+    ``--payload http`` emits."""
+    pay = np.zeros((n, plen), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i in range(n):
+        m = _HTTP_METHODS[int(rng.integers(0, len(_HTTP_METHODS)))]
+        p = _HTTP_PATHS[int(rng.integers(0, len(_HTTP_PATHS)))]
+        line = m + b" " + p + b" HTTP/1.1\r\nHost: example-" + \
+            str(int(rng.integers(0, 100))).encode() + b".net\r\n\r\n"
+        k = min(len(line), plen)
+        pay[i, :k] = np.frombuffer(line[:k], np.uint8)
+        lens[i] = k
+    return pay, lens
+
+
+def attack_payloads(rng, n: int, patterns: Sequence[bytes],
+                    plen: int = 64) -> Tuple[np.ndarray, np.ndarray]:
+    """Signature-bearing payload columns: benign base with one pattern
+    planted per packet at a random offset — sometimes deliberately
+    CROSSING the prefix-truncation boundary (those must NOT match, the
+    truncation-semantics surface the statecheck config exercises)."""
+    pay, lens = benign_payloads(rng, n, plen)
+    pats = [bytes(p) for p in patterns]
+    for i in range(n):
+        p = pats[int(rng.integers(0, len(pats)))]
+        lens[i] = plen
+        if rng.random() < 0.15 and len(p) > 1:
+            off = plen - int(rng.integers(1, len(p)))  # straddles the cut
+        else:
+            off = int(rng.integers(0, plen - len(p) + 1))
+        end = min(off + len(p), plen)
+        pay[i, off:end] = np.frombuffer(p[:end - off], np.uint8)
+    return pay, lens
+
+
+# --- the serving-tier facade -------------------------------------------------
+
+
+class PayloadTier:
+    """Owns the compiled automaton's device operands + policy mode +
+    match counters.  STATELESS on device (unlike flow/telemetry/score —
+    nothing donated): the fused step takes the operands alongside the
+    tables, so swapping them can never disturb donation aliasing."""
+
+    def __init__(self, model_or_patterns, plen: int = 64,
+                 mode: str = "shadow", spec: Optional[AcSpec] = None,
+                 keep_masks: int = 0, device=None) -> None:
+        if isinstance(model_or_patterns, AcModel):
+            model = model_or_patterns
+        else:
+            model = compile_patterns(
+                model_or_patterns, plen=plen, spec=spec
+            )
+        if mode not in ("shadow", "enforce"):
+            raise ValueError(f"payload mode {mode!r}")
+        self._lock = threading.Lock()
+        self.model = model
+        self.spec = model.spec
+        self.mode = mode
+        self.version = 0
+        #: Device or replicated NamedSharding (the mesh placement)
+        self._device = device
+        self._trans, self._mmap = model_device(model, device=device)
+        self._pmode = self._put_mode(mode)
+        self._counters: Dict[str, int] = {
+            "admissions": 0, "lanes": 0, "matched": 0, "enforced": 0,
+            "swaps": 0,
+        }
+        self._keep = int(keep_masks)
+        self._masks: deque = deque(maxlen=max(1, self._keep))
+        #: classifier hook: fired after a pattern swap (flow-generation
+        #: bump — a swap behaves like a rule patch)
+        self.on_swap = None
+
+    # -- operands -----------------------------------------------------------
+
+    def _put_mode(self, mode: str):
+        import jax
+
+        arr = np.asarray([1 if mode == "enforce" else 0], np.int32)
+        return (jax.device_put(arr) if self._device is None
+                else jax.device_put(arr, self._device))
+
+    def device_ops(self) -> tuple:
+        """(trans, matchmap, pmode) — the fused step's payload operand
+        group.  Value operands only; geometry lives in ``self.spec``."""
+        with self._lock:
+            return (self._trans, self._mmap, self._pmode)
+
+    @property
+    def enforce(self) -> bool:
+        return self.mode == "enforce"
+
+    def set_mode(self, mode: str) -> None:
+        if mode not in ("shadow", "enforce"):
+            raise ValueError(f"payload mode {mode!r}")
+        with self._lock:
+            self.mode = mode
+            self._pmode = self._put_mode(mode)
+
+    def set_keep_masks(self, n: int) -> None:
+        with self._lock:
+            self._keep = int(n)
+            self._masks = deque(self._masks, maxlen=max(1, self._keep))
+
+    @property
+    def tracking(self) -> bool:
+        """True when retained-mask tracking is on (statecheck): the
+        resident paths then re-derive the full match bitmap through one
+        standalone launch per admission (the fused readback ships only
+        the packed hit/rewrite bits)."""
+        with self._lock:
+            return self._keep > 0
+
+    def recent_masks(self) -> list:
+        """[(pay, plen, bitmap, hit)] retained admissions (statecheck's
+        device-vs-oracle compare substrate; keep_masks > 0 only).
+        ``bitmap`` is the (B, PW) device match bitmap, ``hit`` the
+        SERVED matched-lane bits — on the fused paths they come from
+        different programs over the same operands, so the cross-check
+        bitmap.any(axis=1) == hit pins the fused merge to the
+        standalone kernel."""
+        with self._lock:
+            return list(self._masks)
+
+    # -- classic (follow-on launch) path ------------------------------------
+
+    def match(self, pay_np: np.ndarray, plen_np: np.ndarray) -> np.ndarray:
+        """One standalone device launch -> (B, PW) uint32 bitmaps."""
+        import jax
+
+        with self._lock:
+            trans, mmap = self._trans, self._mmap
+            spec = self.spec
+        f = jitted_acmatch(spec)
+        pay = np.ascontiguousarray(pay_np, np.uint8)
+        plen = np.ascontiguousarray(plen_np, np.int32)
+        if self._device is None:
+            pay, plen = jax.device_put(pay), jax.device_put(plen)
+        else:
+            pay = jax.device_put(pay, self._device)
+            plen = jax.device_put(plen, self._device)
+        return np.asarray(f(trans, mmap, pay, plen))
+
+    def apply_wire(self, res16: np.ndarray, pay_np: np.ndarray,
+                   plen_np: np.ndarray, proto: np.ndarray,
+                   dst_port: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """The multi-dispatch path's follow-on: match + (enforce-mode)
+        host rewrite -> (res16_out, hit).  Counters accrue here."""
+        bitmap = self.match(pay_np, plen_np)
+        with self._lock:
+            model, enforce = self.model, self.mode == "enforce"
+        res_out = host_payload_rewrite(
+            model, res16, bitmap, enforce, proto, dst_port
+        )
+        hit = (bitmap != 0).any(axis=1)
+        self.note(bitmap, hit,
+                  np.asarray(res_out, np.uint32)
+                  != np.asarray(res16, np.uint32),
+                  pay_np=pay_np, plen_np=plen_np)
+        return res_out, hit
+
+    # -- counters (both paths) ----------------------------------------------
+
+    def note(self, bitmap: Optional[np.ndarray], hit: np.ndarray,
+             rewrote: np.ndarray, pay_np: Optional[np.ndarray] = None,
+             plen_np: Optional[np.ndarray] = None) -> None:
+        """Fold one admission's outcome into the counters (and the
+        retained-mask ring when tracking is on)."""
+        with self._lock:
+            self._counters["admissions"] += 1
+            self._counters["lanes"] += int(np.asarray(hit).shape[0])
+            self._counters["matched"] += int(np.count_nonzero(hit))
+            self._counters["enforced"] += int(np.count_nonzero(rewrote))
+            if self._keep and pay_np is not None and bitmap is not None:
+                self._masks.append((
+                    np.array(pay_np, np.uint8, copy=True),
+                    np.array(plen_np, np.int32, copy=True),
+                    np.array(bitmap, np.uint32, copy=True),
+                    np.array(hit, bool, copy=True),
+                ))
+
+    def counter_values(self) -> Dict[str, int]:
+        """payload_* counters/gauges for /metrics."""
+        with self._lock:
+            return {
+                "payload_admissions_total": self._counters["admissions"],
+                "payload_lanes_total": self._counters["lanes"],
+                "payload_matched_total": self._counters["matched"],
+                "payload_enforced_total": self._counters["enforced"],
+                "payload_pattern_swaps_total": self._counters["swaps"],
+                "payload_patterns": len(self.model.patterns),
+                "payload_patternset_version": self.version,
+            }
+
+    # -- hot swap ------------------------------------------------------------
+
+    def swap_patterns(self, patterns_or_model, plen: Optional[int] = None
+                      ) -> None:
+        """Replace the pattern set WITHOUT recompiling: the new set
+        must land in the same AcSpec buckets (states/patterns/plen), so
+        only the device value operands change.  Fires ``on_swap`` (the
+        classifier's flow-generation bump) after the operands flip."""
+        if isinstance(patterns_or_model, AcModel):
+            model = patterns_or_model
+        else:
+            model = compile_patterns(
+                patterns_or_model, plen=plen or self.spec.plen,
+                spec=self.spec,
+            )
+        if model.spec != self.spec:
+            raise ValueError(
+                f"pattern swap changes geometry {self.spec} -> "
+                f"{model.spec}; a swap must stay in-bucket"
+            )
+        trans, mmap = model_device(model, device=self._device)
+        with self._lock:
+            self.model = model
+            self._trans, self._mmap = trans, mmap
+            self.version += 1
+            self._counters["swaps"] += 1
+            # retained masks were matched by the OLD automaton — stale
+            # against the new pattern set, drop them
+            self._masks.clear()
+            hook = self.on_swap
+        if hook is not None:
+            hook()
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            for k in self._counters:
+                self._counters[k] = 0

@@ -20,9 +20,14 @@ Layout (one file, mapped by both sides):
 - ``slots`` fixed-size slots of ``slot_bytes`` each, 64-byte aligned.
   Slot layout: commit (u64, = sequence + 1 once the payload below it is
   fully written — the publish barrier), n (u32 packets), width (u32, 4
-  or 7), flags (u32: bit0 v4_only, bit1 tcp_flags present), reserved
-  (u32), then ``n*width`` uint32 wire words, then ``n`` int32 TCP flags
-  when present.
+  or 7), flags (u32: bit0 v4_only, bit1 tcp_flags present, bit2 payload
+  column present), payload prefix width L (u32, the formerly-reserved
+  word; 0 unless bit2), then ``n*width`` uint32 wire words, then ``n``
+  int32 TCP flags when present, then the OPTIONAL payload-prefix column
+  (ISSUE-19): ``n*L`` uint8 payload bytes + ``n`` int32 payload lengths.
+  L is one of kernels.wire_decode.PAYLOAD_PREFIX_WIDTHS (64/128) so the
+  column lands in the fixed jit geometry buckets the Aho-Corasick match
+  compiles against.
 
 Single-producer / single-consumer by design (the deployment shape: one
 loadgen or NIC-facing shim per daemon); the commit word gives the
@@ -50,17 +55,23 @@ _SLOT_HEADER_BYTES = 64
 #: record flag bits
 FLAG_V4_ONLY = 1
 FLAG_TCP_FLAGS = 2
+FLAG_PAYLOAD = 4
 
 DEFAULT_SLOTS = 64
 DEFAULT_SLOT_PACKETS = 4096
 
 
 def slot_bytes_for(max_packets: int, width: int = 7,
-                   with_flags: bool = True) -> int:
-    """Slot size fitting ``max_packets`` of the widest record shape."""
+                   with_flags: bool = True,
+                   payload_width: int = 0) -> int:
+    """Slot size fitting ``max_packets`` of the widest record shape.
+    ``payload_width`` > 0 reserves the per-packet payload-prefix column
+    (L uint8 bytes + one int32 length)."""
     n = _SLOT_HEADER_BYTES + max_packets * width * 4
     if with_flags:
         n += max_packets * 4
+    if payload_width:
+        n += max_packets * (int(payload_width) + 4)
     return (n + 63) & ~63
 
 
@@ -72,14 +83,20 @@ class RingChunk:
     materialized (the daemon keeps it in the in-flight job), or copy.
     """
 
-    __slots__ = ("wire", "tcp_flags", "v4_only", "seq", "_ring")
+    __slots__ = ("wire", "tcp_flags", "payload", "payload_len",
+                 "v4_only", "seq", "_ring")
 
-    def __init__(self, ring, seq, wire, tcp_flags, v4_only):
+    def __init__(self, ring, seq, wire, tcp_flags, v4_only,
+                 payload=None, payload_len=None):
         self._ring = ring
         self.seq = seq
         self.wire = wire
         self.tcp_flags = tcp_flags
         self.v4_only = v4_only
+        #: optional ring-sliced payload-prefix column (ISSUE-19):
+        #: (n, L) uint8 view + (n,) int32 lengths, or None
+        self.payload = payload
+        self.payload_len = payload_len
 
     def release(self) -> None:
         """Return the slot to the producer (advance tail past seq).
@@ -134,12 +151,13 @@ class IngestRing:
 
     @classmethod
     def create(cls, path: str, slots: int = DEFAULT_SLOTS,
-               slot_packets: int = DEFAULT_SLOT_PACKETS) -> "IngestRing":
+               slot_packets: int = DEFAULT_SLOT_PACKETS,
+               payload_width: int = 0) -> "IngestRing":
         # build the ring under a temp name and rename into place: a
         # producer's attach() (which retries until the path exists) can
         # then never map a half-initialized file — the header, cursors
         # and zeroed commit words are all durable before visibility
-        slot_b = slot_bytes_for(slot_packets)
+        slot_b = slot_bytes_for(slot_packets, payload_width=payload_width)
         total = _HEADER_BYTES + slots * slot_b
         tmp = f"{path}.tmp.{os.getpid()}"
         fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
@@ -244,26 +262,40 @@ class IngestRing:
 
     # -- producer ------------------------------------------------------------
 
-    def max_packets(self, width: int = 7, with_flags: bool = True) -> int:
+    def max_packets(self, width: int = 7, with_flags: bool = True,
+                    payload_width: int = 0) -> int:
         avail = self.slot_bytes - _SLOT_HEADER_BYTES
         per = width * 4 + (4 if with_flags else 0)
+        if payload_width:
+            per += int(payload_width) + 4
         return avail // per
 
     def reserve(self, n: int, width: int,
                 with_flags: bool = False,
+                payload_width: int = 0,
                 timeout: Optional[float] = None):
         """Producer half 1: claim the next slot and return in-place
         views -> (wire (n, width) uint32 view, flags (n,) int32 view or
-        None, token).  The producer packs straight into the views (no
-        intermediate chunk array), then ``commit(token)`` publishes.
-        Blocks while the ring is full (backpressure); raises
-        TimeoutError past ``timeout`` seconds."""
+        None, token) — or, with ``payload_width`` L > 0, (wire, flags,
+        payload (n, L) uint8 view, payload_len (n,) int32 view, token).
+        The producer packs straight into the views (no intermediate
+        chunk array), then ``commit(token)`` publishes.  Blocks while
+        the ring is full (backpressure); raises TimeoutError past
+        ``timeout`` seconds."""
         if n < 1 or width not in (4, 7):
             raise ValueError(f"bad record shape n={n} width={width}")
-        if n > self.max_packets(width, with_flags):
+        if payload_width:
+            from .kernels.wire_decode import PAYLOAD_PREFIX_WIDTHS
+
+            if payload_width not in PAYLOAD_PREFIX_WIDTHS:
+                raise ValueError(
+                    f"payload prefix width {payload_width} not in "
+                    f"{PAYLOAD_PREFIX_WIDTHS}"
+                )
+        if n > self.max_packets(width, with_flags, payload_width):
             raise ValueError(
                 f"record of {n} packets exceeds the slot capacity "
-                f"{self.max_packets(width, with_flags)}"
+                f"{self.max_packets(width, with_flags, payload_width)}"
             )
         deadline = None if timeout is None else time.monotonic() + timeout
         seq = self.head
@@ -285,19 +317,29 @@ class IngestRing:
         off = self._slot_off(seq)
         hdr32 = np.frombuffer(self._mm, np.uint32, 4, off + 8)
         flags = (FLAG_TCP_FLAGS if with_flags else 0)
+        if payload_width:
+            flags |= FLAG_PAYLOAD
         hdr32[0] = n
         hdr32[1] = width
         hdr32[2] = flags
+        hdr32[3] = int(payload_width)
         wire = np.frombuffer(
             self._mm, np.uint32, n * width, off + _SLOT_HEADER_BYTES
         ).reshape(n, width)
+        cursor = off + _SLOT_HEADER_BYTES + n * width * 4
         fl = None
         if with_flags:
-            fl = np.frombuffer(
-                self._mm, np.int32, n,
-                off + _SLOT_HEADER_BYTES + n * width * 4,
-            )
-        return wire, fl, (seq, off)
+            fl = np.frombuffer(self._mm, np.int32, n, cursor)
+            cursor += n * 4
+        if not payload_width:
+            return wire, fl, (seq, off)
+        pay = np.frombuffer(
+            self._mm, np.uint8, n * payload_width, cursor
+        ).reshape(n, payload_width)
+        plen = np.frombuffer(
+            self._mm, np.int32, n, cursor + n * payload_width
+        )
+        return wire, fl, pay, plen, (seq, off)
 
     def commit(self, token, v4_only: bool = False) -> int:
         """Producer half 2: publish the reserved record (commit-word
@@ -317,13 +359,30 @@ class IngestRing:
 
     def push(self, wire: np.ndarray, v4_only: bool = False,
              tcp_flags: Optional[np.ndarray] = None,
+             payload: Optional[np.ndarray] = None,
+             payload_len: Optional[np.ndarray] = None,
              timeout: Optional[float] = None) -> int:
         """One-call producer convenience: reserve + in-place copy +
-        commit."""
+        commit.  ``payload`` must already be bucketed to a
+        PAYLOAD_PREFIX_WIDTHS column (kernels.wire_decode.
+        pad_payload_prefix)."""
         n, width = wire.shape
-        wv, fv, token = self.reserve(
-            n, width, with_flags=tcp_flags is not None, timeout=timeout
-        )
+        if payload is None:
+            wv, fv, token = self.reserve(
+                n, width, with_flags=tcp_flags is not None,
+                timeout=timeout,
+            )
+        else:
+            wv, fv, pv, lv, token = self.reserve(
+                n, width, with_flags=tcp_flags is not None,
+                payload_width=payload.shape[1], timeout=timeout,
+            )
+            np.copyto(pv, np.asarray(payload, np.uint8))
+            np.copyto(lv, (
+                np.asarray(payload_len, np.int32)
+                if payload_len is not None
+                else np.full(n, payload.shape[1], np.int32)
+            ))
         np.copyto(wv, wire)
         if tcp_flags is not None:
             np.copyto(fv, np.asarray(tcp_flags, np.int32))
@@ -350,11 +409,13 @@ class IngestRing:
         off = self._slot_off(seq)
         hdr32 = np.frombuffer(self._mm, np.uint32, 4, off + 8)
         n, width, flags = int(hdr32[0]), int(hdr32[1]), int(hdr32[2])
+        pw = int(hdr32[3]) if flags & FLAG_PAYLOAD else 0
         # the sanity bound must use the RECORD's own layout: a
         # flag-less record legally holds more packets than a flagged
         # one of the same slot size
-        cap = self.max_packets(width, bool(flags & FLAG_TCP_FLAGS))
-        if width not in (4, 7) or n < 1 or n > cap:
+        cap = self.max_packets(width, bool(flags & FLAG_TCP_FLAGS), pw)
+        bad_pw = bool(flags & FLAG_PAYLOAD) and pw not in (64, 128)
+        if width not in (4, 7) or n < 1 or bad_pw or n > cap:
             # fail closed on a torn/corrupt record: skip the READ
             # cursor only — the slot frees when the release order
             # reaches it (_drain_skipped), never by bumping the tail
@@ -364,23 +425,30 @@ class IngestRing:
             self._drain_skipped()
             raise ValueError(
                 f"corrupt ring record at seq {seq}: n={n} width={width}"
+                f" payload_width={pw}"
             )
         wire = np.frombuffer(
             self._mm, np.uint32, n * width, off + _SLOT_HEADER_BYTES
         ).reshape(n, width)
+        cursor = off + _SLOT_HEADER_BYTES + n * width * 4
         fl = None
         if flags & FLAG_TCP_FLAGS:
-            fl = np.frombuffer(
-                self._mm, np.int32, n,
-                off + _SLOT_HEADER_BYTES + n * width * 4,
-            )
+            fl = np.frombuffer(self._mm, np.int32, n, cursor)
+            cursor += n * 4
+        pay = plen = None
+        if pw:
+            pay = np.frombuffer(
+                self._mm, np.uint8, n * pw, cursor
+            ).reshape(n, pw)
+            plen = np.frombuffer(self._mm, np.int32, n, cursor + n * pw)
         self._stats["popped"] += 1
         depth = self.head - seq
         sched_point("ring-hwm-cons")
         if depth > self._stats["depth_hwm_cons"]:
             self._stats["depth_hwm_cons"] = depth
         self._read_seq = seq + 1
-        return RingChunk(self, seq, wire, fl, bool(flags & FLAG_V4_ONLY))
+        return RingChunk(self, seq, wire, fl, bool(flags & FLAG_V4_ONLY),
+                         payload=pay, payload_len=plen)
 
     # -- observability -------------------------------------------------------
 

@@ -1,0 +1,613 @@
+"""Payload-matching tier (ISSUE-19): Aho-Corasick lowering vs two
+independent host references, device gather/matmul kernel parity,
+prefix-truncation semantics, versioned pattern artifacts + hot swap,
+the PayloadTier facade, the ring payload column and the daemon factory
+gating.
+
+Tier-1 keeps the cheap host-side construction/semantics/artifact tests
+plus two small device-kernel parity tests; the jit-heavy classifier
+serving paths (classic + resident fused + superbatch, enforce/failsafe
+precedence on device) and the statecheck sweeps are slow-marked and
+run in ``make test``, ``make state-check`` (payload configs + the
+aclink acceptance) and ``make payload-bench`` (oracle + retention +
+hot-swap + enforce gates).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from infw import oracle, testing
+from infw.backend.cpu_ref import HostAcAutomaton, payload_match_ref
+from infw.kernels import acmatch
+from infw.kernels.acmatch import (
+    AcSpec,
+    compile_patterns,
+    host_match_bitmap,
+    host_payload_rewrite,
+    jitted_acmatch,
+    model_device,
+    validate_patterns,
+)
+from infw.kernels.jaxpath import TCP_ACK
+from infw.kernels.wire_decode import (
+    pad_payload_prefix,
+    payload_prefix_bucket,
+)
+from infw.payload import (
+    PayloadTier,
+    attack_payloads,
+    benign_payloads,
+    load_patterns,
+    save_patterns,
+    signature_patterns,
+)
+
+#: overlapping-suffix set — the failure-link surface (suffix patterns
+#: must be reported by states their failure chains reach)
+OVERLAP = [b"/etc/passwd", b"etc/passwd", b"passwd", b"ab", b"b",
+           b"abab"]
+
+
+def _host_dfa_bitmap(model, pay, plen):
+    """Walk the COMPILED dense DFA on the host — a third reference
+    beside the naive scan and HostAcAutomaton, pinning exactly what the
+    device kernel computes."""
+    pay = np.asarray(pay, np.uint8)
+    out = np.zeros((pay.shape[0], model.spec.pwords), np.uint32)
+    for i in range(pay.shape[0]):
+        s = 0
+        n = int(min(plen[i], model.spec.plen))
+        for c in pay[i, :n]:
+            s = int(model.delta[s, int(c)])
+            out[i] |= model.matchmap[s]
+    return out
+
+
+# --- spec / validation -------------------------------------------------------
+
+
+def test_acspec_buckets():
+    s = AcSpec.make(65, 33)
+    assert s.states == 128 and s.patterns == 64 and s.pwords == 2
+    assert AcSpec.make(1, 1).states == 64
+    assert AcSpec.make(1, 1).patterns == 32
+    # matmul defaults on for tiny automata, off past the threshold
+    assert AcSpec.make(64, 32).matmul
+    assert not AcSpec.make(acmatch.MATMUL_MAX_STATES + 1, 32).matmul
+    with pytest.raises(ValueError):
+        AcSpec.make(64, 32, plen=96)
+    # same-bucket pattern sets share a spec (the hot-swap key)
+    a = compile_patterns([b"abc", b"xy"], plen=64).spec
+    b = compile_patterns([b"zzz", b"qq", b"p"], plen=64).spec
+    assert a == b
+
+
+def test_validate_patterns_rejects():
+    with pytest.raises(ValueError):
+        validate_patterns([], 64)
+    with pytest.raises(ValueError):
+        validate_patterns([b""], 64)
+    with pytest.raises(ValueError):
+        validate_patterns([b"x" * 65], 64)  # could never fire
+    with pytest.raises(ValueError):
+        validate_patterns([b"ab", b"ab"], 64)
+    with pytest.raises(ValueError):
+        validate_patterns(["ab"], 64)
+    validate_patterns([b"x" * 64, b"y"], 64)  # exactly plen is fine
+
+
+def test_compile_refuses_oversized_hot_swap():
+    spec = compile_patterns([b"ab", b"cd"], plen=64).spec
+    big = signature_patterns(np.random.default_rng(0), 40, plen=64)
+    with pytest.raises(ValueError):
+        compile_patterns(big, plen=64, spec=spec)
+    with pytest.raises(ValueError):
+        compile_patterns([b"ab"], plen=128, spec=spec)
+
+
+# --- construction vs independent references ----------------------------------
+
+
+def test_compiled_dfa_matches_naive_and_host_ac():
+    rng = np.random.default_rng(3)
+    pats = OVERLAP + signature_patterns(rng, 20, plen=64)[8:]
+    model = compile_patterns(pats, plen=64)
+    pay, plen = attack_payloads(rng, 64, pats, plen=64)
+    want = payload_match_ref(pats, pay, plen, 64, model.spec.pwords)
+    got = _host_dfa_bitmap(model, pay, plen)
+    assert np.array_equal(got, want)
+    # third angle: the link-walking host automaton on the same prefixes
+    ac = HostAcAutomaton(pats)
+    for i in range(pay.shape[0]):
+        n = int(min(plen[i], 64))
+        idx = ac.matches(pay[i, :n].tobytes())
+        ref = {
+            j for j in range(len(pats))
+            if want[i, j // 32] >> (j % 32) & 1
+        }
+        assert idx == ref
+    # host_match_bitmap is the naive reference, re-exported
+    assert np.array_equal(host_match_bitmap(model, pay, plen), want)
+
+
+def test_aclink_defect_diverges_from_naive_oracle():
+    """The injected construction defect MUST be visible to the naive
+    reference (the statecheck catch) — if this compare ever passes with
+    the flag on, the defect registry's bound is meaningless."""
+    pats = OVERLAP
+    # the dropped fold lands on the FIRST BFS state whose failure chain
+    # carries output — here the "ab" state, which must also report the
+    # suffix pattern "b"; sweep payloads exercising every chain so the
+    # witness stays robust to BFS-order changes
+    pay = np.zeros((2, 64), np.uint8)
+    pay[0, :4] = np.frombuffer(b"abab", np.uint8)
+    pay[1, :11] = np.frombuffer(b"/etc/passwd", np.uint8)
+    plen = np.asarray([64, 64], np.int32)
+    want = payload_match_ref(pats, pay, plen, 64, 1)
+    acmatch._INJECT_ACLINK_BUG = True
+    try:
+        bad = compile_patterns(pats, plen=64)
+    finally:
+        acmatch._INJECT_ACLINK_BUG = False
+    assert not np.array_equal(_host_dfa_bitmap(bad, pay, plen), want)
+    good = compile_patterns(pats, plen=64)
+    assert np.array_equal(_host_dfa_bitmap(good, pay, plen), want)
+
+
+# --- device kernel parity ----------------------------------------------------
+
+
+def test_device_gather_matches_oracle():
+    rng = np.random.default_rng(5)
+    pats = signature_patterns(rng, 33, plen=64)  # 2 match words
+    model = compile_patterns(pats, plen=64, matmul=False)
+    trans, mmap = model_device(model)
+    pay, plen = attack_payloads(rng, 32, pats, plen=64)
+    got = np.asarray(jitted_acmatch(model.spec)(
+        trans, mmap, pay, plen.astype(np.int32)
+    ))
+    want = payload_match_ref(pats, pay, plen, 64, model.spec.pwords)
+    assert np.array_equal(got.astype(np.uint32), want)
+
+
+def test_device_matmul_matches_gather():
+    rng = np.random.default_rng(6)
+    pats = [b"ab", b"b", b"cde", b"\x00\x01"]  # tiny -> matmul bucket
+    m_mm = compile_patterns(pats, plen=64, matmul=True)
+    m_ga = compile_patterns(pats, plen=64, matmul=False)
+    assert m_mm.spec.matmul and not m_ga.spec.matmul
+    pay, plen = attack_payloads(rng, 16, pats, plen=64)
+    got_mm = np.asarray(jitted_acmatch(m_mm.spec)(
+        *model_device(m_mm), pay, plen.astype(np.int32)
+    ))
+    got_ga = np.asarray(jitted_acmatch(m_ga.spec)(
+        *model_device(m_ga), pay, plen.astype(np.int32)
+    ))
+    want = payload_match_ref(pats, pay, plen, 64, m_mm.spec.pwords)
+    assert np.array_equal(got_mm.astype(np.uint32), want)
+    assert np.array_equal(got_ga.astype(np.uint32), want)
+
+
+def test_truncation_boundary_semantics():
+    """A pattern occurrence must end wholly within
+    min(plen[i], prefix) — straddling the cut or the valid-length
+    boundary claims nothing, and zero padding never walks the
+    automaton."""
+    pats = [b"abcd", b"d"]
+    model = compile_patterns(pats, plen=64, matmul=False)
+    pay = np.zeros((4, 64), np.uint8)
+    pay[0, 60:64] = np.frombuffer(b"abcd", np.uint8)  # ends AT the cut
+    pay[1, 62:64] = np.frombuffer(b"ab", np.uint8)    # straddles it
+    pay[2, 10:14] = np.frombuffer(b"abcd", np.uint8)  # past plen=12
+    pay[3, 0:4] = np.frombuffer(b"abcd", np.uint8)    # pad region zero
+    plen = np.asarray([64, 64, 12, 4], np.int32)
+    got = np.asarray(jitted_acmatch(model.spec)(
+        *model_device(model), pay, plen
+    )).astype(np.uint32)
+    want = payload_match_ref(pats, pay, plen, 64, model.spec.pwords)
+    assert np.array_equal(got, want)
+    assert got[0, 0] == 0b11   # both occurrences end AT the cut
+    assert got[1, 0] == 0      # straddles the prefix cut
+    assert got[2, 0] == 0      # every occurrence ends past plen=12
+    assert got[3, 0] == 0b11   # both end exactly at plen=4
+
+
+def test_enforce_rewrite_failsafe_precedence_host():
+    from infw.constants import ALLOW, DENY
+
+    pats = [b"sig"]
+    model = compile_patterns(pats, plen=64)
+    bitmap = np.asarray([[1], [1], [0], [1]], np.uint32)
+    res = np.asarray(
+        [ALLOW | (7 << 8), ALLOW | (8 << 8), ALLOW, DENY | (3 << 8)],
+        np.uint32,
+    )
+    proto = np.full(4, 6, np.int32)
+    dst_port = np.asarray([22, 8080, 8080, 8080], np.int32)  # 22 = fs
+    out = host_payload_rewrite(model, res, bitmap, True, proto, dst_port)
+    assert out[0] == res[0]          # failsafe cell never rewritten
+    assert out[1] == acmatch.PAYLOAD_DENY_RESULT
+    assert out[2] == res[2]          # no match -> untouched
+    assert out[3] == res[3]          # already DENY -> untouched
+    # shadow never touches verdicts
+    assert np.array_equal(
+        host_payload_rewrite(model, res, bitmap, False, proto, dst_port),
+        res,
+    )
+
+
+# --- wire format / packets / ring -------------------------------------------
+
+
+def test_pad_payload_prefix_buckets():
+    assert payload_prefix_bucket(1) == 64
+    assert payload_prefix_bucket(64) == 64
+    assert payload_prefix_bucket(65) == 128
+    assert payload_prefix_bucket(4096) == 128
+    pay = np.arange(3 * 40, dtype=np.uint8).reshape(3, 40)
+    out, lens = pad_payload_prefix(pay, np.asarray([40, 10, 99]))
+    assert out.shape == (3, 64) and out.dtype == np.uint8
+    assert np.array_equal(out[:, :40], pay)
+    assert not out[:, 40:].any()
+    assert lens.tolist() == [40, 10, 64]  # clamped to the bucket
+    wide = np.zeros((2, 200), np.uint8)
+    out2, lens2 = pad_payload_prefix(wide, np.asarray([150, 5]))
+    assert out2.shape == (2, 128)
+    assert lens2.tolist() == [128, 5]
+
+
+def test_packet_batch_payload_columns():
+    tabs = testing.random_tables(np.random.default_rng(1), n_entries=16)
+    batch = testing.random_batch(np.random.default_rng(2), tabs, 8)
+    batch.payload = np.arange(8 * 64, dtype=np.uint8).reshape(8, 64)
+    batch.payload_len = np.full(8, 64, np.int32)
+    s = batch.slice(2, 6)
+    assert np.array_equal(s.payload, batch.payload[2:6])
+    assert np.array_equal(s.payload_len, batch.payload_len[2:6])
+    idx = np.asarray([7, 0, 3])
+    t = batch.take(idx)
+    assert np.array_equal(t.payload, batch.payload[idx])
+    assert np.array_equal(t.payload_len, batch.payload_len[idx])
+
+
+def test_ring_payload_roundtrip(tmp_path):
+    from infw.ring import IngestRing
+
+    path = str(tmp_path / "ingest.ring")
+    ring = IngestRing.create(path, slots=4, slot_packets=64,
+                             payload_width=64)
+    prod = IngestRing.attach(path)
+    w = np.arange(16 * 7, dtype=np.uint32).reshape(16, 7)
+    fl = np.arange(16, dtype=np.int32)
+    pay = np.arange(16 * 64, dtype=np.uint8).reshape(16, 64)
+    plen = np.full(16, 33, np.int32)
+    prod.push(w, v4_only=False, tcp_flags=fl, payload=pay,
+              payload_len=plen)
+    prod.push(w, v4_only=True)  # payload-free record on the same ring
+    chunk = ring.pop()
+    assert np.array_equal(chunk.wire, w)
+    assert np.array_equal(chunk.tcp_flags, fl)
+    assert np.array_equal(chunk.payload, pay)
+    assert np.array_equal(chunk.payload_len, plen)
+    chunk.release()
+    chunk2 = ring.pop()
+    assert chunk2.payload is None and chunk2.payload_len is None
+    chunk2.release()
+    prod.close()
+    ring.close()
+
+
+# --- artifacts ---------------------------------------------------------------
+
+
+def test_pattern_artifact_roundtrip(tmp_path):
+    pats = signature_patterns(np.random.default_rng(4), 12, plen=64)
+    path = str(tmp_path / "sigs.npz")
+    mpath = save_patterns(pats, path, plen=64, version="v7")
+    assert os.path.exists(mpath)
+    got, spec, version = load_patterns(path)
+    assert got == [bytes(p) for p in pats]
+    assert version == "v7"
+    assert spec == compile_patterns(pats, plen=64).spec
+
+
+def test_pattern_artifact_rejects_corruption(tmp_path):
+    pats = [b"abc", b"de"]
+    path = str(tmp_path / "sigs.npz")
+    save_patterns(pats, path, plen=64)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+    with pytest.raises(ValueError, match="checksum"):
+        load_patterns(path)
+    os.unlink(path + ".json")
+    with pytest.raises(ValueError, match="manifest"):
+        load_patterns(path)
+
+
+# --- the tier facade ---------------------------------------------------------
+
+
+def test_tier_swap_mode_and_counters():
+    pats = signature_patterns(np.random.default_rng(0), 8, plen=64)
+    tier = PayloadTier(pats, plen=64, mode="shadow", keep_masks=4)
+    assert tier.version == 0 and not tier.enforce
+    spec0 = tier.spec
+    fired = []
+    tier.on_swap = lambda: fired.append(1)
+    tier._masks.append(("stale",))
+    tier.swap_patterns(signature_patterns(np.random.default_rng(1), 8,
+                                          plen=64))
+    assert tier.version == 1 and tier.spec == spec0
+    assert fired == [1]
+    # retained masks were matched by the OLD automaton — must be gone
+    assert not tier._masks
+    cv = tier.counter_values()
+    assert cv["payload_pattern_swaps_total"] == 1
+    assert cv["payload_patternset_version"] == 1
+    assert cv["payload_patterns"] == 8
+    # geometry-changing swap refuses (would recompile under the hood)
+    with pytest.raises(ValueError):
+        tier.swap_patterns(
+            signature_patterns(np.random.default_rng(2), 8, plen=128),
+            plen=128,
+        )
+    tier.set_mode("enforce")
+    assert tier.enforce
+    with pytest.raises(ValueError):
+        tier.set_mode("observe")
+    with pytest.raises(ValueError):
+        PayloadTier(pats, mode="observe")
+
+
+def test_tier_match_vs_oracle():
+    pats = signature_patterns(np.random.default_rng(0), 8, plen=64)
+    tier = PayloadTier(pats, plen=64)
+    pay, plen = attack_payloads(np.random.default_rng(1), 16, pats,
+                                plen=64)
+    got = np.asarray(tier.match(pay, plen)).astype(np.uint32)
+    want = payload_match_ref(pats, pay, plen, 64, tier.spec.pwords)
+    assert np.array_equal(got, want)
+
+
+# --- generators --------------------------------------------------------------
+
+
+def test_traffic_generators_deterministic():
+    pats = signature_patterns(np.random.default_rng(9), 16, plen=64)
+    assert pats == signature_patterns(np.random.default_rng(9), 16,
+                                      plen=64)
+    assert len(set(pats)) == 16
+    assert all(1 <= len(p) <= 64 for p in pats)
+    pay, lens = benign_payloads(np.random.default_rng(3), 32, plen=64)
+    assert pay.shape == (32, 64) and (lens <= 64).all() and (lens > 0).all()
+    a1 = attack_payloads(np.random.default_rng(5), 32, pats, plen=64)
+    a2 = attack_payloads(np.random.default_rng(5), 32, pats, plen=64)
+    assert np.array_equal(a1[0], a2[0]) and np.array_equal(a1[1], a2[1])
+    # the planted signatures are real: a solid majority must match
+    # (the deliberate boundary-straddlers are the ~15% exception)
+    hits = payload_match_ref(pats, a1[0], a1[1], 64, 1)
+    assert (hits != 0).any(axis=1).mean() > 0.6
+
+
+def test_loadgen_payload_shapes():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        from loadgen import decode_attack_labels, synth_payload
+    finally:
+        sys.path.pop(0)
+    rng = np.random.default_rng(2)
+    pay, lens, meta = synth_payload(rng, 100, "attack-mix", 64, 0, 16,
+                                    0.3, 32)
+    assert pay.shape == (100, 64) and lens.shape == (100,)
+    assert meta["payload_bytes_per_packet"] == 68
+    mask = decode_attack_labels(
+        meta["payload_labels"]["record_bitmaps_hex"], 100, 32
+    )
+    assert int(mask.sum()) == meta["payload_signature_packets"] > 0
+    # labeled lanes carry the seeded pattern set's signatures
+    pats = signature_patterns(np.random.default_rng(0), 16, plen=64)
+    hits = payload_match_ref(pats, pay[mask], lens[mask], 64, 1)
+    assert (hits != 0).any(axis=1).mean() > 0.6
+    _pay2, _lens2, meta2 = synth_payload(
+        np.random.default_rng(2), 50, "http", 64, 0, 16, 0.3, 32
+    )
+    assert "payload_labels" not in meta2
+
+
+# --- daemon factory gating ---------------------------------------------------
+
+
+def test_factory_payload_gating():
+    from infw.daemon import make_classifier_factory
+    from infw.flow import FlowConfig
+
+    pats = signature_patterns(np.random.default_rng(0), 4, plen=64)
+    cpu = make_classifier_factory(backend="cpu", payload=pats)()
+    assert getattr(cpu, "payload", None) is None  # headers-only on cpu
+    tpu = make_classifier_factory(
+        backend="tpu", resident=True,
+        flow_table=FlowConfig.make(entries=256), payload=pats,
+        payload_mode="enforce",
+    )()
+    assert tpu.payload is not None and tpu.payload.enforce
+    tpu.close()
+
+
+# --- jit-heavy serving paths (slow tier) -------------------------------------
+
+
+def _served_tables():
+    return testing.random_tables_fast(
+        np.random.default_rng(3), n_entries=300, width=4,
+        v6_fraction=0.4, ifindexes=(2, 3),
+    )
+
+
+@pytest.mark.slow
+def test_classifier_paths_payload_oracle():
+    """Classic + resident fused serving paths: shadow verdicts stay
+    bit-identical to the CPU oracle, device bitmaps to the naive host
+    reference, and the served hit bits to the standalone kernel."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.flow import FlowConfig
+
+    tabs = _served_tables()
+    pats = signature_patterns(np.random.default_rng(11), 8, plen=64)
+    bs = 64
+    batch = testing.random_batch(np.random.default_rng(21), tabs, bs * 3)
+    batch.tcp_flags = np.full(len(batch), TCP_ACK, np.int32)
+    pay_a, len_a = attack_payloads(np.random.default_rng(22), bs, pats,
+                                   plen=64)
+    pay_b, len_b = benign_payloads(np.random.default_rng(23), bs * 2,
+                                   plen=64)
+    batch.payload = np.concatenate([pay_a, pay_b])
+    batch.payload_len = np.concatenate([len_a, len_b]).astype(np.int32)
+    ref = oracle.classify(tabs, batch)
+    for kw in (
+        dict(force_path="trie"),
+        dict(force_path="trie", resident=True,
+             flow_table=FlowConfig.make(entries=512)),
+    ):
+        clf = TpuClassifier(payload=pats, payload_plen=64,
+                            payload_track=True, **kw)
+        clf.load_tables(tabs)
+        for lo in range(0, len(batch), bs):
+            out = clf.classify(batch.slice(lo, lo + bs),
+                               apply_stats=False)
+            assert np.array_equal(out.results,
+                                  ref.results[lo:lo + bs])
+        for pay, plen, bitmap, hit in clf.payload.recent_masks():
+            want = payload_match_ref(pats, pay, plen, 64,
+                                     clf.payload.spec.pwords)
+            assert np.array_equal(np.asarray(bitmap, np.uint32), want)
+            assert np.array_equal(np.asarray(hit, bool),
+                                  (want != 0).any(axis=1))
+        clf.close()
+
+
+@pytest.mark.slow
+def test_enforce_failsafe_precedence_fused():
+    """Enforce mode on the resident fused path: signature lanes at open
+    ports are denied, failsafe cells keep their rule verdicts."""
+    from infw.backend.tpu import TpuClassifier
+    from infw.constants import DENY
+    from infw.flow import FlowConfig
+    from infw.kernels.mxu_score import failsafe_lane_mask_np
+
+    tabs = _served_tables()
+    pats = [b"evil-sig"]
+    bs = 64
+    batch = testing.random_batch(np.random.default_rng(31), tabs, bs)
+    batch.proto[:] = 6
+    batch.dst_port[: bs // 2] = 22  # SSH failsafe cell
+    batch.dst_port[bs // 2:] = 8080
+    batch.tcp_flags = np.full(bs, TCP_ACK, np.int32)
+    pay = np.zeros((bs, 64), np.uint8)
+    pay[:, 7:15] = np.frombuffer(b"evil-sig", np.uint8)
+    batch.payload = pay
+    batch.payload_len = np.full(bs, 64, np.int32)
+    ref = oracle.classify(tabs, batch)
+    clf = TpuClassifier(force_path="trie", resident=True,
+                        flow_table=FlowConfig.make(entries=512),
+                        payload=pats, payload_plen=64,
+                        payload_mode="enforce")
+    clf.load_tables(tabs)
+    out = clf.classify(batch, apply_stats=False)
+    fs = failsafe_lane_mask_np(batch.proto, batch.dst_port)
+    assert fs[: bs // 2].all() and not fs[bs // 2:].any()
+    assert np.array_equal(out.results[fs], ref.results[fs])
+    open_hit = ~fs & ((ref.results & 0xFF) != DENY)
+    assert ((out.results[open_hit] & 0xFF) == DENY).all()
+    assert clf.payload.counter_values()["payload_enforced_total"] > 0
+    clf.close()
+
+
+@pytest.mark.slow
+def test_daemon_ring_superbatch_payload(tmp_path):
+    """Ring ingest with the payload column through the superbatch epoch
+    loop: lanes/matches counted, patterns-dir hot swap consumed and
+    re-applied to a rebuilt classifier generation."""
+    from infw.daemon import Daemon
+    from infw.flow import FlowConfig
+    from infw.ring import IngestRing
+
+    rng = np.random.default_rng(7)
+    pats = signature_patterns(rng, 8, plen=64)
+    ringp = str(tmp_path / "ingest.ring")
+    daemon = Daemon(
+        state_dir=str(tmp_path), node_name="n1", backend="tpu",
+        resident=True, ring=ringp, superbatch_k=2, metrics_port=0,
+        health_port=0, file_poll_interval_s=10.0,
+        flow_table=FlowConfig.make(entries=512),
+        payload=pats, payload_mode="enforce", payload_plen=64,
+    )
+    try:
+        tabs = _served_tables()
+        clf = daemon.syncer._factory()
+        clf.load_tables(tabs)
+        daemon.syncer._classifier = clf
+        bs, n_chunks = 64, 3
+        batch = testing.random_batch_fast(
+            np.random.default_rng(41), tabs, bs * n_chunks
+        )
+        wire = batch.pack_wire()
+        tflags = (np.zeros(len(batch), np.int32)
+                  if batch.tcp_flags is None
+                  else np.asarray(batch.tcp_flags, np.int32))
+        pay = rng.integers(0, 256, size=(len(batch), 64), dtype=np.uint8)
+        sig = pats[0]
+        for i in range(0, len(batch), 2):
+            pay[i, 5:5 + len(sig)] = np.frombuffer(sig, np.uint8)
+        plen = np.full(len(batch), 64, np.int32)
+        prod = IngestRing.attach(ringp)
+        for lo in range(0, len(batch), bs):
+            prod.push(np.ascontiguousarray(wire[lo:lo + bs]),
+                      v4_only=False,
+                      tcp_flags=np.ascontiguousarray(tflags[lo:lo + bs]),
+                      payload=np.ascontiguousarray(pay[lo:lo + bs]),
+                      payload_len=np.ascontiguousarray(plen[lo:lo + bs]))
+        n = daemon.process_ring_once(budget=10 ** 9)
+        assert n == bs * n_chunks
+        assert (clf.resident_counters()
+                ["resident_superbatch_dispatches_total"] >= 1)
+        cv = daemon._payload_counters.counter_values()
+        assert cv["payload_admissions_total"] == n_chunks
+        assert cv["payload_lanes_total"] == bs * n_chunks
+        assert cv["payload_matched_total"] >= bs * n_chunks // 2
+        assert cv["payload_enforced_total"] > 0
+        v0 = cv["payload_patternset_version"]
+
+        new_pats = signature_patterns(np.random.default_rng(9), 8,
+                                      plen=64)
+        save_patterns(new_pats,
+                      os.path.join(daemon.patterns_dir, "s1.npz"),
+                      plen=64, version="v-test-1")
+        daemon._payload_maintenance()
+        assert not os.listdir(daemon.patterns_dir)
+        cv2 = daemon._payload_counters.counter_values()
+        assert cv2["payload_patternset_version"] == v0 + 1
+        # a rebuilt classifier generation gets the swapped set
+        clf2 = daemon.syncer._factory()
+        clf2.load_tables(tabs)
+        daemon.syncer._classifier = clf2
+        daemon._payload_maintenance()
+        assert clf2.payload.version == 1
+        assert "payload_matched_total" in \
+            daemon.metrics_registry.render_text()
+        prod.close()
+    finally:
+        daemon.stop()
+
+
+@pytest.mark.slow
+def test_statecheck_payload_configs():
+    from infw.analysis import statecheck
+
+    for cfg in ("payload", "payload-resident"):
+        rep = statecheck.run_config(cfg, seed=0, n_ops=6,
+                                    shrink_on_failure=False)
+        assert rep["ok"], rep

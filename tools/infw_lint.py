@@ -328,7 +328,8 @@ DEFAULT_STATE_CONFIGS = ("trie", "overlay", "wide", "nojoined", "ctrie",
                          "arena-ctrie", "arena-cow", "arena-splice",
                          "flow", "flow-ctrie",
                          "resident", "pipeline", "telemetry",
-                         "telemetry-resident")
+                         "telemetry-resident",
+                         "payload", "payload-resident")
 
 
 def _run_inject_defect(args, as_json: bool) -> int:
@@ -348,6 +349,7 @@ def _run_inject_defect(args, as_json: bool) -> int:
     from infw import flow as flow_mod, resident as resident_mod, txn as txn_mod
     from infw.analysis import statecheck
     from infw.kernels import (
+        acmatch as acmatch_mod,
         jaxpath,
         mxu_score as mxu_score_mod,
         sketch as sketch_mod,
@@ -422,6 +424,16 @@ def _run_inject_defect(args, as_json: bool) -> int:
         # traffic op
         "mlquant": (mxu_score_mod, "_INJECT_MLQUANT_BUG",
                     "mlscore", 3),
+        # dropped failure-link fold (infw.kernels.acmatch): automaton
+        # construction "forgets" to union one failure state's pattern
+        # outputs into its inheritors, so the DEVICE bitmap misses
+        # matches reached through failure transitions (overlapping
+        # patterns, signatures embedded mid-payload) while the NAIVE
+        # host substring oracle still claims them — the payload
+        # config's device-bitmap-vs-payload_match_ref pass diverges at
+        # the first settled check after a payload_traffic op, shrinking
+        # to that one op plus slack
+        "aclink": (acmatch_mod, "_INJECT_ACLINK_BUG", "payload", 4),
     }[defect]
     # the fold defect only fires on a delete-then-readd landing in one
     # transaction; give the seeded generator a horizon that reliably
@@ -731,7 +743,7 @@ def main(argv=None) -> int:
                          choices=("joined-pad", "cskip", "fold", "pageflip",
                                   "cowleak", "spliceleak", "flowstale",
                                   "residentstale", "slotepoch", "sketchsat",
-                                  "mlquant"),
+                                  "mlquant", "aclink"),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "

@@ -85,6 +85,63 @@ def synth_columns(rng: np.random.Generator, n: int, v6_fraction: float,
     }, n_flows
 
 
+def synth_payload(rng: np.random.Generator, n: int, shape: str,
+                  plen: int, pattern_seed: int, n_patterns: int,
+                  attack_fraction: float, file_packets: int):
+    """Payload-prefix columns for the --ring producer (ISSUE-19):
+    ``http`` is the benign HTTP-ish request mix
+    (infw.payload.benign_payloads); ``attack-mix`` overwrites a seeded
+    fraction of lanes with signature-bearing prefixes
+    (infw.payload.attack_payloads) drawn from the SAME deterministic
+    pattern set a daemon gets from ``--payload <n_patterns>`` at the
+    same seed — so the measuring side knows exactly which lanes must
+    match.  Returns (pay (n, plen) uint8, plens (n,) int32, meta);
+    the meta carries per-record ground-truth label bitmaps in the
+    attack-label encoding (decode_attack_labels) plus the pattern-set
+    coordinates.  Byte-deterministic per (seeded rng, arguments);
+    the header stream is untouched (payload rides beside the wire)."""
+    from infw.payload import (
+        attack_payloads,
+        benign_payloads,
+        signature_patterns,
+    )
+
+    pay, plens = benign_payloads(rng, n, plen=plen)
+    meta = {
+        "payload_shape": shape,
+        "payload_prefix_bytes": int(plen),
+        # ring-record cost per lane: the uint8 prefix column plus the
+        # int32 valid-byte count word
+        "payload_bytes_per_packet": int(plen) + 4,
+        "payload_pattern_seed": int(pattern_seed),
+        "payload_patterns": int(n_patterns),
+    }
+    if shape == "attack-mix":
+        pats = signature_patterns(
+            np.random.default_rng(pattern_seed), n_patterns, plen=plen
+        )
+        mask = rng.random(n) < float(attack_fraction)
+        k = int(mask.sum())
+        if k:
+            apay, alens = attack_payloads(rng, k, pats, plen=plen)
+            pay[mask] = apay
+            plens[mask] = alens
+        meta["payload_signature_packets"] = k
+        # same per-record hex-bitmap label encoding as the header
+        # attacks: which lanes the generator planted signatures in
+        # (decode_attack_labels recovers the mask).  NOTE ~15% of the
+        # planted lanes deliberately straddle the truncation boundary
+        # and must NOT match — the label marks "signature-bearing",
+        # not "must match"; exact match truth is the host oracle
+        # (infw.backend.cpu_ref.payload_match_ref) over these columns.
+        meta["payload_labels"] = {
+            "record_bitmaps_hex": encode_attack_labels(
+                mask, file_packets
+            ),
+        }
+    return pay, np.asarray(plens, np.int32), meta
+
+
 def encode_attack_labels(mask: np.ndarray, file_packets: int) -> list:
     """Per-record ground-truth label bitmaps: the (n,) bool attack-lane
     mask packed little-bit-first per record window and hex-encoded —
@@ -261,6 +318,18 @@ def _ring_main(args, rng, offs) -> int:
         established_fraction=args.established_fraction,
         file_packets=args.file_packets, attack=_attack_dict(args),
     )
+    pay = plens = None
+    payload_meta = {}
+    if args.payload != "none":
+        # a CHILD rng keyed off --seed: the header stream stays
+        # byte-identical to the same run with --payload none (payload
+        # rides beside the wire, never perturbs it)
+        pay, plens, payload_meta = synth_payload(
+            np.random.default_rng([args.seed, 0x7061796C]), args.n,
+            args.payload, args.payload_plen, args.payload_seed,
+            args.payload_patterns, args.payload_attack_fraction,
+            args.file_packets,
+        )
     fp = int(args.file_packets)
     n_rec = -(-args.n // fp)
     rec_starts = offs[::fp][:n_rec]
@@ -270,7 +339,7 @@ def _ring_main(args, rng, offs) -> int:
         "mode": "ring", "records": int(n_rec), "file_packets": fp,
         "duration_s": float(offs[-1]), "seed": int(args.seed),
         "established_fraction": float(args.established_fraction),
-        "n_flows": int(n_flows), **attack_meta,
+        "n_flows": int(n_flows), **attack_meta, **payload_meta,
     }
     print(json.dumps(summary), flush=True)
     if args.dry_run:
@@ -303,10 +372,19 @@ def _ring_main(args, rng, offs) -> int:
             np.arange(lo, hi, dtype=np.int64)
         )
         flags = getattr(batch, "tcp_flags", None)
-        wv, fl, token = ring.reserve(
-            wire.shape[0], wire.shape[1],
-            with_flags=flags is not None, timeout=30.0,
-        )
+        if pay is None:
+            wv, fl, token = ring.reserve(
+                wire.shape[0], wire.shape[1],
+                with_flags=flags is not None, timeout=30.0,
+            )
+        else:
+            wv, fl, pv, lv, token = ring.reserve(
+                wire.shape[0], wire.shape[1],
+                with_flags=flags is not None,
+                payload_width=pay.shape[1], timeout=30.0,
+            )
+            np.copyto(pv, pay[lo:hi])
+            np.copyto(lv, plens[lo:hi])
         np.copyto(wv, wire)
         if fl is not None and flags is not None:
             np.copyto(fl, flags[lo:hi])
@@ -406,6 +484,34 @@ def main(argv=None) -> int:
     p.add_argument("--attackers", type=int, default=2,
                    help="distinct attack sources (portscan always uses "
                         "1; default 2)")
+    p.add_argument("--payload", choices=("none", "http", "attack-mix"),
+                   default="none",
+                   help="payload-prefix traffic shape (--ring mode "
+                        "only; frames files carry no payload bytes): "
+                        "http = benign HTTP-ish request prefixes "
+                        "(infw.payload.benign_payloads); attack-mix = "
+                        "the same plus a seeded "
+                        "--payload-attack-fraction of lanes bearing "
+                        "signatures from the deterministic pattern set "
+                        "(--payload-seed/--payload-patterns — the same "
+                        "set a daemon loads with --payload N at that "
+                        "seed), labels in the manifest.  The target "
+                        "daemon must run --payload so its ring slots "
+                        "carry the column")
+    p.add_argument("--payload-plen", type=int, default=64,
+                   help="payload prefix bytes per packet (a "
+                        "PAYLOAD_PREFIX_WIDTHS bucket: 64 or 128; "
+                        "default 64); the manifest records the "
+                        "resulting payload-column bytes/packet")
+    p.add_argument("--payload-patterns", type=int, default=32,
+                   help="signature pattern-set size for attack-mix "
+                        "(default 32)")
+    p.add_argument("--payload-seed", type=int, default=0,
+                   help="pattern-set seed for attack-mix (default 0 — "
+                        "matches the daemon's --payload default set)")
+    p.add_argument("--payload-attack-fraction", type=float, default=0.1,
+                   help="fraction of lanes carrying a planted "
+                        "signature in attack-mix (default 0.1)")
     p.add_argument("--dry-run", action="store_true",
                    help="print the schedule summary without writing or "
                         "sleeping")
@@ -423,6 +529,18 @@ def main(argv=None) -> int:
     if (args.out is None) == (args.ring is None):
         p.error("exactly one of --out (file drops) or --ring (ring "
                 "producer) is required")
+    if args.payload != "none":
+        if args.ring is None:
+            p.error("--payload requires --ring (frames files carry no "
+                    "payload bytes)")
+        from infw.kernels.wire_decode import PAYLOAD_PREFIX_WIDTHS
+        if args.payload_plen not in PAYLOAD_PREFIX_WIDTHS:
+            p.error(f"--payload-plen must be one of "
+                    f"{PAYLOAD_PREFIX_WIDTHS}")
+        if args.payload_patterns < 1:
+            p.error("--payload-patterns must be >= 1")
+        if not 0.0 <= args.payload_attack_fraction <= 1.0:
+            p.error("--payload-attack-fraction must be in [0, 1]")
     if args.ring and args.established_ladder:
         p.error("--established-ladder emits file-drop sub-runs; use "
                 "--established-fraction with --ring")
