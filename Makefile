@@ -9,7 +9,7 @@ PY ?= python
 	payload-bench pipeline-bench native entry-check dryrun-multichip \
 	mesh-check \
 	spill-read wire-check lint static-check state-check lock-check \
-	sched-check clean
+	sched-check bounds-check clean
 
 # 8 virtual host devices for every CPU-side audit/gate: the mesh serving
 # entrypoints (classify-mesh/*) need a multi-device pool to build, and a
@@ -81,7 +81,15 @@ lint:
 #      biased arena-splice config;
 #   6. the strict jax audit must FAIL on a deliberately injected
 #      implicit host->device transfer (and pass without it — the plain
-#      strict audit runs in entry-check/static-check).
+#      strict audit runs in entry-check/static-check);
+#   7. the bounds verifier acceptances: --inject-defect clampgather
+#      (drop the spliced page-table & mask decode; caught as oob-gather
+#      with a diverging bank-1 witness) and --inject-defect i8wrap
+#      (int8 restage of the AC carried DFA state; caught as int-wrap
+#      with a diverging deep-state payload witness) — each in a fresh
+#      process, the flags act at trace time.
+# The full defect inventory is declarative (infw.analysis.defects);
+# `infw_lint acceptance` loops it end to end.
 # Must be green before any bench record is published (benchruns/README).
 state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --strict
@@ -96,6 +104,8 @@ state-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect slotepoch
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect sketchsat
 	$(MESH_ENV) $(PY) tools/infw_lint.py state --inject-defect mlquant
+	$(MESH_ENV) $(PY) tools/infw_lint.py bounds --inject-defect clampgather
+	$(MESH_ENV) $(PY) tools/infw_lint.py bounds --inject-defect i8wrap
 	@$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict \
 		--inject-donation-defect --entries defect/undonated-buffer \
 		>/dev/null 2>&1; rc=$$?; \
@@ -145,10 +155,24 @@ sched-check:
 	$(MESH_ENV) $(PY) tools/infw_lint.py sched --strict
 	$(MESH_ENV) $(PY) tools/infw_lint.py sched --inject-defect cowrace
 
+# Kernel admission verifier (infw.analysis.boundscheck): jaxpr abstract
+# interpretation over EVERY registered entrypoint, seeded from the
+# declared tensor bounds (infw.contracts.TENSOR_BOUNDS — the same
+# declarations statecheck's runtime invariant sweeps enforce), proving
+# gather/scatter/dynamic_slice bounds and integer-overflow freedom.
+# Intentional modular arithmetic lives in
+# infw/analysis/boundscheck_suppressions.txt with required
+# justifications; --strict means zero unsuppressed findings.  The two
+# injected-defect acceptances (clampgather, i8wrap) run in state-check
+# (fresh processes — the flags act at trace time).
+bounds-check:
+	$(MESH_ENV) $(PY) tools/infw_lint.py bounds --strict
+
 static-check: lint
 	$(PY) tools/infw_lint.py rules --ignore failsafe-violation --strict
 	$(PY) tools/infw_lint.py rules --acceptance
 	$(MESH_ENV) $(PY) tools/infw_lint.py jax --strict
+	$(MAKE) bounds-check
 	$(MAKE) lock-check
 	$(MAKE) state-check
 	@echo "static-check OK"

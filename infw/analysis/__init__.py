@@ -23,10 +23,27 @@ Three prongs (none runs in the packet path):
   runtime hooks; failures shrink to minimal paste-able reproducers.
   (Imported lazily — ``from infw.analysis import statecheck`` — since
   it pulls in jax.)
+- ``boundscheck``: the kernel admission verifier — abstract
+  interpretation (interval + known-bits domain) over the jaxpr of
+  every registered entrypoint, seeded from the declared tensor bounds
+  (``infw.contracts.TENSOR_BOUNDS``, the same declarations the runtime
+  invariant sweeps enforce), proving every gather/scatter/dynamic_slice
+  index in range and every integer op wrap-free; error findings replay
+  a concretized boundary witness through production dispatch vs the
+  CPU oracle.  Intentional modular arithmetic is suppressed with
+  required justifications (``boundscheck_suppressions.txt``, loaded by
+  the shared ``_suppress`` module).  (Lazy import — pulls in jax.)
 
-CLI: ``tools/infw_lint.py`` (``rules`` / ``jax`` / ``state``
-subcommands); ``make static-check`` is the repo-level gate and
-``make state-check`` the patch-path slice of it.
+Cross-cutting: ``defects`` is the declarative injected-defect registry
+every checker's ``--inject-defect`` acceptance (and the ``acceptance``
+CLI loop) consumes; ``lockcheck``/``schedcheck`` are the concurrency
+verifier pair.
+
+CLI: ``tools/infw_lint.py`` (``rules`` / ``jax`` / ``state`` / ``lock``
+/ ``sched`` / ``bounds`` / ``acceptance`` subcommands); ``make
+static-check`` is the repo-level gate, ``make state-check`` the
+patch-path slice and ``make bounds-check`` the admission-verifier
+slice of it.
 """
 from . import rules  # noqa: F401  (re-export for infw.analysis.rules)
 

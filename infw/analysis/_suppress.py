@@ -1,0 +1,60 @@
+"""The shared justification-required suppression-file loader.
+
+Both static checkers that admit intentional residue (lockcheck's
+TEST-ONLY raw-lock sites, boundscheck's intentional-wrap hashing)
+consume ONE file format through this module:
+
+    check-id subject-glob  # justification
+
+One suppression per line; the justification after ``#`` is REQUIRED —
+a bare glob raises at load time, so an entry can never silence a
+finding without a written reason riding next to it in review diffs.
+Blank lines and pure-comment lines are skipped.  Matching is
+``fnmatch`` on the finding's subject string, scoped to the exact
+check id.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import List, Optional, Tuple
+
+#: (check-id, subject-glob, justification)
+Suppression = Tuple[str, str, str]
+
+
+def sibling_path(name: str) -> str:
+    """Path of a suppression file living next to the analysis code
+    (the checked-in, code-reviewed location)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def load_suppressions(path: str) -> List[Suppression]:
+    """Lines of ``check-id subject-glob  # justification``; blank lines
+    and pure comments skipped.  A justification is REQUIRED."""
+    out: List[Suppression] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, reason = line.partition("#")
+            parts = body.split()
+            if len(parts) != 2 or not reason.strip():
+                raise ValueError(
+                    f"{path}:{n}: expected 'check subject-glob  # why', "
+                    f"got {line!r}")
+            out.append((parts[0], parts[1], reason.strip()))
+    return out
+
+
+def match(supp: List[Suppression], check: str,
+          subject: str) -> Optional[Suppression]:
+    """First suppression whose check id equals ``check`` and whose glob
+    matches ``subject``; None when the finding must stand."""
+    for s in supp:
+        if s[0] == check and fnmatch.fnmatch(subject, s[1]):
+            return s
+    return None

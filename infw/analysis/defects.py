@@ -1,0 +1,206 @@
+"""The injected-defect registry: one declarative table for every
+``--inject-defect`` acceptance across the static/dynamic checkers.
+
+Each checker's acceptance gate re-introduces a known bug class and
+proves its analysis catches it (with a shrunk reproducer, a named lock
+cycle, a failing schedule, or a diverging witness — whatever "caught"
+means for that checker).  Before this table the defect inventory lived
+as five per-subcommand literals inside tools/infw_lint.py; now the CLI
+choices, the injection flags, the per-defect run parameters and the
+expected-catch contract all come from HERE, so adding a defect is one
+entry (plus the flag in the production module) and every consumer —
+CLI, Makefile acceptance loop, tests — picks it up.
+
+A ``Defect`` is deliberately checker-agnostic: the ``checker`` field
+routes it, and only the fields that checker reads are meaningful
+(``config``/``bound``/``min_ops``/``shrink_runs`` for the statecheck
+equivalence engine, ``scenario``/``max_segments``/``invariant_token``
+for the interleaving explorer, ``entry``/``check`` for the bounds
+verifier).  ``module``/``flag`` name the production-module toggle —
+TRACE-time for the bounds defects (set before the first trace; the
+acceptance gates run them in a fresh process, and ``env`` is the
+variable the subprocess path sets), call-time for the rest.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, NamedTuple
+
+
+class Defect(NamedTuple):
+    """One injected-defect acceptance (see module docstring)."""
+
+    name: str            # CLI id (--inject-defect <name>)
+    checker: str         # state | lock | sched | jax | bounds
+    expect: str          # one-line expected-catch contract
+    module: str = ""     # dotted module holding the injection flag
+    flag: str = ""       # module attr ("" = checker-native injection)
+    env: str = ""        # env-var twin of the flag (subprocess toggles)
+    config: str = ""     # statecheck config (state)
+    bound: int = 0       # max shrunk-reproducer ops (state)
+    min_ops: int = 0     # generator horizon floor (state; 0 = CLI arg)
+    shrink_runs: int = 32    # shrinker budget (state)
+    scenario: str = ""       # schedcheck scenario (sched)
+    max_segments: int = 0    # shrunk-schedule step bound (sched)
+    invariant_token: str = ""    # substring of the naming invariant (sched)
+    entry: str = ""      # registered kernel entrypoint (bounds)
+    check: str = ""      # expected finding check id (bounds)
+
+
+_D = Defect
+
+DEFECTS: Dict[str, Defect] = {d.name: d for d in [
+    # -- statecheck: seeded op sequences through the device-table edit
+    #    state machine; caught = equivalence failure shrunk to <= bound
+    #    ops.
+    _D("joined-pad", "state",
+       "PR-4 joined-placeholder bucket-padding bug on the placeholder "
+       "layout: caught by device-vs-cold-rebuild bit-identity, shrunk "
+       "reproducer <= 3 ops",
+       module="infw.kernels.jaxpath", flag="_INJECT_JOINED_PAD_BUG",
+       env="INFW_INJECT_JOINED_PAD_BUG", config="nojoined", bound=3),
+    _D("cskip", "state",
+       "zeroed compressed-layout skip-bits: resident AND cold rebuild "
+       "share the defect, so the catch must be CPU-oracle divergence "
+       "(the classify-equivalence half covers the skip-node path)",
+       module="infw.kernels.jaxpath", flag="_INJECT_CSKIP_BUG",
+       env="INFW_INJECT_CSKIP_BUG", config="ctrie", bound=3),
+    _D("fold", "state",
+       "transaction fold drops delete-then-readd pairs: corrupted fold "
+       "feeds updater, resident state and cold rebuild alike — caught "
+       "by per-op oracle divergence, shrunk to the (delete, readd) pair",
+       module="infw.txn", flag="_INJECT_FOLD_BUG",
+       env="INFW_INJECT_FOLD_BUG", config="txn", bound=2,
+       min_ops=12, shrink_runs=64),
+    _D("pageflip", "state",
+       "stale page-table row after tenant hot-swap (O(1) activation "
+       "not landing): caught by the arena invariant/oracle layers, "
+       "shrunk to the one tenant_swap op",
+       module="infw.kernels.jaxpath", flag="_INJECT_PAGEFLIP_BUG",
+       env="INFW_INJECT_PAGEFLIP_BUG", config="arena-ctrie", bound=3),
+    _D("cowleak", "state",
+       "CoW donor-refcount leak on the clone path: caught by "
+       "check_arena's refcount-vs-page-table-rows invariant on the "
+       "shared-then-edited-biased config",
+       module="infw.kernels.jaxpath", flag="_INJECT_COWLEAK_BUG",
+       env="INFW_INJECT_COWLEAK_BUG", config="arena-cow", bound=3,
+       min_ops=12, shrink_runs=64),
+    _D("spliceleak", "state",
+       "subtree-plane refcount leak on the unsplice path: caught by "
+       "check_arena's plane-refcount-vs-splice-row-recount invariant "
+       "on the near-copy-biased config",
+       module="infw.kernels.jaxpath", flag="_INJECT_SPLICELEAK_BUG",
+       env="INFW_INJECT_SPLICELEAK_BUG", config="arena-splice", bound=3,
+       min_ops=12, shrink_runs=64),
+    _D("flowstale", "state",
+       "dropped flow-cache invalidation (generation bump no-ops): "
+       "device, host model and cold rebuild all agree, so the catch "
+       "must be oracle divergence on replayed traffic after an edit",
+       module="infw.flow", flag="_INJECT_FLOW_STALE_BUG",
+       env="INFW_INJECT_FLOW_STALE_BUG", config="flow", bound=4,
+       min_ops=12, shrink_runs=64),
+    _D("residentstale", "state",
+       "resident pool serves pre-patch captured operands (staleness "
+       "check dropped): caught by oracle divergence at the next "
+       "settled check, shrunk to a single edit op",
+       module="infw.resident", flag="_INJECT_RESIDENT_STALE_BUG",
+       env="INFW_INJECT_RESIDENT_STALE_BUG", config="resident", bound=3),
+    _D("slotepoch", "state",
+       "pipeline slot 1 re-seeds the device epoch one behind the host "
+       "model: caught by the flow-column bit-identity pass at the "
+       "first settled check",
+       module="infw.flow", flag="_INJECT_SLOT_EPOCH_BUG",
+       env="INFW_INJECT_SLOT_EPOCH_BUG", config="pipeline", bound=3),
+    _D("sketchsat", "state",
+       "device count-min update stops clamping at sat while the host "
+       "model clamps: device-vs-model bit-identity diverges on the "
+       "first settled check's witness traffic",
+       module="infw.kernels.sketch", flag="_INJECT_SKETCH_SAT_BUG",
+       env="INFW_INJECT_SKETCH_SAT_BUG", config="telemetry", bound=3),
+    _D("mlquant", "state",
+       "device MLP hidden layer stops saturating at 127 (int8 wrap) "
+       "while the host model clamps: caught by score bit-identity on "
+       "the clamp-stress model",
+       module="infw.kernels.mxu_score", flag="_INJECT_MLQUANT_BUG",
+       env="INFW_INJECT_MLQUANT_BUG", config="mlscore", bound=3),
+    _D("aclink", "state",
+       "one failure-link output fold dropped from automaton build: the "
+       "device bitmap misses suffix matches the naive substring oracle "
+       "claims — caught at the first payload_traffic settled check",
+       module="infw.kernels.acmatch", flag="_INJECT_ACLINK_BUG",
+       env="INFW_INJECT_ACLINK_BUG", config="payload", bound=4),
+
+    # -- lockcheck: static lock-order verifier; caught = a declared-
+    #    order contradiction (cycle) named in the report.
+    _D("lockorder", "lock",
+       "a synthetic acquisition edge contradicting the declared "
+       "LOCK_ORDER: caught as a named lock cycle by the static "
+       "lock-order pass"),
+
+    # -- schedcheck: deterministic interleaving explorer; caught = a
+    #    failing schedule shrunk to <= max_segments whose invariant
+    #    error names the defect.
+    _D("cowrace", "sched",
+       "allocator lock dropped around the CoW donor refcount "
+       "decrement: the explorer finds the lost-update interleaving, "
+       "shrinks it, and check_arena's cowleak invariant names it",
+       module="infw.kernels.jaxpath", flag="_INJECT_COWRACE_BUG",
+       env="INFW_INJECT_COWRACE_BUG", scenario="cow-vs-destroy",
+       max_segments=6, invariant_token="cowleak"),
+
+    # -- jax hot-path audit: checker-native injections (synthetic
+    #    defect entrypoints appended to the audited registry).
+    _D("transfer", "jax",
+       "a deliberately implicit host->device transfer inside a jitted "
+       "entrypoint: the strict jax audit must fail on it (and pass "
+       "without it)"),
+    _D("donation", "jax",
+       "a donable operand left undonated on a dispatch-loop "
+       "entrypoint: the strict jax audit's donation lint must fail on "
+       "it (and pass without it)"),
+
+    # -- boundscheck: jaxpr abstract interpretation; caught = an
+    #    unsuppressed finding of the expected check at the expected
+    #    entry, concretized by a DIVERGING boundary witness.  Both
+    #    flags are TRACE-time: the acceptance runs in a fresh process.
+    _D("clampgather", "bounds",
+       "arena_ctrie_rows drops the & _SPLICE_PAGE_MASK page decode: "
+       "the bank bit leaks into the page id and the root-lut gather "
+       "escapes its extent — caught as oob-gather on the spliced "
+       "arena entry with a diverging bank-1 witness batch",
+       module="infw.kernels.jaxpath", flag="_INJECT_CLAMPGATHER_BUG",
+       env="INFW_INJECT_CLAMPGATHER_BUG",
+       entry="classify-wire/arena-splice-trie", check="oob-gather"),
+    _D("i8wrap", "bounds",
+       "the AC gather transition path restages the carried DFA state "
+       "through int8: states past 127 wrap silently — caught as "
+       "int-wrap on the standalone payload entry (the ac-delta "
+       "declared bound makes the carried range known) with a "
+       "diverging deep-state witness payload",
+       module="infw.kernels.acmatch", flag="_INJECT_I8WRAP_BUG",
+       env="INFW_INJECT_I8WRAP_BUG",
+       entry="payload/acmatch-standalone", check="int-wrap"),
+]}
+
+
+def by_checker(checker: str) -> List[Defect]:
+    """Registry slice for one checker, declaration order preserved."""
+    return [d for d in DEFECTS.values() if d.checker == checker]
+
+
+def names(checker: str) -> List[str]:
+    """CLI choices for one checker's --inject-defect."""
+    return [d.name for d in by_checker(checker)]
+
+
+def get(name: str) -> Defect:
+    return DEFECTS[name]
+
+
+def set_flag(defect: Defect, on: bool) -> None:
+    """Flip the defect's production-module injection flag (no-op for
+    checker-native defects)."""
+    if not defect.module or not defect.flag:
+        return
+    mod = importlib.import_module(defect.module)
+    setattr(mod, defect.flag, bool(on))

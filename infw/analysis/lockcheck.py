@@ -46,10 +46,11 @@ one in flow.py and the injected one).
 from __future__ import annotations
 
 import ast
-import fnmatch
 import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
+
+from . import _suppress
 
 LOCK_KINDS = ("Lock", "RLock", "Condition", "Event")
 #: kinds that participate in the acquisition graph (Event has no
@@ -766,30 +767,15 @@ def analyze(corpus: Corpus, declared_order=None) -> Tuple[List[Finding],
 
 
 def default_suppressions_path() -> str:
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "lockcheck_suppressions.txt")
+    return _suppress.sibling_path("lockcheck_suppressions.txt")
 
 
 def load_suppressions(path: Optional[str] = None):
     """Lines of ``check-id subject-glob  # justification``; blank lines
-    and pure comments skipped.  A justification is REQUIRED."""
-    path = path or default_suppressions_path()
-    out = []
-    if not os.path.exists(path):
-        return out
-    with open(path, encoding="utf-8") as f:
-        for n, line in enumerate(f, 1):
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            body, _, reason = line.partition("#")
-            parts = body.split()
-            if len(parts) != 2 or not reason.strip():
-                raise ValueError(
-                    f"{path}:{n}: expected 'check subject-glob  # why', "
-                    f"got {line!r}")
-            out.append((parts[0], parts[1], reason.strip()))
-    return out
+    and pure comments skipped.  A justification is REQUIRED.  (Shared
+    loader: infw.analysis._suppress — one format for lockcheck and
+    boundscheck.)"""
+    return _suppress.load_suppressions(path or default_suppressions_path())
 
 
 def analyze_repo(root: Optional[str] = None,
@@ -800,9 +786,7 @@ def analyze_repo(root: Optional[str] = None,
     supp = load_suppressions(suppressions_path)
     kept, suppressed = [], []
     for f in findings:
-        hit = next((s for s in supp
-                    if s[0] == f.check and fnmatch.fnmatch(f.subject, s[1])),
-                   None)
+        hit = _suppress.match(supp, f.check, f.subject)
         (suppressed if hit else kept).append(
             (f, hit[2] if hit else None))
     return {

@@ -66,6 +66,7 @@ from ..compiler import (
     compile_tables_from_content,
 )
 from ..constants import IPPROTO_TCP, KIND_IPV6, MAX_TARGETS
+from .. import contracts
 from ..kernels import jaxpath
 
 
@@ -809,8 +810,15 @@ def check_device_tables(dev: "jaxpath.DeviceTables") -> List[str]:
     DIR-16 root sizing, child/target range bounds against the next
     level, the targets[0] == 0 sentinel, root-LUT bounds, and entry-count
     accounting — the (1,1)->(8,1) bug class and its relatives become
-    named violations at the table, not a downstream parity mystery."""
-    v: List[str] = []
+    named violations at the table, not a downstream parity mystery.
+
+    The declared-value half (contracts.TENSOR_BOUNDS) runs first: the
+    same per-field bounds the static verifier (boundscheck) seeds its
+    abstract interpretation from are enforced here on the concrete
+    state, so a static in-range proof never rests on an assumption the
+    runtime sweep would let drift."""
+    v: List[str] = list(contracts.check_declared_bounds(
+        "device-tables", dev))
     kw = np.asarray(dev.key_words)
     mw = np.asarray(dev.mask_words)
     ml = np.asarray(dev.mask_len)
@@ -1007,8 +1015,11 @@ def check_ctrie_tables(cdev) -> List[str]:
     (skip_len <= CPOP_MAX_SKIP, skip_bits inside the skip window),
     child/target base ranges, the flat-target sentinel, and the
     per-tidx joined row self-indexing.  Pad rows must be all-zero
-    (bitmaps 0 = unreachable)."""
-    v: List[str] = []
+    (bitmaps 0 = unreachable).  Opens with the declared
+    contracts.TENSOR_BOUNDS value sweep (the boundscheck seed
+    contract)."""
+    v: List[str] = list(contracts.check_declared_bounds(
+        "ctrie-tables", cdev))
     l0 = np.asarray(cdev.l0)
     nodes = np.asarray(cdev.nodes)
     targets = np.asarray(cdev.targets)
@@ -2486,6 +2497,12 @@ def check_arena(alloc) -> List[str]:
             }
         else:
             page_decomposed = set()
+    # declared TENSOR_BOUNDS value sweep — the static verifier's seed
+    # contract, enforced on the live pool state
+    role = ("ctrie-arena" if isinstance(dev, jaxpath.CtrieArena)
+            else "dense-arena")
+    viols.extend(contracts.check_declared_bounds(
+        role, dev, spec=alloc.spec))
     for name, harr in host.items():
         darr = np.asarray(getattr(dev, name))
         if darr.shape != harr.shape or darr.dtype != harr.dtype:

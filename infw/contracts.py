@@ -1,8 +1,13 @@
-"""Declared concurrency contracts — the machine-checked half of the
-comment disciplines PRs 10/13/15/17 introduced.
+"""Declared contracts — the machine-checked half of the comment
+disciplines PRs 10/13/15/17 introduced, plus the device-table value
+bounds (TENSOR_BOUNDS) shared by the runtime invariant checkers and
+the static bounds verifier.
 
-Two kinds of declaration live here, both consumed statically by
-``infw.analysis.lockcheck`` (the decorators are runtime no-ops):
+Three kinds of declaration live here (the decorators are runtime
+no-ops; the first two are consumed statically by
+``infw.analysis.lockcheck``, the third by both
+``infw.analysis.statecheck`` at runtime and
+``infw.analysis.boundscheck`` at trace time):
 
 ``@must_precede("first", "then")`` — inside the decorated function,
 every call to ``then`` must come after a call to ``first`` (checked by
@@ -19,10 +24,25 @@ reverse.  lockcheck flags any measured acquisition edge that contradicts
 a declared pair (directly or through the declared order's transitive
 closure).  Lock names are ``ClassName._attr`` as inventoried by
 lockcheck.
+
+``TENSOR_BOUNDS`` — per-role device-table value bounds: role name ->
+resolver mapping a concrete table container to per-field
+``TensorBound(lo, hi, bits)`` declarations.  The SAME resolver output
+feeds two consumers: ``check_declared_bounds`` (called from
+statecheck's ``check_device_tables``/``check_ctrie_tables``/
+``check_arena``) verifies a concrete state obeys the declaration, and
+``boundscheck`` seeds its abstract interpretation of every kernel
+jaxpr from it — so a bound the static pass relies on to prove a
+gather in-range is by construction one the runtime invariant sweep
+enforces on every install.  ``bits`` is an optional maybe-bits mask
+constraining the NON-NEGATIVE values of the field (negative sentinel
+values like ``-1`` page rows are bounded by ``lo`` alone); it is what
+lets the verifier reason through ``value & mask`` decodes such as the
+spliced page table's ``page | bank << 30`` rows.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 #: Declared lock-nesting order (PR 13's discipline, extended by PR 14):
 #: the fused resident dispatch holds the flow tier's lock while
@@ -52,3 +72,180 @@ def must_precede(first: str, then: str) -> Callable:
         return fn
 
     return deco
+
+
+# -- declared tensor value bounds (PR 20) ------------------------------------
+
+
+class TensorBound(NamedTuple):
+    """Declared value bound for one device-table field: every element
+    is in ``[lo, hi]``, and every NON-NEGATIVE element has set bits
+    only inside ``bits`` (None = no bit declaration)."""
+
+    lo: int
+    hi: int
+    bits: Optional[int] = None
+
+
+def _pow2_mask(n: int) -> int:
+    """Smallest all-ones mask covering ``n`` (0 -> 0)."""
+    m = 0
+    while m < n:
+        m = (m << 1) | 1
+    return m
+
+
+def _ctrie_tables_bounds(cdev, spec=None) -> Dict[str, TensorBound]:
+    """Standalone compressed-poptrie tables (jaxpath.CTrieTables):
+    l0 col 0 holds node_id+1 (<= nodes rows), col 1 / targets hold
+    tidx+1 joined positions (< joined rows), root_lut holds DIR-16
+    root ids (< l0_rows / 65536).  These are exactly the ranges
+    statecheck's check_ctrie_tables sweeps on every install."""
+    n0 = cdev.l0.shape[0] // 65536
+    jrows = cdev.joined.shape[0]
+    return {
+        "l0": TensorBound(0, max(cdev.nodes.shape[0], jrows - 1)),
+        "targets": TensorBound(0, jrows - 1),
+        "root_lut": TensorBound(0, max(n0 - 1, 0)),
+    }
+
+
+def _device_tables_bounds(tdev, spec=None) -> Dict[str, TensorBound]:
+    """Uncompressed DeviceTables: mask_len carries the -1 padding
+    sentinel and caps at 128 bits; root_lut / trie_targets index the
+    DIR-16 root level / joined rows."""
+    out = {}
+    mask_len = getattr(tdev, "mask_len", None)
+    if mask_len is not None:
+        out["mask_len"] = TensorBound(-1, 128)
+    if getattr(tdev, "trie_levels", None):
+        l0 = tdev.trie_levels[0]
+        # sharded layouts carry a leading shard dim: (S, n0*65536, 2)
+        rows0 = l0.shape[1] if l0.ndim == 3 else l0.shape[0]
+        n0 = rows0 // 65536
+        out["root_lut"] = TensorBound(0, max(n0 - 1, 0))
+    joined = getattr(tdev, "joined", None)
+    if joined is not None and joined.shape[0] > 1:
+        out["trie_targets"] = TensorBound(0, joined.shape[0] - 1)
+    elif joined is None and mask_len is not None and \
+            getattr(tdev, "trie_targets", None) is not None:
+        # joined-less shards: targets hold mask_len positions +1 (0 =
+        # no match), bounded by the per-rule column count
+        out["trie_targets"] = TensorBound(0, mask_len.shape[-1])
+    return out
+
+
+def _ctrie_arena_bounds(ca, spec=None) -> Dict[str, TensorBound]:
+    """Paged ctrie arena (jaxpath.CtrieArena).  The page table is the
+    interesting row: ``-1`` absent-tenant sentinel, else ``page`` or
+    ``page | bank << 30`` on spliced geometries — declared as an
+    interval PLUS a maybe-bits mask, because only the bit view
+    survives the kernel's ``& _SPLICE_PAGE_MASK`` decode.  l0 col 0
+    additionally carries SPLICE_TAG-tagged slot ids on spliced
+    geometries."""
+    from .kernels import jaxpath
+
+    n0 = ca.l0.shape[0] // 65536
+    jrows = ca.joined.shape[0]
+    l0_hi = max(ca.nodes.shape[0], jrows - 1)
+    out = {
+        "targets": TensorBound(0, jrows - 1),
+        "root_lut": TensorBound(0, max(n0 - 1, 0)),
+    }
+    if spec is not None and getattr(spec, "spliced", False):
+        tag = int(jaxpath.SPLICE_TAG)
+        l0_hi = max(l0_hi, tag + spec.splice_slots - 1)
+        out["page_table"] = TensorBound(
+            -1, (1 << jaxpath._SPLICE_BANK_SHIFT) + spec.pages - 1,
+            bits=_pow2_mask(spec.pages - 1)
+            | (1 << jaxpath._SPLICE_BANK_SHIFT))
+        out["splice"] = TensorBound(-1, spec.plane_slots - 1)
+    elif spec is not None:
+        out["page_table"] = TensorBound(-1, spec.pages - 1)
+    out["l0"] = TensorBound(0, l0_hi)
+    return out
+
+
+def _dense_arena_bounds(da, spec=None) -> Dict[str, TensorBound]:
+    out = {"mask_len": TensorBound(-1, 128)}
+    if spec is not None:
+        out["page_table"] = TensorBound(-1, spec.pages - 1)
+    return out
+
+
+def _ac_delta_bounds(trans, spec=None) -> Dict[str, TensorBound]:
+    """Aho-Corasick transition tensor: every entry is a DFA state id
+    in [0, states-1] (the dense delta) — the bound that makes a
+    narrowed restage of the carried walk state a provable wrap."""
+    return {"": TensorBound(0, trans.shape[0] - 1)}
+
+
+def _flow_page_table_bounds(pt, spec=None) -> Dict[str, TensorBound]:
+    """Flow-tier tenant -> slab page map: ``-1`` unmapped sentinel,
+    else a slab id below the tier's slab count (``spec``; the
+    single-slab fixtures pass 1).  The bound is what lets the verifier
+    prove ``clip(page, 0) * slab_entries + local`` lands inside the
+    flow columns."""
+    n = int(spec) if spec is not None else 1
+    return {"": TensorBound(-1, max(n - 1, 0))}
+
+
+def _ac_dflat_bounds(dflat, spec=None) -> Dict[str, TensorBound]:
+    """Flattened one-hot transition block of the matmul regime: every
+    entry is a 0/1 indicator.  (Row one-hotness itself is beyond an
+    elementwise bound — the verifier cannot derive it, which is why
+    the int8 restage of the matmul walk carries a justified
+    suppression rather than a proof.)"""
+    return {"": TensorBound(0, 1)}
+
+
+#: role -> resolver(concrete_value, spec=None) -> {field: TensorBound}.
+#: ``""`` keys a bare-array argument; other keys name NamedTuple
+#: fields.  Fields without a declaration default to dtype-top (no
+#: promise beyond the dtype).
+TENSOR_BOUNDS: Dict[str, Callable] = {
+    "ctrie-tables": _ctrie_tables_bounds,
+    "device-tables": _device_tables_bounds,
+    "ctrie-arena": _ctrie_arena_bounds,
+    "dense-arena": _dense_arena_bounds,
+    "ac-delta": _ac_delta_bounds,
+    "ac-dflat": _ac_dflat_bounds,
+    "flow-page-table": _flow_page_table_bounds,
+}
+
+
+def resolve_bounds(role: str, value, spec=None) -> Dict[str, TensorBound]:
+    """The declared per-field bounds of ``value`` under ``role``
+    (empty dict for unknown roles — callers treat that as dtype-top)."""
+    fn = TENSOR_BOUNDS.get(role)
+    return fn(value, spec=spec) if fn else {}
+
+
+def check_declared_bounds(role: str, value, spec=None) -> List[str]:
+    """Runtime half of TENSOR_BOUNDS: verify a concrete table
+    container obeys every declared field bound.  Returns violation
+    strings (empty = clean); consumed by statecheck's invariant
+    sweeps so the static verifier's seed assumptions are enforced on
+    every install."""
+    import numpy as np
+
+    viols: List[str] = []
+    for field, b in resolve_bounds(role, value, spec=spec).items():
+        arr = np.asarray(value if field == "" else getattr(value, field))
+        if arr.size == 0:
+            continue
+        a = arr.astype(np.int64)
+        lo, hi = int(a.min()), int(a.max())
+        name = field or role
+        if lo < b.lo or hi > b.hi:
+            viols.append(
+                f"bounds[{role}].{name}: values [{lo}, {hi}] escape "
+                f"declared [{b.lo}, {b.hi}]")
+        if b.bits is not None:
+            nn = a[a >= 0]
+            if nn.size and int(np.bitwise_or.reduce(
+                    nn.reshape(-1)) & ~np.int64(b.bits)):
+                viols.append(
+                    f"bounds[{role}].{name}: non-negative values set "
+                    f"bits outside declared mask {b.bits:#x}")
+    return viols

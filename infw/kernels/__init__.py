@@ -48,12 +48,22 @@ class KernelEntrypoint(NamedTuple):
     reuses in place.  Donating entrypoints consume their operands, so
     the executing lints rebuild args per run.  Every resident-loop
     entrypoint MUST declare its donated operands (registry-level rule,
-    also lint-enforced)."""
+    also lint-enforced).
+
+    ``bounds`` declares which positional args carry contract-bounded
+    table operands: ``(arg_idx, role)`` or ``(arg_idx, role,
+    spec_thunk)`` entries, where ``role`` keys
+    ``contracts.TENSOR_BOUNDS`` and ``spec_thunk`` lazily supplies the
+    geometry spec some resolvers need (arena page counts).  The static
+    bounds verifier (analysis.boundscheck) seeds its abstract
+    interpretation from these — an unannotated operand is assumed
+    attacker-controlled (dtype-top)."""
 
     name: str
     kind: str  # "xla" | "pallas"
     build: Callable[[int], Tuple[Callable, tuple]]
     donate: Tuple[int, ...] = ()
+    bounds: Tuple = ()
 
 
 # -- canonical fixtures ------------------------------------------------------
@@ -880,6 +890,42 @@ def _payload_operands(b: int, stacked: bool = False):
             jax.device_put(pay), jax.device_put(plen))
 
 
+@functools.lru_cache(maxsize=None)
+def _acmatch_standalone_model():
+    """A DELIBERATELY deep pattern set (40 x 8 bytes -> several
+    hundred DFA states, bucketed past MATMUL_MAX_STATES) so the
+    standalone matcher compiles the dense-delta GATHER path — the
+    int32 carried-state regime where a narrowed restage is a provable
+    wrap.  The canonical 4-pattern payload fixture stays in the
+    matmul regime and cannot exercise that path."""
+    from . import acmatch
+
+    pats = [
+        bytes(((i * 17 + j * 7) % 251) + 1 for j in range(8))
+        for i in range(40)
+    ]
+    model = acmatch.compile_patterns(pats, plen=64)
+    assert not model.spec.matmul, (
+        "standalone AC fixture must land in the gather regime"
+    )
+    return model
+
+
+def _build_acmatch_standalone(b: int):
+    import jax
+
+    from . import acmatch
+
+    model = _acmatch_standalone_model()
+    trans, mmap = acmatch.model_device(model)
+    pay = np.zeros((b, model.spec.plen), np.uint8)
+    sig = np.frombuffer(model.patterns[0], np.uint8)
+    pay[: b // 2, : len(sig)] = sig
+    plen = np.full(b, model.spec.plen, np.int32)
+    fn = acmatch.jitted_acmatch(model.spec)
+    return fn, (trans, mmap, jax.device_put(pay), jax.device_put(plen))
+
+
 def _build_resident_payload_fused(b: int):
     """The resident fused step with the payload-matching tier riding
     the same program: flow columns + epoch donated exactly as the base
@@ -1074,20 +1120,26 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
     the TPU backend (backend/tpu.py _launch_wire and friends), then the
     mesh serving programs (backend/mesh.py)."""
     return [
-        KernelEntrypoint("classify/xla-dense", "xla", _build_classify(False)),
-        KernelEntrypoint("classify/xla-trie", "xla", _build_classify(True)),
+        KernelEntrypoint("classify/xla-dense", "xla", _build_classify(False),
+                         bounds=((0, "device-tables"),)),
+        KernelEntrypoint("classify/xla-trie", "xla", _build_classify(True),
+                         bounds=((0, "device-tables"),)),
         KernelEntrypoint(
-            "classify-wire/xla-trie-fused", "xla", _build_classify_wire_fused
+            "classify-wire/xla-trie-fused", "xla", _build_classify_wire_fused,
+            bounds=((0, "device-tables"),),
         ),
         KernelEntrypoint(
             "classify-wire/xla-overlay-fused", "xla",
             _build_classify_wire_overlay,
+            bounds=((0, "device-tables"), (1, "device-tables")),
         ),
         KernelEntrypoint(
-            "classify-wire8/xla-fused", "xla", _build_wire8
+            "classify-wire8/xla-fused", "xla", _build_wire8,
+            bounds=((0, "device-tables"),),
         ),
         KernelEntrypoint(
-            "wire-decode/delta-fused", "xla", _build_delta_decode
+            "wire-decode/delta-fused", "xla", _build_delta_decode,
+            bounds=((0, "device-tables"),),
         ),
         KernelEntrypoint(
             "classify/pallas-dense", "pallas", _build_pallas_dense
@@ -1100,14 +1152,17 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
             "classify/pallas-walk", "pallas", _build_pallas_walk
         ),
         KernelEntrypoint(
-            "classify-wire/xla-ctrie-fused", "xla", _build_ctrie_wire_fused
+            "classify-wire/xla-ctrie-fused", "xla", _build_ctrie_wire_fused,
+            bounds=((0, "ctrie-tables"),),
         ),
         KernelEntrypoint(
             "classify-wire/xla-ctrie-overlay-fused", "xla",
             _build_ctrie_wire_overlay,
+            bounds=((0, "ctrie-tables"), (1, "device-tables")),
         ),
         KernelEntrypoint(
-            "classify/pallas-cwalk", "pallas", _build_pallas_cwalk
+            "classify/pallas-cwalk", "pallas", _build_pallas_cwalk,
+            bounds=((0, "ctrie-tables"),),
         ),
         KernelEntrypoint(
             "patch/txn-scatter-dense", "xla", _build_txn_scatter_dense
@@ -1116,35 +1171,50 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
             "patch/ctrie-joined-scatter", "xla", _build_ctrie_joined_scatter
         ),
         KernelEntrypoint(
-            "classify-wire/arena-dense", "xla", _build_arena_wire("dense")
+            "classify-wire/arena-dense", "xla", _build_arena_wire("dense"),
+            bounds=((0, "dense-arena",
+                     lambda: _fixture_arena("dense").spec),),
         ),
         KernelEntrypoint(
-            "classify-wire/arena-trie", "xla", _build_arena_wire("ctrie")
+            "classify-wire/arena-trie", "xla", _build_arena_wire("ctrie"),
+            bounds=((0, "ctrie-arena",
+                     lambda: _fixture_arena("ctrie").spec),),
         ),
         KernelEntrypoint(
             "classify-wire/arena-splice-trie", "xla",
             _build_arena_splice_wire,
+            bounds=((0, "ctrie-arena",
+                     lambda: _fixture_splice_arena().spec),),
         ),
         KernelEntrypoint(
-            "classify/pallas-arena-walk", "pallas", _build_pallas_arena_walk
+            "classify/pallas-arena-walk", "pallas", _build_pallas_arena_walk,
+            bounds=((0, "ctrie-arena",
+                     lambda: _fixture_arena("ctrie").spec),),
         ),
         KernelEntrypoint(
-            "classify-wire/flow-probe", "xla", _build_flow_probe
+            "classify-wire/flow-probe", "xla", _build_flow_probe,
+            bounds=((2, "flow-page-table",
+                     lambda: _fixture_flow().config.pages),),
         ),
         KernelEntrypoint(
-            "patch/flow-insert", "xla", _build_flow_insert
+            "patch/flow-insert", "xla", _build_flow_insert,
+            bounds=((2, "flow-page-table",
+                     lambda: _fixture_flow().config.pages),),
         ),
         KernelEntrypoint(
             "classify-wire/resident-fused", "xla", _build_resident_fused,
             donate=(0, 3),
+            bounds=((2, "flow-page-table"), (4, "device-tables")),
         ),
         KernelEntrypoint(
             "classify-wire/resident-ring-fused", "xla",
             _build_resident_ring_fused, donate=(0, 3),
+            bounds=((2, "flow-page-table"), (4, "device-tables")),
         ),
         KernelEntrypoint(
             "classify-wire/resident-superbatch-fused", "xla",
             _build_resident_superbatch_fused, donate=(0, 3),
+            bounds=((2, "flow-page-table"), (4, "device-tables")),
         ),
         KernelEntrypoint(
             "telemetry/sketch-update", "xla", _build_sketch_update,
@@ -1153,10 +1223,12 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         KernelEntrypoint(
             "classify-wire/resident-telemetry-fused", "xla",
             _build_resident_telemetry_fused, donate=(0, 3, 4),
+            bounds=((2, "flow-page-table"), (5, "device-tables")),
         ),
         KernelEntrypoint(
             "classify-wire/resident-superbatch-telemetry-fused", "xla",
             _build_resident_superbatch_telemetry_fused, donate=(0, 3, 4),
+            bounds=((2, "flow-page-table"), (5, "device-tables")),
         ),
         KernelEntrypoint(
             "mlscore/score-update", "xla", _build_score_update,
@@ -1165,27 +1237,40 @@ def kernel_entrypoints() -> List[KernelEntrypoint]:
         KernelEntrypoint(
             "classify-wire/resident-mlscore-fused", "xla",
             _build_resident_mlscore_fused, donate=(0, 3, 4),
+            bounds=((2, "flow-page-table"), (7, "device-tables")),
         ),
         KernelEntrypoint(
             "classify-wire/resident-payload-fused", "xla",
             _build_resident_payload_fused, donate=(0, 3),
+            bounds=((2, "flow-page-table"), (4, "ac-dflat"),
+                    (7, "device-tables")),
         ),
         KernelEntrypoint(
             "classify-wire/resident-superbatch-payload-fused", "xla",
             _build_resident_superbatch_payload_fused, donate=(0, 3),
+            bounds=((2, "flow-page-table"), (4, "ac-dflat"),
+                    (7, "device-tables")),
+        ),
+        KernelEntrypoint(
+            "payload/acmatch-standalone", "xla", _build_acmatch_standalone,
+            bounds=((0, "ac-delta"),),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-dense-wire", "xla",
             _build_mesh_sharded_dense,
+            bounds=((0, "device-tables"),),
         ),
         KernelEntrypoint(
             "classify-mesh/sharded-trie-wire", "xla",
             _build_mesh_sharded_trie,
+            bounds=((0, "device-tables"),),
         ),
         KernelEntrypoint(
             "classify-mesh/walk-wire", "pallas", _build_mesh_walk
         ),
         KernelEntrypoint(
-            "classify-mesh/arena-trie-wire", "xla", _build_mesh_arena_trie
+            "classify-mesh/arena-trie-wire", "xla", _build_mesh_arena_trie,
+            bounds=((0, "ctrie-arena",
+                     lambda: _fixture_mesh_arena()[1].spec),),
         ),
     ]

@@ -48,6 +48,7 @@ zero-recompile hot-swap discipline).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,6 +71,27 @@ MATMUL_MAX_STATES = 128
 #: suffix patterns its failure chain carries, so any payload containing
 #: an overlapping/suffix match diverges from the naive host oracle.
 _INJECT_ACLINK_BUG = False
+
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_I8WRAP_BUG env var), the gather transition path
+#: restages the carried DFA state through int8 between scan steps —
+#: the narrowed-accumulator defect class: any automaton with more than
+#: 127 states silently wraps the state id and walks garbage
+#: transitions.  The static bounds verifier's acceptance gate
+#: (tools/infw_lint.py bounds --inject-defect i8wrap) proves the
+#: int-wrap check flags the restage (the ac-delta declared bound makes
+#: the carried state's true range known) and concretizes a diverging
+#: boundary witness.  TRACE-time flag: set it before the first trace
+#: (the acceptance gate runs in a fresh process).  Never set in
+#: production.
+_INJECT_I8WRAP_BUG = False
+
+
+def _inject_i8wrap_bug() -> bool:
+    if _INJECT_I8WRAP_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_I8WRAP_BUG", "")
+    return env not in ("", "0", "false", "no")
 
 
 class AcSpec(NamedTuple):
@@ -305,6 +327,8 @@ def _acmatch_core(trans, matchmap, pay, plen, *, spec: AcSpec):
         flat = jnp.clip(state, 0, S - 1) * 256 + byte
         nxt = jnp.take(delta.reshape(-1), flat, mode="clip")
         state2 = jnp.where(active, nxt, state)
+        if _inject_i8wrap_bug():
+            state2 = state2.astype(jnp.int8).astype(jnp.int32)
         m = jnp.take(matchmap, jnp.clip(state2, 0, S - 1), axis=0,
                      mode="clip")
         matches = matches | jnp.where(active[:, None], m, jnp.uint32(0))

@@ -3047,6 +3047,27 @@ def _inject_cowrace_bug() -> bool:
     return env not in ("", "0", "false", "no")
 
 
+#: TEST-ONLY defect injection: when truthy (module flag or the
+#: INFW_INJECT_CLAMPGATHER_BUG env var), arena_ctrie_rows skips the
+#: ``& _SPLICE_PAGE_MASK`` decode of spliced page-table rows — the
+#: bank bit (bit 30) leaks into the page id, so ``pg0 * SL + ifindex``
+#: indexes the root lut out of bounds for any spliced tenant.  The
+#: static bounds verifier's acceptance gate (tools/infw_lint.py bounds
+#: --inject-defect clampgather) proves abstract interpretation flags
+#: the unclamped gather and concretizes a diverging boundary witness.
+#: TRACE-time flag: must be set before the entrypoint is first traced
+#: (the acceptance gate runs it in a fresh process).  Never set in
+#: production.
+_INJECT_CLAMPGATHER_BUG = False
+
+
+def _inject_clampgather_bug() -> bool:
+    if _INJECT_CLAMPGATHER_BUG:
+        return True
+    env = os.environ.get("INFW_INJECT_CLAMPGATHER_BUG", "")
+    return env not in ("", "0", "false", "no")
+
+
 class ArenaCapacityError(ValueError):
     """A tenant table does not fit the arena's slab geometry (entries,
     node rows, trie depth, rule width, lut span) or the pool is out of
@@ -3792,7 +3813,10 @@ def _arena_ctrie_entry(
     spliced = spec is not None and spec.spliced
     if spliced:
         bank = jnp.where(valid, pg_raw >> _SPLICE_BANK_SHIFT, 0)
-        pg = jnp.where(valid, pg_raw & _SPLICE_PAGE_MASK, -1)
+        if _inject_clampgather_bug():
+            pg = jnp.where(valid, pg_raw, -1)
+        else:
+            pg = jnp.where(valid, pg_raw & _SPLICE_PAGE_MASK, -1)
     else:
         pg = pg_raw
     pg0 = jnp.clip(pg, 0)

@@ -1,0 +1,438 @@
+"""Kernel admission verifier (infw.analysis.boundscheck).
+
+Covers: the abstract domain (interval + maybe-bits, joins, dtype
+clamping), per-primitive transfer functions driven through tiny traced
+jaxprs (arithmetic hulls, narrowing converts, masked decodes, gather/
+scatter proof and guard recognition, select_n dead-branch pruning
+through jnp.take's internal wraparound), integer-wrap detection at the
+int8/int32/uint32 edges with the intentional-modular exemption,
+fixpoint termination on loop carries, declared-bound seeding
+(infw.contracts.TENSOR_BOUNDS), the shared justification-required
+suppression loader, and the declarative injected-defect registry
+(infw.analysis.defects).  Slow-marked: the full-fleet sweep over every
+registered entrypoint (zero unsuppressed findings — the make
+bounds-check gate) and the two injected-defect acceptances through the
+CLI in fresh subprocesses (the flags act at trace time).
+"""
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infw import contracts
+from infw.analysis import _suppress, defects
+from infw.analysis import boundscheck as bc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "infw_lint.py")
+
+
+def interp(fn, *seeds, args=None):
+    """Trace ``fn`` at the seeds' shapes and abstractly interpret it.
+
+    ``seeds`` align with the positional args: an AbsVal seeds that
+    argument's interval; a concrete array seeds dtype-top at its
+    shape.  Returns (ctx, out_absvals)."""
+    if args is None:
+        args = []
+        for s in seeds:
+            if isinstance(s, bc.AbsVal):
+                args.append(jnp.zeros((8,), s.dtype))
+            else:
+                args.append(s)
+    closed = jax.make_jaxpr(fn)(*args)
+    flat = []
+    for s, v in zip(seeds, closed.jaxpr.invars):
+        if isinstance(s, bc.AbsVal):
+            flat.append(s)
+        else:
+            dt = v.aval.dtype
+            flat.append(bc.AbsVal(dt, is_float=np.dtype(dt).kind == "f"))
+    ctx = bc._Ctx("test")
+    outs = bc.interp_closed_jaxpr(closed, flat, ctx)
+    return ctx, outs
+
+
+def errors(ctx, check=None):
+    return [f for f in ctx.findings.values()
+            if f.severity == "error" and (check is None or f.check == check)]
+
+
+# --- abstract domain --------------------------------------------------------
+
+
+def test_absval_clamps_to_dtype():
+    a = bc.AbsVal(np.int8, -1000, 1000)
+    assert (a.lo, a.hi) == (-128, 127)
+    assert not a.informative()
+    b = bc.AbsVal(np.int32, 0, 100)
+    assert b.informative() and b.bits == 0x7F
+
+
+def test_absval_bits_cap_hi():
+    a = bc.AbsVal(np.int32, 0, 1000, bits=0xFF)
+    assert a.hi == 0xFF
+
+
+def test_join_widens_interval_and_ors_bits():
+    a = bc.AbsVal(np.int32, 0, 3)
+    b = bc.AbsVal(np.int32, 8, 15)
+    j = bc._join(a, b)
+    assert (j.lo, j.hi) == (0, 15)
+
+
+# --- arithmetic transfer ----------------------------------------------------
+
+
+def test_add_interval_hull():
+    ctx, (out,) = interp(
+        lambda x, y: x + y,
+        bc.AbsVal(np.int32, 0, 10), bc.AbsVal(np.int32, 5, 7))
+    assert (out.lo, out.hi) == (5, 17)
+    assert not errors(ctx)
+
+
+def test_mul_corner_hull():
+    ctx, (out,) = interp(
+        lambda x, y: x * y,
+        bc.AbsVal(np.int32, -3, 4), bc.AbsVal(np.int32, -5, 6))
+    assert (out.lo, out.hi) == (-20, 24)
+
+
+def test_and_mask_bounds_result():
+    """value & mask decodes are what the bits half of the domain is
+    for: the result is bounded by the mask even when the value is top."""
+    ctx, (out,) = interp(lambda x: x & 0xFF, bc.AbsVal(np.int32))
+    assert (out.lo, out.hi) == (0, 0xFF)
+
+
+def test_cumsum_scales_by_axis_length():
+    ctx, (out,) = interp(lambda x: jnp.cumsum(x), bc.AbsVal(np.int32, 0, 3))
+    assert (out.lo, out.hi) == (0, 24)          # 8 lanes * 3
+    assert not errors(ctx)
+
+
+def test_cumsum_int8_accumulation_wrap_flagged():
+    ctx, _ = interp(lambda x: jnp.cumsum(x), bc.AbsVal(np.int8, 0, 100))
+    errs = errors(ctx, "int-wrap")
+    assert len(errs) == 1 and "cumsum" in errs[0].subject
+
+
+def test_clip_narrows_and_min_max_hull():
+    ctx, (out,) = interp(
+        lambda x: jnp.clip(x, 0, 15), bc.AbsVal(np.int32))
+    assert (out.lo, out.hi) == (0, 15)
+
+
+# --- integer wrap detection at the dtype edges ------------------------------
+
+
+def test_int8_add_wrap_flagged():
+    ctx, _ = interp(
+        lambda x, y: x + y,
+        bc.AbsVal(np.int8, 0, 100), bc.AbsVal(np.int8, 0, 100))
+    errs = errors(ctx, "int-wrap")
+    assert len(errs) == 1 and "add" in errs[0].subject
+
+
+def test_int32_mul_const_wrap_flagged_with_const_tag():
+    ctx, _ = interp(
+        lambda x: x * jnp.int32(65536),
+        bc.AbsVal(np.int32, 0, 2**20))
+    errs = errors(ctx, "int-wrap")
+    assert len(errs) == 1
+    assert ":c65536" in errs[0].subject
+
+
+def test_uint32_sub_wrap_flagged():
+    ctx, _ = interp(
+        lambda x, y: x - y,
+        bc.AbsVal(np.uint32, 0, 10), bc.AbsVal(np.uint32, 0, 20))
+    assert len(errors(ctx, "int-wrap")) == 1
+
+
+def test_in_range_arith_not_flagged():
+    ctx, _ = interp(
+        lambda x, y: x * y,
+        bc.AbsVal(np.int32, 0, 1000), bc.AbsVal(np.int32, 0, 1000))
+    assert not errors(ctx)
+
+
+def test_intentional_modular_not_flagged():
+    """An operand already spanning the full dtype ring means modular
+    arithmetic on purpose (hash state, u32 counters) — no finding."""
+    ctx, _ = interp(
+        lambda x: x * jnp.uint32(16777619),    # FNV-1a prime step
+        bc.AbsVal(np.uint32))
+    assert not errors(ctx)
+
+
+def test_narrowing_convert_flagged_and_value_preserving_not():
+    ctx, _ = interp(
+        lambda x: x.astype(jnp.int8), bc.AbsVal(np.int32, 0, 300))
+    errs = errors(ctx, "int-wrap")
+    assert len(errs) == 1 and "convert" in errs[0].subject
+    ctx2, (out,) = interp(
+        lambda x: x.astype(jnp.int8), bc.AbsVal(np.int32, 0, 100))
+    assert not errors(ctx2) and (out.lo, out.hi) == (0, 100)
+
+
+# --- gather/scatter proof and guard recognition -----------------------------
+
+
+def test_seeded_in_range_gather_proved():
+    t = jnp.arange(64, dtype=jnp.int32)
+    ctx, _ = interp(
+        lambda t, i: jnp.take(t, i),
+        t, bc.AbsVal(np.int32, 0, 63), args=[t, jnp.zeros((8,), jnp.int32)])
+    assert not errors(ctx)
+    assert ctx.stats["proved"] >= 1
+
+
+def test_unbounded_gather_flagged():
+    t = jnp.arange(64, dtype=jnp.int32)
+    ctx, _ = interp(
+        lambda t, i: t[i],
+        t, bc.AbsVal(np.int32), args=[t, jnp.zeros((8,), jnp.int32)])
+    assert len(errors(ctx, "oob-gather")) == 1
+
+
+def test_guarded_gather_recognized():
+    """The production idiom: range-test the raw index, clip it for the
+    gather, select on the test — the tested bounds flow through clip
+    by shared reference, so the site counts as guarded, not flagged."""
+    t = jnp.arange(64, dtype=jnp.int32)
+
+    def fn(t, i):
+        ok = (i >= 0) & (i < 64)
+        return jnp.where(ok, jnp.take(t, jnp.clip(i, 0, 63)), 0)
+
+    ctx, _ = interp(fn, t, bc.AbsVal(np.int32),
+                    args=[t, jnp.zeros((8,), jnp.int32)])
+    assert not errors(ctx)
+
+
+def test_take_internal_wraparound_not_flagged():
+    """jnp.take lowers to ``where(i < 0, i + n, i)`` + a fill-mode
+    gather; with the index seeded non-negative the wraparound add and
+    the fill path are both abstractly dead — no int-wrap, no fill
+    join, site proved (the select_n dead-branch pruning test)."""
+    t = jnp.arange(100, dtype=jnp.int32)
+    ctx, _ = interp(
+        lambda t, i: jnp.take(t, i),
+        t, bc.AbsVal(np.int32, 0, 99), args=[t, jnp.zeros((8,), jnp.int32)])
+    assert not errors(ctx)
+    assert ctx.stats["proved"] >= 1
+
+
+def test_masked_decode_proves_gather():
+    """The splice page-table idiom: a declared-bits row decodes via
+    ``& mask`` into a provable index."""
+    t = jnp.arange(16, dtype=jnp.int32)
+    ctx, _ = interp(
+        lambda t, v: jnp.take(t, v & 0xF),
+        t, bc.AbsVal(np.int32), args=[t, jnp.zeros((8,), jnp.int32)])
+    assert not errors(ctx)
+
+
+# --- fixpoint termination ---------------------------------------------------
+
+
+def test_scan_carry_fixpoint_terminates_and_widens():
+    """A strictly growing loop carry must widen to dtype-top within
+    WIDEN_AFTER joins instead of iterating the interval lattice — the
+    termination bound of the fixpoint."""
+
+    def fn(x):
+        def step(c, _):
+            return c + x, ()
+        out, _ = jax.lax.scan(step, jnp.int32(0), None, length=1000)
+        return out
+
+    ctx, (out,) = interp(fn, bc.AbsVal(np.int32, 1, 1),
+                         args=[jnp.int32(1)])
+    assert out.hi == np.iinfo(np.int32).max
+
+
+def test_fori_loop_bounded_carry_stays_bounded():
+    def fn(t):
+        def body(_, c):
+            return jnp.clip(c + 1, 0, 7)
+        return jax.lax.fori_loop(0, 100, body, jnp.int32(0))
+
+    ctx, (out,) = interp(fn, bc.AbsVal(np.int32, 0, 0),
+                         args=[jnp.int32(0)])
+    assert 0 <= out.lo and out.hi <= 7
+
+
+# --- declared-bound seeding -------------------------------------------------
+
+
+def test_tensor_bounds_roles_resolve():
+    b = contracts.resolve_bounds("flow-page-table",
+                                 np.zeros(8, np.int32), spec=4)
+    assert b[""] == contracts.TensorBound(-1, 3)
+    assert contracts.resolve_bounds("no-such-role", None) == {}
+
+
+def test_check_declared_bounds_runtime_half():
+    ok = contracts.check_declared_bounds(
+        "flow-page-table", np.array([-1, 0, 3], np.int32), spec=4)
+    assert ok == []
+    bad = contracts.check_declared_bounds(
+        "flow-page-table", np.array([4], np.int32), spec=4)
+    assert bad and "escape" in bad[0]
+
+
+def test_seed_absvals_applies_declared_interval():
+    arr = np.zeros(8, np.int32)
+    flat = bc.seed_absvals(
+        (arr, arr), ((1, "flow-page-table", lambda: 4),))
+    assert flat[0].lo == np.iinfo(np.int32).min     # unseeded: top
+    assert (flat[1].lo, flat[1].hi) == (-1, 3)      # declared
+
+
+# --- suppression loader -----------------------------------------------------
+
+
+def test_suppression_requires_justification(tmp_path):
+    p = tmp_path / "s.txt"
+    p.write_text("int-wrap foo:*\n")
+    with pytest.raises(ValueError):
+        _suppress.load_suppressions(str(p))
+
+
+def test_suppression_scoped_by_check_and_glob(tmp_path):
+    p = tmp_path / "s.txt"
+    p.write_text("int-wrap *:mul:*  # modular on purpose\n")
+    supp = _suppress.load_suppressions(str(p))
+    assert _suppress.match(supp, "int-wrap", "e:mul:uint32@f.py:1")
+    assert not _suppress.match(supp, "oob-gather", "e:mul:uint32@f.py:1")
+    assert not _suppress.match(supp, "int-wrap", "e:add:uint32@f.py:1")
+
+
+def test_shipped_suppressions_load_and_are_justified():
+    supp = _suppress.load_suppressions(bc.default_suppressions_path())
+    assert supp, "shipped suppression file must exist and be non-empty"
+    assert all(s[2] for s in supp)
+    assert all(s[0] in ("int-wrap", "oob-gather", "oob-scatter")
+               for s in supp)
+
+
+# --- injected-defect registry -----------------------------------------------
+
+
+def test_defect_registry_flags_resolve():
+    import importlib
+
+    for d in defects.DEFECTS.values():
+        if d.module:
+            mod = importlib.import_module(d.module)
+            assert hasattr(mod, d.flag), (d.name, d.flag)
+            assert getattr(mod, d.flag) is False, (
+                f"{d.name}: injection flag must ship off")
+        assert d.expect
+
+
+def test_defect_registry_checker_slices():
+    assert set(defects.names("bounds")) == {"clampgather", "i8wrap"}
+    assert "joined-pad" in defects.names("state")
+    assert defects.names("lock") == ["lockorder"]
+    assert defects.names("sched") == ["cowrace"]
+    for d in defects.by_checker("bounds"):
+        assert d.entry and d.check and d.env
+
+
+def test_defect_set_flag_roundtrip():
+    import importlib
+
+    d = defects.get("i8wrap")
+    mod = importlib.import_module(d.module)
+    defects.set_flag(d, True)
+    try:
+        assert getattr(mod, d.flag) is True
+    finally:
+        defects.set_flag(d, False)
+    assert getattr(mod, d.flag) is False
+
+
+# --- fleet sweep + CLI acceptances (slow) -----------------------------------
+
+
+def _cli(*argv):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, LINT, *argv], capture_output=True, text=True,
+        env=env, cwd=REPO)
+
+
+@pytest.mark.slow
+def test_full_fleet_sweep_clean():
+    """Every registered entrypoint audits clean: zero unsuppressed
+    findings, zero audit errors, every index site proved/guarded/
+    dead — the make bounds-check gate, in-process."""
+    reports = bc.audit_all(witness=False)
+    summary = bc.summarize(reports)
+    assert summary["audit_errors"] == 0, [r.error for r in reports if r.error]
+    assert summary["errors"] == 0, [
+        f.subject for r in reports for f in r.findings
+        if f.severity == "error"]
+    assert summary["entries"] >= 30
+    assert summary["proved"] >= 250
+    assert summary["guarded"] >= 200
+    assert summary["suppressed"] >= 60
+    # every suppressed finding names its justification
+    for r in reports:
+        for f in r.suppressed:
+            assert f.suppressed_by
+
+
+@pytest.mark.slow
+def test_wrap_findings_carry_source_attribution():
+    """Suppressed wrap residue must point at the kernel line (the
+    sharply-scoped suppression subjects), not the jax internals."""
+    reports = bc.audit_all(witness=False)
+    tagged = [f for r in reports for f in r.suppressed
+              if f.check == "int-wrap"]
+    assert tagged
+    assert all("@" in f.subject and ".py:" in f.subject for f in tagged)
+
+
+@pytest.mark.slow
+def test_cli_bounds_strict_clean():
+    proc = _cli("bounds", "--strict", "--no-witness")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_clampgather_acceptance():
+    """Fresh process (the flag acts at trace time): the dropped
+    & _SPLICE_PAGE_MASK decode must be reported as oob-gather AND
+    concretized by a diverging bank-1 witness."""
+    proc = _cli("bounds", "--inject-defect", "clampgather")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CAUGHT clampgather" in proc.stdout
+    assert "oob-gather" in proc.stdout
+    assert "diverge" in proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_i8wrap_acceptance():
+    proc = _cli("bounds", "--inject-defect", "i8wrap")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CAUGHT i8wrap" in proc.stdout
+    assert "int-wrap" in proc.stdout
+    assert "diverge" in proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_acceptance_loop_bounds_slice():
+    proc = _cli("acceptance", "--checker", "bounds")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 missed" in proc.stdout

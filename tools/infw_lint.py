@@ -54,6 +54,25 @@ Subcommands:
           allocator lock around the CoW donor refcount decrement; the
           explorer must find + shrink the race and check_arena's
           cowleak invariant must name it (exit 0 = caught).
+  bounds  Kernel admission verifier (infw.analysis.boundscheck):
+          abstract interpretation over the jaxpr of EVERY registered
+          kernel entrypoint — interval + known-bits domain seeded from
+          the declared tensor bounds (infw.contracts.TENSOR_BOUNDS,
+          the same declarations the runtime invariant sweeps enforce)
+          — proving every gather/scatter/dynamic_slice index fits its
+          operand extent and every integer op fits its dtype.  Error
+          findings replay a concretized boundary witness through
+          production dispatch vs the CPU oracle; intentional modular
+          arithmetic (SWAR popcount, one-hot packing) lives in
+          analysis/boundscheck_suppressions.txt with REQUIRED
+          justifications.  ``--inject-defect [clampgather|i8wrap]``
+          re-introduces a known bounds bug and verifies the verifier
+          reports it with a diverging witness (exit 0 = caught).
+  acceptance
+          Run the consolidated injected-defect registry
+          (infw.analysis.defects) end to end: every ``--inject-defect``
+          acceptance of every checker, each in a fresh subprocess;
+          ``--checker``/``--defects`` select slices.
 
 Exit status: 1 when any error-severity finding exists (or, with
 ``--strict``, any warning too); 0 otherwise.  ``--json`` prints one
@@ -74,6 +93,8 @@ from typing import List
 from _common import repo_root, setup_repo_path
 
 setup_repo_path()
+
+from infw.analysis import defects as defect_registry  # noqa: E402
 
 
 # --- rules subcommand -------------------------------------------------------
@@ -345,118 +366,25 @@ def _run_inject_defect(args, as_json: bool) -> int:
     transaction fold (infw.txn) on the 'txn' config — the corrupted
     fold feeds updater, resident state AND cold rebuild alike, so the
     catch again MUST be per-op-ground-truth oracle divergence, shrunk
-    to a <= 2-op (delete, readd) reproducer."""
-    from infw import flow as flow_mod, resident as resident_mod, txn as txn_mod
+    to a <= 2-op (delete, readd) reproducer.  The per-defect knobs
+    (config, shrink bound, generator horizon, shrinker budget) and the
+    expected-catch contract all come from the declarative registry
+    (infw.analysis.defects)."""
     from infw.analysis import statecheck
-    from infw.kernels import (
-        acmatch as acmatch_mod,
-        jaxpath,
-        mxu_score as mxu_score_mod,
-        sketch as sketch_mod,
-    )
 
-    defect = args.inject_defect
-    mod, flag, config, bound = {
-        "joined-pad": (jaxpath, "_INJECT_JOINED_PAD_BUG", "nojoined", 3),
-        "cskip": (jaxpath, "_INJECT_CSKIP_BUG", "ctrie", 3),
-        "fold": (txn_mod, "_INJECT_FOLD_BUG", "txn", 2),
-        # stale page-table row after a tenant hot-swap (the arena's
-        # O(1) activation silently not landing on device): caught by
-        # the arena invariant/oracle layers, shrunk to the one
-        # tenant_swap op
-        "pageflip": (jaxpath, "_INJECT_PAGEFLIP_BUG", "arena-ctrie", 3),
-        # CoW donor-refcount leak: the clone path of the content-
-        # addressed arena "forgets" to decrement the donor page's
-        # refcount after flipping the editing tenant onto its private
-        # copy — caught by check_arena's refcount-vs-page-table-rows
-        # invariant on the shared-then-edited-biased arena-cow config,
-        # shrinking to (copy-create, edit) plus slack
-        "cowleak": (jaxpath, "_INJECT_COWLEAK_BUG", "arena-cow", 3),
-        # subtree-plane refcount leak: the unsplice path of the
-        # structural-compression arena "forgets" to decrement the OLD
-        # plane's refcount after re-pointing the editing tenant's
-        # splice row at its private copy — caught by check_arena's
-        # plane-refcount-vs-splice-row-recount invariant on the
-        # near-copy-biased arena-splice config, shrinking to
-        # (near-copy create, deep edit) plus slack
-        "spliceleak": (jaxpath, "_INJECT_SPLICELEAK_BUG",
-                       "arena-splice", 3),
-        # dropped flow invalidation: a rule edit's generation bump is
-        # silently skipped (infw.flow.bump_generation no-ops), so the
-        # flow tier keeps serving the PRE-edit cached verdict.  Device
-        # state, host model and cold rebuild all agree (the bump never
-        # ran anywhere), so the catch MUST be oracle divergence on a
-        # replayed traffic stream after an edit — shrinking to
-        # (flow_traffic, edit, flow_traffic) plus slack
-        "flowstale": (flow_mod, "_INJECT_FLOW_STALE_BUG", "flow", 4),
-        # stale donated serving loop: the resident pool's table-
-        # generation staleness check is dropped (infw.resident), so
-        # after a rule patch the fused donated program keeps
-        # classifying against the PRE-patch captured table operands —
-        # caught by oracle divergence on the resident config's witness
-        # (the very next settled check after any edit), shrinking to a
-        # single edit op
-        "residentstale": (resident_mod, "_INJECT_RESIDENT_STALE_BUG",
-                          "resident", 3),
-        # stale slot-1 epoch re-seed (ISSUE-16): every second resident
-        # dispatch (pipeline slot 1) silently re-uploads the device
-        # epoch at epoch-2 instead of chaining the donated scalar, so
-        # the device stamps flow rows one epoch behind the host model —
-        # caught by the pipeline config's flow-column bit-identity pass
-        # at the first settled check (one flow_traffic op dispatches
-        # both slots), shrinking to a single traffic op plus slack
-        "slotepoch": (flow_mod, "_INJECT_SLOT_EPOCH_BUG", "pipeline", 3),
-        # dropped count-min saturation clamp (infw.kernels.sketch): the
-        # DEVICE sketch update stops clamping at ``sat`` while the host
-        # model keeps clamping — the telemetry config's tiny sat makes
-        # the very first settled check's witness traffic push a bucket
-        # past the clamp, so the device-vs-model bit-identity pass
-        # diverges and the shrinker reduces to (at most) one traffic op
-        "sketchsat": (sketch_mod, "_INJECT_SKETCH_SAT_BUG",
-                      "telemetry", 3),
-        # dropped MLP requantization clamp (infw.kernels.mxu_score):
-        # the DEVICE scoring kernels stop saturating the hidden layer
-        # at 127 (activations wrap through int8) while the host model
-        # keeps clamping — the mlscore config runs the clamp-stress
-        # model, so the very first scored admission's witness traffic
-        # pushes an activation past the clamp and the device-vs-model
-        # bit-identity pass diverges, shrinking to (at most) one
-        # traffic op
-        "mlquant": (mxu_score_mod, "_INJECT_MLQUANT_BUG",
-                    "mlscore", 3),
-        # dropped failure-link fold (infw.kernels.acmatch): automaton
-        # construction "forgets" to union one failure state's pattern
-        # outputs into its inheritors, so the DEVICE bitmap misses
-        # matches reached through failure transitions (overlapping
-        # patterns, signatures embedded mid-payload) while the NAIVE
-        # host substring oracle still claims them — the payload
-        # config's device-bitmap-vs-payload_match_ref pass diverges at
-        # the first settled check after a payload_traffic op, shrinking
-        # to that one op plus slack
-        "aclink": (acmatch_mod, "_INJECT_ACLINK_BUG", "payload", 4),
-    }[defect]
-    # the fold defect only fires on a delete-then-readd landing in one
-    # transaction; give the seeded generator a horizon that reliably
-    # produces one (seed 0 hits by op 5 at 12 ops) and the shrinker
-    # budget to reduce it back down to the (delete, readd) pair
-    # fold/flowstale defects need a multi-op pattern to fire (delete-
-    # then-readd in one txn; traffic-edit-traffic on one seed): give the
-    # generator a horizon that reliably produces one and the shrinker
-    # the budget to reduce it
-    n_ops = (
-        max(args.ops, 12)
-        if defect in ("fold", "flowstale", "cowleak", "spliceleak")
-        else args.ops
-    )
-    shrink_runs = (
-        64 if defect in ("fold", "flowstale", "cowleak", "spliceleak")
-        else 32
-    )
+    d = defect_registry.get(args.inject_defect)
+    defect, config, bound = d.name, d.config, d.bound
+    # multi-op defects (delete-then-readd in one txn; traffic-edit-
+    # traffic on one seed) need a generator horizon that reliably
+    # produces the pattern and a shrinker budget to reduce it back —
+    # declared per defect as min_ops/shrink_runs in the registry
+    n_ops = max(args.ops, d.min_ops)
+    shrink_runs = d.shrink_runs
     if args.configs:
         print(f"note: --inject-defect {defect} always runs the "
               f"{config!r} config (the defect's layout regime); "
               "--configs ignored", file=sys.stderr)
-    setattr(mod, flag, True)
+    defect_registry.set_flag(d, True)
     try:
         report = statecheck.run_config(
             config, seed=args.seed, n_ops=n_ops,
@@ -464,7 +392,7 @@ def _run_inject_defect(args, as_json: bool) -> int:
             max_shrink_runs=shrink_runs,
         )
     finally:
-        setattr(mod, flag, False)
+        defect_registry.set_flag(d, False)
     problems = []
     if report["ok"]:
         problems.append(
@@ -607,28 +535,27 @@ def cmd_sched(args) -> int:
     from infw.analysis import schedcheck
 
     if args.inject_defect:
-        # cowrace acceptance: drop the allocator lock around the CoW
-        # donor refcount decrement; the explorer must find the
-        # interleaving, shrink it to <= 6 schedule steps, and
-        # check_arena's cowleak invariant must name it.  Exit 0 =
+        # acceptance (registry-declared, e.g. cowrace): inject the
+        # defect's production-module flag; the explorer must find the
+        # failing interleaving, shrink it to <= max_segments schedule
+        # steps, and the declared invariant must name it.  Exit 0 =
         # caught.
-        from infw.kernels import jaxpath
-
-        old = jaxpath._INJECT_COWRACE_BUG
-        jaxpath._INJECT_COWRACE_BUG = True
+        d = defect_registry.get(args.inject_defect)
+        defect_registry.set_flag(d, True)
         try:
             res = schedcheck.explore(
-                "cow-vs-destroy",
-                schedcheck.SCENARIOS["cow-vs-destroy"],
+                d.scenario,
+                schedcheck.SCENARIOS[d.scenario],
                 seed=args.seed, runs=max(args.runs, 120),
                 bound=args.preemptions,
             )
         finally:
-            jaxpath._INJECT_COWRACE_BUG = old
+            defect_registry.set_flag(d, False)
         caught = (
             not res.ok and res.shrunk is not None
-            and res.shrunk.segments <= 6
-            and any("cowleak" in e for e in res.shrunk.invariant_errors)
+            and res.shrunk.segments <= d.max_segments
+            and any(d.invariant_token in e
+                    for e in res.shrunk.invariant_errors)
         )
         if args.json:
             print(json.dumps({"defect": args.inject_defect,
@@ -644,7 +571,8 @@ def cmd_sched(args) -> int:
         else:
             print(f"NOT CAUGHT {args.inject_defect}: "
                   + ("no failing interleaving found" if res.ok
-                     else "failure did not shrink to the cowleak repro"))
+                     else "failure did not shrink to the "
+                          f"{d.invariant_token} repro"))
         return 0 if caught else 1
 
     names = ([x for x in args.scenarios.split(",") if x]
@@ -672,6 +600,166 @@ def cmd_sched(args) -> int:
                     print(f"     | {line}")
         print(f"sched: {len(results)} scenario(s), {n_fail} failure(s)")
     return 1 if n_fail else 0
+
+
+# --- bounds subcommand ------------------------------------------------------
+
+
+def _bounds_inject(args, as_json: bool) -> int:
+    """Bounds injected-defect acceptance: flip the registry-declared
+    TRACE-time flag, audit the defect's entry, and require (1) an
+    unsuppressed error finding of the expected check and (2) a
+    DIVERGING boundary witness — the executable half of the proof.
+    Exit 0 = caught.  Run in a fresh process (the flags act at trace
+    time; the Makefile/tests invoke the CLI, which is one)."""
+    from infw.analysis import boundscheck
+
+    d = defect_registry.get(args.inject_defect)
+    defect_registry.set_flag(d, True)
+    try:
+        rep = boundscheck.audit_entry(
+            _bounds_entry(d.entry), batch=args.batch, witness=True)
+    finally:
+        defect_registry.set_flag(d, False)
+    hits = [f for f in rep.findings
+            if f.severity == "error" and f.check == d.check]
+    problems = []
+    if rep.error:
+        problems.append(f"audit failed: {rep.error}")
+    if not hits:
+        problems.append(
+            f"injected {d.name} defect NOT caught: no unsuppressed "
+            f"{d.check} error on {d.entry}")
+    witnessed = [f for f in hits
+                 if f.witness and f.witness.get("ran")
+                 and f.witness.get("diverged")]
+    if hits and not witnessed:
+        w = hits[0].witness or {}
+        problems.append(
+            f"{d.check} reported but the boundary witness did not "
+            f"diverge ({w.get('error') or w.get('detail') or 'no witness'})")
+    if as_json:
+        print(json.dumps({
+            "defect": d.name, "caught": not problems,
+            "problems": problems, "report": rep.to_dict(),
+        }, indent=2))
+    else:
+        for f in witnessed:
+            print(f"inject-defect: CAUGHT {d.name}: {f.check} "
+                  f"{f.subject} {f.interval} — witness: "
+                  f"{f.witness.get('detail')}")
+        for p in problems:
+            print(f"INJECT-DEFECT FAIL: {p}")
+    return 0 if not problems else 1
+
+
+def _bounds_entry(name: str):
+    from infw import kernels
+
+    for ep in kernels.kernel_entrypoints():
+        if ep.name == name:
+            return ep
+    raise SystemExit(f"no registered entrypoint named {name!r}")
+
+
+def cmd_bounds(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from infw.analysis import boundscheck
+
+    if args.inject_defect:
+        return _bounds_inject(args, args.json)
+
+    names = [x for x in (args.entry or "").split(",") if x] or None
+    reports = boundscheck.audit_all(
+        names=names, batch=args.batch,
+        witness=not args.no_witness)
+    summary = boundscheck.summarize(reports)
+    ignore = set((args.ignore or "").split(","))
+    findings = [f for r in reports for f in r.findings
+                if f.check not in ignore]
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity == "warning"]
+    audit_errors = [r for r in reports if r.error]
+    if args.json:
+        print(json.dumps({
+            "summary": summary,
+            "reports": [r.to_dict() for r in reports],
+        }, indent=2))
+    else:
+        for r in reports:
+            if r.error:
+                print(f"AUDIT-ERROR {r.entry}: {r.error}")
+        for f in findings:
+            sev = f.severity.upper()
+            line = f"{sev} [{f.check}] {f.subject} {f.interval}"
+            if f.extent:
+                line += f" extent {f.extent}"
+            print(line)
+            if f.eqn:
+                print(f"  {f.eqn}")
+        sup = summary["suppressed"]
+        print(f"bounds: {summary['entries']} entries, "
+              f"{summary['index_sites']} index sites "
+              f"({summary['proved']} proved, {summary['guarded']} "
+              f"guarded, {summary['pallas_opaque']} pallas-opaque), "
+              f"{len(errors)} error(s), {len(warnings)} warning(s), "
+              f"{sup} suppressed")
+    if errors or audit_errors:
+        return 1
+    if args.strict and warnings:
+        return 1
+    return 0
+
+
+# --- acceptance subcommand --------------------------------------------------
+
+
+def cmd_acceptance(args) -> int:
+    """Loop the injected-defect registry (optionally one checker's
+    slice) and run every acceptance in a FRESH subprocess — uniform
+    for all checkers, and required for the bounds defects, whose flags
+    act at trace time and whose witness replays would otherwise reuse
+    this process's warm jit caches."""
+    import subprocess
+
+    wanted = [x for x in (args.checker or "").split(",") if x]
+    rows = []
+    for d in defect_registry.DEFECTS.values():
+        if wanted and d.checker not in wanted:
+            continue
+        if args.defects and d.name not in args.defects.split(","):
+            continue
+        if d.checker == "jax":
+            flag = ("--inject-transfer-defect" if d.name == "transfer"
+                    else "--inject-donation-defect")
+            entry = ("defect/implicit-transfer" if d.name == "transfer"
+                     else "defect/undonated-buffer")
+            argv = ["jax", "--strict", flag, "--entries", entry]
+            want_rc = 1     # the audit must FAIL on the injection
+        else:
+            argv = [d.checker, "--inject-defect", d.name]
+            want_rc = 0
+        cmd = [sys.executable, os.path.abspath(__file__)] + argv
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        ok = proc.returncode == want_rc
+        rows.append({"defect": d.name, "checker": d.checker, "ok": ok,
+                     "returncode": proc.returncode,
+                     "expect": d.expect})
+        status = "CAUGHT " if ok else "MISSED "
+        print(f"{status} {d.checker:6s} {d.name}")
+        if not ok:
+            tail = (proc.stdout + proc.stderr).strip().splitlines()[-6:]
+            for line in tail:
+                print(f"       | {line}")
+    n_bad = sum(1 for r in rows if not r["ok"])
+    if args.json:
+        print(json.dumps({"acceptances": rows, "ok": n_bad == 0},
+                         indent=2))
+    else:
+        print(f"acceptance: {len(rows)} defect(s), {n_bad} missed")
+    return 1 if n_bad or not rows else 0
 
 
 # --- main -------------------------------------------------------------------
@@ -740,10 +828,7 @@ def main(argv=None) -> int:
                          help="witness batch size override")
     p_state.add_argument("--inject-defect", nargs="?",
                          const="joined-pad", default=None,
-                         choices=("joined-pad", "cskip", "fold", "pageflip",
-                                  "cowleak", "spliceleak", "flowstale",
-                                  "residentstale", "slotepoch", "sketchsat",
-                                  "mlquant", "aclink"),
+                         choices=tuple(defect_registry.names("state")),
                          help="re-introduce a known bug — joined-pad "
                               "(default): the PR-4 joined-placeholder "
                               "bucket-padding bug; cskip: zeroed "
@@ -760,7 +845,8 @@ def main(argv=None) -> int:
     p_lock.add_argument("--strict", action="store_true",
                         help="warnings are fatal too")
     p_lock.add_argument("--inject-defect", nargs="?", const="lockorder",
-                        default=None, choices=("lockorder",),
+                        default=None,
+                        choices=tuple(defect_registry.names("lock")),
                         help="reverse the flow->telemetry lock nesting "
                              "in one synthetic path and verify the "
                              "analyzer reports the cycle with BOTH "
@@ -785,13 +871,56 @@ def main(argv=None) -> int:
                          help="comma-separated scenario subset "
                               "(default: the four production scenarios)")
     p_sched.add_argument("--inject-defect", nargs="?", const="cowrace",
-                         default=None, choices=("cowrace",),
+                         default=None,
+                         choices=tuple(defect_registry.names("sched")),
                          help="drop the allocator lock around the CoW "
                               "donor refcount decrement and verify the "
                               "explorer finds the interleaving, shrinks "
                               "it to <= 6 steps, and check_arena names "
                               "it (exit 0 = caught)")
     p_sched.set_defaults(fn=cmd_sched)
+
+    p_bounds = sub.add_parser(
+        "bounds", help="static gather/scatter bounds + overflow "
+                       "verifier (boundscheck)")
+    p_bounds.add_argument("--json", action="store_true")
+    p_bounds.add_argument("--strict", action="store_true",
+                          help="warnings are fatal too")
+    p_bounds.add_argument("--entry", metavar="NAMES",
+                          help="comma-separated entrypoint subset "
+                               "(default: every registered entrypoint)")
+    p_bounds.add_argument("--batch", type=int, default=256,
+                          help="trace batch size (default 256)")
+    p_bounds.add_argument("--ignore", metavar="CHECKS", default="",
+                          help="comma-separated check ids to drop")
+    p_bounds.add_argument("--no-witness", action="store_true",
+                          help="skip the boundary-witness replay of "
+                               "error findings (faster; proofs and "
+                               "suppressions unaffected)")
+    p_bounds.add_argument("--inject-defect", nargs="?",
+                          const="clampgather", default=None,
+                          choices=tuple(defect_registry.names("bounds")),
+                          help="re-introduce a known bounds bug — "
+                               "clampgather (default): drop the spliced "
+                               "page-table & mask decode; i8wrap: "
+                               "restage the AC carried DFA state "
+                               "through int8 — and verify the verifier "
+                               "reports it with a DIVERGING boundary "
+                               "witness (exit 0 = caught; run in a "
+                               "fresh process, the flags act at trace "
+                               "time)")
+    p_bounds.set_defaults(fn=cmd_bounds)
+
+    p_acc = sub.add_parser(
+        "acceptance", help="run the injected-defect registry "
+                           "(infw.analysis.defects) end to end")
+    p_acc.add_argument("--json", action="store_true")
+    p_acc.add_argument("--checker", metavar="NAMES",
+                       help="comma-separated checker subset "
+                            "(state,lock,sched,jax,bounds; default all)")
+    p_acc.add_argument("--defects", metavar="NAMES",
+                       help="comma-separated defect subset")
+    p_acc.set_defaults(fn=cmd_acceptance)
 
     args = ap.parse_args(argv)
     return args.fn(args)
